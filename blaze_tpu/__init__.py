@@ -1,0 +1,46 @@
+"""blaze_tpu — a TPU-native query-execution engine with the capabilities of
+Apache Auron (formerly Blaze).
+
+Auron intercepts optimized Spark/Flink physical plans, ships them as protobuf
+into a native engine, and executes them with vectorized columnar kernels
+(reference: /root/reference/README.md:30-46).  blaze_tpu provides the same
+capability re-designed TPU-first: plans decode into a DAG of operators whose
+hot paths are `jax.jit`-compiled XLA/Pallas programs over statically-shaped
+columnar batches, scaled across chips with `jax.sharding` meshes and XLA
+collectives instead of shuffle-file RPC where possible.
+
+Layer map (mirrors SURVEY.md §1):
+  - plan/     : plan IR + serde + planner   (ref: native-engine/auron-planner)
+  - ops/      : execution operators         (ref: datafusion-ext-plans)
+  - exprs/    : expression evaluation       (ref: datafusion-ext-exprs)
+  - funcs/    : spark-semantics functions   (ref: datafusion-ext-functions)
+  - kernels/  : shared kernels              (ref: datafusion-ext-commons)
+  - shuffle/  : repartitioners + IPC files  (ref: datafusion-ext-plans/src/shuffle)
+  - memory/   : memory budget + spill       (ref: auron-memmgr)
+  - parallel/ : mesh / collective exchange  (TPU-native: ICI all-to-all, psum)
+  - bridge/   : host runtime + resource map (ref: auron/ + auron-jni-bridge)
+"""
+
+import jax
+
+# 64-bit dtypes are load-bearing for this domain: Arrow int64 keys, Spark
+# xxhash64, decimal128 unscaled values.  The axon TPU backend supports
+# i64/u64/f64 (emulated where needed), so enable globally before any tracing.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from blaze_tpu.schema import DataType, Field, Schema  # noqa: E402
+from blaze_tpu.batch import ColumnBatch, DeviceColumn, HostColumn  # noqa: E402
+from blaze_tpu.config import conf  # noqa: E402
+
+__all__ = [
+    "DataType",
+    "Field",
+    "Schema",
+    "ColumnBatch",
+    "DeviceColumn",
+    "HostColumn",
+    "conf",
+    "__version__",
+]

@@ -1,0 +1,293 @@
+"""Columnar batches bridging Arrow (host) and statically-shaped device arrays.
+
+The reference streams Arrow `RecordBatch`es between operators
+(ref: native-engine/auron/src/rt.rs:156-192, Arrow C-Data FFI at the JVM
+boundary).  XLA wants static shapes, so the TPU-native equivalent is:
+
+  * every device buffer is padded to a static `capacity` (rounded to the TPU
+    lane width, 128); real row count is host-side metadata;
+  * nullability is a separate bool `validity` array per column (Arrow's
+    validity bitmap, unpacked — TPU ops are masked, not branchy);
+  * filters do NOT compact: they AND a row `selection` mask (the
+    CoalesceStream analog, ref common/execution_context.rs:146-150, compacts
+    lazily at operator boundaries that need packed rows);
+  * variable-width columns (utf8/binary/nested) stay host-resident as Arrow
+    arrays and join the device columns only through dedicated kernels
+    (offsets+bytes form) — TPU has no pointers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu import config
+from blaze_tpu.schema import DataType, Field, Schema, TypeId
+
+LANE = 128  # TPU lane width; device buffers are padded to a multiple of this
+
+
+def round_capacity(n: int) -> int:
+    return max(LANE, -(-n // LANE) * LANE)
+
+
+def _unpack_validity(arr: pa.Array) -> np.ndarray:
+    """Arrow validity bitmap -> bool array of len(arr)."""
+    if arr.null_count == 0:
+        return np.ones(len(arr), dtype=bool)
+    buf = arr.buffers()[0]
+    bits = np.unpackbits(np.frombuffer(buf, dtype=np.uint8), bitorder="little")
+    return bits[arr.offset:arr.offset + len(arr)].astype(bool)
+
+
+def _arrow_fixed_values(arr: pa.Array, dtype: DataType) -> np.ndarray:
+    """Extract the data buffer of a fixed-width Arrow array as numpy."""
+    if dtype.id == TypeId.BOOL:
+        buf = arr.buffers()[1]
+        bits = np.unpackbits(np.frombuffer(buf, dtype=np.uint8), bitorder="little")
+        return bits[arr.offset:arr.offset + len(arr)].astype(bool)
+    if dtype.id == TypeId.DECIMAL:
+        buf = arr.buffers()[1]
+        # decimal128 little-endian; p<=18 fits in the low 8 bytes
+        pairs = np.frombuffer(buf, dtype=np.int64).reshape(-1, 2)
+        return pairs[arr.offset:arr.offset + len(arr), 0].copy()
+    np_dtype = dtype.np_dtype()
+    buf = arr.buffers()[1]
+    vals = np.frombuffer(buf, dtype=np_dtype)
+    return vals[arr.offset:arr.offset + len(arr)]
+
+
+@dataclass
+class DeviceColumn:
+    """Fixed-width column resident on device: padded data + validity."""
+
+    dtype: DataType
+    data: jax.Array      # (capacity,)
+    validity: jax.Array  # (capacity,) bool; False in padding
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    @staticmethod
+    def from_numpy(values: np.ndarray, valid: Optional[np.ndarray],
+                   dtype: DataType, capacity: int) -> "DeviceColumn":
+        n = len(values)
+        assert capacity >= n
+        np_dtype = dtype.np_dtype()
+        data = np.zeros(capacity, dtype=np_dtype)
+        data[:n] = values
+        v = np.zeros(capacity, dtype=bool)
+        v[:n] = True if valid is None else valid
+        return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(v))
+
+    @staticmethod
+    def from_arrow(arr: pa.Array, dtype: DataType, capacity: int) -> "DeviceColumn":
+        arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+        values = _arrow_fixed_values(arr, dtype)
+        valid = _unpack_validity(arr)
+        return DeviceColumn.from_numpy(values, valid, dtype, capacity)
+
+    def to_arrow(self, num_rows: int, selection: Optional[np.ndarray] = None) -> pa.Array:
+        values = np.asarray(self.data)[:num_rows]
+        valid = np.asarray(self.validity)[:num_rows]
+        if selection is not None:
+            values = values[selection[:num_rows]]
+            valid = valid[selection[:num_rows]]
+        mask = ~valid
+        at = self.dtype.to_arrow()
+        if self.dtype.id == TypeId.DECIMAL:
+            ints = pa.array(values, mask=mask)
+            # unscaled int64 -> decimal128 via arrow cast of the raw integers,
+            # then reinterpret scale (arrow cast would rescale, so build
+            # decimal from pieces instead)
+            import decimal as pydec
+            scale = self.dtype.scale
+            py = [None if m else pydec.Decimal(int(v)).scaleb(-scale)
+                  for v, m in zip(values.tolist(), mask.tolist())]
+            return pa.array(py, type=at)
+        if self.dtype.id == TypeId.BOOL:
+            return pa.array(values.astype(bool), type=at, mask=mask)
+        return pa.array(values, type=at, mask=mask)
+
+    def take_host(self, indices: np.ndarray) -> "DeviceColumn":
+        """Gather rows host-side (compaction boundary)."""
+        values = np.asarray(self.data)[indices]
+        valid = np.asarray(self.validity)[indices]
+        return DeviceColumn.from_numpy(values, valid, self.dtype,
+                                       round_capacity(len(indices)))
+
+
+@dataclass
+class HostColumn:
+    """Variable-width / nested column kept host-side as an Arrow array."""
+
+    dtype: DataType
+    array: pa.Array  # exactly num_rows long (never padded)
+
+    @property
+    def capacity(self) -> int:
+        return len(self.array)
+
+    def to_arrow(self, num_rows: int, selection: Optional[np.ndarray] = None) -> pa.Array:
+        arr = self.array.slice(0, num_rows)
+        if selection is not None:
+            arr = arr.filter(pa.array(selection[:num_rows]))
+        return arr
+
+    def take_host(self, indices: np.ndarray) -> "HostColumn":
+        return HostColumn(self.dtype, self.array.take(pa.array(indices, type=pa.int64())))
+
+
+Column = Union[DeviceColumn, HostColumn]
+
+
+@dataclass
+class ColumnBatch:
+    """A batch of rows: schema + per-column device/host storage.
+
+    `selection` (device bool array over capacity, or None) marks surviving
+    rows after filters; padding rows are always deselected via `row_mask()`.
+    """
+
+    schema: Schema
+    columns: List[Column]
+    num_rows: int
+    selection: Optional[jax.Array] = None
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_arrow(rb: Union[pa.RecordBatch, pa.Table],
+                   capacity: Optional[int] = None) -> "ColumnBatch":
+        if isinstance(rb, pa.Table):
+            rb = rb.combine_chunks()
+            arrays = [c.combine_chunks() if isinstance(c, pa.ChunkedArray) else c
+                      for c in rb.columns]
+            arrays = [a.chunk(0) if isinstance(a, pa.ChunkedArray) else a for a in arrays]
+        else:
+            arrays = list(rb.columns)
+        schema = Schema.from_arrow(rb.schema)
+        n = rb.num_rows
+        cap = capacity or round_capacity(n)
+        cols: List[Column] = []
+        for arr, f in zip(arrays, schema):
+            if f.data_type.is_fixed_width:
+                cols.append(DeviceColumn.from_arrow(arr, f.data_type, cap))
+            else:
+                cols.append(HostColumn(f.data_type, arr))
+        return ColumnBatch(schema, cols, n)
+
+    @staticmethod
+    def from_numpy(schema: Schema, arrays: Sequence[np.ndarray],
+                   capacity: Optional[int] = None) -> "ColumnBatch":
+        n = len(arrays[0]) if arrays else 0
+        cap = capacity or round_capacity(n)
+        cols: List[Column] = []
+        for arr, f in zip(arrays, schema):
+            if f.data_type.is_fixed_width:
+                cols.append(DeviceColumn.from_numpy(np.asarray(arr), None, f.data_type, cap))
+            else:
+                cols.append(HostColumn(f.data_type, pa.array(arr, type=f.data_type.to_arrow())))
+        return ColumnBatch(schema, cols, n)
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        for c in self.columns:
+            if isinstance(c, DeviceColumn):
+                return c.capacity
+        return round_capacity(self.num_rows)
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, i: int) -> Column:
+        return self.columns[i]
+
+    def row_mask(self) -> jax.Array:
+        """Device bool mask over capacity: in-range AND selected."""
+        cap = self.capacity
+        base = jnp.arange(cap) < self.num_rows
+        if self.selection is not None:
+            base = base & self.selection
+        return base
+
+    def selected_count(self) -> int:
+        """Host-synced surviving row count."""
+        if self.selection is None:
+            return self.num_rows
+        return int(jnp.sum(self.row_mask()))
+
+    # -- transformations ----------------------------------------------------
+    def with_selection(self, sel: jax.Array) -> "ColumnBatch":
+        new = sel if self.selection is None else (self.selection & sel)
+        return replace(self, selection=new)
+
+    def compact(self) -> "ColumnBatch":
+        """Pack surviving rows to the front; drops the selection mask.
+
+        Host-side boundary operation (the CoalesceStream analog)."""
+        if self.selection is None:
+            return self
+        sel_np = np.asarray(self.row_mask())
+        indices = np.nonzero(sel_np)[0]
+        cols = [c.take_host(indices) if isinstance(c, DeviceColumn)
+                else c.take_host(indices[indices < self.num_rows]) for c in self.columns]
+        return ColumnBatch(self.schema, cols, len(indices), None)
+
+    def take(self, indices: np.ndarray) -> "ColumnBatch":
+        indices = np.asarray(indices)
+        cols = [c.take_host(indices) for c in self.columns]
+        return ColumnBatch(self.schema, cols, len(indices), None)
+
+    def select_columns(self, indices: Sequence[int]) -> "ColumnBatch":
+        return ColumnBatch(Schema([self.schema[i] for i in indices]),
+                           [self.columns[i] for i in indices],
+                           self.num_rows, self.selection)
+
+    def to_arrow(self) -> pa.RecordBatch:
+        sel = None
+        if self.selection is not None:
+            sel = np.asarray(self.row_mask())
+        arrays = [c.to_arrow(self.num_rows, sel) for c in self.columns]
+        return pa.RecordBatch.from_arrays(arrays, schema=self.schema.to_arrow())
+
+    @staticmethod
+    def concat(batches: Sequence["ColumnBatch"],
+               capacity: Optional[int] = None) -> "ColumnBatch":
+        """Concatenate (host-side) after compacting each batch."""
+        assert batches
+        batches = [b.compact() for b in batches]
+        schema = batches[0].schema
+        total = sum(b.num_rows for b in batches)
+        cap = capacity or round_capacity(total)
+        cols: List[Column] = []
+        for i, f in enumerate(schema):
+            if f.data_type.is_fixed_width:
+                vals = np.concatenate([np.asarray(b.columns[i].data)[:b.num_rows]
+                                       for b in batches])
+                valid = np.concatenate([np.asarray(b.columns[i].validity)[:b.num_rows]
+                                        for b in batches])
+                cols.append(DeviceColumn.from_numpy(vals, valid, f.data_type, cap))
+            else:
+                arrs = [b.columns[i].array for b in batches]
+                combined = pa.concat_arrays([a.cast(f.data_type.to_arrow()) for a in arrs])
+                cols.append(HostColumn(f.data_type, combined))
+        return ColumnBatch(schema, cols, total, None)
+
+    def nbytes_device(self) -> int:
+        total = 0
+        for c in self.columns:
+            if isinstance(c, DeviceColumn):
+                total += c.data.nbytes + c.validity.nbytes
+        return total
+
+    def __repr__(self):
+        return (f"ColumnBatch(rows={self.num_rows}, cap={self.capacity}, "
+                f"cols={[f.name for f in self.schema]})")

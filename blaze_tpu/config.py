@@ -1,0 +1,190 @@
+"""Layered typed configuration.
+
+Mirrors the reference's config system: JVM-side `ConfigOption` schema objects
+(ref: auron-core/.../configuration/ConfigOption.java) with ~70 `spark.auron.*`
+keys defined in SparkAuronConfiguration, read lazily by the native side through
+`define_conf!` proxies (ref: auron-jni-bridge/src/conf.rs:20-63).
+
+Here the host engine (Spark bridge or test harness) supplies a plain dict of
+key→string overrides; operators read typed values through module-level
+`ConfigOption` objects.  A single `conf` session object is the source of truth,
+like the reference's single JVM SparkConf.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+_REGISTRY: Dict[str, "ConfigOption"] = {}
+
+
+@dataclass(frozen=True)
+class ConfigOption:
+    """Typed config key with default, alt-keys and doc (ref ConfigOption.java)."""
+
+    key: str
+    default: Any
+    parse: Callable[[str], Any]
+    doc: str = ""
+    alt_keys: tuple = ()
+    category: str = "core"
+
+    def __post_init__(self):
+        _REGISTRY[self.key] = self
+
+    def get(self, session: Optional["ConfSession"] = None) -> Any:
+        return (session or conf).get(self)
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def int_conf(key: str, default: int, doc: str = "", category: str = "core") -> ConfigOption:
+    return ConfigOption(key, default, int, doc, category=category)
+
+
+def float_conf(key: str, default: float, doc: str = "", category: str = "core") -> ConfigOption:
+    return ConfigOption(key, default, float, doc, category=category)
+
+
+def bool_conf(key: str, default: bool, doc: str = "", category: str = "core") -> ConfigOption:
+    return ConfigOption(key, default, _parse_bool, doc, category=category)
+
+
+def str_conf(key: str, default: str, doc: str = "", category: str = "core") -> ConfigOption:
+    return ConfigOption(key, default, str, doc, category=category)
+
+
+class ConfSession:
+    """Mutable override store; thread-safe; env `BLAZE_TPU_<KEY>` wins lowest."""
+
+    def __init__(self, overrides: Optional[Dict[str, str]] = None):
+        self._lock = threading.Lock()
+        self._overrides: Dict[str, str] = dict(overrides or {})
+
+    def set(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._overrides[key] = str(value)
+
+    def unset(self, key: str) -> None:
+        with self._lock:
+            self._overrides.pop(key, None)
+
+    def update(self, kv: Dict[str, Any]) -> None:
+        with self._lock:
+            for k, v in kv.items():
+                self._overrides[k] = str(v)
+
+    def get(self, opt: ConfigOption) -> Any:
+        with self._lock:
+            for k in (opt.key, *opt.alt_keys):
+                if k in self._overrides:
+                    return opt.parse(self._overrides[k])
+        env_key = "BLAZE_TPU_" + opt.key.upper().replace(".", "_")
+        if env_key in os.environ:
+            return opt.parse(os.environ[env_key])
+        return opt.default
+
+    def snapshot(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._overrides)
+
+
+class _Scoped:
+    """Context manager restoring overridden keys on exit (test helper)."""
+
+    def __init__(self, session: ConfSession, kv: Dict[str, Any]):
+        self._session = session
+        self._kv = kv
+        self._saved: Dict[str, Optional[str]] = {}
+
+    def __enter__(self):
+        snap = self._session.snapshot()
+        for k, v in self._kv.items():
+            self._saved[k] = snap.get(k)
+            self._session.set(k, v)
+        return self._session
+
+    def __exit__(self, *exc):
+        for k, old in self._saved.items():
+            if old is None:
+                self._session.unset(k)
+            else:
+                self._session.set(k, old)
+        return False
+
+
+#: Global session (the host bridge replaces/overlays this per task).
+conf = ConfSession()
+
+
+def scoped(**kv: Any) -> _Scoped:
+    """`with scoped(**{"auron.batch.size": 1024}): ...`"""
+    return _Scoped(conf, {k.replace("_", "."): v for k, v in kv.items()} if all(
+        "." not in k for k in kv) else kv)
+
+
+def describe_all() -> List[Dict[str, Any]]:
+    """Doc generator feed (ref SparkAuronConfigurationDocGenerator.java)."""
+    return [
+        {"key": o.key, "default": o.default, "doc": o.doc, "category": o.category}
+        for o in sorted(_REGISTRY.values(), key=lambda o: o.key)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Core option schema.  Keys keep the reference's names (conf.rs:32-63 /
+# SparkAuronConfiguration) so a host bridge can pass them straight through.
+# ---------------------------------------------------------------------------
+
+BATCH_SIZE = int_conf(
+    "auron.batch.size", 8192,
+    "Static rows-per-batch tile; device buffers are padded to this capacity.")
+MEMORY_FRACTION = float_conf(
+    "auron.memory.fraction", 0.6,
+    "Fraction of the device HBM budget granted to the memory manager.")
+SMJ_FALLBACK_ENABLE = bool_conf(
+    "auron.smjfallback.enable", False, "Allow SMJ fallback for oversized hash joins.")
+PARTIAL_AGG_SKIPPING_ENABLE = bool_conf(
+    "auron.partialAggSkipping.enable", True,
+    "Pass rows through un-aggregated when partial-agg cardinality is too high "
+    "(ref agg_table.rs:108-122).")
+PARTIAL_AGG_SKIPPING_RATIO = float_conf(
+    "auron.partialAggSkipping.ratio", 0.8,
+    "Cardinality/rows ratio beyond which partial agg switches to pass-through.")
+PARTIAL_AGG_SKIPPING_MIN_ROWS = int_conf(
+    "auron.partialAggSkipping.minRows", 8192 * 25,
+    "Rows observed before partial-agg skipping may trigger.")
+SPILL_COMPRESSION_CODEC = str_conf(
+    "auron.spill.compression.codec", "zstd", "Codec for spill files + shuffle IPC.")
+SHUFFLE_COMPRESSION_TARGET_BUF_SIZE = int_conf(
+    "auron.shuffle.compression.target.buf.size", 4194304,
+    "Target frame size for compressed shuffle IPC blocks.")
+UDF_WRAPPER_NUM_THREADS = int_conf(
+    "auron.udfWrapper.numThreads", 1, "Host threads serving UDF fallback eval.")
+TOKIO_WORKER_THREADS_PER_CPU = int_conf(
+    "auron.tokio.worker.threads.per.cpu", 1,
+    "Host async worker threads per CPU core for the task runtime "
+    "(ref rt.rs:108-112; our executor is a thread pool feeding the device).")
+PARQUET_ENABLE_PAGE_FILTERING = bool_conf(
+    "auron.parquet.enable.pageFiltering", True,
+    "Row-group/page pruning with min-max stats on scan (ref conf.rs:43).")
+PARQUET_ENABLE_BLOOM_FILTER = bool_conf(
+    "auron.parquet.enable.bloomFilter", False,
+    "Parquet bloom-filter pruning on scan (ref conf.rs:44).")
+IGNORE_CORRUPTED_FILES = bool_conf(
+    "auron.ignore.corrupted.files", False, "Skip unreadable input files.")
+INPUT_BATCH_PREFETCH = int_conf(
+    "auron.input.batch.prefetch", 2,
+    "Host->device double-buffering depth (the sync_channel(1) analog, rt.rs:142).")
+ON_DEVICE_AGG_CAPACITY = int_conf(
+    "auron.tpu.agg.table.capacity", 1 << 16,
+    "Static per-device group slots for hash aggregation before host merge.")
+SORT_SPILL_BATCHES = int_conf(
+    "auron.tpu.sort.inmem.batches", 64,
+    "Batches buffered in device memory before external sort spills a run.")
+CASE_SENSITIVE = bool_conf("spark.sql.caseSensitive", False, "Column name matching.")

@@ -1,0 +1,1 @@
+"""Shared vectorized kernels (ref: datafusion-ext-commons)."""

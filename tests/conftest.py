@@ -1,0 +1,15 @@
+"""Test config: force CPU platform with 8 virtual devices so sharding /
+collective paths are exercised without TPU hardware (the reference's analog:
+spark-local[N] exercising the full shuffle path without a cluster,
+SURVEY.md §4)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

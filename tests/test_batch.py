@@ -1,0 +1,104 @@
+"""ColumnBatch: Arrow ⇄ device round trips, selection masks, compaction."""
+
+import numpy as np
+import pyarrow as pa
+import jax.numpy as jnp
+
+from blaze_tpu import schema as S
+from blaze_tpu.batch import ColumnBatch, DeviceColumn, HostColumn, round_capacity
+
+
+def _sample_rb():
+    return pa.record_batch({
+        "i": pa.array([1, 2, None, 4, 5], type=pa.int64()),
+        "f": pa.array([1.5, None, 3.5, 4.5, 5.5], type=pa.float64()),
+        "s": pa.array(["a", "bb", None, "dddd", "e"]),
+        "b": pa.array([True, False, True, None, False]),
+        "d": pa.array([0, 1, 2, 3, None], type=pa.date32()),
+    })
+
+
+def test_round_capacity():
+    assert round_capacity(0) == 128
+    assert round_capacity(1) == 128
+    assert round_capacity(128) == 128
+    assert round_capacity(129) == 256
+
+
+def test_arrow_roundtrip():
+    rb = _sample_rb()
+    cb = ColumnBatch.from_arrow(rb)
+    assert cb.num_rows == 5
+    assert cb.capacity == 128
+    assert isinstance(cb.columns[0], DeviceColumn)
+    assert isinstance(cb.columns[2], HostColumn)
+    back = cb.to_arrow()
+    assert back.equals(rb)
+
+
+def test_validity_and_padding():
+    cb = ColumnBatch.from_arrow(_sample_rb())
+    col = cb.columns[0]
+    v = np.asarray(col.validity)
+    assert v[:5].tolist() == [True, True, False, True, True]
+    assert not v[5:].any()
+
+
+def test_selection_and_compact():
+    cb = ColumnBatch.from_arrow(_sample_rb())
+    sel = jnp.asarray(np.arange(cb.capacity) % 2 == 0)  # keep rows 0, 2, 4
+    out = cb.with_selection(sel)
+    assert out.selected_count() == 3
+    packed = out.compact()
+    assert packed.num_rows == 3
+    rb = packed.to_arrow()
+    assert rb.column(0).to_pylist() == [1, None, 5]
+    assert rb.column(2).to_pylist() == ["a", None, "e"]
+
+
+def test_selection_chaining():
+    cb = ColumnBatch.from_arrow(_sample_rb())
+    s1 = jnp.asarray(np.arange(cb.capacity) < 4)
+    s2 = jnp.asarray(np.arange(cb.capacity) >= 2)
+    out = cb.with_selection(s1).with_selection(s2)
+    assert out.selected_count() == 2
+    assert out.compact().to_arrow().column(0).to_pylist() == [None, 4]
+
+
+def test_concat():
+    cb1 = ColumnBatch.from_arrow(_sample_rb())
+    cb2 = ColumnBatch.from_arrow(_sample_rb())
+    out = ColumnBatch.concat([cb1, cb2])
+    assert out.num_rows == 10
+    assert out.to_arrow().column(0).to_pylist() == [1, 2, None, 4, 5] * 2
+
+
+def test_decimal_roundtrip():
+    import decimal as pydec
+    rb = pa.record_batch({
+        "dec": pa.array([None, pydec.Decimal("1.00"), pydec.Decimal("250.00")],
+                        type=pa.decimal128(10, 2)),
+    })
+    cb = ColumnBatch.from_arrow(rb)
+    col = cb.columns[0]
+    assert isinstance(col, DeviceColumn)
+    # unscaled representation: 1 -> 100, 250 -> 25000
+    assert np.asarray(col.data)[:3].tolist() == [0, 100, 25000]
+    back = cb.to_arrow()
+    assert back.column(0).to_pylist()[1:] == [__import__("decimal").Decimal("1.00"),
+                                              __import__("decimal").Decimal("250.00")]
+
+
+def test_timestamp_roundtrip():
+    rb = pa.record_batch({
+        "ts": pa.array([1_000_000, None, 3_000_000], type=pa.timestamp("us")),
+    })
+    cb = ColumnBatch.from_arrow(rb)
+    assert cb.to_arrow().equals(rb)
+
+
+def test_select_columns():
+    cb = ColumnBatch.from_arrow(_sample_rb())
+    out = cb.select_columns([2, 0])
+    assert out.schema.names == ["s", "i"]
+    assert out.to_arrow().column(1).to_pylist() == [1, 2, None, 4, 5]

@@ -1,0 +1,133 @@
+"""Bit-exactness tests against Spark-generated vectors.
+
+Expected values mirror the reference's own test vectors
+(ref: datafusion-ext-commons/src/spark_hash.rs:415-520, themselves generated
+with Spark Murmur3_x86_32 / XxHash64) — behavioral parity, not a code port.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.kernels import hashing as H
+
+
+def _mm3(cols, n):
+    return np.asarray(H.hash_columns(cols, seed=42, xp=np, algo="murmur3"))
+
+
+def test_murmur3_i32_vectors():
+    for value, expected in [(1, -559580957), (2, 1765031574),
+                            (3, -1823081949), (4, -397064898)]:
+        vals = np.array([value], dtype=np.int32)
+        out = H.hash_columns([(vals, None, "int32")], xp=np)
+        assert out[0] == expected
+
+
+def test_murmur3_i8_promotes_to_int():
+    vals = np.array([1, 0, -1, 127, -128], dtype=np.int8)
+    out = H.hash_columns([(vals, None, "int8")], xp=np)
+    expected = np.array([0xDEA578E3, 0x379FAE8F, 0xA0590E3D, 0x43B4D8ED, 0x422A1365],
+                        dtype=np.uint32).view(np.int32)
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_murmur3_i64_vectors():
+    vals = np.array([1, 0, -1, np.iinfo(np.int64).max, np.iinfo(np.int64).min],
+                    dtype=np.int64)
+    out = H.hash_columns([(vals, None, "int64")], xp=np)
+    expected = np.array([0x99F0149D, 0x9C67B85D, 0xC8008529, 0xA05B5D7B, 0xCD1E64FB],
+                        dtype=np.uint32).view(np.int32)
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_murmur3_string_vectors():
+    arr = pa.array(["hello", "bar", "", "😁", "天地"])
+    (mat, lengths), valid = H.string_column_to_padded_bytes(arr)
+    out = H.hash_columns([(((mat, lengths)), valid, "utf8")], xp=np)
+    expected = np.array([3286402344, 2486176763, 142593372, 885025535, 2395000894],
+                        dtype=np.uint32).view(np.int32)
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_xxhash64_i64_vectors():
+    vals = np.array([1, 0, -1, np.iinfo(np.int64).max, np.iinfo(np.int64).min],
+                    dtype=np.int64)
+    out = H.hash_columns([(vals, None, "int64")], xp=np, algo="xxhash64")
+    expected = np.array([-7001672635703045582, -5252525462095825812,
+                         3858142552250413010, -3246596055638297850,
+                         -8619748838626508300], dtype=np.int64)
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_xxhash64_string_vectors():
+    arr = pa.array(["hello", "bar", "", "😁", "天地"])
+    (mat, lengths), valid = H.string_column_to_padded_bytes(arr)
+    out = H.hash_columns([((mat, lengths), valid, "utf8")], xp=np, algo="xxhash64")
+    expected = np.array([-4367754540140381902, -1798770879548125814,
+                         -7444071767201028348, -6337236088984028203,
+                         -235771157374669727], dtype=np.int64)
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_xxhash64_long_strings_stripes():
+    # >32 bytes exercises the stripe path
+    s = ["a" * 100, "b" * 33, "c" * 32, "d" * 31, "x" * 64 + "tail"]
+    arr = pa.array(s)
+    (mat, lengths), valid = H.string_column_to_padded_bytes(arr)
+    out = np.asarray(H.xxhash64_bytes(mat, lengths,
+                                      np.full(5, 42, dtype=np.int64).view(np.uint64)))
+    # cross-check against the reference python impl of xxh64 (hashlib lacks it),
+    # so instead assert device/host agreement and determinism
+    out_j = np.asarray(H.xxhash64_bytes(jnp.asarray(mat), jnp.asarray(lengths),
+                                        jnp.full(5, 42, dtype=jnp.int64).view(jnp.uint64),
+                                        xp=jnp))
+    np.testing.assert_array_equal(out, out_j)
+
+
+def test_null_rows_keep_seed():
+    vals = np.array([1, 1], dtype=np.int32)
+    valid = np.array([True, False])
+    out = H.hash_columns([(vals, valid, "int32")], xp=np)
+    assert out[0] == -559580957
+    assert out[1] == 42  # untouched seed
+
+
+def test_multi_column_chaining_matches_sequential():
+    a = np.array([1, 2, 3], dtype=np.int32)
+    b = np.array([10, 20, 30], dtype=np.int64)
+    chained = H.hash_columns([(a, None, "int32"), (b, None, "int64")], xp=np)
+    seeds = np.full(3, 42, dtype=np.uint32)
+    h1 = H.murmur3_hash_int(a, seeds, np)
+    h2 = H.murmur3_hash_long(b, h1, np)
+    np.testing.assert_array_equal(chained, h2.view(np.int32))
+
+
+def test_device_host_agreement():
+    rng = np.random.default_rng(0)
+    vals32 = rng.integers(-2**31, 2**31 - 1, size=1000, dtype=np.int64).astype(np.int32)
+    vals64 = rng.integers(-2**62, 2**62, size=1000, dtype=np.int64)
+    host = H.hash_columns([(vals32, None, "int32"), (vals64, None, "int64")], xp=np)
+    dev = H.hash_columns([(jnp.asarray(vals32), None, "int32"),
+                          (jnp.asarray(vals64), None, "int64")], xp=jnp)
+    np.testing.assert_array_equal(host, np.asarray(dev))
+
+    hostx = H.hash_columns([(vals64, None, "int64")], xp=np, algo="xxhash64")
+    devx = H.hash_columns([(jnp.asarray(vals64), None, "int64")], xp=jnp,
+                          algo="xxhash64")
+    np.testing.assert_array_equal(hostx, np.asarray(devx))
+
+
+def test_pmod_nonnegative():
+    h = np.array([-7, 7, -200, 0], dtype=np.int32)
+    out = H.pmod(h, 200, xp=np)
+    assert out.tolist() == [193, 7, 0, 0]
+    assert (np.asarray(H.pmod(jnp.asarray(h), 200)) == out).all()
+
+
+def test_float_hash_negzero_and_nan():
+    # -0.0 and 0.0 hash differently in raw bits; NaNs canonicalize
+    f = np.array([np.nan, np.float32(np.nan)], dtype=np.float32)
+    out = H.hash_columns([(f, None, "float32")], xp=np)
+    assert out[0] == out[1]
