@@ -47,6 +47,10 @@ def _unpack_validity(arr: pa.Array) -> np.ndarray:
 
 def _arrow_fixed_values(arr: pa.Array, dtype: DataType) -> np.ndarray:
     """Extract the data buffer of a fixed-width Arrow array as numpy."""
+    if dtype.id == TypeId.TIMESTAMP_MICROS and pa.types.is_timestamp(arr.type) \
+            and arr.type.unit != "us":
+        # normalize any timestamp unit to microseconds at the host boundary
+        arr = arr.cast(pa.timestamp("us", tz=arr.type.tz))
     if dtype.id == TypeId.BOOL:
         buf = arr.buffers()[1]
         bits = np.unpackbits(np.frombuffer(buf, dtype=np.uint8), bitorder="little")
@@ -237,8 +241,7 @@ class ColumnBatch:
             return self
         sel_np = np.asarray(self.row_mask())
         indices = np.nonzero(sel_np)[0]
-        cols = [c.take_host(indices) if isinstance(c, DeviceColumn)
-                else c.take_host(indices[indices < self.num_rows]) for c in self.columns]
+        cols = [c.take_host(indices) for c in self.columns]
         return ColumnBatch(self.schema, cols, len(indices), None)
 
     def take(self, indices: np.ndarray) -> "ColumnBatch":

@@ -1,0 +1,118 @@
+"""Null-aware comparison and order-key encoding.
+
+Parity targets: the reference's eq-comparator and row-encoding machinery
+(ref: datafusion-ext-commons/src/arrow/eq_comparator.rs; sort key-prefix
+`Rows` encoding in datafusion-ext-plans/src/sort_exec.rs:86).
+
+TPU-first design: instead of byte-wise row encodings compared with memcmp,
+each sort key column is mapped to an *order key* — an unsigned integer whose
+natural `<` ordering equals the column's SQL ordering (asc/desc,
+nulls-first/last, NaN-largest like Spark).  Multi-key sorts then feed the
+order keys to `jax.lax.sort(..., num_keys=k)`, which XLA lowers to a single
+fused lexicographic sort on device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.schema import DataType, TypeId
+
+
+def order_key(data: jax.Array, validity: Optional[jax.Array], dtype: DataType,
+              descending: bool = False, nulls_first: bool = True
+              ) -> Tuple[jax.Array, jax.Array]:
+    """Map one column to a (bucket uint8, value_key) operand pair whose joint
+    lexicographic `<` equals the column's SQL ordering.
+
+    Two separate sort operands (not one packed word) because int64 keys need
+    all 64 bits, and TPU x64-emulation has no f64<->i64 bitcast — floats stay
+    floats and sort with XLA's native comparator.  Bucket layout:
+      0/4 = null (first/last, per nulls_first — Spark's NULLS FIRST/LAST is
+            independent of ASC/DESC),
+      2   = ordinary value,
+      1/3 = NaN (Spark treats NaN as the largest value: after values on ASC,
+            before values on DESC).
+    NaN value-keys are zeroed and -0.0 normalized to +0.0, so the same
+    operands double as grouping keys (NaN == NaN, -0.0 == 0.0, null == null).
+    """
+    tid = dtype.id
+    n = data.shape[0]
+    if tid in (TypeId.FLOAT32, TypeId.FLOAT64):
+        is_nan = jnp.isnan(data)
+        key = jnp.where(is_nan, jnp.zeros_like(data), data)
+        if descending:
+            key = -key
+        key = key + jnp.zeros_like(key)  # -0.0 + 0.0 == +0.0 normalization
+        bucket = jnp.where(is_nan, jnp.uint8(1 if descending else 3), jnp.uint8(2))
+    elif tid == TypeId.BOOL:
+        key = data.astype(jnp.uint8)
+        if descending:
+            key = jnp.uint8(1) - key
+        bucket = jnp.full(n, 2, dtype=jnp.uint8)
+    else:
+        v = data.astype(jnp.int64)
+        key = (v.view(jnp.uint64)) ^ jnp.uint64(0x8000000000000000)  # sign bias
+        if descending:
+            key = ~key
+        bucket = jnp.full(n, 2, dtype=jnp.uint8)
+    if validity is not None:
+        bucket = jnp.where(validity, bucket, jnp.uint8(0 if nulls_first else 4))
+        key = jnp.where(validity, key, jnp.zeros_like(key))
+    return bucket, key
+
+
+def order_keys(columns: Sequence[Tuple[jax.Array, Optional[jax.Array], DataType]],
+               descending: Sequence[bool], nulls_first: Sequence[bool]
+               ) -> Tuple[jax.Array, ...]:
+    """Flattened (bucket, key) operand list for lexsort_indices."""
+    out = []
+    for (d, v, t), desc, nf in zip(columns, descending, nulls_first):
+        bucket, key = order_key(d, v, t, desc, nf)
+        out.append(bucket)
+        out.append(key)
+    return tuple(out)
+
+
+def lexsort_indices(keys: Sequence[jax.Array], valid_mask: Optional[jax.Array] = None,
+                    ) -> jax.Array:
+    """Stable lexicographic sort permutation over equal-length key arrays.
+
+    Invalid rows (masked) sort to the very end regardless of keys."""
+    n = keys[0].shape[0]
+    ops = list(keys)
+    if valid_mask is not None:
+        ops = [jnp.where(valid_mask, jnp.uint8(0), jnp.uint8(1))] + ops
+    perm = jnp.arange(n, dtype=jnp.int32)
+    out = jax.lax.sort(tuple(ops) + (perm,), num_keys=len(ops), is_stable=True)
+    return out[-1]
+
+
+def null_aware_eq(a_data: jax.Array, a_valid: Optional[jax.Array],
+                  b_data: jax.Array, b_valid: Optional[jax.Array],
+                  nan_equal: bool = True) -> jax.Array:
+    """SQL <=> / grouping equality: null == null, NaN == NaN (Spark grouping).
+
+    The eq_comparator analog (ref arrow/eq_comparator.rs)."""
+    eq = a_data == b_data
+    if jnp.issubdtype(a_data.dtype, jnp.floating) and nan_equal:
+        eq = eq | (jnp.isnan(a_data) & jnp.isnan(b_data))
+    av = jnp.ones_like(eq) if a_valid is None else a_valid
+    bv = jnp.ones_like(eq) if b_valid is None else b_valid
+    return jnp.where(av & bv, eq, av == bv)
+
+
+def rows_differ_from_prev(keys: Sequence[jax.Array]) -> jax.Array:
+    """Boundary mask over sorted rows: True where row i != row i-1 on any key.
+
+    Row 0 is always a boundary.  Feeds segmented aggregation (group ids =
+    cumsum(boundaries) - 1), the sort-based replacement for the reference's
+    agg hash map (ref agg/agg_hash_map.rs — see SURVEY.md §7 hard-part 3)."""
+    n = keys[0].shape[0]
+    diff = jnp.zeros(n, dtype=bool)
+    for k in keys:
+        diff = diff | jnp.concatenate([jnp.ones(1, bool), k[1:] != k[:-1]])
+    return diff

@@ -333,21 +333,34 @@ def string_column_to_padded_bytes(arr, xp=np) -> Tuple:
     import pyarrow as pa
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
-    arr = arr.cast(pa.binary()) if pa.types.is_string(arr.type) else arr
+    if pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type):
+        arr = arr.cast(pa.binary())
     n = len(arr)
-    lengths = np.zeros(n, dtype=np.int32)
-    valid = np.ones(n, dtype=bool)
-    pylist = arr.to_pylist()
-    for i, v in enumerate(pylist):
-        if v is None:
-            valid[i] = False
+    if n == 0:
+        mat = np.zeros((0, 4), dtype=np.uint8)
+        lengths = np.zeros(0, dtype=np.int32)
+        valid = np.ones(0, dtype=bool)
+    else:
+        # vectorized from the Arrow offsets/data buffers — no per-row Python
+        validity_buf = arr.buffers()[0]
+        if validity_buf is None or arr.null_count == 0:
+            valid = np.ones(n, dtype=bool)
         else:
-            lengths[i] = len(v)
-    max_len = max(int(lengths.max()), 4) if n else 4
-    mat = np.zeros((n, max_len), dtype=np.uint8)
-    for i, v in enumerate(pylist):
-        if v:
-            mat[i, :len(v)] = np.frombuffer(v, dtype=np.uint8)
+            bits = np.unpackbits(np.frombuffer(validity_buf, dtype=np.uint8),
+                                 bitorder="little")
+            valid = bits[arr.offset:arr.offset + n].astype(bool)
+        offsets = np.frombuffer(arr.buffers()[1], dtype=np.int32)[
+            arr.offset:arr.offset + n + 1].astype(np.int64)
+        data_buf = arr.buffers()[2]
+        data = (np.frombuffer(data_buf, dtype=np.uint8) if data_buf is not None
+                else np.zeros(0, dtype=np.uint8))
+        lengths = np.diff(offsets).astype(np.int32)
+        max_len = max(int(lengths.max()), 4)
+        idx = offsets[:-1, None] + np.arange(max_len)[None, :]
+        in_range = np.arange(max_len)[None, :] < lengths[:, None]
+        safe = np.clip(idx, 0, max(len(data) - 1, 0))
+        mat = np.where(in_range & (len(data) > 0), data[safe], np.uint8(0))
+        lengths = np.where(valid, lengths, 0).astype(np.int32)
     if xp is not np:
         return (xp.asarray(mat), xp.asarray(lengths)), xp.asarray(valid)
     return (mat, lengths), valid

@@ -1,0 +1,112 @@
+"""Selection / compaction / interleave kernels.
+
+Behavioral parity with the reference's selection kernels
+(ref: datafusion-ext-commons/src/arrow/selection.rs `create_batch_interleaver`,
+arrow/coalesce.rs) re-designed for static shapes: instead of producing
+data-dependent-length outputs, device kernels emit fixed-capacity outputs plus
+a valid-count, and compaction happens either fully on device (stable
+partition-by-mask via argsort) or at host boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def compaction_indices(mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Stable front-packing permutation for a bool mask (device-only).
+
+    Returns (indices, count): `indices[i]` for i < count is the i-th selected
+    row, and rows >= count point at an arbitrary selected-or-not row (callers
+    mask by count).  Implemented as an argsort of !mask which is stable in
+    XLA, so selected rows keep their relative order — the TPU analog of the
+    CoalesceStream compaction (ref common/execution_context.rs:146-150).
+    """
+    n = mask.shape[0]
+    order = jnp.argsort(~mask, stable=True)
+    count = jnp.sum(mask.astype(jnp.int32))
+    return order, count
+
+
+def compact_column(data: jax.Array, validity: jax.Array,
+                   indices: jax.Array, count: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Gather a column through compaction indices; rows >= count invalidated."""
+    g = jnp.take(data, indices, axis=0)
+    v = jnp.take(validity, indices, axis=0)
+    inrange = jnp.arange(data.shape[0]) < count
+    return g, v & inrange
+
+
+def take(data: jax.Array, validity: jax.Array, indices: jax.Array,
+         index_valid: Optional[jax.Array] = None
+         ) -> Tuple[jax.Array, jax.Array]:
+    """Null-propagating gather: out-of-range or invalid indices yield null.
+
+    The interleave/take analog (ref arrow/selection.rs) used by joins and
+    window functions.  `indices` int32/int64; negative = null output row.
+    """
+    n = data.shape[0]
+    ok = (indices >= 0) & (indices < n)
+    if index_valid is not None:
+        ok = ok & index_valid
+    safe = jnp.clip(indices, 0, n - 1)
+    g = jnp.take(data, safe, axis=0)
+    v = jnp.take(validity, safe, axis=0) & ok
+    return g, v
+
+
+def interleave(columns: Sequence[Tuple[jax.Array, jax.Array]],
+               batch_ids: jax.Array, row_ids: jax.Array
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Interleave rows from multiple stacked batches of one column.
+
+    columns: per-batch (data, validity) with equal capacity.  The device
+    analog of `create_batch_interleaver` (ref arrow/selection.rs): output row
+    i = columns[batch_ids[i]][row_ids[i]].
+    """
+    data = jnp.stack([c[0] for c in columns])     # (nb, cap)
+    valid = jnp.stack([c[1] for c in columns])    # (nb, cap)
+    nb, cap = data.shape
+    ok = (batch_ids >= 0) & (batch_ids < nb) & (row_ids >= 0) & (row_ids < cap)
+    b = jnp.clip(batch_ids, 0, nb - 1)
+    r = jnp.clip(row_ids, 0, cap - 1)
+    g = data[b, r]
+    v = valid[b, r] & ok
+    return g, v
+
+
+def count_true(mask: jax.Array) -> jax.Array:
+    return jnp.sum(mask.astype(jnp.int32))
+
+
+def partition_start_offsets(part_ids: jax.Array, mask: jax.Array,
+                            num_partitions: int
+                            ) -> Tuple[jax.Array, jax.Array]:
+    """Histogram + exclusive prefix for partition-sorted writes.
+
+    Returns (counts[num_partitions], offsets[num_partitions+1]) — the device
+    side of the shuffle `.index` computation (ref shuffle/buffered_data.rs:48:
+    radix-sort rows by partition id then concatenate per-partition runs)."""
+    ids = jnp.where(mask, part_ids, num_partitions)  # masked rows -> overflow bin
+    counts = jnp.bincount(ids, length=num_partitions + 1)[:num_partitions]
+    offsets = jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)])
+    return counts, offsets
+
+
+def sort_by_partition(part_ids: jax.Array, mask: jax.Array
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Stable order of rows grouped by partition id; masked rows sink to end.
+
+    Returns (row_indices, valid_count).  This is the rdx_sort analog for the
+    shuffle write path (ref algorithm/rdx_sort.rs) — on TPU a single stable
+    key sort maps straight onto XLA's sort HLO.
+    """
+    n = part_ids.shape[0]
+    key = jnp.where(mask, part_ids.astype(jnp.int32), jnp.int32(2**31 - 1))
+    order = jnp.argsort(key, stable=True)
+    return order, count_true(mask)

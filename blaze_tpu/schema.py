@@ -53,6 +53,9 @@ class DataType:
     # -- classification ----------------------------------------------------
     @property
     def is_fixed_width(self) -> bool:
+        if self.id == TypeId.DECIMAL:
+            # p>18 exceeds int64 unscaled range -> host-resident column
+            return self.precision <= 18
         return self.id not in (TypeId.UTF8, TypeId.BINARY, TypeId.LIST,
                                TypeId.STRUCT, TypeId.MAP, TypeId.NULL)
 

@@ -1,0 +1,238 @@
+"""Kernel-layer unit tests (pure-native tier, SURVEY.md §4 tier 1):
+selection/compaction, order-key sort, segmented reduce, cast, bloom, strings
+validated against numpy / pyarrow / python references.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.kernels import selection, compare, sort as ksort, cast as kcast
+from blaze_tpu.kernels import bloom, strings, hashing
+from blaze_tpu import schema as S
+
+
+def test_compaction_indices_stable():
+    rng = np.random.default_rng(0)
+    mask = rng.random(512) < 0.3
+    idx, count = selection.compaction_indices(jnp.asarray(mask))
+    idx, count = np.asarray(idx), int(count)
+    assert count == mask.sum()
+    np.testing.assert_array_equal(idx[:count], np.nonzero(mask)[0])
+
+
+def test_take_null_propagation():
+    data = jnp.arange(10, dtype=jnp.int64)
+    valid = jnp.asarray([True] * 9 + [False])
+    idx = jnp.asarray([0, 9, -1, 12, 3])
+    g, v = selection.take(data, valid, idx)
+    np.testing.assert_array_equal(np.asarray(v), [True, False, False, False, True])
+    assert int(g[0]) == 0 and int(g[4]) == 3
+
+
+def test_partition_offsets():
+    pids = jnp.asarray([2, 0, 1, 2, 0, 1, 1], dtype=jnp.int32)
+    mask = jnp.asarray([1, 1, 1, 1, 1, 1, 0], dtype=bool)
+    counts, offsets = selection.partition_start_offsets(pids, mask, 3)
+    np.testing.assert_array_equal(np.asarray(counts), [2, 2, 2])
+    np.testing.assert_array_equal(np.asarray(offsets), [0, 2, 4, 6])
+
+
+@pytest.mark.parametrize("descending", [False, True])
+@pytest.mark.parametrize("nulls_first", [False, True])
+def test_order_key_int_matches_python(descending, nulls_first):
+    rng = np.random.default_rng(1)
+    vals = rng.integers(-1000, 1000, 200).astype(np.int64)
+    valid = rng.random(200) < 0.9
+    bucket, key = compare.order_key(jnp.asarray(vals), jnp.asarray(valid),
+                                    S.INT64, descending, nulls_first)
+    perm = np.asarray(compare.lexsort_indices([bucket, key]))
+    got = [(None if not valid[i] else int(vals[i])) for i in perm]
+
+    def py_key(i):
+        null_rank = 0 if nulls_first else 2
+        if not valid[i]:
+            return (null_rank, 0)
+        return (1, -vals[i] if descending else vals[i])
+    expect_perm = sorted(range(200), key=py_key)
+    expect = [(None if not valid[i] else int(vals[i])) for i in expect_perm]
+    assert got == expect
+
+
+def test_order_key_float_nan_sorts_last():
+    vals = np.array([1.5, np.nan, -np.inf, np.inf, -0.0, 0.0, -2.5])
+    bucket, key = compare.order_key(jnp.asarray(vals), None, S.FLOAT64, False, True)
+    perm = np.asarray(compare.lexsort_indices([bucket, key]))
+    ordered = vals[perm]
+    assert np.isneginf(ordered[0]) and ordered[1] == -2.5
+    assert np.isposinf(ordered[-2]) and np.isnan(ordered[-1])
+
+
+def test_lexsort_multi_key_matches_numpy():
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, 5, 300).astype(np.int64)
+    b = rng.integers(-50, 50, 300).astype(np.int64)
+    keys = compare.order_keys(
+        [(jnp.asarray(a), None, S.INT64), (jnp.asarray(b), None, S.INT64)],
+        [False, True], [True, True])
+    perm = np.asarray(compare.lexsort_indices(list(keys)))
+    expect = np.lexsort((-b, a))  # last key primary in np.lexsort
+    np.testing.assert_array_equal(a[perm], a[expect])
+    np.testing.assert_array_equal(b[perm], b[expect])
+
+
+def test_group_ids_and_segment_sum():
+    keys = jnp.asarray([1, 1, 2, 2, 2, 5, 7, 7], dtype=jnp.int64)
+    valid = jnp.asarray([True] * 8)
+    gids, ngroups = ksort.group_ids_from_sorted([keys], valid)
+    assert int(ngroups) == 4
+    vals = jnp.asarray([1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0])
+    sums = ksort.segment_sum(vals, gids, 8)
+    np.testing.assert_allclose(np.asarray(sums)[:4], [3.0, 12.0, 6.0, 15.0])
+
+
+def test_cast_float_to_int_spark_semantics():
+    vals = jnp.asarray([1.9, -1.9, np.nan, np.inf, -np.inf, 2**40 * 1.0])
+    out, v = kcast.cast_column(vals, None, S.FLOAT64, S.INT32)
+    np.testing.assert_array_equal(
+        np.asarray(out), [1, -1, 0, 2**31 - 1, -(2**31), 2**31 - 1])
+
+
+def test_cast_int_wraparound():
+    vals = jnp.asarray([300, -300, 127], dtype=jnp.int64)
+    out, _ = kcast.cast_column(vals, None, S.INT64, S.INT8)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.array([300, -300, 127]).astype(np.int8))
+
+
+def test_cast_to_decimal_half_up_and_overflow():
+    vals = jnp.asarray([1.25, -1.25, 1.24, 99999.0])
+    out, v = kcast.cast_column(vals, None, S.FLOAT64, S.decimal(5, 2))
+    np.testing.assert_array_equal(np.asarray(out)[:3], [125, -125, 124])
+    assert not bool(np.asarray(v)[3])  # 99999.00 needs p=7 > 5 -> null
+
+
+def test_decimal_rescale_half_up():
+    vals = jnp.asarray([125, -125, 114, -114], dtype=jnp.int64)  # scale 2
+    out, _ = kcast.cast_column(vals, None, S.decimal(5, 2), S.decimal(5, 1))
+    np.testing.assert_array_equal(np.asarray(out), [13, -13, 11, -11])
+
+
+def test_bloom_filter_roundtrip_and_probe():
+    items = np.arange(0, 1000, 3, dtype=np.int64)
+    f = bloom.SparkBloomFilter(bloom.optimal_num_bits(len(items), 0.01),
+                               bloom.optimal_num_hashes(
+                                   len(items), bloom.optimal_num_bits(len(items), 0.01)))
+    f.put_longs(items)
+    probe = jnp.asarray(np.arange(1000, dtype=np.int64))
+    hits = np.asarray(f.might_contain_longs(probe))
+    assert hits[items].all()  # no false negatives
+    fp_rate = hits[np.setdiff1d(np.arange(1000), items)].mean()
+    assert fp_rate < 0.05
+    # serde roundtrip
+    g = bloom.SparkBloomFilter.from_bytes(f.to_bytes())
+    np.testing.assert_array_equal(g.words, f.words)
+    assert g.num_hashes == f.num_hashes
+
+
+def test_string_predicates():
+    arr = pa.array(["hello", "help", "yelp", None, "lo", ""])
+    (mat, lens), valid = hashing.string_column_to_padded_bytes(arr)
+    mat, lens = jnp.asarray(mat), jnp.asarray(lens)
+    np.testing.assert_array_equal(
+        np.asarray(strings.starts_with(mat, lens, b"hel"))[:3], [True, True, False])
+    np.testing.assert_array_equal(
+        np.asarray(strings.ends_with(mat, lens, b"lp"))[:3], [False, True, True])
+    np.testing.assert_array_equal(
+        np.asarray(strings.contains(mat, lens, b"el")),
+        [True, True, True, False, False, False])
+    np.testing.assert_array_equal(
+        np.asarray(strings.eq_const(mat, lens, b"lo")),
+        [False, False, False, False, True, False])
+
+
+def test_string_utf8_length_and_case():
+    arr = pa.array(["abc", "héllo", "", "ABC"])
+    (mat, lens), _ = hashing.string_column_to_padded_bytes(arr)
+    mat, lens = jnp.asarray(mat), jnp.asarray(lens)
+    np.testing.assert_array_equal(
+        np.asarray(strings.length_utf8_chars(mat, lens)), [3, 5, 0, 3])
+    up = np.asarray(strings.upper_ascii(mat))
+    assert bytes(up[0][:3]) == b"ABC"
+
+
+def test_substring_fixed():
+    arr = pa.array(["hello world", "hi", ""])
+    (mat, lens), _ = hashing.string_column_to_padded_bytes(arr)
+    out, out_len = strings.substring_fixed(jnp.asarray(mat), jnp.asarray(lens), 7, 5)
+    assert bytes(np.asarray(out)[0][:int(out_len[0])]) == b"world"
+    assert int(out_len[1]) == 0 or bytes(np.asarray(out)[1][:int(out_len[1])]) == b""
+
+
+# -- regression tests from code review ---------------------------------------
+
+def test_cast_float_to_int64_range_2_62_to_2_63():
+    vals = jnp.asarray([5.0e18, -5.0e18, 9.3e18, -9.3e18])
+    out, _ = kcast.cast_column(vals, None, S.FLOAT64, S.INT64)
+    out = np.asarray(out)
+    assert out[0] == 5000000000000000000 and out[1] == -5000000000000000000
+    assert out[2] == 2**63 - 1 and out[3] == -(2**63)
+
+
+def test_cast_int_to_decimal_no_wraparound():
+    vals = jnp.asarray([1844674407370955162, 5], dtype=jnp.int64)
+    out, v = kcast.cast_column(vals, None, S.INT64, S.decimal(18, 1))
+    assert not bool(np.asarray(v)[0])  # overflow -> null, not wrapped value
+    assert bool(np.asarray(v)[1]) and int(np.asarray(out)[1]) == 50
+
+
+def test_decimal_upscale_no_wraparound():
+    vals = jnp.asarray([10**17, 3], dtype=jnp.int64)  # scale 0 -> scale 2
+    out, v = kcast.cast_column(vals, None, S.decimal(18, 0), S.decimal(18, 2))
+    assert not bool(np.asarray(v)[0])
+    assert int(np.asarray(out)[1]) == 300
+
+
+def test_wide_decimal_stays_host_side():
+    import decimal as pydec
+    from blaze_tpu.batch import ColumnBatch, HostColumn
+    arr = pa.array([pydec.Decimal(2**63), None], type=pa.decimal128(38, 0))
+    cb = ColumnBatch.from_arrow(pa.table({"d": arr}))
+    assert isinstance(cb.columns[0], HostColumn)
+    assert cb.to_arrow().column(0)[0].as_py() == pydec.Decimal(2**63)
+
+
+def test_timestamp_ms_normalized_to_us():
+    from blaze_tpu.batch import ColumnBatch
+    arr = pa.array([1000], type=pa.timestamp("ms"))
+    cb = ColumnBatch.from_arrow(pa.table({"t": arr}))
+    assert int(np.asarray(cb.columns[0].data)[0]) == 1_000_000
+
+
+def test_substring_start_zero_is_one():
+    arr = pa.array(["abc"])
+    (mat, lens), _ = hashing.string_column_to_padded_bytes(arr)
+    out, out_len = strings.substring_fixed(jnp.asarray(mat), jnp.asarray(lens), 0, 2)
+    assert bytes(np.asarray(out)[0][:int(out_len[0])]) == b"ab"
+
+
+def test_segment_first_takes_first_row_even_if_null():
+    vals = jnp.asarray([10, 20, 30], dtype=jnp.int64)
+    valid = jnp.asarray([False, True, True])
+    gids = jnp.asarray([0, 0, 1])
+    v, ok = ksort.segment_first(vals, valid, gids, 3)
+    assert not bool(np.asarray(ok)[0])          # first row of group 0 is null
+    assert int(np.asarray(v)[1]) == 30 and bool(np.asarray(ok)[1])
+    assert not bool(np.asarray(ok)[2])          # empty segment
+
+
+def test_padded_bytes_vectorized_matches_pylist():
+    arr = pa.array(["", None, "abcd", "xy", None, "a" * 40])
+    (mat, lens), valid = hashing.string_column_to_padded_bytes(arr)
+    assert lens.tolist() == [0, 0, 4, 2, 0, 40]
+    assert valid.tolist() == [True, False, True, True, False, True]
+    assert bytes(mat[2][:4]) == b"abcd" and bytes(mat[5][:40]) == b"a" * 40
+    sliced = arr.slice(2, 3)  # non-zero offset path
+    (m2, l2), v2 = hashing.string_column_to_padded_bytes(sliced)
+    assert l2.tolist() == [4, 2, 0] and bytes(m2[0][:4]) == b"abcd"
