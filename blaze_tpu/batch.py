@@ -49,8 +49,9 @@ def _arrow_fixed_values(arr: pa.Array, dtype: DataType) -> np.ndarray:
     """Extract the data buffer of a fixed-width Arrow array as numpy."""
     if dtype.id == TypeId.TIMESTAMP_MICROS and pa.types.is_timestamp(arr.type) \
             and arr.type.unit != "us":
-        # normalize any timestamp unit to microseconds at the host boundary
-        arr = arr.cast(pa.timestamp("us", tz=arr.type.tz))
+        # normalize any timestamp unit to microseconds at the host boundary;
+        # safe=False truncates sub-microsecond ns components like Spark
+        arr = arr.cast(pa.timestamp("us", tz=arr.type.tz), safe=False)
     if dtype.id == TypeId.BOOL:
         buf = arr.buffers()[1]
         bits = np.unpackbits(np.frombuffer(buf, dtype=np.uint8), bitorder="little")

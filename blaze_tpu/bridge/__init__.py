@@ -1,0 +1,11 @@
+"""Host runtime bridge: task context, resource map, metrics, runtime.
+
+Ref: auron-core (JVM core) + native-engine/auron (entry/runtime) layers.
+"""
+
+from blaze_tpu.bridge.context import (TaskContext, TaskKilledError,
+                                      current_task, set_current_task,
+                                      task_scope)
+
+__all__ = ["TaskContext", "TaskKilledError", "current_task",
+           "set_current_task", "task_scope"]

@@ -1,0 +1,66 @@
+"""Per-task execution context.
+
+Parity: the reference's TaskDefinition proto (task_id/stage_id/partition_id,
+ref auron-planner/proto/auron.proto:814 TaskDefinition) and the thread-local
+stage/partition ids the native runtime injects into every worker thread
+(ref native-engine/auron/src/rt.rs:133-135, logging.rs).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass
+class TaskContext:
+    stage_id: int = 0
+    partition_id: int = 0
+    num_partitions: int = 1
+    attempt_num: int = 0
+    task_attempt_id: int = 0
+    # cooperative-cancel probe (ref JniBridge.isTaskRunning,
+    # AuronAdaptor.java:76-80; polled in long loops)
+    is_running: Callable[[], bool] = lambda: True
+
+    def check_running(self):
+        if not self.is_running():
+            raise TaskKilledError(
+                f"task stage={self.stage_id} partition={self.partition_id} killed")
+
+
+class TaskKilledError(RuntimeError):
+    pass
+
+
+_local = threading.local()
+
+
+def current_task() -> TaskContext:
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None:
+        ctx = TaskContext()
+        _local.ctx = ctx
+    return ctx
+
+
+def set_current_task(ctx: Optional[TaskContext]) -> None:
+    _local.ctx = ctx
+
+
+class task_scope:
+    """`with task_scope(TaskContext(...)):` — restores the previous context."""
+
+    def __init__(self, ctx: TaskContext):
+        self._ctx = ctx
+        self._prev: Optional[TaskContext] = None
+
+    def __enter__(self) -> TaskContext:
+        self._prev = getattr(_local, "ctx", None)
+        _local.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _local.ctx = self._prev
+        return False

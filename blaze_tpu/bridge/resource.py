@@ -1,0 +1,51 @@
+"""Process-wide resource map.
+
+Parity: the JVM resource map the native side pulls shuffle-read block
+iterators, broadcast byte arrays and cached build-side hash maps from
+(ref: auron-core/.../jni/JniBridge.java getResource/putResource statics;
+consumed at ipc_reader_exec.rs:144 and broadcast_join_exec.rs build-map
+caching).  Values are arbitrary Python objects; `remove=True` gets preserve
+the reference's take-once semantics for streaming resources.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.Lock()
+_map: Dict[str, Any] = {}
+
+
+def put_resource(key: str, value: Any) -> None:
+    with _lock:
+        _map[key] = value
+
+
+def get_resource(key: str, remove: bool = False) -> Optional[Any]:
+    with _lock:
+        if remove:
+            return _map.pop(key, None)
+        return _map.get(key)
+
+
+def get_or_create(key: str, factory: Callable[[], Any]) -> Any:
+    """Atomic cache for shared build artifacts (broadcast hash maps)."""
+    with _lock:
+        if key not in _map:
+            _map[key] = factory()
+        return _map[key]
+
+
+def remove_resource(key: str) -> None:
+    with _lock:
+        _map.pop(key, None)
+
+
+def clear_resources(prefix: str = "") -> None:
+    with _lock:
+        if not prefix:
+            _map.clear()
+        else:
+            for k in [k for k in _map if k.startswith(prefix)]:
+                del _map[k]

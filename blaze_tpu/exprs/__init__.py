@@ -1,0 +1,28 @@
+"""Physical expressions (ref: datafusion-ext-exprs + planner.rs:924)."""
+
+from blaze_tpu.exprs.base import (BoundReference, ColVal, Literal,
+                                  PhysicalExpr, col, lit)
+from blaze_tpu.exprs.binary import BinaryExpr, and_, eq, or_
+from blaze_tpu.exprs.cast import Cast, TryCast
+from blaze_tpu.exprs.conditional import (CaseWhen, Coalesce, If, InList,
+                                         IsNotNull, IsNull, Not)
+from blaze_tpu.exprs.evaluator import CachedExprsEvaluator, split_conjuncts
+from blaze_tpu.exprs.special import (BloomFilterMightContain, GetIndexedField,
+                                     GetMapValue, MonotonicallyIncreasingId,
+                                     NamedStruct, Rand, RowNum,
+                                     ScalarSubqueryWrapper, SparkPartitionId,
+                                     UDFWrapper)
+from blaze_tpu.exprs.strings import (Like, RLike, StringPredicate, contains,
+                                     ends_with, starts_with)
+
+__all__ = [
+    "PhysicalExpr", "ColVal", "BoundReference", "Literal", "col", "lit",
+    "BinaryExpr", "and_", "or_", "eq",
+    "Cast", "TryCast",
+    "CaseWhen", "Coalesce", "If", "InList", "IsNotNull", "IsNull", "Not",
+    "CachedExprsEvaluator", "split_conjuncts",
+    "BloomFilterMightContain", "GetIndexedField", "GetMapValue",
+    "MonotonicallyIncreasingId", "NamedStruct", "Rand", "RowNum",
+    "ScalarSubqueryWrapper", "SparkPartitionId", "UDFWrapper",
+    "Like", "RLike", "StringPredicate", "contains", "ends_with", "starts_with",
+]

@@ -1,0 +1,181 @@
+"""Physical expression base: evaluation over ColumnBatch.
+
+The reference evaluates DataFusion `PhysicalExpr` trees decoded from proto
+(ref: native-engine/auron-planner/src/planner.rs:924 try_parse_physical_expr;
+Spark-specific exprs in datafusion-ext-exprs/).  Here an expression evaluates
+a `ColumnBatch` to a `ColVal` — either a device (data, validity) pair over the
+batch's static capacity, or a host Arrow array of exactly num_rows for
+variable-width results.  Device results are what jit'd stage functions
+compose; host results cross to device only through dedicated kernels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu.batch import ColumnBatch, DeviceColumn, HostColumn
+from blaze_tpu.schema import DataType, Schema, TypeId
+
+
+@dataclass
+class ColVal:
+    """Evaluated column value: device (padded) or host (exact-length) form."""
+
+    dtype: DataType
+    data: Optional[jax.Array] = None      # (capacity,) when device-form
+    validity: Optional[jax.Array] = None  # (capacity,) bool when device-form
+    array: Optional[pa.Array] = None      # num_rows-long when host-form
+
+    @property
+    def is_device(self) -> bool:
+        return self.data is not None
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def device(dtype: DataType, data: jax.Array,
+               validity: Optional[jax.Array] = None) -> "ColVal":
+        if validity is None:
+            validity = jnp.ones(data.shape[0], dtype=bool)
+        return ColVal(dtype, data=data, validity=validity)
+
+    @staticmethod
+    def host(dtype: DataType, array: pa.Array) -> "ColVal":
+        return ColVal(dtype, array=array)
+
+    @staticmethod
+    def from_column(col, capacity: int) -> "ColVal":
+        if isinstance(col, DeviceColumn):
+            return ColVal(col.dtype, data=col.data, validity=col.validity)
+        return ColVal(col.dtype, array=col.array)
+
+    # -- conversions --------------------------------------------------------
+    def to_host(self, num_rows: int) -> pa.Array:
+        """Materialize as an Arrow array of num_rows (device sync)."""
+        if self.array is not None:
+            return self.array.slice(0, num_rows)
+        return DeviceColumn(self.dtype, self.data, self.validity).to_arrow(num_rows)
+
+    def to_device(self, capacity: int) -> "ColVal":
+        """Materialize host-form as a padded device pair (fixed-width only)."""
+        if self.is_device:
+            return self
+        dc = DeviceColumn.from_arrow(self.array, self.dtype, capacity)
+        return ColVal(self.dtype, data=dc.data, validity=dc.validity)
+
+    def to_column(self, capacity: int):
+        if self.is_device:
+            return DeviceColumn(self.dtype, self.data, self.validity)
+        return HostColumn(self.dtype, self.array)
+
+    def as_mask(self, batch: ColumnBatch) -> jax.Array:
+        """SQL predicate -> device bool over capacity (null counts as False)."""
+        if self.is_device:
+            return self.data.astype(bool) & self.validity
+        vals = self.array.slice(0, batch.num_rows)
+        np_mask = np.asarray(vals.fill_null(False), dtype=bool)
+        padded = np.zeros(batch.capacity, dtype=bool)
+        padded[:len(np_mask)] = np_mask
+        return jnp.asarray(padded)
+
+
+class PhysicalExpr:
+    """Base physical expression (ref planner.rs:924 expr kinds)."""
+
+    def data_type(self, schema: Schema) -> DataType:
+        raise NotImplementedError
+
+    def children(self) -> Sequence["PhysicalExpr"]:
+        return ()
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        raise NotImplementedError
+
+    # cache key for the common-subexpression evaluator
+    # (ref common/cached_exprs_evaluator.rs:522)
+    def cache_key(self) -> Any:
+        return (type(self).__name__,
+                tuple(c.cache_key() for c in self.children()))
+
+    def __repr__(self):
+        cs = ", ".join(repr(c) for c in self.children())
+        return f"{type(self).__name__}({cs})"
+
+
+@dataclass(frozen=True, repr=False)
+class BoundReference(PhysicalExpr):
+    """Column by ordinal (proto PhysicalColumn, auron.proto expr `column`)."""
+
+    index: int
+    name: str = ""
+
+    def data_type(self, schema: Schema) -> DataType:
+        return schema[self.index].data_type
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        return ColVal.from_column(batch.columns[self.index], batch.capacity)
+
+    def cache_key(self):
+        return ("col", self.index)
+
+    def __repr__(self):
+        return f"#{self.index}" + (f"({self.name})" if self.name else "")
+
+
+def col(index: int, name: str = "") -> BoundReference:
+    return BoundReference(index, name)
+
+
+@dataclass(frozen=True, repr=False)
+class Literal(PhysicalExpr):
+    """Scalar literal (proto PhysicalScalarValue / ScalarValue serde,
+    ref datafusion-ext-commons/src/scalar_value.rs)."""
+
+    value: Any
+    dtype: DataType
+
+    def data_type(self, schema: Schema) -> DataType:
+        return self.dtype
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        cap = batch.capacity
+        if self.dtype.is_fixed_width:
+            if self.value is None:
+                data = jnp.zeros(cap, dtype=self.dtype.jnp_dtype())
+                return ColVal(self.dtype, data=data,
+                              validity=jnp.zeros(cap, dtype=bool))
+            data = jnp.full(cap, self.value, dtype=self.dtype.jnp_dtype())
+            return ColVal.device(self.dtype, data)
+        arr = pa.array([self.value] * batch.num_rows, type=self.dtype.to_arrow())
+        return ColVal.host(self.dtype, arr)
+
+    def cache_key(self):
+        return ("lit", self.dtype.id.value, self.value)
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+def lit(value: Any, dtype: Optional[DataType] = None) -> Literal:
+    from blaze_tpu import schema as S
+    if dtype is None:
+        if isinstance(value, bool):
+            dtype = S.BOOL
+        elif isinstance(value, int):
+            dtype = S.INT64
+        elif isinstance(value, float):
+            dtype = S.FLOAT64
+        elif isinstance(value, str):
+            dtype = S.UTF8
+        elif isinstance(value, bytes):
+            dtype = S.BINARY
+        elif value is None:
+            dtype = S.NULL
+        else:
+            raise TypeError(f"cannot infer literal type of {value!r}")
+    return Literal(value, dtype)

@@ -1,0 +1,217 @@
+"""Binary operators with Spark null semantics.
+
+Parity: the proto binary-op surface (ref auron-planner/src/lib.rs:73
+`from_proto_binary_op`: And/Or/Eq/NotEq/Lt/LtEq/Gt/GtEq/Plus/Minus/Multiply/
+Divide/Modulo/BitwiseAnd/BitwiseOr/BitwiseXor/BitwiseShl/BitwiseShr) plus
+Spark specifics the reference implements in datafusion-ext-*:
+
+  * arithmetic on mismatched widths promotes like Spark (widest int wins,
+    any float -> double math for int/float mixes follows jnp promotion);
+  * `/ 0`, `% 0` -> NULL (non-ANSI Spark), including decimal;
+  * AND/OR use Kleene three-valued logic;
+  * comparisons on floats: NaN == NaN is FALSE under `=`, but `<=>`
+    (null-safe eq, EqNullSafe) treats null==null as TRUE.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import pyarrow.compute as pc
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs.base import ColVal, PhysicalExpr
+from blaze_tpu.schema import BOOL, DataType, Schema, TypeId
+
+
+def _both_valid(a: ColVal, b: ColVal) -> jax.Array:
+    return a.validity & b.validity
+
+
+def _promote(a: ColVal, b: ColVal):
+    dt = jnp.promote_types(a.data.dtype, b.data.dtype)
+    return a.data.astype(dt), b.data.astype(dt)
+
+
+_ARITH = {"+", "-", "*", "/", "%", "pmod",
+          "&", "|", "^", "<<", ">>"}
+_CMP = {"==", "!=", "<", "<=", ">", ">=", "<=>"}
+_BOOLEAN = {"and", "or"}
+
+
+@dataclass(frozen=True, repr=False)
+class BinaryExpr(PhysicalExpr):
+    op: str
+    left: PhysicalExpr
+    right: PhysicalExpr
+
+    def children(self):
+        return (self.left, self.right)
+
+    def data_type(self, schema: Schema) -> DataType:
+        if self.op in _CMP or self.op in _BOOLEAN:
+            return BOOL
+        lt = self.left.data_type(schema)
+        rt = self.right.data_type(schema)
+        if self.op == "/" and lt.id == TypeId.DECIMAL:
+            # Spark decimal division result scale handled upstream by
+            # check_overflow; native math happens in f64 here
+            return lt
+        if not lt.is_fixed_width:
+            return lt
+        if not rt.is_fixed_width:
+            return rt
+        dt = jnp.promote_types(lt.jnp_dtype(), rt.jnp_dtype())
+        from blaze_tpu import schema as S
+        m = {"bool": S.BOOL, "int8": S.INT8, "int16": S.INT16, "int32": S.INT32,
+             "int64": S.INT64, "float32": S.FLOAT32, "float64": S.FLOAT64}
+        if lt.id == TypeId.DECIMAL and rt.id == TypeId.DECIMAL:
+            return lt
+        return m[jnp.dtype(dt).name]
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        a = self.left.evaluate(batch)
+        b = self.right.evaluate(batch)
+        if not a.is_device or not b.is_device:
+            return self._evaluate_host(batch, a, b)
+        if self.op in _BOOLEAN:
+            return _kleene(self.op, a, b)
+        if self.op in _CMP:
+            return _compare(self.op, a, b)
+        return _arith(self.op, a, b, self.data_type(batch.schema))
+
+    def _evaluate_host(self, batch: ColumnBatch, a: ColVal, b: ColVal) -> ColVal:
+        """String/binary comparisons and concat run on host Arrow arrays."""
+        n = batch.num_rows
+        ha, hb = a.to_host(n), b.to_host(n)
+        fns: dict[str, Callable] = {
+            "==": pc.equal, "!=": pc.not_equal, "<": pc.less,
+            "<=": pc.less_equal, ">": pc.greater, ">=": pc.greater_equal,
+        }
+        if self.op in fns:
+            return ColVal.host(BOOL, fns[self.op](ha, hb))
+        if self.op == "<=>":
+            eq = pc.equal(ha, hb)
+            both_null = pc.and_(pc.is_null(ha), pc.is_null(hb))
+            return ColVal.host(BOOL, pc.or_kleene(eq.fill_null(False),
+                                                  both_null).fill_null(False))
+        if self.op == "+":  # string concat via binary `+` is not Spark; but
+            raise TypeError("use Concat for strings")
+        raise TypeError(f"unsupported host binary op {self.op}")
+
+    def cache_key(self):
+        return ("bin", self.op, self.left.cache_key(), self.right.cache_key())
+
+    def __repr__(self):
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+def _kleene(op: str, a: ColVal, b: ColVal) -> ColVal:
+    """Three-valued AND/OR (Spark/SQL semantics)."""
+    av, bv = a.validity, b.validity
+    ad = a.data.astype(bool)
+    bd = b.data.astype(bool)
+    if op == "and":
+        data = ad & bd
+        # known when: both valid, or either side is a known False
+        valid = (av & bv) | (av & ~ad) | (bv & ~bd)
+    else:
+        data = ad | bd
+        valid = (av & bv) | (av & ad) | (bv & bd)
+    return ColVal(BOOL, data=data & valid, validity=valid)
+
+
+def _compare(op: str, a: ColVal, b: ColVal) -> ColVal:
+    x, y = _promote(a, b)
+    if op == "<=>":
+        from blaze_tpu.kernels.compare import null_aware_eq
+        # Spark's EqNullSafe: null<=>null TRUE; NaN<=>NaN TRUE (same as
+        # grouping equality, ref eq_comparator.rs)
+        eq = null_aware_eq(x, a.validity, y, b.validity)
+        return ColVal.device(BOOL, eq)
+    fns = {"==": jnp.equal, "!=": jnp.not_equal, "<": jnp.less,
+           "<=": jnp.less_equal, ">": jnp.greater, ">=": jnp.greater_equal}
+    data = fns[op](x, y)
+    valid = _both_valid(a, b)
+    return ColVal(BOOL, data=data & valid, validity=valid)
+
+
+def _arith(op: str, a: ColVal, b: ColVal, out_dtype: DataType) -> ColVal:
+    x, y = _promote(a, b)
+    valid = _both_valid(a, b)
+    is_float = jnp.issubdtype(x.dtype, jnp.floating)
+
+    if op in ("/", "%", "pmod") and not is_float:
+        zero = y == 0
+        valid = valid & ~zero
+        y = jnp.where(zero, jnp.ones_like(y), y)  # avoid div-by-zero traps
+
+    if op == "+":
+        data = x + y
+    elif op == "-":
+        data = x - y
+    elif op == "*":
+        data = x * y
+    elif op == "/":
+        if is_float:
+            data = x / y          # inf/nan like Spark double division
+        elif a.dtype.id == TypeId.DECIMAL or b.dtype.id == TypeId.DECIMAL:
+            data = x // y         # decimal div handled by planner rescale
+        else:
+            # Spark integral `/` yields double; `div` yields long.  The
+            # planner emits Cast around this node; here: truncating int div
+            # like Java (toward zero), not floor
+            q = jnp.abs(x) // jnp.abs(y)
+            data = jnp.where((x < 0) ^ (y < 0), -q, q)
+    elif op == "%":
+        if is_float:
+            data = jnp.where(jnp.isfinite(y) | jnp.isnan(y),
+                             x - jnp.trunc(x / y) * y, x)
+            data = jnp.where(jnp.isinf(y) & jnp.isfinite(x), x, data)
+        else:
+            # Java %: sign follows dividend
+            r = jnp.abs(x) % jnp.abs(y)
+            data = jnp.where(x < 0, -r, r)
+    elif op == "pmod":
+        # Spark pmod: ((x % y) + y) % y, sign follows divisor's magnitude
+        if is_float:
+            r = x - jnp.trunc(x / y) * y
+            data = jnp.where((r != 0) & ((r < 0) != (y < 0)), r + y, r)
+        else:
+            r = jnp.abs(x) % jnp.abs(y)
+            r = jnp.where(x < 0, -r, r)
+            data = jnp.where(r < 0, r + jnp.abs(y), r)
+    elif op == "&":
+        data = x & y
+    elif op == "|":
+        data = x | y
+    elif op == "^":
+        data = x ^ y
+    elif op == "<<":
+        data = x << (y.astype(x.dtype) & (x.dtype.itemsize * 8 - 1))
+    elif op == ">>":
+        data = x >> (y.astype(x.dtype) & (x.dtype.itemsize * 8 - 1))
+    else:
+        raise TypeError(f"unknown arithmetic op {op}")
+
+    if out_dtype.is_fixed_width and data.dtype != out_dtype.jnp_dtype():
+        data = data.astype(out_dtype.jnp_dtype())
+    data = jnp.where(valid, data, jnp.zeros_like(data))
+    return ColVal(out_dtype, data=data, validity=valid)
+
+
+# convenience builders --------------------------------------------------------
+
+def and_(l: PhysicalExpr, r: PhysicalExpr) -> BinaryExpr:
+    return BinaryExpr("and", l, r)
+
+
+def or_(l: PhysicalExpr, r: PhysicalExpr) -> BinaryExpr:
+    return BinaryExpr("or", l, r)
+
+
+def eq(l: PhysicalExpr, r: PhysicalExpr) -> BinaryExpr:
+    return BinaryExpr("==", l, r)

@@ -1,0 +1,175 @@
+"""Cast / TryCast expressions.
+
+Parity: datafusion-ext-exprs/src/cast.rs (TryCast) over the Spark cast matrix
+in datafusion-ext-commons/src/arrow/cast.rs.  Device-side fixed-width casts
+go through kernels/cast.py; any cast touching strings runs at the host
+boundary with Spark's parsing semantics (invalid input -> NULL, non-ANSI).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs.base import ColVal, PhysicalExpr
+from blaze_tpu.kernels import cast as cast_kernels
+from blaze_tpu.schema import DataType, Schema, TypeId
+
+
+@dataclass(frozen=True, repr=False)
+class Cast(PhysicalExpr):
+    child: PhysicalExpr
+    to: DataType
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema: Schema) -> DataType:
+        return self.to
+
+    def cache_key(self):
+        return ("cast", repr(self.to), self.child.cache_key())
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        v = self.child.evaluate(batch)
+        src = v.dtype
+        if src == self.to:
+            return v
+        if v.is_device and self.to.is_fixed_width:
+            data, valid = cast_kernels.cast_column(v.data, v.validity, src, self.to)
+            return ColVal(self.to, data=data, validity=valid)
+        return _host_cast(v, self.to, batch)
+
+    def __repr__(self):
+        return f"cast({self.child!r} as {self.to!r})"
+
+
+# TryCast is the same node in non-ANSI mode (invalid -> null); the reference
+# distinguishes them for ANSI error raising (cast.rs TryCastExpr).
+TryCast = Cast
+
+
+def _host_cast(v: ColVal, to: DataType, batch: ColumnBatch) -> ColVal:
+    n = batch.num_rows
+    arr = v.to_host(n)
+    src = v.dtype
+
+    if src.id == TypeId.UTF8:
+        out = _parse_string(arr, to)
+    elif to.id == TypeId.UTF8:
+        out = _format_string(arr, src)
+    else:
+        try:
+            out = arr.cast(to.to_arrow(), safe=False)
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+            out = pa.nulls(n, type=to.to_arrow())
+    if to.is_fixed_width:
+        return ColVal.host(to, out).to_device(batch.capacity)
+    return ColVal.host(to, out)
+
+
+def _parse_string(arr: pa.Array, to: DataType) -> pa.Array:
+    """Spark string parsing: trim, invalid -> null (non-ANSI)."""
+    arr = pc.utf8_trim_whitespace(arr)
+    t = to.to_arrow()
+    if to.id == TypeId.BOOL:
+        lowered = pc.utf8_lower(arr)
+        truthy = pc.is_in(lowered, value_set=pa.array(
+            ["true", "t", "yes", "y", "1"]))
+        falsy = pc.is_in(lowered, value_set=pa.array(
+            ["false", "f", "no", "n", "0"]))
+        out = pc.if_else(truthy, True, pc.if_else(
+            falsy, False, pa.nulls(len(arr), pa.bool_())))
+        return pc.if_else(pc.is_valid(arr), out, pa.nulls(len(arr), pa.bool_()))
+    if to.is_integer or to.id in (TypeId.DATE32, TypeId.TIMESTAMP_MICROS):
+        if to.id == TypeId.DATE32:
+            return _try_strptime_date(arr)
+        if to.id == TypeId.TIMESTAMP_MICROS:
+            return _try_parse_timestamp(arr)
+        # Spark accepts "12.5" -> 12 for int casts: go through double first
+        dbl = _try_cast(arr, pa.float64())
+        trunc = pc.trunc(dbl)
+        return _try_cast(trunc, t)
+    return _try_cast(arr, t)
+
+
+def _try_cast(arr: pa.Array, t: pa.DataType) -> pa.Array:
+    """Element-wise safe cast: failures become null, not errors."""
+    try:
+        return arr.cast(t, safe=False)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        pass
+    out = []
+    for x in arr:
+        try:
+            out.append(pa.array([x.as_py()]).cast(t, safe=False)[0].as_py()
+                       if x.is_valid else None)
+        except (pa.ArrowInvalid, ValueError, TypeError, OverflowError):
+            out.append(None)
+    return pa.array(out, type=t)
+
+
+def _try_strptime_date(arr: pa.Array) -> pa.Array:
+    import datetime
+    out = []
+    for x in arr:
+        if not x.is_valid:
+            out.append(None)
+            continue
+        s = x.as_py().strip()
+        try:
+            # Spark accepts yyyy, yyyy-mm, yyyy-mm-dd, and timestamps
+            parts = s.split("T")[0].split(" ")[0].split("-")
+            y = int(parts[0])
+            m = int(parts[1]) if len(parts) > 1 else 1
+            d = int(parts[2]) if len(parts) > 2 else 1
+            out.append(datetime.date(y, m, d))
+        except (ValueError, IndexError):
+            out.append(None)
+    return pa.array(out, type=pa.date32())
+
+
+def _try_parse_timestamp(arr: pa.Array) -> pa.Array:
+    import datetime
+    out = []
+    for x in arr:
+        if not x.is_valid:
+            out.append(None)
+            continue
+        s = x.as_py().strip().replace("T", " ")
+        val = None
+        for fmt in ("%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S",
+                    "%Y-%m-%d %H:%M", "%Y-%m-%d"):
+            try:
+                val = datetime.datetime.strptime(s, fmt)
+                break
+            except ValueError:
+                continue
+        out.append(val)
+    return pa.array(out, type=pa.timestamp("us"))
+
+
+def _format_string(arr: pa.Array, src: DataType) -> pa.Array:
+    if src.id == TypeId.BOOL:
+        return pc.if_else(arr, "true", "false")
+    if src.id == TypeId.FLOAT32 or src.id == TypeId.FLOAT64:
+        # Java Double.toString: integral doubles print with ".0"
+        py = []
+        for x in arr:
+            if not x.is_valid:
+                py.append(None)
+                continue
+            f = x.as_py()
+            if f != f:
+                py.append("NaN")
+            elif f in (float("inf"), float("-inf")):
+                py.append("Infinity" if f > 0 else "-Infinity")
+            else:
+                py.append(repr(f) if not float(f).is_integer()
+                          else f"{f:.1f}")
+        return pa.array(py, type=pa.utf8())
+    return arr.cast(pa.utf8())

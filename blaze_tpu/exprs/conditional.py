@@ -1,0 +1,218 @@
+"""Null tests, NOT, CASE WHEN, IF, COALESCE, IN-list.
+
+Parity: proto expr kinds `is_null_expr`/`is_not_null_expr`/`not_expr`/
+`case_expr`/`in_list`/`scalar_function IF|COALESCE`
+(ref auron-planner/proto/auron.proto:60-141 PhysicalExprNode oneof;
+decode at planner.rs:924).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs.base import ColVal, PhysicalExpr
+from blaze_tpu.schema import BOOL, DataType, Schema
+
+
+@dataclass(frozen=True, repr=False)
+class IsNull(PhysicalExpr):
+    child: PhysicalExpr
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return BOOL
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        v = self.child.evaluate(batch)
+        if v.is_device:
+            # padding rows are invalid -> read as "null"; callers mask rows
+            return ColVal.device(BOOL, ~v.validity)
+        return ColVal.host(BOOL, pc.is_null(v.to_host(batch.num_rows)))
+
+
+@dataclass(frozen=True, repr=False)
+class IsNotNull(PhysicalExpr):
+    child: PhysicalExpr
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return BOOL
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        v = self.child.evaluate(batch)
+        if v.is_device:
+            return ColVal.device(BOOL, v.validity)
+        return ColVal.host(BOOL, pc.is_valid(v.to_host(batch.num_rows)))
+
+
+@dataclass(frozen=True, repr=False)
+class Not(PhysicalExpr):
+    child: PhysicalExpr
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return BOOL
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        v = self.child.evaluate(batch)
+        if v.is_device:
+            return ColVal(BOOL, data=(~v.data.astype(bool)) & v.validity,
+                          validity=v.validity)
+        return ColVal.host(BOOL, pc.invert(v.to_host(batch.num_rows)))
+
+
+@dataclass(frozen=True, repr=False)
+class If(PhysicalExpr):
+    """IF(cond, then, else) — null cond selects else (Spark If)."""
+
+    cond: PhysicalExpr
+    then: PhysicalExpr
+    otherwise: PhysicalExpr
+
+    def children(self):
+        return (self.cond, self.then, self.otherwise)
+
+    def data_type(self, schema):
+        return self.then.data_type(schema)
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        return CaseWhen(((self.cond, self.then),), self.otherwise).evaluate(batch)
+
+
+@dataclass(frozen=True, repr=False)
+class CaseWhen(PhysicalExpr):
+    """CASE WHEN p1 THEN v1 ... ELSE e END (proto PhysicalCaseNode)."""
+
+    branches: Tuple[Tuple[PhysicalExpr, PhysicalExpr], ...]
+    otherwise: Optional[PhysicalExpr] = None
+
+    def children(self):
+        cs = [e for pair in self.branches for e in pair]
+        if self.otherwise is not None:
+            cs.append(self.otherwise)
+        return tuple(cs)
+
+    def data_type(self, schema):
+        return self.branches[0][1].data_type(schema)
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        dtype = self.data_type(batch.schema)
+        if not dtype.is_fixed_width:
+            return self._evaluate_host(batch, dtype)
+        cap = batch.capacity
+        # evaluate lazily from the last branch backwards under jnp.where
+        if self.otherwise is not None:
+            acc = self.otherwise.evaluate(batch).to_device(cap)
+            data, valid = acc.data.astype(dtype.jnp_dtype()), acc.validity
+        else:
+            data = jnp.zeros(cap, dtype=dtype.jnp_dtype())
+            valid = jnp.zeros(cap, dtype=bool)
+        taken = jnp.zeros(cap, dtype=bool)
+        for pred_e, val_e in self.branches:
+            pred = pred_e.evaluate(batch)
+            hit = pred.as_mask(batch) & ~taken if pred.is_device else \
+                pred.as_mask(batch) & ~taken
+            val = val_e.evaluate(batch).to_device(cap)
+            data = jnp.where(hit, val.data.astype(dtype.jnp_dtype()), data)
+            valid = jnp.where(hit, val.validity, valid)
+            taken = taken | hit
+        # rows where no branch fired and no ELSE keep validity False
+        return ColVal(dtype, data=data, validity=valid)
+
+    def _evaluate_host(self, batch: ColumnBatch, dtype: DataType) -> ColVal:
+        n = batch.num_rows
+        chosen = np.full(n, -1, dtype=np.int32)
+        for bi, (pred_e, _) in enumerate(self.branches):
+            mask = np.asarray(pred_e.evaluate(batch).as_mask(batch))[:n]
+            chosen = np.where((chosen < 0) & mask, bi, chosen)
+        out_vals = [e.evaluate(batch).to_host(n)
+                    for _, e in self.branches]
+        other = (self.otherwise.evaluate(batch).to_host(n)
+                 if self.otherwise is not None else
+                 pa.nulls(n, type=dtype.to_arrow()))
+        py = []
+        for i in range(n):
+            src = out_vals[chosen[i]] if chosen[i] >= 0 else other
+            py.append(src[i].as_py())
+        return ColVal.host(dtype, pa.array(py, type=dtype.to_arrow()))
+
+
+@dataclass(frozen=True, repr=False)
+class Coalesce(PhysicalExpr):
+    args: Tuple[PhysicalExpr, ...]
+
+    def children(self):
+        return self.args
+
+    def data_type(self, schema):
+        return self.args[0].data_type(schema)
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        dtype = self.data_type(batch.schema)
+        if not dtype.is_fixed_width:
+            n = batch.num_rows
+            out = self.args[0].evaluate(batch).to_host(n)
+            for e in self.args[1:]:
+                out = pc.coalesce(out, e.evaluate(batch).to_host(n))
+            return ColVal.host(dtype, out)
+        cap = batch.capacity
+        acc = self.args[0].evaluate(batch).to_device(cap)
+        data, valid = acc.data.astype(dtype.jnp_dtype()), acc.validity
+        for e in self.args[1:]:
+            v = e.evaluate(batch).to_device(cap)
+            fill = ~valid & v.validity
+            data = jnp.where(fill, v.data.astype(dtype.jnp_dtype()), data)
+            valid = valid | v.validity
+        return ColVal(dtype, data=data, validity=valid)
+
+
+@dataclass(frozen=True, repr=False)
+class InList(PhysicalExpr):
+    """expr IN (lit, ...) with SQL null semantics (proto PhysicalInListNode).
+
+    If no match and any member (or the probe) is null -> NULL, else FALSE.
+    """
+
+    child: PhysicalExpr
+    values: Tuple[object, ...]
+    negated: bool = False
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return BOOL
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        v = self.child.evaluate(batch)
+        has_null_member = any(x is None for x in self.values)
+        members = [x for x in self.values if x is not None]
+        if v.is_device:
+            hit = jnp.zeros(v.data.shape[0], dtype=bool)
+            for m in members:
+                hit = hit | (v.data == jnp.asarray(m, dtype=v.data.dtype))
+            # no match + a null member -> NULL (the null could have matched)
+            valid = (v.validity & hit) if has_null_member else v.validity
+            data = hit if not self.negated else ~hit
+            return ColVal(BOOL, data=data & valid, validity=valid)
+        arr = v.to_host(batch.num_rows)
+        hit = pc.is_in(arr, value_set=pa.array(members, type=arr.type))
+        if has_null_member:
+            hit = pc.if_else(hit, hit, pa.nulls(len(arr), pa.bool_()))
+        out = pc.invert(hit) if self.negated else hit
+        # probe nulls stay null
+        out = pc.if_else(pc.is_valid(arr), out, pa.nulls(len(arr), pa.bool_()))
+        return ColVal.host(BOOL, out)

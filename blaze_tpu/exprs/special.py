@@ -1,0 +1,262 @@
+"""Spark-specific expressions: ids, randoms, bloom probe, nested access, UDF.
+
+Parity: datafusion-ext-exprs/src/{row_num,spark_partition_id,
+spark_monotonically_increasing_id,spark_randn,bloom_filter_might_contain,
+get_indexed_field,get_map_value,named_struct,spark_udf_wrapper,
+spark_scalar_subquery_wrapper}.rs
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.bridge.context import current_task
+from blaze_tpu.exprs.base import ColVal, PhysicalExpr
+from blaze_tpu.schema import (BOOL, DataType, Field, INT32, INT64, FLOAT64,
+                              Schema, TypeId)
+
+
+@dataclass(frozen=True, repr=False)
+class RowNum(PhysicalExpr):
+    """Running row number within the task (ref row_num.rs — stateful across
+    batches; the operator supplies the running offset via batch metadata)."""
+
+    def data_type(self, schema):
+        return INT64
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        base = getattr(batch, "row_num_offset", 0)
+        data = jnp.arange(batch.capacity, dtype=jnp.int64) + jnp.int64(base)
+        return ColVal.device(INT64, data)
+
+    def cache_key(self):
+        return ("row_num", id(self))  # stateful: never CSE-shared
+
+
+@dataclass(frozen=True, repr=False)
+class SparkPartitionId(PhysicalExpr):
+    """spark_partition_id() (ref spark_partition_id.rs)."""
+
+    def data_type(self, schema):
+        return INT32
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        pid = current_task().partition_id
+        return ColVal.device(
+            INT32, jnp.full(batch.capacity, pid, dtype=jnp.int32))
+
+
+@dataclass(frozen=True, repr=False)
+class MonotonicallyIncreasingId(PhysicalExpr):
+    """partition_id << 33 | row_in_partition (Spark contract,
+    ref spark_monotonically_increasing_id.rs)."""
+
+    def data_type(self, schema):
+        return INT64
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        base = getattr(batch, "row_num_offset", 0)
+        pid = current_task().partition_id
+        rows = jnp.arange(batch.capacity, dtype=jnp.int64) + jnp.int64(base)
+        return ColVal.device(INT64, (jnp.int64(pid) << 33) | rows)
+
+    def cache_key(self):
+        return ("mono_id", id(self))
+
+
+@dataclass(frozen=True, repr=False)
+class Rand(PhysicalExpr):
+    """rand()/randn(seed) — per-task stream seeded with seed+partition_id
+    like Spark's RNG expressions (ref spark_randn.rs)."""
+
+    seed: int
+    normal: bool = False
+
+    def data_type(self, schema):
+        return FLOAT64
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        base = getattr(batch, "row_num_offset", 0)
+        key = jax.random.key(self.seed + current_task().partition_id)
+        key = jax.random.fold_in(key, base)
+        shape = (batch.capacity,)
+        data = (jax.random.normal(key, shape, dtype=jnp.float64) if self.normal
+                else jax.random.uniform(key, shape, dtype=jnp.float64))
+        return ColVal.device(FLOAT64, data)
+
+    def cache_key(self):
+        return ("rand", id(self))
+
+
+@dataclass(frozen=True, repr=False)
+class BloomFilterMightContain(PhysicalExpr):
+    """Probe a Spark bloom filter built by the bloom_filter agg
+    (ref bloom_filter_might_contain.rs; the filter value arrives as a
+    broadcast binary scalar resolved through the resource map)."""
+
+    uuid: str
+    value: PhysicalExpr
+
+    def children(self):
+        return (self.value,)
+
+    def data_type(self, schema):
+        return BOOL
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        from blaze_tpu.bridge.resource import get_resource
+        from blaze_tpu.kernels import bloom
+        filt = get_resource(self.uuid)
+        if filt is None:
+            # filter not built (empty build side): everything might match
+            return ColVal.device(
+                BOOL, jnp.ones(batch.capacity, dtype=bool))
+        v = self.value.evaluate(batch)
+        if not v.is_device:
+            v = v.to_device(batch.capacity)
+        hit = filt.might_contain_longs(v.data.astype(jnp.int64))
+        return ColVal(BOOL, data=hit & v.validity, validity=v.validity)
+
+
+@dataclass(frozen=True, repr=False)
+class GetIndexedField(PhysicalExpr):
+    """list[ordinal] / struct.field by index (ref get_indexed_field.rs)."""
+
+    child: PhysicalExpr
+    index: int  # list ordinal (0-based) or struct field index
+    out_type: DataType
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return self.out_type
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        arr = self.child.evaluate(batch).to_host(batch.num_rows)
+        if pa.types.is_struct(arr.type):
+            out = arr.field(self.index)
+        else:
+            out = pc.list_element(arr, self.index)
+        cv = ColVal.host(self.out_type, out)
+        if self.out_type.is_fixed_width:
+            return cv.to_device(batch.capacity)
+        return cv
+
+
+@dataclass(frozen=True, repr=False)
+class GetMapValue(PhysicalExpr):
+    """map[key] with a literal key (ref get_map_value.rs)."""
+
+    child: PhysicalExpr
+    key: object
+    out_type: DataType
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return self.out_type
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        arr = self.child.evaluate(batch).to_host(batch.num_rows)
+        py = []
+        for row in arr:
+            if not row.is_valid:
+                py.append(None)
+                continue
+            val = None
+            for k, v in row.as_py() or []:
+                if k == self.key:
+                    val = v  # Spark keeps the LAST duplicate key
+            py.append(val)
+        cv = ColVal.host(self.out_type, pa.array(py, type=self.out_type.to_arrow()))
+        if self.out_type.is_fixed_width:
+            return cv.to_device(batch.capacity)
+        return cv
+
+
+@dataclass(frozen=True, repr=False)
+class NamedStruct(PhysicalExpr):
+    """named_struct(name1, v1, ...) (ref named_struct.rs)."""
+
+    names: Tuple[str, ...]
+    args: Tuple[PhysicalExpr, ...]
+
+    def children(self):
+        return self.args
+
+    def data_type(self, schema):
+        return DataType(TypeId.STRUCT, children=tuple(
+            Field(n, a.data_type(schema)) for n, a in zip(self.names, self.args)))
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        n = batch.num_rows
+        arrays = [a.evaluate(batch).to_host(n) for a in self.args]
+        out = pa.StructArray.from_arrays(arrays, names=list(self.names))
+        return ColVal.host(self.data_type(batch.schema), out)
+
+
+@dataclass(frozen=True, repr=False)
+class ScalarSubqueryWrapper(PhysicalExpr):
+    """Pre-computed scalar subquery result injected as a literal
+    (ref spark_scalar_subquery_wrapper.rs — the JVM evaluates the subquery
+    and ships the scalar; here the host bridge stores it in the resource map)."""
+
+    uuid: str
+    out_type: DataType
+
+    def data_type(self, schema):
+        return self.out_type
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        from blaze_tpu.bridge.resource import get_resource
+        from blaze_tpu.exprs.base import Literal
+        return Literal(get_resource(self.uuid), self.out_type).evaluate(batch)
+
+
+@dataclass(frozen=True, repr=False)
+class UDFWrapper(PhysicalExpr):
+    """Fallback eval of an engine-side function over the host boundary.
+
+    The reference round-trips params to the JVM per batch
+    (ref spark_udf_wrapper.rs:207-226: export params StructArray, call
+    SparkAuronUDFWrapperContext.eval, import result).  Here `fn` is the
+    host-registered callable (Arrow arrays in, Arrow array out); the bridge
+    installs JVM-backed callables under serialized names.
+    """
+
+    name: str
+    fn: Callable[..., pa.Array] = field(compare=False)
+    args: Tuple[PhysicalExpr, ...] = ()
+    out_type: DataType = INT64
+
+    def children(self):
+        return self.args
+
+    def data_type(self, schema):
+        return self.out_type
+
+    def cache_key(self):
+        return ("udf", self.name, tuple(a.cache_key() for a in self.args))
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        n = batch.num_rows
+        params = [a.evaluate(batch).to_host(n) for a in self.args]
+        out = self.fn(*params)
+        if not isinstance(out, pa.Array):
+            out = pa.array(out, type=self.out_type.to_arrow())
+        if len(out) != n:
+            raise ValueError(f"UDF {self.name} returned {len(out)} rows, want {n}")
+        cv = ColVal.host(self.out_type, out)
+        if self.out_type.is_fixed_width:
+            return cv.to_device(batch.capacity)
+        return cv

@@ -1,0 +1,137 @@
+"""String predicate / manipulation expressions (host Arrow path).
+
+Parity: datafusion-ext-exprs/src/string_{starts_with,ends_with,contains}.rs
+and the string members of the proto ScalarFunction enum
+(ref auron.proto:218 — Substr, Concat, Upper, Lower, Trim, Ltrim, Rtrim,
+Length, Like, RLike).  Strings are host-resident (offsets+bytes have no
+pointer-free device form worth MXU time for these ops); predicates return
+host bool ColVals that `as_mask` pads onto device for the jit'd filter.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Tuple
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs.base import ColVal, PhysicalExpr
+from blaze_tpu.schema import BOOL, INT32, UTF8, Schema
+
+
+@dataclass(frozen=True, repr=False)
+class StringPredicate(PhysicalExpr):
+    """starts_with / ends_with / contains with a literal needle."""
+
+    kind: str  # "starts_with" | "ends_with" | "contains"
+    child: PhysicalExpr
+    needle: str
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return BOOL
+
+    def cache_key(self):
+        return ("strpred", self.kind, self.needle, self.child.cache_key())
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        arr = self.child.evaluate(batch).to_host(batch.num_rows)
+        if self.kind == "starts_with":
+            out = pc.starts_with(arr, pattern=self.needle)
+        elif self.kind == "ends_with":
+            out = pc.ends_with(arr, pattern=self.needle)
+        else:
+            out = pc.match_substring(arr, pattern=self.needle)
+        return ColVal.host(BOOL, out)
+
+
+def starts_with(child: PhysicalExpr, needle: str) -> StringPredicate:
+    return StringPredicate("starts_with", child, needle)
+
+
+def ends_with(child: PhysicalExpr, needle: str) -> StringPredicate:
+    return StringPredicate("ends_with", child, needle)
+
+
+def contains(child: PhysicalExpr, needle: str) -> StringPredicate:
+    return StringPredicate("contains", child, needle)
+
+
+def _like_to_regex(pattern: str, escape: str = "\\") -> str:
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+@dataclass(frozen=True, repr=False)
+class Like(PhysicalExpr):
+    """SQL LIKE with %/_ wildcards (Spark Like; proto LikeExprNode)."""
+
+    child: PhysicalExpr
+    pattern: str
+    negated: bool = False
+    case_insensitive: bool = False
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return BOOL
+
+    def cache_key(self):
+        return ("like", self.pattern, self.negated, self.case_insensitive,
+                self.child.cache_key())
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        arr = self.child.evaluate(batch).to_host(batch.num_rows)
+        regex = _like_to_regex(self.pattern)
+        flags = re.DOTALL | (re.IGNORECASE if self.case_insensitive else 0)
+        prog = re.compile(regex, flags)
+        py = [None if not x.is_valid else bool(prog.match(x.as_py()))
+              for x in arr]
+        out = pa.array(py, type=pa.bool_())
+        if self.negated:
+            out = pc.invert(out)
+        return ColVal.host(BOOL, out)
+
+
+@dataclass(frozen=True, repr=False)
+class RLike(PhysicalExpr):
+    """Java-regex find() semantics (Spark RLike; ref spark_strings.rs)."""
+
+    child: PhysicalExpr
+    pattern: str
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return BOOL
+
+    def cache_key(self):
+        return ("rlike", self.pattern, self.child.cache_key())
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        arr = self.child.evaluate(batch).to_host(batch.num_rows)
+        prog = re.compile(self.pattern)
+        py = [None if not x.is_valid else bool(prog.search(x.as_py()))
+              for x in arr]
+        return ColVal.host(BOOL, pa.array(py, type=pa.bool_()))

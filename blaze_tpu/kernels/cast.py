@@ -57,10 +57,19 @@ def cast_column(data: jax.Array, validity: Optional[jax.Array],
     s, d = src.id, dst.id
     v = validity
 
-    # --- decimal source: unscale to f64 or rescale ------------------------
+    # --- decimal source: exact integral path, f64 for floats --------------
     if s == TypeId.DECIMAL:
         if d == TypeId.DECIMAL:
             return _rescale_decimal(data, v, src, dst)
+        if dst.is_integer and d not in (TypeId.DATE32, TypeId.TIMESTAMP_MICROS):
+            # exact int64 math (f64 would corrupt >2^53 unscaled values);
+            # truncate toward zero like BigDecimal.toBigInteger, overflow->null
+            q = jnp.int64(_pow10(src.scale))
+            i = jnp.sign(data) * (jnp.abs(data) // q)
+            lo, hi = _int_bounds(d)
+            ok = (i >= lo) & (i <= hi)
+            nv = ok if v is None else (v & ok)
+            return jnp.where(ok, i, 0).astype(dst.jnp_dtype()), nv
         f = data.astype(jnp.float64) / _pow10(src.scale)
         return cast_column(f, v, DataType(TypeId.FLOAT64), dst)
 
@@ -98,6 +107,26 @@ def cast_column(data: jax.Array, validity: Optional[jax.Array],
         return data.astype(jnp.int64) * jnp.int64(_US_PER_DAY), v
     if s == TypeId.TIMESTAMP_MICROS and d == TypeId.DATE32:
         return jnp.floor_divide(data, jnp.int64(_US_PER_DAY)).astype(jnp.int32), v
+
+    # --- numeric <-> timestamp: Spark scales by SECONDS -------------------
+    if d == TypeId.TIMESTAMP_MICROS:
+        if src.is_floating:
+            us = data.astype(jnp.float64) * 1e6
+            ok = jnp.isfinite(us) & (jnp.abs(us) < 2.0 ** 63)
+            nv = ok if v is None else (v & ok)
+            return jnp.where(ok, us, 0.0).astype(jnp.int64), nv
+        if s != TypeId.DATE32:
+            return data.astype(jnp.int64) * jnp.int64(1_000_000), v
+    if s == TypeId.TIMESTAMP_MICROS:
+        if dst.is_floating:
+            return (data.astype(jnp.float64) / 1e6).astype(dst.jnp_dtype()), v
+        if d != TypeId.DATE32:
+            # Math.floorDiv like Spark's MICROSECONDS.toSeconds
+            secs = jnp.floor_divide(data, jnp.int64(1_000_000))
+            return secs.astype(dst.jnp_dtype()), v
+    if (s == TypeId.DATE32) != (d == TypeId.DATE32):
+        # Spark has no numeric<->date cast (AnalysisException)
+        raise TypeError(f"unsupported device cast {src} -> {dst}")
 
     # --- float -> integral: truncate, NaN->0, saturate --------------------
     if src.is_floating and (dst.is_integer or d == TypeId.DATE32):

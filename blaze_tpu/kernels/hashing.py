@@ -356,10 +356,14 @@ def string_column_to_padded_bytes(arr, xp=np) -> Tuple:
                 else np.zeros(0, dtype=np.uint8))
         lengths = np.diff(offsets).astype(np.int32)
         max_len = max(int(lengths.max()), 4)
-        idx = offsets[:-1, None] + np.arange(max_len)[None, :]
-        in_range = np.arange(max_len)[None, :] < lengths[:, None]
-        safe = np.clip(idx, 0, max(len(data) - 1, 0))
-        mat = np.where(in_range & (len(data) > 0), data[safe], np.uint8(0))
+        if len(data) == 0:
+            # all rows empty or null: no data buffer to gather from
+            mat = np.zeros((n, max_len), dtype=np.uint8)
+        else:
+            idx = offsets[:-1, None] + np.arange(max_len)[None, :]
+            in_range = np.arange(max_len)[None, :] < lengths[:, None]
+            safe = np.clip(idx, 0, len(data) - 1)
+            mat = np.where(in_range, data[safe], np.uint8(0))
         lengths = np.where(valid, lengths, 0).astype(np.int32)
     if xp is not np:
         return (xp.asarray(mat), xp.asarray(lengths)), xp.asarray(valid)

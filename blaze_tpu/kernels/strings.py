@@ -93,10 +93,15 @@ def substring_fixed(byte_mat: jax.Array, lengths: jax.Array,
     n, width = byte_mat.shape
     if start >= 0:
         # Spark treats start 0 the same as 1 (first character)
-        begin = jnp.full(n, max(start - 1, 0), dtype=jnp.int32)
-    else:  # negative start counts from the end, SQL style
-        begin = jnp.maximum(lengths + start, 0)
-    out_len = jnp.clip(lengths - begin, 0, sub_len)
+        begin_raw = jnp.full(n, max(start - 1, 0), dtype=jnp.int32)
+    else:  # negative start counts from the end, SQL style (may underflow 0)
+        begin_raw = (lengths + start).astype(jnp.int32)
+    # Spark UTF8String.substringSQL: the window END is computed from the
+    # UNclamped start, then [max(start,0), min(end,len)) is taken — so a
+    # negative start past the front shrinks the output instead of shifting it
+    end = begin_raw + sub_len
+    begin = jnp.maximum(begin_raw, 0)
+    out_len = jnp.clip(jnp.minimum(end, lengths) - begin, 0, sub_len)
     idx = begin[:, None] + jnp.arange(max(sub_len, 1))[None, :]
     idx = jnp.clip(idx, 0, width - 1)
     out = jnp.take_along_axis(byte_mat, idx, axis=1)

@@ -1,15 +1,19 @@
 """Test config: force CPU platform with 8 virtual devices so sharding /
 collective paths are exercised without TPU hardware (the reference's analog:
 spark-local[N] exercising the full shuffle path without a cluster,
-SURVEY.md §4)."""
+SURVEY.md §4).
+
+Note: in this environment the axon TPU plugin ignores the JAX_PLATFORMS env
+var, so the override must go through jax.config before first backend use.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,177 @@
+"""Expression-layer tests: Spark null semantics, Kleene logic, casts, strings.
+
+Modeled on the reference's per-expression unit tests
+(ref: datafusion-ext-exprs/src/*.rs #[test] blocks, SURVEY.md §4 tier 1).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import schema as S
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import (BinaryExpr, CachedExprsEvaluator, CaseWhen, Cast,
+                             Coalesce, If, InList, IsNotNull, IsNull, Like,
+                             Not, col, lit)
+
+
+def make_batch(**cols):
+    arrays, fields = [], []
+    for name, values in cols.items():
+        arr = pa.array(values)
+        fields.append(pa.field(name, arr.type))
+        arrays.append(arr)
+    return ColumnBatch.from_arrow(
+        pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields)))
+
+
+def col_py(batch, expr):
+    """Evaluate expr and return python list over real rows."""
+    v = expr.evaluate(batch)
+    return v.to_host(batch.num_rows).to_pylist()
+
+
+def test_arith_null_propagation():
+    b = make_batch(a=[1, None, 3], b=[10, 20, None])
+    assert col_py(b, BinaryExpr("+", col(0), col(1))) == [11, None, None]
+    assert col_py(b, BinaryExpr("*", col(0), lit(2))) == [2, None, 6]
+
+
+def test_division_by_zero_is_null():
+    b = make_batch(a=[10, 7, 5], b=[2, 0, 0])
+    assert col_py(b, BinaryExpr("/", col(0), col(1))) == [5, None, None]
+    assert col_py(b, BinaryExpr("%", col(0), col(1))) == [0, None, None]
+
+
+def test_int_division_truncates_toward_zero():
+    b = make_batch(a=[-7, 7, -7], b=[2, -2, -2])
+    assert col_py(b, BinaryExpr("/", col(0), col(1))) == [-3, -3, 3]
+    # Java %: sign follows dividend
+    assert col_py(b, BinaryExpr("%", col(0), col(1))) == [-1, 1, -1]
+
+
+def test_pmod_matches_spark():
+    b = make_batch(a=[-7, 7, -3], b=[3, 3, 5])
+    # Spark: pmod(-7,3)=2, pmod(7,3)=1, pmod(-3,5)=2
+    assert col_py(b, BinaryExpr("pmod", col(0), col(1))) == [2, 1, 2]
+
+
+def test_kleene_and_or():
+    b = make_batch(p=[True, True, False, None, None, False],
+                   q=[True, None, None, None, False, False])
+    assert col_py(b, BinaryExpr("and", col(0), col(1))) == \
+        [True, None, False, None, False, False]
+    assert col_py(b, BinaryExpr("or", col(0), col(1))) == \
+        [True, True, None, None, None, False]
+
+
+def test_comparison_null():
+    b = make_batch(a=[1, None, 3], b=[1, 2, 2])
+    assert col_py(b, BinaryExpr("==", col(0), col(1))) == [True, None, False]
+    assert col_py(b, BinaryExpr("<=>", col(0), col(1))) == [True, False, False]
+
+
+def test_null_safe_eq_nulls():
+    b = make_batch(a=[None, None], b=[None, 1])
+    assert col_py(b, BinaryExpr("<=>", col(0), col(1))) == [True, False]
+
+
+def test_is_null_not():
+    b = make_batch(a=[1, None, 3])
+    assert col_py(b, IsNull(col(0))) == [False, True, False]
+    assert col_py(b, IsNotNull(col(0))) == [True, False, True]
+    bb = make_batch(p=[True, False, None])
+    assert col_py(bb, Not(col(0))) == [False, True, None]
+
+
+def test_case_when():
+    b = make_batch(a=[1, 2, 3, None])
+    e = CaseWhen(
+        branches=((BinaryExpr("==", col(0), lit(1)), lit(10)),
+                  (BinaryExpr("==", col(0), lit(2)), lit(20))),
+        otherwise=lit(0))
+    assert col_py(b, e) == [10, 20, 0, 0]
+    e2 = CaseWhen(branches=((BinaryExpr("==", col(0), lit(1)), lit(10)),))
+    assert col_py(b, e2) == [10, None, None, None]
+
+
+def test_if_and_coalesce():
+    b = make_batch(a=[1, None, 3], b=[9, 8, 7])
+    assert col_py(b, If(IsNull(col(0)), col(1), col(0))) == [1, 8, 3]
+    assert col_py(b, Coalesce((col(0), col(1)))) == [1, 8, 3]
+
+
+def test_in_list_null_semantics():
+    b = make_batch(a=[1, 2, None, 4])
+    assert col_py(b, InList(col(0), (1, 2))) == [True, True, None, False]
+    # null member: non-matching probes become NULL, not FALSE
+    assert col_py(b, InList(col(0), (1, None))) == [True, None, None, None]
+
+
+def test_cast_string_to_int_invalid_null():
+    b = make_batch(s=["12", " 34 ", "x", "12.7", None])
+    assert col_py(b, Cast(col(0), S.INT32)) == [12, 34, None, 12, None]
+
+
+def test_cast_int_to_string():
+    b = make_batch(a=[1, None, -3])
+    assert col_py(b, Cast(col(0), S.UTF8)) == ["1", None, "-3"]
+
+
+def test_cast_double_to_string_java_format():
+    b = make_batch(a=[1.0, 2.5, float("nan")])
+    assert col_py(b, Cast(col(0), S.UTF8)) == ["1.0", "2.5", "NaN"]
+
+
+def test_cast_string_to_bool_and_date():
+    b = make_batch(s=["true", "NO", "1", "zzz"])
+    assert col_py(b, Cast(col(0), S.BOOL)) == [True, False, True, None]
+    d = make_batch(s=["2023-05-17", "2023-5-1", "bad", "2023-05-17 10:00:00"])
+    import datetime
+    assert col_py(d, Cast(col(0), S.DATE32)) == [
+        datetime.date(2023, 5, 17), datetime.date(2023, 5, 1), None,
+        datetime.date(2023, 5, 17)]
+
+
+def test_like_patterns():
+    b = make_batch(s=["apple", "banana", "grape", None])
+    assert col_py(b, Like(col(0), "%an%")) == [False, True, False, None]
+    assert col_py(b, Like(col(0), "_pple")) == [True, False, False, None]
+    assert col_py(b, Like(col(0), "gr%")) == [False, False, True, None]
+
+
+def test_string_compare_host():
+    b = make_batch(s=["a", "b", None], t=["a", "a", "a"])
+    assert col_py(b, BinaryExpr("==", col(0), col(1))) == [True, False, None]
+    assert col_py(b, BinaryExpr(">", col(0), col(1))) == [False, True, None]
+
+
+def test_filter_evaluator_short_circuit_and_mask():
+    b = make_batch(a=[1, 2, 3, 4, 5], s=["x", "y", "x", "y", "x"])
+    ev = CachedExprsEvaluator(
+        filters=[BinaryExpr("and",
+                            BinaryExpr(">", col(0), lit(1)),
+                            BinaryExpr("==", col(1), lit("x")))])
+    out = ev.filter(b)
+    assert out.selected_count() == 2
+    packed = out.compact()
+    assert packed.to_arrow().column(0).to_pylist() == [3, 5]
+
+
+def test_project_with_cse():
+    b = make_batch(a=[1, 2, 3])
+    shared = BinaryExpr("+", col(0), lit(10))
+    ev = CachedExprsEvaluator(projections=[
+        shared, BinaryExpr("*", shared, lit(2))])
+    out_schema = S.Schema([S.Field("x", S.INT64), S.Field("y", S.INT64)])
+    out = ev.project(b, out_schema)
+    assert out.to_arrow().column(0).to_pylist() == [11, 12, 13]
+    assert out.to_arrow().column(1).to_pylist() == [22, 24, 26]
+
+
+def test_float_mod_and_nan():
+    b = make_batch(a=[7.5, float("nan"), 7.5], b=[2.0, 2.0, 0.0])
+    out = col_py(b, BinaryExpr("%", col(0), col(1)))
+    assert out[0] == pytest.approx(1.5)
+    assert np.isnan(out[1])
+    assert np.isnan(out[2])  # float % 0.0 -> NaN (Spark double semantics)

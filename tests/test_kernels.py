@@ -236,3 +236,65 @@ def test_padded_bytes_vectorized_matches_pylist():
     sliced = arr.slice(2, 3)  # non-zero offset path
     (m2, l2), v2 = hashing.string_column_to_padded_bytes(sliced)
     assert l2.tolist() == [4, 2, 0] and bytes(m2[0][:4]) == b"abcd"
+
+
+# -- regression tests from code review ---------------------------------------
+
+def test_padded_bytes_all_empty_or_null():
+    import pyarrow as pa
+    from blaze_tpu.kernels.hashing import string_column_to_padded_bytes
+    for arr in (pa.array(["", "", ""]), pa.array([None, None], type=pa.utf8())):
+        (mat, lengths), valid = string_column_to_padded_bytes(arr)
+        assert mat.shape[0] == len(arr)
+        assert (lengths == 0).all()
+
+
+def test_ns_timestamp_ingest_truncates():
+    import pyarrow as pa
+    from blaze_tpu.batch import ColumnBatch
+    cb = ColumnBatch.from_arrow(
+        pa.table({"t": pa.array([1001, 2999], type=pa.timestamp("ns"))}))
+    assert np.asarray(cb.columns[0].data)[:2].tolist() == [1, 2]
+
+
+def test_cast_int_seconds_to_timestamp():
+    from blaze_tpu.kernels.cast import cast_column
+    from blaze_tpu import schema as S
+    data, v = cast_column(jnp.asarray([5, -3], dtype=jnp.int64), None,
+                          S.INT64, S.TIMESTAMP_MICROS)
+    assert np.asarray(data).tolist() == [5_000_000, -3_000_000]
+    back, _ = cast_column(data, None, S.TIMESTAMP_MICROS, S.INT64)
+    assert np.asarray(back).tolist() == [5, -3]
+    # floor division for negative sub-second timestamps
+    back2, _ = cast_column(jnp.asarray([-1500000], dtype=jnp.int64), None,
+                           S.TIMESTAMP_MICROS, S.INT64)
+    assert np.asarray(back2).tolist() == [-2]
+
+
+def test_cast_decimal_to_long_exact():
+    from blaze_tpu.kernels.cast import cast_column
+    from blaze_tpu import schema as S
+    big = 999999999999999999  # > 2^53: float64 path would round this
+    data, v = cast_column(jnp.asarray([big, -big], dtype=jnp.int64), None,
+                          S.decimal(18, 0), S.INT64)
+    assert np.asarray(data).tolist() == [big, -big]
+    # scale>0 truncates toward zero
+    data2, _ = cast_column(jnp.asarray([1999, -1999], dtype=jnp.int64), None,
+                           S.decimal(10, 3), S.INT64)
+    assert np.asarray(data2).tolist() == [1, -1]
+    # overflow -> null
+    data3, v3 = cast_column(jnp.asarray([12345678901], dtype=jnp.int64), None,
+                            S.decimal(18, 0), S.INT32)
+    assert not bool(np.asarray(v3)[0])
+
+
+def test_substring_negative_start_past_front():
+    from blaze_tpu.kernels.strings import substring_fixed
+    from blaze_tpu.kernels.hashing import string_column_to_padded_bytes
+    import pyarrow as pa
+    (mat, lengths), _ = string_column_to_padded_bytes(pa.array(["abc", "hello"]))
+    out, out_len = substring_fixed(jnp.asarray(mat), jnp.asarray(lengths), -5, 4)
+    # Spark substring('abc', -5, 4) = 'ab'; substring('hello', -5, 4) = 'hell'
+    got = [bytes(np.asarray(out)[i][:int(out_len[i])]).decode()
+           for i in range(2)]
+    assert got == ["ab", "hell"]
