@@ -1,0 +1,55 @@
+"""Metric tree mirroring the operator tree.
+
+Parity: auron-core MetricNode (ref: auron-core/.../metric/MetricNode.java:27 —
+a tree of named counters the native side pushes into on finalize,
+native-engine/auron/src/metrics.rs:22 update_metric_node) surfaced to Spark
+SQLMetrics (SparkMetricNode.scala).  Operators own a MetricNode; the runtime
+collects the tree after execution.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class MetricNode:
+    name: str = ""
+    values: Dict[str, int] = field(default_factory=dict)
+    children: List["MetricNode"] = field(default_factory=list)
+
+    def add(self, metric: str, value: int = 1) -> None:
+        self.values[metric] = self.values.get(metric, 0) + int(value)
+
+    def set(self, metric: str, value: int) -> None:
+        self.values[metric] = int(value)
+
+    def get(self, metric: str) -> int:
+        return self.values.get(metric, 0)
+
+    def child(self, i: int) -> "MetricNode":
+        while len(self.children) <= i:
+            self.children.append(MetricNode())
+        return self.children[i]
+
+    @contextmanager
+    def timer(self, metric: str):
+        """Accumulate elapsed nanoseconds (ref common/timer_helper.rs)."""
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.add(metric, time.perf_counter_ns() - t0)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "values": dict(self.values),
+                "children": [c.to_dict() for c in self.children]}
+
+    def merge_from(self, other: "MetricNode") -> None:
+        for k, v in other.values.items():
+            self.add(k, v)
+        for i, c in enumerate(other.children):
+            self.child(i).merge_from(c)

@@ -1,0 +1,9 @@
+"""Memory budget + spill tiers (ref: auron-memmgr)."""
+
+from blaze_tpu.memory.manager import MemConsumer, MemManager, default_budget_bytes
+from blaze_tpu.memory.spill import (FileSpill, HostMemSpill, Spill,
+                                    SpillMetrics, try_new_spill)
+
+__all__ = ["MemConsumer", "MemManager", "default_budget_bytes",
+           "FileSpill", "HostMemSpill", "Spill", "SpillMetrics",
+           "try_new_spill"]

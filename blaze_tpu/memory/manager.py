@@ -1,0 +1,161 @@
+"""Unified memory manager: fixed budget, fair consumer caps, spill-on-pressure.
+
+Parity: auron-memmgr (ref: auron-memmgr/src/lib.rs:38 `MemManager`, `:46`
+init, `:82` register_consumer, `:202` `MemConsumer` trait — update_mem_used
+triggers spill() of the biggest consumer when the pool overflows).
+
+TPU mapping: the budget models DEVICE HBM held by operator state (sort runs,
+agg tables, join build sides, shuffle staging).  Spill tiers mirror the
+reference's Spill abstraction (ref auron-memmgr/src/spill.rs:89
+try_new_spill: JVM on-heap if available else disk): here tier 1 is host RAM
+(the "on-heap" analog — device arrays become numpy/Arrow buffers), tier 2 is
+a zstd-compressed disk file.  Synchronous (no condvar): one task runtime
+drives one operator chain, so update_mem_used spills inline, matching the
+per-task budget discipline rather than the cross-task waiting.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from blaze_tpu import config
+from blaze_tpu.memory.spill import SpillMetrics
+
+MEM_SPILL_FACTOR = 0.8  # consumer must shrink below cap*factor after spill
+
+
+class MemConsumer:
+    """Spillable operator state (ref MemConsumer trait, lib.rs:202).
+
+    Subclasses implement `spill()` to move their largest retained structure
+    down a tier and return the bytes released.
+    """
+
+    name: str = "consumer"
+
+    def __init__(self, name: str):
+        self.name = name
+        self._mem_used = 0
+        self._manager: Optional[MemManager] = None
+        self.spill_metrics = SpillMetrics()
+
+    @property
+    def mem_used(self) -> int:
+        return self._mem_used
+
+    def set_spillable(self, manager: "MemManager") -> None:
+        self._manager = manager
+        manager.register_consumer(self)
+
+    def update_mem_used(self, nbytes: int) -> None:
+        """Declare current retained bytes; may trigger spills (incl. self)."""
+        self._mem_used = max(0, int(nbytes))
+        if self._manager is not None:
+            self._manager.on_mem_updated(self)
+
+    def add_mem_used(self, delta: int) -> None:
+        self.update_mem_used(self._mem_used + delta)
+
+    def spill(self) -> int:
+        """Release memory down a tier; returns bytes released."""
+        raise NotImplementedError
+
+    def unregister(self) -> None:
+        if self._manager is not None:
+            self._manager.unregister_consumer(self)
+            self._manager = None
+
+
+class MemManager:
+    """Process-wide budget over registered consumers (ref lib.rs:38)."""
+
+    _instance: Optional["MemManager"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, total_bytes: int):
+        self.total = int(total_bytes)
+        self._lock = threading.RLock()
+        self._consumers: List[MemConsumer] = []
+        self.total_spill_count = 0
+        self.total_spilled_bytes = 0
+
+    # -- singleton wiring (ref MemManager::init, lib.rs:46) ---------------
+    @classmethod
+    def init(cls, total_bytes: Optional[int] = None) -> "MemManager":
+        with cls._instance_lock:
+            if cls._instance is None or total_bytes is not None:
+                if total_bytes is None:
+                    total_bytes = default_budget_bytes()
+                cls._instance = cls(total_bytes)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "MemManager":
+        return cls.init()
+
+    # -- consumer registry -------------------------------------------------
+    def register_consumer(self, c: MemConsumer) -> None:
+        with self._lock:
+            if c not in self._consumers:
+                self._consumers.append(c)
+
+    def unregister_consumer(self, c: MemConsumer) -> None:
+        with self._lock:
+            if c in self._consumers:
+                self._consumers.remove(c)
+
+    @property
+    def mem_used(self) -> int:
+        with self._lock:
+            return sum(c.mem_used for c in self._consumers)
+
+    def consumer_cap(self) -> int:
+        """Fair per-consumer cap: total / max(1, N) (ref lib.rs fair share)."""
+        with self._lock:
+            return self.total // max(1, len(self._consumers))
+
+    # -- pressure handling -------------------------------------------------
+    def on_mem_updated(self, updated: MemConsumer) -> None:
+        with self._lock:
+            overflow = self.mem_used - self.total
+            cap = self.consumer_cap()
+            # a consumer far over its fair share spills even without global
+            # overflow, so one giant sort cannot starve later operators
+            if overflow <= 0 and updated.mem_used <= cap * 2:
+                return
+            # spill biggest consumers until under budget (ref lib.rs: spill
+            # of the biggest consumer on pressure)
+            for c in sorted(self._consumers, key=lambda c: -c.mem_used):
+                if self.mem_used <= self.total * MEM_SPILL_FACTOR:
+                    break
+                if c.mem_used == 0:
+                    continue
+                released = c.spill()
+                self.total_spill_count += 1
+                self.total_spilled_bytes += released
+
+    # -- diagnostics (ref lib.rs:143 dump_status) -------------------------
+    def dump_status(self) -> str:
+        with self._lock:
+            lines = [f"MemManager total={self.total} used={self.mem_used} "
+                     f"spills={self.total_spill_count} "
+                     f"spilled_bytes={self.total_spilled_bytes}"]
+            for c in self._consumers:
+                lines.append(f"  {c.name}: used={c.mem_used}")
+            return "\n".join(lines)
+
+
+def default_budget_bytes() -> int:
+    """HBM budget: device memory * memory fraction (the executor-overhead ×
+    fraction formula of the reference, NativeHelper.scala:51-73)."""
+    import jax
+    frac = config.MEMORY_FRACTION.get()
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"] * frac)
+    except Exception:
+        pass
+    return int(4 * (1 << 30) * frac)  # CPU-test fallback: 4 GiB nominal

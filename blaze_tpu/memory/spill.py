@@ -1,0 +1,130 @@
+"""Spill abstraction: host-RAM tier and compressed disk-file tier.
+
+Parity: auron-memmgr/src/spill.rs (`:89` try_new_spill chooses JVM on-heap
+when available else a direct disk file; `:107` FileSpill, `:180` OnHeapSpill)
+and the spill metrics in auron-memmgr/src/metrics.rs.
+
+A Spill stores a sequence of Arrow RecordBatches (the universal operator
+state currency) written through the framed compressed IPC writer — the same
+format as shuffle blocks (ref io/ipc_compression.rs) so spill files and
+shuffle files share one reader.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+
+from blaze_tpu import config
+
+
+@dataclass
+class SpillMetrics:
+    """(ref auron-memmgr/src/metrics.rs SpillMetrics)"""
+
+    spill_count: int = 0
+    spilled_bytes: int = 0        # uncompressed
+    spilled_file_bytes: int = 0   # on disk
+
+
+class Spill:
+    """One spilled run of record batches."""
+
+    def write_batches(self, batches: Iterator[pa.RecordBatch]) -> int:
+        raise NotImplementedError
+
+    def read_batches(self) -> Iterator[pa.RecordBatch]:
+        raise NotImplementedError
+
+    def release(self) -> None:
+        pass
+
+    @property
+    def stored_bytes(self) -> int:
+        raise NotImplementedError
+
+
+class HostMemSpill(Spill):
+    """Tier-1: device state moved to host RAM as serialized IPC bytes
+    (the OnHeapSpill analog, spill.rs:180)."""
+
+    def __init__(self):
+        self._buf: Optional[bytes] = None
+
+    def write_batches(self, batches) -> int:
+        from blaze_tpu.shuffle.ipc import IpcCompressionWriter
+        sink = io.BytesIO()
+        w = IpcCompressionWriter(sink)
+        n = 0
+        for b in batches:
+            n += w.write_batch(b)
+        w.finish()
+        self._buf = sink.getvalue()
+        return n
+
+    def read_batches(self):
+        from blaze_tpu.shuffle.ipc import IpcCompressionReader
+        assert self._buf is not None
+        yield from IpcCompressionReader(io.BytesIO(self._buf)).read_batches()
+
+    def release(self):
+        self._buf = None
+
+    @property
+    def stored_bytes(self) -> int:
+        return len(self._buf) if self._buf else 0
+
+
+class FileSpill(Spill):
+    """Tier-2: compressed on-disk run (ref spill.rs:107 FileSpill)."""
+
+    def __init__(self, dir: Optional[str] = None):
+        fd, self.path = tempfile.mkstemp(prefix="blaze-spill-", suffix=".spill",
+                                         dir=dir)
+        os.close(fd)
+
+    def write_batches(self, batches) -> int:
+        from blaze_tpu.shuffle.ipc import IpcCompressionWriter
+        n = 0
+        with open(self.path, "wb") as f:
+            w = IpcCompressionWriter(f)
+            for b in batches:
+                n += w.write_batch(b)
+            w.finish()
+        return n
+
+    def read_batches(self):
+        from blaze_tpu.shuffle.ipc import IpcCompressionReader
+        with open(self.path, "rb") as f:
+            yield from IpcCompressionReader(f).read_batches()
+
+    def release(self):
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    @property
+    def stored_bytes(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+
+_host_spill_budget = threading.Semaphore()  # placeholder; see try_new_spill
+
+
+def try_new_spill(prefer_host: bool = True,
+                  host_mem_available: Optional[bool] = None) -> Spill:
+    """Choose the spill tier (ref spill.rs:89: on-heap if isOnHeapAvailable,
+    else getDirectWriteSpillToDiskFile)."""
+    if host_mem_available is None:
+        host_mem_available = prefer_host
+    return HostMemSpill() if host_mem_available else FileSpill()

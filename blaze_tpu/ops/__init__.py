@@ -1,0 +1,16 @@
+"""Execution operators (ref: datafusion-ext-plans/src/)."""
+
+from blaze_tpu.ops.base import (BatchIterator, CoalesceStream, ExecutionPlan,
+                                coalesce)
+from blaze_tpu.ops.basic import (DebugExec, EmptyPartitionsExec, ExpandExec,
+                                 FilterExec, FilterProjectExec, LimitExec,
+                                 ProjectExec, RenameColumnsExec, UnionExec)
+from blaze_tpu.ops.scan import MemoryScanExec, ParquetScanExec
+from blaze_tpu.ops.sort import SortExec
+
+__all__ = [
+    "BatchIterator", "CoalesceStream", "ExecutionPlan", "coalesce",
+    "DebugExec", "EmptyPartitionsExec", "ExpandExec", "FilterExec",
+    "FilterProjectExec", "LimitExec", "ProjectExec", "RenameColumnsExec",
+    "UnionExec", "MemoryScanExec", "ParquetScanExec", "SortExec",
+]

@@ -1,0 +1,130 @@
+"""Execution operator base + the batch-coalescing stream.
+
+Parity: DataFusion `ExecutionPlan` as used by the reference's 28 operators
+(ref: datafusion-ext-plans/src/*, planner.rs:122 create_plan) and the
+CoalesceStream auto-wrapped around every plan root
+(ref: common/execution_context.rs:146-150, rt.rs:160-166).
+
+Execution model (TPU-first): synchronous pull iterators of ColumnBatch per
+partition.  The reference's tokio async streams exist to overlap JVM IO with
+native compute; here overlap comes from (a) the host prefetch thread in the
+task runtime (bridge/runtime.py) and (b) XLA async dispatch — device work is
+enqueued ahead while the host iterates.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+from blaze_tpu import config
+from blaze_tpu.batch import ColumnBatch, round_capacity
+from blaze_tpu.bridge.context import current_task
+from blaze_tpu.bridge.metrics import MetricNode
+from blaze_tpu.schema import Schema
+
+BatchIterator = Iterator[ColumnBatch]
+
+
+class ExecutionPlan:
+    """One physical operator node."""
+
+    def __init__(self, children: Sequence["ExecutionPlan"] = ()):
+        self._children: List[ExecutionPlan] = list(children)
+        self.metrics = MetricNode(name=type(self).__name__)
+
+    # -- topology -----------------------------------------------------------
+    @property
+    def children(self) -> List["ExecutionPlan"]:
+        return self._children
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def num_partitions(self) -> int:
+        """Output partition count (Spark RDD partitions analog)."""
+        if self._children:
+            return self._children[0].num_partitions
+        return 1
+
+    # -- execution ----------------------------------------------------------
+    def execute(self, partition: int) -> BatchIterator:
+        """Pull-stream of batches for one partition."""
+        raise NotImplementedError
+
+    def execute_collect(self) -> "ColumnBatch":
+        """All partitions concatenated (test/driver helper)."""
+        out = []
+        for p in range(self.num_partitions):
+            out.extend(self.execute(p))
+        if not out:
+            from blaze_tpu.batch import ColumnBatch as CB
+            import pyarrow as pa
+            empty = pa.Table.from_batches([], schema=self.schema.to_arrow())
+            return CB.from_arrow(empty)
+        return ColumnBatch.concat(out)
+
+    def collect_metrics(self) -> MetricNode:
+        node = MetricNode(name=type(self).__name__, values=dict(self.metrics.values))
+        node.children = [c.collect_metrics() for c in self._children]
+        return node
+
+    def __repr__(self):
+        head = type(self).__name__
+        if not self._children:
+            return head
+        inner = ", ".join(repr(c) for c in self._children)
+        return f"{head}({inner})"
+
+    def pretty(self, indent: int = 0) -> str:
+        lines = ["  " * indent + type(self).__name__]
+        for c in self._children:
+            lines.append(c.pretty(indent + 1))
+        return "\n".join(lines)
+
+
+class CoalesceStream:
+    """Re-batches a stream to ~batch_size dense rows.
+
+    The reference coalesces small batches at every plan root and between
+    operators (ref execution_context.rs:146 CoalesceStream).  Here it also
+    compacts sparse selections: a batch whose surviving-row density is below
+    `min_density` is compacted so downstream device work stops paying for
+    dead lanes — the static-shape analog of selection vectors.
+    """
+
+    def __init__(self, stream: BatchIterator, batch_size: Optional[int] = None,
+                 min_density: float = 0.5, metrics: Optional[MetricNode] = None):
+        self._stream = stream
+        self._batch_size = batch_size or config.BATCH_SIZE.get()
+        self._min_density = min_density
+        self._metrics = metrics or MetricNode()
+
+    def __iter__(self) -> BatchIterator:
+        staged: List[ColumnBatch] = []
+        staged_rows = 0
+        ctx = current_task()
+        for batch in self._stream:
+            ctx.check_running()
+            n = batch.selected_count()
+            if n == 0:
+                continue
+            density = n / max(1, batch.capacity)
+            if density < self._min_density:
+                batch = batch.compact()
+            if n >= self._batch_size // 2 and not staged:
+                yield batch
+                continue
+            staged.append(batch)
+            staged_rows += n
+            if staged_rows >= self._batch_size:
+                yield ColumnBatch.concat(staged,
+                                         round_capacity(staged_rows))
+                staged, staged_rows = [], 0
+        if staged:
+            yield ColumnBatch.concat(staged, round_capacity(staged_rows))
+
+
+def coalesce(stream: BatchIterator, batch_size: Optional[int] = None) -> BatchIterator:
+    return iter(CoalesceStream(stream, batch_size))

@@ -1,0 +1,102 @@
+"""Row-group pruning with parquet min/max statistics.
+
+Parity: the reference delegates page/row-group filtering to DataFusion's
+parquet source gated by `auron.parquet.enable.pageFiltering` (ref
+conf.rs:43, parquet_exec.rs).  Here: interval analysis of the filter
+PhysicalExpr against per-row-group [min, max] statistics — a conservative
+evaluator that returns "maybe" unless stats prove a group empty.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from blaze_tpu.exprs.base import BoundReference, Literal, PhysicalExpr
+from blaze_tpu.exprs.binary import BinaryExpr
+from blaze_tpu.exprs.conditional import InList, IsNotNull, IsNull
+from blaze_tpu.schema import Schema
+
+Interval = Tuple[Optional[object], Optional[object], bool]  # (min, max, has_nulls)
+
+
+def prune_with_stats(md, schema: Schema, predicate: PhysicalExpr,
+                     groups: List[int]) -> List[int]:
+    name_to_col = {md.schema.column(i).name: i
+                   for i in range(len(md.schema))}
+    keep = []
+    for g in groups:
+        rg = md.row_group(g)
+        stats = {}
+        for name, ci in name_to_col.items():
+            col = rg.column(ci)
+            if col.statistics is not None and col.statistics.has_min_max:
+                stats[name] = (col.statistics.min, col.statistics.max,
+                               (col.statistics.null_count or 0) > 0)
+        if _may_match(predicate, schema, stats):
+            keep.append(g)
+    return keep
+
+
+def _col_name(expr: PhysicalExpr, schema: Schema) -> Optional[str]:
+    if isinstance(expr, BoundReference):
+        if expr.name:
+            return expr.name
+        if expr.index < len(schema):
+            return schema[expr.index].name
+    return None
+
+
+def _lit_value(expr: PhysicalExpr):
+    if isinstance(expr, Literal):
+        return expr.value
+    return None
+
+
+def _may_match(pred: PhysicalExpr, schema: Schema, stats: dict) -> bool:
+    """Conservative: False only when stats PROVE no row matches."""
+    if isinstance(pred, BinaryExpr):
+        if pred.op == "and":
+            return (_may_match(pred.left, schema, stats) and
+                    _may_match(pred.right, schema, stats))
+        if pred.op == "or":
+            return (_may_match(pred.left, schema, stats) or
+                    _may_match(pred.right, schema, stats))
+        if pred.op in ("==", "<", "<=", ">", ">="):
+            # normalize to col OP lit
+            name, lit, op = _col_name(pred.left, schema), _lit_value(pred.right), pred.op
+            if name is None and _col_name(pred.right, schema) is not None:
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "=="}
+                name, lit, op = (_col_name(pred.right, schema),
+                                 _lit_value(pred.left), flip[pred.op])
+            if name is None or lit is None or name not in stats:
+                return True
+            mn, mx, _ = stats[name]
+            try:
+                if op == "==":
+                    return mn <= lit <= mx
+                if op == "<":
+                    return mn < lit
+                if op == "<=":
+                    return mn <= lit
+                if op == ">":
+                    return mx > lit
+                if op == ">=":
+                    return mx >= lit
+            except TypeError:
+                return True
+        return True
+    if isinstance(pred, InList) and not pred.negated:
+        name = _col_name(pred.child, schema)
+        if name is None or name not in stats:
+            return True
+        mn, mx, _ = stats[name]
+        try:
+            return any(v is not None and mn <= v <= mx for v in pred.values)
+        except TypeError:
+            return True
+    if isinstance(pred, IsNull):
+        name = _col_name(pred.child, schema)
+        if name is not None and name in stats:
+            return stats[name][2]
+        return True
+    return True

@@ -1,0 +1,140 @@
+"""Scan operators: in-memory (tests) and Parquet.
+
+Parity: parquet_exec.rs:70 (DataFusion parquet source through the JVM Hadoop
+FS bridge, page filtering + bloom gated by conf) and the TestMemoryExec
+pattern used across the reference's Rust unit tests (SURVEY.md §4 tier 1).
+
+TPU-first: parquet decoding is host work (pyarrow's C++ reader), producing
+Arrow batches that cross to device as padded columns.  Predicate pushdown =
+row-group min/max pruning + pyarrow filter pushdown; the residual predicate
+still runs on device in FilterExec (scans never trust pushdown completeness,
+matching the reference).
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.dataset
+import pyarrow.parquet as pq
+
+from blaze_tpu import config
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
+from blaze_tpu.schema import Schema
+
+
+class MemoryScanExec(ExecutionPlan):
+    """Fixed batches per partition (the TestMemoryExec analog)."""
+
+    def __init__(self, schema: Schema,
+                 partitions: Sequence[Sequence[ColumnBatch]]):
+        super().__init__()
+        self._schema = schema
+        self._partitions = [list(p) for p in partitions]
+
+    @staticmethod
+    def from_arrow(table: pa.Table, num_partitions: int = 1,
+                   batch_rows: Optional[int] = None) -> "MemoryScanExec":
+        schema = Schema.from_arrow(table.schema)
+        batch_rows = batch_rows or config.BATCH_SIZE.get()
+        batches = table.to_batches(max_chunksize=batch_rows)
+        parts: List[List[ColumnBatch]] = [[] for _ in range(num_partitions)]
+        for i, rb in enumerate(batches):
+            parts[i % num_partitions].append(ColumnBatch.from_arrow(rb))
+        return MemoryScanExec(schema, parts)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._partitions)
+
+    def execute(self, partition: int) -> BatchIterator:
+        for b in self._partitions[partition]:
+            self.metrics.add("output_rows", b.selected_count())
+            yield b
+
+
+class ParquetScanExec(ExecutionPlan):
+    """Parquet scan over a list of file splits.
+
+    Each partition owns a list of (path, row_group_range) splits, mirroring
+    the FileScanConfig file groups of parquet_exec.rs:70.  `predicate` is a
+    PhysicalExpr evaluated twice: statically against row-group min/max stats
+    here (pruning, ref conf auron.parquet.enable.pageFiltering), and
+    row-wise on device by the FilterExec above this scan.
+    """
+
+    def __init__(self, schema: Schema, file_groups: Sequence[Sequence[str]],
+                 projection: Optional[Sequence[str]] = None,
+                 predicate=None, batch_rows: Optional[int] = None):
+        super().__init__()
+        self._file_schema = schema
+        self._projection = list(projection) if projection is not None else None
+        self._schema = (Schema([schema.field(n) for n in self._projection])
+                        if self._projection is not None else schema)
+        self._file_groups = [list(g) for g in file_groups]
+        self._predicate = predicate
+        self._batch_rows = batch_rows or config.BATCH_SIZE.get()
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._file_groups)
+
+    def execute(self, partition: int) -> BatchIterator:
+        for path in self._file_groups[partition]:
+            try:
+                f = pq.ParquetFile(path)
+            except Exception:
+                if config.IGNORE_CORRUPTED_FILES.get():
+                    continue
+                raise
+            row_groups = self._prune_row_groups(f)
+            self.metrics.add("pruned_row_groups",
+                             f.metadata.num_row_groups - len(row_groups))
+            if not row_groups:
+                continue
+            columns = self._projection
+            for rb in f.iter_batches(batch_size=self._batch_rows,
+                                     row_groups=row_groups, columns=columns):
+                rb = _align_schema(rb, self._schema)
+                cb = ColumnBatch.from_arrow(rb)
+                self.metrics.add("output_rows", cb.num_rows)
+                yield cb
+
+    def _prune_row_groups(self, f: pq.ParquetFile) -> List[int]:
+        md = f.metadata
+        all_groups = list(range(md.num_row_groups))
+        if (self._predicate is None or
+                not config.PARQUET_ENABLE_PAGE_FILTERING.get()):
+            return all_groups
+        from blaze_tpu.ops.pruning import prune_with_stats
+        return prune_with_stats(md, self._file_schema, self._predicate,
+                                all_groups)
+
+
+def _align_schema(rb: pa.RecordBatch, schema: Schema) -> pa.RecordBatch:
+    """Cast physical file types to the plan's logical schema (schema
+    evolution: missing columns -> nulls, widened ints, ts units)."""
+    target = schema.to_arrow()
+    if rb.schema.equals(target):
+        return rb
+    arrays = []
+    for field in target:
+        idx = rb.schema.get_field_index(field.name)
+        if idx < 0:
+            arrays.append(pa.nulls(rb.num_rows, type=field.type))
+        else:
+            col = rb.column(idx)
+            arrays.append(col if col.type.equals(field.type)
+                          else col.cast(field.type, safe=False))
+    return pa.RecordBatch.from_arrays(arrays, schema=target)

@@ -1,0 +1,11 @@
+"""Shuffle: repartitioners, framed IPC blocks, .data/.index files.
+
+Ref: datafusion-ext-plans/src/shuffle/ + io/ipc_compression.rs.
+"""
+
+from blaze_tpu.shuffle.ipc import (IpcCompressionReader, IpcCompressionWriter,
+                                   read_batches_from_bytes,
+                                   write_batches_to_bytes)
+
+__all__ = ["IpcCompressionReader", "IpcCompressionWriter",
+           "read_batches_from_bytes", "write_batches_to_bytes"]

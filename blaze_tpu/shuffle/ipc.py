@@ -1,0 +1,144 @@
+"""Framed compressed Arrow-IPC block format.
+
+Parity: datafusion-ext-commons/src/io/ipc_compression.rs (`:35`
+IpcCompressionWriter, `:135` IpcCompressionReader) — the one wire/disk format
+shared by shuffle `.data` files, spill files and broadcast byte arrays.
+
+Frame layout (little-endian):
+    [u8  codec]  0 = raw, 1 = zstd  (lz4 is not in this environment; the
+                 codec byte keeps the format open, ref SPILL_COMPRESSION_CODEC)
+    [u32 length] compressed payload size
+    [payload]    one Arrow IPC *stream* (schema + N record batches)
+
+Frames are self-describing and concatenable: a reader can start at any frame
+boundary, which is what the shuffle `.index` file points at.  Batches are
+buffered until the target frame size so small batches amortize compression
+(ref auron.shuffle.compression.target.buf.size).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import BinaryIO, Iterator, List, Optional
+
+import pyarrow as pa
+
+from blaze_tpu import config
+
+_HEADER = struct.Struct("<BI")
+CODEC_RAW = 0
+CODEC_ZSTD = 1
+
+
+def _get_codec() -> int:
+    name = config.SPILL_COMPRESSION_CODEC.get().lower()
+    return CODEC_ZSTD if name in ("zstd", "zstandard") else CODEC_RAW
+
+
+def _compress(codec: int, payload: bytes) -> bytes:
+    if codec == CODEC_ZSTD:
+        import zstandard
+        return zstandard.ZstdCompressor(level=1).compress(payload)
+    return payload
+
+
+def _decompress(codec: int, payload: bytes) -> bytes:
+    if codec == CODEC_ZSTD:
+        import zstandard
+        return zstandard.ZstdDecompressor().decompress(payload)
+    return payload
+
+
+class IpcCompressionWriter:
+    """Streams record batches into framed compressed IPC blocks."""
+
+    def __init__(self, sink: BinaryIO, target_frame_bytes: Optional[int] = None):
+        self._sink = sink
+        self._codec = _get_codec()
+        self._target = (target_frame_bytes or
+                        config.SHUFFLE_COMPRESSION_TARGET_BUF_SIZE.get())
+        self._pending: List[pa.RecordBatch] = []
+        self._pending_bytes = 0
+        self.raw_bytes_written = 0
+        self.frames_written = 0
+
+    def write_batch(self, batch: pa.RecordBatch) -> int:
+        """Buffer a batch; flush a frame when the target size is reached.
+        Returns the batch's in-memory size (for spill accounting)."""
+        nbytes = batch.nbytes
+        self._pending.append(batch)
+        self._pending_bytes += nbytes
+        if self._pending_bytes >= self._target:
+            self.flush_frame()
+        return nbytes
+
+    def flush_frame(self) -> None:
+        if not self._pending:
+            return
+        buf = io.BytesIO()
+        with pa.ipc.new_stream(buf, self._pending[0].schema) as w:
+            for b in self._pending:
+                w.write_batch(b)
+        payload = _compress(self._codec, buf.getvalue())
+        self._sink.write(_HEADER.pack(self._codec, len(payload)))
+        self._sink.write(payload)
+        self.raw_bytes_written += self._pending_bytes
+        self.frames_written += 1
+        self._pending.clear()
+        self._pending_bytes = 0
+
+    def finish(self) -> None:
+        self.flush_frame()
+
+
+class IpcCompressionReader:
+    """Reads frames until EOF (or a byte limit for file-segment blocks)."""
+
+    def __init__(self, source: BinaryIO, limit: Optional[int] = None):
+        self._source = source
+        self._remaining = limit
+
+    def _read_exact(self, n: int) -> Optional[bytes]:
+        if self._remaining is not None:
+            if self._remaining == 0:
+                return None
+            assert self._remaining >= n, "frame crosses segment boundary"
+        data = self._source.read(n)
+        if not data:
+            return None
+        while len(data) < n:
+            more = self._source.read(n - len(data))
+            if not more:
+                raise EOFError("truncated IPC frame")
+            data += more
+        if self._remaining is not None:
+            self._remaining -= n
+        return data
+
+    def read_batches(self) -> Iterator[pa.RecordBatch]:
+        while True:
+            header = self._read_exact(_HEADER.size)
+            if header is None:
+                return
+            codec, length = _HEADER.unpack(header)
+            payload = self._read_exact(length)
+            if payload is None:
+                raise EOFError("truncated IPC frame payload")
+            raw = _decompress(codec, payload)
+            with pa.ipc.open_stream(io.BytesIO(raw)) as r:
+                yield from r
+
+
+def write_batches_to_bytes(batches) -> bytes:
+    """One-shot helper (broadcast data, ref NativeBroadcastExchangeBase)."""
+    sink = io.BytesIO()
+    w = IpcCompressionWriter(sink)
+    for b in batches:
+        w.write_batch(b)
+    w.finish()
+    return sink.getvalue()
+
+
+def read_batches_from_bytes(data: bytes) -> Iterator[pa.RecordBatch]:
+    yield from IpcCompressionReader(io.BytesIO(data)).read_batches()

