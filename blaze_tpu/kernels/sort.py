@@ -87,6 +87,19 @@ def segment_first(values: jax.Array, valid: jax.Array, gids: jax.Array,
     return jnp.take(values, idx), jnp.take(valid, idx) & has_rows
 
 
+def segment_first_ignores_null(values: jax.Array, valid: jax.Array,
+                               gids: jax.Array, num_segments: int
+                               ) -> Tuple[jax.Array, jax.Array]:
+    """First NON-NULL value per segment — Spark first(ignoreNulls=true)
+    (ref agg/first_ignores_null.rs)."""
+    n = values.shape[0]
+    pos = jnp.where(valid, jnp.arange(n, dtype=jnp.int64), jnp.int64(n))
+    first_pos = jax.ops.segment_min(pos, gids, num_segments=num_segments)
+    has_valid = first_pos < n
+    idx = jnp.clip(first_pos, 0, n - 1)
+    return jnp.take(values, idx), has_valid
+
+
 def _identity_for(dtype, minimum: bool):
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.array(-jnp.inf if minimum else jnp.inf, dtype=dtype)

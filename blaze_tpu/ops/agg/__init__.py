@@ -1,0 +1,10 @@
+"""Aggregation framework (ref: datafusion-ext-plans/src/agg/)."""
+
+from blaze_tpu.ops.agg.exec import AggExec, AggExecMode, AggMode
+from blaze_tpu.ops.agg.functions import (AggFunction, AvgAgg, BloomFilterAgg,
+                                         CollectAgg, CountAgg, FirstAgg,
+                                         MinMaxAgg, SumAgg, make_agg)
+
+__all__ = ["AggExec", "AggExecMode", "AggMode", "AggFunction", "AvgAgg",
+           "BloomFilterAgg", "CollectAgg", "CountAgg", "FirstAgg",
+           "MinMaxAgg", "SumAgg", "make_agg"]

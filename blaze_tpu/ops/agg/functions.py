@@ -1,0 +1,407 @@
+"""Aggregate functions over segmented (sort-based) group layouts.
+
+Parity: the reference's Agg trait — partial_update / partial_merge /
+final_merge over columnar accumulators (ref: datafusion-ext-plans/src/agg/
+agg.rs:41,55,63,71; acc.rs:39 AccColumn; impls sum.rs, avg.rs, count.rs,
+maxmin.rs:316, first.rs:346, first_ignores_null.rs, collect.rs:749,
+bloom_filter.rs:312).
+
+TPU-first redesign: the reference updates accumulators through a hash map of
+group slots; here groups arrive as SORTED SEGMENTS (device lexsort + boundary
+cumsum, SURVEY.md §7 hard-part 3), so every accumulator update is one fused
+segmented reduction on device.  An agg's accumulator state is a tuple of
+fixed-width device arrays indexed by dense group id ("AccTable, columnar not
+row-based" — same layout philosophy as acc.rs, but jnp arrays).  Collect and
+bloom keep host accumulators (variable width), mirroring the reference's
+boxed AccColumn for dynamic types.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import PhysicalExpr
+from blaze_tpu.kernels import sort as K
+from blaze_tpu.schema import (BOOL, BINARY, DataType, Field, FLOAT64, INT64,
+                              Schema, TypeId)
+
+# Arrays on device per group slot; host accs are lists of python objects.
+AccArrays = Tuple
+
+
+class AggFunction:
+    """One aggregate function instance bound to its input expressions."""
+
+    name = "agg"
+
+    def __init__(self, children: Sequence[PhysicalExpr]):
+        self.children = list(children)
+        self.input_type: Optional[DataType] = None
+
+    def bind(self, input_schema: Schema) -> None:
+        """Resolve input type once (AggExec calls this at plan time)."""
+        if self.children:
+            self.input_type = self.children[0].data_type(input_schema)
+
+    # -- schema -------------------------------------------------------------
+    def acc_fields(self, input_schema: Schema) -> List[Field]:
+        """Accumulator columns as materialized in partial batches."""
+        raise NotImplementedError
+
+    def output_type(self, input_schema: Schema) -> DataType:
+        raise NotImplementedError
+
+    # -- device phases ------------------------------------------------------
+    def partial_update(self, args: List[Tuple[jax.Array, jax.Array]],
+                       gids: jax.Array, num_segments: int) -> AccArrays:
+        """Raw inputs (sorted by group) -> per-group accumulator arrays.
+        `args[i]` = (data, validity) gathered through the sort permutation."""
+        raise NotImplementedError
+
+    def partial_merge(self, accs: List[Tuple[jax.Array, jax.Array]],
+                      gids: jax.Array, num_segments: int) -> AccArrays:
+        """Partial accumulator columns (sorted by group) -> combined accs."""
+        raise NotImplementedError
+
+    def final_eval(self, accs: List[Tuple[jax.Array, jax.Array]]
+                   ) -> Tuple[jax.Array, jax.Array]:
+        """Combined accumulator columns -> (data, validity) result column."""
+        raise NotImplementedError
+
+    @property
+    def is_host(self) -> bool:
+        return False
+
+
+def _out_num_type(dt: DataType) -> DataType:
+    """Spark sum/avg result types: int sums stay int64, floats f64,
+    decimal sums keep decimal (scale preserved, precision widened)."""
+    if dt.id == TypeId.DECIMAL:
+        return DataType(TypeId.DECIMAL, min(dt.precision + 10, 18), dt.scale)
+    if dt.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        return FLOAT64
+    return INT64
+
+
+class SumAgg(AggFunction):
+    name = "sum"
+
+    def acc_fields(self, s):
+        t = _out_num_type(self.children[0].data_type(s))
+        return [Field("sum", t)]
+
+    def output_type(self, s):
+        return _out_num_type(self.children[0].data_type(s))
+
+    def partial_update(self, args, gids, n):
+        data, valid = args[0]
+        acc_dt = jnp.float64 if jnp.issubdtype(data.dtype, jnp.floating) else jnp.int64
+        s = K.segment_sum(data.astype(acc_dt), gids, n, valid)
+        has = K.segment_count(valid, gids, n) > 0
+        return ((s, has),)
+
+    def partial_merge(self, accs, gids, n):
+        data, valid = accs[0]
+        s = K.segment_sum(data, gids, n, valid)
+        has = K.segment_count(valid, gids, n) > 0
+        return ((s, has),)
+
+    def final_eval(self, accs):
+        return accs[0]
+
+
+class CountAgg(AggFunction):
+    """count(expr) / count(*) when children empty (never-null output)."""
+
+    name = "count"
+
+    def acc_fields(self, s):
+        return [Field("count", INT64, nullable=False)]
+
+    def output_type(self, s):
+        return INT64
+
+    def partial_update(self, args, gids, n):
+        if self.children:
+            _, valid = args[0]
+            c = K.segment_count(valid, gids, n)
+        else:
+            ones = jnp.ones(gids.shape[0], dtype=bool)
+            c = K.segment_count(ones, gids, n)
+        return ((c, jnp.ones(n, dtype=bool)),)
+
+    def partial_merge(self, accs, gids, n):
+        data, valid = accs[0]
+        c = K.segment_sum(data, gids, n, valid)
+        return ((c, jnp.ones(c.shape[0], dtype=bool)),)
+
+    def final_eval(self, accs):
+        data, _ = accs[0]
+        return data, jnp.ones(data.shape[0], dtype=bool)
+
+
+class AvgAgg(AggFunction):
+    name = "avg"
+
+    def acc_fields(self, s):
+        t = self.children[0].data_type(s)
+        if t.id == TypeId.DECIMAL:
+            sum_t = _out_num_type(t)
+        elif t.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            sum_t = FLOAT64
+        else:
+            sum_t = INT64  # Spark avg(int) sums as long
+        return [Field("sum", sum_t), Field("count", INT64, nullable=False)]
+
+    def output_type(self, s):
+        t = self.children[0].data_type(s)
+        if t.id == TypeId.DECIMAL:
+            # Spark: avg(decimal(p,s)) -> decimal(p+4, s+4) capped
+            return DataType(TypeId.DECIMAL, min(t.precision + 4, 18),
+                            min(t.scale + 4, 18))
+        return FLOAT64
+
+    def partial_update(self, args, gids, n):
+        data, valid = args[0]
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            s = K.segment_sum(data.astype(jnp.float64), gids, n, valid)
+        else:  # int and decimal-unscaled sums stay exact in int64
+            s = K.segment_sum(data.astype(jnp.int64), gids, n, valid)
+        c = K.segment_count(valid, gids, n)
+        return ((s, c > 0), (c, jnp.ones(n, dtype=bool)))
+
+    def partial_merge(self, accs, gids, n):
+        (s_d, s_v), (c_d, c_v) = accs
+        s = K.segment_sum(s_d, gids, n, s_v)
+        c = K.segment_sum(c_d, gids, n, c_v)
+        return ((s, c > 0), (c, jnp.ones(c.shape[0], dtype=bool)))
+
+    def final_eval(self, accs):
+        (s_d, _), (c_d, _) = accs
+        valid = c_d > 0
+        denom = jnp.where(valid, c_d, 1)
+        if self.input_type is not None and self.input_type.id == TypeId.DECIMAL:
+            # decimal(p,s) -> decimal(p+4, s+4): unscaled*10^4 / count, HALF_UP
+            num = s_d * jnp.int64(10_000)
+            half = denom // 2
+            adj = jnp.where(num >= 0, num + half, num - half)
+            q = jnp.sign(adj) * (jnp.abs(adj) // denom)
+            return q, valid
+        return s_d / denom.astype(jnp.float64), valid
+
+
+class MinMaxAgg(AggFunction):
+    def __init__(self, children, minimum: bool):
+        super().__init__(children)
+        self.minimum = minimum
+        self.name = "min" if minimum else "max"
+
+    def acc_fields(self, s):
+        return [Field(self.name, self.children[0].data_type(s))]
+
+    def output_type(self, s):
+        return self.children[0].data_type(s)
+
+    def _reduce(self, data, valid, gids, n):
+        fn = K.segment_min if self.minimum else K.segment_max
+        out = fn(data, gids, n, valid)
+        has = K.segment_count(valid, gids, n) > 0
+        identity = K._identity_for(data.dtype, minimum=not self.minimum)
+        out = jnp.where(has, out, jnp.zeros_like(out))
+        return ((out, has),)
+
+    def partial_update(self, args, gids, n):
+        return self._reduce(args[0][0], args[0][1], gids, n)
+
+    def partial_merge(self, accs, gids, n):
+        return self._reduce(accs[0][0], accs[0][1], gids, n)
+
+    def final_eval(self, accs):
+        return accs[0]
+
+
+class FirstAgg(AggFunction):
+    def __init__(self, children, ignores_null: bool = False):
+        super().__init__(children)
+        self.ignores_null = ignores_null
+        self.name = "first_ignores_null" if ignores_null else "first"
+
+    def acc_fields(self, s):
+        t = self.children[0].data_type(s)
+        fields = [Field("first", t)]
+        if not self.ignores_null:
+            # "value is null" vs "no value yet" need separate tracking
+            fields.append(Field("has", BOOL, nullable=False))
+        return fields
+
+    def output_type(self, s):
+        return self.children[0].data_type(s)
+
+    def partial_update(self, args, gids, n):
+        data, valid = args[0]
+        if self.ignores_null:
+            v, has = K.segment_first_ignores_null(data, valid, gids, n)
+            return ((v, has),)
+        v, vvalid = K.segment_first(data, valid, gids, n)
+        has_rows = K.segment_count(jnp.ones_like(valid), gids, n) > 0
+        return ((v, vvalid), (has_rows, jnp.ones(n, dtype=bool)))
+
+    def partial_merge(self, accs, gids, n):
+        if self.ignores_null:
+            data, valid = accs[0]
+            v, has = K.segment_first_ignores_null(data, valid, gids, n)
+            return ((v, has),)
+        (data, valid), (has, _) = accs
+        # first among partials that HAVE a value (has flag), not non-null
+        v, _ = K.segment_first_ignores_null(
+            data, has.astype(bool), gids, n)
+        vv, _ = K.segment_first_ignores_null(
+            valid, has.astype(bool), gids, n)
+        any_has = K.segment_count(has.astype(bool), gids, n) > 0
+        return ((v, vv.astype(bool) & any_has),
+                (any_has, jnp.ones(n, dtype=bool)))
+
+    def final_eval(self, accs):
+        return accs[0]
+
+
+class CollectAgg(AggFunction):
+    """collect_list / collect_set — host accumulators (variable width),
+    ref collect.rs:749."""
+
+    def __init__(self, children, distinct: bool):
+        super().__init__(children)
+        self.distinct = distinct
+        self.name = "collect_set" if distinct else "collect_list"
+
+    @property
+    def is_host(self) -> bool:
+        return True
+
+    def acc_fields(self, s):
+        item = self.children[0].data_type(s)
+        return [Field("items", DataType(TypeId.LIST,
+                                        children=(Field("item", item),)))]
+
+    def output_type(self, s):
+        item = self.children[0].data_type(s)
+        return DataType(TypeId.LIST, children=(Field("item", item),))
+
+    # host phases operate on pa arrays + numpy gids
+    def host_update(self, args: List[pa.Array], gids: np.ndarray,
+                    num_segments: int) -> List[pa.Array]:
+        vals = args[0]
+        out: List[List] = [[] for _ in range(num_segments)]
+        for v, g in zip(vals, gids):
+            if g < num_segments and v.is_valid:
+                out[g].append(v.as_py())
+        if self.distinct:
+            out = [list(dict.fromkeys(x)) for x in out]
+        item_t = vals.type
+        return [pa.array(out, type=pa.list_(item_t))]
+
+    def host_merge(self, accs: List[pa.Array], gids: np.ndarray,
+                   num_segments: int) -> List[pa.Array]:
+        lists = accs[0]
+        out: List[List] = [[] for _ in range(num_segments)]
+        for v, g in zip(lists, gids):
+            if g < num_segments and v.is_valid:
+                out[g].extend(v.as_py())
+        if self.distinct:
+            out = [list(dict.fromkeys(x)) for x in out]
+        return [pa.array(out, type=lists.type)]
+
+    def host_eval(self, accs: List[pa.Array]) -> pa.Array:
+        return accs[0]
+
+
+class BloomFilterAgg(AggFunction):
+    """bloom_filter_agg for runtime-filter joins (ref agg/bloom_filter.rs:312):
+    global (ungrouped) Spark-compatible bloom built from int64 hashes."""
+
+    name = "bloom_filter"
+
+    def __init__(self, children, expected_items: int = 1_000_000,
+                 num_bits: Optional[int] = None):
+        super().__init__(children)
+        from blaze_tpu.kernels import bloom
+        self.num_bits = num_bits or bloom.optimal_num_bits(expected_items, 0.03)
+        self.num_hashes = bloom.optimal_num_hashes(expected_items, self.num_bits)
+
+    @property
+    def is_host(self) -> bool:
+        return True
+
+    def acc_fields(self, s):
+        return [Field("bloom", BINARY)]
+
+    def output_type(self, s):
+        return BINARY
+
+    def host_update(self, args, gids, num_segments):
+        from blaze_tpu.kernels.bloom import SparkBloomFilter
+        vals = args[0].cast(pa.int64())
+        out = []
+        npg = np.asarray(gids)
+        npv = np.asarray(vals.fill_null(0), dtype=np.int64)
+        valid = np.asarray(vals.is_valid())
+        for g in range(num_segments):
+            f = SparkBloomFilter(self.num_bits, self.num_hashes)
+            f.put_longs(npv[(npg == g) & valid])
+            out.append(f.to_bytes())
+        return [pa.array(out, type=pa.binary())]
+
+    def host_merge(self, accs, gids, num_segments):
+        from blaze_tpu.kernels.bloom import SparkBloomFilter
+        out = []
+        npg = np.asarray(gids)
+        for g in range(num_segments):
+            f: Optional[SparkBloomFilter] = None
+            for i in np.nonzero(npg == g)[0]:
+                v = accs[0][int(i)]
+                if not v.is_valid:
+                    continue
+                other = SparkBloomFilter.from_bytes(v.as_py())
+                if f is None:
+                    f = other
+                else:
+                    f.merge(other)
+            out.append(f.to_bytes() if f is not None else None)
+        return [pa.array(out, type=pa.binary())]
+
+    def host_eval(self, accs):
+        return accs[0]
+
+
+# -- registry (proto AggFunction enum, auron.proto:143) ----------------------
+
+def make_agg(name: str, children: Sequence[PhysicalExpr], **kw) -> AggFunction:
+    name = name.lower()
+    if name == "sum":
+        return SumAgg(children)
+    if name == "count":
+        return CountAgg(children)
+    if name == "avg":
+        return AvgAgg(children)
+    if name == "min":
+        return MinMaxAgg(children, minimum=True)
+    if name == "max":
+        return MinMaxAgg(children, minimum=False)
+    if name == "first":
+        return FirstAgg(children, ignores_null=False)
+    if name == "first_ignores_null":
+        return FirstAgg(children, ignores_null=True)
+    if name == "collect_list":
+        return CollectAgg(children, distinct=False)
+    if name == "collect_set":
+        return CollectAgg(children, distinct=True)
+    if name == "bloom_filter":
+        return BloomFilterAgg(children, **kw)
+    raise KeyError(f"unknown aggregate function {name}")

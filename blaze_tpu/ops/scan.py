@@ -36,8 +36,10 @@ class MemoryScanExec(ExecutionPlan):
         self._partitions = [list(p) for p in partitions]
 
     @staticmethod
-    def from_arrow(table: pa.Table, num_partitions: int = 1,
+    def from_arrow(table, num_partitions: int = 1,
                    batch_rows: Optional[int] = None) -> "MemoryScanExec":
+        if isinstance(table, pa.RecordBatch):
+            table = pa.Table.from_batches([table])
         schema = Schema.from_arrow(table.schema)
         batch_rows = batch_rows or config.BATCH_SIZE.get()
         batches = table.to_batches(max_chunksize=batch_rows)

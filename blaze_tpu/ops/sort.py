@@ -283,64 +283,72 @@ class _SortState(MemConsumer):
 
     def _merge_runs(self, runs: List[Iterator[pa.RecordBatch]]
                     ) -> Iterator[pa.RecordBatch]:
-        """Vectorized k-way merge: per round, merge every buffered row whose
-        key <= the smallest 'run-head max key' (safe threshold — no
-        unbuffered row can precede it)."""
         desc = [d for _, d, _ in self._specs]
         nf = [f for _, _, f in self._specs]
-        key_cols = list(range(self._num_keys))
+        for rb in merge_sorted_batches(runs, list(range(self._num_keys)),
+                                       desc, nf):
+            yield self._strip_keys(rb)
 
-        heads: List[Optional[pa.RecordBatch]] = []
-        keys: List[Optional[List[np.ndarray]]] = []
-        for r in runs:
-            rb = next(r, None)
-            heads.append(rb)
-            keys.append(host_sort_keys(rb, key_cols, desc, nf) if rb is not None
-                        else None)
 
-        def _advance(i):
-            rb = next(runs[i], None)
-            heads[i] = rb
-            keys[i] = (host_sort_keys(rb, key_cols, desc, nf)
-                       if rb is not None else None)
+def merge_sorted_batches(runs: List[Iterator[pa.RecordBatch]],
+                         key_cols: Sequence[int], desc: Sequence[bool],
+                         nf: Sequence[bool]) -> Iterator[pa.RecordBatch]:
+    """Vectorized k-way merge of sorted batch streams (shared by SortExec
+    and the agg spill merge): per round, merge every buffered row whose key
+    <= the smallest 'run-head max key' (safe threshold — no unbuffered row
+    can precede it) in one host lexsort instead of a row-at-a-time loser
+    tree (ref algorithm/loser_tree.rs)."""
+    heads: List[Optional[pa.RecordBatch]] = []
+    keys: List[Optional[List[np.ndarray]]] = []
+    for r in runs:
+        rb = next(r, None)
+        heads.append(rb)
+        keys.append(host_sort_keys(rb, key_cols, desc, nf) if rb is not None
+                    else None)
 
-        bs = config.BATCH_SIZE.get()
-        while True:
-            live = [i for i in range(len(runs)) if heads[i] is not None]
-            if not live:
-                return
-            if len(live) == 1:
-                i = live[0]
-                yield self._strip_keys(heads[i])
-                _advance(i)
+    def _advance(i):
+        rb = next(runs[i], None)
+        heads[i] = rb
+        keys[i] = (host_sort_keys(rb, key_cols, desc, nf)
+                   if rb is not None else None)
+
+    bs = config.BATCH_SIZE.get()
+    while True:
+        live = [i for i in range(len(runs)) if heads[i] is not None]
+        if not live:
+            return
+        if len(live) == 1:
+            i = live[0]
+            yield heads[i]
+            _advance(i)
+            continue
+        # threshold = min over live runs of that run's head LAST key
+        # (each run is sorted, so its head's last row is its max)
+        last_tuples = {i: _key_tuple(keys[i], heads[i].num_rows - 1)
+                       for i in live}
+        t_i = min(live, key=lambda i: last_tuples[i])
+        threshold = last_tuples[t_i]
+        take_parts: List[pa.RecordBatch] = []
+        take_keys: List[List[np.ndarray]] = []
+        for i in live:
+            k = keys[i]
+            cnt = _count_leq(k, threshold)
+            if cnt == 0:
                 continue
-            # threshold = min over live runs of that run's head LAST key
-            # (each run is sorted, so its head's last row is its max)
-            last_tuples = {i: _key_tuple(keys[i], heads[i].num_rows - 1)
-                           for i in live}
-            t_i = min(live, key=lambda i: last_tuples[i])
-            threshold = last_tuples[t_i]
-            take_parts: List[pa.RecordBatch] = []
-            take_keys: List[List[np.ndarray]] = []
-            for i in live:
-                k = keys[i]
-                cnt = _count_leq(k, threshold)
-                if cnt == 0:
-                    continue
-                take_parts.append(heads[i].slice(0, cnt))
-                take_keys.append([col[:cnt] for col in k])
-                if cnt == heads[i].num_rows:
-                    _advance(i)
-                else:
-                    heads[i] = heads[i].slice(cnt)
-                    keys[i] = [col[cnt:] for col in keys[i]]
-            merged = pa.Table.from_batches(take_parts).combine_chunks()
-            mk = [np.concatenate([tk[j] for tk in take_keys])
-                  for j in range(len(take_keys[0]))]
-            perm = lexsort_host(mk)
-            out = merged.to_batches()[0].take(pa.array(perm, type=pa.int64()))
-            for off in range(0, out.num_rows, bs):
-                yield self._strip_keys(out.slice(off, min(bs, out.num_rows - off)))
+            take_parts.append(heads[i].slice(0, cnt))
+            take_keys.append([col[:cnt] for col in k])
+            if cnt == heads[i].num_rows:
+                _advance(i)
+            else:
+                heads[i] = heads[i].slice(cnt)
+                keys[i] = [col[cnt:] for col in keys[i]]
+        merged = pa.Table.from_batches(take_parts).combine_chunks()
+        mk = [np.concatenate([tk[j] for tk in take_keys])
+              for j in range(len(take_keys[0]))]
+        perm = lexsort_host(mk)
+        out = merged.to_batches()[0].take(pa.array(perm, type=pa.int64()))
+        for off in range(0, out.num_rows, bs):
+            yield out.slice(off, min(bs, out.num_rows - off))
 
 
 def _is_fixed(t: pa.DataType) -> bool:
