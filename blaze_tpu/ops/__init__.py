@@ -7,10 +7,16 @@ from blaze_tpu.ops.basic import (DebugExec, EmptyPartitionsExec, ExpandExec,
                                  ProjectExec, RenameColumnsExec, UnionExec)
 from blaze_tpu.ops.scan import MemoryScanExec, ParquetScanExec
 from blaze_tpu.ops.sort import SortExec
+from blaze_tpu.ops.agg import AggExec, AggMode, make_agg
+from blaze_tpu.ops.joins import (BroadcastJoinExec, JoinType,
+                                 ShuffledHashJoinExec, SortMergeJoinExec)
 
 __all__ = [
     "BatchIterator", "CoalesceStream", "ExecutionPlan", "coalesce",
     "DebugExec", "EmptyPartitionsExec", "ExpandExec", "FilterExec",
     "FilterProjectExec", "LimitExec", "ProjectExec", "RenameColumnsExec",
     "UnionExec", "MemoryScanExec", "ParquetScanExec", "SortExec",
+    "AggExec", "AggMode", "make_agg",
+    "BroadcastJoinExec", "JoinType", "ShuffledHashJoinExec",
+    "SortMergeJoinExec",
 ]

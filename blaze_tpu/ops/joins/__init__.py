@@ -1,0 +1,8 @@
+"""Joins (ref: datafusion-ext-plans/src/joins/ + broadcast_join_exec.rs)."""
+
+from blaze_tpu.ops.joins.exec import (BaseJoinExec, BroadcastJoinExec,
+                                      JoinMap, JoinType, ShuffledHashJoinExec,
+                                      SortMergeJoinExec, build_join_map)
+
+__all__ = ["BaseJoinExec", "BroadcastJoinExec", "JoinMap", "JoinType",
+           "ShuffledHashJoinExec", "SortMergeJoinExec", "build_join_map"]

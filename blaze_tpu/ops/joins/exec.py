@@ -1,0 +1,392 @@
+"""Equi-joins: sort-merge / shuffled-hash / broadcast, all join types.
+
+Parity: sort_merge_join_exec.rs:397 + joins/smj/{full,semi,existence}_join.rs,
+joins/join_hash_map.rs:277 JoinHashMap, broadcast_join_exec.rs:695 (SHJ and
+BHJ share probe code), broadcast_join_build_hash_map_exec.rs (build map made
+once per broadcast, cached via the resource map).
+
+TPU-first redesign (SURVEY.md §7 step 6): instead of a pointer-chasing hash
+map, the build side becomes a HASH-SORTED table: device xxhash64 over the
+join keys, device sort by hash.  Probing is vectorized searchsorted over the
+sorted hashes (binary search lowers to fused gathers), candidate pairs expand
+host-side with numpy (data-dependent sizes live on host, the static-shape
+boundary), and every candidate verifies actual key equality — hash collisions
+cannot produce wrong results.  All three exec flavors share this probe core,
+mirroring how the reference shares probe code between SHJ and BHJ.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu import config
+from blaze_tpu.batch import ColumnBatch, round_capacity
+from blaze_tpu.bridge.resource import get_or_create
+from blaze_tpu.exprs import PhysicalExpr
+from blaze_tpu.kernels import hashing as H
+from blaze_tpu.ops.base import BatchIterator, CoalesceStream, ExecutionPlan
+from blaze_tpu.schema import BOOL, Field, Schema, TypeId
+
+
+class JoinType(enum.Enum):
+    INNER = "inner"
+    LEFT = "left"            # left outer
+    RIGHT = "right"          # right outer
+    FULL = "full"
+    LEFT_SEMI = "left_semi"
+    LEFT_ANTI = "left_anti"
+    RIGHT_SEMI = "right_semi"
+    RIGHT_ANTI = "right_anti"
+    EXISTENCE = "existence"  # left rows + bool `exists` column
+
+
+def _device_hash_keys(batch: ColumnBatch, key_exprs: Sequence[PhysicalExpr]
+                      ) -> Tuple[np.ndarray, np.ndarray, List[pa.Array]]:
+    """(hash int64[num_rows], any_null bool[num_rows], key arrays host)."""
+    n = batch.num_rows
+    cols = []
+    key_arrays = []
+    any_null = np.zeros(n, dtype=bool)
+    for e in key_exprs:
+        v = e.evaluate(batch)
+        arr = v.to_host(n)
+        key_arrays.append(arr)
+        if v.is_device:
+            cols.append((v.data, v.validity, _tid(v.dtype)))
+            any_null |= ~np.asarray(v.validity)[:n]
+        else:
+            (mat, lengths), valid = H.string_column_to_padded_bytes(arr)
+            cols.append(((jnp.asarray(mat), jnp.asarray(lengths)),
+                         jnp.asarray(_pad(valid, mat.shape[0])), "utf8"))
+            any_null |= ~valid
+    h = H.hash_columns(cols, seed=42, xp=jnp, algo="xxhash64")
+    return np.asarray(h)[:n], any_null, key_arrays
+
+
+def _pad(v: np.ndarray, n: int) -> np.ndarray:
+    if len(v) == n:
+        return v
+    out = np.zeros(n, dtype=v.dtype)
+    out[:len(v)] = v
+    return out
+
+
+def _tid(dtype) -> str:
+    return dtype.id.value
+
+
+class JoinMap:
+    """Hash-sorted build table (the JoinHashMap analog, join_hash_map.rs:277)."""
+
+    def __init__(self, table: pa.Table, key_exprs: Sequence[PhysicalExpr],
+                 schema: Schema):
+        self.table = table.combine_chunks()
+        self.schema = schema
+        n = self.table.num_rows
+        if n:
+            cb = ColumnBatch.from_arrow(self.table)
+            hashes, any_null, self.key_arrays = _device_hash_keys(cb, key_exprs)
+            # null keys never match: give them a reserved hash bucket we skip
+            self._valid = ~any_null
+            order = np.argsort(hashes, kind="stable")
+            self.sorted_hashes = hashes[order]
+            self.sorted_idx = order
+        else:
+            self._valid = np.zeros(0, dtype=bool)
+            self.sorted_hashes = np.zeros(0, dtype=np.int64)
+            self.sorted_idx = np.zeros(0, dtype=np.int64)
+            self.key_arrays = []
+        self.matched = np.zeros(n, dtype=bool)  # for right/full outer
+
+    @property
+    def num_rows(self) -> int:
+        return self.table.num_rows
+
+    def lookup(self, probe_hashes: np.ndarray, probe_null: np.ndarray,
+               probe_keys: List[pa.Array]
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Candidate-verified (probe_idx, build_idx) pair arrays."""
+        n = len(probe_hashes)
+        if self.num_rows == 0 or n == 0:
+            return (np.zeros(0, dtype=np.int64),) * 2
+        lo = np.searchsorted(self.sorted_hashes, probe_hashes, "left")
+        hi = np.searchsorted(self.sorted_hashes, probe_hashes, "right")
+        counts = np.where(probe_null, 0, hi - lo)
+        total = int(counts.sum())
+        if total == 0:
+            return (np.zeros(0, dtype=np.int64),) * 2
+        probe_idx = np.repeat(np.arange(n, dtype=np.int64), counts)
+        starts = np.repeat(lo, counts)
+        offs = np.arange(total, dtype=np.int64) - \
+            np.repeat(np.cumsum(counts) - counts, counts)
+        build_idx = self.sorted_idx[starts + offs]
+        # drop null-key build rows, then verify true equality per key column
+        keep = self._valid[build_idx]
+        for pk, bk in zip(probe_keys, self.key_arrays):
+            if not keep.any():
+                break
+            pe = pk.take(pa.array(probe_idx, type=pa.int64()))
+            be = bk.take(pa.array(build_idx, type=pa.int64()))
+            eq = pc.equal(pe, be).fill_null(False)
+            keep &= np.asarray(eq)
+        return probe_idx[keep], build_idx[keep]
+
+
+def build_join_map(batches: Iterator[pa.RecordBatch], schema: Schema,
+                   key_exprs: Sequence[PhysicalExpr]) -> JoinMap:
+    blist = list(batches)
+    table = (pa.Table.from_batches(blist) if blist
+             else pa.Table.from_batches([], schema=schema.to_arrow()))
+    return JoinMap(table, key_exprs, schema)
+
+
+class BaseJoinExec(ExecutionPlan):
+    """Shared probe core.  `build_side` names which child is materialized."""
+
+    def __init__(self, left: ExecutionPlan, right: ExecutionPlan,
+                 left_keys: Sequence[PhysicalExpr],
+                 right_keys: Sequence[PhysicalExpr],
+                 join_type: JoinType,
+                 build_side: str = "right",
+                 join_filter: Optional[PhysicalExpr] = None,
+                 existence_col: str = "exists"):
+        super().__init__([left, right])
+        assert build_side in ("left", "right")
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        self.build_side = build_side
+        self.join_filter = join_filter
+        self._existence_col = existence_col
+        self._out_schema = self._build_schema()
+
+    # -- schema -------------------------------------------------------------
+    def _build_schema(self) -> Schema:
+        l, r = self.children[0].schema, self.children[1].schema
+        jt = self.join_type
+        if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            return l
+        if jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI):
+            return r
+        if jt == JoinType.EXISTENCE:
+            return Schema(list(l) + [Field(self._existence_col, BOOL, False)])
+        fields = []
+        for f in l:
+            nullable = f.nullable or jt in (JoinType.RIGHT, JoinType.FULL)
+            fields.append(Field(f.name, f.data_type, nullable))
+        for f in r:
+            nullable = f.nullable or jt in (JoinType.LEFT, JoinType.FULL)
+            fields.append(Field(f.name, f.data_type, nullable))
+        return Schema(fields)
+
+    @property
+    def schema(self) -> Schema:
+        return self._out_schema
+
+    @property
+    def num_partitions(self) -> int:
+        probe = 0 if self.build_side == "right" else 1
+        return self.children[probe].num_partitions
+
+    # -- build-side acquisition (overridden by BroadcastJoinExec) ----------
+    def _get_join_map(self, partition: int) -> JoinMap:
+        build = 1 if self.build_side == "right" else 0
+        child = self.children[build]
+        stream = (b.compact().to_arrow() for b in child.execute(partition))
+        keys = self.right_keys if build == 1 else self.left_keys
+        return build_join_map(stream, child.schema, keys)
+
+    # -- execution ----------------------------------------------------------
+    def execute(self, partition: int) -> BatchIterator:
+        jmap = self._get_join_map(partition)
+        probe_is_left = self.build_side == "right"
+        probe = self.children[0 if probe_is_left else 1]
+        probe_keys = self.left_keys if probe_is_left else self.right_keys
+
+        def gen():
+            for batch in probe.execute(partition):
+                batch = batch.compact()
+                if batch.num_rows == 0:
+                    continue
+                yield from self._probe_batch(jmap, batch, probe_keys,
+                                             probe_is_left)
+            yield from self._emit_unmatched_build(jmap, probe_is_left)
+        return iter(CoalesceStream(gen(), metrics=self.metrics))
+
+    # -- probe one batch ----------------------------------------------------
+    def _probe_batch(self, jmap: JoinMap, batch: ColumnBatch,
+                     probe_keys: Sequence[PhysicalExpr], probe_is_left: bool
+                     ) -> Iterator[ColumnBatch]:
+        n = batch.num_rows
+        hashes, any_null, key_arrays = _device_hash_keys(batch, probe_keys)
+        p_idx, b_idx = jmap.lookup(hashes, any_null, key_arrays)
+        probe_rb = batch.to_arrow()
+
+        if self.join_filter is not None and len(p_idx):
+            mask = self._apply_filter(probe_rb, jmap, p_idx, b_idx,
+                                      probe_is_left)
+            p_idx, b_idx = p_idx[mask], b_idx[mask]
+
+        jt = self.join_type
+        jmap.matched[b_idx] = True
+        match_count = np.bincount(p_idx, minlength=n)
+
+        probe_semi = ((jt == JoinType.LEFT_SEMI and probe_is_left) or
+                      (jt == JoinType.RIGHT_SEMI and not probe_is_left))
+        probe_anti = ((jt == JoinType.LEFT_ANTI and probe_is_left) or
+                      (jt == JoinType.RIGHT_ANTI and not probe_is_left))
+        if probe_semi or probe_anti:
+            keep = np.nonzero(match_count > 0 if probe_semi
+                              else match_count == 0)[0]
+            if len(keep):
+                yield ColumnBatch.from_arrow(
+                    probe_rb.take(pa.array(keep, type=pa.int64())))
+            return
+        if jt in (JoinType.LEFT_SEMI, JoinType.RIGHT_SEMI,
+                  JoinType.LEFT_ANTI, JoinType.RIGHT_ANTI):
+            # semi/anti of the BUILD side: probe only records matches;
+            # emission happens in _emit_unmatched_build
+            return
+        if jt == JoinType.EXISTENCE:
+            arrays = list(probe_rb.columns) + \
+                [pa.array(match_count > 0, type=pa.bool_())]
+            yield ColumnBatch.from_arrow(pa.RecordBatch.from_arrays(
+                arrays, schema=self.schema.to_arrow()))
+            return
+
+        # inner/outer: matched pairs
+        outer_probe = (jt == JoinType.FULL or
+                       (jt == JoinType.LEFT and probe_is_left) or
+                       (jt == JoinType.RIGHT and not probe_is_left))
+        if outer_probe:
+            un = np.nonzero(match_count == 0)[0]
+            if len(un):
+                p_idx = np.concatenate([p_idx, un])
+                b_idx = np.concatenate([b_idx,
+                                        np.full(len(un), -1, dtype=np.int64)])
+        if not len(p_idx):
+            return
+        yield self._materialize(probe_rb, jmap, p_idx, b_idx, probe_is_left)
+
+    def _apply_filter(self, probe_rb, jmap: JoinMap, p_idx, b_idx,
+                      probe_is_left) -> np.ndarray:
+        joined = self._joined_batch(probe_rb, jmap, p_idx, b_idx,
+                                    probe_is_left, allow_missing=False)
+        v = self.join_filter.evaluate(joined)
+        return np.asarray(v.as_mask(joined))[:joined.num_rows]
+
+    def _joined_batch(self, probe_rb, jmap, p_idx, b_idx, probe_is_left,
+                      allow_missing=True) -> ColumnBatch:
+        pt = probe_rb.take(pa.array(p_idx, type=pa.int64()))
+        bi = pa.array(b_idx, type=pa.int64())
+        if jmap.num_rows == 0:
+            bt_cols = [pa.nulls(len(b_idx), f.data_type.to_arrow())
+                       for f in jmap.schema]
+        elif allow_missing and (b_idx < 0).any():
+            bi = pa.array(np.where(b_idx < 0, 0, b_idx), type=pa.int64())
+            bt = jmap.table.take(bi)
+            null_mask = b_idx < 0
+            bt_cols = [_null_out(c, null_mask) for c in bt.columns]
+        else:
+            bt = jmap.table.take(bi)
+            bt_cols = [c.combine_chunks() if isinstance(c, pa.ChunkedArray)
+                       else c for c in bt.columns]
+        left_cols = (list(pt.columns) if probe_is_left else bt_cols)
+        right_cols = (bt_cols if probe_is_left else list(pt.columns))
+        arrays = left_cols + right_cols
+        out_schema = self.schema if self.join_type in (
+            JoinType.INNER, JoinType.LEFT, JoinType.RIGHT, JoinType.FULL) \
+            else Schema(list(self.children[0].schema) +
+                        list(self.children[1].schema))
+        arrays = [a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
+                  for a in arrays]
+        rb = pa.RecordBatch.from_arrays(
+            [a.cast(f.data_type.to_arrow(), safe=False)
+             if not a.type.equals(f.data_type.to_arrow()) else a
+             for a, f in zip(arrays, out_schema)],
+            schema=out_schema.to_arrow())
+        return ColumnBatch.from_arrow(rb)
+
+    def _materialize(self, probe_rb, jmap, p_idx, b_idx, probe_is_left
+                     ) -> ColumnBatch:
+        out = self._joined_batch(probe_rb, jmap, p_idx, b_idx, probe_is_left)
+        self.metrics.add("output_rows", out.num_rows)
+        return out
+
+    def _emit_unmatched_build(self, jmap: JoinMap, probe_is_left: bool
+                              ) -> Iterator[ColumnBatch]:
+        jt = self.join_type
+        build_outer = (jt == JoinType.FULL or
+                       (jt == JoinType.RIGHT and probe_is_left) or
+                       (jt == JoinType.LEFT and not probe_is_left))
+        build_semi = ((jt == JoinType.RIGHT_SEMI and probe_is_left) or
+                      (jt == JoinType.LEFT_SEMI and not probe_is_left))
+        build_anti = ((jt == JoinType.RIGHT_ANTI and probe_is_left) or
+                      (jt == JoinType.LEFT_ANTI and not probe_is_left))
+        if build_semi or build_anti:
+            want = jmap.matched if build_semi else ~jmap.matched
+            idx = np.nonzero(want)[0]
+            if len(idx):
+                rb = jmap.table.take(pa.array(idx, type=pa.int64())) \
+                    .combine_chunks()
+                yield ColumnBatch.from_arrow(rb.to_batches()[0])
+            return
+        if not build_outer or jmap.num_rows == 0:
+            return
+        idx = np.nonzero(~jmap.matched)[0]
+        if not len(idx):
+            return
+        bt = jmap.table.take(pa.array(idx, type=pa.int64()))
+        probe_schema = self.children[0 if probe_is_left else 1].schema
+        null_probe = [pa.nulls(len(idx), f.data_type.to_arrow())
+                      for f in probe_schema]
+        bt_cols = [c.combine_chunks() if isinstance(c, pa.ChunkedArray) else c
+                   for c in bt.columns]
+        arrays = (null_probe + bt_cols) if probe_is_left else \
+            (bt_cols + null_probe)
+        rb = pa.RecordBatch.from_arrays(arrays, schema=self.schema.to_arrow())
+        self.metrics.add("output_rows", rb.num_rows)
+        yield ColumnBatch.from_arrow(rb)
+
+
+def _null_out(col, null_mask: np.ndarray) -> pa.Array:
+    col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    return pc.if_else(pa.array(~null_mask), col,
+                      pa.nulls(len(col), col.type))
+
+
+class SortMergeJoinExec(BaseJoinExec):
+    """SMJ parity node (ref sort_merge_join_exec.rs:397).  Children arrive
+    key-sorted from SortExec; the probe core is order-agnostic so the sort
+    is exploited only by upstream operators, not required here."""
+
+
+class ShuffledHashJoinExec(BaseJoinExec):
+    """SHJ parity node: build side = one shuffled partition."""
+
+
+class BroadcastJoinExec(BaseJoinExec):
+    """BHJ: build side materialized once per broadcast and cached in the
+    resource map (ref broadcast_join_exec.rs:695 cached_build_hash_map)."""
+
+    def __init__(self, *args, broadcast_id: Optional[str] = None, **kw):
+        super().__init__(*args, **kw)
+        self._broadcast_id = broadcast_id or f"bhj-{id(self)}"
+
+    def _get_join_map(self, partition: int) -> JoinMap:
+        def factory():
+            build = 1 if self.build_side == "right" else 0
+            child = self.children[build]
+            keys = self.right_keys if build == 1 else self.left_keys
+            batches = []
+            for p in range(child.num_partitions):
+                batches.extend(b.compact().to_arrow()
+                               for b in child.execute(p))
+            return build_join_map(iter(batches), child.schema, keys)
+        return get_or_create(f"join_map://{self._broadcast_id}", factory)

@@ -1,0 +1,155 @@
+"""Join tests over the join-type matrix, modeled on the reference's
+joins/test.rs (1,249 LoC SMJ/BHJ/SHJ x join-type matrix, SURVEY.md §4)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import schema as S
+from blaze_tpu.exprs import BinaryExpr, col, lit
+from blaze_tpu.memory import MemManager
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.ops.joins import (BroadcastJoinExec, JoinType,
+                                 ShuffledHashJoinExec, SortMergeJoinExec)
+
+
+@pytest.fixture(autouse=True)
+def budget():
+    MemManager.init(4 << 30)
+
+
+LEFT = pa.table({
+    "lk": pa.array([1, 2, 3, 4, None, 2], type=pa.int64()),
+    "lv": pa.array(["a", "b", "c", "d", "e", "f"]),
+})
+RIGHT = pa.table({
+    "rk": pa.array([2, 2, 3, 5, None], type=pa.int64()),
+    "rv": pa.array([20, 21, 30, 50, 99], type=pa.int64()),
+})
+
+
+def join(how, left=LEFT, right=RIGHT, cls=ShuffledHashJoinExec,
+         build_side="right", flt=None):
+    plan = cls(MemoryScanExec.from_arrow(left),
+               MemoryScanExec.from_arrow(right),
+               [col(0, "lk")], [col(0, "rk")], how,
+               build_side=build_side, join_filter=flt)
+    got = plan.execute_collect().to_arrow()
+    return got
+
+
+def rows(tbl, cols=None):
+    names = cols or tbl.schema.names
+    data = [tbl.column(n).to_pylist() for n in names]
+    return sorted(zip(*data), key=lambda r: tuple((x is None, x) for x in r))
+
+
+def test_inner_join():
+    got = join(JoinType.INNER)
+    assert rows(got, ["lk", "lv", "rv"]) == sorted([
+        (2, "b", 20), (2, "b", 21), (2, "f", 20), (2, "f", 21), (3, "c", 30)])
+
+
+def test_left_outer():
+    got = join(JoinType.LEFT)
+    r = rows(got, ["lk", "lv", "rv"])
+    want = sorted([(2, "b", 20), (2, "b", 21), (2, "f", 20), (2, "f", 21),
+                   (3, "c", 30), (1, "a", None), (4, "d", None),
+                   (None, "e", None)],
+                  key=lambda t: tuple((x is None, x) for x in t))
+    assert r == want
+
+
+def test_right_outer():
+    got = join(JoinType.RIGHT)
+    r = rows(got, ["lk", "rk", "rv"])
+    want = sorted([(2, 2, 20), (2, 2, 21), (2, 2, 20), (2, 2, 21),
+                   (3, 3, 30), (None, 5, 50), (None, None, 99)],
+                  key=lambda t: tuple((x is None, x) for x in t))
+    assert r == want
+
+
+def test_full_outer():
+    got = join(JoinType.FULL)
+    assert got.num_rows == 5 + 3 + 2  # matches + unmatched left + unmatched right
+
+
+def test_left_semi_and_anti():
+    semi = join(JoinType.LEFT_SEMI)
+    assert sorted(semi.column("lv").to_pylist()) == ["b", "c", "f"]
+    anti = join(JoinType.LEFT_ANTI)
+    assert sorted(anti.column("lv").to_pylist()) == ["a", "d", "e"]
+
+
+def test_right_semi_and_anti():
+    semi = join(JoinType.RIGHT_SEMI)
+    assert sorted(semi.column("rv").to_pylist()) == [20, 21, 30]
+    anti = join(JoinType.RIGHT_ANTI)
+    assert sorted(anti.column("rv").to_pylist()) == [50, 99]
+
+
+def test_existence_join():
+    got = join(JoinType.EXISTENCE)
+    d = dict(zip(got.column("lv").to_pylist(),
+                 got.column("exists").to_pylist()))
+    assert d == {"a": False, "b": True, "c": True, "d": False, "e": False,
+                 "f": True}
+
+
+def test_join_filter():
+    # inner join with residual filter rv > 20
+    flt = BinaryExpr(">", col(3, "rv"), lit(20))
+    got = join(JoinType.INNER, flt=flt)
+    assert rows(got, ["lk", "lv", "rv"]) == sorted([
+        (2, "b", 21), (2, "f", 21), (3, "c", 30)])
+
+
+def test_broadcast_join_build_left():
+    got = join(JoinType.INNER, cls=BroadcastJoinExec, build_side="left")
+    assert got.num_rows == 5
+
+
+def test_string_keys_join():
+    l = pa.table({"k": pa.array(["x", "y", None, "z"]),
+                  "v": pa.array([1, 2, 3, 4])})
+    r = pa.table({"k": pa.array(["y", "z", "z", None]),
+                  "w": pa.array([20, 30, 31, 40])})
+    plan = ShuffledHashJoinExec(
+        MemoryScanExec.from_arrow(l), MemoryScanExec.from_arrow(r),
+        [col(0, "k")], [col(0, "k")], JoinType.INNER)
+    got = plan.execute_collect().to_arrow()
+    assert sorted(zip(got.column(1).to_pylist(),
+                      got.column(3).to_pylist())) == \
+        [(2, 20), (4, 30), (4, 31)]
+
+
+def test_join_fuzz_vs_pandas():
+    rng = np.random.default_rng(5)
+    n, m = 3000, 2000
+    l = pa.table({"k": pa.array(rng.integers(0, 500, n)),
+                  "a": pa.array(rng.random(n))})
+    r = pa.table({"k": pa.array(rng.integers(0, 500, m)),
+                  "b": pa.array(rng.random(m))})
+    for how, pd_how in [(JoinType.INNER, "inner"), (JoinType.LEFT, "left"),
+                        (JoinType.FULL, "outer")]:
+        plan = SortMergeJoinExec(
+            MemoryScanExec.from_arrow(l, batch_rows=512),
+            MemoryScanExec.from_arrow(r, batch_rows=512),
+            [col(0)], [col(0)], how)
+        got = plan.execute_collect().to_arrow()
+        want = l.to_pandas().merge(r.to_pandas(), on="k", how=pd_how)
+        assert got.num_rows == len(want), how
+        assert got.column("a").null_count == want.a.isna().sum()
+
+
+def test_empty_sides():
+    empty_r = RIGHT.slice(0, 0)
+    got = join(JoinType.INNER, right=empty_r)
+    assert got.num_rows == 0
+    got2 = join(JoinType.LEFT, right=empty_r)
+    assert got2.num_rows == 6
+    assert got2.column("rv").null_count == 6
+    empty_l = LEFT.slice(0, 0)
+    got3 = join(JoinType.FULL, left=empty_l)
+    assert got3.num_rows == 5
