@@ -6,6 +6,22 @@ Ref: datafusion-ext-plans/src/shuffle/ + io/ipc_compression.rs.
 from blaze_tpu.shuffle.ipc import (IpcCompressionReader, IpcCompressionWriter,
                                    read_batches_from_bytes,
                                    write_batches_to_bytes)
+from blaze_tpu.shuffle.partitioning import (HashPartitioning, Partitioning,
+                                            RangePartitioning,
+                                            RoundRobinPartitioning,
+                                            SinglePartitioning,
+                                            sample_range_bounds)
+from blaze_tpu.shuffle.reader import (FFIReaderExec, FileSegmentBlock,
+                                      IpcReaderExec, IpcWriterExec)
+from blaze_tpu.shuffle.writer import (RssShuffleWriterExec,
+                                      ShuffleRepartitioner, ShuffleWriterExec)
+from blaze_tpu.shuffle.exchange import LocalShuffleExchange, read_index_file
 
 __all__ = ["IpcCompressionReader", "IpcCompressionWriter",
-           "read_batches_from_bytes", "write_batches_to_bytes"]
+           "read_batches_from_bytes", "write_batches_to_bytes",
+           "HashPartitioning", "Partitioning", "RangePartitioning",
+           "RoundRobinPartitioning", "SinglePartitioning",
+           "sample_range_bounds",
+           "FFIReaderExec", "FileSegmentBlock", "IpcReaderExec",
+           "IpcWriterExec", "RssShuffleWriterExec", "ShuffleRepartitioner",
+           "ShuffleWriterExec", "LocalShuffleExchange", "read_index_file"]

@@ -1,0 +1,100 @@
+"""Local shuffle exchange: stage boundary without a cluster.
+
+The reference relies on Spark's BlockManager for transport; in spark-local
+mode the full native write/read path is still exercised through real files
+(SURVEY.md §4 'multi-node without a cluster').  LocalShuffleExchange is that
+analog: map partitions write .data/.index via ShuffleWriterExec, reduce
+partitions read their file segments via IpcReaderExec — same files, same
+frames, same index contract as the distributed deployment.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+import uuid
+from typing import List, Optional
+
+from blaze_tpu.bridge.context import TaskContext, task_scope
+from blaze_tpu.bridge.resource import put_resource, remove_resource
+from blaze_tpu.ops.base import ExecutionPlan
+from blaze_tpu.schema import Schema
+from blaze_tpu.shuffle.partitioning import Partitioning
+from blaze_tpu.shuffle.reader import FileSegmentBlock, IpcReaderExec
+from blaze_tpu.shuffle.writer import ShuffleWriterExec
+
+
+def read_index_file(path: str) -> List[int]:
+    """Cumulative offsets (ref AuronShuffleWriterBase.scala:68-78)."""
+    out = []
+    with open(path, "rb") as f:
+        data = f.read()
+    for i in range(0, len(data), 8):
+        out.append(struct.unpack_from("<q", data, i)[0])
+    return out
+
+
+class LocalShuffleExchange(ExecutionPlan):
+    """Materializing exchange: runs all map tasks on first reduce pull."""
+
+    def __init__(self, child: ExecutionPlan, partitioning: Partitioning,
+                 work_dir: Optional[str] = None, stage_id: int = 0):
+        super().__init__([child])
+        self.partitioning = partitioning
+        self.stage_id = stage_id
+        self._dir = work_dir or tempfile.mkdtemp(prefix="blaze-exchange-")
+        self._shuffle_id = uuid.uuid4().hex[:12]
+        self._materialized = False
+        self._map_outputs: List[tuple] = []  # (data_file, offsets)
+        self.reader = IpcReaderExec(
+            f"shuffle://{self._shuffle_id}", child.schema,
+            partitioning.num_partitions)
+        self.reader._children = []  # standalone reader node
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioning.num_partitions
+
+    def _materialize(self) -> None:
+        if self._materialized:
+            return
+        child = self.children[0]
+        for map_id in range(child.num_partitions):
+            data = os.path.join(self._dir,
+                                f"shuffle-{self._shuffle_id}-{map_id}.data")
+            index = data.replace(".data", ".index")
+            writer = ShuffleWriterExec(child, self.partitioning, data, index)
+            writer.metrics = self.metrics  # surface write metrics here
+            with task_scope(TaskContext(stage_id=self.stage_id,
+                                        partition_id=map_id,
+                                        num_partitions=child.num_partitions)):
+                list(writer.execute(map_id))
+            self._map_outputs.append((data, read_index_file(index)))
+
+        def blocks_for(reduce_id: int):
+            for data, offsets in self._map_outputs:
+                length = offsets[reduce_id + 1] - offsets[reduce_id]
+                if length:
+                    yield FileSegmentBlock(data, offsets[reduce_id], length)
+        put_resource(f"shuffle://{self._shuffle_id}", blocks_for)
+        self._materialized = True
+
+    def execute(self, partition: int):
+        self._materialize()
+        return self.reader.execute(partition)
+
+    def cleanup(self) -> None:
+        remove_resource(f"shuffle://{self._shuffle_id}")
+        for data, _ in self._map_outputs:
+            for p in (data, data.replace(".data", ".index")):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        self._map_outputs = []
+        self._materialized = False

@@ -1,0 +1,136 @@
+"""Partitioning schemes: hash / round-robin / single / range.
+
+Parity: shuffle/mod.rs:113-123 (Partitioning enum) and the Spark-compatible
+partition id computation `pmod(murmur3(cols, seed=42), n)`
+(ref shuffle/mod.rs:164-189) — bit-exact with Spark's HashPartitioning so a
+native map stage can feed vanilla Spark reducers and vice versa.  Range
+partitioning uses driver-sampled bounds rows compared via the same host
+order-key encoding as sort (ref NativeShuffleExchangeBase.scala:313
+rangePartitioningBound + evaluate_range_partition_ids).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.bridge.context import current_task
+from blaze_tpu.exprs import PhysicalExpr
+from blaze_tpu.kernels import hashing as H
+
+
+class Partitioning:
+    num_partitions: int = 1
+
+    def partition_ids(self, batch: ColumnBatch) -> np.ndarray:
+        """int32 partition id per (selected) row; batch must be compact."""
+        raise NotImplementedError
+
+
+@dataclass
+class SinglePartitioning(Partitioning):
+    num_partitions: int = 1
+
+    def partition_ids(self, batch: ColumnBatch) -> np.ndarray:
+        return np.zeros(batch.num_rows, dtype=np.int32)
+
+
+class HashPartitioning(Partitioning):
+    def __init__(self, exprs: Sequence[PhysicalExpr], num_partitions: int):
+        self.exprs = list(exprs)
+        self.num_partitions = num_partitions
+
+    def partition_ids(self, batch: ColumnBatch) -> np.ndarray:
+        n = batch.num_rows
+        cols = []
+        for e in self.exprs:
+            v = e.evaluate(batch)
+            if v.is_device:
+                cols.append((v.data, v.validity, v.dtype.id.value))
+            else:
+                arr = v.to_host(n)
+                (mat, lengths), valid = H.string_column_to_padded_bytes(arr)
+                pad_valid = np.zeros(mat.shape[0], dtype=bool)
+                pad_valid[:len(valid)] = valid
+                cols.append(((jnp.asarray(mat), jnp.asarray(lengths)),
+                             jnp.asarray(pad_valid), "utf8"))
+        h = H.hash_columns(cols, seed=42, xp=jnp, algo="murmur3")
+        pids = H.pmod(h, self.num_partitions, xp=jnp)
+        return np.asarray(pids)[:n].astype(np.int32)
+
+
+class RoundRobinPartitioning(Partitioning):
+    def __init__(self, num_partitions: int):
+        self.num_partitions = num_partitions
+        self._next = 0
+
+    def partition_ids(self, batch: ColumnBatch) -> np.ndarray:
+        n = batch.num_rows
+        # Spark RoundRobin starts at a per-task position; keep a running
+        # cursor so rows spread evenly across batches
+        ids = (np.arange(n, dtype=np.int64) + self._next) % self.num_partitions
+        self._next = int((self._next + n) % self.num_partitions)
+        return ids.astype(np.int32)
+
+
+class RangePartitioning(Partitioning):
+    """Bounds rows (one per cut, sorted) decide the partition id via
+    binary search on host order keys."""
+
+    def __init__(self, sort_exprs: Sequence[Tuple[PhysicalExpr, bool, bool]],
+                 num_partitions: int, bounds: pa.RecordBatch):
+        self.sort_exprs = list(sort_exprs)
+        self.num_partitions = num_partitions
+        self.bounds = bounds  # num_partitions-1 rows, columns match sort keys
+        from blaze_tpu.ops.sort import host_sort_keys
+        self._bound_keys = host_sort_keys(
+            bounds, list(range(bounds.num_columns)),
+            [d for _, d, _ in self.sort_exprs],
+            [f for _, _, f in self.sort_exprs])
+
+    def partition_ids(self, batch: ColumnBatch) -> np.ndarray:
+        from blaze_tpu.ops.sort import host_sort_keys
+        n = batch.num_rows
+        arrays = [e.evaluate(batch).to_host(n)
+                  for e, _, _ in self.sort_exprs]
+        rb = pa.RecordBatch.from_arrays(
+            arrays, names=[f"k{i}" for i in range(len(arrays))])
+        row_keys = host_sort_keys(rb, list(range(len(arrays))),
+                                  [d for _, d, _ in self.sort_exprs],
+                                  [f for _, _, f in self.sort_exprs])
+        # id = count of bounds STRICTLY below the row (ties stay in the
+        # bound's own partition, matching Spark RangePartitioner)
+        nb = len(self._bound_keys[0])
+        ids = np.zeros(n, dtype=np.int32)
+        for b in range(nb):
+            gt = np.zeros(n, dtype=bool)
+            for j in range(len(row_keys) - 1, -1, -1):
+                bk = self._bound_keys[j][b]
+                rk = row_keys[j]
+                gt = (rk > bk) | ((rk == bk) & gt)
+            ids += gt.astype(np.int32)
+        return ids
+
+
+def sample_range_bounds(sample: pa.Table,
+                        sort_exprs: Sequence[Tuple[PhysicalExpr, bool, bool]],
+                        num_partitions: int,
+                        key_names: Sequence[str]) -> pa.RecordBatch:
+    """Driver-side bounds sampling (the rangePartitioningBound analog):
+    sort the sample, pick num_partitions-1 evenly spaced rows."""
+    from blaze_tpu.ops import MemoryScanExec, SortExec
+    scan = MemoryScanExec.from_arrow(sample)
+    plan = SortExec(scan, sort_exprs)
+    sorted_rb = plan.execute_collect().to_arrow()
+    n = sorted_rb.num_rows
+    cuts = [int(n * (i + 1) / num_partitions) for i in range(num_partitions - 1)]
+    cuts = [min(c, n - 1) for c in cuts]
+    idx = pa.array(cuts, type=pa.int64())
+    cols = [sorted_rb.column(sorted_rb.schema.get_field_index(k)).take(idx)
+            for k in key_names]
+    return pa.RecordBatch.from_arrays(cols, names=list(key_names))

@@ -1,0 +1,138 @@
+"""Shuffle read + collect operators.
+
+Parity: ipc_reader_exec.rs:47 (pulls BlockObjects registered by the engine's
+reader in the resource map — file segments / byte buffers / channels,
+:277-359), ipc_writer_exec.rs (collect-to-driver IPC stream), and
+ffi_reader_exec.rs (row-to-columnar input imported over Arrow FFI; here the
+in-process analog imports an iterator of Arrow batches).
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+from typing import BinaryIO, Callable, Iterator, List, Optional, Union
+
+import pyarrow as pa
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.bridge.resource import get_resource
+from blaze_tpu.ops.base import BatchIterator, CoalesceStream, ExecutionPlan
+from blaze_tpu.schema import Schema
+from blaze_tpu.shuffle.ipc import IpcCompressionReader, IpcCompressionWriter
+
+
+@dataclass
+class FileSegmentBlock:
+    """(path, offset, length) — the FileSegment fast path
+    (ref ipc_reader_exec.rs:277)."""
+
+    path: str
+    offset: int
+    length: int
+
+
+Block = Union[FileSegmentBlock, bytes, BinaryIO]
+
+
+def read_block(block: Block) -> Iterator[pa.RecordBatch]:
+    if isinstance(block, FileSegmentBlock):
+        if block.length == 0:
+            return
+        with open(block.path, "rb") as f:
+            f.seek(block.offset)
+            yield from IpcCompressionReader(f, limit=block.length).read_batches()
+    elif isinstance(block, (bytes, bytearray, memoryview)):
+        yield from IpcCompressionReader(io.BytesIO(block)).read_batches()
+    else:  # file-like channel
+        yield from IpcCompressionReader(block).read_batches()
+
+
+class IpcReaderExec(ExecutionPlan):
+    """Reads shuffle blocks for this partition from the resource map.
+
+    The resource value is either an iterator/list of Blocks, or a callable
+    `partition -> iterable of Blocks` (the per-reduce-task registration
+    pattern of AuronBlockStoreShuffleReaderBase.scala:29-66).
+    """
+
+    def __init__(self, resource_id: str, schema: Schema,
+                 num_partitions: int = 1):
+        super().__init__()
+        self.resource_id = resource_id
+        self._schema = schema
+        self._num_partitions = num_partitions
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def execute(self, partition: int) -> BatchIterator:
+        source = get_resource(self.resource_id)
+        if source is None:
+            raise KeyError(f"shuffle resource {self.resource_id!r} not found")
+        blocks = source(partition) if callable(source) else source
+        def gen():
+            for block in blocks:
+                for rb in read_block(block):
+                    self.metrics.add("output_rows", rb.num_rows)
+                    yield ColumnBatch.from_arrow(rb)
+        return iter(CoalesceStream(gen(), metrics=self.metrics))
+
+
+class IpcWriterExec(ExecutionPlan):
+    """Writes the child stream as framed IPC into a host sink — the
+    collect()-to-driver path (ref ipc_writer_exec.rs)."""
+
+    def __init__(self, child: ExecutionPlan,
+                 sink_factory: Callable[[int], BinaryIO]):
+        super().__init__([child])
+        self._sink_factory = sink_factory
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int) -> BatchIterator:
+        sink = self._sink_factory(partition)
+        w = IpcCompressionWriter(sink)
+        for batch in self.children[0].execute(partition):
+            rb = batch.compact().to_arrow()
+            if rb.num_rows:
+                w.write_batch(rb)
+        w.finish()
+        return iter(())
+
+
+class FFIReaderExec(ExecutionPlan):
+    """Imports host-exported Arrow batches (the ConvertToNative path,
+    ref ffi_reader_exec.rs; in-process, 'FFI' is a zero-copy handoff of
+    pyarrow batches through the resource map)."""
+
+    def __init__(self, resource_id: str, schema: Schema,
+                 num_partitions: int = 1):
+        super().__init__()
+        self.resource_id = resource_id
+        self._schema = schema
+        self._num_partitions = num_partitions
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def execute(self, partition: int) -> BatchIterator:
+        source = get_resource(self.resource_id)
+        if source is None:
+            raise KeyError(f"ffi resource {self.resource_id!r} not found")
+        batches = source(partition) if callable(source) else source
+        for rb in batches:
+            self.metrics.add("output_rows", rb.num_rows)
+            yield ColumnBatch.from_arrow(rb)

@@ -1,0 +1,254 @@
+"""Shuffle write: staged repartitioning + .data/.index files.
+
+Parity: shuffle_writer_exec.rs + shuffle/sort_repartitioner.rs:44
+(SortShuffleRepartitioner: BufferedData stages batches, radix-sorts rows by
+partition id, writes per-partition framed compressed IPC runs with offsets,
+spills under memory pressure and merges spills at shuffle_write;
+buffered_data.rs:48) and the file contract consumed by the JVM
+(.data + little-endian u64 cumulative-offset .index,
+ref AuronShuffleWriterBase.scala:46-85).
+
+TPU-first: partition ids compute ON DEVICE (murmur3+pmod inside the jit'd
+stage), then rows group by pid via the same device sort-by-key machinery as
+aggregation; the host writes per-partition frames.  Spill files hold the
+same per-partition framed layout with an in-memory offset table, so the
+final merge is pure sequential IO per partition (no decode).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+import tempfile
+from dataclasses import dataclass, field
+from typing import BinaryIO, Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu import config
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.bridge.context import current_task
+from blaze_tpu.memory import MemConsumer, MemManager
+from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
+from blaze_tpu.schema import Schema
+from blaze_tpu.shuffle.ipc import IpcCompressionWriter
+from blaze_tpu.shuffle.partitioning import Partitioning
+
+
+class _PartitionedSpill:
+    """Spill file laid out partition-major with an offset table."""
+
+    def __init__(self):
+        fd, self.path = tempfile.mkstemp(prefix="blaze-shuffle-",
+                                         suffix=".spill")
+        os.close(fd)
+        self.offsets: List[int] = []
+
+    def release(self):
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class ShuffleRepartitioner(MemConsumer):
+    """BufferedData + spill management (ref sort_repartitioner.rs:44)."""
+
+    def __init__(self, partitioning: Partitioning, schema: Schema,
+                 metrics=None):
+        super().__init__("shuffle")
+        self.partitioning = partitioning
+        self.schema = schema
+        self._staged: List[pa.RecordBatch] = []  # with __pid lead column
+        self._staged_bytes = 0
+        self._spills: List[_PartitionedSpill] = []
+        self._metrics = metrics
+
+    # -- insert (ref ShuffleRepartitioner::insert_batch, shuffle/mod.rs:55)
+    def insert_batch(self, batch: ColumnBatch) -> None:
+        batch = batch.compact()
+        if batch.num_rows == 0:
+            return
+        current_task().check_running()
+        pids = self.partitioning.partition_ids(batch)
+        rb = batch.to_arrow()
+        arrays = [pa.array(pids, type=pa.int32())] + list(rb.columns)
+        staged = pa.RecordBatch.from_arrays(
+            arrays, names=["__pid"] + list(rb.schema.names))
+        self._staged.append(staged)
+        self._staged_bytes += staged.nbytes
+        self.update_mem_used(self._staged_bytes)
+
+    # -- spill (MemConsumer) -----------------------------------------------
+    def spill(self) -> int:
+        if not self._staged:
+            return 0
+        spill = _PartitionedSpill()
+        with open(spill.path, "wb") as f:
+            spill.offsets = self._write_partitioned(f)
+        self._spills.append(spill)
+        released = self._staged_bytes
+        self._staged = []
+        self._staged_bytes = 0
+        self._mem_used = 0
+        if self._metrics is not None:
+            self._metrics.add("spill_count")
+            self._metrics.add("spilled_bytes", released)
+        return released
+
+    def _write_partitioned(self, sink: BinaryIO) -> List[int]:
+        """Sort staged rows by pid, write per-partition frames; returns
+        cumulative offsets (n+1)."""
+        n_parts = self.partitioning.num_partitions
+        tbl = pa.Table.from_batches(self._staged).combine_chunks()
+        rb = tbl.to_batches()[0]
+        pids = np.asarray(rb.column(0))
+        order = np.argsort(pids, kind="stable")
+        sorted_rb = rb.take(pa.array(order, type=pa.int64()))
+        sorted_pids = pids[order]
+        # per-partition row ranges
+        starts = np.searchsorted(sorted_pids, np.arange(n_parts), "left")
+        ends = np.searchsorted(sorted_pids, np.arange(n_parts), "right")
+        payload = sorted_rb.select(range(1, sorted_rb.num_columns))
+        offsets = [0]
+        bs = config.BATCH_SIZE.get()
+        for p in range(n_parts):
+            s, e = int(starts[p]), int(ends[p])
+            if e > s:
+                w = IpcCompressionWriter(sink)
+                for off in range(s, e, bs):
+                    w.write_batch(payload.slice(off, min(bs, e - off)))
+                w.finish()
+            offsets.append(sink.tell())
+        return offsets
+
+    # -- final write (ref shuffle_write, shuffle/mod.rs:58) ----------------
+    def write(self, data_file: str, index_file: str) -> List[int]:
+        """Merge spills + staged rows into .data/.index; returns lengths."""
+        mem_offsets: List[int] = []
+        mem_buf = io.BytesIO()
+        if self._staged:
+            mem_offsets = self._write_partitioned(mem_buf)
+            self._staged = []
+            self._staged_bytes = 0
+            self.update_mem_used(0)
+        n_parts = self.partitioning.num_partitions
+        offsets = [0]
+        spill_files = [open(s.path, "rb") for s in self._spills]
+        try:
+            mem_view = mem_buf.getbuffer()
+            with open(data_file, "wb") as out:
+                for p in range(n_parts):
+                    if mem_offsets:
+                        out.write(mem_view[mem_offsets[p]:mem_offsets[p + 1]])
+                    for s, f in zip(self._spills, spill_files):
+                        seg_len = s.offsets[p + 1] - s.offsets[p]
+                        if seg_len:
+                            f.seek(s.offsets[p])
+                            out.write(f.read(seg_len))
+                    offsets.append(out.tell())
+        finally:
+            for f in spill_files:
+                f.close()
+            for s in self._spills:
+                s.release()
+            self._spills = []
+        with open(index_file, "wb") as idx:
+            for off in offsets:
+                idx.write(struct.pack("<q", off))
+        return [offsets[i + 1] - offsets[i] for i in range(n_parts)]
+
+    def write_rss(self, rss_write: Callable[[int, bytes], None]) -> None:
+        """Push per-partition bytes through a host callback
+        (ref rss_shuffle_writer_exec.rs + shuffle/rss.rs:45 RssWriter)."""
+        mem_offsets: List[int] = []
+        mem_buf = io.BytesIO()
+        if self._staged:
+            mem_offsets = self._write_partitioned(mem_buf)
+            self._staged = []
+            self._staged_bytes = 0
+            self.update_mem_used(0)
+        n_parts = self.partitioning.num_partitions
+        spill_files = [open(s.path, "rb") for s in self._spills]
+        try:
+            mem_view = mem_buf.getbuffer()
+            for p in range(n_parts):
+                chunks = []
+                if mem_offsets:
+                    chunks.append(bytes(mem_view[mem_offsets[p]:mem_offsets[p + 1]]))
+                for s, f in zip(self._spills, spill_files):
+                    seg_len = s.offsets[p + 1] - s.offsets[p]
+                    if seg_len:
+                        f.seek(s.offsets[p])
+                        chunks.append(f.read(seg_len))
+                data = b"".join(chunks)
+                if data:
+                    rss_write(p, data)
+        finally:
+            for f in spill_files:
+                f.close()
+            for s in self._spills:
+                s.release()
+            self._spills = []
+
+
+class ShuffleWriterExec(ExecutionPlan):
+    """Map-side shuffle write (ref shuffle_writer_exec.rs).  Consumes the
+    child partition, writes `.data`/`.index`, emits nothing — the engine
+    reads the index for MapStatus (AuronShuffleWriterBase.scala:68-85)."""
+
+    def __init__(self, child: ExecutionPlan, partitioning: Partitioning,
+                 data_file: str, index_file: str):
+        super().__init__([child])
+        self.partitioning = partitioning
+        self.data_file = data_file
+        self.index_file = index_file
+        self.partition_lengths: Optional[List[int]] = None
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int) -> BatchIterator:
+        rep = ShuffleRepartitioner(self.partitioning, self.schema,
+                                   self.metrics)
+        rep.set_spillable(MemManager.get())
+        try:
+            with self.metrics.timer("elapsed_compute"):
+                for batch in self.children[0].execute(partition):
+                    rep.insert_batch(batch)
+                self.partition_lengths = rep.write(self.data_file,
+                                                   self.index_file)
+            self.metrics.add("data_size", sum(self.partition_lengths))
+        finally:
+            rep.unregister()
+        return iter(())
+
+
+class RssShuffleWriterExec(ExecutionPlan):
+    """Remote-shuffle-service writer: bytes go through a callback instead of
+    local files (ref rss_shuffle_writer_exec.rs)."""
+
+    def __init__(self, child: ExecutionPlan, partitioning: Partitioning,
+                 rss_write: Callable[[int, bytes], None]):
+        super().__init__([child])
+        self.partitioning = partitioning
+        self._rss_write = rss_write
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int) -> BatchIterator:
+        rep = ShuffleRepartitioner(self.partitioning, self.schema,
+                                   self.metrics)
+        rep.set_spillable(MemManager.get())
+        try:
+            for batch in self.children[0].execute(partition):
+                rep.insert_batch(batch)
+            rep.write_rss(self._rss_write)
+        finally:
+            rep.unregister()
+        return iter(())
