@@ -8,6 +8,10 @@ from blaze_tpu.ops.basic import (DebugExec, EmptyPartitionsExec, ExpandExec,
 from blaze_tpu.ops.scan import MemoryScanExec, ParquetScanExec
 from blaze_tpu.ops.sort import SortExec
 from blaze_tpu.ops.agg import AggExec, AggMode, make_agg
+from blaze_tpu.ops.window import (LeadLagFunc, NthValueFunc, RankFunc,
+                                  WindowAggFunc, WindowExec, WindowRankType)
+from blaze_tpu.ops.generate import (ExplodeGenerator, GenerateExec,
+                                    JsonTupleGenerator, UDTFGenerator)
 from blaze_tpu.ops.joins import (BroadcastJoinExec, JoinType,
                                  ShuffledHashJoinExec, SortMergeJoinExec)
 
@@ -19,4 +23,7 @@ __all__ = [
     "AggExec", "AggMode", "make_agg",
     "BroadcastJoinExec", "JoinType", "ShuffledHashJoinExec",
     "SortMergeJoinExec",
+    "LeadLagFunc", "NthValueFunc", "RankFunc", "WindowAggFunc", "WindowExec",
+    "WindowRankType", "ExplodeGenerator", "GenerateExec",
+    "JsonTupleGenerator", "UDTFGenerator",
 ]

@@ -1,0 +1,218 @@
+"""Generate operator: explode / posexplode / json_tuple / UDTF.
+
+Parity: generate_exec.rs:550 + generate/{explode,json_tuple,
+spark_udtf_wrapper}.rs.  Fan-out sizes are data-dependent, so row
+multiplication happens host-side with vectorized numpy repeat over Arrow
+list offsets; the generated batch re-enters the device pipeline as a normal
+ColumnBatch.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import PhysicalExpr
+from blaze_tpu.ops.base import BatchIterator, CoalesceStream, ExecutionPlan
+from blaze_tpu.schema import DataType, Field, INT32, Schema, TypeId, UTF8
+
+
+class Generator:
+    """Produces (repeat_counts, generated_columns) for one input batch."""
+
+    def out_fields(self, in_schema: Schema) -> List[Field]:
+        raise NotImplementedError
+
+    def generate(self, batch: ColumnBatch) -> tuple:
+        raise NotImplementedError
+
+
+@dataclass
+class ExplodeGenerator(Generator):
+    """explode/posexplode over list or map columns (ref generate/explode.rs)."""
+
+    child: PhysicalExpr
+    position: bool = False   # posexplode
+    outer: bool = False      # explode_outer keeps empty/null rows
+
+    def out_fields(self, in_schema: Schema) -> List[Field]:
+        t = self.child.data_type(in_schema)
+        fields = []
+        if self.position:
+            fields.append(Field("pos", INT32, False))
+        if t.id == TypeId.LIST:
+            fields.append(Field("col", t.children[0].data_type))
+        elif t.id == TypeId.MAP:
+            fields.append(Field("key", t.children[0].data_type))
+            fields.append(Field("value", t.children[1].data_type))
+        else:
+            raise TypeError(f"explode over non-list/map {t}")
+        return fields
+
+    def generate(self, batch: ColumnBatch):
+        n = batch.num_rows
+        arr = self.child.evaluate(batch).to_host(n)
+        is_map = pa.types.is_map(arr.type)
+        lengths = np.asarray(pc_list_len(arr))
+        if self.outer:
+            counts = np.where(lengths <= 0, 1, lengths)
+            empty = lengths <= 0
+        else:
+            counts = np.where(lengths < 0, 0, lengths)
+            empty = np.zeros(n, dtype=bool)
+        if is_map:
+            flat = arr.values  # entries struct array (key, value)
+            keys, vals = flat.field(0), flat.field(1)
+        else:
+            flat = arr.flatten()  # values of all lists concatenated
+        # positions within each row
+        total = int(counts.sum())
+        pos = np.arange(total, dtype=np.int64) - \
+            np.repeat(np.cumsum(counts) - counts, counts)
+        # source index into the flattened values; outer-empty rows get null
+        starts = np.zeros(n, dtype=np.int64)
+        starts[1:] = np.cumsum(np.where(lengths < 0, 0, lengths))[:-1]
+        src = np.repeat(starts, counts) + pos
+        null_out = np.repeat(empty, counts)
+        src_safe = np.clip(src, 0, max(len(flat) - 1, 0))
+        cols: List[pa.Array] = []
+        if self.position:
+            p = np.where(null_out, 0, pos).astype(np.int32)
+            cols.append(pa.array(p, mask=null_out, type=pa.int32()))
+        idx = pa.array(src_safe, type=pa.int64())
+        if is_map:
+            for part in (keys, vals):
+                taken = (part.take(idx) if len(part) else
+                         pa.nulls(total, part.type))
+                cols.append(_mask_nulls(taken, null_out))
+        else:
+            taken = (flat.take(idx) if len(flat) else
+                     pa.nulls(total, flat.type))
+            cols.append(_mask_nulls(taken, null_out))
+        return counts, cols
+
+
+def pc_list_len(arr: pa.Array) -> pa.Array:
+    import pyarrow.compute as pc
+    if pa.types.is_map(arr.type):
+        # map arrays share the list offset layout; measure via offsets
+        offsets = np.frombuffer(arr.buffers()[1], dtype=np.int32)[
+            arr.offset:arr.offset + len(arr) + 1]
+        lengths = np.diff(offsets).astype(np.int64)
+        valid = (np.ones(len(arr), dtype=bool) if arr.null_count == 0
+                 else np.asarray(arr.is_valid()))
+        return pa.array(np.where(valid, lengths, -1))
+    return pc.list_value_length(arr).fill_null(-1)
+
+
+def _mask_nulls(arr: pa.Array, mask: np.ndarray) -> pa.Array:
+    if not mask.any():
+        return arr
+    import pyarrow.compute as pc
+    return pc.if_else(pa.array(~mask), arr, pa.nulls(len(arr), arr.type))
+
+
+@dataclass
+class JsonTupleGenerator(Generator):
+    """json_tuple(json, f1, f2, ...) — one output row per input row
+    (ref generate/json_tuple.rs)."""
+
+    child: PhysicalExpr
+    fields: Sequence[str] = ()
+
+    def out_fields(self, in_schema: Schema) -> List[Field]:
+        return [Field(f"c{i}", UTF8) for i in range(len(self.fields))]
+
+    def generate(self, batch: ColumnBatch):
+        n = batch.num_rows
+        arr = self.child.evaluate(batch).to_host(n)
+        outs: List[List[Optional[str]]] = [[] for _ in self.fields]
+        for x in arr:
+            doc = None
+            if x.is_valid:
+                try:
+                    doc = json.loads(x.as_py())
+                except (ValueError, TypeError):
+                    doc = None
+            for i, f in enumerate(self.fields):
+                v = None
+                if isinstance(doc, dict) and f in doc:
+                    raw = doc[f]
+                    v = (json.dumps(raw) if isinstance(raw, (dict, list))
+                         else None if raw is None else str(raw))
+                outs[i].append(v)
+        counts = np.ones(n, dtype=np.int64)
+        return counts, [pa.array(o, type=pa.utf8()) for o in outs]
+
+
+@dataclass
+class UDTFGenerator(Generator):
+    """Host-callable UDTF fallback (ref generate/spark_udtf_wrapper.rs —
+    the JVM round-trip analog: rows out per row in)."""
+
+    args: Sequence[PhysicalExpr] = ()
+    fn: Callable = None      # row_values -> list of output tuples
+    fields: Sequence[Field] = ()
+
+    def out_fields(self, in_schema: Schema) -> List[Field]:
+        return list(self.fields)
+
+    def generate(self, batch: ColumnBatch):
+        n = batch.num_rows
+        arrays = [a.evaluate(batch).to_host(n) for a in self.args]
+        counts = np.zeros(n, dtype=np.int64)
+        cols: List[List] = [[] for _ in self.fields]
+        for i in range(n):
+            row = tuple(a[i].as_py() for a in arrays)
+            out_rows = self.fn(*row) or []
+            counts[i] = len(out_rows)
+            for tup in out_rows:
+                for j, v in enumerate(tup):
+                    cols[j].append(v)
+        arrays_out = [pa.array(c, type=f.data_type.to_arrow())
+                      for c, f in zip(cols, self.fields)]
+        return counts, arrays_out
+
+
+class GenerateExec(ExecutionPlan):
+
+    def __init__(self, child: ExecutionPlan, generator: Generator,
+                 required_cols: Optional[Sequence[int]] = None,
+                 outer: bool = False):
+        super().__init__([child])
+        self.generator = generator
+        self._required = (list(required_cols) if required_cols is not None
+                          else list(range(len(child.schema))))
+        in_schema = child.schema
+        kept = [in_schema[i] for i in self._required]
+        self._out_schema = Schema(kept + generator.out_fields(in_schema))
+
+    @property
+    def schema(self) -> Schema:
+        return self._out_schema
+
+    def execute(self, partition: int) -> BatchIterator:
+        def gen():
+            for batch in self.children[0].execute(partition):
+                batch = batch.compact()
+                if batch.num_rows == 0:
+                    continue
+                counts, gen_cols = self.generator.generate(batch)
+                rb = batch.to_arrow()
+                idx = pa.array(np.repeat(np.arange(batch.num_rows), counts),
+                               type=pa.int64())
+                kept = [rb.column(i).take(idx) for i in self._required]
+                arrays = kept + list(gen_cols)
+                out_schema = self.schema.to_arrow()
+                arrays = [a.cast(f.type, safe=False)
+                          if not a.type.equals(f.type) else a
+                          for a, f in zip(arrays, out_schema)]
+                out = pa.RecordBatch.from_arrays(arrays, schema=out_schema)
+                self.metrics.add("output_rows", out.num_rows)
+                yield ColumnBatch.from_arrow(out)
+        return iter(CoalesceStream(gen(), metrics=self.metrics))

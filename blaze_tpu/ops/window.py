@@ -1,0 +1,365 @@
+"""Window operator: rank family, lead/lag, nth_value, agg-over-window.
+
+Parity: window_exec.rs:896 + window/window_context.rs:31 +
+window/processors/{row_number,rank,dense_rank,percent_rank,cume_dist,lead,
+nth_value,agg}.rs and window-group-limit (proto auron.proto:600).
+
+TPU-first: the input arrives sorted by (partition keys, order keys) —
+Spark plans a SortExec under every window — so all processors become
+vectorized prefix scans over segment structure: partition boundaries ->
+cumsum segment ids, rank = position of the last order-key change, running
+aggregates = segmented cumulative sums.  No per-row state machine; one
+fused device pass per batch set.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import PhysicalExpr
+from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
+from blaze_tpu.ops.sort import host_sort_keys
+from blaze_tpu.schema import (DataType, Field, FLOAT64, INT32, INT64, Schema)
+
+
+class WindowRankType(enum.Enum):
+    ROW_NUMBER = "row_number"
+    RANK = "rank"
+    DENSE_RANK = "dense_rank"
+    PERCENT_RANK = "percent_rank"
+    CUME_DIST = "cume_dist"
+
+
+@dataclass
+class WindowFunc:
+    name: str
+
+    def out_field(self, in_schema: Schema) -> Field:
+        raise NotImplementedError
+
+
+@dataclass
+class RankFunc(WindowFunc):
+    kind: WindowRankType = WindowRankType.ROW_NUMBER
+
+    def out_field(self, in_schema):
+        if self.kind in (WindowRankType.PERCENT_RANK, WindowRankType.CUME_DIST):
+            return Field(self.name, FLOAT64, False)
+        return Field(self.name, INT32, False)
+
+
+@dataclass
+class LeadLagFunc(WindowFunc):
+    expr: PhysicalExpr = None
+    offset: int = 1          # positive = lead, negative = lag
+    default: Optional[object] = None
+
+    def out_field(self, in_schema):
+        return Field(self.name, self.expr.data_type(in_schema), True)
+
+
+@dataclass
+class NthValueFunc(WindowFunc):
+    expr: PhysicalExpr = None
+    n: int = 1               # 1-based
+
+    def out_field(self, in_schema):
+        return Field(self.name, self.expr.data_type(in_schema), True)
+
+
+@dataclass
+class WindowAggFunc(WindowFunc):
+    agg: object = None       # AggFunction
+    running: bool = True     # unbounded-preceding..current-row vs whole part
+
+    def out_field(self, in_schema):
+        return Field(self.name, self.agg.output_type(in_schema), True)
+
+
+class WindowExec(ExecutionPlan):
+
+    def __init__(self, child: ExecutionPlan,
+                 funcs: Sequence[WindowFunc],
+                 partition_by: Sequence[PhysicalExpr],
+                 order_by: Sequence[Tuple[PhysicalExpr, bool, bool]],
+                 group_limit: Optional[int] = None):
+        super().__init__([child])
+        self.funcs = list(funcs)
+        self.partition_by = list(partition_by)
+        self.order_by = list(order_by)
+        self.group_limit = group_limit
+        in_schema = child.schema
+        for f in self.funcs:
+            if isinstance(f, WindowAggFunc):
+                f.agg.bind(in_schema)
+        self._out_schema = Schema(
+            list(in_schema) + [f.out_field(in_schema) for f in self.funcs])
+
+    @property
+    def schema(self) -> Schema:
+        return self._out_schema
+
+    def execute(self, partition: int) -> BatchIterator:
+        batches = [b.compact().to_arrow()
+                   for b in self.children[0].execute(partition)]
+        batches = [b for b in batches if b.num_rows]
+        if not batches:
+            return iter(())
+        tbl = pa.Table.from_batches(batches).combine_chunks()
+        rb = tbl.to_batches()[0]
+        return iter(self._process(rb))
+
+    # ------------------------------------------------------------------
+    def _process(self, rb: pa.RecordBatch) -> List[ColumnBatch]:
+        n = rb.num_rows
+        in_schema = self.children[0].schema
+        cb = ColumnBatch.from_arrow(rb)
+
+        part_seg, order_change = self._segments(rb, cb)
+        # positions & per-partition geometry (device prefix scans)
+        pos = jnp.arange(n, dtype=jnp.int64)
+        seg_start = _segment_start(part_seg, pos)
+        row_number = (pos - seg_start + 1).astype(jnp.int32)
+        # partition sizes via boundary scatter
+        part_size = _segment_size(part_seg, n)
+
+        # rank: position of the last (partition-or-order) change before/at row
+        change = part_seg | order_change
+        rank = (pos - _running_max_where(change, pos) + 0).astype(jnp.int64)
+        rank_val = (_running_max_where(change, pos) - seg_start + 1
+                    ).astype(jnp.int32)
+        dense = _segmented_cumsum(order_change & ~part_seg, part_seg
+                                  ).astype(jnp.int32) + 1
+
+        out_cols: List[pa.Array] = list(rb.columns)
+        np_part_seg = np.asarray(part_seg)
+        for f in self.funcs:
+            if isinstance(f, RankFunc):
+                out_cols.append(self._rank_col(f, row_number, rank_val, dense,
+                                               part_size, seg_start, change,
+                                               pos, n))
+            elif isinstance(f, LeadLagFunc):
+                out_cols.append(self._lead_lag(f, cb, np_part_seg, n))
+            elif isinstance(f, NthValueFunc):
+                out_cols.append(self._nth_value(f, cb, seg_start, part_size, n))
+            elif isinstance(f, WindowAggFunc):
+                out_cols.append(self._window_agg(f, cb, rb, part_seg,
+                                                 order_change, n))
+            else:
+                raise TypeError(f"unknown window function {f}")
+
+        out_schema = self.schema.to_arrow()
+        out_cols = [a.cast(fld.type, safe=False)
+                    if not a.type.equals(fld.type) else a
+                    for a, fld in zip(out_cols, out_schema)]
+        out = pa.RecordBatch.from_arrays(out_cols, schema=out_schema)
+        if self.group_limit is not None:
+            # window-group-limit: keep rows with rank <= k (proto :600)
+            keep = np.asarray(rank_val) <= self.group_limit
+            out = out.filter(pa.array(keep))
+        self.metrics.add("output_rows", out.num_rows)
+        return [ColumnBatch.from_arrow(out)]
+
+    def _segments(self, rb: pa.RecordBatch, cb: ColumnBatch):
+        """(partition_boundary, order_change) bool arrays over rows."""
+        n = rb.num_rows
+        if self.partition_by:
+            arrays = [e.evaluate(cb).to_host(n) for e in self.partition_by]
+            prb = pa.RecordBatch.from_arrays(
+                arrays, names=[f"p{i}" for i in range(len(arrays))])
+            keys = host_sort_keys(prb, list(range(len(arrays))),
+                                  [False] * len(arrays), [True] * len(arrays))
+            part_seg = np.zeros(n, dtype=bool)
+            part_seg[0] = True
+            for k in keys:
+                part_seg[1:] |= k[1:] != k[:-1]
+        else:
+            part_seg = np.zeros(n, dtype=bool)
+            part_seg[0] = True
+        if self.order_by:
+            arrays = [e.evaluate(cb).to_host(n) for e, _, _ in self.order_by]
+            orb = pa.RecordBatch.from_arrays(
+                arrays, names=[f"o{i}" for i in range(len(arrays))])
+            keys = host_sort_keys(orb, list(range(len(arrays))),
+                                  [d for _, d, _ in self.order_by],
+                                  [f for _, _, f in self.order_by])
+            order_change = np.zeros(n, dtype=bool)
+            order_change[0] = True
+            for k in keys:
+                order_change[1:] |= k[1:] != k[:-1]
+        else:
+            order_change = np.ones(n, dtype=bool)
+        return jnp.asarray(part_seg), jnp.asarray(order_change)
+
+    def _rank_col(self, f: RankFunc, row_number, rank_val, dense, part_size,
+                  seg_start, change, pos, n) -> pa.Array:
+        k = f.kind
+        if k == WindowRankType.ROW_NUMBER:
+            return pa.array(np.asarray(row_number), type=pa.int32())
+        if k == WindowRankType.RANK:
+            return pa.array(np.asarray(rank_val), type=pa.int32())
+        if k == WindowRankType.DENSE_RANK:
+            return pa.array(np.asarray(dense), type=pa.int32())
+        if k == WindowRankType.PERCENT_RANK:
+            denom = jnp.maximum(part_size - 1, 1).astype(jnp.float64)
+            out = (rank_val.astype(jnp.float64) - 1.0) / denom
+            out = jnp.where(part_size == 1, 0.0, out)
+            return pa.array(np.asarray(out), type=pa.float64())
+        # CUME_DIST: (last row position with same order value + 1 - start)/size
+        last_same = _next_change_pos(change, pos, n)
+        out = (last_same - seg_start).astype(jnp.float64) / \
+            part_size.astype(jnp.float64)
+        return pa.array(np.asarray(out), type=pa.float64())
+
+    def _lead_lag(self, f: LeadLagFunc, cb: ColumnBatch, part_seg: np.ndarray,
+                  n: int) -> pa.Array:
+        vals = f.expr.evaluate(cb).to_host(n)
+        off = f.offset
+        pid = np.cumsum(part_seg) - 1
+        idx = np.arange(n) + off
+        ok = (idx >= 0) & (idx < n)
+        safe = np.clip(idx, 0, n - 1)
+        ok &= pid[safe] == pid  # stay inside the partition
+        shifted = vals.take(pa.array(safe, type=pa.int64()))
+        py = [shifted[i].as_py() if ok[i] else f.default for i in range(n)]
+        return pa.array(py, type=vals.type)
+
+    def _nth_value(self, f: NthValueFunc, cb: ColumnBatch, seg_start,
+                   part_size, n: int) -> pa.Array:
+        vals = f.expr.evaluate(cb).to_host(n)
+        target = np.asarray(seg_start) + (f.n - 1)
+        ok = (f.n - 1) < np.asarray(part_size)
+        safe = np.clip(target, 0, n - 1)
+        taken = vals.take(pa.array(safe, type=pa.int64()))
+        py = [taken[i].as_py() if ok[i] else None for i in range(n)]
+        return pa.array(py, type=vals.type)
+
+    def _window_agg(self, f: WindowAggFunc, cb: ColumnBatch,
+                    rb: pa.RecordBatch, part_seg, order_change, n
+                    ) -> pa.Array:
+        from blaze_tpu.ops.agg.functions import (AvgAgg, CountAgg, MinMaxAgg,
+                                                 SumAgg)
+        e = f.agg.children[0] if f.agg.children else None
+        v = e.evaluate(cb).to_device(cb.capacity) if e is not None else None
+        data = v.data[:n] if v is not None else jnp.ones(n, dtype=jnp.int64)
+        valid = v.validity[:n] if v is not None else jnp.ones(n, dtype=bool)
+        running = f.running and bool(self.order_by)
+        if isinstance(f.agg, CountAgg):
+            acc = _segmented_cumsum(valid.astype(jnp.int64), part_seg)
+            out, ovalid = acc, jnp.ones(n, dtype=bool)
+        elif isinstance(f.agg, (SumAgg, AvgAgg)):
+            dt = jnp.float64 if jnp.issubdtype(data.dtype, jnp.floating) \
+                else jnp.int64
+            s = _segmented_cumsum(jnp.where(valid, data.astype(dt), 0),
+                                  part_seg)
+            c = _segmented_cumsum(valid.astype(jnp.int64), part_seg)
+            if isinstance(f.agg, SumAgg):
+                out, ovalid = s, c > 0
+            else:
+                out = s.astype(jnp.float64) / jnp.maximum(c, 1)
+                ovalid = c > 0
+        elif isinstance(f.agg, MinMaxAgg):
+            big = jnp.iinfo(jnp.int64).max if not jnp.issubdtype(
+                data.dtype, jnp.floating) else jnp.inf
+            fill = big if f.agg.minimum else (-big if not jnp.issubdtype(
+                data.dtype, jnp.floating) else -jnp.inf)
+            x = jnp.where(valid, data, jnp.asarray(fill, dtype=data.dtype))
+            out = _segmented_cummin(x, part_seg) if f.agg.minimum \
+                else _segmented_cummax(x, part_seg)
+            ovalid = _segmented_cumsum(valid.astype(jnp.int64), part_seg) > 0
+        else:
+            raise TypeError(f"window agg {f.agg.name} unsupported")
+        if not running:
+            # whole-partition frame: broadcast the partition's last value
+            last = _partition_last(out, part_seg, n)
+            out = last
+            ovalid = _partition_last(ovalid.astype(jnp.int64), part_seg, n) > 0
+        else:
+            # RANGE frame: ties (same order value) share the frame end value
+            last_same = _next_change_pos(part_seg | order_change,
+                                         jnp.arange(n, dtype=jnp.int64), n) - 1
+            out = jnp.take(out, last_same)
+            ovalid = jnp.take(ovalid, last_same)
+        d = np.asarray(out)
+        m = ~np.asarray(ovalid)
+        return pa.array(d, mask=m)
+
+
+# -- prefix-scan helpers (device) -------------------------------------------
+
+def _segment_start(part_seg, pos):
+    return _running_max_where(part_seg, pos)
+
+
+def _running_max_where(mask, pos):
+    """For each row, the position of the most recent row where mask=True."""
+    import jax.lax
+    marked = jnp.where(mask, pos, jnp.int64(-1))
+    return jax.lax.cummax(marked)
+
+
+def _segment_size(part_seg, n):
+    pos = jnp.arange(n, dtype=jnp.int64)
+    start = _segment_start(part_seg, pos)
+    # size = next_start - start; next start found from the right
+    is_last = jnp.concatenate([part_seg[1:], jnp.ones(1, dtype=bool)])
+    end_pos = _next_true_pos(is_last, pos, n)
+    return end_pos - start + 1
+
+
+def _next_true_pos(mask, pos, n):
+    """Position of the next row (>= current) where mask is True."""
+    import jax.lax
+    marked = jnp.where(mask, pos, jnp.int64(n))
+    return jnp.flip(jax.lax.cummin(jnp.flip(marked)))
+
+
+def _next_change_pos(change, pos, n):
+    """Exclusive end of the run of rows equal to this row: position of the
+    next change after current, or n."""
+    nxt = jnp.concatenate([change[1:], jnp.ones(1, dtype=bool)])
+    return _next_true_pos(nxt, pos, n) + 1
+
+
+def _partition_last(values, part_seg, n):
+    """Broadcast each partition's LAST row value to all its rows."""
+    pos = jnp.arange(n, dtype=jnp.int64)
+    is_last = jnp.concatenate([part_seg[1:], jnp.ones(1, dtype=bool)])
+    last_pos = _next_true_pos(is_last, pos, n)
+    return jnp.take(values, jnp.clip(last_pos, 0, n - 1))
+
+
+def _segmented_cumsum(values, part_seg):
+    """Cumulative sum restarting at each partition boundary."""
+    total = jnp.cumsum(values)
+    pos = jnp.arange(values.shape[0], dtype=jnp.int64)
+    start = _segment_start(part_seg, pos)
+    base = jnp.take(total, jnp.maximum(start - 1, 0))
+    base = jnp.where(start == 0, jnp.zeros_like(base), base)
+    return total - base
+
+
+def _segmented_cummax(values, part_seg):
+    n = values.shape[0]
+    pid = jnp.cumsum(part_seg.astype(jnp.int64)) - 1
+    # log-steps doubling scan bounded by segment membership
+    out = values
+    shift = 1
+    while shift < n:
+        prev = jnp.concatenate([out[:shift], out[:-shift]])
+        prev_pid = jnp.concatenate([pid[:shift], pid[:-shift]])
+        ok = (jnp.arange(n) >= shift) & (prev_pid == pid)
+        out = jnp.where(ok, jnp.maximum(out, prev), out)
+        shift *= 2
+    return out
+
+
+def _segmented_cummin(values, part_seg):
+    return -_segmented_cummax(-values, part_seg)

@@ -1,0 +1,158 @@
+"""Window + Generate operator tests (ref window_exec.rs / generate_exec.rs
+unit tests, SURVEY.md §4 tier 1)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import schema as S
+from blaze_tpu.exprs import col, lit
+from blaze_tpu.memory import MemManager
+from blaze_tpu.ops import MemoryScanExec, SortExec, make_agg
+from blaze_tpu.ops.generate import (ExplodeGenerator, GenerateExec,
+                                    JsonTupleGenerator, UDTFGenerator)
+from blaze_tpu.ops.window import (LeadLagFunc, NthValueFunc, RankFunc,
+                                  WindowAggFunc, WindowExec, WindowRankType)
+
+
+@pytest.fixture(autouse=True)
+def budget():
+    MemManager.init(4 << 30)
+
+
+def sorted_scan(t, part_col, order_col):
+    scan = MemoryScanExec.from_arrow(t, batch_rows=64)
+    return SortExec(scan, [(col(part_col), False, True),
+                           (col(order_col), False, True)])
+
+
+T = pa.table({
+    "g": pa.array([1, 1, 1, 2, 2, 2, 2]),
+    "v": pa.array([10, 20, 20, 5, 6, 7, 7]),
+})
+
+
+def test_rank_family():
+    plan = WindowExec(
+        sorted_scan(T, 0, 1),
+        [RankFunc("rn", WindowRankType.ROW_NUMBER),
+         RankFunc("rk", WindowRankType.RANK),
+         RankFunc("dr", WindowRankType.DENSE_RANK),
+         RankFunc("pr", WindowRankType.PERCENT_RANK),
+         RankFunc("cd", WindowRankType.CUME_DIST)],
+        [col(0)], [(col(1), False, True)])
+    got = plan.execute_collect().to_arrow()
+    assert got.column("rn").to_pylist() == [1, 2, 3, 1, 2, 3, 4]
+    assert got.column("rk").to_pylist() == [1, 2, 2, 1, 2, 3, 3]
+    assert got.column("dr").to_pylist() == [1, 2, 2, 1, 2, 3, 3]
+    assert got.column("pr").to_pylist() == pytest.approx(
+        [0.0, 0.5, 0.5, 0.0, 1 / 3, 2 / 3, 2 / 3])
+    assert got.column("cd").to_pylist() == pytest.approx(
+        [1 / 3, 1.0, 1.0, 0.25, 0.5, 1.0, 1.0])
+
+
+def test_window_group_limit():
+    plan = WindowExec(sorted_scan(T, 0, 1),
+                      [RankFunc("rk", WindowRankType.RANK)],
+                      [col(0)], [(col(1), False, True)], group_limit=2)
+    got = plan.execute_collect().to_arrow()
+    assert got.column("rk").to_pylist() == [1, 2, 2, 1, 2]
+
+
+def test_lead_lag_nth():
+    plan = WindowExec(
+        sorted_scan(T, 0, 1),
+        [LeadLagFunc("ld", col(1), 1), LeadLagFunc("lg", col(1), -1, -99),
+         NthValueFunc("n2", col(1), 2)],
+        [col(0)], [(col(1), False, True)])
+    got = plan.execute_collect().to_arrow()
+    assert got.column("ld").to_pylist() == [20, 20, None, 6, 7, 7, None]
+    assert got.column("lg").to_pylist() == [-99, 10, 20, -99, 5, 6, 7]
+    assert got.column("n2").to_pylist() == [20, 20, 20, 6, 6, 6, 6]
+
+
+def test_running_and_whole_partition_agg():
+    plan = WindowExec(
+        sorted_scan(T, 0, 1),
+        [WindowAggFunc("rs", make_agg("sum", [col(1)]), running=True),
+         WindowAggFunc("ts", make_agg("sum", [col(1)]), running=False),
+         WindowAggFunc("rc", make_agg("count", [col(1)]), running=True)],
+        [col(0)], [(col(1), False, True)])
+    got = plan.execute_collect().to_arrow()
+    # RANGE frame: tied order values share the frame end (Spark default)
+    assert got.column("rs").to_pylist() == [10, 50, 50, 5, 11, 25, 25]
+    assert got.column("ts").to_pylist() == [50, 50, 50, 25, 25, 25, 25]
+    assert got.column("rc").to_pylist() == [1, 3, 3, 1, 2, 4, 4]
+
+
+def test_window_no_partition():
+    plan = WindowExec(
+        SortExec(MemoryScanExec.from_arrow(T), [(col(1), False, True)]),
+        [RankFunc("rn", WindowRankType.ROW_NUMBER)],
+        [], [(col(1), False, True)])
+    got = plan.execute_collect().to_arrow()
+    assert got.column("rn").to_pylist() == list(range(1, 8))
+
+
+def test_explode_list():
+    t = pa.table({
+        "id": pa.array([1, 2, 3, 4]),
+        "xs": pa.array([[1, 2], [], None, [5]], type=pa.list_(pa.int64())),
+    })
+    plan = GenerateExec(MemoryScanExec.from_arrow(t),
+                        ExplodeGenerator(col(1)), required_cols=[0])
+    got = plan.execute_collect().to_arrow()
+    assert got.column("id").to_pylist() == [1, 1, 4]
+    assert got.column("col").to_pylist() == [1, 2, 5]
+
+
+def test_explode_outer_and_pos():
+    t = pa.table({
+        "id": pa.array([1, 2]),
+        "xs": pa.array([[7, 8], None], type=pa.list_(pa.int64())),
+    })
+    plan = GenerateExec(MemoryScanExec.from_arrow(t),
+                        ExplodeGenerator(col(1), position=True, outer=True),
+                        required_cols=[0])
+    got = plan.execute_collect().to_arrow()
+    assert got.column("id").to_pylist() == [1, 1, 2]
+    assert got.column("pos").to_pylist() == [0, 1, None]
+    assert got.column("col").to_pylist() == [7, 8, None]
+
+
+def test_explode_map():
+    t = pa.table({
+        "id": pa.array([1, 2]),
+        "m": pa.array([[("a", 1), ("b", 2)], [("c", 3)]],
+                      type=pa.map_(pa.utf8(), pa.int64())),
+    })
+    plan = GenerateExec(MemoryScanExec.from_arrow(t),
+                        ExplodeGenerator(col(1)), required_cols=[0])
+    got = plan.execute_collect().to_arrow()
+    assert got.column("key").to_pylist() == ["a", "b", "c"]
+    assert got.column("value").to_pylist() == [1, 2, 3]
+
+
+def test_json_tuple():
+    t = pa.table({"j": pa.array(['{"a": 1, "b": "x"}', 'bad json', None,
+                                 '{"a": null, "c": [1,2]}'])})
+    plan = GenerateExec(MemoryScanExec.from_arrow(t),
+                        JsonTupleGenerator(col(0), ["a", "b", "c"]),
+                        required_cols=[])
+    got = plan.execute_collect().to_arrow()
+    assert got.column("c0").to_pylist() == ["1", None, None, None]
+    assert got.column("c1").to_pylist() == ["x", None, None, None]
+    assert got.column("c2").to_pylist() == [None, None, None, "[1, 2]"]
+
+
+def test_udtf():
+    t = pa.table({"n": pa.array([2, 0, 3])})
+    gen = UDTFGenerator(
+        args=[col(0)],
+        fn=lambda n: [(i,) for i in range(n)],
+        fields=[S.Field("i", S.INT64)])
+    plan = GenerateExec(MemoryScanExec.from_arrow(t), gen, required_cols=[0])
+    got = plan.execute_collect().to_arrow()
+    assert got.column("n").to_pylist() == [2, 2, 3, 3, 3]
+    assert got.column("i").to_pylist() == [0, 1, 0, 1, 2]
