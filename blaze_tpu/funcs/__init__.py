@@ -1,0 +1,91 @@
+"""Spark-semantics scalar function registry.
+
+Parity: datafusion-ext-functions/src/ (~40 functions registered by name
+under ScalarFunction::AuronExtFunctions, ref proto auron.proto:218) plus the
+DataFusion built-in math the reference planner maps directly
+(planner.rs try_parse_physical_expr ScalarFunction arm).
+
+Dispatch: `ScalarFunctionExpr` evaluates its args and calls the registered
+callable `fn(args: List[ColVal], batch, out_type) -> ColVal`.  Numeric
+kernels run on device (jnp); string/date/json functions run host-side with
+pyarrow.compute — mirroring Auron's own split where pointer-heavy work
+lives off the vector unit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs.base import ColVal, PhysicalExpr
+from blaze_tpu.schema import DataType, Schema
+
+_REGISTRY: Dict[str, Tuple[Callable, Callable]] = {}
+
+
+def register(name: str, type_fn: Optional[Callable] = None):
+    """Decorator: register `fn(args, batch, out_type) -> ColVal`.
+    `type_fn(arg_types) -> DataType` infers the return type."""
+    def deco(fn):
+        _REGISTRY[name.lower()] = (fn, type_fn or (lambda ts: ts[0]))
+        return fn
+    return deco
+
+
+def lookup(name: str):
+    entry = _REGISTRY.get(name.lower())
+    if entry is None:
+        raise KeyError(f"unknown scalar function {name!r}; "
+                       f"known: {sorted(_REGISTRY)}")
+    return entry
+
+
+def registered_names() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+@dataclass(frozen=True, repr=False)
+class ScalarFunctionExpr(PhysicalExpr):
+    name: str
+    args: Tuple[PhysicalExpr, ...] = ()
+    out_type: Optional[DataType] = None  # explicit override from the plan
+
+    def children(self):
+        return self.args
+
+    def data_type(self, schema: Schema) -> DataType:
+        if self.out_type is not None:
+            return self.out_type
+        _, type_fn = lookup(self.name)
+        return type_fn([a.data_type(schema) for a in self.args])
+
+    def cache_key(self):
+        return ("fn", self.name, tuple(a.cache_key() for a in self.args))
+
+    def evaluate(self, batch: ColumnBatch) -> ColVal:
+        fn, type_fn = lookup(self.name)
+        vals = [a.evaluate(batch) for a in self.args]
+        out_type = self.out_type or type_fn([v.dtype for v in vals])
+        return fn(vals, batch, out_type)
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+def fn(name: str, *args: PhysicalExpr,
+       out_type: Optional[DataType] = None) -> ScalarFunctionExpr:
+    return ScalarFunctionExpr(name, tuple(args), out_type)
+
+
+# import registrations (order-independent)
+from blaze_tpu.funcs import math as _math          # noqa: E402,F401
+from blaze_tpu.funcs import dates as _dates        # noqa: E402,F401
+from blaze_tpu.funcs import strings as _strings    # noqa: E402,F401
+from blaze_tpu.funcs import collections as _coll   # noqa: E402,F401
+from blaze_tpu.funcs import crypto as _crypto      # noqa: E402,F401
+from blaze_tpu.funcs import decimal_fns as _dec    # noqa: E402,F401
+from blaze_tpu.funcs import json_fns as _json      # noqa: E402,F401
+
+__all__ = ["ScalarFunctionExpr", "fn", "register", "lookup",
+           "registered_names"]

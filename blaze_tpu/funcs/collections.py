@@ -1,0 +1,199 @@
+"""Array/map functions.
+
+Parity: spark_array.rs / spark_make_array.rs, spark_map.rs (1,516 LoC:
+str_to_map, map builders/accessors) and brickhouse/ (array_union etc.).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.exprs.base import ColVal
+from blaze_tpu.funcs import register
+from blaze_tpu.schema import (BOOL, DataType, Field, INT32, TypeId, UTF8)
+
+
+def _host(args, batch):
+    return [a.to_host(batch.num_rows) for a in args]
+
+
+def _lit(arr):
+    return arr[0].as_py() if len(arr) and arr[0].is_valid else None
+
+
+def _list_type(ts):
+    item = ts[0] if ts else UTF8
+    return DataType(TypeId.LIST, children=(Field("item", item),))
+
+
+@register("make_array", _list_type)
+@register("array", _list_type)
+def _make_array(args, batch, out_type):
+    arrs = _host(args, batch)
+    n = batch.num_rows
+    py = [[a[i].as_py() if a[i].is_valid else None for a in arrs]
+          for i in range(n)]
+    return ColVal.host(out_type, pa.array(py, type=out_type.to_arrow()))
+
+
+@register("array_contains", lambda ts: BOOL)
+def _array_contains(args, batch, out_type):
+    arrs = _host(args, batch)
+    needle = _lit(arrs[1])
+    py = []
+    for x in arrs[0]:
+        if not x.is_valid:
+            py.append(None)
+        else:
+            py.append(needle in (x.as_py() or []))
+    return ColVal.host(BOOL, pa.array(py, type=pa.bool_()))
+
+
+@register("size", lambda ts: INT32)
+@register("cardinality", lambda ts: INT32)
+def _size(args, batch, out_type):
+    (a,) = _host(args, batch)
+    from blaze_tpu.ops.generate import pc_list_len
+    return ColVal.host(INT32, pc_list_len(a).cast(pa.int32()))
+
+
+@register("array_union", _list_type)
+def _array_union(args, batch, out_type):
+    a, b = _host(args, batch)
+    py = []
+    for x, y in zip(a, b):
+        if not x.is_valid or not y.is_valid:
+            py.append(None)
+        else:
+            py.append(list(dict.fromkeys((x.as_py() or []) + (y.as_py() or []))))
+    return ColVal.host(out_type, pa.array(py, type=a.type))
+
+
+@register("array_distinct", _list_type)
+def _array_distinct(args, batch, out_type):
+    (a,) = _host(args, batch)
+    py = [None if not x.is_valid else list(dict.fromkeys(x.as_py() or []))
+          for x in a]
+    return ColVal.host(out_type, pa.array(py, type=a.type))
+
+
+@register("array_max")
+def _array_max(args, batch, out_type):
+    (a,) = _host(args, batch)
+    py = []
+    for x in a:
+        vals = [v for v in (x.as_py() or []) if v is not None] \
+            if x.is_valid else None
+        py.append(max(vals) if vals else None)
+    return ColVal.host(out_type, pa.array(py, type=a.type.value_type))
+
+
+@register("array_min")
+def _array_min(args, batch, out_type):
+    (a,) = _host(args, batch)
+    py = []
+    for x in a:
+        vals = [v for v in (x.as_py() or []) if v is not None] \
+            if x.is_valid else None
+        py.append(min(vals) if vals else None)
+    return ColVal.host(out_type, pa.array(py, type=a.type.value_type))
+
+
+@register("array_join", lambda ts: UTF8)
+def _array_join(args, batch, out_type):
+    arrs = _host(args, batch)
+    sep = _lit(arrs[1]) or ""
+    null_repl = _lit(arrs[2]) if len(arrs) > 2 else None
+    py = []
+    for x in arrs[0]:
+        if not x.is_valid:
+            py.append(None)
+            continue
+        vals = []
+        for v in x.as_py() or []:
+            if v is None:
+                if null_repl is not None:
+                    vals.append(null_repl)
+            else:
+                vals.append(str(v))
+        py.append(sep.join(vals))
+    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+
+
+def _map_type(ts):
+    return DataType(TypeId.MAP, children=(Field("key", UTF8, False),
+                                          Field("value", UTF8)))
+
+
+@register("str_to_map", _map_type)
+def _str_to_map(args, batch, out_type):
+    """str_to_map(text, pair_delim=',', kv_delim=':') (ref spark_map.rs +
+    JniBridge.strToMapSplit fallback)."""
+    arrs = _host(args, batch)
+    pair_d = (_lit(arrs[1]) if len(arrs) > 1 else ",") or ","
+    kv_d = (_lit(arrs[2]) if len(arrs) > 2 else ":") or ":"
+    py = []
+    for x in arrs[0]:
+        if not x.is_valid:
+            py.append(None)
+            continue
+        out = {}
+        for pair in x.as_py().split(pair_d):
+            if kv_d in pair:
+                k, v = pair.split(kv_d, 1)
+            else:
+                k, v = pair, None
+            out[k] = v  # Spark keeps the LAST duplicate
+        py.append(list(out.items()))
+    return ColVal.host(out_type, pa.array(py, type=pa.map_(pa.utf8(),
+                                                           pa.utf8())))
+
+
+@register("map_keys", lambda ts: _list_type([ts[0].children[0].data_type
+                                            if ts and ts[0].children else UTF8]))
+def _map_keys(args, batch, out_type):
+    (a,) = _host(args, batch)
+    py = [None if not x.is_valid else [k for k, _ in x.as_py() or []]
+          for x in a]
+    return ColVal.host(out_type, pa.array(py, type=pa.list_(a.type.key_type)))
+
+
+@register("map_values", lambda ts: _list_type([ts[0].children[1].data_type
+                                              if ts and ts[0].children else UTF8]))
+def _map_values(args, batch, out_type):
+    (a,) = _host(args, batch)
+    py = [None if not x.is_valid else [v for _, v in x.as_py() or []]
+          for x in a]
+    return ColVal.host(out_type, pa.array(py, type=pa.list_(a.type.item_type)))
+
+
+@register("element_at")
+def _element_at(args, batch, out_type):
+    a, k = _host(args, batch)
+    py = []
+    if pa.types.is_map(a.type):
+        for x, key in zip(a, k):
+            if not x.is_valid or not key.is_valid:
+                py.append(None)
+                continue
+            val = None
+            for kk, vv in x.as_py() or []:
+                if kk == key.as_py():
+                    val = vv
+            py.append(val)
+        return ColVal.host(out_type, pa.array(py, type=a.type.item_type))
+    for x, idx in zip(a, k):
+        if not x.is_valid or not idx.is_valid:
+            py.append(None)
+            continue
+        lst = x.as_py() or []
+        i = int(idx.as_py())
+        # Spark element_at is 1-based; negative indexes from the end
+        if i == 0 or abs(i) > len(lst):
+            py.append(None)
+        else:
+            py.append(lst[i - 1] if i > 0 else lst[i])
+    return ColVal.host(out_type, pa.array(py, type=a.type.value_type))

@@ -1,0 +1,44 @@
+"""Decimal helpers.
+
+Parity: spark_make_decimal.rs / spark_unscaled_value.rs /
+spark_check_overflow.rs — the three internal expressions Spark emits around
+decimal arithmetic.  Our decimals are int64 unscaled values on device
+(schema.py), so these are elementwise integer kernels.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blaze_tpu.exprs.base import ColVal
+from blaze_tpu.funcs import register
+from blaze_tpu.schema import DataType, INT64, TypeId
+
+
+@register("unscaled_value", lambda ts: INT64)
+def _unscaled_value(args, batch, out_type):
+    v = args[0].to_device(batch.capacity)
+    return ColVal(INT64, data=v.data.astype(jnp.int64), validity=v.validity)
+
+
+@register("make_decimal")
+def _make_decimal(args, batch, out_type):
+    """long unscaled -> decimal(p,s); out of precision range -> null."""
+    v = args[0].to_device(batch.capacity)
+    p = out_type.precision if out_type.id == TypeId.DECIMAL else 18
+    limit = jnp.int64(10 ** min(p, 18))
+    ok = jnp.abs(v.data) < limit
+    return ColVal(out_type, data=jnp.where(ok, v.data, 0),
+                  validity=v.validity & ok)
+
+
+@register("check_overflow")
+def _check_overflow(args, batch, out_type):
+    """Rescale + precision check after decimal arithmetic
+    (ref spark_check_overflow.rs): overflow -> null (non-ANSI)."""
+    from blaze_tpu.kernels.cast import cast_column
+    v = args[0].to_device(batch.capacity)
+    if v.dtype.id == TypeId.DECIMAL and out_type.id == TypeId.DECIMAL:
+        data, valid = cast_column(v.data, v.validity, v.dtype, out_type)
+        return ColVal(out_type, data=data, validity=valid)
+    return v

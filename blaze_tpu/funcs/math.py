@@ -1,0 +1,185 @@
+"""Math functions — device jnp kernels.
+
+Parity: spark_round.rs / spark_bround.rs + the DataFusion math built-ins the
+reference planner maps (planner.rs ScalarFunction arm: abs, ceil, floor,
+sqrt, exp, ln, log10, log2, pow, sin/cos/tan..., signum).  Spark HALF_UP
+rounding for `round`, HALF_EVEN for `bround`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blaze_tpu.exprs.base import ColVal
+from blaze_tpu.funcs import register
+from blaze_tpu.schema import BOOL, DataType, FLOAT64, INT64, TypeId
+
+
+def _dev(args, batch):
+    return [a.to_device(batch.capacity) for a in args]
+
+
+def _unary(math_fn, float_out=True):
+    def impl(args, batch, out_type):
+        (v,) = _dev(args, batch)
+        data = v.data.astype(jnp.float64) if float_out else v.data
+        out = math_fn(data)
+        return ColVal(out_type, data=out, validity=v.validity)
+    return impl
+
+
+def _ftype(ts):
+    return FLOAT64
+
+
+for _name, _fn in {
+    "sqrt": jnp.sqrt, "exp": jnp.exp, "ln": jnp.log, "log10": jnp.log10,
+    "log2": jnp.log2, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "cbrt": jnp.cbrt, "degrees": jnp.degrees, "radians": jnp.radians,
+    "expm1": jnp.expm1, "log1p": jnp.log1p,
+}.items():
+    register(_name, _ftype)(_unary(_fn))
+
+
+@register("abs")
+def _abs(args, batch, out_type):
+    (v,) = _dev(args, batch)
+    return ColVal(out_type, data=jnp.abs(v.data), validity=v.validity)
+
+
+@register("negative")
+def _negative(args, batch, out_type):
+    (v,) = _dev(args, batch)
+    return ColVal(out_type, data=-v.data, validity=v.validity)
+
+
+@register("signum", _ftype)
+def _signum(args, batch, out_type):
+    (v,) = _dev(args, batch)
+    return ColVal(out_type, data=jnp.sign(v.data.astype(jnp.float64)),
+                  validity=v.validity)
+
+
+@register("ceil", lambda ts: INT64 if ts[0].id != TypeId.DECIMAL else ts[0])
+def _ceil(args, batch, out_type):
+    (v,) = _dev(args, batch)
+    if v.dtype.is_integer:
+        return ColVal(out_type, data=v.data.astype(jnp.int64),
+                      validity=v.validity)
+    out = jnp.ceil(v.data.astype(jnp.float64)).astype(jnp.int64)
+    return ColVal(out_type, data=out, validity=v.validity)
+
+
+@register("floor", lambda ts: INT64 if ts[0].id != TypeId.DECIMAL else ts[0])
+def _floor(args, batch, out_type):
+    (v,) = _dev(args, batch)
+    if v.dtype.is_integer:
+        return ColVal(out_type, data=v.data.astype(jnp.int64),
+                      validity=v.validity)
+    out = jnp.floor(v.data.astype(jnp.float64)).astype(jnp.int64)
+    return ColVal(out_type, data=out, validity=v.validity)
+
+
+@register("pow", _ftype)
+def _pow(args, batch, out_type):
+    a, b = _dev(args, batch)
+    out = jnp.power(a.data.astype(jnp.float64), b.data.astype(jnp.float64))
+    return ColVal(out_type, data=out, validity=a.validity & b.validity)
+
+
+@register("atan2", _ftype)
+def _atan2(args, batch, out_type):
+    a, b = _dev(args, batch)
+    out = jnp.arctan2(a.data.astype(jnp.float64), b.data.astype(jnp.float64))
+    return ColVal(out_type, data=out, validity=a.validity & b.validity)
+
+
+@register("isnan", lambda ts: BOOL)
+def _isnan(args, batch, out_type):
+    (v,) = _dev(args, batch)
+    out = jnp.isnan(v.data.astype(jnp.float64)) & v.validity
+    return ColVal(BOOL, data=out, validity=jnp.ones_like(out))
+
+
+@register("nanvl")
+def _nanvl(args, batch, out_type):
+    a, b = _dev(args, batch)
+    nan = jnp.isnan(a.data.astype(jnp.float64))
+    data = jnp.where(nan, b.data.astype(a.data.dtype), a.data)
+    valid = jnp.where(nan, b.validity, a.validity)
+    return ColVal(out_type, data=data, validity=valid)
+
+
+def _round_impl(half_even: bool):
+    """Spark round (HALF_UP) / bround (HALF_EVEN) with integer `scale`
+    literal baked by the planner (ref spark_round.rs/spark_bround.rs)."""
+    def impl(args, batch, out_type):
+        v = args[0].to_device(batch.capacity)
+        scale = 0
+        if len(args) > 1:
+            import numpy as np
+            scale = int(np.asarray(args[1].to_device(batch.capacity).data)[0])
+        tid = v.dtype.id
+        if tid == TypeId.DECIMAL:
+            q = 10 ** max(v.dtype.scale - scale, 0)
+            if q == 1:
+                return v
+            data = v.data
+            if half_even:
+                quot = jnp.round(data.astype(jnp.float64) / q).astype(jnp.int64)
+            else:
+                half = jnp.int64(q // 2)
+                adj = jnp.where(data >= 0, data + half, data - half)
+                quot = jnp.sign(adj) * (jnp.abs(adj) // q)
+            return ColVal(v.dtype, data=quot * jnp.int64(q),
+                          validity=v.validity)
+        if v.dtype.is_integer:
+            if scale >= 0:
+                return v
+            q = 10 ** (-scale)
+            data = v.data.astype(jnp.int64)
+            half = jnp.int64(q // 2)
+            adj = jnp.where(data >= 0, data + half, data - half)
+            out = jnp.sign(adj) * (jnp.abs(adj) // q) * q
+            return ColVal(v.dtype, data=out.astype(v.data.dtype),
+                          validity=v.validity)
+        f = v.data.astype(jnp.float64)
+        m = 10.0 ** scale
+        scaled = f * m
+        if half_even:
+            out = jnp.round(scaled) / m
+        else:
+            out = jnp.where(scaled >= 0, jnp.floor(scaled + 0.5),
+                            jnp.ceil(scaled - 0.5)) / m
+        out = jnp.where(jnp.isfinite(f), out, f)
+        return ColVal(v.dtype, data=out.astype(v.data.dtype),
+                      validity=v.validity)
+    return impl
+
+
+register("round")(_round_impl(half_even=False))
+register("bround")(_round_impl(half_even=True))
+
+
+@register("greatest")
+def _greatest(args, batch, out_type):
+    vs = _dev(args, batch)
+    data, valid = vs[0].data, vs[0].validity
+    for v in vs[1:]:
+        take = v.validity & (~valid | (v.data > data))
+        data = jnp.where(take, v.data.astype(data.dtype), data)
+        valid = valid | v.validity
+    return ColVal(out_type, data=data, validity=valid)
+
+
+@register("least")
+def _least(args, batch, out_type):
+    vs = _dev(args, batch)
+    data, valid = vs[0].data, vs[0].validity
+    for v in vs[1:]:
+        take = v.validity & (~valid | (v.data < data))
+        data = jnp.where(take, v.data.astype(data.dtype), data)
+        valid = valid | v.validity
+    return ColVal(out_type, data=data, validity=valid)

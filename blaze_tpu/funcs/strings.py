@@ -1,0 +1,330 @@
+"""String functions — host pyarrow.compute path.
+
+Parity: spark_strings.rs (783 LoC: concat, concat_ws, instr/locate, lpad/
+rpad, repeat, reverse, split, replace, translate, initcap, substring_index,
+ascii, chr, space) + trim/case/length built-ins mapped by the planner.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.exprs.base import ColVal
+from blaze_tpu.funcs import register
+from blaze_tpu.schema import (BINARY, DataType, Field, INT32, TypeId, UTF8)
+
+
+def _utf8(ts):
+    return UTF8
+
+
+def _int32(ts):
+    return INT32
+
+
+def _host(args, batch) -> List[pa.Array]:
+    return [a.to_host(batch.num_rows) for a in args]
+
+
+def _lit(arr: pa.Array):
+    return arr[0].as_py() if len(arr) and arr[0].is_valid else None
+
+
+@register("concat", _utf8)
+def _concat(args, batch, out_type):
+    arrs = _host(args, batch)
+    out = arrs[0].cast(pa.utf8())
+    for a in arrs[1:]:
+        out = pc.binary_join_element_wise(out, a.cast(pa.utf8()), "")
+    return ColVal.host(UTF8, out)
+
+
+@register("concat_ws", _utf8)
+def _concat_ws(args, batch, out_type):
+    arrs = _host(args, batch)
+    sep = _lit(arrs[0]) or ""
+    parts = [a.cast(pa.utf8()) for a in arrs[1:]]
+    if not parts:
+        return ColVal.host(UTF8, pa.array([""] * batch.num_rows))
+    # Spark concat_ws SKIPS null arguments instead of nulling the result
+    py = []
+    for i in range(batch.num_rows):
+        vals = [p[i].as_py() for p in parts if p[i].is_valid]
+        py.append(sep.join(vals))
+    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+
+
+@register("upper", _utf8)
+def _upper(args, batch, out_type):
+    (a,) = _host(args, batch)
+    return ColVal.host(UTF8, pc.utf8_upper(a))
+
+
+@register("lower", _utf8)
+def _lower(args, batch, out_type):
+    (a,) = _host(args, batch)
+    return ColVal.host(UTF8, pc.utf8_lower(a))
+
+
+@register("trim", _utf8)
+def _trim(args, batch, out_type):
+    arrs = _host(args, batch)
+    if len(arrs) == 1:
+        return ColVal.host(UTF8, pc.utf8_trim_whitespace(arrs[0]))
+    return ColVal.host(UTF8, pc.utf8_trim(arrs[0],
+                                          characters=_lit(arrs[1]) or ""))
+
+
+@register("ltrim", _utf8)
+def _ltrim(args, batch, out_type):
+    arrs = _host(args, batch)
+    if len(arrs) == 1:
+        return ColVal.host(UTF8, pc.utf8_ltrim_whitespace(arrs[0]))
+    return ColVal.host(UTF8, pc.utf8_ltrim(arrs[0],
+                                           characters=_lit(arrs[1]) or ""))
+
+
+@register("rtrim", _utf8)
+def _rtrim(args, batch, out_type):
+    arrs = _host(args, batch)
+    if len(arrs) == 1:
+        return ColVal.host(UTF8, pc.utf8_rtrim_whitespace(arrs[0]))
+    return ColVal.host(UTF8, pc.utf8_rtrim(arrs[0],
+                                           characters=_lit(arrs[1]) or ""))
+
+
+@register("length", _int32)
+@register("char_length", _int32)
+def _length(args, batch, out_type):
+    (a,) = _host(args, batch)
+    if pa.types.is_binary(a.type):
+        return ColVal.host(INT32, pc.binary_length(a).cast(pa.int32()))
+    return ColVal.host(INT32, pc.utf8_length(a).cast(pa.int32()))
+
+
+@register("octet_length", _int32)
+def _octet_length(args, batch, out_type):
+    (a,) = _host(args, batch)
+    return ColVal.host(INT32, pc.binary_length(a).cast(pa.int32()))
+
+
+@register("substring", _utf8)
+@register("substr", _utf8)
+def _substring(args, batch, out_type):
+    arrs = _host(args, batch)
+    s = arrs[0]
+    start = _lit(arrs[1]) or 0
+    length = _lit(arrs[2]) if len(arrs) > 2 else None
+    py = []
+    for x in s:
+        if not x.is_valid:
+            py.append(None)
+            continue
+        v = x.as_py()
+        n = len(v)
+        pos = int(start)
+        st = pos - 1 if pos > 0 else (n + pos if pos < 0 else 0)
+        end = n if length is None else st + int(length)
+        py.append(v[max(st, 0):max(min(end, n), 0)])
+    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+
+
+@register("instr", _int32)
+@register("locate", _int32)
+@register("position", _int32)
+def _instr(args, batch, out_type):
+    arrs = _host(args, batch)
+    # locate(substr, str) vs instr(str, substr): Spark argument orders differ;
+    # the planner normalizes to (str, substr) before reaching here
+    hay, needle = arrs[0], _lit(arrs[1]) or ""
+    found = pc.find_substring(hay, pattern=needle)
+    # arrow: -1 when missing; Spark: 0 missing, 1-based otherwise
+    out = pc.add(found, 1)
+    return ColVal.host(INT32, out.cast(pa.int32()))
+
+
+@register("lpad", _utf8)
+def _lpad(args, batch, out_type):
+    arrs = _host(args, batch)
+    width = _lit(arrs[1]) or 0
+    fill = (_lit(arrs[2]) if len(arrs) > 2 else " ") or " "
+    py = []
+    for x in arrs[0]:
+        if not x.is_valid:
+            py.append(None)
+            continue
+        v = x.as_py()
+        if len(v) >= width:
+            py.append(v[:width])
+        else:
+            pad = (fill * width)[:width - len(v)]
+            py.append(pad + v)
+    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+
+
+@register("rpad", _utf8)
+def _rpad(args, batch, out_type):
+    arrs = _host(args, batch)
+    width = _lit(arrs[1]) or 0
+    fill = (_lit(arrs[2]) if len(arrs) > 2 else " ") or " "
+    py = []
+    for x in arrs[0]:
+        if not x.is_valid:
+            py.append(None)
+            continue
+        v = x.as_py()
+        if len(v) >= width:
+            py.append(v[:width])
+        else:
+            pad = (fill * width)[:width - len(v)]
+            py.append(v + pad)
+    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+
+
+@register("repeat", _utf8)
+def _repeat(args, batch, out_type):
+    arrs = _host(args, batch)
+    n = _lit(arrs[1]) or 0
+    py = [None if not x.is_valid else x.as_py() * max(int(n), 0)
+          for x in arrs[0]]
+    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+
+
+@register("reverse", _utf8)
+def _reverse(args, batch, out_type):
+    (a,) = _host(args, batch)
+    return ColVal.host(UTF8, pc.utf8_reverse(a))
+
+
+@register("split", lambda ts: DataType(TypeId.LIST, children=(
+    Field("item", UTF8),)))
+def _split(args, batch, out_type):
+    arrs = _host(args, batch)
+    import re as _re
+    pattern = _lit(arrs[1]) or ""
+    limit = _lit(arrs[2]) if len(arrs) > 2 else -1
+    prog = _re.compile(pattern)
+    py = []
+    for x in arrs[0]:
+        if not x.is_valid:
+            py.append(None)
+        else:
+            py.append(prog.split(x.as_py(),
+                                 maxsplit=0 if (limit or -1) <= 0
+                                 else int(limit) - 1))
+    return ColVal.host(out_type, pa.array(py, type=pa.list_(pa.utf8())))
+
+
+@register("replace", _utf8)
+def _replace(args, batch, out_type):
+    arrs = _host(args, batch)
+    search = _lit(arrs[1]) or ""
+    repl = (_lit(arrs[2]) if len(arrs) > 2 else "") or ""
+    return ColVal.host(UTF8, pc.replace_substring(arrs[0], pattern=search,
+                                                  replacement=repl))
+
+
+@register("regexp_replace", _utf8)
+def _regexp_replace(args, batch, out_type):
+    arrs = _host(args, batch)
+    pattern = _lit(arrs[1]) or ""
+    repl = (_lit(arrs[2]) if len(arrs) > 2 else "") or ""
+    return ColVal.host(UTF8, pc.replace_substring_regex(
+        arrs[0], pattern=pattern, replacement=repl))
+
+
+@register("regexp_extract", _utf8)
+def _regexp_extract(args, batch, out_type):
+    import re as _re
+    arrs = _host(args, batch)
+    prog = _re.compile(_lit(arrs[1]) or "")
+    group = int(_lit(arrs[2]) or 1) if len(arrs) > 2 else 1
+    py = []
+    for x in arrs[0]:
+        if not x.is_valid:
+            py.append(None)
+            continue
+        m = prog.search(x.as_py())
+        py.append(m.group(group) if m and group <= (m.lastindex or 0) or
+                  (m and group == 0) else "")
+    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+
+
+@register("translate", _utf8)
+def _translate(args, batch, out_type):
+    arrs = _host(args, batch)
+    src = _lit(arrs[1]) or ""
+    dst = _lit(arrs[2]) or ""
+    table = {}
+    for i, ch in enumerate(src):
+        table[ord(ch)] = dst[i] if i < len(dst) else None
+    py = [None if not x.is_valid else x.as_py().translate(table)
+          for x in arrs[0]]
+    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+
+
+@register("initcap", _utf8)
+def _initcap(args, batch, out_type):
+    (a,) = _host(args, batch)
+    py = []
+    for x in a:
+        if not x.is_valid:
+            py.append(None)
+        else:
+            py.append(" ".join(w[:1].upper() + w[1:].lower() if w else w
+                               for w in x.as_py().split(" ")))
+    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+
+
+@register("substring_index", _utf8)
+def _substring_index(args, batch, out_type):
+    arrs = _host(args, batch)
+    delim = _lit(arrs[1]) or ""
+    count = int(_lit(arrs[2]) or 0)
+    py = []
+    for x in arrs[0]:
+        if not x.is_valid:
+            py.append(None)
+            continue
+        v = x.as_py()
+        if not delim or count == 0:
+            py.append("")
+            continue
+        parts = v.split(delim)
+        if count > 0:
+            py.append(delim.join(parts[:count]))
+        else:
+            py.append(delim.join(parts[count:]))
+    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+
+
+@register("ascii", _int32)
+def _ascii(args, batch, out_type):
+    (a,) = _host(args, batch)
+    py = [None if not x.is_valid else (ord(x.as_py()[0]) if x.as_py() else 0)
+          for x in a]
+    return ColVal.host(INT32, pa.array(py, type=pa.int32()))
+
+
+@register("chr", _utf8)
+def _chr(args, batch, out_type):
+    (a,) = _host(args, batch)
+    py = []
+    for x in a:
+        if not x.is_valid:
+            py.append(None)
+        else:
+            code = int(x.as_py()) % 256
+            py.append("" if code == 0 else chr(code))
+    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+
+
+@register("space", _utf8)
+def _space(args, batch, out_type):
+    (a,) = _host(args, batch)
+    py = [None if not x.is_valid else " " * max(int(x.as_py()), 0) for x in a]
+    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))

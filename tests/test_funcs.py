@@ -1,0 +1,195 @@
+"""Scalar-function registry tests — per-function Spark-semantics cases,
+modeled on the reference's ~150 #[test]s across datafusion-ext-functions
+(e.g. spark_dates.rs has 31, SURVEY.md §4)."""
+
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import schema as S
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import col, lit
+from blaze_tpu.funcs import fn, registered_names
+
+
+def make_batch(**cols):
+    arrays, fields = [], []
+    for name, spec in cols.items():
+        arr = spec if isinstance(spec, pa.Array) else pa.array(spec)
+        fields.append(pa.field(name, arr.type))
+        arrays.append(arr)
+    return ColumnBatch.from_arrow(
+        pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields)))
+
+
+def ev(batch, expr):
+    return expr.evaluate(batch).to_host(batch.num_rows).to_pylist()
+
+
+def test_registry_breadth():
+    # the reference registers ~40 ext functions + builtins; require >= 70
+    assert len(registered_names()) >= 70
+
+
+def test_math_basics():
+    b = make_batch(x=[4.0, 9.0, None])
+    assert ev(b, fn("sqrt", col(0))) == [2.0, 3.0, None]
+    assert ev(b, fn("abs", fn("negative", col(0)))) == [4.0, 9.0, None]
+    c = make_batch(x=[1.5, -1.5, 2.5])
+    assert ev(c, fn("ceil", col(0))) == [2, -1, 3]
+    assert ev(c, fn("floor", col(0))) == [1, -2, 2]
+
+
+def test_round_half_up_vs_bround_half_even():
+    b = make_batch(x=[2.5, 3.5, -2.5])
+    assert ev(b, fn("round", col(0))) == [3.0, 4.0, -3.0]
+    assert ev(b, fn("bround", col(0))) == [2.0, 4.0, -2.0]
+    c = make_batch(x=[1.245])
+    assert ev(c, fn("round", col(0), lit(2)))[0] == pytest.approx(1.25)
+
+
+def test_greatest_least_skip_nulls():
+    b = make_batch(x=[1, None, 5], y=[3, 2, None])
+    assert ev(b, fn("greatest", col(0), col(1))) == [3, 2, 5]
+    assert ev(b, fn("least", col(0), col(1))) == [1, 2, 5]
+
+
+DATES = pa.array([datetime.date(2023, 5, 17), datetime.date(2020, 2, 29),
+                  datetime.date(1969, 12, 31), None])
+
+
+def test_date_fields():
+    b = make_batch(d=DATES)
+    assert ev(b, fn("year", col(0))) == [2023, 2020, 1969, None]
+    assert ev(b, fn("month", col(0))) == [5, 2, 12, None]
+    assert ev(b, fn("day", col(0))) == [17, 29, 31, None]
+    assert ev(b, fn("quarter", col(0))) == [2, 1, 4, None]
+    assert ev(b, fn("dayofweek", col(0))) == [4, 7, 4, None]  # Wed,Sat,Wed
+    assert ev(b, fn("dayofyear", col(0))) == [137, 60, 365, None]
+
+
+def test_date_arith():
+    b = make_batch(d=DATES)
+    assert ev(b, fn("date_add", col(0), lit(10)))[0] == datetime.date(2023, 5, 27)
+    assert ev(b, fn("date_sub", col(0), lit(1)))[1] == datetime.date(2020, 2, 28)
+    assert ev(b, fn("last_day", col(0)))[:2] == [datetime.date(2023, 5, 31),
+                                                 datetime.date(2020, 2, 29)]
+    assert ev(b, fn("add_months", col(0), lit(1)))[1] == datetime.date(2020, 3, 29)
+    # end-of-month clamp: Jan 31 + 1 month = Feb 29 (2020 leap)
+    c = make_batch(d=pa.array([datetime.date(2020, 1, 31)]))
+    assert ev(c, fn("add_months", col(0), lit(1))) == [datetime.date(2020, 2, 29)]
+    b2 = make_batch(a=pa.array([datetime.date(2023, 5, 17)]),
+                    b=pa.array([datetime.date(2023, 5, 10)]))
+    assert ev(b2, fn("datediff", col(0), col(1))) == [7]
+
+
+def test_trunc_and_weekofyear():
+    b = make_batch(d=pa.array([datetime.date(2023, 5, 17)]))
+    assert ev(b, fn("trunc", col(0), lit("year"))) == [datetime.date(2023, 1, 1)]
+    assert ev(b, fn("trunc", col(0), lit("month"))) == [datetime.date(2023, 5, 1)]
+    assert ev(b, fn("trunc", col(0), lit("week"))) == [datetime.date(2023, 5, 15)]
+    assert ev(b, fn("weekofyear", col(0))) == [20]
+    # ISO edge: 2021-01-01 is week 53 of 2020
+    c = make_batch(d=pa.array([datetime.date(2021, 1, 1)]))
+    assert ev(c, fn("weekofyear", col(0))) == [53]
+
+
+def test_timestamp_fields_and_trunc():
+    ts = pa.array([datetime.datetime(2023, 5, 17, 13, 45, 59)],
+                  type=pa.timestamp("us"))
+    b = make_batch(t=ts)
+    assert ev(b, fn("hour", col(0))) == [13]
+    assert ev(b, fn("minute", col(0))) == [45]
+    assert ev(b, fn("second", col(0))) == [59]
+    got = ev(b, fn("date_trunc", lit("hour"), col(0)))
+    assert got == [datetime.datetime(2023, 5, 17, 13, 0, 0)]
+
+
+def test_string_functions():
+    b = make_batch(s=["Hello", "wORld", None])
+    assert ev(b, fn("upper", col(0))) == ["HELLO", "WORLD", None]
+    assert ev(b, fn("lower", col(0))) == ["hello", "world", None]
+    assert ev(b, fn("length", col(0))) == [5, 5, None]
+    assert ev(b, fn("reverse", col(0))) == ["olleH", "dlROw", None]
+    assert ev(b, fn("initcap", col(0))) == ["Hello", "World", None]
+    b2 = make_batch(s=["a,b,c"])
+    assert ev(b2, fn("split", col(0), lit(","))) == [["a", "b", "c"]]
+    assert ev(b2, fn("replace", col(0), lit(","), lit("-"))) == ["a-b-c"]
+
+
+def test_concat_ws_skips_nulls():
+    b = make_batch(x=["a", None], y=[None, "b"], z=["c", "d"])
+    got = ev(b, fn("concat_ws", lit("/"), col(0), col(1), col(2)))
+    assert got == ["a/c", "b/d"]
+
+
+def test_substring_lpad_rpad():
+    b = make_batch(s=["hello"])
+    assert ev(b, fn("substring", col(0), lit(2), lit(3))) == ["ell"]
+    assert ev(b, fn("substring", col(0), lit(-3), lit(2))) == ["ll"]
+    assert ev(b, fn("lpad", col(0), lit(8), lit("*"))) == ["***hello"]
+    assert ev(b, fn("rpad", col(0), lit(3))) == ["hel"]
+    assert ev(b, fn("substring_index", col(0), lit("l"), lit(1))) == ["he"]
+    assert ev(b, fn("substring_index", col(0), lit("l"), lit(-1))) == ["o"]
+
+
+def test_instr_1_based():
+    b = make_batch(s=["hello", "world", None])
+    assert ev(b, fn("instr", col(0), lit("l"))) == [3, 4, None]
+    assert ev(b, fn("instr", col(0), lit("z"))) == [0, 0, None]
+
+
+def test_crypto():
+    b = make_batch(s=["abc"])
+    assert ev(b, fn("md5", col(0))) == ["900150983cd24fb0d6963f7d28e17f72"]
+    assert ev(b, fn("sha2", col(0), lit(256))) == [
+        "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"]
+    assert ev(b, fn("crc32", col(0))) == [891568578]
+
+
+def test_hash_matches_kernel():
+    """hash()/xxhash64() expression == shuffle hash kernels (bit-exact)."""
+    import jax.numpy as jnp
+    from blaze_tpu.kernels import hashing as H
+    b = make_batch(x=pa.array([1, 2, 3], type=pa.int64()))
+    got = ev(b, fn("hash", col(0)))
+    want = H.hash_columns([(np.array([1, 2, 3], dtype=np.int64), None,
+                            "int64")], seed=42, xp=np, algo="murmur3")
+    assert got == [int(x) for x in want]
+
+
+def test_get_json_object():
+    b = make_batch(j=['{"a": {"b": 2}, "xs": [1, 2, 3]}', "oops", None])
+    assert ev(b, fn("get_json_object", col(0), lit("$.a.b"))) == ["2", None, None]
+    assert ev(b, fn("get_json_object", col(0), lit("$.xs[1]"))) == ["2", None, None]
+    assert ev(b, fn("get_json_object", col(0), lit("$.a"))) == \
+        ['{"b": 2}', None, None]
+    assert ev(b, fn("get_json_object", col(0), lit("$.xs[*]"))) == \
+        ["[1, 2, 3]", None, None]
+    assert ev(b, fn("get_json_object", col(0), lit("$.zzz"))) == [None, None, None]
+
+
+def test_arrays_and_maps():
+    b = make_batch(x=[1, 4], y=[2, 5], z=[3, 6])
+    assert ev(b, fn("make_array", col(0), col(1), col(2))) == [[1, 2, 3],
+                                                               [4, 5, 6]]
+    lb = make_batch(xs=pa.array([[1, 2, 2], None], type=pa.list_(pa.int64())))
+    assert ev(lb, fn("array_distinct", col(0))) == [[1, 2], None]
+    assert ev(lb, fn("size", col(0))) == [3, -1]
+    assert ev(lb, fn("array_max", col(0))) == [2, None]
+    mb = make_batch(s=["a:1,b:2,a:3"])
+    assert ev(mb, fn("str_to_map", col(0))) == [[("a", "3"), ("b", "2")]]
+    kb = make_batch(m=pa.array([[("k1", 10), ("k2", 20)]],
+                               type=pa.map_(pa.utf8(), pa.int64())))
+    assert ev(kb, fn("map_keys", col(0))) == [["k1", "k2"]]
+    assert ev(kb, fn("element_at", col(0), lit("k2"))) == [20]
+
+
+def test_decimal_helpers():
+    dec = pa.array([None], type=pa.decimal128(10, 2)).fill_null(0)
+    b = make_batch(d=pa.array([1550, -99], type=pa.int64()))
+    got = ev(b, fn("make_decimal", col(0), out_type=S.decimal(10, 2)))
+    import decimal as pydec
+    assert got == [pydec.Decimal("15.50"), pydec.Decimal("-0.99")]
