@@ -1,0 +1,107 @@
+"""ctypes loaders for the native libraries.
+
+The codec library accelerates the framed-IPC hot path (shuffle/spill
+compression); the host-bridge library is the embedding surface for
+non-Python host engines.  Both degrade gracefully: pure-Python zstd when
+the codec .so is absent, in-process python calls when the bridge is.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SEARCH = [
+    os.path.join(_HERE, "native", "build"),
+    os.path.join(_HERE, "native", "lib"),
+    os.environ.get("BLAZE_TPU_NATIVE_DIR", ""),
+]
+
+
+def _find(name: str) -> Optional[str]:
+    for d in _SEARCH:
+        if not d:
+            continue
+        p = os.path.join(d, name)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+class _Codec:
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.blaze_ipc_compress_frame.restype = ctypes.c_int64
+        lib.blaze_ipc_compress_frame.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+        lib.blaze_ipc_decompress.restype = ctypes.c_int64
+        lib.blaze_ipc_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
+        lib.blaze_ipc_decompressed_size.restype = ctypes.c_int64
+        lib.blaze_ipc_decompressed_size.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64]
+        lib.blaze_free.argtypes = [ctypes.c_void_p]
+
+    def compress_frame(self, payload: bytes, level: int = 1) -> bytes:
+        """Whole frame (header + compressed payload)."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.blaze_ipc_compress_frame(payload, len(payload), level,
+                                               ctypes.byref(out))
+        if n < 0:
+            raise RuntimeError("native zstd compression failed")
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.blaze_free(out)
+
+    def decompress(self, payload: bytes) -> bytes:
+        size = self._lib.blaze_ipc_decompressed_size(payload, len(payload))
+        if size < 0:
+            raise RuntimeError("unknown decompressed size")
+        buf = ctypes.create_string_buffer(int(size))
+        n = self._lib.blaze_ipc_decompress(payload, len(payload), buf, size)
+        if n < 0:
+            raise RuntimeError("native zstd decompression failed")
+        return buf.raw[:n]
+
+
+_codec: Optional[_Codec] = None
+_codec_checked = False
+
+
+def get_codec() -> Optional[_Codec]:
+    global _codec, _codec_checked
+    if not _codec_checked:
+        _codec_checked = True
+        path = _find("libblaze_ipc_codec.so")
+        if path:
+            try:
+                _codec = _Codec(ctypes.CDLL(path))
+            except OSError:
+                _codec = None
+    return _codec
+
+
+def get_host_bridge() -> Optional[ctypes.CDLL]:
+    """The C-ABI entry-point library (tests exercise it in-process)."""
+    path = _find("libblaze_host_bridge.so")
+    if not path:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.blaze_call_native.restype = ctypes.c_int64
+    lib.blaze_call_native.argtypes = [ctypes.c_char_p,
+                                      ctypes.POINTER(ctypes.c_char_p)]
+    lib.blaze_next_batch.restype = ctypes.c_int64
+    lib.blaze_next_batch.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_char_p)]
+    lib.blaze_finalize_native.restype = ctypes.c_int64
+    lib.blaze_finalize_native.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p)]
+    lib.blaze_free_buffer.argtypes = [ctypes.c_void_p]
+    return lib

@@ -1,0 +1,72 @@
+"""Python half of the C-ABI host bridge.
+
+The C++ library (native/src/host_bridge.cpp) embeds CPython and calls
+these four functions — the exec.rs entry-point bodies.  Handles are
+process-global ints mapping to live NativeExecutionRuntimes (the reference
+stashes a raw pointer in the JVM wrapper; a handle table is the safe
+equivalent).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from typing import Dict, Optional
+
+import pyarrow as pa
+
+from blaze_tpu.bridge.runtime import NativeExecutionRuntime
+
+_lock = threading.Lock()
+_handles: Dict[int, NativeExecutionRuntime] = {}
+_next_handle = 1
+
+
+def call_native(task_definition_json: str) -> int:
+    """(ref exec.rs:42 callNative)"""
+    global _next_handle
+    rt = NativeExecutionRuntime(task_definition_json).start()
+    with _lock:
+        handle = _next_handle
+        _next_handle += 1
+        _handles[handle] = rt
+    return handle
+
+
+def next_batch(handle: int) -> Optional[bytes]:
+    """Arrow IPC stream bytes for one batch; None = end (ref exec.rs:122)."""
+    with _lock:
+        rt = _handles.get(handle)
+    if rt is None:
+        raise KeyError(f"invalid native handle {handle}")
+    rb = rt.next_batch()
+    if rb is None:
+        return None
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    return sink.getvalue()
+
+
+def finalize_native(handle: int) -> str:
+    """Tear down; returns the metric tree as JSON (ref exec.rs:133 +
+    metrics.rs:22)."""
+    with _lock:
+        rt = _handles.pop(handle, None)
+    if rt is None:
+        return "{}"
+    metrics = rt.finalize()
+    return json.dumps(metrics.to_dict())
+
+
+def on_exit() -> None:
+    """(ref exec.rs:144 onExit)"""
+    with _lock:
+        handles = list(_handles.items())
+        _handles.clear()
+    for _, rt in handles:
+        try:
+            rt.finalize()
+        except Exception:
+            pass

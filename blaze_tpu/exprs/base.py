@@ -71,6 +71,10 @@ class ColVal:
     def to_column(self, capacity: int):
         if self.is_device:
             return DeviceColumn(self.dtype, self.data, self.validity)
+        if self.dtype.is_fixed_width:
+            # keep the invariant: fixed-width columns live on device
+            v = self.to_device(capacity)
+            return DeviceColumn(v.dtype, v.data, v.validity)
         return HostColumn(self.dtype, self.array)
 
     def as_mask(self, batch: ColumnBatch) -> jax.Array:

@@ -1,0 +1,82 @@
+"""Table sinks: native Parquet write.
+
+Parity: parquet_sink_exec.rs:532 (native write of Hive-insert data through
+host output streams; NativeParquetSinkUtils) — here pyarrow's C++ parquet
+writer plays the native-writer role.  Hive-style partitioned layout when
+partition_cols given.  ORC output is gated on pyarrow's ORC writer
+(orc_sink_exec.rs:568 parity).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
+from blaze_tpu.schema import Schema
+
+
+class ParquetSinkExec(ExecutionPlan):
+
+    def __init__(self, child: ExecutionPlan, path: str,
+                 partition_cols: Optional[Sequence[str]] = None,
+                 compression: str = "zstd"):
+        super().__init__([child])
+        self.path = path
+        self.partition_cols = list(partition_cols or [])
+        self.compression = compression
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int) -> BatchIterator:
+        child = self.children[0]
+        batches = [b.compact().to_arrow() for b in child.execute(partition)]
+        batches = [b for b in batches if b.num_rows]
+        if not batches:
+            return iter(())
+        table = pa.Table.from_batches(batches)
+        rows = table.num_rows
+        if self.partition_cols:
+            pq.write_to_dataset(table, self.path,
+                                partition_cols=self.partition_cols,
+                                compression=self.compression,
+                                basename_template=(
+                                    f"part-{partition}-{{i}}.parquet"))
+        else:
+            os.makedirs(self.path, exist_ok=True)
+            out = os.path.join(self.path, f"part-{partition:05d}.parquet")
+            pq.write_table(table, out, compression=self.compression)
+        self.metrics.add("output_rows", rows)
+        return iter(())
+
+
+class OrcSinkExec(ExecutionPlan):
+    """(ref orc_sink_exec.rs:568)"""
+
+    def __init__(self, child: ExecutionPlan, path: str):
+        super().__init__([child])
+        self.path = path
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int) -> BatchIterator:
+        from pyarrow import orc
+        child = self.children[0]
+        batches = [b.compact().to_arrow() for b in child.execute(partition)]
+        batches = [b for b in batches if b.num_rows]
+        if not batches:
+            return iter(())
+        table = pa.Table.from_batches(batches)
+        os.makedirs(self.path, exist_ok=True)
+        out = os.path.join(self.path, f"part-{partition:05d}.orc")
+        orc.write_table(table, out)
+        self.metrics.add("output_rows", table.num_rows)
+        return iter(())

@@ -1,0 +1,127 @@
+"""Expression decoding: IR dicts -> PhysicalExpr trees.
+
+Parity: try_parse_physical_expr (ref auron-planner/src/planner.rs:924)
+pattern-matching the PhysicalExprNode oneof (~35 kinds, auron.proto:60-141)
+plus from_proto_binary_op (ref src/lib.rs:73).
+
+Expression kinds (the `kind` discriminator):
+  column, literal, binary, is_null, is_not_null, not, case, if, coalesce,
+  in_list, cast, try_cast, like, rlike, string_starts_with,
+  string_ends_with, string_contains, scalar_function, named_struct,
+  get_indexed_field, get_map_value, row_num, spark_partition_id,
+  monotonically_increasing_id, rand, randn, bloom_filter_might_contain,
+  scalar_subquery, udf
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from blaze_tpu.exprs import (BinaryExpr, BloomFilterMightContain,
+                             BoundReference, CaseWhen, Cast, Coalesce,
+                             GetIndexedField, GetMapValue, If, InList,
+                             IsNotNull, IsNull, Like, Literal,
+                             MonotonicallyIncreasingId, NamedStruct, Not,
+                             PhysicalExpr, RLike, Rand, RowNum,
+                             ScalarSubqueryWrapper, SparkPartitionId,
+                             StringPredicate, TryCast, UDFWrapper)
+from blaze_tpu.funcs import ScalarFunctionExpr
+from blaze_tpu.plan.types import type_from_dict
+from blaze_tpu.schema import Schema
+
+
+def expr_from_dict(d: Dict[str, Any], schema: Optional[Schema] = None
+                   ) -> PhysicalExpr:
+    k = d["kind"]
+    if k == "column":
+        idx = d.get("index")
+        if idx is None:
+            if schema is None:
+                raise ValueError("named column ref requires an input schema")
+            idx = schema.index_of(d["name"])
+        return BoundReference(idx, d.get("name", ""))
+    if k == "literal":
+        return Literal(d.get("value"), type_from_dict(d["type"]))
+    if k == "binary":
+        return BinaryExpr(d["op"], expr_from_dict(d["l"], schema),
+                          expr_from_dict(d["r"], schema))
+    if k == "is_null":
+        return IsNull(expr_from_dict(d["child"], schema))
+    if k == "is_not_null":
+        return IsNotNull(expr_from_dict(d["child"], schema))
+    if k == "not":
+        return Not(expr_from_dict(d["child"], schema))
+    if k == "case":
+        branches = tuple((expr_from_dict(w, schema), expr_from_dict(t, schema))
+                         for w, t in d["branches"])
+        other = (expr_from_dict(d["else"], schema)
+                 if d.get("else") is not None else None)
+        return CaseWhen(branches, other)
+    if k == "if":
+        return If(expr_from_dict(d["cond"], schema),
+                  expr_from_dict(d["then"], schema),
+                  expr_from_dict(d["else"], schema))
+    if k == "coalesce":
+        return Coalesce(tuple(expr_from_dict(a, schema) for a in d["args"]))
+    if k == "in_list":
+        return InList(expr_from_dict(d["child"], schema),
+                      tuple(d["values"]), d.get("negated", False))
+    if k in ("cast", "try_cast"):
+        cls = Cast if k == "cast" else TryCast
+        return cls(expr_from_dict(d["child"], schema),
+                   type_from_dict(d["type"]))
+    if k == "like":
+        return Like(expr_from_dict(d["child"], schema), d["pattern"],
+                    d.get("negated", False), d.get("case_insensitive", False))
+    if k == "rlike":
+        return RLike(expr_from_dict(d["child"], schema), d["pattern"])
+    if k in ("string_starts_with", "string_ends_with", "string_contains"):
+        kind = k.replace("string_", "")
+        return StringPredicate(kind, expr_from_dict(d["child"], schema),
+                               d["pattern"])
+    if k == "scalar_function":
+        args = tuple(expr_from_dict(a, schema) for a in d.get("args", ()))
+        out_t = (type_from_dict(d["return_type"])
+                 if d.get("return_type") else None)
+        return ScalarFunctionExpr(d["name"], args, out_t)
+    if k == "named_struct":
+        return NamedStruct(tuple(d["names"]),
+                           tuple(expr_from_dict(a, schema)
+                                 for a in d["args"]))
+    if k == "get_indexed_field":
+        return GetIndexedField(expr_from_dict(d["child"], schema),
+                               d["index"], type_from_dict(d["type"]))
+    if k == "get_map_value":
+        return GetMapValue(expr_from_dict(d["child"], schema), d["key"],
+                           type_from_dict(d["type"]))
+    if k == "row_num":
+        return RowNum()
+    if k == "spark_partition_id":
+        return SparkPartitionId()
+    if k == "monotonically_increasing_id":
+        return MonotonicallyIncreasingId()
+    if k in ("rand", "randn"):
+        return Rand(d.get("seed", 0), normal=(k == "randn"))
+    if k == "bloom_filter_might_contain":
+        return BloomFilterMightContain(d["uuid"],
+                                       expr_from_dict(d["value"], schema))
+    if k == "scalar_subquery":
+        return ScalarSubqueryWrapper(d["uuid"], type_from_dict(d["type"]))
+    if k == "udf":
+        from blaze_tpu.bridge.resource import get_resource
+        fn = get_resource(f"udf://{d['name']}")
+        if fn is None:
+            raise KeyError(f"UDF {d['name']!r} not registered in the "
+                           f"resource map (udf://{d['name']})")
+        return UDFWrapper(d["name"], fn,
+                          tuple(expr_from_dict(a, schema)
+                                for a in d.get("args", ())),
+                          type_from_dict(d["type"]))
+    raise ValueError(f"unknown expression kind {k!r}")
+
+
+def sort_spec_from_dict(d: Dict[str, Any], schema: Optional[Schema] = None):
+    """{expr, descending, nulls_first} -> SortExec spec tuple."""
+    return (expr_from_dict(d["expr"], schema),
+            bool(d.get("descending", False)),
+            bool(d.get("nulls_first", not d.get("descending", False))))

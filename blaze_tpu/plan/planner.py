@@ -1,0 +1,270 @@
+"""Physical planner: plan IR dicts -> operator trees.
+
+Parity: PhysicalPlanner::create_plan (ref auron-planner/src/planner.rs:
+122-922) pattern-matching the PhysicalPlanNode oneof (28 operators,
+auron.proto:27-56), parse_protobuf_partitioning (planner.rs:1201) and
+TaskDefinition decoding (auron.proto:814, rt.rs:79-90).
+
+Node kinds: parquet_scan, memory_scan, filter, project, filter_project,
+sort, limit, union, rename_columns, expand, empty_partitions, debug,
+hash_agg, sort_agg, sort_merge_join, hash_join, broadcast_join, window,
+generate, shuffle_writer, rss_shuffle_writer, ipc_reader, ipc_writer,
+ffi_reader, coalesce_batches, parquet_sink.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from blaze_tpu.ops import (AggExec, DebugExec, EmptyPartitionsExec,
+                           ExpandExec, FilterExec, FilterProjectExec,
+                           GenerateExec, LimitExec, MemoryScanExec,
+                           ParquetScanExec, ProjectExec, RenameColumnsExec,
+                           SortExec, UnionExec, WindowExec)
+from blaze_tpu.ops.agg import AggExecMode, AggMode, make_agg
+from blaze_tpu.ops.agg.exec import AggExec as _AggExec
+from blaze_tpu.ops.base import CoalesceStream, ExecutionPlan
+from blaze_tpu.ops.generate import (ExplodeGenerator, JsonTupleGenerator,
+                                    UDTFGenerator)
+from blaze_tpu.ops.joins import (BroadcastJoinExec, JoinType,
+                                 ShuffledHashJoinExec, SortMergeJoinExec)
+from blaze_tpu.ops.window import (LeadLagFunc, NthValueFunc, RankFunc,
+                                  WindowAggFunc, WindowRankType)
+from blaze_tpu.plan.exprs import expr_from_dict, sort_spec_from_dict
+from blaze_tpu.plan.types import schema_from_dict
+from blaze_tpu.schema import Schema
+from blaze_tpu.shuffle import (FFIReaderExec, HashPartitioning, IpcReaderExec,
+                               IpcWriterExec, LocalShuffleExchange,
+                               Partitioning, RangePartitioning,
+                               RoundRobinPartitioning, RssShuffleWriterExec,
+                               ShuffleWriterExec, SinglePartitioning)
+
+
+class CoalesceBatchesExec(ExecutionPlan):
+    """Explicit re-batching node (ref CoalesceStream auto-wrap,
+    rt.rs:160-166; also a plan-addressable node for parity)."""
+
+    def __init__(self, child: ExecutionPlan, batch_size: Optional[int] = None):
+        super().__init__([child])
+        self._batch_size = batch_size
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int):
+        return iter(CoalesceStream(self.children[0].execute(partition),
+                                   self._batch_size, metrics=self.metrics))
+
+
+def create_plan(d: Dict[str, Any]) -> ExecutionPlan:
+    """Decode one plan node (and recursively its children)."""
+    k = d["kind"]
+
+    if k == "parquet_scan":
+        schema = schema_from_dict(d["schema"])
+        pred = (expr_from_dict(d["predicate"], schema)
+                if d.get("predicate") else None)
+        return ParquetScanExec(schema, d["file_groups"],
+                               projection=d.get("projection"),
+                               predicate=pred)
+    if k == "memory_scan":
+        import pyarrow as pa
+        schema = schema_from_dict(d["schema"])
+        from blaze_tpu.bridge.resource import get_resource
+        table = get_resource(d["resource_id"])
+        if table is None:
+            raise KeyError(f"memory_scan resource {d['resource_id']!r}")
+        return MemoryScanExec.from_arrow(table,
+                                         d.get("num_partitions", 1))
+    if k == "ipc_reader":
+        return IpcReaderExec(d["resource_id"], schema_from_dict(d["schema"]),
+                             d.get("num_partitions", 1))
+    if k == "ffi_reader":
+        return FFIReaderExec(d["resource_id"], schema_from_dict(d["schema"]),
+                             d.get("num_partitions", 1))
+    if k == "empty_partitions":
+        return EmptyPartitionsExec(schema_from_dict(d["schema"]),
+                                   d.get("num_partitions", 1))
+
+    child = create_plan(d["input"]) if "input" in d else None
+    in_schema = child.schema if child is not None else None
+
+    if k == "filter":
+        preds = [expr_from_dict(p, in_schema) for p in d["predicates"]]
+        return FilterExec(child, preds)
+    if k == "project":
+        exprs = [expr_from_dict(e, in_schema) for e in d["exprs"]]
+        return ProjectExec(child, exprs, d["names"])
+    if k == "filter_project":
+        preds = [expr_from_dict(p, in_schema) for p in d["predicates"]]
+        exprs = [expr_from_dict(e, in_schema) for e in d["exprs"]]
+        return FilterProjectExec(child, preds, exprs, d["names"])
+    if k == "sort":
+        specs = [sort_spec_from_dict(s, in_schema) for s in d["specs"]]
+        return SortExec(child, specs, fetch=d.get("fetch"))
+    if k == "limit":
+        return LimitExec(child, d["limit"])
+    if k == "union":
+        children = [create_plan(c) for c in d["inputs"]]
+        return UnionExec(children)
+    if k == "rename_columns":
+        return RenameColumnsExec(child, d["names"])
+    if k == "expand":
+        projections = [[expr_from_dict(e, in_schema) for e in proj]
+                       for proj in d["projections"]]
+        return ExpandExec(child, projections, d["names"])
+    if k == "debug":
+        return DebugExec(child, d.get("tag", "debug"))
+    if k == "coalesce_batches":
+        return CoalesceBatchesExec(child, d.get("batch_size"))
+
+    if k in ("hash_agg", "sort_agg"):
+        groups = [(expr_from_dict(g["expr"], in_schema), g["name"])
+                  for g in d.get("groupings", [])]
+        aggs = []
+        for a in d.get("aggs", []):
+            children = [expr_from_dict(c, in_schema)
+                        for c in a.get("args", [])]
+            fn = make_agg(a["fn"], children, **a.get("options", {}))
+            aggs.append((fn, AggMode(a.get("mode", "partial")), a["name"]))
+        mode = (AggExecMode.HASH_AGG if k == "hash_agg"
+                else AggExecMode.SORT_AGG)
+        return AggExec(child, groups, aggs, mode)
+
+    if k in ("sort_merge_join", "hash_join", "broadcast_join"):
+        left = create_plan(d["left"])
+        right = create_plan(d["right"])
+        lkeys = [expr_from_dict(e, left.schema) for e in d["left_keys"]]
+        rkeys = [expr_from_dict(e, right.schema) for e in d["right_keys"]]
+        jt = JoinType(d.get("join_type", "inner"))
+        flt = None
+        if d.get("join_filter"):
+            flt = expr_from_dict(d["join_filter"])  # bound on joined schema
+        cls = {"sort_merge_join": SortMergeJoinExec,
+               "hash_join": ShuffledHashJoinExec,
+               "broadcast_join": BroadcastJoinExec}[k]
+        kw = dict(build_side=d.get("build_side", "right"), join_filter=flt)
+        if k == "broadcast_join" and d.get("broadcast_id"):
+            kw["broadcast_id"] = d["broadcast_id"]
+        return cls(left, right, lkeys, rkeys, jt, **kw)
+
+    if k == "window":
+        funcs = []
+        for w in d["functions"]:
+            wk = w["kind"]
+            if wk in [t.value for t in WindowRankType]:
+                funcs.append(RankFunc(w["name"], WindowRankType(wk)))
+            elif wk in ("lead", "lag"):
+                off = w.get("offset", 1)
+                funcs.append(LeadLagFunc(
+                    w["name"], expr_from_dict(w["expr"], in_schema),
+                    off if wk == "lead" else -off, w.get("default")))
+            elif wk == "nth_value":
+                funcs.append(NthValueFunc(
+                    w["name"], expr_from_dict(w["expr"], in_schema),
+                    w.get("n", 1)))
+            elif wk == "agg":
+                children = [expr_from_dict(c, in_schema)
+                            for c in w.get("args", [])]
+                funcs.append(WindowAggFunc(
+                    w["name"], make_agg(w["fn"], children),
+                    running=w.get("running", True)))
+            else:
+                raise ValueError(f"unknown window function kind {wk!r}")
+        part = [expr_from_dict(e, in_schema)
+                for e in d.get("partition_by", [])]
+        order = [sort_spec_from_dict(s, in_schema)
+                 for s in d.get("order_by", [])]
+        return WindowExec(child, funcs, part, order,
+                          group_limit=d.get("group_limit"))
+
+    if k == "generate":
+        g = d["generator"]
+        gk = g["kind"]
+        if gk in ("explode", "posexplode"):
+            gen = ExplodeGenerator(expr_from_dict(g["child"], in_schema),
+                                   position=(gk == "posexplode"),
+                                   outer=g.get("outer", False))
+        elif gk == "json_tuple":
+            gen = JsonTupleGenerator(expr_from_dict(g["child"], in_schema),
+                                     g["fields"])
+        elif gk == "udtf":
+            from blaze_tpu.bridge.resource import get_resource
+            from blaze_tpu.plan.types import field_from_dict
+            fn = get_resource(f"udtf://{g['name']}")
+            gen = UDTFGenerator(
+                args=[expr_from_dict(a, in_schema)
+                      for a in g.get("args", [])],
+                fn=fn, fields=[field_from_dict(f) for f in g["fields"]])
+        else:
+            raise ValueError(f"unknown generator kind {gk!r}")
+        return GenerateExec(child, gen, d.get("required_cols"),
+                            outer=g.get("outer", False))
+
+    if k == "shuffle_writer":
+        part = partitioning_from_dict(d["partitioning"], in_schema)
+        return ShuffleWriterExec(child, part, d["data_file"], d["index_file"])
+    if k == "rss_shuffle_writer":
+        from blaze_tpu.bridge.resource import get_resource
+        part = partitioning_from_dict(d["partitioning"], in_schema)
+        writer = get_resource(d["rss_resource_id"])
+        return RssShuffleWriterExec(child, part, writer)
+    if k == "local_exchange":
+        part = partitioning_from_dict(d["partitioning"], in_schema)
+        return LocalShuffleExchange(child, part,
+                                    stage_id=d.get("stage_id", 0))
+    if k == "ipc_writer":
+        from blaze_tpu.bridge.resource import get_resource
+        sink = get_resource(d["sink_resource_id"])
+        return IpcWriterExec(child, sink)
+    if k == "parquet_sink":
+        from blaze_tpu.ops.sink import ParquetSinkExec
+        return ParquetSinkExec(child, d["path"],
+                               partition_cols=d.get("partition_cols"))
+
+    raise ValueError(f"unknown plan node kind {k!r}")
+
+
+def partitioning_from_dict(d: Dict[str, Any],
+                           schema: Optional[Schema]) -> Partitioning:
+    """(ref parse_protobuf_partitioning, planner.rs:1201)"""
+    k = d["kind"]
+    if k == "hash":
+        exprs = [expr_from_dict(e, schema) for e in d["exprs"]]
+        return HashPartitioning(exprs, d["num_partitions"])
+    if k == "round_robin":
+        return RoundRobinPartitioning(d["num_partitions"])
+    if k == "single":
+        return SinglePartitioning()
+    if k == "range":
+        import base64
+        import io
+        import pyarrow as pa
+        specs = [sort_spec_from_dict(s, schema) for s in d["specs"]]
+        with pa.ipc.open_stream(io.BytesIO(
+                base64.b64decode(d["bounds_ipc"]))) as r:
+            bounds = next(iter(r))
+        return RangePartitioning(specs, d["num_partitions"], bounds)
+    raise ValueError(f"unknown partitioning kind {k!r}")
+
+
+# ---------------------------------------------------------------------------
+# TaskDefinition (ref auron.proto:814, rt.rs:79-90)
+# ---------------------------------------------------------------------------
+
+def decode_task_definition(data) -> Dict[str, Any]:
+    if isinstance(data, (bytes, bytearray)):
+        data = data.decode("utf-8")
+    if isinstance(data, str):
+        data = json.loads(data)
+    return data
+
+
+def plan_to_json(d: Dict[str, Any]) -> str:
+    return json.dumps(d, separators=(",", ":"))
+
+
+def plan_from_json(s) -> Dict[str, Any]:
+    return decode_task_definition(s)

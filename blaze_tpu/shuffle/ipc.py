@@ -38,6 +38,11 @@ def _get_codec() -> int:
 
 def _compress(codec: int, payload: bytes) -> bytes:
     if codec == CODEC_ZSTD:
+        from blaze_tpu.bridge.native import get_codec
+        native = get_codec()
+        if native is not None:
+            # native frame includes the header; strip it (caller re-adds)
+            return native.compress_frame(payload, 1)[_HEADER.size:]
         import zstandard
         return zstandard.ZstdCompressor(level=1).compress(payload)
     return payload
@@ -45,6 +50,13 @@ def _compress(codec: int, payload: bytes) -> bytes:
 
 def _decompress(codec: int, payload: bytes) -> bytes:
     if codec == CODEC_ZSTD:
+        from blaze_tpu.bridge.native import get_codec
+        native = get_codec()
+        if native is not None:
+            try:
+                return native.decompress(payload)
+            except RuntimeError:
+                pass  # streaming-format frame: fall through to python zstd
         import zstandard
         return zstandard.ZstdDecompressor().decompress(payload)
     return payload

@@ -1,0 +1,187 @@
+// Host-engine bridge: the libauron.so analog.
+//
+// Parity: the reference exports exactly four JNI entry points from its
+// native engine (ref auron-core/.../jni/JniBridge.java:49-55 natives;
+// native-engine/auron/src/exec.rs:42 callNative, :122 nextBatch,
+// :133 finalizeNative, :144 onExit).  This library exports the same four
+// operations as a plain C ABI so ANY host engine (a JVM via a thin JNI
+// shim, or a C++ service) can drive the TPU engine:
+//
+//   int64_t blaze_call_native(const char* task_definition_json, char** err)
+//   int64_t blaze_next_batch(int64_t handle, uint8_t** data, char** err)
+//   int64_t blaze_finalize_native(int64_t handle, char** metrics_json,
+//                                 char** err)
+//   void    blaze_on_exit(void)
+//
+// Internally it embeds CPython once per process (the analog of exec.rs's
+// once-per-process init of logging/session/memmgr) and drives
+// blaze_tpu.bridge.runtime.NativeExecutionRuntime, which owns the JAX/XLA
+// client.  Batches cross the boundary as Arrow IPC stream bytes; the
+// zero-copy Arrow C-Data handoff (AuronCallNativeWrapper.java:145
+// importBatch) is the drop-in upgrade once the host links arrow's abi.h.
+//
+// Panic safety: every entry point catches Python exceptions and returns
+// them through `err` (the handle_unwinded_scope analog, exec.rs:50).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::once_flag g_init_once;
+bool g_we_initialized = false;
+
+void ensure_python() {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_we_initialized = true;
+      // release the GIL acquired by Py_Initialize so entry points can
+      // take it from any host thread
+      PyEval_SaveThread();
+    }
+  });
+}
+
+char* dup_cstr(const std::string& s) {
+  char* out = (char*)malloc(s.size() + 1);
+  memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+// Fetch the pending Python error as a string (clears the error).
+std::string fetch_error() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string out = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) out = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return out;
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+PyObject* bridge_module() {
+  // blaze_tpu.bridge.native_entry hosts the python side of this ABI
+  return PyImport_ImportModule("blaze_tpu.bridge.native_entry");
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a runtime for one task; returns handle > 0, or 0 with *err set.
+int64_t blaze_call_native(const char* task_definition_json, char** err) {
+  ensure_python();
+  Gil gil;
+  PyObject* mod = bridge_module();
+  if (!mod) {
+    *err = dup_cstr(fetch_error());
+    return 0;
+  }
+  PyObject* r = PyObject_CallMethod(mod, "call_native", "s",
+                                    task_definition_json);
+  Py_DECREF(mod);
+  if (!r) {
+    *err = dup_cstr(fetch_error());
+    return 0;
+  }
+  int64_t handle = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return handle;
+}
+
+// Next batch as Arrow IPC stream bytes (schema + one batch).
+// Returns byte length (>0), 0 on end-of-stream, -1 on error.
+// Caller frees *data with blaze_free_buffer.
+int64_t blaze_next_batch(int64_t handle, uint8_t** data, char** err) {
+  Gil gil;
+  PyObject* mod = bridge_module();
+  if (!mod) {
+    *err = dup_cstr(fetch_error());
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(mod, "next_batch", "L",
+                                    (long long)handle);
+  Py_DECREF(mod);
+  if (!r) {
+    *err = dup_cstr(fetch_error());
+    return -1;
+  }
+  if (r == Py_None) {
+    Py_DECREF(r);
+    return 0;
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    *err = dup_cstr(fetch_error());
+    return -1;
+  }
+  uint8_t* out = (uint8_t*)malloc((size_t)len);
+  memcpy(out, buf, (size_t)len);
+  Py_DECREF(r);
+  *data = out;
+  return (int64_t)len;
+}
+
+// Tear down the task runtime; returns 0 and sets *metrics_json to the
+// metric tree (ref metrics.rs:22 update_metric_node push-on-finalize).
+int64_t blaze_finalize_native(int64_t handle, char** metrics_json,
+                              char** err) {
+  Gil gil;
+  PyObject* mod = bridge_module();
+  if (!mod) {
+    *err = dup_cstr(fetch_error());
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(mod, "finalize_native", "L",
+                                    (long long)handle);
+  Py_DECREF(mod);
+  if (!r) {
+    *err = dup_cstr(fetch_error());
+    return -1;
+  }
+  const char* s = PyUnicode_AsUTF8(r);
+  if (metrics_json) *metrics_json = dup_cstr(s ? s : "{}");
+  Py_DECREF(r);
+  return 0;
+}
+
+void blaze_free_buffer(void* p) { free(p); }
+
+// Process teardown (ref exec.rs:144 onExit).
+void blaze_on_exit(void) {
+  if (Py_IsInitialized()) {
+    Gil gil;
+    PyObject* mod = bridge_module();
+    if (mod) {
+      PyObject* r = PyObject_CallMethod(mod, "on_exit", NULL);
+      Py_XDECREF(r);
+      Py_DECREF(mod);
+    } else {
+      PyErr_Clear();
+    }
+  }
+}
+
+}  // extern "C"

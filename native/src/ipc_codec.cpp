@@ -1,0 +1,63 @@
+// Framed zstd block codec — native hot path for shuffle/spill IO.
+//
+// Parity: datafusion-ext-commons/src/io/ipc_compression.rs (the reference
+// compresses shuffle blocks in native Rust; this is the C++ equivalent used
+// by blaze_tpu/shuffle/ipc.py through ctypes, replacing the Python
+// `zstandard` round trip on the hot path).  Frame layout matches ipc.py:
+//   [u8 codec (1 = zstd)] [u32le length] [payload]
+//
+// C ABI only — loadable from ctypes without pybind11.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+#include <zstd.h>
+
+extern "C" {
+
+// Compress `src` into a malloc'd frame (header + zstd payload).
+// Returns total frame size, or -1 on error.  Caller frees via blaze_free.
+int64_t blaze_ipc_compress_frame(const uint8_t* src, int64_t src_len,
+                                 int32_t level, uint8_t** out) {
+  size_t bound = ZSTD_compressBound((size_t)src_len);
+  uint8_t* buf = (uint8_t*)malloc(bound + 5);
+  if (!buf) return -1;
+  size_t n = ZSTD_compress(buf + 5, bound, src, (size_t)src_len, level);
+  if (ZSTD_isError(n)) {
+    free(buf);
+    return -1;
+  }
+  buf[0] = 1;  // CODEC_ZSTD
+  uint32_t len = (uint32_t)n;
+  memcpy(buf + 1, &len, 4);  // little-endian on all supported targets
+  *out = buf;
+  return (int64_t)(n + 5);
+}
+
+// Decompress one frame payload (without the 5-byte header).
+// `dst_cap` must be the decompressed size if known, else pass a bound.
+// Returns decompressed size or -1.
+int64_t blaze_ipc_decompress(const uint8_t* payload, int64_t payload_len,
+                             uint8_t* dst, int64_t dst_cap) {
+  unsigned long long need =
+      ZSTD_getFrameContentSize(payload, (size_t)payload_len);
+  if (need == ZSTD_CONTENTSIZE_ERROR) return -1;
+  size_t n = ZSTD_decompress(dst, (size_t)dst_cap, payload,
+                             (size_t)payload_len);
+  if (ZSTD_isError(n)) return -1;
+  return (int64_t)n;
+}
+
+int64_t blaze_ipc_decompressed_size(const uint8_t* payload,
+                                    int64_t payload_len) {
+  unsigned long long need =
+      ZSTD_getFrameContentSize(payload, (size_t)payload_len);
+  if (need == ZSTD_CONTENTSIZE_ERROR || need == ZSTD_CONTENTSIZE_UNKNOWN)
+    return -1;
+  return (int64_t)need;
+}
+
+void blaze_free(void* p) { free(p); }
+
+}  // extern "C"
