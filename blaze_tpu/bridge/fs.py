@@ -1,0 +1,118 @@
+"""Host filesystem bridge.
+
+Parity: datafusion-ext-commons/src/hadoop_fs.rs (FsProvider/Fs/
+FsDataInputWrapper — the native side reads any Hadoop FileSystem through
+JVM callbacks registered in the resource map; JniBridge.openFileAsDataInputWrapper).
+
+Here the engine-side registers an `FsProvider` (scheme -> open callbacks);
+the default provider serves local paths, and remote schemes (hdfs://,
+s3://...) are provided by the host engine as python callables — the same
+inversion of control as the reference, without assuming fsspec exists.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+from typing import BinaryIO, Callable, Dict, Optional
+
+
+class Fs:
+    """One filesystem instance (ref hadoop_fs.rs Fs)."""
+
+    def open(self, path: str) -> BinaryIO:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def size(self, path: str) -> int:
+        raise NotImplementedError
+
+    def create(self, path: str) -> BinaryIO:
+        raise NotImplementedError
+
+
+class LocalFs(Fs):
+    def open(self, path: str) -> BinaryIO:
+        return open(_strip_scheme(path), "rb")
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(_strip_scheme(path))
+
+    def size(self, path: str) -> int:
+        return os.path.getsize(_strip_scheme(path))
+
+    def create(self, path: str) -> BinaryIO:
+        p = _strip_scheme(path)
+        os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+        return open(p, "wb")
+
+
+class CallbackFs(Fs):
+    """Host-engine-backed FS: the JVM FSDataInputStream wrapper analog."""
+
+    def __init__(self, open_fn: Callable[[str], BinaryIO],
+                 exists_fn: Optional[Callable[[str], bool]] = None,
+                 size_fn: Optional[Callable[[str], int]] = None,
+                 create_fn: Optional[Callable[[str], BinaryIO]] = None):
+        self._open = open_fn
+        self._exists = exists_fn
+        self._size = size_fn
+        self._create = create_fn
+
+    def open(self, path: str) -> BinaryIO:
+        return self._open(path)
+
+    def exists(self, path: str) -> bool:
+        if self._exists is None:
+            raise NotImplementedError
+        return self._exists(path)
+
+    def size(self, path: str) -> int:
+        if self._size is not None:
+            return self._size(path)
+        f = self.open(path)
+        try:
+            f.seek(0, io.SEEK_END)
+            return f.tell()
+        finally:
+            f.close()
+
+    def create(self, path: str) -> BinaryIO:
+        if self._create is None:
+            raise NotImplementedError
+        return self._create(path)
+
+
+class FsProvider:
+    """scheme -> Fs registry (ref hadoop_fs.rs FsProvider, cached per
+    scheme like the reference's per-task fs cache)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fs: Dict[str, Fs] = {"": LocalFs(), "file": LocalFs()}
+
+    def register(self, scheme: str, fs: Fs) -> None:
+        with self._lock:
+            self._fs[scheme] = fs
+
+    def provide(self, path: str) -> Fs:
+        scheme = path.split("://", 1)[0] if "://" in path else ""
+        with self._lock:
+            fs = self._fs.get(scheme)
+        if fs is None:
+            raise KeyError(f"no filesystem registered for scheme "
+                           f"{scheme!r} ({path})")
+        return fs
+
+
+def _strip_scheme(path: str) -> str:
+    if path.startswith("file://"):
+        return path[len("file://"):]
+    return path
+
+
+#: Process-wide provider (the host bridge registers remote schemes here).
+fs_provider = FsProvider()

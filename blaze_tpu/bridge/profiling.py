@@ -1,0 +1,114 @@
+"""Profiling / observability service.
+
+Parity: the reference's optional native HTTP service (feature
+`http-service`, ref auron/src/exec.rs:53-60; poem routes for CPU pprof
+flamegraphs auron/src/http/pprof.rs:71 and jemalloc heap profiles
+http/memory_profiling.rs:49).
+
+TPU-native equivalents served over a stdlib HTTP endpoint:
+  /status   — engine status: memory manager dump, device memory stats
+  /metrics  — last collected metric trees (JSON)
+  /trace    — start/stop a JAX profiler trace (XLA's own profiler is the
+              pprof analog: it captures device + host timelines viewable
+              in TensorBoard/Perfetto)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()
+_recent_metrics: List[dict] = []
+_MAX_METRICS = 64
+
+
+def record_metrics(tree: dict) -> None:
+    """Runtimes push finalize()-time metric trees here (metrics.rs:22)."""
+    with _lock:
+        _recent_metrics.append(tree)
+        del _recent_metrics[:-_MAX_METRICS]
+
+
+def engine_status() -> dict:
+    from blaze_tpu.memory import MemManager
+    import jax
+    status = {"mem_manager": MemManager.get().dump_status()}
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        status["device_memory"] = {k: v for k, v in stats.items()
+                                   if isinstance(v, (int, float))}
+    except Exception:
+        status["device_memory"] = {}
+    return status
+
+
+class _Handler(BaseHTTPRequestHandler):
+    _tracing = False
+
+    def log_message(self, *args):
+        pass
+
+    def _send(self, code: int, body: str,
+              ctype: str = "application/json"):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/status":
+            self._send(200, json.dumps(engine_status()))
+        elif self.path == "/metrics":
+            with _lock:
+                self._send(200, json.dumps(_recent_metrics))
+        elif self.path.startswith("/trace/start"):
+            import jax
+            out = "/tmp/blaze-tpu-trace"
+            if "?" in self.path:
+                out = self.path.split("?", 1)[1] or out
+            try:
+                jax.profiler.start_trace(out)
+                _Handler._tracing = True
+                self._send(200, json.dumps({"tracing": True, "dir": out}))
+            except Exception as e:
+                self._send(500, json.dumps({"error": str(e)}))
+        elif self.path == "/trace/stop":
+            import jax
+            try:
+                jax.profiler.stop_trace()
+                _Handler._tracing = False
+                self._send(200, json.dumps({"tracing": False}))
+            except Exception as e:
+                self._send(500, json.dumps({"error": str(e)}))
+        else:
+            self._send(404, json.dumps({"error": "unknown path",
+                                        "paths": ["/status", "/metrics",
+                                                  "/trace/start",
+                                                  "/trace/stop"]}))
+
+
+_server: Optional[ThreadingHTTPServer] = None
+
+
+def start_http_service(port: int = 0) -> int:
+    """Start the service; returns the bound port (0 picks a free one)."""
+    global _server
+    if _server is not None:
+        return _server.server_address[1]
+    _server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    t = threading.Thread(target=_server.serve_forever, daemon=True,
+                         name="blaze-http-service")
+    t.start()
+    return _server.server_address[1]
+
+
+def stop_http_service() -> None:
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

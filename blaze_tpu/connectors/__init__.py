@@ -1,0 +1,21 @@
+"""Table-format scan providers: Iceberg / Paimon / Hudi / Delta-style.
+
+Parity: thirdparty/auron-{iceberg,paimon,hudi} — each contributes an
+`AuronConvertProvider` ServiceLoader plugin mapping the format's scan into
+a native parquet/orc scan with split + deletion handling
+(ref spark-extension/.../AuronConvertProvider.scala; conf gates
+`auron.enable.{iceberg,paimon,hudi}.scan`).
+
+Here a `ScanProvider` maps a format-specific table descriptor to concrete
+file splits + deletion filters that compose onto ParquetScanExec/OrcScanExec.
+The formats' manifest-reading layers live engine-side (the reference reads
+manifests in the JVM too) — the provider receives resolved splits.
+"""
+
+from blaze_tpu.connectors.provider import (DeleteFilter, ScanProvider,
+                                           ScanSplit, build_scan,
+                                           get_provider, register_provider)
+from blaze_tpu.connectors import iceberg, hudi, paimon  # noqa: F401
+
+__all__ = ["DeleteFilter", "ScanProvider", "ScanSplit", "build_scan",
+           "get_provider", "register_provider"]

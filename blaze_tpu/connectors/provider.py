@@ -1,0 +1,137 @@
+"""Scan-provider SPI."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu import config
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
+from blaze_tpu.schema import Schema
+
+
+@dataclass
+class ScanSplit:
+    """One readable unit: file + optional row-group/byte range + per-split
+    constants (partition values the format stores in metadata)."""
+
+    path: str
+    file_format: str = "parquet"              # parquet | orc
+    row_groups: Optional[List[int]] = None
+    partition_values: Dict[str, object] = field(default_factory=dict)
+    delete_files: List[str] = field(default_factory=list)  # iceberg v2 etc.
+
+
+class DeleteFilter:
+    """Row-level deletes applied after the base scan (iceberg v2
+    positional/equality deletes; paimon/hudi merge-on-read analogs)."""
+
+    def apply(self, batch: ColumnBatch, split: ScanSplit,
+              row_offset: int) -> ColumnBatch:
+        return batch
+
+
+class ScanProvider:
+    name = "base"
+    enable_conf: Optional[object] = None
+
+    def resolve_splits(self, descriptor: dict) -> List[ScanSplit]:
+        """Format descriptor -> concrete splits."""
+        raise NotImplementedError
+
+    def delete_filter(self, descriptor: dict) -> DeleteFilter:
+        return DeleteFilter()
+
+    def enabled(self) -> bool:
+        return self.enable_conf is None or self.enable_conf.get()
+
+
+_providers: Dict[str, ScanProvider] = {}
+
+
+def register_provider(p: ScanProvider) -> None:
+    _providers[p.name] = p
+
+
+def get_provider(name: str) -> ScanProvider:
+    if name not in _providers:
+        raise KeyError(f"no scan provider {name!r}; have {sorted(_providers)}")
+    return _providers[name]
+
+
+class ProviderScanExec(ExecutionPlan):
+    """Scan through a provider: base file scan + delete filtering +
+    partition-constant columns."""
+
+    def __init__(self, provider: ScanProvider, descriptor: dict,
+                 schema: Schema, num_partitions: int = 1):
+        super().__init__()
+        if not provider.enabled():
+            raise RuntimeError(f"provider {provider.name} disabled by conf")
+        self._provider = provider
+        self._schema = schema
+        splits = provider.resolve_splits(descriptor)
+        self._groups: List[List[ScanSplit]] = [[] for _ in
+                                               range(num_partitions)]
+        for i, s in enumerate(splits):
+            self._groups[i % num_partitions].append(s)
+        self._delete = provider.delete_filter(descriptor)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._groups)
+
+    def execute(self, partition: int) -> BatchIterator:
+        import pyarrow.parquet as pq
+        bs = config.BATCH_SIZE.get()
+        for split in self._groups[partition]:
+            row_offset = 0
+            if split.file_format == "parquet":
+                f = pq.ParquetFile(split.path)
+                groups = (split.row_groups if split.row_groups is not None
+                          else list(range(f.metadata.num_row_groups)))
+                it = f.iter_batches(batch_size=bs, row_groups=groups,
+                                    columns=[n for n in self._schema.names
+                                             if n not in
+                                             split.partition_values])
+            else:
+                from pyarrow import orc
+                tbl = orc.ORCFile(split.path).read()
+                it = tbl.to_batches(max_chunksize=bs)
+            for rb in it:
+                rb = self._with_partition_values(rb, split)
+                cb = ColumnBatch.from_arrow(rb)
+                cb = self._delete.apply(cb, split, row_offset)
+                row_offset += rb.num_rows
+                self.metrics.add("output_rows", cb.selected_count())
+                yield cb
+
+    def _with_partition_values(self, rb: pa.RecordBatch,
+                               split: ScanSplit) -> pa.RecordBatch:
+        if not split.partition_values:
+            return rb
+        arrays, names = [], []
+        for f in self._schema:
+            if f.name in split.partition_values:
+                v = split.partition_values[f.name]
+                arrays.append(pa.array([v] * rb.num_rows,
+                                       type=f.data_type.to_arrow()))
+            else:
+                arrays.append(rb.column(rb.schema.get_field_index(f.name)))
+            names.append(f.name)
+        return pa.RecordBatch.from_arrays(arrays,
+                                          schema=self._schema.to_arrow())
+
+
+def build_scan(format_name: str, descriptor: dict, schema: Schema,
+               num_partitions: int = 1) -> ProviderScanExec:
+    return ProviderScanExec(get_provider(format_name), descriptor, schema,
+                            num_partitions)

@@ -1,0 +1,10 @@
+"""Integration harness (ref: dev/auron-it — TPC-DS golden testing)."""
+
+from blaze_tpu.itest.runner import (QueryResult, check_plan_stability,
+                                    compare_frames, normalize_plan,
+                                    run_query)
+from blaze_tpu.itest.tpcds_data import generate, write_parquet_dataset
+
+__all__ = ["QueryResult", "check_plan_stability", "compare_frames",
+           "normalize_plan", "run_query", "generate",
+           "write_parquet_dataset"]

@@ -1,0 +1,162 @@
+"""TPC-DS progression queries as operator plans (BASELINE.md configs).
+
+Parity role: dev/auron-it query set.  Queries build against the synthetic
+tables of tpcds_data.py; each returns (plan, oracle) where `oracle` computes
+the expected result with pandas — the QueryRunner compares them cell-wise
+(comparison/QueryResultComparator.scala analog).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from blaze_tpu.exprs import BinaryExpr, and_, col, lit
+from blaze_tpu.ops import (AggExec, AggMode, FilterExec, LimitExec,
+                           MemoryScanExec, ProjectExec, SortExec,
+                           SortMergeJoinExec, BroadcastJoinExec, JoinType,
+                           make_agg)
+from blaze_tpu.shuffle import HashPartitioning, LocalShuffleExchange
+
+
+def _scan(t: pa.Table, partitions=2, batch_rows=8192):
+    return MemoryScanExec.from_arrow(t, num_partitions=partitions,
+                                     batch_rows=batch_rows)
+
+
+def q01(tables: Dict[str, pa.Table], partitions: int = 2):
+    """TPC-DS q01: customers returning more than 1.2x their store's average
+    (correlated subquery decorrelated into an avg-by-store join)."""
+    sr, dd, st, cu = (tables["store_returns"], tables["date_dim"],
+                      tables["store"], tables["customer"])
+
+    # ctr: returns joined to year-2000 dates, grouped by (customer, store)
+    dd_flt = FilterExec(_scan(dd, 1),
+                        [BinaryExpr("==", col(1, "d_year"), lit(2000))])
+    sr_dd = BroadcastJoinExec(
+        _scan(sr, partitions), dd_flt,
+        [col(0, "sr_returned_date_sk")], [col(0, "d_date_sk")],
+        JoinType.INNER, build_side="right")
+    # columns: sr_returned_date_sk, sr_customer_sk, sr_store_sk,
+    #          sr_return_amt, sr_ticket_number, d_date_sk, d_year, ...
+    ctr_partial = AggExec(sr_dd,
+                          [(col(1, "sr_customer_sk"), "ctr_customer_sk"),
+                           (col(2, "sr_store_sk"), "ctr_store_sk")],
+                          [(make_agg("sum", [col(3)]), AggMode.PARTIAL,
+                            "ctr_total_return")])
+    ctr_ex = LocalShuffleExchange(
+        ctr_partial, HashPartitioning([col(0), col(1)], partitions))
+    ctr = AggExec(ctr_ex,
+                  [(col(0, "ctr_customer_sk"), "ctr_customer_sk"),
+                   (col(1, "ctr_store_sk"), "ctr_store_sk")],
+                  [(make_agg("sum", [col(2)]), AggMode.PARTIAL_MERGE,
+                    "ctr_total_return")])
+
+    # avg(ctr_total_return) by store
+    avg_ex = LocalShuffleExchange(ctr, HashPartitioning([col(1)], partitions))
+    avg_by_store = AggExec(
+        avg_ex, [(col(1, "ctr_store_sk"), "avg_store_sk")],
+        [(make_agg("avg", [col(2)]), AggMode.COMPLETE, "avg_return")])
+
+    # ctr join avg_by_store on store, filter > 1.2*avg
+    ctr2 = LocalShuffleExchange(ctr, HashPartitioning([col(1)], partitions))
+    joined = SortMergeJoinExec(ctr2, avg_by_store,
+                               [col(1)], [col(0)], JoinType.INNER)
+    # cols: ctr_customer_sk, ctr_store_sk, ctr_total_return,
+    #       avg_store_sk, avg_return
+    flt = FilterExec(joined, [BinaryExpr(
+        ">", col(2), BinaryExpr("*", col(4), lit(1.2)))])
+
+    # join store (s_state = 'TN'), join customer, project id
+    st_flt = FilterExec(_scan(st, 1),
+                        [BinaryExpr("==", col(1, "s_state"), lit("TN"))])
+    j_store = BroadcastJoinExec(flt, st_flt, [col(1)], [col(0)],
+                                JoinType.INNER, build_side="right")
+    j_cust = BroadcastJoinExec(
+        j_store, _scan(cu, 1), [col(0)], [col(0, "c_customer_sk")],
+        JoinType.INNER, build_side="right")
+    # c_customer_id is at offset: flt(5 cols) + store(3) + customer: sk,id,addr
+    id_idx = 5 + 3 + 1
+    proj = ProjectExec(j_cust, [col(id_idx)], ["c_customer_id"])
+    single = LocalShuffleExchange(proj, HashPartitioning([col(0)], 1))
+    plan = LimitExec(SortExec(single, [(col(0), False, True)], fetch=100),
+                     100)
+
+    def oracle():
+        srd = sr.to_pandas()
+        ddd = dd.to_pandas()
+        std = st.to_pandas()
+        cud = cu.to_pandas()
+        m = srd.merge(ddd[ddd.d_year == 2000], left_on="sr_returned_date_sk",
+                      right_on="d_date_sk")
+        ctr = (m.dropna(subset=["sr_customer_sk"])
+               .groupby(["sr_customer_sk", "sr_store_sk"], as_index=False)
+               .sr_return_amt.sum()
+               .rename(columns={"sr_return_amt": "ctr_total"}))
+        avg = ctr.groupby("sr_store_sk", as_index=False).ctr_total.mean() \
+            .rename(columns={"ctr_total": "avg_return"})
+        j = ctr.merge(avg, on="sr_store_sk")
+        j = j[j.ctr_total > 1.2 * j.avg_return]
+        j = j.merge(std[std.s_state == "TN"], left_on="sr_store_sk",
+                    right_on="s_store_sk")
+        j = j.merge(cud, left_on="sr_customer_sk", right_on="c_customer_sk")
+        out = j[["c_customer_id"]].sort_values("c_customer_id")[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q06_like(tables: Dict[str, pa.Table], partitions: int = 4):
+    """q06 shape (BASELINE config #2): sales joined to items above the
+    category-average price, counted by state-ish key — hash-join +
+    group-by over `partitions` partitions."""
+    ss, it = tables["store_sales"], tables["item"]
+
+    # avg price per category
+    cat_avg = AggExec(_scan(it, 1), [(col(1, "i_category"), "cat")],
+                      [(make_agg("avg", [col(2)]), AggMode.COMPLETE,
+                        "avg_price")])
+    # items priced > 1.2x their category average
+    it_j = BroadcastJoinExec(_scan(it, 1), cat_avg,
+                             [col(1)], [col(0)], JoinType.INNER,
+                             build_side="right")
+    it_flt = FilterExec(it_j, [BinaryExpr(
+        ">", col(2), BinaryExpr("*", col(4), lit(1.2)))])
+
+    ss_j = BroadcastJoinExec(_scan(ss, partitions), it_flt,
+                             [col(3, "ss_item_sk")], [col(0, "i_item_sk")],
+                             JoinType.INNER, build_side="right")
+    partial = AggExec(ss_j, [(col(2, "ss_store_sk"), "store")],
+                      [(make_agg("count", [col(0)]), AggMode.PARTIAL, "cnt")])
+    ex = LocalShuffleExchange(partial, HashPartitioning([col(0)], partitions))
+    final = AggExec(ex, [(col(0, "store"), "store")],
+                    [(make_agg("sum", [col(1)]), AggMode.PARTIAL_MERGE,
+                      "cnt")])
+    single = LocalShuffleExchange(final, HashPartitioning([col(0)], 1))
+    plan = SortExec(single, [(col(0), False, True)])
+
+    def oracle():
+        ssd = ss.to_pandas()
+        itd = it.to_pandas()
+        avg = itd.groupby("i_category", as_index=False) \
+            .i_current_price.mean().rename(
+                columns={"i_current_price": "avg_price"})
+        j = itd.merge(avg, on="i_category")
+        sel = j[j.i_current_price > 1.2 * j.avg_price]
+        m = ssd.merge(sel, left_on="ss_item_sk", right_on="i_item_sk")
+        out = (m.groupby("ss_store_sk", as_index=False)
+               .agg(cnt=("ss_sold_date_sk", "count"))
+               .rename(columns={"ss_store_sk": "store"})
+               .sort_values("store"))
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+QUERIES: Dict[str, Tuple[Callable, list]] = {
+    "q01": (q01, ["store_returns", "date_dim", "store", "customer"]),
+    "q06": (q06_like, ["store_sales", "item"]),
+}

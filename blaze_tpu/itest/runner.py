@@ -1,0 +1,125 @@
+"""Query runner + result comparator + plan-stability checker.
+
+Parity: dev/auron-it (QueryRunner.scala runs baseline vs accelerated and
+reports per-query speedup; comparison/QueryResultComparator.scala checks
+row counts + cell equality with double tolerance;
+comparison/PlanStabilityChecker.scala:30-107 normalizes plans and diffs
+against goldens).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+DOUBLE_TOL = 1e-6
+
+
+@dataclass
+class QueryResult:
+    name: str
+    rows: int
+    engine_seconds: float
+    oracle_seconds: float
+    passed: bool
+    detail: str = ""
+
+    @property
+    def speedup(self) -> float:
+        return self.oracle_seconds / max(self.engine_seconds, 1e-9)
+
+
+def compare_frames(got: pd.DataFrame, want: pd.DataFrame) -> Optional[str]:
+    """Row-count + cell equality with double tolerance, order-insensitive
+    (QueryResultComparator semantics)."""
+    if len(got) != len(want):
+        return f"row count mismatch: got {len(got)} want {len(want)}"
+    if got.shape[1] != want.shape[1]:
+        return f"column count mismatch: {got.shape[1]} vs {want.shape[1]}"
+    g = got.copy()
+    w = want.copy()
+    g.columns = list(range(g.shape[1]))
+    w.columns = list(range(w.shape[1]))
+    key = sorted(range(g.shape[1]),
+                 key=lambda i: str(g[i].dtype))  # stable sort key order
+    g = g.sort_values(by=list(range(g.shape[1]))).reset_index(drop=True)
+    w = w.sort_values(by=list(range(w.shape[1]))).reset_index(drop=True)
+    for ci in range(g.shape[1]):
+        gc, wc = g[ci], w[ci]
+        for ri in range(len(g)):
+            a, b = gc.iloc[ri], wc.iloc[ri]
+            if _cell_equal(a, b):
+                continue
+            return f"cell mismatch at row {ri} col {ci}: {a!r} != {b!r}"
+    return None
+
+
+def _cell_equal(a, b) -> bool:
+    a_null = a is None or (isinstance(a, float) and math.isnan(a)) or a is pd.NA
+    b_null = b is None or (isinstance(b, float) and math.isnan(b)) or b is pd.NA
+    if a_null or b_null:
+        return a_null and b_null
+    if isinstance(a, float) or isinstance(b, float):
+        fa, fb = float(a), float(b)
+        if math.isnan(fa) or math.isnan(fb):
+            return math.isnan(fa) and math.isnan(fb)
+        return abs(fa - fb) <= DOUBLE_TOL * max(1.0, abs(fa), abs(fb))
+    return a == b
+
+
+def run_query(name: str, plan, oracle) -> QueryResult:
+    t0 = time.perf_counter()
+    got_rb = plan.execute_collect().to_arrow()
+    engine_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    want = oracle()
+    oracle_s = time.perf_counter() - t1
+    got = got_rb.to_pandas() if got_rb.num_rows else pd.DataFrame(
+        {n: [] for n in got_rb.schema.names})
+    err = compare_frames(got, want)
+    return QueryResult(name, got_rb.num_rows, engine_s, oracle_s,
+                       err is None, err or "")
+
+
+# -- plan stability (PlanStabilityChecker analog) ----------------------------
+
+_NORMALIZERS = [
+    (re.compile(r"0x[0-9a-f]+"), "<addr>"),
+    (re.compile(r"/[\w/.-]*/(blaze-[\w.-]+)"), r"<tmp>/\1"),
+    (re.compile(r"shuffle://[0-9a-f]+"), "shuffle://<id>"),
+    (re.compile(r"bhj-\d+"), "bhj-<id>"),
+]
+
+
+def normalize_plan(plan) -> str:
+    text = plan.pretty()
+    for pat, repl in _NORMALIZERS:
+        text = pat.sub(repl, text)
+    return text.strip() + "\n"
+
+
+def check_plan_stability(plan, golden_path: str,
+                         update: bool = False) -> Optional[str]:
+    import os
+    text = normalize_plan(plan)
+    if update or not os.path.exists(golden_path):
+        os.makedirs(os.path.dirname(golden_path), exist_ok=True)
+        with open(golden_path, "w") as f:
+            f.write(text)
+        return None
+    with open(golden_path) as f:
+        want = f.read()
+    if text != want:
+        import difflib
+        diff = "".join(difflib.unified_diff(
+            want.splitlines(keepends=True), text.splitlines(keepends=True),
+            "golden", "current"))
+        return diff
+    return None

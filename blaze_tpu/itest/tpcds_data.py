@@ -1,0 +1,139 @@
+"""Synthetic TPC-DS-shaped data generator.
+
+Parity role: the 1GB TPC-DS dataset of dev/auron-it/local-run-tpcds.sh.
+Zero-egress environment: generate schema-faithful synthetic tables (same
+columns/types/key relationships as the TPC-DS subset the progression
+queries touch) with deterministic seeds, scaled by `scale` (1.0 ~ SF1 row
+counts for the used tables).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+SF1_ROWS = {
+    "store_returns": 287_514,
+    "store_sales": 2_880_404,
+    "catalog_sales": 1_441_548,
+    "web_sales": 719_384,
+    "store": 12,
+    "customer": 100_000,
+    "customer_address": 50_000,
+    "date_dim": 73_049,
+    "item": 18_000,
+}
+
+
+def _rows(name: str, scale: float) -> int:
+    base = SF1_ROWS[name]
+    if name in ("store", "date_dim"):
+        return base  # dimension tables do not scale
+    return max(1, int(base * scale))
+
+
+def gen_date_dim(scale: float, seed: int = 11) -> pa.Table:
+    n = _rows("date_dim", scale)
+    sk = np.arange(2450815, 2450815 + n)
+    year = 1998 + (np.arange(n) // 365)
+    moy = (np.arange(n) % 365) // 31 + 1
+    return pa.table({
+        "d_date_sk": pa.array(sk),
+        "d_year": pa.array(year.astype(np.int32)),
+        "d_moy": pa.array(np.minimum(moy, 12).astype(np.int32)),
+        "d_dom": pa.array(((np.arange(n) % 31) + 1).astype(np.int32)),
+    })
+
+
+def gen_store(scale: float, seed: int = 12) -> pa.Table:
+    n = _rows("store", scale)
+    rng = np.random.default_rng(seed)
+    states = np.array(["TN", "CA", "NY", "TX", "WA"])
+    return pa.table({
+        "s_store_sk": pa.array(np.arange(1, n + 1)),
+        "s_state": pa.array(states[rng.integers(0, len(states), n)]),
+        "s_store_name": pa.array([f"store_{i}" for i in range(1, n + 1)]),
+    })
+
+
+def gen_customer(scale: float, seed: int = 13) -> pa.Table:
+    n = _rows("customer", scale)
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "c_customer_sk": pa.array(np.arange(1, n + 1)),
+        "c_customer_id": pa.array([f"C{i:011d}" for i in range(1, n + 1)]),
+        "c_current_addr_sk": pa.array(
+            rng.integers(1, _rows("customer_address", scale) + 1, n)),
+    })
+
+
+def gen_store_returns(scale: float, seed: int = 14) -> pa.Table:
+    n = _rows("store_returns", scale)
+    rng = np.random.default_rng(seed)
+    date_n = _rows("date_dim", scale)
+    null_mask = rng.random(n) < 0.02
+    cust = rng.integers(1, _rows("customer", scale) + 1, n).astype(float)
+    cust[null_mask] = np.nan
+    return pa.table({
+        "sr_returned_date_sk": pa.array(
+            rng.integers(2450815, 2450815 + date_n, n)),
+        "sr_customer_sk": pa.array(
+            np.where(null_mask, None, cust).tolist(), type=pa.int64()),
+        "sr_store_sk": pa.array(rng.integers(1, _rows("store", scale) + 1, n)),
+        "sr_return_amt": pa.array(np.round(rng.random(n) * 500, 2)),
+        "sr_ticket_number": pa.array(np.arange(1, n + 1)),
+    })
+
+
+def gen_store_sales(scale: float, seed: int = 15) -> pa.Table:
+    n = _rows("store_sales", scale)
+    rng = np.random.default_rng(seed)
+    date_n = _rows("date_dim", scale)
+    return pa.table({
+        "ss_sold_date_sk": pa.array(
+            rng.integers(2450815, 2450815 + date_n, n)),
+        "ss_customer_sk": pa.array(
+            rng.integers(1, _rows("customer", scale) + 1, n)),
+        "ss_store_sk": pa.array(rng.integers(1, _rows("store", scale) + 1, n)),
+        "ss_item_sk": pa.array(rng.integers(1, _rows("item", scale) + 1, n)),
+        "ss_ext_sales_price": pa.array(np.round(rng.random(n) * 300, 2)),
+        "ss_quantity": pa.array(rng.integers(1, 100, n).astype(np.int32)),
+    })
+
+
+def gen_item(scale: float, seed: int = 16) -> pa.Table:
+    n = _rows("item", scale)
+    rng = np.random.default_rng(seed)
+    cats = np.array(["Books", "Home", "Sports", "Music", "Electronics"])
+    return pa.table({
+        "i_item_sk": pa.array(np.arange(1, n + 1)),
+        "i_category": pa.array(cats[rng.integers(0, len(cats), n)]),
+        "i_current_price": pa.array(np.round(rng.random(n) * 100, 2)),
+    })
+
+
+GENERATORS = {
+    "date_dim": gen_date_dim,
+    "store": gen_store,
+    "customer": gen_customer,
+    "store_returns": gen_store_returns,
+    "store_sales": gen_store_sales,
+    "item": gen_item,
+}
+
+
+def generate(names, scale: float = 0.01):
+    return {name: GENERATORS[name](scale) for name in names}
+
+
+def write_parquet_dataset(tables, out_dir: str, row_group_size: int = 1 << 17):
+    import os
+    import pyarrow.parquet as pq
+    paths = {}
+    for name, t in tables.items():
+        d = os.path.join(out_dir, name)
+        os.makedirs(d, exist_ok=True)
+        p = os.path.join(d, "part-00000.parquet")
+        pq.write_table(t, p, row_group_size=row_group_size)
+        paths[name] = p
+    return paths

@@ -380,6 +380,80 @@ class BloomFilterAgg(AggFunction):
         return accs[0]
 
 
+class HostUDAF(AggFunction):
+    """Engine-side UDAF fallback (ref agg/spark_udaf_wrapper.rs:451 — the
+    JVM round-trip with SparkUDAFMemTracker).  The host registers four
+    callables; accumulator state serializes as binary per group so partial
+    batches spill/shuffle like any other column."""
+
+    def __init__(self, name: str, children,
+                 init_fn, update_fn, merge_fn, eval_fn,
+                 out_type: DataType = FLOAT64):
+        super().__init__(children)
+        self.name = name
+        self._init = init_fn      # () -> state
+        self._update = update_fn  # (state, *values) -> state
+        self._merge = merge_fn    # (state, state) -> state
+        self._eval = eval_fn      # (state) -> python value
+        self._out = out_type
+
+    @property
+    def is_host(self) -> bool:
+        return True
+
+    def acc_fields(self, s):
+        return [Field("state", BINARY)]
+
+    def output_type(self, s):
+        return self._out
+
+    def _serialize(self, state) -> bytes:
+        import pickle
+        return pickle.dumps(state)
+
+    def _deserialize(self, b: bytes):
+        import pickle
+        return pickle.loads(b)
+
+    def host_update(self, args: List[pa.Array], gids: np.ndarray,
+                    num_segments: int) -> List[pa.Array]:
+        states = [self._init() for _ in range(num_segments)]
+        n = len(gids)
+        pyargs = [a.to_pylist() for a in args]
+        for i in range(n):
+            g = int(gids[i])
+            if g < num_segments:
+                states[g] = self._update(states[g],
+                                         *(col[i] for col in pyargs))
+        return [pa.array([self._serialize(s) for s in states],
+                         type=pa.binary())]
+
+    def host_merge(self, accs: List[pa.Array], gids: np.ndarray,
+                   num_segments: int) -> List[pa.Array]:
+        states = [None] * num_segments
+        for i, g in enumerate(gids):
+            g = int(g)
+            if g >= num_segments:
+                continue
+            v = accs[0][i]
+            if not v.is_valid:
+                continue
+            s = self._deserialize(v.as_py())
+            states[g] = s if states[g] is None else self._merge(states[g], s)
+        return [pa.array([self._serialize(s if s is not None
+                                          else self._init())
+                          for s in states], type=pa.binary())]
+
+    def host_eval(self, accs: List[pa.Array]) -> pa.Array:
+        py = []
+        for v in accs[0]:
+            if not v.is_valid:
+                py.append(None)
+            else:
+                py.append(self._eval(self._deserialize(v.as_py())))
+        return pa.array(py, type=self._out.to_arrow())
+
+
 # -- registry (proto AggFunction enum, auron.proto:143) ----------------------
 
 def make_agg(name: str, children: Sequence[PhysicalExpr], **kw) -> AggFunction:
@@ -404,4 +478,12 @@ def make_agg(name: str, children: Sequence[PhysicalExpr], **kw) -> AggFunction:
         return CollectAgg(children, distinct=True)
     if name == "bloom_filter":
         return BloomFilterAgg(children, **kw)
+    if name == "udaf":
+        from blaze_tpu.bridge.resource import get_resource
+        impl = get_resource(f"udaf://{kw['udaf_name']}")
+        if impl is None:
+            raise KeyError(f"UDAF {kw['udaf_name']!r} not registered "
+                           f"(udaf://{kw['udaf_name']})")
+        return HostUDAF(kw["udaf_name"], children, *impl,
+                        out_type=kw.get("out_type", FLOAT64))
     raise KeyError(f"unknown aggregate function {name}")

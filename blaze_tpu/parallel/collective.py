@@ -1,0 +1,101 @@
+"""Mesh collectives: the TPU-native exchange (shuffle-over-ICI).
+
+The reference's all-to-all exchange is shuffle files + BlockManager RPC
+(SURVEY.md §2.7).  On a TPU slice, the same repartitioning rides ICI as an
+XLA `all_to_all` INSIDE the jit'd stage: every device hash-partitions its
+local group table by key, scatters slots into per-destination buffers, and
+one collective moves all partitions simultaneously.  Global (ungrouped)
+aggregates merge with a single `psum`.  Host shuffle files remain the
+cross-slice / cross-host fallback (DCN), exactly how the reference keeps
+RSS as the wide-area transport.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from blaze_tpu.kernels import hashing as H
+from blaze_tpu.parallel.stage import AggTable, merge_agg_tables
+
+
+def partition_ids_for_keys(keys: Sequence[Tuple[jax.Array, jax.Array]],
+                           num_partitions: int) -> jax.Array:
+    """Spark-compatible pid = pmod(murmur3(keys, 42), P) on device
+    (ref shuffle/mod.rs:164-189) — traceable under jit/shard_map."""
+    cols = []
+    for data, valid in keys:
+        from blaze_tpu.parallel.stage import _dtype_of
+        cols.append((data, valid, _dtype_of(data).id.value))
+    h = H.hash_columns(cols, seed=42, xp=jnp, algo="murmur3")
+    return H.pmod(h, num_partitions, xp=jnp)
+
+
+def all_to_all_regroup(table: AggTable, axis_name: str,
+                       num_partitions: int, out_slots: int) -> AggTable:
+    """Exchange group-table slots so equal keys land on one device, then
+    merge — the on-ICI shuffle+final-agg.  Callable only inside shard_map
+    over `axis_name`."""
+    G = table.slot_valid.shape[0]
+    pid = partition_ids_for_keys(
+        list(zip(table.keys, table.key_valid)), num_partitions)
+    pid = jnp.where(table.slot_valid, pid, num_partitions)  # park empties
+
+    # stable order by destination; within-destination dense index
+    order = jnp.argsort(pid, stable=True)
+    sorted_pid = jnp.take(pid, order)
+    counts = jnp.bincount(jnp.clip(pid, 0, num_partitions),
+                          length=num_partitions + 1)[:num_partitions]
+    starts = jnp.cumsum(counts) - counts
+    idx_within = jnp.arange(G) - jnp.take(
+        jnp.concatenate([starts, jnp.zeros(1, starts.dtype)]),
+        jnp.clip(sorted_pid, 0, num_partitions))
+
+    dest = (jnp.clip(sorted_pid, 0, num_partitions - 1), idx_within)
+    in_range = sorted_pid < num_partitions
+
+    def scatter(col):
+        sc = jnp.take(col, order)
+        buf = jnp.zeros((num_partitions, G), dtype=col.dtype)
+        return buf.at[dest].set(jnp.where(in_range, sc,
+                                          jnp.zeros_like(sc)), mode="drop")
+
+    def scatter_valid(col):
+        sc = jnp.take(col, order) & in_range
+        buf = jnp.zeros((num_partitions, G), dtype=bool)
+        return buf.at[dest].set(sc, mode="drop")
+
+    keys_b = [scatter(k) for k in table.keys]
+    kval_b = [scatter_valid(v) for v in table.key_valid]
+    accs_b = [scatter(a) for a in table.accs]
+    aval_b = [scatter_valid(v) for v in table.acc_valid]
+    slot_b = scatter_valid(table.slot_valid)
+
+    def exchange(buf):
+        return jax.lax.all_to_all(buf, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)
+
+    keys_r = [exchange(b).reshape(num_partitions * G) for b in keys_b]
+    kval_r = [exchange(b).reshape(num_partitions * G) for b in kval_b]
+    accs_r = [exchange(b).reshape(num_partitions * G) for b in accs_b]
+    aval_r = [exchange(b).reshape(num_partitions * G) for b in aval_b]
+    slot_r = exchange(slot_b).reshape(num_partitions * G)
+
+    received = AggTable(tuple(keys_r), tuple(kval_r), tuple(accs_r),
+                        tuple(aval_r), slot_r,
+                        jnp.sum(slot_r.astype(jnp.int32)))
+    # kinds: sum-merge semantics chosen by caller via merge_agg_tables
+    return received
+
+
+def psum_table_accs(table: AggTable, axis_name: str) -> AggTable:
+    """Global (ungrouped) aggregate merge: one psum over acc columns."""
+    accs = tuple(jax.lax.psum(jnp.where(v, a, jnp.zeros_like(a)), axis_name)
+                 for a, v in zip(table.accs, table.acc_valid))
+    any_valid = tuple(jax.lax.psum(v.astype(jnp.int32), axis_name) > 0
+                      for v in table.acc_valid)
+    return table._replace(accs=accs, acc_valid=any_valid)

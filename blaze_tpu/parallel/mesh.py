@@ -1,0 +1,82 @@
+"""Device mesh construction + the distributed stage runner.
+
+TPU-native scaling model (SURVEY.md §7 step 7): data parallelism over a 1-D
+`dp` mesh axis (each device = one partition worth of rows, the Spark-task
+analog), with exchanges as in-jit collectives over ICI.  Multi-host slices
+extend the same mesh across hosts (jax.distributed); the host shuffle
+service (shuffle/) carries cross-slice DCN traffic.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+
+
+def make_mesh(num_devices: Optional[int] = None,
+              axis: str = DP_AXIS) -> Mesh:
+    devs = jax.devices()
+    n = num_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def shard_rows(mesh: Mesh, *arrays: jax.Array):
+    """Shard row-dimension arrays across the dp axis."""
+    sharding = NamedSharding(mesh, P(DP_AXIS))
+    return tuple(jax.device_put(a, sharding) for a in arrays)
+
+
+def distributed_grouped_agg(mesh: Mesh, key_specs, agg_specs,
+                            num_slots: int, out_slots: int,
+                            merge_kinds: Sequence[str]):
+    """Build the jit'd two-phase distributed aggregation step.
+
+    Returns fn(valid_mask, *key_and_value_arrays) -> final AggTable slots
+    per device.  The whole pipeline — partial agg, on-device hash
+    partition, ICI all-to-all, final merge — is ONE compiled XLA program:
+    the TPU-native equivalent of map-side agg + shuffle + reduce-side agg.
+
+    key_specs / agg_specs describe argument positions:
+      key_specs: number of key columns (each contributes data+valid args)
+      agg_specs: list of kinds ('sum'|'count'|'min'|'max'); each non-count
+                 contributes data+valid args.
+    """
+    from blaze_tpu.parallel.collective import all_to_all_regroup
+    from blaze_tpu.parallel.stage import merge_agg_tables, partial_agg_table
+
+    num_keys = key_specs if isinstance(key_specs, int) else len(key_specs)
+    P_ = mesh.shape[DP_AXIS]
+
+    def stage(valid_mask, *cols):
+        i = 0
+        keys = []
+        for _ in range(num_keys):
+            keys.append((cols[i], cols[i + 1]))
+            i += 2
+        specs = []
+        for kind in agg_specs:
+            if kind == "count":
+                specs.append((kind, None, None))
+            else:
+                specs.append((kind, cols[i], cols[i + 1]))
+                i += 2
+        local = partial_agg_table(keys, specs, valid_mask, num_slots)
+        received = all_to_all_regroup(local, DP_AXIS, P_, out_slots)
+        final = merge_agg_tables(received, merge_kinds, out_slots)
+        # scalars can't concatenate across the mesh: give num_groups a
+        # (1,)-axis so out_specs P('dp') stacks per-device counts
+        return final._replace(num_groups=final.num_groups.reshape(1))
+
+    sharded = jax.shard_map(
+        stage, mesh=mesh,
+        in_specs=P(DP_AXIS),
+        out_specs=P(DP_AXIS),
+        check_vma=False)
+    return jax.jit(sharded)

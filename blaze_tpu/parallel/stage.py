@@ -1,0 +1,150 @@
+"""Fused, fully-jittable stage kernels (static shapes end-to-end).
+
+The eager operator layer (ops/) favors generality: it syncs group counts to
+the host per batch.  For the hot TPC-DS shapes the stage compiler fuses
+scan-side filter + project + partial aggregation into ONE jit'd function
+with a FIXED-capacity group table — no host sync inside the stage, so XLA
+fuses the whole pipeline (hash, sort, segmented reduce) into one program.
+This mirrors how the reference keeps its whole operator chain inside one
+tokio task (rt.rs:156): here the chain lives inside one XLA computation.
+
+Key building block: `partial_agg_table` — sort-based grouping into a
+static `num_slots` table (key cols + acc cols + slot validity).  Overflow
+slots (more distinct groups than num_slots) spill into a "overflowed"
+count the host can check — the AGG_TRIGGER_PARTIAL_SKIPPING analog
+(agg_table.rs:108-122): the host reruns the batch through the general
+path when it overflows.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.kernels import compare
+
+
+class AggTable(NamedTuple):
+    """Fixed-capacity columnar group table (the device AccTable)."""
+
+    keys: Tuple[jax.Array, ...]        # each (num_slots,)
+    key_valid: Tuple[jax.Array, ...]   # per-key null flags
+    accs: Tuple[jax.Array, ...]        # accumulator columns (num_slots,)
+    acc_valid: Tuple[jax.Array, ...]
+    slot_valid: jax.Array              # (num_slots,) bool
+    num_groups: jax.Array              # scalar int32 (may exceed num_slots!)
+
+
+def sort_by_keys(key_cols: Sequence[Tuple[jax.Array, jax.Array]],
+                 valid_mask: jax.Array):
+    """Sort rows by (encoded) grouping keys; returns (perm, sorted ops,
+    sorted validity)."""
+    operands = []
+    for data, kvalid in key_cols:
+        from blaze_tpu.schema import DataType, TypeId
+        bucket, key = compare.order_key(
+            data, kvalid, _dtype_of(data), False, True)
+        operands.append(bucket)
+        operands.append(key)
+    perm = compare.lexsort_indices(operands, valid_mask)
+    sorted_ops = [jnp.take(o, perm) for o in operands]
+    sorted_valid = jnp.take(valid_mask, perm)
+    return perm, sorted_ops, sorted_valid
+
+
+def _dtype_of(data: jax.Array):
+    from blaze_tpu import schema as S
+    m = {"bool": S.BOOL, "int8": S.INT8, "int16": S.INT16, "int32": S.INT32,
+         "int64": S.INT64, "float32": S.FLOAT32, "float64": S.FLOAT64}
+    return m[jnp.dtype(data.dtype).name]
+
+
+def partial_agg_table(key_cols: Sequence[Tuple[jax.Array, jax.Array]],
+                      agg_specs: Sequence[Tuple[str, jax.Array, jax.Array]],
+                      valid_mask: jax.Array, num_slots: int) -> AggTable:
+    """One fused pass: sort rows by key, segment-reduce into a static table.
+
+    agg_specs: (kind, values, validity) with kind in sum/count/min/max.
+    Fully traceable — `num_slots` is the only static parameter.
+    """
+    n = valid_mask.shape[0]
+    perm, sorted_ops, sorted_valid = sort_by_keys(key_cols, valid_mask)
+    boundary = compare.rows_differ_from_prev(sorted_ops) & sorted_valid
+    first_valid = jnp.argmax(sorted_valid)
+    boundary = boundary | ((jnp.arange(n) == first_valid) & sorted_valid)
+    gids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    num_groups = jnp.sum(boundary.astype(jnp.int32))
+    # rows of groups beyond num_slots scatter out of range (dropped)
+    gids = jnp.where(sorted_valid, gids, num_slots)
+
+    keys_out: List[jax.Array] = []
+    kvalid_out: List[jax.Array] = []
+    for data, kvalid in key_cols:
+        sd = jnp.take(data, perm)
+        sv = jnp.take(kvalid, perm) & sorted_valid
+        # first row of each segment carries the key
+        kd = jnp.zeros(num_slots, dtype=data.dtype).at[
+            jnp.where(boundary, gids, num_slots)].set(sd, mode="drop")
+        kv = jnp.zeros(num_slots, dtype=bool).at[
+            jnp.where(boundary, gids, num_slots)].set(sv, mode="drop")
+        keys_out.append(kd)
+        kvalid_out.append(kv)
+
+    accs_out: List[jax.Array] = []
+    avalid_out: List[jax.Array] = []
+    for kind, values, avalid in agg_specs:
+        sv = jnp.take(values, perm) if values is not None else None
+        sav = (jnp.take(avalid, perm) if avalid is not None
+               else jnp.ones(n, dtype=bool)) & sorted_valid
+        if kind == "count":
+            acc = jax.ops.segment_sum(sav.astype(jnp.int64), gids,
+                                      num_segments=num_slots)
+            accs_out.append(acc)
+            avalid_out.append(jnp.ones(num_slots, dtype=bool))
+            continue
+        if kind == "sum":
+            dt = (jnp.float64 if jnp.issubdtype(sv.dtype, jnp.floating)
+                  else jnp.int64)
+            masked = jnp.where(sav, sv.astype(dt), 0)
+            acc = jax.ops.segment_sum(masked, gids, num_segments=num_slots)
+        elif kind == "min":
+            big = _identity(sv.dtype, False)
+            acc = jax.ops.segment_min(jnp.where(sav, sv, big), gids,
+                                      num_segments=num_slots)
+        elif kind == "max":
+            small = _identity(sv.dtype, True)
+            acc = jax.ops.segment_max(jnp.where(sav, sv, small), gids,
+                                      num_segments=num_slots)
+        else:
+            raise ValueError(f"unsupported fused agg kind {kind}")
+        has = jax.ops.segment_sum(sav.astype(jnp.int32), gids,
+                                  num_segments=num_slots) > 0
+        acc = jnp.where(has, acc, jnp.zeros_like(acc))
+        accs_out.append(acc)
+        avalid_out.append(has)
+
+    slot_valid = jnp.arange(num_slots) < jnp.minimum(num_groups, num_slots)
+    return AggTable(tuple(keys_out), tuple(kvalid_out), tuple(accs_out),
+                    tuple(avalid_out), slot_valid, num_groups)
+
+
+def merge_agg_tables(table: AggTable,
+                     merge_kinds: Sequence[str], num_slots: int) -> AggTable:
+    """Re-aggregate a (possibly duplicated-key) table — the partial_merge
+    phase as a fused kernel.  Input slots act as rows."""
+    key_cols = list(zip(table.keys, table.key_valid))
+    specs = []
+    for kind, acc, av in zip(merge_kinds, table.accs, table.acc_valid):
+        k = "sum" if kind in ("count", "sum") else kind
+        specs.append((k, acc, av))
+    return partial_agg_table(key_cols, specs, table.slot_valid, num_slots)
+
+
+def _identity(dtype, minimum: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf if minimum else jnp.inf, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.min if minimum else info.max, dtype=dtype)

@@ -197,3 +197,26 @@ def test_agg_fuzz_vs_pandas():
         else:
             assert s == pytest.approx(ws)
             assert c == wc
+
+
+def test_host_udaf_fallback():
+    """UDAF round-trip (ref spark_udaf_wrapper.rs): geometric mean."""
+    import math
+    from blaze_tpu.bridge.resource import put_resource
+    put_resource("udaf://geomean", (
+        lambda: (0.0, 0),
+        lambda st, v: st if v is None else (st[0] + math.log(v), st[1] + 1),
+        lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        lambda st: math.exp(st[0] / st[1]) if st[1] else None,
+    ))
+    t = pa.table({"k": pa.array([1, 1, 2, 2]),
+                  "v": pa.array([2.0, 8.0, 3.0, None])})
+    scan = MemoryScanExec.from_arrow(t)
+    from blaze_tpu.exprs import col
+    plan = AggExec(scan, [(col(0, "k"), "k")], [
+        (make_agg("udaf", [col(1)], udaf_name="geomean"),
+         AggMode.COMPLETE, "gm")])
+    out = plan.execute_collect().to_arrow()
+    d = dict(zip(out.column("k").to_pylist(), out.column("gm").to_pylist()))
+    assert d[1] == pytest.approx(4.0)
+    assert d[2] == pytest.approx(3.0)

@@ -1,0 +1,31 @@
+"""TPC-DS integration tests: queries vs pandas oracle + plan stability
+(the dev/auron-it tier, SURVEY.md §4 tier 4)."""
+
+import os
+
+import pytest
+
+from blaze_tpu.itest import (check_plan_stability, generate, run_query)
+from blaze_tpu.itest.queries import QUERIES
+from blaze_tpu.memory import MemManager
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldens")
+
+
+@pytest.fixture(autouse=True)
+def budget():
+    MemManager.init(4 << 30)
+
+
+@pytest.mark.parametrize("qname", sorted(QUERIES))
+def test_tpcds_query(qname):
+    builder, table_names = QUERIES[qname]
+    tables = generate(table_names, scale=0.02)
+    plan, oracle = builder(tables)
+    res = run_query(qname, plan, oracle)
+    assert res.passed, f"{qname}: {res.detail}"
+    # plan stability vs golden (created on first run, then enforced)
+    diff = check_plan_stability(
+        plan, os.path.join(GOLDEN_DIR, f"{qname}.plan.txt"),
+        update=os.environ.get("BLAZE_UPDATE_GOLDENS") == "1")
+    assert diff is None, f"plan changed for {qname}:\n{diff}"

@@ -175,3 +175,26 @@ def test_parquet_scan_projection(tmp_path):
     got = scan.execute_collect().to_arrow()
     assert got.schema.names == ["s", "a"]
     assert got.num_rows == 100
+
+
+def test_orc_scan_roundtrip(tmp_path):
+    from pyarrow import orc
+    from blaze_tpu.ops.orc import OrcScanExec
+    t = table(500)
+    path = str(tmp_path / "t.orc")
+    orc.write_table(t, path)
+    scan = OrcScanExec(S.Schema.from_arrow(t.schema), [[path]],
+                       projection=["a", "b"])
+    got = scan.execute_collect().to_arrow()
+    assert got.num_rows == 500
+    assert got.schema.names == ["a", "b"]
+
+
+def test_fs_provider_local_and_callback():
+    import io
+    from blaze_tpu.bridge.fs import CallbackFs, fs_provider
+    blobs = {"x://data/f1": b"hello"}
+    fs_provider.register("x", CallbackFs(lambda p: io.BytesIO(blobs[p])))
+    f = fs_provider.provide("x://data/f1").open("x://data/f1")
+    assert f.read() == b"hello"
+    assert fs_provider.provide("/tmp").__class__.__name__ == "LocalFs"
