@@ -30,11 +30,18 @@ def get_resource(key: str, remove: bool = False) -> Optional[Any]:
 
 
 def get_or_create(key: str, factory: Callable[[], Any]) -> Any:
-    """Atomic cache for shared build artifacts (broadcast hash maps)."""
+    """Cache for shared build artifacts (broadcast hash maps).
+
+    The factory runs OUTSIDE the lock: building one broadcast map may
+    recursively build another (nested broadcast joins), and holding the
+    non-reentrant lock across the factory self-deadlocks.  Two racing
+    threads may both build; setdefault keeps exactly one."""
     with _lock:
-        if key not in _map:
-            _map[key] = factory()
-        return _map[key]
+        if key in _map:
+            return _map[key]
+    value = factory()
+    with _lock:
+        return _map.setdefault(key, value)
 
 
 def remove_resource(key: str) -> None:

@@ -131,6 +131,89 @@ def partial_agg_table(key_cols: Sequence[Tuple[jax.Array, jax.Array]],
                     tuple(avalid_out), slot_valid, num_groups)
 
 
+def pack_dense_keys(key_cols: Sequence[Tuple[jax.Array, jax.Array]],
+                    ranges: Sequence[Tuple[int, int]]
+                    ) -> Tuple[jax.Array, int]:
+    """Pack bounded-range keys into ONE dense group id (row-major strides).
+
+    The TPU fast path: when every grouping key has a known bound — int keys
+    with parquet min/max stats, or dictionary codes (always dense) — the
+    group id is pure arithmetic and aggregation needs NO SORT, just
+    scatter-adds.  Null gets the extra slot per key (range + 1 values).
+    Returns (gid array, total_slots)."""
+    total = 1
+    strides = []
+    for lo, hi in ranges:
+        strides.append(total)
+        total *= (hi - lo + 2)  # +1 for the null slot
+    gid = None
+    for (data, valid), (lo, hi), stride in zip(key_cols, ranges, strides):
+        k = jnp.clip(data.astype(jnp.int64) - lo, 0, hi - lo)
+        k = jnp.where(valid, k, hi - lo + 1)
+        contrib = k * stride
+        gid = contrib if gid is None else gid + contrib
+    return gid, total
+
+
+def unpack_dense_keys(slots: jax.Array, ranges: Sequence[Tuple[int, int]]
+                      ) -> List[Tuple[jax.Array, jax.Array]]:
+    """Inverse of pack_dense_keys for slot indices -> (key, validity)."""
+    out = []
+    rem = slots.astype(jnp.int64)
+    for lo, hi in ranges:
+        size = hi - lo + 2
+        k = rem % size
+        rem = rem // size
+        valid = k < (hi - lo + 1)
+        out.append((jnp.where(valid, k + lo, 0), valid))
+    return out
+
+
+def dense_partial_agg(gid: jax.Array, num_slots: int,
+                      agg_specs: Sequence[Tuple[str, Optional[jax.Array],
+                                                Optional[jax.Array]]],
+                      valid_mask: jax.Array):
+    """Sort-free aggregation: one segment-reduce per accumulator, keyed by
+    a precomputed dense group id.  Rows with valid_mask False scatter out
+    of range.  Returns (accs, acc_valid, slot_occupied)."""
+    g = jnp.where(valid_mask, gid, num_slots)
+    accs: List[jax.Array] = []
+    avalid: List[jax.Array] = []
+    occupied = jax.ops.segment_sum(
+        valid_mask.astype(jnp.int32), g, num_segments=num_slots) > 0
+    for kind, values, vvalid in agg_specs:
+        vv = (vvalid if vvalid is not None
+              else jnp.ones_like(valid_mask)) & valid_mask
+        if kind == "count":
+            acc = jax.ops.segment_sum(vv.astype(jnp.int64), g,
+                                      num_segments=num_slots)
+            accs.append(acc)
+            avalid.append(jnp.ones(num_slots, dtype=bool))
+            continue
+        if kind == "sum":
+            dt = (jnp.float64 if jnp.issubdtype(values.dtype, jnp.floating)
+                  else jnp.int64)
+            acc = jax.ops.segment_sum(jnp.where(vv, values.astype(dt), 0),
+                                      g, num_segments=num_slots)
+        elif kind == "min":
+            big = _identity(values.dtype, False)
+            acc = jax.ops.segment_min(
+                jnp.where(vv, values, big),
+                jnp.where(vv, g, num_slots), num_segments=num_slots)
+        elif kind == "max":
+            small = _identity(values.dtype, True)
+            acc = jax.ops.segment_max(
+                jnp.where(vv, values, small),
+                jnp.where(vv, g, num_slots), num_segments=num_slots)
+        else:
+            raise ValueError(f"unsupported dense agg kind {kind}")
+        has = jax.ops.segment_sum(vv.astype(jnp.int32), g,
+                                  num_segments=num_slots) > 0
+        accs.append(jnp.where(has, acc, jnp.zeros_like(acc)))
+        avalid.append(has)
+    return accs, avalid, occupied
+
+
 def merge_agg_tables(table: AggTable,
                      merge_kinds: Sequence[str], num_slots: int) -> AggTable:
     """Re-aggregate a (possibly duplicated-key) table — the partial_merge

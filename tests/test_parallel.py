@@ -116,3 +116,67 @@ def test_distributed_agg_with_nulls_and_filter():
     # sum is null-aware: only valid values contribute
     total_sum = np.asarray(out.accs[0])[slot_valid].sum()
     assert total_sum == pytest.approx(float((vvalid & mask).sum()))
+
+
+def test_dense_key_pack_unpack_roundtrip():
+    from blaze_tpu.parallel.stage import pack_dense_keys, unpack_dense_keys
+    n = 1000
+    rng = np.random.default_rng(0)
+    k1 = rng.integers(5, 50, n)
+    k2 = rng.integers(0, 7, n)
+    v1 = rng.random(n) < 0.9
+    ranges = [(5, 49), (0, 6)]
+    gid, total = pack_dense_keys(
+        [(jnp.asarray(k1), jnp.asarray(v1)),
+         (jnp.asarray(k2), jnp.ones(n, dtype=bool))], ranges)
+    assert total == (49 - 5 + 2) * (6 - 0 + 2)
+    assert int(jnp.max(gid)) < total
+    # unpack every distinct gid and verify it matches the inputs
+    ks = unpack_dense_keys(gid, ranges)
+    got1, gv1 = np.asarray(ks[0][0]), np.asarray(ks[0][1])
+    got2, _ = np.asarray(ks[1][0]), np.asarray(ks[1][1])
+    assert (gv1 == v1).all()
+    assert (got1[v1] == k1[v1]).all()
+    assert (got2 == k2).all()
+
+
+def test_dense_partial_agg_matches_sorted_path():
+    from blaze_tpu.parallel.stage import (dense_partial_agg,
+                                          pack_dense_keys,
+                                          partial_agg_table)
+    rng = np.random.default_rng(3)
+    n = 4096
+    keys = rng.integers(0, 100, n)
+    vals = rng.random(n)
+    mask = rng.random(n) < 0.7
+    ones = jnp.ones(n, dtype=bool)
+    gid, slots = pack_dense_keys([(jnp.asarray(keys), ones)], [(0, 99)])
+    accs, avalid, occ = dense_partial_agg(
+        gid, slots, [("sum", jnp.asarray(vals), None),
+                     ("count", None, None),
+                     ("min", jnp.asarray(vals), None),
+                     ("max", jnp.asarray(vals), None)],
+        jnp.asarray(mask))
+    table = partial_agg_table(
+        [(jnp.asarray(keys), ones)],
+        [("sum", jnp.asarray(vals), ones), ("count", None, None),
+         ("min", jnp.asarray(vals), ones), ("max", jnp.asarray(vals), ones)],
+        jnp.asarray(mask), num_slots=128)
+    sv = np.asarray(table.slot_valid)
+    sorted_by_key = {int(k): (float(s), int(c), float(mn), float(mx))
+                     for k, s, c, mn, mx in zip(
+                         np.asarray(table.keys[0])[sv],
+                         np.asarray(table.accs[0])[sv],
+                         np.asarray(table.accs[1])[sv],
+                         np.asarray(table.accs[2])[sv],
+                         np.asarray(table.accs[3])[sv])}
+    occ_np = np.asarray(occ)
+    for slot in np.nonzero(occ_np)[0]:
+        k = int(slot)  # identity packing with lo=0
+        s = float(np.asarray(accs[0])[slot])
+        c = int(np.asarray(accs[1])[slot])
+        mn = float(np.asarray(accs[2])[slot])
+        mx = float(np.asarray(accs[3])[slot])
+        assert sorted_by_key[k] == (pytest.approx(s), c, pytest.approx(mn),
+                                    pytest.approx(mx))
+    assert occ_np.sum() == len(sorted_by_key)
