@@ -31,6 +31,7 @@ class ColVal:
     data: Optional[jax.Array] = None      # (capacity,) when device-form
     validity: Optional[jax.Array] = None  # (capacity,) bool when device-form
     array: Optional[pa.Array] = None      # num_rows-long when host-form
+    literal: bool = False                 # evaluated from a Literal expr
 
     @property
     def is_device(self) -> bool:
@@ -152,11 +153,13 @@ class Literal(PhysicalExpr):
             if self.value is None:
                 data = jnp.zeros(cap, dtype=self.dtype.jnp_dtype())
                 return ColVal(self.dtype, data=data,
-                              validity=jnp.zeros(cap, dtype=bool))
+                              validity=jnp.zeros(cap, dtype=bool),
+                              literal=True)
             data = jnp.full(cap, self.value, dtype=self.dtype.jnp_dtype())
-            return ColVal.device(self.dtype, data)
+            return ColVal(self.dtype, data=data,
+                          validity=jnp.ones(cap, dtype=bool), literal=True)
         arr = pa.array([self.value] * batch.num_rows, type=self.dtype.to_arrow())
-        return ColVal.host(self.dtype, arr)
+        return ColVal(self.dtype, array=arr, literal=True)
 
     def cache_key(self):
         return ("lit", self.dtype.id.value, self.value)

@@ -16,12 +16,7 @@ from blaze_tpu.funcs import register
 from blaze_tpu.schema import (BOOL, DataType, Field, INT32, TypeId, UTF8)
 
 
-def _host(args, batch):
-    return [a.to_host(batch.num_rows) for a in args]
-
-
-def _lit(arr):
-    return arr[0].as_py() if len(arr) and arr[0].is_valid else None
+from blaze_tpu.funcs.common import host as _host, per_row as _per_row
 
 
 def _list_type(ts):
@@ -42,10 +37,10 @@ def _make_array(args, batch, out_type):
 @register("array_contains", lambda ts: BOOL)
 def _array_contains(args, batch, out_type):
     arrs = _host(args, batch)
-    needle = _lit(arrs[1])
+    needles = _per_row(arrs[1])
     py = []
-    for x in arrs[0]:
-        if not x.is_valid:
+    for x, needle in zip(arrs[0], needles):
+        if not x.is_valid or needle is None:
             py.append(None)
         else:
             py.append(needle in (x.as_py() or []))
@@ -105,11 +100,11 @@ def _array_min(args, batch, out_type):
 @register("array_join", lambda ts: UTF8)
 def _array_join(args, batch, out_type):
     arrs = _host(args, batch)
-    sep = _lit(arrs[1]) or ""
-    null_repl = _lit(arrs[2]) if len(arrs) > 2 else None
+    seps = _per_row(arrs[1])
+    null_repls = _per_row(arrs[2]) if len(arrs) > 2 else [None] * batch.num_rows
     py = []
-    for x in arrs[0]:
-        if not x.is_valid:
+    for x, sep, null_repl in zip(arrs[0], seps, null_repls):
+        if not x.is_valid or sep is None:
             py.append(None)
             continue
         vals = []
@@ -133,16 +128,19 @@ def _str_to_map(args, batch, out_type):
     """str_to_map(text, pair_delim=',', kv_delim=':') (ref spark_map.rs +
     JniBridge.strToMapSplit fallback)."""
     arrs = _host(args, batch)
-    pair_d = (_lit(arrs[1]) if len(arrs) > 1 else ",") or ","
-    kv_d = (_lit(arrs[2]) if len(arrs) > 2 else ":") or ":"
+    n = batch.num_rows
+    pair_ds = _per_row(arrs[1]) if len(arrs) > 1 else [","] * n
+    kv_ds = _per_row(arrs[2]) if len(arrs) > 2 else [":"] * n
     py = []
-    for x in arrs[0]:
-        if not x.is_valid:
+    for x, pair_d, kv_d in zip(arrs[0], pair_ds, kv_ds):
+        # Spark StringToMap is null-intolerant: NULL text or delimiter -> NULL
+        if not x.is_valid or pair_d is None or kv_d is None:
             py.append(None)
             continue
         out = {}
-        for pair in x.as_py().split(pair_d):
-            if kv_d in pair:
+        pairs = x.as_py().split(pair_d) if pair_d else list(x.as_py())
+        for pair in pairs:
+            if kv_d and kv_d in pair:
                 k, v = pair.split(kv_d, 1)
             else:
                 k, v = pair, None

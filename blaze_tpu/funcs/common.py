@@ -1,0 +1,45 @@
+"""Shared helpers for scalar-function implementations.
+
+Argument-shape contract (mirrors the reference's ColumnarValue
+Scalar-vs-Array split, datafusion-ext-functions/src/*): function impls
+receive evaluated `ColVal`s; helpers here materialize them host-side and
+classify literal vs column-valued arguments.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.exprs.base import ColVal
+
+
+def host(args, batch) -> List[pa.Array]:
+    return [a.to_host(batch.num_rows) for a in args]
+
+
+def per_row(arr: pa.Array) -> List[Any]:
+    """Per-row python values for an argument that may be literal or column."""
+    return [v.as_py() if v.is_valid else None for v in arr]
+
+
+def const_arg(val: ColVal, batch, fname: str,
+              arr: Optional[pa.Array] = None) -> Optional[Any]:
+    """Value of an argument that must be constant across the batch.
+
+    A `Literal` expression marks its ColVal (O(1), deterministic).  A
+    broadcast-constant column (plans that materialize literals early) is
+    accepted via an all-rows-equal check; a genuinely varying column raises
+    instead of silently applying row 0's value to every row."""
+    if arr is None:
+        arr = val.to_host(batch.num_rows)
+    if val.literal or len(arr) == 0:
+        return arr[0].as_py() if len(arr) and arr[0].is_valid else None
+    if arr.null_count == len(arr):
+        return None
+    if arr.null_count == 0 and pc.count_distinct(arr).as_py() <= 1:
+        return arr[0].as_py()
+    raise NotImplementedError(
+        f"{fname}: non-literal (column-valued) argument is not supported")

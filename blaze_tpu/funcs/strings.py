@@ -26,12 +26,11 @@ def _int32(ts):
     return INT32
 
 
-def _host(args, batch) -> List[pa.Array]:
-    return [a.to_host(batch.num_rows) for a in args]
+from blaze_tpu.funcs.common import const_arg, host as _host, per_row as _per_row
 
 
-def _lit(arr: pa.Array):
-    return arr[0].as_py() if len(arr) and arr[0].is_valid else None
+def _null_utf8(n: int) -> "ColVal":
+    return ColVal.host(UTF8, pa.nulls(n, type=pa.utf8()))
 
 
 @register("concat", _utf8)
@@ -46,15 +45,20 @@ def _concat(args, batch, out_type):
 @register("concat_ws", _utf8)
 def _concat_ws(args, batch, out_type):
     arrs = _host(args, batch)
-    sep = _lit(arrs[0]) or ""
+    seps = _per_row(arrs[0])
     parts = [a.cast(pa.utf8()) for a in arrs[1:]]
     if not parts:
-        return ColVal.host(UTF8, pa.array([""] * batch.num_rows))
+        # Spark: NULL separator -> NULL result
+        return ColVal.host(UTF8, pa.array(
+            ["" if s is not None else None for s in seps], type=pa.utf8()))
     # Spark concat_ws SKIPS null arguments instead of nulling the result
     py = []
     for i in range(batch.num_rows):
+        if seps[i] is None:
+            py.append(None)
+            continue
         vals = [p[i].as_py() for p in parts if p[i].is_valid]
-        py.append(sep.join(vals))
+        py.append(seps[i].join(vals))
     return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
 
 
@@ -72,29 +76,35 @@ def _lower(args, batch, out_type):
 
 @register("trim", _utf8)
 def _trim(args, batch, out_type):
-    arrs = _host(args, batch)
-    if len(arrs) == 1:
+    arrs = [args[0].to_host(batch.num_rows)]
+    if len(args) == 1:
         return ColVal.host(UTF8, pc.utf8_trim_whitespace(arrs[0]))
-    return ColVal.host(UTF8, pc.utf8_trim(arrs[0],
-                                          characters=_lit(arrs[1]) or ""))
+    chars = const_arg(args[1], batch, "trim")
+    if chars is None:
+        return _null_utf8(batch.num_rows)
+    return ColVal.host(UTF8, pc.utf8_trim(arrs[0], characters=chars))
 
 
 @register("ltrim", _utf8)
 def _ltrim(args, batch, out_type):
-    arrs = _host(args, batch)
-    if len(arrs) == 1:
+    arrs = [args[0].to_host(batch.num_rows)]
+    if len(args) == 1:
         return ColVal.host(UTF8, pc.utf8_ltrim_whitespace(arrs[0]))
-    return ColVal.host(UTF8, pc.utf8_ltrim(arrs[0],
-                                           characters=_lit(arrs[1]) or ""))
+    chars = const_arg(args[1], batch, "ltrim")
+    if chars is None:
+        return _null_utf8(batch.num_rows)
+    return ColVal.host(UTF8, pc.utf8_ltrim(arrs[0], characters=chars))
 
 
 @register("rtrim", _utf8)
 def _rtrim(args, batch, out_type):
-    arrs = _host(args, batch)
-    if len(arrs) == 1:
+    arrs = [args[0].to_host(batch.num_rows)]
+    if len(args) == 1:
         return ColVal.host(UTF8, pc.utf8_rtrim_whitespace(arrs[0]))
-    return ColVal.host(UTF8, pc.utf8_rtrim(arrs[0],
-                                           characters=_lit(arrs[1]) or ""))
+    chars = const_arg(args[1], batch, "rtrim")
+    if chars is None:
+        return _null_utf8(batch.num_rows)
+    return ColVal.host(UTF8, pc.utf8_rtrim(arrs[0], characters=chars))
 
 
 @register("length", _int32)
@@ -116,19 +126,22 @@ def _octet_length(args, batch, out_type):
 @register("substr", _utf8)
 def _substring(args, batch, out_type):
     arrs = _host(args, batch)
+    nrows = batch.num_rows
     s = arrs[0]
-    start = _lit(arrs[1]) or 0
-    length = _lit(arrs[2]) if len(arrs) > 2 else None
+    starts = _per_row(arrs[1])
+    has_len = len(arrs) > 2
+    lengths = _per_row(arrs[2]) if has_len else [None] * nrows
     py = []
-    for x in s:
-        if not x.is_valid:
+    for x, start, length in zip(s, starts, lengths):
+        # 2-arg form: suffix to end; 3-arg form with NULL length: NULL result
+        if not x.is_valid or start is None or (has_len and length is None):
             py.append(None)
             continue
         v = x.as_py()
         n = len(v)
         pos = int(start)
         st = pos - 1 if pos > 0 else (n + pos if pos < 0 else 0)
-        end = n if length is None else st + int(length)
+        end = n if not has_len else st + int(length)
         py.append(v[max(st, 0):max(min(end, n), 0)])
     return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
 
@@ -137,60 +150,72 @@ def _substring(args, batch, out_type):
 @register("locate", _int32)
 @register("position", _int32)
 def _instr(args, batch, out_type):
-    arrs = _host(args, batch)
     # locate(substr, str) vs instr(str, substr): Spark argument orders differ;
     # the planner normalizes to (str, substr) before reaching here
-    hay, needle = arrs[0], _lit(arrs[1]) or ""
+    hay = args[0].to_host(batch.num_rows)
+    arr1 = args[1].to_host(batch.num_rows)
+    try:
+        needle = const_arg(args[1], batch, "instr", arr=arr1)
+        if needle is None:
+            # NULL needle -> NULL result
+            return ColVal.host(INT32, pa.nulls(batch.num_rows,
+                                               type=pa.int32()))
+    except NotImplementedError:
+        # column-valued needle: per-row search
+        needles = _per_row(arr1)
+        py = []
+        for x, nd in zip(hay, needles):
+            if not x.is_valid or nd is None:
+                py.append(None)
+            else:
+                py.append(x.as_py().find(nd) + 1)
+        return ColVal.host(INT32, pa.array(py, type=pa.int32()))
     found = pc.find_substring(hay, pattern=needle)
     # arrow: -1 when missing; Spark: 0 missing, 1-based otherwise
     out = pc.add(found, 1)
     return ColVal.host(INT32, out.cast(pa.int32()))
 
 
-@register("lpad", _utf8)
-def _lpad(args, batch, out_type):
+def _pad(args, batch, left: bool):
     arrs = _host(args, batch)
-    width = _lit(arrs[1]) or 0
-    fill = (_lit(arrs[2]) if len(arrs) > 2 else " ") or " "
+    nrows = batch.num_rows
+    widths = _per_row(arrs[1])
+    fills = _per_row(arrs[2]) if len(args) > 2 else [" "] * nrows
     py = []
-    for x in arrs[0]:
-        if not x.is_valid:
+    for x, width, fill in zip(arrs[0], widths, fills):
+        if not x.is_valid or width is None or fill is None:
             py.append(None)
             continue
         v = x.as_py()
-        if len(v) >= width:
+        width = int(width)
+        if width <= 0:
+            py.append("")
+        elif len(v) >= width:
             py.append(v[:width])
+        elif not fill:
+            py.append(v)  # Spark: empty pad string pads nothing
         else:
             pad = (fill * width)[:width - len(v)]
-            py.append(pad + v)
+            py.append(pad + v if left else v + pad)
     return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+
+
+@register("lpad", _utf8)
+def _lpad(args, batch, out_type):
+    return _pad(args, batch, left=True)
 
 
 @register("rpad", _utf8)
 def _rpad(args, batch, out_type):
-    arrs = _host(args, batch)
-    width = _lit(arrs[1]) or 0
-    fill = (_lit(arrs[2]) if len(arrs) > 2 else " ") or " "
-    py = []
-    for x in arrs[0]:
-        if not x.is_valid:
-            py.append(None)
-            continue
-        v = x.as_py()
-        if len(v) >= width:
-            py.append(v[:width])
-        else:
-            pad = (fill * width)[:width - len(v)]
-            py.append(v + pad)
-    return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
+    return _pad(args, batch, left=False)
 
 
 @register("repeat", _utf8)
 def _repeat(args, batch, out_type):
     arrs = _host(args, batch)
-    n = _lit(arrs[1]) or 0
-    py = [None if not x.is_valid else x.as_py() * max(int(n), 0)
-          for x in arrs[0]]
+    ns = _per_row(arrs[1])
+    py = [None if (not x.is_valid or n is None) else x.as_py() * max(int(n), 0)
+          for x, n in zip(arrs[0], ns)]
     return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
 
 
@@ -203,36 +228,55 @@ def _reverse(args, batch, out_type):
 @register("split", lambda ts: DataType(TypeId.LIST, children=(
     Field("item", UTF8),)))
 def _split(args, batch, out_type):
-    arrs = _host(args, batch)
+    arrs = [args[0].to_host(batch.num_rows)]
     import re as _re
-    pattern = _lit(arrs[1]) or ""
-    limit = _lit(arrs[2]) if len(arrs) > 2 else -1
+    pattern = const_arg(args[1], batch, "split")
+    if pattern is None:
+        return ColVal.host(out_type, pa.nulls(batch.num_rows,
+                                              type=pa.list_(pa.utf8())))
+    if len(args) > 2:
+        limit = const_arg(args[2], batch, "split")
+        if limit is None:
+            return ColVal.host(out_type, pa.nulls(batch.num_rows,
+                                                  type=pa.list_(pa.utf8())))
+        limit = int(limit)
+    else:
+        limit = -1
     prog = _re.compile(pattern)
     py = []
     for x in arrs[0]:
         if not x.is_valid:
             py.append(None)
+        elif limit == 1:
+            py.append([x.as_py()])  # Java Pattern.split: at most 1 element
         else:
-            py.append(prog.split(x.as_py(),
-                                 maxsplit=0 if (limit or -1) <= 0
-                                 else int(limit) - 1))
+            parts = prog.split(x.as_py(),
+                               maxsplit=0 if limit <= 0 else limit - 1)
+            if limit == 0:  # Java limit=0 drops trailing empty strings
+                while parts and parts[-1] == "":
+                    parts.pop()
+            py.append(parts)
     return ColVal.host(out_type, pa.array(py, type=pa.list_(pa.utf8())))
 
 
 @register("replace", _utf8)
 def _replace(args, batch, out_type):
-    arrs = _host(args, batch)
-    search = _lit(arrs[1]) or ""
-    repl = (_lit(arrs[2]) if len(arrs) > 2 else "") or ""
+    arrs = [args[0].to_host(batch.num_rows)]
+    search = const_arg(args[1], batch, "replace")
+    repl = const_arg(args[2], batch, "replace") if len(args) > 2 else ""
+    if search is None or repl is None:
+        return _null_utf8(batch.num_rows)
     return ColVal.host(UTF8, pc.replace_substring(arrs[0], pattern=search,
                                                   replacement=repl))
 
 
 @register("regexp_replace", _utf8)
 def _regexp_replace(args, batch, out_type):
-    arrs = _host(args, batch)
-    pattern = _lit(arrs[1]) or ""
-    repl = (_lit(arrs[2]) if len(arrs) > 2 else "") or ""
+    arrs = [args[0].to_host(batch.num_rows)]
+    pattern = const_arg(args[1], batch, "regexp_replace")
+    repl = const_arg(args[2], batch, "regexp_replace") if len(args) > 2 else ""
+    if pattern is None or repl is None:
+        return _null_utf8(batch.num_rows)
     return ColVal.host(UTF8, pc.replace_substring_regex(
         arrs[0], pattern=pattern, replacement=repl))
 
@@ -240,9 +284,13 @@ def _regexp_replace(args, batch, out_type):
 @register("regexp_extract", _utf8)
 def _regexp_extract(args, batch, out_type):
     import re as _re
-    arrs = _host(args, batch)
-    prog = _re.compile(_lit(arrs[1]) or "")
-    group = int(_lit(arrs[2]) or 1) if len(arrs) > 2 else 1
+    arrs = [args[0].to_host(batch.num_rows)]
+    pattern = const_arg(args[1], batch, "regexp_extract")
+    group_v = const_arg(args[2], batch, "regexp_extract") if len(args) > 2 else 1
+    if pattern is None or group_v is None:
+        return _null_utf8(batch.num_rows)
+    prog = _re.compile(pattern)
+    group = int(group_v)
     py = []
     for x in arrs[0]:
         if not x.is_valid:
@@ -256,9 +304,11 @@ def _regexp_extract(args, batch, out_type):
 
 @register("translate", _utf8)
 def _translate(args, batch, out_type):
-    arrs = _host(args, batch)
-    src = _lit(arrs[1]) or ""
-    dst = _lit(arrs[2]) or ""
+    arrs = [args[0].to_host(batch.num_rows)]
+    src = const_arg(args[1], batch, "translate")
+    dst = const_arg(args[2], batch, "translate")
+    if src is None or dst is None:
+        return _null_utf8(batch.num_rows)
     table = {}
     for i, ch in enumerate(src):
         table[ord(ch)] = dst[i] if i < len(dst) else None
@@ -283,14 +333,15 @@ def _initcap(args, batch, out_type):
 @register("substring_index", _utf8)
 def _substring_index(args, batch, out_type):
     arrs = _host(args, batch)
-    delim = _lit(arrs[1]) or ""
-    count = int(_lit(arrs[2]) or 0)
+    delims = _per_row(arrs[1])
+    counts = _per_row(arrs[2])
     py = []
-    for x in arrs[0]:
-        if not x.is_valid:
+    for x, delim, count in zip(arrs[0], delims, counts):
+        if not x.is_valid or delim is None or count is None:
             py.append(None)
             continue
         v = x.as_py()
+        count = int(count)
         if not delim or count == 0:
             py.append("")
             continue

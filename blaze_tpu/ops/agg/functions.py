@@ -213,7 +213,6 @@ class MinMaxAgg(AggFunction):
         fn = K.segment_min if self.minimum else K.segment_max
         out = fn(data, gids, n, valid)
         has = K.segment_count(valid, gids, n) > 0
-        identity = K._identity_for(data.dtype, minimum=not self.minimum)
         out = jnp.where(has, out, jnp.zeros_like(out))
         return ((out, has),)
 

@@ -77,12 +77,27 @@ def _host_order_key(arr: pa.Array, descending: bool, nulls_first: bool
         if descending:
             key = np.uint64(1) - key
         bucket = np.full(n, 2, dtype=np.uint8)
+    elif pa.types.is_decimal(t):
+        # Order by the UNSCALED two's-complement int128 (value casting to
+        # int64 would truncate fractional digits).  Key = sign-biased high
+        # u64 + low u64, matching the device order-key path's unscaled-int
+        # encoding (schema.py:36) but exact for any precision.
+        filled = arr.fill_null(0).cast(pa.decimal128(38, t.scale))
+        buf = filled.buffers()[1]
+        off = filled.offset
+        u = np.frombuffer(buf, dtype=np.uint64,
+                          count=2 * (off + n))[2 * off:]
+        lokey = u[0::2].copy()
+        hikey = u[1::2].copy() ^ np.uint64(1 << 63)
+        if descending:
+            hikey, lokey = ~hikey, ~lokey
+        bucket = np.where(valid, 2, 0 if nulls_first else 4).astype(np.uint8)
+        hikey = np.where(valid, hikey, np.uint64(0))
+        lokey = np.where(valid, lokey, np.uint64(0))
+        return [bucket, hikey, lokey]
     else:
         if pa.types.is_timestamp(t) or pa.types.is_date(t):
             arr2 = arr.cast(pa.int64() if pa.types.is_timestamp(t) else pa.int32())
-        elif pa.types.is_decimal(t):
-            arr2 = arr.cast(pa.decimal128(t.precision, t.scale)).cast(pa.int64(),
-                                                                      safe=False)
         else:
             arr2 = arr
         v = np.asarray(arr2.fill_null(0)).astype(np.int64)

@@ -22,7 +22,9 @@ import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 
+from blaze_tpu import config
 from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.memory import MemConsumer, try_new_spill
 from blaze_tpu.exprs import PhysicalExpr
 from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
 from blaze_tpu.ops.sort import host_sort_keys
@@ -83,6 +85,57 @@ class WindowAggFunc(WindowFunc):
         return Field(self.name, self.agg.output_type(in_schema), True)
 
 
+class _WindowBuffer(MemConsumer):
+    """Buffered window input rows: a spill-capable MemConsumer (same
+    pattern as ops/sort.py _SortState).  Under memory pressure the
+    in-memory batches move to the shared Spill tiers (host-RAM -> disk)
+    and are read back at the next boundary flush."""
+
+    def __init__(self, op: "WindowExec"):
+        super().__init__("WindowExec.buffer")
+        self._op = op
+        self._mem: List[pa.RecordBatch] = []
+        self._mem_bytes = 0
+        self._spills: list = []
+        self.rows = 0
+
+    def add(self, rb: pa.RecordBatch) -> None:
+        self._mem.append(rb)
+        self._mem_bytes += rb.nbytes
+        self.rows += rb.num_rows
+        self.update_mem_used(self._mem_bytes)
+
+    def spill(self) -> int:
+        if not self._mem:
+            return 0
+        s = try_new_spill()
+        s.write_batches(iter(self._mem))
+        self._spills.append(s)
+        released = self._mem_bytes
+        self._mem = []
+        self._mem_bytes = 0
+        self._mem_used = 0
+        self.spill_metrics.spill_count += 1
+        self.spill_metrics.spilled_bytes += released
+        self._op.metrics.add("spill_count")
+        self._op.metrics.add("spilled_bytes", released)
+        return released
+
+    def drain(self) -> List[pa.RecordBatch]:
+        """All buffered batches in arrival order (spilled runs first, since
+        spills always capture the oldest prefix); resets the buffer."""
+        out: List[pa.RecordBatch] = []
+        for s in self._spills:
+            out.extend(s.read_batches())
+        self._spills = []
+        out.extend(self._mem)
+        self._mem = []
+        self._mem_bytes = 0
+        self.rows = 0
+        self.update_mem_used(0)
+        return out
+
+
 class WindowExec(ExecutionPlan):
 
     def __init__(self, child: ExecutionPlan,
@@ -107,14 +160,65 @@ class WindowExec(ExecutionPlan):
         return self._out_schema
 
     def execute(self, partition: int) -> BatchIterator:
-        batches = [b.compact().to_arrow()
-                   for b in self.children[0].execute(partition)]
-        batches = [b for b in batches if b.num_rows]
-        if not batches:
-            return iter(())
-        tbl = pa.Table.from_batches(batches).combine_chunks()
-        rb = tbl.to_batches()[0]
-        return iter(self._process(rb))
+        # Stream in partition-boundary-aligned chunks: input is sorted by
+        # partition_by (the planner places a SortExec below, as Spark does),
+        # so once a later partition starts every earlier one is complete and
+        # can be processed + emitted.  The buffer is a spill-capable
+        # MemConsumer; peak working memory is the largest single partition,
+        # not the whole input (ref window_exec.rs streaming processors).
+        from blaze_tpu.memory import MemManager
+
+        buf = _WindowBuffer(self)
+        buf.set_spillable(MemManager.get())
+        flush_rows = 4 * config.BATCH_SIZE.get()
+        prev_last: Optional[tuple] = None  # prior batch's last-row part keys
+        last_cut: Optional[int] = None  # buffer-relative last partition start
+        try:
+            for b in self.children[0].execute(partition):
+                rb = b.compact().to_arrow()
+                if rb.num_rows == 0:
+                    continue
+                if self.partition_by:
+                    # incremental boundary scan: only THIS batch's keys are
+                    # evaluated; the seam is detected by comparing row 0
+                    # against the cached key values of the previous batch's
+                    # last row (no batch copy, no buffer rescan, no spill
+                    # rehydration just to look)
+                    base = buf.rows
+                    keys = self._part_keys(rb)
+                    n = rb.num_rows
+                    seg = np.zeros(n, dtype=bool)
+                    for k in keys:
+                        seg[1:] |= k[1:] != k[:-1]
+                    if prev_last is not None:
+                        seg[0] = any(k[0] != pl
+                                     for k, pl in zip(keys, prev_last))
+                    idx = np.flatnonzero(seg)
+                    idx = idx[idx + base > 0]  # buffer row 0 is not a cut
+                    if len(idx):
+                        last_cut = int(idx[-1]) + base
+                    prev_last = tuple(k[-1] for k in keys)
+                buf.add(rb)
+                if self.partition_by and buf.rows >= flush_rows \
+                        and last_cut is not None:
+                    whole = pa.Table.from_batches(buf.drain()) \
+                        .combine_chunks().to_batches()[0]
+                    # take() materializes a copy: a plain slice would pin
+                    # every drained buffer while the accounting only sees
+                    # the slice's logical bytes
+                    tail_idx = pa.array(
+                        np.arange(last_cut, whole.num_rows), type=pa.int64())
+                    buf.add(whole.take(tail_idx))
+                    head = whole.slice(0, last_cut)
+                    last_cut = None
+                    yield from self._process(head)
+            tail = buf.drain()
+            if tail:
+                tbl = pa.Table.from_batches(tail).combine_chunks()
+                if tbl.num_rows:
+                    yield from self._process(tbl.to_batches()[0])
+        finally:
+            buf.unregister()
 
     # ------------------------------------------------------------------
     def _process(self, rb: pa.RecordBatch) -> List[ColumnBatch]:
@@ -167,22 +271,33 @@ class WindowExec(ExecutionPlan):
         self.metrics.add("output_rows", out.num_rows)
         return [ColumnBatch.from_arrow(out)]
 
+    def _part_keys(self, rb: pa.RecordBatch,
+                   cb: Optional[ColumnBatch] = None) -> List[np.ndarray]:
+        """Order-key-encoded partition_by columns (host arrays)."""
+        n = rb.num_rows
+        if cb is None:
+            cb = ColumnBatch.from_arrow(rb)
+        arrays = [e.evaluate(cb).to_host(n) for e in self.partition_by]
+        prb = pa.RecordBatch.from_arrays(
+            arrays, names=[f"p{i}" for i in range(len(arrays))])
+        return host_sort_keys(prb, list(range(len(arrays))),
+                              [False] * len(arrays), [True] * len(arrays))
+
+    def _part_boundaries(self, rb: pa.RecordBatch,
+                         cb: Optional[ColumnBatch] = None) -> np.ndarray:
+        """Bool array marking rows where a new partition starts."""
+        n = rb.num_rows
+        part_seg = np.zeros(n, dtype=bool)
+        part_seg[0] = True
+        if self.partition_by:
+            for k in self._part_keys(rb, cb):
+                part_seg[1:] |= k[1:] != k[:-1]
+        return part_seg
+
     def _segments(self, rb: pa.RecordBatch, cb: ColumnBatch):
         """(partition_boundary, order_change) bool arrays over rows."""
         n = rb.num_rows
-        if self.partition_by:
-            arrays = [e.evaluate(cb).to_host(n) for e in self.partition_by]
-            prb = pa.RecordBatch.from_arrays(
-                arrays, names=[f"p{i}" for i in range(len(arrays))])
-            keys = host_sort_keys(prb, list(range(len(arrays))),
-                                  [False] * len(arrays), [True] * len(arrays))
-            part_seg = np.zeros(n, dtype=bool)
-            part_seg[0] = True
-            for k in keys:
-                part_seg[1:] |= k[1:] != k[:-1]
-        else:
-            part_seg = np.zeros(n, dtype=bool)
-            part_seg[0] = True
+        part_seg = self._part_boundaries(rb, cb)
         if self.order_by:
             arrays = [e.evaluate(cb).to_host(n) for e, _, _ in self.order_by]
             orb = pa.RecordBatch.from_arrays(

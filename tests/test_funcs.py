@@ -193,3 +193,76 @@ def test_decimal_helpers():
     got = ev(b, fn("make_decimal", col(0), out_type=S.decimal(10, 2)))
     import decimal as pydec
     assert got == [pydec.Decimal("15.50"), pydec.Decimal("-0.99")]
+
+
+def test_string_column_valued_args():
+    # per-row (non-literal) position/width/count arguments (ADVICE r1)
+    b = make_batch(s=["hello", "hello", "hello"], p=[1, 2, 3], w=[6, 7, 2])
+    assert ev(b, fn("substring", col(0), col(1), lit(3))) == \
+        ["hel", "ell", "llo"]
+    assert ev(b, fn("lpad", col(0), col(2), lit("*"))) == \
+        ["*hello", "**hello", "he"]
+    assert ev(b, fn("rpad", col(0), col(2), lit("*"))) == \
+        ["hello*", "hello**", "he"]
+    assert ev(b, fn("repeat", col(0), col(1))) == \
+        ["hello", "hellohello", "hellohellohello"]
+    b2 = make_batch(s=["hello", "world"], n=["l", "ld"])
+    assert ev(b2, fn("instr", col(0), col(1))) == [3, 4]
+    b3 = make_batch(s=["a,b;c", "a,b;c"], d=[",", ";"], c=[1, -1])
+    assert ev(b3, fn("substring_index", col(0), col(1), col(2))) == \
+        ["a", "c"]
+    # pc-kernel functions reject column-valued pattern args instead of
+    # silently applying row 0's value
+    b4 = make_batch(s=["ab", "cd"], pat=["a", "c"])
+    with pytest.raises(NotImplementedError):
+        ev(b4, fn("replace", col(0), col(1), lit("-")))
+
+
+def test_concat_ws_null_separator():
+    b = make_batch(sep=["/", None], x=["a", "a"], y=["b", "b"])
+    assert ev(b, fn("concat_ws", col(0), col(1), col(2))) == ["a/b", None]
+
+
+def test_string_null_args_propagate():
+    # NULL length / needle / fill -> NULL result (code-review r2)
+    b = make_batch(s=["hello"], nl=pa.array([None], type=pa.int64()))
+    assert ev(b, fn("substring", col(0), lit(1), col(1))) == [None]
+    assert ev(b, fn("instr", col(0), lit(None))) == [None]
+    b2 = make_batch(s=["hello"], w=[-1])
+    assert ev(b2, fn("lpad", col(0), col(1), lit("*"))) == [""]
+    assert ev(b2, fn("rpad", col(0), col(1), lit("*"))) == [""]
+    b3 = make_batch(s=["hello"], f=pa.array([None], type=pa.string()))
+    assert ev(b3, fn("lpad", col(0), lit(8), col(1))) == [None]
+
+
+def test_array_column_valued_args():
+    b = make_batch(a=pa.array([[1, 2], [1, 2]]), n=[1, 3])
+    assert ev(b, fn("array_contains", col(0), col(1))) == [True, False]
+    b2 = make_batch(a=pa.array([["x", "y"], ["x", "y"]]), s=["-", "+"])
+    assert ev(b2, fn("array_join", col(0), col(1))) == ["x-y", "x+y"]
+
+
+def test_string_null_literal_pattern_args():
+    # NULL literal pattern/delim args -> NULL results (code-review r2)
+    b = make_batch(s=["a,b"])
+    assert ev(b, fn("split", col(0), lit(None))) == [None]
+    assert ev(b, fn("replace", col(0), lit(None), lit("-"))) == [None]
+    assert ev(b, fn("trim", col(0), lit(None))) == [None]
+    assert ev(b, fn("translate", col(0), lit(None), lit("x"))) == [None]
+    b2 = make_batch(s=["a:1,b:2"])
+    assert ev(b2, fn("str_to_map", col(0), lit(None), lit(":"))) == [None]
+    b3 = make_batch(a=pa.array([[1, 2]]),
+                    n=pa.array([None], type=pa.int64()))
+    assert ev(b3, fn("array_contains", col(0), col(1))) == [None]
+
+
+def test_split_limit_semantics():
+    # Java Pattern.split limits (code-review r2): limit=1 -> whole string,
+    # limit=0 -> drop trailing empties, NULL limit -> NULL
+    b = make_batch(s=["a,b,c"])
+    assert ev(b, fn("split", col(0), lit(","), lit(1))) == [["a,b,c"]]
+    assert ev(b, fn("split", col(0), lit(","), lit(2))) == [["a", "b,c"]]
+    assert ev(b, fn("split", col(0), lit(","), lit(None))) == [None]
+    b2 = make_batch(s=["a,b,,"])
+    assert ev(b2, fn("split", col(0), lit(","), lit(0))) == [["a", "b"]]
+    assert ev(b2, fn("split", col(0), lit(","), lit(-1))) == [["a", "b", "", ""]]

@@ -198,3 +198,23 @@ def test_fs_provider_local_and_callback():
     f = fs_provider.provide("x://data/f1").open("x://data/f1")
     assert f.read() == b"hello"
     assert fs_provider.provide("/tmp").__class__.__name__ == "LocalFs"
+
+
+def test_sort_decimal_order_host_path():
+    # decimal ORDER BY must order by value, not the truncated integer part
+    # (ADVICE r1 high: _host_order_key decimal truncation)
+    import decimal as pydec
+    vals = ["0.20", "-0.50", "1.45", "1.23", None, "-0.49"]
+    t = pa.table({"d": pa.array(
+        [None if v is None else pydec.Decimal(v) for v in vals],
+        type=pa.decimal128(12, 2))})
+    plan = SortExec(MemoryScanExec.from_arrow(t, batch_rows=4), [(col(0), False, True)])
+    out = pa.Table.from_batches(
+        [b.to_arrow() for b in plan.execute(0)])
+    got = [None if v is None else str(v) for v in out.column(0).to_pylist()]
+    assert got == [None, "-0.50", "-0.49", "0.20", "1.23", "1.45"]
+    # descending, nulls last
+    plan = SortExec(MemoryScanExec.from_arrow(t, batch_rows=4), [(col(0), True, False)])
+    out = pa.Table.from_batches([b.to_arrow() for b in plan.execute(0)])
+    got = [None if v is None else str(v) for v in out.column(0).to_pylist()]
+    assert got == ["1.45", "1.23", "0.20", "-0.49", "-0.50", None]

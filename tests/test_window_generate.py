@@ -156,3 +156,63 @@ def test_udtf():
     got = plan.execute_collect().to_arrow()
     assert got.column("n").to_pylist() == [2, 2, 3, 3, 3]
     assert got.column("i").to_pylist() == [0, 1, 0, 1, 2]
+
+
+def test_window_streaming_matches_oneshot():
+    # many partitions + small batches: exercises the partition-boundary
+    # flush path; result must equal pandas' whole-input computation
+    from blaze_tpu import config
+    rng = np.random.default_rng(3)
+    n = 4000
+    t = pa.table({
+        "g": pa.array(np.sort(rng.integers(0, 200, n))),
+        "v": pa.array(rng.integers(0, 100, n)),
+    })
+    scan = sorted_scan(t, 0, 1)
+    w = WindowExec(scan, [RankFunc("rn", WindowRankType.ROW_NUMBER),
+                          WindowAggFunc("s", make_agg("sum", [col(1)]),
+                                        running=True)],
+                   [col(0)], [(col(1), False, True)])
+    with config.scoped(**{config.BATCH_SIZE.key: 256}):
+        out = pa.Table.from_batches([b.to_arrow() for b in w.execute(0)])
+    # one-shot: same operator over the whole input in a single huge batch
+    w2 = WindowExec(sorted_scan(t, 0, 1),
+                    [RankFunc("rn", WindowRankType.ROW_NUMBER),
+                     WindowAggFunc("s", make_agg("sum", [col(1)]),
+                                   running=True)],
+                    [col(0)], [(col(1), False, True)])
+    with config.scoped(**{config.BATCH_SIZE.key: 1 << 20}):
+        out2 = pa.Table.from_batches([b.to_arrow() for b in w2.execute(0)])
+    df = out.to_pandas().sort_values(["g", "v", "rn"]).reset_index(drop=True)
+    df2 = out2.to_pandas().sort_values(["g", "v", "rn"]).reset_index(drop=True)
+    assert len(df) == len(df2) == 4000
+    assert (df["s"].values == df2["s"].values).all()
+    assert (df["rn"].values == df2["rn"].values).all()
+
+
+def test_window_buffer_spills_under_pressure():
+    from blaze_tpu import config
+    rng = np.random.default_rng(5)
+    n = 3000
+    t = pa.table({
+        "g": pa.array(np.sort(rng.integers(0, 50, n))),
+        "v": pa.array(np.arange(n)),
+    })
+    w = WindowExec(sorted_scan(t, 0, 1),
+                   [WindowAggFunc("s", make_agg("sum", [col(1)]),
+                                  running=True)],
+                   [col(0)], [(col(1), False, True)])
+    mgr = MemManager.init(64 << 10)  # 64 KiB: forces the buffer to spill
+    spills_before = mgr.total_spill_count
+    try:
+        with config.scoped(**{config.BATCH_SIZE.key: 128}):
+            out = pa.Table.from_batches([b.to_arrow() for b in w.execute(0)])
+        assert mgr.total_spill_count > spills_before, \
+            "expected the window buffer (or its upstream sort) to spill"
+    finally:
+        MemManager.init(4 << 30)
+    df = out.to_pandas().sort_values(["g", "v"]).reset_index(drop=True)
+    pdf = t.to_pandas().sort_values(["g", "v"]).reset_index(drop=True)
+    pdf["s"] = pdf.groupby("g")["v"].cumsum()
+    assert len(df) == n
+    assert (df["s"].values == pdf["s"].values).all()
