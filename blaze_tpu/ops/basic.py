@@ -101,23 +101,32 @@ class FilterProjectExec(ExecutionPlan):
 
 
 class LimitExec(ExecutionPlan):
-    """LocalLimit (per partition) / GlobalLimit on partition 0
-    (ref limit_exec.rs:305)."""
+    """LocalLimit (per partition) / GlobalLimit on partition 0, with
+    offset-skip (ref limit_exec.rs:305, LimitExecNode offset field)."""
 
-    def __init__(self, child: ExecutionPlan, limit: int):
+    def __init__(self, child: ExecutionPlan, limit: int, offset: int = 0):
         super().__init__([child])
         self._limit = limit
+        self._offset = offset
 
     @property
     def schema(self) -> Schema:
         return self.children[0].schema
 
     def execute(self, partition: int) -> BatchIterator:
+        to_skip = self._offset
         remaining = self._limit
         for batch in self.children[0].execute(partition):
             if remaining <= 0:
                 break
             n = batch.selected_count()
+            if to_skip:
+                if n <= to_skip:
+                    to_skip -= n
+                    continue
+                batch = batch.compact().take(list(range(to_skip, n)))
+                n -= to_skip
+                to_skip = 0
             if n <= remaining:
                 remaining -= n
                 yield batch

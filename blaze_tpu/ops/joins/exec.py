@@ -108,6 +108,10 @@ class JoinMap:
     def num_rows(self) -> int:
         return self.table.num_rows
 
+    @property
+    def has_null_keys(self) -> bool:
+        return bool((~self._valid).any())
+
     def lookup(self, probe_hashes: np.ndarray, probe_null: np.ndarray,
                probe_keys: List[pa.Array]
                ) -> Tuple[np.ndarray, np.ndarray]:
@@ -155,7 +159,8 @@ class BaseJoinExec(ExecutionPlan):
                  join_type: JoinType,
                  build_side: str = "right",
                  join_filter: Optional[PhysicalExpr] = None,
-                 existence_col: str = "exists"):
+                 existence_col: str = "exists",
+                 null_aware_anti: bool = False):
         super().__init__([left, right])
         assert build_side in ("left", "right")
         self.left_keys = list(left_keys)
@@ -164,6 +169,11 @@ class BaseJoinExec(ExecutionPlan):
         self.build_side = build_side
         self.join_filter = join_filter
         self._existence_col = existence_col
+        # NOT IN subquery semantics (ref BroadcastJoinExecNode
+        # is_null_aware_anti_join): a NULL anywhere makes membership
+        # three-valued UNKNOWN, so null build keys reject everything and
+        # null probe keys never pass
+        self.null_aware_anti = null_aware_anti
         self._out_schema = self._build_schema()
 
     # -- schema -------------------------------------------------------------
@@ -241,6 +251,17 @@ class BaseJoinExec(ExecutionPlan):
                       (jt == JoinType.RIGHT_SEMI and not probe_is_left))
         probe_anti = ((jt == JoinType.LEFT_ANTI and probe_is_left) or
                       (jt == JoinType.RIGHT_ANTI and not probe_is_left))
+        if probe_anti and self.null_aware_anti and jmap.num_rows:
+            if jmap.has_null_keys:
+                return  # NULL in the IN-list: nothing ever qualifies
+            # NOT IN over a non-empty list: a NULL probe key is UNKNOWN.
+            # (empty build side falls through: x NOT IN () is TRUE even
+            # for NULL x, so the plain anti path below keeps every row)
+            keep = np.nonzero((match_count == 0) & ~any_null)[0]
+            if len(keep):
+                yield ColumnBatch.from_arrow(
+                    probe_rb.take(pa.array(keep, type=pa.int64())))
+            return
         if probe_semi or probe_anti:
             keep = np.nonzero(match_count > 0 if probe_semi
                               else match_count == 0)[0]
@@ -390,3 +411,33 @@ class BroadcastJoinExec(BaseJoinExec):
                                for b in child.execute(p))
             return build_join_map(iter(batches), child.schema, keys)
         return get_or_create(f"join_map://{self._broadcast_id}", factory)
+
+
+class BuildHashMapExec(ExecutionPlan):
+    """Broadcast build-map stage (ref broadcast_join_build_hash_map_exec.rs):
+    materializes the build side once per broadcast so downstream
+    BroadcastJoinExec tasks can share it through the resource-map cache.
+    Batches stream through unchanged; the map is built as a side effect the
+    first time any consumer pulls the stage."""
+
+    def __init__(self, child: ExecutionPlan, keys: Sequence[PhysicalExpr],
+                 cache_id: Optional[str] = None):
+        super().__init__([child])
+        self.keys = list(keys)
+        self.cache_id = cache_id
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int) -> BatchIterator:
+        child = self.children[0]
+        if not self.cache_id:  # no consumer to share with: stream through
+            yield from child.execute(partition)
+            return
+        batches = [b.compact() for b in child.execute(partition)]
+        arrow = [b.to_arrow() for b in batches]
+        get_or_create(
+            f"join_map://{self.cache_id}",
+            lambda: build_join_map(iter(arrow), child.schema, self.keys))
+        yield from iter(batches)

@@ -71,6 +71,7 @@ class LeadLagFunc(WindowFunc):
 class NthValueFunc(WindowFunc):
     expr: PhysicalExpr = None
     n: int = 1               # 1-based
+    ignore_nulls: bool = False  # ref processors/nth_value.rs IGNORE NULLS
 
     def out_field(self, in_schema):
         return Field(self.name, self.expr.data_type(in_schema), True)
@@ -349,8 +350,23 @@ class WindowExec(ExecutionPlan):
     def _nth_value(self, f: NthValueFunc, cb: ColumnBatch, seg_start,
                    part_size, n: int) -> pa.Array:
         vals = f.expr.evaluate(cb).to_host(n)
-        target = np.asarray(seg_start) + (f.n - 1)
-        ok = (f.n - 1) < np.asarray(part_size)
+        starts = np.asarray(seg_start)
+        if f.ignore_nulls:
+            # nth NON-NULL row of the partition: rank each non-null value
+            # within its partition via a prefix count, pick rank == n
+            valid = np.asarray(vals.is_valid())
+            cum = np.cumsum(valid)
+            base = cum[starts] - valid[starts]
+            rank = cum - base
+            is_nth = valid & (rank == f.n)
+            nth_idx = np.full(n, -1, dtype=np.int64)
+            rows = np.nonzero(is_nth)[0]
+            nth_idx[starts[rows]] = rows
+            target = nth_idx[starts]
+            ok = target >= 0
+        else:
+            target = starts + (f.n - 1)
+            ok = (f.n - 1) < np.asarray(part_size)
         safe = np.clip(target, 0, n - 1)
         taken = vals.take(pa.array(safe, type=pa.int64()))
         py = [taken[i].as_py() if ok[i] else None for i in range(n)]

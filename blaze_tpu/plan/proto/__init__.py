@@ -1,0 +1,14 @@
+"""Vendored wire contract.
+
+`auron.proto` is copied VERBATIM from the reference
+(`native-engine/auron-planner/proto/auron.proto`, Apache License 2.0,
+Apache Auron incubating) — it is the engine-neutral plan/expr serde
+contract that the JVM front-end layers emit, adopted byte-for-byte per
+SURVEY.md §7 step 3 so the existing Spark/Flink extensions can target this
+engine through the preserved `TaskDefinition` boundary.
+
+`auron_pb2.py` is generated output:
+    protoc --python_out=. auron.proto   (from this directory)
+"""
+
+from blaze_tpu.plan.proto import auron_pb2  # noqa: F401
