@@ -1,0 +1,212 @@
+"""Fused-stage compiler tests: plan rewriting + result parity with the
+eager AggExec path (plan/fused.py)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu import config
+from blaze_tpu.exprs import BinaryExpr, col, lit
+from blaze_tpu.ops import (AggExec, AggMode, FilterExec, MemoryScanExec,
+                           make_agg)
+from blaze_tpu.plan import create_plan
+from blaze_tpu.plan.fused import FusedPartialAggExec, fuse_plan
+from blaze_tpu.shuffle import HashPartitioning, LocalShuffleExchange
+
+
+def _table(n=5000, seed=0, nulls=False):
+    rng = np.random.default_rng(seed)
+    cust = rng.integers(1, 200, n).astype(float)
+    if nulls:
+        mask = rng.random(n) < 0.05
+        cust[mask] = np.nan
+        cust_arr = pa.array(np.where(mask, None, cust).tolist(),
+                            type=pa.int64())
+    else:
+        cust_arr = pa.array(cust.astype(np.int64))
+    return pa.table({
+        "date": pa.array(rng.integers(100, 200, n)),
+        "cust": cust_arr,
+        "store": pa.array(rng.integers(1, 13, n)),
+        "amt": pa.array(np.round(rng.random(n) * 100, 2)),
+    })
+
+
+def _partial_agg_plan(scan):
+    flt = FilterExec(scan, [BinaryExpr(">", col(0, "date"), lit(150))])
+    return AggExec(flt,
+                   [(col(1, "cust"), "cust"), (col(2, "store"), "store")],
+                   [(make_agg("sum", [col(3)]), AggMode.PARTIAL, "amt_sum"),
+                    (make_agg("count", [col(3)]), AggMode.PARTIAL, "cnt"),
+                    (make_agg("min", [col(3)]), AggMode.PARTIAL, "amt_min"),
+                    (make_agg("max", [col(3)]), AggMode.PARTIAL, "amt_max")])
+
+
+def _collect(plan):
+    out = [b.compact().to_arrow() for b in plan.execute(0)]
+    out = [b for b in out if b.num_rows]
+    t = pa.Table.from_batches(out, schema=plan.schema.to_arrow())
+    df = t.to_pandas().sort_values(["cust", "store"]).reset_index(drop=True)
+    return df
+
+
+class TestDense:
+    def test_memory_scan_fuses_dense_and_matches_eager(self):
+        t = _table(nulls=True)
+        eager = _partial_agg_plan(MemoryScanExec.from_arrow(t))
+        fused = fuse_plan(_partial_agg_plan(MemoryScanExec.from_arrow(t)))
+        assert isinstance(fused, FusedPartialAggExec)
+        assert fused.fused_mode == "dense"
+        a, b = _collect(eager), _collect(fused)
+        assert len(a) == len(b)
+        for c in a.columns:
+            np.testing.assert_allclose(
+                a[c].to_numpy(dtype=float), b[c].to_numpy(dtype=float),
+                rtol=1e-9, err_msg=c)
+
+    def test_parquet_stats_bounds(self, tmp_path):
+        t = _table()
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(t, path, row_group_size=1000)
+        schema_d = {"fields": [
+            {"name": "date", "type": {"id": "int64"}, "nullable": True},
+            {"name": "cust", "type": {"id": "int64"}, "nullable": True},
+            {"name": "store", "type": {"id": "int64"}, "nullable": True},
+            {"name": "amt", "type": {"id": "float64"}, "nullable": True}]}
+        d = {"kind": "hash_agg",
+             "input": {"kind": "filter",
+                       "input": {"kind": "parquet_scan", "schema": schema_d,
+                                 "file_groups": [[path]]},
+                       "predicates": [{"kind": "binary", "op": ">",
+                                       "l": {"kind": "column",
+                                             "name": "date"},
+                                       "r": {"kind": "literal", "value": 150,
+                                             "type": {"id": "int64"}}}]},
+             "groupings": [{"expr": {"kind": "column", "name": "cust"},
+                            "name": "cust"},
+                           {"expr": {"kind": "column", "name": "store"},
+                            "name": "store"}],
+             "aggs": [{"fn": "sum", "mode": "partial", "name": "amt_sum",
+                       "args": [{"kind": "column", "name": "amt"}]}]}
+        eager = create_plan(d)
+        fused = fuse_plan(create_plan(d))
+        assert isinstance(fused, FusedPartialAggExec)
+        assert fused.fused_mode == "dense"
+        a, b = _collect(eager), _collect(fused)
+        np.testing.assert_allclose(a["amt_sum.sum"].to_numpy(),
+                                   b["amt_sum.sum"].to_numpy(), rtol=1e-9)
+
+    def test_complete_mode_fuses(self):
+        t = _table()
+        scan = MemoryScanExec.from_arrow(t)
+        agg = AggExec(scan, [(col(2, "store"), "store")],
+                      [(make_agg("sum", [col(3)]), AggMode.COMPLETE, "s"),
+                       (make_agg("count", [col(3)]), AggMode.COMPLETE, "c")])
+        fused = fuse_plan(agg)
+        assert isinstance(fused, FusedPartialAggExec)
+        df = pa.Table.from_batches(
+            [b.compact().to_arrow() for b in fused.execute(0)]).to_pandas()
+        want = t.to_pandas().groupby("store").agg(
+            s=("amt", "sum"), c=("amt", "count")).reset_index()
+        got = df.sort_values("store").reset_index(drop=True)
+        np.testing.assert_allclose(got["s"].to_numpy(),
+                                   want["s"].to_numpy(), rtol=1e-9)
+        assert (got["c"].to_numpy() == want["c"].to_numpy()).all()
+
+
+class TestSorted:
+    def _plan_with_computed_key(self, t):
+        # group key is an arithmetic expr -> no traceable bounds -> sorted
+        scan = MemoryScanExec.from_arrow(t)
+        return AggExec(scan,
+                       [(BinaryExpr("%", col(1, "cust"), lit(50)), "kmod")],
+                       [(make_agg("sum", [col(3)]), AggMode.PARTIAL, "s")])
+
+    def test_sorted_path_matches_eager(self):
+        t = _table()
+        eager = self._plan_with_computed_key(t)
+        fused = fuse_plan(self._plan_with_computed_key(t))
+        assert isinstance(fused, FusedPartialAggExec)
+        assert fused.fused_mode == "sorted"
+        a = pa.Table.from_batches([b.compact().to_arrow()
+                                   for b in eager.execute(0)]).to_pandas()
+        b = pa.Table.from_batches([b.compact().to_arrow()
+                                   for b in fused.execute(0)]).to_pandas()
+        a = a.sort_values("kmod").reset_index(drop=True)
+        b = b.sort_values("kmod").reset_index(drop=True)
+        np.testing.assert_allclose(a["s.sum"].to_numpy(),
+                                   b["s.sum"].to_numpy(), rtol=1e-9)
+
+    def test_overflow_degrades_to_passthrough_and_final_agg_fixes_it(self):
+        t = _table(n=4000)
+        config.conf.set(config.ON_DEVICE_AGG_CAPACITY.key, 16)
+        try:
+            partial = fuse_plan(self._plan_with_computed_key(t))
+            assert partial.fused_mode == "sorted"
+            ex = LocalShuffleExchange(partial,
+                                      HashPartitioning([col(0)], 1))
+            final = AggExec(ex, [(col(0, "kmod"), "kmod")],
+                            [(make_agg("sum", [col(1)]),
+                              AggMode.PARTIAL_MERGE, "s")])
+            out = pa.Table.from_batches(
+                [b.compact().to_arrow() for b in final.execute(0)]
+            ).to_pandas().sort_values("kmod").reset_index(drop=True)
+            assert int(partial.metrics.get("partial_skipped")) >= 1
+        finally:
+            config.conf.unset(config.ON_DEVICE_AGG_CAPACITY.key)
+        df = t.to_pandas()
+        df["kmod"] = df.cust % 50
+        want = df.groupby("kmod").amt.sum().reset_index() \
+            .sort_values("kmod").reset_index(drop=True)
+        np.testing.assert_allclose(out["s.sum"].to_numpy(),
+                                   want["amt"].to_numpy(), rtol=1e-9)
+
+
+class TestEligibility:
+    def test_string_keys_not_fused(self):
+        t = pa.table({"s": pa.array(["a", "b", "a"]),
+                      "v": pa.array([1.0, 2.0, 3.0])})
+        agg = AggExec(MemoryScanExec.from_arrow(t),
+                      [(col(0, "s"), "s")],
+                      [(make_agg("sum", [col(1)]), AggMode.PARTIAL, "v")])
+        assert not isinstance(fuse_plan(agg), FusedPartialAggExec)
+
+    def test_avg_not_fused(self):
+        t = _table(n=100)
+        agg = AggExec(MemoryScanExec.from_arrow(t),
+                      [(col(2, "store"), "store")],
+                      [(make_agg("avg", [col(3)]), AggMode.PARTIAL, "a")])
+        assert not isinstance(fuse_plan(agg), FusedPartialAggExec)
+
+    def test_merge_modes_not_fused(self):
+        t = _table(n=100)
+        agg = AggExec(MemoryScanExec.from_arrow(t),
+                      [(col(2, "store"), "store")],
+                      [(make_agg("sum", [col(3)]), AggMode.PARTIAL_MERGE,
+                        "s")])
+        assert not isinstance(fuse_plan(agg), FusedPartialAggExec)
+
+    def test_config_gate(self):
+        t = _table(n=100)
+        config.conf.set(config.FUSED_STAGE_ENABLE.key, False)
+        try:
+            agg = _partial_agg_plan(MemoryScanExec.from_arrow(t))
+            assert not isinstance(fuse_plan(agg), FusedPartialAggExec)
+        finally:
+            config.conf.unset(config.FUSED_STAGE_ENABLE.key)
+
+    def test_inner_agg_rewritten_in_place(self):
+        # the fused node must also be found under other operators
+        t = _table(n=500)
+        partial = _partial_agg_plan(MemoryScanExec.from_arrow(t))
+        ex = LocalShuffleExchange(partial,
+                                  HashPartitioning([col(0), col(1)], 2))
+        final = AggExec(ex,
+                        [(col(0, "cust"), "cust"), (col(1, "store"),
+                                                    "store")],
+                        [(make_agg("sum", [col(2)]), AggMode.PARTIAL_MERGE,
+                          "amt_sum")])
+        top = fuse_plan(final)
+        assert isinstance(top, AggExec)
+        assert isinstance(ex.children[0], FusedPartialAggExec)
