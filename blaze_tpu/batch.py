@@ -98,9 +98,18 @@ class DeviceColumn:
         valid = _unpack_validity(arr)
         return DeviceColumn.from_numpy(values, valid, dtype, capacity)
 
-    def to_arrow(self, num_rows: int, selection: Optional[np.ndarray] = None) -> pa.Array:
-        values = np.asarray(self.data)[:num_rows]
-        valid = np.asarray(self.validity)[:num_rows]
+    def to_arrow(self, num_rows: int, selection: Optional[np.ndarray] = None,
+                 prefetched: Optional[tuple] = None) -> pa.Array:
+        """`prefetched` = (values, validity) numpy arrays already pulled in
+        a batched device_get — individual per-column syncs each cost a full
+        round trip on a tunneled device."""
+        if prefetched is not None:
+            values, valid = prefetched
+            values = values[:num_rows]
+            valid = valid[:num_rows]
+        else:
+            values = np.asarray(self.data)[:num_rows]
+            valid = np.asarray(self.validity)[:num_rows]
         if selection is not None:
             values = values[selection[:num_rows]]
             valid = valid[selection[:num_rows]]
@@ -224,10 +233,15 @@ class ColumnBatch:
         return base
 
     def selected_count(self) -> int:
-        """Host-synced surviving row count."""
+        """Host-synced surviving row count (one scalar D2H, cached — on a
+        tunneled device every sync costs a full round trip)."""
         if self.selection is None:
             return self.num_rows
-        return int(jnp.sum(self.row_mask()))
+        c = getattr(self, "_sel_count", None)
+        if c is None:
+            c = int(jnp.sum(self.row_mask()))
+            self._sel_count = c  # dataclasses.replace drops the cache
+        return c
 
     # -- transformations ----------------------------------------------------
     def with_selection(self, sel: jax.Array) -> "ColumnBatch":
@@ -237,13 +251,27 @@ class ColumnBatch:
     def compact(self) -> "ColumnBatch":
         """Pack surviving rows to the front; drops the selection mask.
 
-        Host-side boundary operation (the CoalesceStream analog)."""
+        Device-resident columns compact ON DEVICE (stable argsort of the
+        mask = order-preserving partition) with only the one scalar count
+        sync — a full per-column D2H round trip here would dominate every
+        filter on a tunneled device.  Host (string) columns still need the
+        mask host-side."""
         if self.selection is None:
             return self
-        sel_np = np.asarray(self.row_mask())
-        indices = np.nonzero(sel_np)[0]
-        cols = [c.take_host(indices) for c in self.columns]
-        return ColumnBatch(self.schema, cols, len(indices), None)
+        count = self.selected_count()
+        if count == self.num_rows:
+            return replace(self, selection=None)
+        if any(isinstance(c, HostColumn) for c in self.columns):
+            sel_np = np.asarray(self.row_mask())
+            indices = np.nonzero(sel_np)[0]
+            cols = [c.take_host(indices) for c in self.columns]
+            return ColumnBatch(self.schema, cols, len(indices), None)
+        mask = self.row_mask()
+        perm = jnp.argsort(~mask, stable=True)  # selected first, in order
+        cols = [DeviceColumn(c.dtype, jnp.take(c.data, perm),
+                             jnp.take(c.validity, perm))
+                for c in self.columns]
+        return ColumnBatch(self.schema, cols, count, None)
 
     def take(self, indices: np.ndarray) -> "ColumnBatch":
         indices = np.asarray(indices)
@@ -256,16 +284,37 @@ class ColumnBatch:
                            self.num_rows, self.selection)
 
     def to_arrow(self) -> pa.RecordBatch:
+        # batch ALL device reads (mask + every column) into one device_get:
+        # the tunnel round trip dominates, and device_get overlaps transfers
+        to_fetch = []
+        if self.selection is not None:
+            to_fetch.append(self.row_mask())
+        dev_idx = [i for i, c in enumerate(self.columns)
+                   if isinstance(c, DeviceColumn)]
+        for i in dev_idx:
+            to_fetch.append(self.columns[i].data)
+            to_fetch.append(self.columns[i].validity)
+        fetched = jax.device_get(to_fetch) if to_fetch else []
+        pos = 0
         sel = None
         if self.selection is not None:
-            sel = np.asarray(self.row_mask())
-        arrays = [c.to_arrow(self.num_rows, sel) for c in self.columns]
+            sel = fetched[0]
+            pos = 1
+        pre = {}
+        for i in dev_idx:
+            pre[i] = (fetched[pos], fetched[pos + 1])
+            pos += 2
+        arrays = [c.to_arrow(self.num_rows, sel, prefetched=pre[i])
+                  if i in pre else c.to_arrow(self.num_rows, sel)
+                  for i, c in enumerate(self.columns)]
         return pa.RecordBatch.from_arrays(arrays, schema=self.schema.to_arrow())
 
     @staticmethod
     def concat(batches: Sequence["ColumnBatch"],
                capacity: Optional[int] = None) -> "ColumnBatch":
-        """Concatenate (host-side) after compacting each batch."""
+        """Concatenate after compacting each batch.  Device columns stay on
+        device (slice bounds are host metadata, so shapes remain static);
+        host columns concatenate via Arrow."""
         assert batches
         batches = [b.compact() for b in batches]
         schema = batches[0].schema
@@ -274,11 +323,15 @@ class ColumnBatch:
         cols: List[Column] = []
         for i, f in enumerate(schema):
             if f.data_type.is_fixed_width:
-                vals = np.concatenate([np.asarray(b.columns[i].data)[:b.num_rows]
-                                       for b in batches])
-                valid = np.concatenate([np.asarray(b.columns[i].validity)[:b.num_rows]
-                                        for b in batches])
-                cols.append(DeviceColumn.from_numpy(vals, valid, f.data_type, cap))
+                vals = jnp.concatenate(
+                    [b.columns[i].data[:b.num_rows] for b in batches])
+                valid = jnp.concatenate(
+                    [b.columns[i].validity[:b.num_rows] for b in batches])
+                pad = cap - total
+                if pad > 0:
+                    vals = jnp.pad(vals, (0, pad))
+                    valid = jnp.pad(valid, (0, pad))
+                cols.append(DeviceColumn(f.data_type, vals, valid))
             else:
                 arrs = [b.columns[i].array for b in batches]
                 combined = pa.concat_arrays([a.cast(f.data_type.to_arrow()) for a in arrs])

@@ -38,13 +38,15 @@ class NativeExecutionRuntime:
     def __init__(self, task_definition: Dict[str, Any],
                  plan: Optional[ExecutionPlan] = None):
         from blaze_tpu.plan import create_plan, decode_task_definition
+        from blaze_tpu.plan.fused import fuse_plan
         td = decode_task_definition(task_definition)
         self.task = TaskContext(
             stage_id=td.get("stage_id", 0),
             partition_id=td.get("partition_id", 0),
             num_partitions=td.get("num_partitions", 1),
             task_attempt_id=td.get("task_attempt_id", 0))
-        self.plan = plan if plan is not None else create_plan(td["plan"])
+        self.plan = fuse_plan(plan if plan is not None
+                              else create_plan(td["plan"]))
         depth = max(1, config.INPUT_BATCH_PREFETCH.get())
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._error: Optional[BaseException] = None

@@ -183,7 +183,16 @@ INPUT_BATCH_PREFETCH = int_conf(
     "Host->device double-buffering depth (the sync_channel(1) analog, rt.rs:142).")
 ON_DEVICE_AGG_CAPACITY = int_conf(
     "auron.tpu.agg.table.capacity", 1 << 16,
-    "Static per-device group slots for hash aggregation before host merge.")
+    "Static group slots for the fused sorted-table aggregation stage; "
+    "overflow degrades to pass-through partials (plan/fused.py).")
+FUSED_STAGE_ENABLE = bool_conf(
+    "auron.tpu.fused.stage.enable", True,
+    "Rewrite eligible scan->filter->partial-agg subtrees into single-XLA-"
+    "program fused stages (plan/fused.py fuse_plan).")
+FUSED_STAGE_CAPACITY = int_conf(
+    "auron.tpu.fused.stage.capacity", 1 << 22,
+    "Max dense group-table slots (product of key ranges) for the fused "
+    "dense-group-id path before falling back to the sorted table.")
 SORT_SPILL_BATCHES = int_conf(
     "auron.tpu.sort.inmem.batches", 64,
     "Batches buffered in device memory before external sort spills a run.")

@@ -119,6 +119,7 @@ class RLike(PhysicalExpr):
 
     child: PhysicalExpr
     pattern: str
+    case_insensitive: bool = False
 
     def children(self):
         return (self.child,)
@@ -127,11 +128,13 @@ class RLike(PhysicalExpr):
         return BOOL
 
     def cache_key(self):
-        return ("rlike", self.pattern, self.child.cache_key())
+        return ("rlike", self.pattern, self.case_insensitive,
+                self.child.cache_key())
 
     def evaluate(self, batch: ColumnBatch) -> ColVal:
         arr = self.child.evaluate(batch).to_host(batch.num_rows)
-        prog = re.compile(self.pattern)
+        prog = re.compile(self.pattern,
+                          re.IGNORECASE if self.case_insensitive else 0)
         py = [None if not x.is_valid else bool(prog.search(x.as_py()))
               for x in arr]
         return ColVal.host(BOOL, pa.array(py, type=pa.bool_()))

@@ -67,10 +67,14 @@ def gen_customer(scale: float, seed: int = 13) -> pa.Table:
     })
 
 
+SALES_DATE_DAYS = 1826  # TPC-DS facts span ~5 years (1998-2002), not the
+#                         full 200-year date_dim
+
+
 def gen_store_returns(scale: float, seed: int = 14) -> pa.Table:
     n = _rows("store_returns", scale)
     rng = np.random.default_rng(seed)
-    date_n = _rows("date_dim", scale)
+    date_n = min(_rows("date_dim", scale), SALES_DATE_DAYS)
     null_mask = rng.random(n) < 0.02
     cust = rng.integers(1, _rows("customer", scale) + 1, n).astype(float)
     cust[null_mask] = np.nan
@@ -88,7 +92,7 @@ def gen_store_returns(scale: float, seed: int = 14) -> pa.Table:
 def gen_store_sales(scale: float, seed: int = 15) -> pa.Table:
     n = _rows("store_sales", scale)
     rng = np.random.default_rng(seed)
-    date_n = _rows("date_dim", scale)
+    date_n = min(_rows("date_dim", scale), SALES_DATE_DAYS)
     return pa.table({
         "ss_sold_date_sk": pa.array(
             rng.integers(2450815, 2450815 + date_n, n)),

@@ -198,12 +198,12 @@ class _AggState(MemConsumer):
             return None
 
         # per-group key values
-        out_arrays: List[pa.Array] = []
+        sink = _ArrowSink()
         for (data, valid), cv in zip(key_dev, key_vals):
             sd = jnp.take(data, perm)
             sv = jnp.take(valid, perm) & sorted_valid
             kd, kv = K.segment_first(sd, sv, gids, num_groups)
-            out_arrays.append(_device_to_arrow(kd, kv, num_groups))
+            sink.add_device(kd, kv, num_groups)
 
         mode_is_raw = {AggMode.PARTIAL: True, AggMode.COMPLETE: True,
                        AggMode.PARTIAL_MERGE: False, AggMode.FINAL: False}
@@ -220,7 +220,8 @@ class _AggState(MemConsumer):
                     accs = fn.host_update(args_host, host_gids, num_groups)
                 else:
                     accs = fn.host_merge(args_host, host_gids, num_groups)
-                out_arrays.extend(accs)
+                for a in accs:
+                    sink.add_host(a)
             else:
                 args = []
                 for c in cols:
@@ -232,7 +233,8 @@ class _AggState(MemConsumer):
                 else:
                     accs = fn.partial_merge(args, gids, num_groups)
                 for ad, av in accs:
-                    out_arrays.append(_device_to_arrow(ad, av, num_groups))
+                    sink.add_device(ad, av, num_groups)
+        out_arrays = sink.materialize()
         return pa.RecordBatch.from_arrays(
             out_arrays, schema=self._internal_pa_schema(out_arrays))
 
@@ -364,13 +366,13 @@ class _AggState(MemConsumer):
             num_groups = 1
         if num_groups == 0:
             return None
-        out_arrays: List[pa.Array] = []
+        sink = _ArrowSink()
         for i in range(self.num_keys):
             col = cb.columns[i]
             sd = jnp.take(col.data, perm)
             sv = jnp.take(col.validity, perm) & sorted_valid
             kd, kv = K.segment_first(sd, sv, gids, num_groups)
-            out_arrays.append(_device_to_arrow(kd, kv, num_groups))
+            sink.add_device(kd, kv, num_groups)
         j = self.num_keys
         host_gids = None
         for fn, mode, name in op._aggs:
@@ -383,7 +385,8 @@ class _AggState(MemConsumer):
                     hg[p] = g
                     host_gids = hg[:rb.num_rows]
                 args = [rb.column(j + t) for t in range(nacc)]
-                out_arrays.extend(fn.host_merge(args, host_gids, num_groups))
+                for a in fn.host_merge(args, host_gids, num_groups):
+                    sink.add_host(a)
             else:
                 args = []
                 for t in range(nacc):
@@ -392,9 +395,9 @@ class _AggState(MemConsumer):
                                  jnp.take(col.validity, perm) & sorted_valid))
                 accs = fn.partial_merge(args, gids, num_groups)
                 for ad, av in accs:
-                    out_arrays.append(_device_to_arrow(ad, av, num_groups))
+                    sink.add_device(ad, av, num_groups)
             j += nacc
-        return pa.RecordBatch.from_arrays(out_arrays,
+        return pa.RecordBatch.from_arrays(sink.materialize(),
                                           schema=self._internal_schema)
 
     def spill(self) -> int:
@@ -497,13 +500,15 @@ class _AggState(MemConsumer):
         for rb in batches:
             if rb.num_rows == 0:
                 continue
-            arrays: List[pa.Array] = self._decode_keys(rb)
+            sink = _ArrowSink()
+            for a in self._decode_keys(rb):
+                sink.add_host(a)
             j = self.num_keys
             for fn, mode, name in op._aggs:
                 nacc = len(fn.acc_fields(self.in_schema))
                 if mode in (AggMode.FINAL, AggMode.COMPLETE):
                     if fn.is_host:
-                        arrays.append(fn.host_eval(
+                        sink.add_host(fn.host_eval(
                             [rb.column(j + t) for t in range(nacc)]))
                     else:
                         cap = round_capacity(rb.num_rows)
@@ -515,11 +520,12 @@ class _AggState(MemConsumer):
                             accs.append((dc.data[:rb.num_rows],
                                          dc.validity[:rb.num_rows]))
                         vd, vv = fn.final_eval(accs)
-                        arrays.append(_device_to_arrow(vd, vv, rb.num_rows))
+                        sink.add_device(vd, vv, rb.num_rows)
                 else:
                     for t in range(nacc):
-                        arrays.append(rb.column(j + t))
+                        sink.add_host(rb.column(j + t))
                 j += nacc
+            arrays = sink.materialize()
             arrays = [_cast_output(a, f.type) for a, f in
                       zip(arrays, out_schema)]
             out = pa.RecordBatch.from_arrays(arrays, schema=out_schema)
@@ -543,6 +549,37 @@ def _device_to_arrow(data: jax.Array, valid: jax.Array, n: int) -> pa.Array:
     if d.dtype == np.bool_:
         return pa.array(d, mask=~v)
     return pa.array(d, mask=~v)
+
+
+class _ArrowSink:
+    """Collects output columns, deferring device arrays so ALL of them come
+    back in ONE batched device_get — per-column syncs each cost a full
+    round trip on a tunneled device."""
+
+    def __init__(self):
+        self._items: List = []  # pa.Array | ("dev", data, valid, n)
+
+    def add_host(self, arr: pa.Array) -> None:
+        self._items.append(arr)
+
+    def add_device(self, data: jax.Array, valid: jax.Array, n: int) -> None:
+        self._items.append(("dev", data, valid, n))
+
+    def materialize(self) -> List[pa.Array]:
+        pending = [(it[1], it[2]) for it in self._items
+                   if isinstance(it, tuple)]
+        fetched = jax.device_get(pending) if pending else []
+        out: List[pa.Array] = []
+        j = 0
+        for it in self._items:
+            if isinstance(it, tuple):
+                d, v = fetched[j]
+                j += 1
+                n = it[3]
+                out.append(pa.array(d[:n], mask=~v[:n]))
+            else:
+                out.append(it)
+        return out
 
 
 def _internal_to_batch(rb: pa.RecordBatch) -> ColumnBatch:

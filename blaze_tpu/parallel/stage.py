@@ -155,17 +155,19 @@ def pack_dense_keys(key_cols: Sequence[Tuple[jax.Array, jax.Array]],
     return gid, total
 
 
-def unpack_dense_keys(slots: jax.Array, ranges: Sequence[Tuple[int, int]]
+def unpack_dense_keys(slots, ranges: Sequence[Tuple[int, int]], xp=jnp
                       ) -> List[Tuple[jax.Array, jax.Array]]:
-    """Inverse of pack_dense_keys for slot indices -> (key, validity)."""
+    """Inverse of pack_dense_keys for slot indices -> (key, validity).
+    Pure stride arithmetic: pass xp=numpy to decode host-side without a
+    device round trip."""
     out = []
-    rem = slots.astype(jnp.int64)
+    rem = slots.astype(xp.int64)
     for lo, hi in ranges:
         size = hi - lo + 2
         k = rem % size
         rem = rem // size
         valid = k < (hi - lo + 1)
-        out.append((jnp.where(valid, k + lo, 0), valid))
+        out.append((xp.where(valid, k + lo, 0), valid))
     return out
 
 

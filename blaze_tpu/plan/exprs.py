@@ -74,7 +74,8 @@ def expr_from_dict(d: Dict[str, Any], schema: Optional[Schema] = None
         return Like(expr_from_dict(d["child"], schema), d["pattern"],
                     d.get("negated", False), d.get("case_insensitive", False))
     if k == "rlike":
-        return RLike(expr_from_dict(d["child"], schema), d["pattern"])
+        return RLike(expr_from_dict(d["child"], schema), d["pattern"],
+                     d.get("case_insensitive", False))
     if k in ("string_starts_with", "string_ends_with", "string_contains"):
         kind = k.replace("string_", "")
         return StringPredicate(kind, expr_from_dict(d["child"], schema),

@@ -1,0 +1,507 @@
+"""Fused-stage compiler: planner trees -> single-XLA-program aggregation.
+
+The eager AggExec (ops/agg/exec.py) materializes an Arrow partial batch per
+input batch, with a host sync for the group count — general, but it leaves
+the device idle between batches.  This pass rewrites eligible
+scan→filter→project→partial-agg subtrees so the aggregation loop body is
+ONE jit'd XLA program per batch with a persistent on-device group table and
+no host syncs (the rt.rs:156 whole-chain-in-one-task analog; SURVEY §7
+step 5).
+
+Two fused strategies, chosen at plan time:
+
+  * DENSE (pack_dense_keys + dense_partial_agg): every grouping key is an
+    integer column whose global [min, max] bounds are known — from parquet
+    row-group statistics or an in-memory table scan.  Group ids are pure
+    arithmetic; the loop body is a handful of scatter-reduces.  Zero host
+    syncs until the final table decode.
+  * SORTED (partial_agg_table): fixed-width keys without usable bounds.
+    A fixed-capacity sorted table carries across batches; one scalar
+    overflow check per batch.  On overflow the stage degrades to
+    pass-through partials (the AGG_TRIGGER_PARTIAL_SKIPPING analog,
+    ref agg_table.rs:108-122) — correct for PARTIAL mode because the
+    final-agg stage downstream re-merges.
+
+Anything else (string keys, host aggs, avg/collect, merge modes) stays on
+the eager path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu import config
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import BoundReference, PhysicalExpr
+from blaze_tpu.ops.agg.exec import AggExec, AggMode
+from blaze_tpu.ops.agg.functions import CountAgg, MinMaxAgg, SumAgg
+from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
+from blaze_tpu.ops.basic import (DebugExec, FilterExec, FilterProjectExec,
+                                 ProjectExec)
+from blaze_tpu.ops.scan import MemoryScanExec, ParquetScanExec
+from blaze_tpu.parallel.stage import (dense_partial_agg, pack_dense_keys,
+                                      partial_agg_table, unpack_dense_keys)
+from blaze_tpu.schema import Field, Schema
+
+
+def fuse_plan(plan: ExecutionPlan) -> ExecutionPlan:
+    """Rewrite eligible AggExec nodes into FusedPartialAggExec, in place
+    for inner nodes (children lists are mutable; schemas are identical by
+    construction)."""
+    if not config.FUSED_STAGE_ENABLE.get():
+        return plan
+    replaced = _try_fuse_agg(plan)
+    if replaced is not None:
+        plan = replaced
+    for i, child in enumerate(plan.children):
+        plan.children[i] = fuse_plan(child)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# eligibility + bounds discovery
+# ---------------------------------------------------------------------------
+
+_FUSABLE_CHAIN = (FilterExec, ProjectExec, FilterProjectExec, DebugExec)
+
+
+def _try_fuse_agg(node: ExecutionPlan) -> Optional["FusedPartialAggExec"]:
+    if not isinstance(node, AggExec) or isinstance(node,
+                                                   FusedPartialAggExec):
+        return None
+    groups = node._group_exprs
+    aggs = node._aggs
+    if not groups or not aggs:
+        return None
+    child = node.children[0]
+    in_schema = child.schema
+
+    modes = {m for _, m, _ in aggs}
+    if modes == {AggMode.PARTIAL}:
+        complete = False
+    elif modes == {AggMode.COMPLETE}:
+        complete = True
+    else:
+        return None
+
+    specs: List[Tuple[str, Optional[PhysicalExpr]]] = []
+    for fn, _m, _name in aggs:
+        if isinstance(fn, SumAgg):
+            kind = "sum"
+        elif isinstance(fn, CountAgg):
+            kind = "count"
+        elif isinstance(fn, MinMaxAgg):
+            kind = fn.name  # "min" | "max"
+        else:
+            return None
+        arg = fn.children[0] if fn.children else None
+        if arg is not None and not arg.data_type(in_schema).is_fixed_width:
+            return None
+        if kind in ("sum", "min", "max"):
+            if arg is None or not (arg.data_type(in_schema).is_integer or
+                                   arg.data_type(in_schema).is_floating):
+                return None
+        specs.append((kind, arg))
+
+    key_types = [e.data_type(in_schema) for e, _ in groups]
+    if not all(t.is_fixed_width for t in key_types):
+        return None
+
+    # dense needs integer keys with discoverable bounds
+    ranges = None
+    if all(t.is_integer for t in key_types):
+        ranges = _discover_ranges(child, groups)
+        if ranges is not None:
+            total = 1
+            for lo, hi in ranges:
+                total *= (hi - lo + 2)
+            if total > config.FUSED_STAGE_CAPACITY.get():
+                ranges = None
+    if ranges is None and complete:
+        return None  # sorted path may overflow into pass-through partials
+    return FusedPartialAggExec(child, groups, aggs, specs, ranges, complete)
+
+
+def _discover_ranges(child: ExecutionPlan,
+                     groups) -> Optional[List[Tuple[int, int]]]:
+    ranges = []
+    for e, _name in groups:
+        b = _column_bounds(child, e)
+        if b is None:
+            return None
+        ranges.append(b)
+    return ranges
+
+
+def _column_bounds(node: ExecutionPlan, expr: PhysicalExpr
+                   ) -> Optional[Tuple[int, int]]:
+    """Trace a grouping expression down a schema-transparent chain to its
+    source scan column and read global [min, max] from parquet row-group
+    statistics (the stats the scan's own pruning uses) or an in-memory
+    table pass."""
+    while True:
+        if not isinstance(expr, BoundReference):
+            return None
+        if isinstance(node, (FilterExec, DebugExec)):
+            node = node.children[0]
+            continue
+        if isinstance(node, (ProjectExec, FilterProjectExec)):
+            exprs = node._exprs
+            if expr.index >= len(exprs):
+                return None
+            expr = exprs[expr.index]
+            node = node.children[0]
+            continue
+        break
+    if isinstance(node, ParquetScanExec):
+        return _parquet_bounds(node, expr.index)
+    if isinstance(node, MemoryScanExec):
+        return _memory_bounds(node, expr.index)
+    return None
+
+
+def _parquet_bounds(scan: ParquetScanExec, col_index: int
+                    ) -> Optional[Tuple[int, int]]:
+    import pyarrow.parquet as pq
+    name = scan.schema[col_index].name
+    lo = hi = None
+    for group in scan._file_groups:
+        for path in group:
+            try:
+                md = pq.ParquetFile(path).metadata
+            except Exception:
+                return None
+            fidx = md.schema.names.index(name) \
+                if name in md.schema.names else -1
+            if fidx < 0:
+                return None
+            for rg in range(md.num_row_groups):
+                st = md.row_group(rg).column(fidx).statistics
+                if st is None or not st.has_min_max:
+                    return None
+                mn, mx = st.min, st.max
+                if not isinstance(mn, (int, np.integer)):
+                    return None
+                lo = mn if lo is None else min(lo, mn)
+                hi = mx if hi is None else max(hi, mx)
+    if lo is None:
+        return None
+    return int(lo), int(hi)
+
+
+def _memory_bounds(scan: MemoryScanExec, col_index: int
+                   ) -> Optional[Tuple[int, int]]:
+    lo = hi = None
+    for part in scan._partitions:
+        for cb in part:
+            col = cb.columns[col_index]
+            data = np.asarray(col.data)[:cb.num_rows]
+            valid = np.asarray(col.validity)[:cb.num_rows]
+            if cb.selection is not None:
+                valid = valid & np.asarray(cb.selection)[:cb.num_rows]
+            if not valid.any():
+                continue
+            mn, mx = int(data[valid].min()), int(data[valid].max())
+            lo = mn if lo is None else min(lo, mn)
+            hi = mx if hi is None else max(hi, mx)
+    if lo is None:
+        return None
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# the fused operator
+# ---------------------------------------------------------------------------
+
+class FusedPartialAggExec(ExecutionPlan):
+    """Drop-in replacement for a partial/complete AggExec over fixed-width
+    keys: same output schema, single-XLA-program loop body."""
+
+    def __init__(self, child: ExecutionPlan, group_exprs, aggs,
+                 specs: Sequence[Tuple[str, Optional[PhysicalExpr]]],
+                 ranges: Optional[List[Tuple[int, int]]],
+                 complete: bool):
+        super().__init__([child])
+        self._group_exprs = list(group_exprs)
+        self._aggs = list(aggs)
+        self._specs = list(specs)
+        self._ranges = ranges
+        self._complete = complete
+        self._in_schema = child.schema
+        self._out_schema = self._build_schema()
+
+    def _build_schema(self) -> Schema:
+        fields: List[Field] = []
+        for e, name in self._group_exprs:
+            fields.append(Field(name, e.data_type(self._in_schema)))
+        for fn, mode, name in self._aggs:
+            if mode in (AggMode.FINAL, AggMode.COMPLETE):
+                fields.append(Field(name, fn.output_type(self._in_schema)))
+            else:
+                for f in fn.acc_fields(self._in_schema):
+                    fields.append(Field(f"{name}.{f.name}", f.data_type,
+                                        f.nullable))
+        return Schema(fields)
+
+    @property
+    def schema(self) -> Schema:
+        return self._out_schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions
+
+    @property
+    def fused_mode(self) -> str:
+        return "dense" if self._ranges is not None else "sorted"
+
+    def execute(self, partition: int) -> BatchIterator:
+        if self._ranges is not None:
+            yield from self._execute_dense(partition)
+        else:
+            yield from self._execute_sorted(partition)
+
+    # -- dense: no host syncs in the loop ----------------------------------
+    def _execute_dense(self, partition: int) -> BatchIterator:
+        num_slots = 1
+        for lo, hi in self._ranges:
+            num_slots *= (hi - lo + 2)
+        kinds = [k for k, _ in self._specs]
+        carry = None
+        n_batches = 0
+        for batch in self.children[0].execute(partition):
+            kd, kv, ad, av, mask = self._device_inputs(batch)
+            step = self._dense_step(batch.capacity, num_slots, tuple(kinds))
+            if carry is None:
+                carry = _init_carry(kinds, ad, num_slots)
+            carry = step(carry, kd, kv, ad, av, mask)
+            n_batches += 1
+        self.metrics.add("fused_batches", n_batches)
+        if carry is None:
+            return
+        yield from self._emit_dense(carry, num_slots)
+
+    def _dense_step(self, capacity: int, num_slots: int, kinds):
+        # the factory is memoized at module level so every task/plan
+        # instance with the same (ranges, kinds, slots) shares one jit
+        # cache — a fresh runtime per task must NOT recompile
+        return _dense_step_factory(tuple(self._ranges), kinds, num_slots)
+
+    def _emit_dense(self, carry, num_slots: int) -> BatchIterator:
+        accs, avalid, occupied = carry
+        # Compact ON DEVICE before reading back: the table has num_slots
+        # entries (possibly millions) but only `count` occupied.  Ship the
+        # occupied prefix, padded to a power-of-two bucket so XLA sees a
+        # handful of shapes instead of one per distinct count.
+        count = int(jnp.sum(occupied))
+        if count == 0:
+            return
+        padded = _bucket(count, num_slots)
+        slots_dev = jnp.argsort(~occupied, stable=True)[:padded]
+        fetch = ([jnp.take(a, slots_dev) for a in accs],
+                 [jnp.take(v, slots_dev) for v in avalid],
+                 slots_dev)
+        host_accs, host_avalid, slots = jax.device_get(fetch)
+        slots = slots[:count]
+        # slot -> key decode host-side (shared stride logic, no round trip)
+        host_keys = unpack_dense_keys(slots, self._ranges, xp=np)
+        yield from self._emit_rows(
+            host_keys, [a[:count] for a in host_accs],
+            [v[:count] for v in host_avalid])
+
+    # -- sorted: carry table + per-batch overflow check --------------------
+    def _execute_sorted(self, partition: int) -> BatchIterator:
+        carry_slots = config.ON_DEVICE_AGG_CAPACITY.get()
+        kinds = [k for k, _ in self._specs]
+        merge_kinds = ["sum" if k == "count" else k for k in kinds]
+        carry = None
+        skipping = False
+        for batch in self.children[0].execute(partition):
+            kd, kv, ad, av, mask = self._device_inputs(batch)
+            # a batch cannot hold more groups than rows, so capacity slots
+            # make the per-batch table lossless
+            table = partial_agg_table(
+                list(zip(kd, kv)),
+                [(k, d, v) for k, d, v in zip(kinds, ad, av)],
+                mask, batch.capacity)
+            if skipping:
+                yield from self._emit_table(table)
+                continue
+            if carry is None:
+                merged = _resize_table(table, merge_kinds, carry_slots)
+            else:
+                merged = _merge_tables(carry, table, merge_kinds,
+                                       carry_slots)
+            # num_groups counts ALL boundaries even past the slot cap, and
+            # merged >= per-batch count, so this ONE scalar sync per batch
+            # covers both the batch table and the merge
+            if int(merged.num_groups) > carry_slots:
+                # degrade to pass-through partials
+                # (ref AGG_TRIGGER_PARTIAL_SKIPPING, agg_table.rs:108-122)
+                skipping = True
+                self.metrics.add("partial_skipped", 1)
+                if carry is not None:
+                    yield from self._emit_table(carry)
+                    carry = None
+                yield from self._emit_table(table)
+                continue
+            carry = merged
+        if carry is not None:
+            yield from self._emit_table(carry)
+
+    def _emit_table(self, table) -> BatchIterator:
+        # groups sit packed at the front of the table (gids are a cumsum),
+        # so only the valid prefix crosses the tunnel
+        count = int(jnp.minimum(table.num_groups, table.slot_valid.shape[0]))
+        if count == 0:
+            return
+        padded = _bucket(count, table.slot_valid.shape[0])
+        keys_h, kvalid_h, accs_h, avalid_h = jax.device_get(
+            ([k[:padded] for k in table.keys],
+             [v[:padded] for v in table.key_valid],
+             [a[:padded] for a in table.accs],
+             [v[:padded] for v in table.acc_valid]))
+        keys = [(kd[:count], kv[:count])
+                for kd, kv in zip(keys_h, kvalid_h)]
+        accs = [a[:count] for a in accs_h]
+        avalid = [v[:count] for v in avalid_h]
+        yield from self._emit_rows(keys, accs, avalid)
+
+    # -- shared emission ----------------------------------------------------
+    def _device_inputs(self, batch: ColumnBatch):
+        cap = batch.capacity
+        kd, kv = [], []
+        for e, _name in self._group_exprs:
+            dv = e.evaluate(batch).to_device(cap)
+            kd.append(dv.data)
+            kv.append(dv.validity)
+        ad, av = [], []
+        for kind, arg in self._specs:
+            if arg is None:
+                ad.append(None)
+                av.append(None)
+            else:
+                dv = arg.evaluate(batch).to_device(cap)
+                ad.append(dv.data)
+                av.append(dv.validity)
+        return tuple(kd), tuple(kv), tuple(ad), tuple(av), batch.row_mask()
+
+    def _emit_rows(self, keys, accs, avalid) -> BatchIterator:
+        n = len(accs[0]) if accs else len(keys[0][0])
+        arrays: List[pa.Array] = []
+        out_arrow = self._out_schema.to_arrow()
+        i = 0
+        for (kd, kv), f in zip(keys, out_arrow):
+            arrays.append(_to_arrow(kd, kv, f.type))
+            i += 1
+        for (kind, _arg), a, v in zip(self._specs, accs, avalid):
+            f = out_arrow.field(i)
+            if kind == "count":
+                arrays.append(_to_arrow(a, np.ones(n, dtype=bool), f.type))
+            else:
+                arrays.append(_to_arrow(a, v, f.type))
+            i += 1
+        rb = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
+        bs = config.BATCH_SIZE.get()
+        for off in range(0, rb.num_rows, bs):
+            chunk = rb.slice(off, min(bs, rb.num_rows - off))
+            self.metrics.add("output_rows", chunk.num_rows)
+            yield ColumnBatch.from_arrow(chunk)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=128)
+def _dense_step_factory(ranges, kinds, num_slots: int):
+    ranges = list(ranges)
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(carry, key_data, key_valid, agg_data, agg_valid, mask):
+        accs, avalid, occupied = carry
+        gid, _total = pack_dense_keys(list(zip(key_data, key_valid)),
+                                      ranges)
+        batch_specs = [(kind, vd, vv)
+                       for kind, vd, vv in zip(kinds, agg_data, agg_valid)]
+        a2, v2, occ2 = dense_partial_agg(gid, num_slots, batch_specs, mask)
+        new_a, new_v = [], []
+        for kind, a, av, b, bv in zip(kinds, accs, avalid, a2, v2):
+            if kind in ("sum", "count"):
+                new_a.append(a + b)
+                new_v.append(av | bv)
+            elif kind == "min":
+                both = av & bv
+                new_a.append(jnp.where(both, jnp.minimum(a, b),
+                                       jnp.where(bv, b, a)))
+                new_v.append(av | bv)
+            else:  # max
+                both = av & bv
+                new_a.append(jnp.where(both, jnp.maximum(a, b),
+                                       jnp.where(bv, b, a)))
+                new_v.append(av | bv)
+        return (tuple(new_a), tuple(new_v), occupied | occ2)
+
+    return step
+
+
+def _init_carry(kinds, agg_data, num_slots: int):
+    accs, avalid = [], []
+    for kind, vd in zip(kinds, agg_data):
+        if kind == "count":
+            accs.append(jnp.zeros(num_slots, dtype=jnp.int64))
+            avalid.append(jnp.ones(num_slots, dtype=bool))
+            continue
+        if kind == "sum":
+            dt = (jnp.float64 if jnp.issubdtype(vd.dtype, jnp.floating)
+                  else jnp.int64)
+        else:
+            dt = vd.dtype
+        accs.append(jnp.zeros(num_slots, dtype=dt))
+        avalid.append(jnp.zeros(num_slots, dtype=bool))
+    occupied = jnp.zeros(num_slots, dtype=bool)
+    return (tuple(accs), tuple(avalid), occupied)
+
+
+def _bucket(count: int, cap: int) -> int:
+    """Next power of two >= count (min 1024), clamped to cap — keeps the
+    device slice shapes to a handful of variants."""
+    b = 1024
+    while b < count:
+        b <<= 1
+    return min(b, cap)
+
+
+def _resize_table(t, merge_kinds, num_slots: int):
+    """Re-aggregate a lossless table into the carry capacity (caller has
+    checked num_groups fits)."""
+    keys = list(zip(t.keys, t.key_valid))
+    specs = [(kind, acc, av) for kind, acc, av in
+             zip(merge_kinds, t.accs, t.acc_valid)]
+    return partial_agg_table(keys, specs, t.slot_valid, num_slots)
+
+
+def _merge_tables(a, b, merge_kinds, num_slots: int):
+    keys = [(jnp.concatenate([ka, kb]), jnp.concatenate([va, vb]))
+            for (ka, kb), (va, vb) in
+            zip(zip(a.keys, b.keys), zip(a.key_valid, b.key_valid))]
+    specs = []
+    for kind, aa, ab, va, vb in zip(merge_kinds, a.accs, b.accs,
+                                    a.acc_valid, b.acc_valid):
+        specs.append((kind, jnp.concatenate([aa, ab]),
+                      jnp.concatenate([va, vb])))
+    mask = jnp.concatenate([a.slot_valid, b.slot_valid])
+    return partial_agg_table(keys, specs, mask, num_slots)
+
+
+def _to_arrow(data: np.ndarray, valid: np.ndarray,
+              t: pa.DataType) -> pa.Array:
+    arr = pa.array(data, mask=~np.asarray(valid, dtype=bool))
+    if not arr.type.equals(t):
+        arr = arr.cast(t, safe=False)
+    return arr

@@ -160,6 +160,13 @@ def create_plan(d: Dict[str, Any]) -> ExecutionPlan:
                   null_aware_anti=d.get("null_aware_anti", False))
         if k == "broadcast_join" and d.get("broadcast_id"):
             kw["broadcast_id"] = d["broadcast_id"]
+            # a build-map stage on the broadcast side shares its map with
+            # this join through the cache id (ref cached_build_hash_map_id,
+            # broadcast_join_build_hash_map_exec.rs)
+            from blaze_tpu.ops.joins.exec import BuildHashMapExec
+            build = right if d.get("build_side", "right") == "right" else left
+            if isinstance(build, BuildHashMapExec):
+                build.cache_id = d["broadcast_id"]
         return cls(left, right, lkeys, rkeys, jt, **kw)
 
     if k == "window":

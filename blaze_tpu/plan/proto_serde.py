@@ -162,7 +162,7 @@ _BINOP_DECODE = {
     "And": "and", "Or": "or", "Eq": "==", "NotEq": "!=", "LtEq": "<=",
     "Lt": "<", "Gt": ">", "GtEq": ">=", "Plus": "+", "Minus": "-",
     "Multiply": "*", "Divide": "/", "Modulo": "%",
-    "IsNotDistinctFrom": "<=>", "StringConcat": "+",
+    "IsNotDistinctFrom": "<=>",
 }
 _BINOP_ENCODE = {
     "and": "And", "or": "Or", "==": "Eq", "!=": "NotEq", "<=": "LtEq",
@@ -231,14 +231,21 @@ def expr_from_proto(e: pb.PhysicalExprNode) -> Dict[str, Any]:
         val, t = scalar_from_proto(e.literal)
         return {"kind": "literal", "value": val, "type": t}
     if kind == "binary_expr":
-        op = _BINOP_DECODE.get(e.binary_expr.op)
+        wire_op = e.binary_expr.op
+        if wire_op in ("RegexMatch", "RegexIMatch"):
+            pat, _ = scalar_from_proto(e.binary_expr.r.literal)
+            return {"kind": "rlike",
+                    "child": expr_from_proto(e.binary_expr.l),
+                    "pattern": pat,
+                    "case_insensitive": wire_op == "RegexIMatch"}
+        if wire_op == "StringConcat":
+            # the engine's binary "+" rejects strings; concat is a fn
+            return {"kind": "scalar_function", "name": "concat",
+                    "args": [expr_from_proto(e.binary_expr.l),
+                             expr_from_proto(e.binary_expr.r)]}
+        op = _BINOP_DECODE.get(wire_op)
         if op is None:
-            if e.binary_expr.op in ("RegexMatch", "RegexIMatch"):
-                pat, _ = scalar_from_proto(e.binary_expr.r.literal)
-                return {"kind": "rlike",
-                        "child": expr_from_proto(e.binary_expr.l),
-                        "pattern": pat}
-            raise ValueError(f"unsupported binary op {e.binary_expr.op!r}")
+            raise ValueError(f"unsupported binary op {wire_op!r}")
         return {"kind": "binary", "op": op,
                 "l": expr_from_proto(e.binary_expr.l),
                 "r": expr_from_proto(e.binary_expr.r)}
@@ -975,8 +982,13 @@ def plan_to_proto(d: Dict[str, Any]) -> pb.PhysicalPlanNode:
         node = n.parquet_scan if k == "parquet_scan" else n.orc_scan
         conf = node.base_conf
         groups = d["file_groups"]
+        non_empty = [i for i, g in enumerate(groups) if g]
+        if len(non_empty) > 1:
+            raise ValueError(
+                "the wire carries ONE file group per task "
+                "(FileScanExecConf); emit one TaskDefinition per partition")
         conf.num_partitions = len(groups)
-        idx = next((i for i, g in enumerate(groups) if g), 0)
+        idx = non_empty[0] if non_empty else 0
         conf.partition_index = idx
         for path in groups[idx]:
             conf.file_group.files.add(path=path)

@@ -128,7 +128,7 @@ class TestExprs:
         {"kind": "get_map_value",
          "child": {"kind": "column", "index": 0}, "key": "k1"},
         {"kind": "rlike", "child": {"kind": "column", "index": 2},
-         "pattern": "^a.*"},
+         "pattern": "^a.*", "case_insensitive": False},
     ])
     def test_expr_roundtrip(self, d):
         assert _roundtrip_expr(d) == d
@@ -484,3 +484,53 @@ class TestNthValueIgnoreNulls:
             [b.compact().to_arrow() for b in w.execute(0)])
         # partition 1: 2nd non-null = 20; partition 2: only one non-null
         assert out["nv"].to_pylist() == [20, 20, 20, None, None]
+
+
+class TestReviewRegressions2:
+    def test_regex_imatch_decodes_case_insensitive(self):
+        e = pb.PhysicalExprNode()
+        e.binary_expr.op = "RegexIMatch"
+        e.binary_expr.l.CopyFrom(expr_to_proto({"kind": "column",
+                                                "index": 0}))
+        e.binary_expr.r.literal.CopyFrom(
+            scalar_to_proto("^ab", {"id": "utf8"}))
+        d = expr_from_proto(e)
+        assert d["case_insensitive"] is True
+        from blaze_tpu.plan.exprs import expr_from_dict
+        rl = expr_from_dict(d)
+        assert rl.case_insensitive is True
+
+    def test_string_concat_decodes_to_concat_fn(self):
+        e = pb.PhysicalExprNode()
+        e.binary_expr.op = "StringConcat"
+        e.binary_expr.l.CopyFrom(expr_to_proto({"kind": "column",
+                                                "index": 0}))
+        e.binary_expr.r.CopyFrom(expr_to_proto({"kind": "column",
+                                                "index": 1}))
+        d = expr_from_proto(e)
+        assert d == {"kind": "scalar_function", "name": "concat",
+                     "args": [{"kind": "column", "index": 0},
+                              {"kind": "column", "index": 1}]}
+
+    def test_multi_group_scan_refuses_to_encode(self):
+        d = {"kind": "parquet_scan", "schema": SCHEMA_D,
+             "file_groups": [["a.parquet"], ["b.parquet"]]}
+        with pytest.raises(ValueError, match="ONE file group"):
+            plan_to_proto(d)
+
+    def test_broadcast_build_map_gets_cache_id(self):
+        from blaze_tpu.ops.joins.exec import BuildHashMapExec
+        reader = {"kind": "ipc_reader", "resource_id": "r",
+                  "schema": SCHEMA_D, "num_partitions": 1}
+        d = {"kind": "broadcast_join", "left": reader,
+             "right": {"kind": "broadcast_join_build_hash_map",
+                       "input": reader,
+                       "keys": [{"kind": "column", "index": 0}]},
+             "left_keys": [{"kind": "column", "index": 0}],
+             "right_keys": [{"kind": "column", "index": 0}],
+             "join_type": "inner", "build_side": "right",
+             "broadcast_id": "bc-7"}
+        plan = create_plan(d)
+        build = plan.children[1]
+        assert isinstance(build, BuildHashMapExec)
+        assert build.cache_id == "bc-7"
