@@ -148,7 +148,16 @@ MEMORY_FRACTION = float_conf(
     "auron.memory.fraction", 0.6,
     "Fraction of the device HBM budget granted to the memory manager.")
 SMJ_FALLBACK_ENABLE = bool_conf(
-    "auron.smjfallback.enable", False, "Allow SMJ fallback for oversized hash joins.")
+    "auron.smjfallback.enable", False,
+    "Fall back from hash join to sort-merge join when the build side "
+    "exceeds the rows/mem thresholds "
+    "(ref SparkAuronConfiguration.java:231).")
+SMJ_FALLBACK_ROWS_THRESHOLD = int_conf(
+    "auron.smjfallback.rows.threshold", 10_000_000,
+    "Build-side row count that triggers hash->SMJ fallback.")
+SMJ_FALLBACK_MEM_THRESHOLD = int_conf(
+    "auron.smjfallback.mem.threshold", 134217728,
+    "Build-side bytes that trigger hash->SMJ fallback (128MB default).")
 PARTIAL_AGG_SKIPPING_ENABLE = bool_conf(
     "auron.partialAggSkipping.enable", True,
     "Pass rows through un-aggregated when partial-agg cardinality is too high "

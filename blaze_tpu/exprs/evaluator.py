@@ -64,7 +64,12 @@ class CachedExprsEvaluator:
             out = out.with_selection(mask)
         return out
 
-    def project(self, batch: ColumnBatch, out_schema) -> ColumnBatch:
+    def project(self, batch: ColumnBatch, out_schema,
+                reuse_cache: bool = False) -> ColumnBatch:
+        # the cache is per-BATCH: cache keys are batch-independent, so a
+        # stale entry would silently replay a previous batch's columns
+        if not reuse_cache:
+            self._cache.clear()
         cols = []
         for expr, field in zip(self.projections, out_schema):
             v = self._eval(expr, batch)
@@ -72,7 +77,7 @@ class CachedExprsEvaluator:
         return ColumnBatch(out_schema, cols, batch.num_rows, batch.selection)
 
     def filter_project(self, batch: ColumnBatch, out_schema) -> ColumnBatch:
-        filtered = self.filter(batch)
-        out = self.project(filtered, out_schema)
+        filtered = self.filter(batch)  # clears + seeds the shared cache
+        out = self.project(filtered, out_schema, reuse_cache=True)
         self._cache.clear()
         return out

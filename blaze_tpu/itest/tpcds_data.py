@@ -17,18 +17,24 @@ SF1_ROWS = {
     "store_sales": 2_880_404,
     "catalog_sales": 1_441_548,
     "web_sales": 719_384,
+    "web_returns": 71_763,
     "store": 12,
     "customer": 100_000,
     "customer_address": 50_000,
+    "customer_demographics": 1_920_800,
     "date_dim": 73_049,
     "item": 18_000,
+    "warehouse": 5,
 }
 
 
 def _rows(name: str, scale: float) -> int:
     base = SF1_ROWS[name]
-    if name in ("store", "date_dim"):
+    if name in ("store", "date_dim", "warehouse"):
         return base  # dimension tables do not scale
+    if name == "customer_demographics":
+        # fixed-size cross-product dimension in TPC-DS
+        return min(base, max(1, int(base * max(scale, 0.01))))
     return max(1, int(base * scale))
 
 
@@ -64,6 +70,10 @@ def gen_customer(scale: float, seed: int = 13) -> pa.Table:
         "c_customer_id": pa.array([f"C{i:011d}" for i in range(1, n + 1)]),
         "c_current_addr_sk": pa.array(
             rng.integers(1, _rows("customer_address", scale) + 1, n)),
+        "c_current_cdemo_sk": pa.array(
+            rng.integers(1, _rows("customer_demographics", scale) + 1, n)),
+        "c_birth_year": pa.array(
+            rng.integers(1924, 1993, n).astype(np.int32)),
     })
 
 
@@ -86,6 +96,9 @@ def gen_store_returns(scale: float, seed: int = 14) -> pa.Table:
         "sr_store_sk": pa.array(rng.integers(1, _rows("store", scale) + 1, n)),
         "sr_return_amt": pa.array(np.round(rng.random(n) * 500, 2)),
         "sr_ticket_number": pa.array(np.arange(1, n + 1)),
+        "sr_item_sk": pa.array(rng.integers(1, _rows("item", scale) + 1, n)),
+        "sr_return_quantity": pa.array(
+            rng.integers(1, 50, n).astype(np.int32)),
     })
 
 
@@ -102,6 +115,84 @@ def gen_store_sales(scale: float, seed: int = 15) -> pa.Table:
         "ss_item_sk": pa.array(rng.integers(1, _rows("item", scale) + 1, n)),
         "ss_ext_sales_price": pa.array(np.round(rng.random(n) * 300, 2)),
         "ss_quantity": pa.array(rng.integers(1, 100, n).astype(np.int32)),
+        "ss_ticket_number": pa.array(np.arange(1, n + 1)),
+    })
+
+
+def gen_catalog_sales(scale: float, seed: int = 17) -> pa.Table:
+    n = _rows("catalog_sales", scale)
+    rng = np.random.default_rng(seed)
+    date_n = min(_rows("date_dim", scale), SALES_DATE_DAYS)
+    return pa.table({
+        "cs_sold_date_sk": pa.array(
+            rng.integers(2450815, 2450815 + date_n, n)),
+        "cs_bill_customer_sk": pa.array(
+            rng.integers(1, _rows("customer", scale) + 1, n)),
+        "cs_bill_cdemo_sk": pa.array(
+            rng.integers(1, _rows("customer_demographics", scale) + 1, n)),
+        "cs_item_sk": pa.array(rng.integers(1, _rows("item", scale) + 1, n)),
+        "cs_quantity": pa.array(rng.integers(1, 100, n).astype(np.int32)),
+        "cs_list_price": pa.array(np.round(rng.random(n) * 300, 2)),
+        "cs_coupon_amt": pa.array(np.round(rng.random(n) * 50, 2)),
+        "cs_sales_price": pa.array(np.round(rng.random(n) * 250, 2)),
+        "cs_net_profit": pa.array(np.round(rng.random(n) * 100 - 20, 2)),
+    })
+
+
+def gen_web_sales(scale: float, seed: int = 18) -> pa.Table:
+    n = _rows("web_sales", scale)
+    rng = np.random.default_rng(seed)
+    date_n = min(_rows("date_dim", scale), SALES_DATE_DAYS)
+    n_orders = max(1, n // 3)  # ~3 line items per order
+    return pa.table({
+        "ws_ship_date_sk": pa.array(
+            rng.integers(2450815, 2450815 + date_n, n)),
+        "ws_ship_addr_sk": pa.array(
+            rng.integers(1, _rows("customer_address", scale) + 1, n)),
+        "ws_web_site_sk": pa.array(rng.integers(1, 31, n)),
+        "ws_order_number": pa.array(rng.integers(1, n_orders + 1, n)),
+        "ws_warehouse_sk": pa.array(
+            rng.integers(1, _rows("warehouse", scale) + 1, n)),
+        "ws_ext_ship_cost": pa.array(np.round(rng.random(n) * 100, 2)),
+        "ws_net_profit": pa.array(np.round(rng.random(n) * 200 - 40, 2)),
+    })
+
+
+def gen_web_returns(scale: float, seed: int = 19) -> pa.Table:
+    n = _rows("web_returns", scale)
+    rng = np.random.default_rng(seed)
+    n_orders = max(1, _rows("web_sales", scale) // 3)
+    return pa.table({
+        "wr_order_number": pa.array(rng.integers(1, n_orders + 1, n)),
+        "wr_return_amt": pa.array(np.round(rng.random(n) * 80, 2)),
+    })
+
+
+def gen_customer_demographics(scale: float, seed: int = 20) -> pa.Table:
+    n = _rows("customer_demographics", scale)
+    rng = np.random.default_rng(seed)
+    genders = np.array(["M", "F"])
+    edu = np.array(["Primary", "Secondary", "College", "2 yr Degree",
+                    "4 yr Degree", "Advanced Degree", "Unknown"])
+    return pa.table({
+        "cd_demo_sk": pa.array(np.arange(1, n + 1)),
+        "cd_gender": pa.array(genders[rng.integers(0, 2, n)]),
+        "cd_education_status": pa.array(edu[rng.integers(0, len(edu), n)]),
+        "cd_dep_count": pa.array(rng.integers(0, 7, n).astype(np.int32)),
+    })
+
+
+def gen_customer_address(scale: float, seed: int = 21) -> pa.Table:
+    n = _rows("customer_address", scale)
+    rng = np.random.default_rng(seed)
+    states = np.array(["TN", "CA", "NY", "TX", "WA", "GA", "IL", "IN",
+                       "OH", "NE"])
+    counties = np.array([f"county_{i}" for i in range(40)])
+    return pa.table({
+        "ca_address_sk": pa.array(np.arange(1, n + 1)),
+        "ca_state": pa.array(states[rng.integers(0, len(states), n)]),
+        "ca_county": pa.array(counties[rng.integers(0, len(counties), n)]),
+        "ca_country": pa.array(np.array(["United States"]).repeat(n)),
     })
 
 
@@ -111,6 +202,7 @@ def gen_item(scale: float, seed: int = 16) -> pa.Table:
     cats = np.array(["Books", "Home", "Sports", "Music", "Electronics"])
     return pa.table({
         "i_item_sk": pa.array(np.arange(1, n + 1)),
+        "i_item_id": pa.array([f"I{i:09d}" for i in range(1, n + 1)]),
         "i_category": pa.array(cats[rng.integers(0, len(cats), n)]),
         "i_current_price": pa.array(np.round(rng.random(n) * 100, 2)),
     })
@@ -122,6 +214,11 @@ GENERATORS = {
     "customer": gen_customer,
     "store_returns": gen_store_returns,
     "store_sales": gen_store_sales,
+    "catalog_sales": gen_catalog_sales,
+    "web_sales": gen_web_sales,
+    "web_returns": gen_web_returns,
+    "customer_demographics": gen_customer_demographics,
+    "customer_address": gen_customer_address,
     "item": gen_item,
 }
 
@@ -140,4 +237,27 @@ def write_parquet_dataset(tables, out_dir: str, row_group_size: int = 1 << 17):
         p = os.path.join(d, "part-00000.parquet")
         pq.write_table(t, p, row_group_size=row_group_size)
         paths[name] = p
+    return paths
+
+
+def write_parquet_splits(tables, out_dir: str, partitions: int,
+                         row_group_size: int = 1 << 16):
+    """Fact tables split into `partitions` files, one scan file-group per
+    partition; dimension tables stay single-file.  Returns
+    {name: [[file], [file], ...]} in the parquet_scan IR shape."""
+    import os
+    import pyarrow.parquet as pq
+    paths = {}
+    for name, t in tables.items():
+        d = os.path.join(out_dir, name)
+        os.makedirs(d, exist_ok=True)
+        nparts = partitions if t.num_rows > 10_000 else 1
+        per = -(-t.num_rows // nparts)
+        groups = []
+        for i in range(nparts):
+            p = os.path.join(d, f"part-{i:05d}.parquet")
+            pq.write_table(t.slice(i * per, per), p,
+                           row_group_size=row_group_size)
+            groups.append([p])
+        paths[name] = groups
     return paths

@@ -214,7 +214,11 @@ class BaseJoinExec(ExecutionPlan):
 
     # -- execution ----------------------------------------------------------
     def execute(self, partition: int) -> BatchIterator:
-        jmap = self._get_join_map(partition)
+        return self._probe_with_map(self._get_join_map(partition),
+                                    partition)
+
+    def _probe_with_map(self, jmap: "JoinMap", partition: int
+                        ) -> BatchIterator:
         probe_is_left = self.build_side == "right"
         probe = self.children[0 if probe_is_left else 1]
         probe_keys = self.left_keys if probe_is_left else self.right_keys
@@ -383,13 +387,99 @@ def _null_out(col, null_mask: np.ndarray) -> pa.Array:
 
 
 class SortMergeJoinExec(BaseJoinExec):
-    """SMJ parity node (ref sort_merge_join_exec.rs:397).  Children arrive
-    key-sorted from SortExec; the probe core is order-agnostic so the sort
-    is exploited only by upstream operators, not required here."""
+    """Streaming merge join (ref sort_merge_join_exec.rs:397 +
+    joins/smj/*, joins/stream_cursor.rs).
+
+    Children are consumed key-sorted (ascending, nulls first).  A child
+    that is already a SortExec on the join keys streams straight through
+    (the converter contract — childOrderingRequiredTag — guarantees sorts
+    in translated plans); otherwise a spillable SortExec is inserted, so
+    hand-built plans stay correct and the sort inherits the external-sort
+    memory discipline."""
+
+    def _sorted_child(self, side: int) -> ExecutionPlan:
+        from blaze_tpu.ops.sort import SortExec
+        child = self.children[side]
+        keys = self.left_keys if side == 0 else self.right_keys
+        if isinstance(child, SortExec):
+            specs = child._specs
+            if len(specs) >= len(keys) and all(
+                    s[0].cache_key() == k.cache_key() and not s[1] and s[2]
+                    for s, k in zip(specs, keys)):
+                return child
+        return SortExec(child, [(k, False, True) for k in keys])
+
+    def execute(self, partition: int) -> BatchIterator:
+        from blaze_tpu.ops.joins.smj import MergeJoiner, _RunCursor
+        left = self._sorted_child(0)
+        right = self._sorted_child(1)
+
+        def arrow_stream(plan):
+            for b in plan.execute(partition):
+                rb = b.compact().to_arrow()
+                if rb.num_rows:
+                    yield rb
+
+        joiner = MergeJoiner(self.children[0].schema,
+                             self.children[1].schema, self.schema,
+                             self.join_type, self.join_filter,
+                             self._existence_col)
+        lcur = _RunCursor(arrow_stream(left), self.left_keys,
+                          self.children[0].schema)
+        rcur = _RunCursor(arrow_stream(right), self.right_keys,
+                          self.children[1].schema)
+
+        def gen():
+            for rb in joiner.join(lcur, rcur):
+                self.metrics.add("output_rows", rb.num_rows)
+                yield ColumnBatch.from_arrow(rb)
+        return iter(CoalesceStream(gen(), metrics=self.metrics))
 
 
 class ShuffledHashJoinExec(BaseJoinExec):
-    """SHJ parity node: build side = one shuffled partition."""
+    """SHJ parity node: build side = one shuffled partition.  When
+    `auron.smjfallback.enable` is set and the build side exceeds the
+    rows/bytes thresholds while materializing, the partition re-executes
+    as a streaming sort-merge join (ref smjfallback confs,
+    SparkAuronConfiguration.java:231-250)."""
+
+    def execute(self, partition: int) -> BatchIterator:
+        if not config.SMJ_FALLBACK_ENABLE.get():
+            yield from super().execute(partition)
+            return
+        build = 1 if self.build_side == "right" else 0
+        child = self.children[build]
+        row_cap = config.SMJ_FALLBACK_ROWS_THRESHOLD.get()
+        mem_cap = config.SMJ_FALLBACK_MEM_THRESHOLD.get()
+        batches: List[pa.RecordBatch] = []
+        rows = nbytes = 0
+        overflowed = False
+        for b in child.execute(partition):
+            rb = b.compact().to_arrow()
+            if rb.num_rows == 0:
+                continue
+            batches.append(rb)
+            rows += rb.num_rows
+            nbytes += rb.nbytes
+            if rows > row_cap or nbytes > mem_cap:
+                overflowed = True
+                break
+        if overflowed:
+            # abandon the hash build; re-run this partition as SMJ
+            self.metrics.add("smj_fallback", 1)
+            del batches
+            smj = SortMergeJoinExec(
+                self.children[0], self.children[1], self.left_keys,
+                self.right_keys, self.join_type,
+                build_side=self.build_side, join_filter=self.join_filter,
+                existence_col=self._existence_col,
+                null_aware_anti=self.null_aware_anti)
+            smj.metrics = self.metrics
+            yield from smj.execute(partition)
+            return
+        keys = self.right_keys if build == 1 else self.left_keys
+        jmap = build_join_map(iter(batches), child.schema, keys)
+        yield from self._probe_with_map(jmap, partition)
 
 
 class BroadcastJoinExec(BaseJoinExec):

@@ -47,19 +47,28 @@ class HashPartitioning(Partitioning):
 
     def partition_ids(self, batch: ColumnBatch) -> np.ndarray:
         n = batch.num_rows
+        cap = batch.capacity
         cols = []
         for e in self.exprs:
             v = e.evaluate(batch)
             if v.is_device:
                 cols.append((v.data, v.validity, v.dtype.id.value))
             else:
+                # host (string) columns are exact-length; pad the byte
+                # matrix to the batch capacity so mixed string+fixed key
+                # hashes line up lane-for-lane
                 arr = v.to_host(n)
                 (mat, lengths), valid = H.string_column_to_padded_bytes(arr)
-                pad_valid = np.zeros(mat.shape[0], dtype=bool)
+                full = np.zeros((cap, mat.shape[1]), dtype=mat.dtype)
+                full[:mat.shape[0]] = mat
+                full_len = np.zeros(cap, dtype=lengths.dtype)
+                full_len[:len(lengths)] = lengths
+                pad_valid = np.zeros(cap, dtype=bool)
                 pad_valid[:len(valid)] = valid
-                cols.append(((jnp.asarray(mat), jnp.asarray(lengths)),
+                cols.append(((jnp.asarray(full), jnp.asarray(full_len)),
                              jnp.asarray(pad_valid), "utf8"))
-        h = H.hash_columns(cols, seed=42, xp=jnp, algo="murmur3")
+        h = H.hash_columns(cols, seed=42, xp=jnp, algo="murmur3",
+                           num_rows=cap)
         pids = H.pmod(h, self.num_partitions, xp=jnp)
         return np.asarray(pids)[:n].astype(np.int32)
 

@@ -218,3 +218,22 @@ def test_sort_decimal_order_host_path():
     out = pa.Table.from_batches([b.to_arrow() for b in plan.execute(0)])
     got = [None if v is None else str(v) for v in out.column(0).to_pylist()]
     assert got == ["1.45", "1.23", "0.20", "-0.49", "-0.50", None]
+
+
+def test_project_multi_batch_does_not_replay_first_batch():
+    """Regression: the projection evaluator cache must reset per batch —
+    a stale entry replays batch 1's columns into every later batch."""
+    import numpy as np
+    import pyarrow as pa
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops import MemoryScanExec, ProjectExec
+    n = 10_000
+    t = pa.table({"a": pa.array(np.arange(n)),
+                  "b": pa.array(np.arange(n) * 2.0)})
+    scan = MemoryScanExec.from_arrow(t, batch_rows=1024)
+    proj = ProjectExec(scan, [col(0), col(1)], ["a", "b"])
+    out = pa.Table.from_batches(
+        [b.compact().to_arrow() for b in proj.execute(0)])
+    assert out.num_rows == n
+    assert np.array_equal(np.asarray(out["a"].combine_chunks()),
+                          np.arange(n))
