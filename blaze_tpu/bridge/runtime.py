@@ -66,12 +66,18 @@ class NativeExecutionRuntime:
         try:
             with task_scope(self.task):
                 stream = self.plan.execute(self.task.partition_id)
+                stats = config.INPUT_BATCH_STATISTICS.get()
                 for batch in stream:  # HOT LOOP (ref rt.rs:175-192)
                     if self._finalized.is_set():
                         return
                     rb = batch.compact().to_arrow()
                     if rb.num_rows == 0:
                         continue
+                    if stats:
+                        m = self.plan.metrics
+                        m.add("output_batches_total", 1)
+                        m.add("output_rows_total", rb.num_rows)
+                        m.add("output_bytes_total", rb.nbytes)
                     self._put(rb)
         except BaseException as e:  # surfaced like setError
             log.error("[stage %d partition %d] native execution failed:\n%s",

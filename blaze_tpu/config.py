@@ -43,20 +43,24 @@ def _parse_bool(s: str) -> bool:
     return s.strip().lower() in ("1", "true", "yes", "on")
 
 
-def int_conf(key: str, default: int, doc: str = "", category: str = "core") -> ConfigOption:
-    return ConfigOption(key, default, int, doc, category=category)
+def int_conf(key: str, default: int, doc: str = "", category: str = "core",
+             alt_keys: tuple = ()) -> ConfigOption:
+    return ConfigOption(key, default, int, doc, alt_keys, category)
 
 
-def float_conf(key: str, default: float, doc: str = "", category: str = "core") -> ConfigOption:
-    return ConfigOption(key, default, float, doc, category=category)
+def float_conf(key: str, default: float, doc: str = "", category: str = "core",
+               alt_keys: tuple = ()) -> ConfigOption:
+    return ConfigOption(key, default, float, doc, alt_keys, category)
 
 
-def bool_conf(key: str, default: bool, doc: str = "", category: str = "core") -> ConfigOption:
-    return ConfigOption(key, default, _parse_bool, doc, category=category)
+def bool_conf(key: str, default: bool, doc: str = "", category: str = "core",
+              alt_keys: tuple = ()) -> ConfigOption:
+    return ConfigOption(key, default, _parse_bool, doc, alt_keys, category)
 
 
-def str_conf(key: str, default: str, doc: str = "", category: str = "core") -> ConfigOption:
-    return ConfigOption(key, default, str, doc, category=category)
+def str_conf(key: str, default: str, doc: str = "", category: str = "core",
+             alt_keys: tuple = ()) -> ConfigOption:
+    return ConfigOption(key, default, str, doc, alt_keys, category)
 
 
 class ConfSession:
@@ -84,10 +88,18 @@ class ConfSession:
             for k in (opt.key, *opt.alt_keys):
                 if k in self._overrides:
                     return opt.parse(self._overrides[k])
-        env_key = "BLAZE_TPU_" + opt.key.upper().replace(".", "_")
-        if env_key in os.environ:
-            return opt.parse(os.environ[env_key])
+        for k in (opt.key, *opt.alt_keys):
+            env_key = "BLAZE_TPU_" + k.upper().replace(".", "_")
+            if env_key in os.environ:
+                return opt.parse(os.environ[env_key])
         return opt.default
+
+    def is_set(self, opt: ConfigOption) -> bool:
+        with self._lock:
+            if any(k in self._overrides for k in (opt.key, *opt.alt_keys)):
+                return True
+        return any("BLAZE_TPU_" + k.upper().replace(".", "_") in os.environ
+                   for k in (opt.key, *opt.alt_keys))
 
     def snapshot(self) -> Dict[str, str]:
         with self._lock:
@@ -134,6 +146,25 @@ def describe_all() -> List[Dict[str, Any]]:
         {"key": o.key, "default": o.default, "doc": o.doc, "category": o.category}
         for o in sorted(_REGISTRY.values(), key=lambda o: o.key)
     ]
+
+
+def generate_docs() -> str:
+    """Render the configuration reference as markdown, grouped by category
+    (the SparkAuronConfigurationDocGenerator analog)."""
+    by_cat: Dict[str, List[Dict[str, Any]]] = {}
+    for o in describe_all():
+        by_cat.setdefault(o["category"], []).append(o)
+    lines = ["# Configuration", ""]
+    for cat in sorted(by_cat):
+        lines.append(f"## {cat}")
+        lines.append("")
+        lines.append("| key | default | description |")
+        lines.append("|---|---|---|")
+        for o in by_cat[cat]:
+            lines.append(f"| `{o['key']}` | `{o['default']}` | "
+                         f"{o['doc']} |")
+        lines.append("")
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------
@@ -186,7 +217,8 @@ PARQUET_ENABLE_BLOOM_FILTER = bool_conf(
     "auron.parquet.enable.bloomFilter", False,
     "Parquet bloom-filter pruning on scan (ref conf.rs:44).")
 IGNORE_CORRUPTED_FILES = bool_conf(
-    "auron.ignore.corrupted.files", False, "Skip unreadable input files.")
+    "auron.files.ignoreCorruptFiles", False, "Skip unreadable input files.",
+    alt_keys=("auron.ignore.corrupted.files",))
 INPUT_BATCH_PREFETCH = int_conf(
     "auron.input.batch.prefetch", 2,
     "Host->device double-buffering depth (the sync_channel(1) analog, rt.rs:142).")
@@ -206,3 +238,147 @@ SORT_SPILL_BATCHES = int_conf(
     "auron.tpu.sort.inmem.batches", 64,
     "Batches buffered in device memory before external sort spills a run.")
 CASE_SENSITIVE = bool_conf("spark.sql.caseSensitive", False, "Column name matching.")
+ANSI_ENABLED = bool_conf(
+    "spark.sql.ansi.enabled", False,
+    "ANSI SQL mode: Cast raises on malformed/overflowing input instead of "
+    "producing NULL; TryCast still nulls (ref cast.rs TryCastExpr).")
+
+# ---------------------------------------------------------------------------
+# Remaining SparkAuronConfiguration families (same key names; ~70 total).
+# "convert"-category switches gate the plan-translation layer
+# (plan/convert.py); the rest are read by the named operators.
+# ---------------------------------------------------------------------------
+
+ENABLED = bool_conf(
+    "auron.enabled", True, "Master switch for native conversion.",
+    category="convert")
+UI_ENABLED = bool_conf(
+    "auron.ui.enabled", True,
+    "Expose the profiling/metrics HTTP endpoints (bridge/profiling.py).",
+    category="observability")
+PROCESS_VMRSS_MEMORY_FRACTION = float_conf(
+    "auron.process.vmrss.memoryFraction", 0.9,
+    "Process-RSS fraction usable before the memory manager refuses growth "
+    "(MemManager.init_from_conf).", category="memory")
+ON_HEAP_SPILL_MEMORY_FRACTION = float_conf(
+    "auron.onHeapSpill.memoryFraction", 0.9,
+    "Fraction of the host budget the spill tiers may pin in RAM before "
+    "moving runs to disk.", category="memory")
+ENABLE_CASECONVERT_FUNCTIONS = bool_conf(
+    "auron.enable.caseconvert.functions", False,
+    "Allow upper/lower conversion through the native path (locale-exact "
+    "parity gate).", category="convert")
+INPUT_BATCH_STATISTICS = bool_conf(
+    "auron.enableInputBatchStatistics", False,
+    "Record per-batch row/byte statistics in the runtime metric tree.",
+    category="observability")
+UDAF_FALLBACK_ENABLE = bool_conf(
+    "auron.udafFallback.enable", True,
+    "Allow typed-imperative UDAFs to run through the host round-trip "
+    "(ops/agg/functions.py HostUDAF); disabled -> plans with UDAFs are "
+    "rejected.", category="operator")
+SUGGESTED_UDAF_MEM_USED_SIZE = int_conf(
+    "auron.suggested.udaf.memUsedSize", 8192,
+    "Per-row memory estimate charged for buffered UDAF state.",
+    category="operator")
+UDAF_FALLBACK_NUM_TRIGGER_SORT_AGG = int_conf(
+    "auron.udafFallback.num.udafs.trigger.sortAgg", 1,
+    "UDAF count at which the converter emits SortAgg instead of HashAgg.",
+    category="convert")
+UDAF_FALLBACK_TYPED_IMPERATIVE_ROW_SIZE = int_conf(
+    "auron.udafFallback.typedImperativeEstimatedRowSize", 256,
+    "Estimated serialized row size for typed-imperative UDAF buffers.",
+    category="operator")
+CAST_TRIM_STRING = bool_conf(
+    "auron.cast.trimString", True,
+    "Trim whitespace before string->numeric/date casts (Spark behavior).",
+    category="operator")
+PARTIAL_AGG_SKIPPING_SKIP_SPILL = bool_conf(
+    "auron.partialAggSkipping.skipSpill", False,
+    "Under memory pressure, switch a partial agg to pass-through instead "
+    "of spilling its buffer.", category="operator")
+PARQUET_MAX_OVER_READ_SIZE = int_conf(
+    "auron.parquet.maxOverReadSize", 16384,
+    "Coalesce adjacent column-chunk reads separated by at most this many "
+    "bytes.", category="scan")
+PARQUET_METADATA_CACHE_SIZE = int_conf(
+    "auron.parquet.metadataCacheSize", 1024,
+    "Parquet footer/metadata entries cached across scans and bound "
+    "discovery (ops/scan.py parquet_metadata).", category="scan")
+IO_COMPRESSION_CODEC = str_conf(
+    "io.compression.codec", "zstd",
+    "Shuffle IPC frame codec: zstd | raw (lz4 is not in this build and "
+    "maps to raw).  Unset, auron.spill.compression.codec applies.",
+    category="shuffle")
+IO_COMPRESSION_ZSTD_LEVEL = int_conf(
+    "io.compression.zstd.level", 1,
+    "zstd level for shuffle/spill frames.", category="shuffle")
+FORCE_SHUFFLED_HASH_JOIN = bool_conf(
+    "auron.forceShuffledHashJoin", False,
+    "Convert every sort-merge join into a shuffled hash join.",
+    category="convert")
+PARSE_JSON_ERROR_FALLBACK = bool_conf(
+    "auron.parseJsonError.fallback", True,
+    "get_json_object parse failures fall back to the host engine instead "
+    "of returning null.", category="operator")
+SUGGESTED_MERGING_BATCH_MEM_SIZE = int_conf(
+    "auron.suggested.batch.memSize.multiwayMerging", 1 << 20,
+    "Target bytes per output chunk in k-way merges (ops/sort.py).",
+    category="operator")
+ORC_FORCE_POSITIONAL_EVOLUTION = bool_conf(
+    "auron.orc.force.positional.evolution", False,
+    "Match ORC columns by position instead of name.", category="scan")
+ORC_TIMESTAMP_USE_MICROSECOND = bool_conf(
+    "auron.orc.timestamp.use.microsecond", True,
+    "Read ORC timestamps at microsecond resolution (the engine-wide "
+    "timestamp unit).", category="scan")
+ORC_SCHEMA_CASE_SENSITIVE = bool_conf(
+    "auron.orc.schema.caseSensitive.enable", False,
+    "Case-sensitive ORC schema matching.", category="scan")
+FORCE_SHORT_CIRCUIT_AND_OR = bool_conf(
+    "auron.forceShortCircuitAndOr", True,
+    "Flatten AND predicate trees into sequential short-circuit conjuncts "
+    "in filters (exprs/evaluator.py; the reference defaults this off "
+    "because its SC nodes bypass Hive-UDF checks — here the flattened "
+    "form is the native fast path).", category="operator")
+DECIMAL_ARITH_OP_ENABLED = bool_conf(
+    "auron.decimal.arithOp.enabled", True,
+    "Allow native decimal +-*/ (precision-tracking arithmetic).",
+    category="convert")
+DATETIME_EXTRACT_ENABLED = bool_conf(
+    "auron.datetime.extract.enabled", True,
+    "Allow native year/month/day/hour extraction.", category="convert")
+UDF_JSON_ENABLED = bool_conf(
+    "auron.udf.UDFJson.enabled", True,
+    "Convert Hive UDFJson (get_json_object) natively.", category="convert")
+UDF_BRICKHOUSE_ENABLED = bool_conf(
+    "auron.udf.brickhouse.enabled", False,
+    "Convert brickhouse collect/combine_unique UDAFs natively.",
+    category="convert")
+UDF_SINGLE_CHILD_FALLBACK_ENABLED = bool_conf(
+    "auron.udf.singleChildFallback.enabled", False,
+    "Wrap single-child unsupported expressions in a UDF fallback instead "
+    "of rejecting the subtree.", category="convert")
+
+# per-operator conversion switches (ref AuronConverters.scala:98-128)
+_OPERATOR_SWITCHES = {}
+for _op in ("scan", "paimon.scan", "iceberg.scan", "hudi.scan", "project",
+            "filter", "sort", "union", "smj", "shj",
+            "native.join.condition", "bhj", "bnlj", "local.limit",
+            "global.limit", "take.ordered.and.project", "collectLimit",
+            "aggr", "expand", "window", "window.group.limit", "generate",
+            "local.table.scan", "data.writing", "data.writing.parquet",
+            "data.writing.orc", "scan.parquet", "scan.parquet.timestamp",
+            "scan.orc", "scan.orc.timestamp", "broadcastExchange",
+            "shuffleExchange"):
+    _OPERATOR_SWITCHES[_op] = bool_conf(
+        f"auron.enable.{_op}", True,
+        f"Allow converting {_op} nodes to the native engine.",
+        category="convert")
+
+
+def operator_enabled(op: str) -> bool:
+    """Converter gate lookup (ref per-op enable flags,
+    AuronConverters.scala:98-128)."""
+    opt = _OPERATOR_SWITCHES.get(op)
+    return True if opt is None else opt.get()

@@ -1,19 +1,26 @@
 """Cast / TryCast expressions.
 
-Parity: datafusion-ext-exprs/src/cast.rs (TryCast) over the Spark cast matrix
-in datafusion-ext-commons/src/arrow/cast.rs.  Device-side fixed-width casts
-go through kernels/cast.py; any cast touching strings runs at the host
-boundary with Spark's parsing semantics (invalid input -> NULL, non-ANSI).
+Parity: datafusion-ext-exprs/src/cast.rs (TryCast) over the Spark cast
+matrix in datafusion-ext-commons/src/arrow/cast.rs (1,046 LoC).  Device-side
+fixed-width casts go through kernels/cast.py; any cast touching strings,
+decimal128 beyond int64 range, or nested values runs at the host boundary
+with Spark's parsing semantics.
+
+ANSI mode (spark.sql.ansi.enabled): a Cast raises on invalid input instead
+of producing NULL; TryCast always produces NULL (that is the distinction
+the reference keeps between CastExpr and TryCastExpr).
 """
 
 from __future__ import annotations
 
+import decimal as pydec
 from dataclasses import dataclass
 
 import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
+from blaze_tpu import config
 from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.exprs.base import ColVal, PhysicalExpr
 from blaze_tpu.kernels import cast as cast_kernels
@@ -25,6 +32,8 @@ class Cast(PhysicalExpr):
     child: PhysicalExpr
     to: DataType
 
+    ansi_capable = True  # TryCast overrides
+
     def children(self):
         return (self.child,)
 
@@ -32,25 +41,65 @@ class Cast(PhysicalExpr):
         return self.to
 
     def cache_key(self):
-        return ("cast", repr(self.to), self.child.cache_key())
+        return (type(self).__name__.lower(), repr(self.to),
+                self.child.cache_key())
 
     def evaluate(self, batch: ColumnBatch) -> ColVal:
         v = self.child.evaluate(batch)
         src = v.dtype
         if src == self.to:
             return v
-        if v.is_device and self.to.is_fixed_width:
-            data, valid = cast_kernels.cast_column(v.data, v.validity, src, self.to)
+        ansi = self.ansi_capable and config.ANSI_ENABLED.get()
+        if (v.is_device and self.to.is_fixed_width and
+                _device_supported(src, self.to)):
+            data, valid = cast_kernels.cast_column(v.data, v.validity,
+                                                   src, self.to)
+            if ansi:
+                self._ansi_check_device(v, valid, batch)
             return ColVal(self.to, data=data, validity=valid)
-        return _host_cast(v, self.to, batch)
+        out = _host_cast(v, self.to, batch)
+        if ansi:
+            self._ansi_check_host(v, out, batch)
+        return out
+
+    def _ansi_check_device(self, v_in: ColVal, valid_out, batch) -> None:
+        import jax.numpy as jnp
+        mask = batch.row_mask()
+        lost = v_in.validity & ~valid_out & mask
+        if bool(jnp.any(lost)):
+            raise ValueError(
+                f"[CAST_INVALID_INPUT] cast to {self.to!r} failed in ANSI "
+                f"mode (use try_cast to tolerate malformed input)")
+
+    def _ansi_check_host(self, v_in: ColVal, out: ColVal, batch) -> None:
+        n = batch.num_rows
+        in_valid = np.asarray(v_in.to_host(n).is_valid())
+        out_valid = np.asarray(out.to_host(n).is_valid())
+        if (in_valid & ~out_valid).any():
+            raise ValueError(
+                f"[CAST_INVALID_INPUT] cast to {self.to!r} failed in ANSI "
+                f"mode (use try_cast to tolerate malformed input)")
 
     def __repr__(self):
         return f"cast({self.child!r} as {self.to!r})"
 
 
-# TryCast is the same node in non-ANSI mode (invalid -> null); the reference
-# distinguishes them for ANSI error raising (cast.rs TryCastExpr).
-TryCast = Cast
+@dataclass(frozen=True, repr=False)
+class TryCast(Cast):
+    """Invalid input -> NULL even under ANSI (ref cast.rs TryCastExpr)."""
+
+    ansi_capable = False
+
+    def __repr__(self):
+        return f"try_cast({self.child!r} as {self.to!r})"
+
+
+def _device_supported(src: DataType, dst: DataType) -> bool:
+    """decimal128 beyond the int64-unscaled range needs the host path."""
+    for t in (src, dst):
+        if t.id == TypeId.DECIMAL and t.precision > 18:
+            return False
+    return True
 
 
 def _host_cast(v: ColVal, to: DataType, batch: ColumnBatch) -> ColVal:
@@ -58,7 +107,9 @@ def _host_cast(v: ColVal, to: DataType, batch: ColumnBatch) -> ColVal:
     arr = v.to_host(n)
     src = v.dtype
 
-    if src.id == TypeId.UTF8:
+    if to.id == TypeId.DECIMAL:
+        out = _to_decimal(arr, src, to)
+    elif src.id == TypeId.UTF8:
         out = _parse_string(arr, to)
     elif to.id == TypeId.UTF8:
         out = _format_string(arr, src)
@@ -72,9 +123,51 @@ def _host_cast(v: ColVal, to: DataType, batch: ColumnBatch) -> ColVal:
     return ColVal.host(to, out)
 
 
+# ---------------------------------------------------------------------------
+# decimal128 (host): BigDecimal semantics with HALF_UP, overflow -> null
+# (ref cast.rs decimal paths; exercised by the 38,18 test vectors)
+# ---------------------------------------------------------------------------
+
+def _to_decimal(arr: pa.Array, src: DataType, to: DataType) -> pa.Array:
+    t = to.to_arrow()
+    quant = pydec.Decimal(1).scaleb(-to.scale)
+    max_unscaled = 10 ** to.precision
+    out = []
+    trim = config.CAST_TRIM_STRING.get()
+    with pydec.localcontext() as ctx:
+        ctx.prec = 76  # two decimal128s' worth; the default 28 overflows
+        for x in arr:
+            if not x.is_valid:
+                out.append(None)
+                continue
+            raw = x.as_py()
+            try:
+                if isinstance(raw, str):
+                    if not trim and raw != raw.strip():
+                        # Decimal() tolerates padding on its own; honor
+                        # auron.cast.trimString=false by rejecting it
+                        out.append(None)
+                        continue
+                    d = pydec.Decimal(raw.strip() if trim else raw)
+                elif isinstance(raw, bool):
+                    d = pydec.Decimal(int(raw))
+                elif isinstance(raw, float):
+                    d = pydec.Decimal(repr(raw))
+                else:
+                    d = pydec.Decimal(raw)
+                q = d.quantize(quant, rounding=pydec.ROUND_HALF_UP)
+            except (pydec.InvalidOperation, ValueError, TypeError):
+                out.append(None)
+                continue
+            unscaled = int(q.scaleb(to.scale))
+            out.append(None if abs(unscaled) >= max_unscaled else q)
+    return pa.array(out, type=t)
+
+
 def _parse_string(arr: pa.Array, to: DataType) -> pa.Array:
     """Spark string parsing: trim, invalid -> null (non-ANSI)."""
-    arr = pc.utf8_trim_whitespace(arr)
+    if config.CAST_TRIM_STRING.get():
+        arr = pc.utf8_trim_whitespace(arr)
     t = to.to_arrow()
     if to.id == TypeId.BOOL:
         lowered = pc.utf8_lower(arr)
@@ -90,11 +183,36 @@ def _parse_string(arr: pa.Array, to: DataType) -> pa.Array:
             return _try_strptime_date(arr)
         if to.id == TypeId.TIMESTAMP_MICROS:
             return _try_parse_timestamp(arr)
-        # Spark accepts "12.5" -> 12 for int casts: go through double first
-        dbl = _try_cast(arr, pa.float64())
-        trunc = pc.trunc(dbl)
-        return _try_cast(trunc, t)
+        # Spark accepts "12.5" -> 12 for int casts: parse as decimal and
+        # truncate toward zero (a double round-trip would corrupt >2^53)
+        return _string_to_integral(arr, to)
     return _try_cast(arr, t)
+
+
+def _string_to_integral(arr: pa.Array, to: DataType) -> pa.Array:
+    lo, hi = cast_kernels._int_bounds(to.id)
+    trim = config.CAST_TRIM_STRING.get()
+    out = []
+    for x in arr:
+        if not x.is_valid:
+            out.append(None)
+            continue
+        s = x.as_py()
+        if trim:
+            s = s.strip()
+        elif s != s.strip():
+            out.append(None)  # Decimal() tolerates padding on its own
+            continue
+        try:
+            # OverflowError: Decimal('Infinity') survives parsing but has
+            # no integral value
+            i = int(pydec.Decimal(s).to_integral_value(
+                rounding=pydec.ROUND_DOWN))
+        except (pydec.InvalidOperation, ValueError, OverflowError):
+            out.append(None)
+            continue
+        out.append(i if lo <= i <= hi else None)
+    return pa.array(out, type=to.to_arrow())
 
 
 def _try_cast(arr: pa.Array, t: pa.DataType) -> pa.Array:
@@ -153,23 +271,71 @@ def _try_parse_timestamp(arr: pa.Array) -> pa.Array:
     return pa.array(out, type=pa.timestamp("us"))
 
 
+# ---------------------------------------------------------------------------
+# value -> string (Spark display formats, ref cast.rs *_to_string tests)
+# ---------------------------------------------------------------------------
+
 def _format_string(arr: pa.Array, src: DataType) -> pa.Array:
     if src.id == TypeId.BOOL:
         return pc.if_else(arr, "true", "false")
-    if src.id == TypeId.FLOAT32 or src.id == TypeId.FLOAT64:
-        # Java Double.toString: integral doubles print with ".0"
+    if src.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        py = []
+        for x in arr:
+            py.append(None if not x.is_valid
+                      else _spark_str(x.as_py(), src))
+        return pa.array(py, type=pa.utf8())
+    if src.id == TypeId.DECIMAL:
+        # full scale with trailing zeros: "123.000000000000000000"
         py = []
         for x in arr:
             if not x.is_valid:
                 py.append(None)
-                continue
-            f = x.as_py()
-            if f != f:
-                py.append("NaN")
-            elif f in (float("inf"), float("-inf")):
-                py.append("Infinity" if f > 0 else "-Infinity")
             else:
-                py.append(repr(f) if not float(f).is_integer()
-                          else f"{f:.1f}")
+                py.append(_format_decimal(x.as_py(), src.scale))
+        return pa.array(py, type=pa.utf8())
+    if src.is_nested:
+        py = []
+        for x in arr:
+            py.append(None if not x.is_valid
+                      else _spark_str(x.as_py(), src))
         return pa.array(py, type=pa.utf8())
     return arr.cast(pa.utf8())
+
+
+def _format_decimal(d: pydec.Decimal, scale: int) -> str:
+    q = d.quantize(pydec.Decimal(1).scaleb(-scale)) if scale else \
+        d.to_integral_value()
+    return format(q, "f")
+
+
+def _spark_str(v, t: DataType) -> str:
+    """One value in Spark's nested-display format: struct "{1, a, true}",
+    map "{k -> v}", array "[1, 2]", nulls as the literal "null"."""
+    if v is None:
+        return "null"
+    if t.id == TypeId.BOOL:
+        return "true" if v else "false"
+    if t.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        f = float(v)
+        if f != f:
+            return "NaN"
+        if f in (float("inf"), float("-inf")):
+            return "Infinity" if f > 0 else "-Infinity"
+        return repr(f) if not f.is_integer() else f"{f:.1f}"
+    if t.id == TypeId.DECIMAL:
+        return _format_decimal(v, t.scale)
+    if t.id == TypeId.STRUCT:
+        inner = ", ".join(
+            _spark_str(v.get(f.name), f.data_type) for f in t.children)
+        return "{" + inner + "}"
+    if t.id == TypeId.MAP:
+        kt = t.children[0].data_type
+        vt = t.children[1].data_type
+        items = v.items() if isinstance(v, dict) else v
+        inner = ", ".join(f"{_spark_str(k, kt)} -> {_spark_str(val, vt)}"
+                          for k, val in items)
+        return "{" + inner + "}"
+    if t.id == TypeId.LIST:
+        et = t.children[0].data_type
+        return "[" + ", ".join(_spark_str(e, et) for e in v) + "]"
+    return str(v)

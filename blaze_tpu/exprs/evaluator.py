@@ -30,9 +30,16 @@ class CachedExprsEvaluator:
 
     def __init__(self, filters: Sequence[PhysicalExpr] = (),
                  projections: Sequence[PhysicalExpr] = ()):
+        from blaze_tpu import config
         self.filters: List[PhysicalExpr] = []
+        flatten = config.FORCE_SHORT_CIRCUIT_AND_OR.get()
         for f in filters:
-            self.filters.extend(split_conjuncts(f))
+            if flatten:
+                # sequential conjuncts narrow the selection between
+                # evaluations (ref auron.forceShortCircuitAndOr)
+                self.filters.extend(split_conjuncts(f))
+            else:
+                self.filters.append(f)
         self.projections = list(projections)
         self._cache: Dict[object, ColVal] = {}
 

@@ -16,6 +16,7 @@ per-task budget discipline rather than the cross-task waiting.
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -158,4 +159,11 @@ def default_budget_bytes() -> int:
             return int(stats["bytes_limit"] * frac)
     except Exception:
         pass
-    return int(4 * (1 << 30) * frac)  # CPU-test fallback: 4 GiB nominal
+    # CPU fallback: host memory bounded by the process-RSS fraction
+    # (ref auron.process.vmrss.memoryFraction), nominally capped at 4 GiB
+    try:
+        phys = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (ValueError, OSError, AttributeError):
+        phys = 4 << 30
+    vmrss = config.PROCESS_VMRSS_MEMORY_FRACTION.get()
+    return int(min(phys * vmrss, 4 << 30) * frac)

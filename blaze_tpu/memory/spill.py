@@ -50,6 +50,16 @@ class Spill:
         raise NotImplementedError
 
 
+_host_spill_bytes = 0  # live RAM-tier bytes across all spills
+_host_spill_lock = threading.Lock()
+
+
+def _host_spill_account(delta: int) -> None:
+    global _host_spill_bytes
+    with _host_spill_lock:
+        _host_spill_bytes = max(0, _host_spill_bytes + delta)
+
+
 class HostMemSpill(Spill):
     """Tier-1: device state moved to host RAM as serialized IPC bytes
     (the OnHeapSpill analog, spill.rs:180)."""
@@ -66,6 +76,7 @@ class HostMemSpill(Spill):
             n += w.write_batch(b)
         w.finish()
         self._buf = sink.getvalue()
+        _host_spill_account(len(self._buf))
         return n
 
     def read_batches(self):
@@ -74,6 +85,8 @@ class HostMemSpill(Spill):
         yield from IpcCompressionReader(io.BytesIO(self._buf)).read_batches()
 
     def release(self):
+        if self._buf is not None:
+            _host_spill_account(-len(self._buf))
         self._buf = None
 
     @property
@@ -124,7 +137,16 @@ _host_spill_budget = threading.Semaphore()  # placeholder; see try_new_spill
 def try_new_spill(prefer_host: bool = True,
                   host_mem_available: Optional[bool] = None) -> Spill:
     """Choose the spill tier (ref spill.rs:89: on-heap if isOnHeapAvailable,
-    else getDirectWriteSpillToDiskFile)."""
+    else getDirectWriteSpillToDiskFile).  The RAM tier is capped at
+    auron.onHeapSpill.memoryFraction of the manager budget; past that,
+    runs go straight to disk."""
     if host_mem_available is None:
-        host_mem_available = prefer_host
+        if prefer_host:
+            from blaze_tpu import config
+            from blaze_tpu.memory.manager import MemManager
+            cap = (MemManager.get().total *
+                   config.ON_HEAP_SPILL_MEMORY_FRACTION.get())
+            host_mem_available = _host_spill_bytes < cap
+        else:
+            host_mem_available = False
     return HostMemSpill() if host_mem_available else FileSpill()

@@ -113,6 +113,8 @@ class _AggState(MemConsumer):
         self.buffer: List[pa.RecordBatch] = []
         self.buffered_bytes = 0
         self.spills: List[Spill] = []
+        self.flush_pending: List[pa.RecordBatch] = []  # skipSpill handoff
+        self._output_started = False  # guards cross-thread skipSpill
         self.skipping = False
         self.rows_seen = 0
         self.groups_emitted = 0
@@ -127,6 +129,9 @@ class _AggState(MemConsumer):
             return
         self.rows_seen += batch.selected_count()
         if self.skipping:
+            if self.flush_pending:
+                pending, self.flush_pending = self.flush_pending, []
+                yield from self._emit(pending)
             yield from self._emit([partial])
             return
         self.buffer.append(partial)
@@ -403,6 +408,23 @@ class _AggState(MemConsumer):
     def spill(self) -> int:
         if not self.buffer:
             return 0
+        if (config.PARTIAL_AGG_SKIPPING_SKIP_SPILL.get() and
+                not self.skipping and not self._output_started and
+                self.num_keys and self.op._aggs and
+                all(m == AggMode.PARTIAL for _, m, _ in self.op._aggs) and
+                not any(fn.is_host for fn, _, _ in self.op._aggs)):
+            # under pressure, hand the buffered partials downstream
+            # un-merged instead of spilling: process()/output() drain
+            # flush_pending at the next pull
+            # (ref auron.partialAggSkipping.skipSpill)
+            self.skipping = True
+            self.flush_pending.extend(self.buffer)
+            released = self.buffered_bytes
+            self.buffer = []
+            self.buffered_bytes = 0
+            self._mem_used = 0
+            self.op.metrics.add("partial_skipped", 1)
+            return released
         self._combine_buffer()
         if not self.buffer:
             return 0
@@ -426,6 +448,12 @@ class _AggState(MemConsumer):
     # ------------------------------------------------------------------
     def output(self) -> Iterator[pa.RecordBatch]:
         op = self.op
+        # a cross-thread skipSpill after this point would strand rows in
+        # flush_pending; from here on spill() takes the normal path
+        self._output_started = True
+        if self.flush_pending:
+            pending, self.flush_pending = self.flush_pending, []
+            yield from self._emit(pending)
         self._combine_buffer()
         if not self.spills:
             batches = self.buffer

@@ -478,7 +478,11 @@ def make_agg(name: str, children: Sequence[PhysicalExpr], **kw) -> AggFunction:
     if name == "bloom_filter":
         return BloomFilterAgg(children, **kw)
     if name == "udaf":
+        from blaze_tpu import config
         from blaze_tpu.bridge.resource import get_resource
+        if not config.UDAF_FALLBACK_ENABLE.get():
+            raise ValueError("UDAF host fallback disabled "
+                             "(auron.udafFallback.enable=false)")
         impl = get_resource(f"udaf://{kw['udaf_name']}")
         if impl is None:
             raise KeyError(f"UDAF {kw['udaf_name']!r} not registered "

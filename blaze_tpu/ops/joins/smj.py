@@ -36,7 +36,10 @@ class _Run:
 
     @property
     def is_null_key(self) -> bool:
-        return any(k[0] == 0 for k in self.key)
+        # flag 0 = NULL (sorts first), flag 2 = NaN (sorts last); neither
+        # ever matches across sides — mirroring the hash-probe path, where
+        # pc.equal drops both
+        return any(k[0] != 1 for k in self.key)
 
 
 def _key_tuple(arrays: List[pa.Array], row: int) -> Tuple:
@@ -46,7 +49,14 @@ def _key_tuple(arrays: List[pa.Array], row: int) -> Tuple:
         if not v.is_valid:
             out.append((0, 0))  # nulls first, never equal across sides
         else:
-            out.append((1, v.as_py()))
+            py = v.as_py()
+            if isinstance(py, float) and py != py:
+                # NaN poisons tuple comparison (both < and > come back
+                # False, reading as a bogus match); give it its own
+                # sorts-last, never-matching flag
+                out.append((2, 0))
+            else:
+                out.append((1, py))
     return tuple(out)
 
 

@@ -18,9 +18,7 @@ from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
 from blaze_tpu.ops.scan import _align_schema
 from blaze_tpu.schema import Schema
 
-ORC_FORCE_POSITIONAL = config.bool_conf(
-    "auron.orc.force.positional.evolution", False,
-    "Match ORC columns by position instead of name (ref orc_exec.rs).")
+ORC_FORCE_POSITIONAL = config.ORC_FORCE_POSITIONAL_EVOLUTION
 
 
 class OrcScanExec(ExecutionPlan):

@@ -25,6 +25,28 @@ from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
 from blaze_tpu.schema import Schema
 
+_META_CACHE: dict = {}
+
+
+def parquet_metadata(path: str):
+    """Footer metadata cached across scans and fused-stage bound discovery
+    (ref auron.parquet.metadataCacheSize; keyed by path + mtime so
+    rewritten files refresh)."""
+    import os
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = 0
+    key = (path, mtime)
+    md = _META_CACHE.get(key)
+    if md is None:
+        md = pq.ParquetFile(path).metadata
+        limit = max(1, config.PARQUET_METADATA_CACHE_SIZE.get())
+        if len(_META_CACHE) >= limit:
+            _META_CACHE.pop(next(iter(_META_CACHE)))
+        _META_CACHE[key] = md
+    return md
+
 
 class MemoryScanExec(ExecutionPlan):
     """Fixed batches per partition (the TestMemoryExec analog)."""

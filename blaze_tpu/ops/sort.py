@@ -362,8 +362,13 @@ def merge_sorted_batches(runs: List[Iterator[pa.RecordBatch]],
               for j in range(len(take_keys[0]))]
         perm = lexsort_host(mk)
         out = merged.to_batches()[0].take(pa.array(perm, type=pa.int64()))
-        for off in range(0, out.num_rows, bs):
-            yield out.slice(off, min(bs, out.num_rows - off))
+        # chunk by rows AND by the suggested merge memory target
+        # (ref auron.suggested.batch.memSize.multiwayMerging)
+        mem_target = config.SUGGESTED_MERGING_BATCH_MEM_SIZE.get()
+        row_bytes = max(1, out.nbytes // max(1, out.num_rows))
+        chunk = max(1, min(bs, mem_target // row_bytes))
+        for off in range(0, out.num_rows, chunk):
+            yield out.slice(off, min(chunk, out.num_rows - off))
 
 
 def _is_fixed(t: pa.DataType) -> bool:

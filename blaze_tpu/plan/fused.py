@@ -168,13 +168,13 @@ def _column_bounds(node: ExecutionPlan, expr: PhysicalExpr
 
 def _parquet_bounds(scan: ParquetScanExec, col_index: int
                     ) -> Optional[Tuple[int, int]]:
-    import pyarrow.parquet as pq
+    from blaze_tpu.ops.scan import parquet_metadata
     name = scan.schema[col_index].name
     lo = hi = None
     for group in scan._file_groups:
         for path in group:
             try:
-                md = pq.ParquetFile(path).metadata
+                md = parquet_metadata(path)
             except Exception:
                 return None
             fidx = md.schema.names.index(name) \

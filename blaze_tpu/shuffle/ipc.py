@@ -32,7 +32,13 @@ CODEC_ZSTD = 1
 
 
 def _get_codec() -> int:
-    name = config.SPILL_COMPRESSION_CODEC.get().lower()
+    # io.compression.codec governs shuffle frames when explicitly set;
+    # otherwise the spill codec key (which governed this framing before
+    # the io.* family landed) still applies
+    if config.conf.is_set(config.IO_COMPRESSION_CODEC):
+        name = config.IO_COMPRESSION_CODEC.get().lower()
+    else:
+        name = config.SPILL_COMPRESSION_CODEC.get().lower()
     return CODEC_ZSTD if name in ("zstd", "zstandard") else CODEC_RAW
 
 

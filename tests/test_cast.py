@@ -1,0 +1,195 @@
+"""Spark cast-matrix tests mirroring the reference vectors
+(datafusion-ext-commons/src/arrow/cast.rs:540-1000)."""
+
+import decimal as pydec
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import config
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import Cast, TryCast, col
+from blaze_tpu.schema import (BOOL, DataType, FLOAT64, INT32, INT64, UTF8,
+                              TypeId, decimal)
+
+I32MAX, I32MIN = 2**31 - 1, -(2**31)
+
+
+def _cast_values(values, src_type: pa.DataType, to: DataType,
+                 expr_cls=Cast):
+    t = pa.table({"c": pa.array(values, type=src_type)})
+    cb = ColumnBatch.from_arrow(t.to_batches()[0])
+    out = expr_cls(col(0), to).evaluate(cb).to_host(cb.num_rows)
+    return out.to_pylist()
+
+
+class TestReferenceVectors:
+    def test_boolean_to_string(self):
+        # ref cast.rs:541
+        got = _cast_values([None, True, False], pa.bool_(), UTF8)
+        assert got == [None, "true", "false"]
+
+    def test_float_to_int(self):
+        # ref cast.rs:553 — truncate, saturate at int bounds, NaN -> 0
+        vals = [None, 123.456, 987.654, I32MAX + 10000.0, I32MIN - 10000.0,
+                float("inf"), float("-inf"), float("nan")]
+        got = _cast_values(vals, pa.float64(), INT32)
+        assert got == [None, 123, 987, I32MAX, I32MIN, I32MAX, I32MIN, 0]
+
+    def test_int_to_float(self):
+        # ref cast.rs:582
+        got = _cast_values([None, 123, 987, I32MAX, I32MIN], pa.int32(),
+                           FLOAT64)
+        assert got == [None, 123.0, 987.0, float(I32MAX), float(I32MIN)]
+
+    def test_int_to_decimal_38_18(self):
+        # ref cast.rs:605
+        got = _cast_values([None, 123, 987, I32MAX, I32MIN], pa.int32(),
+                           decimal(38, 18))
+        want_unscaled = [None, 123 * 10**18, 987 * 10**18,
+                         I32MAX * 10**18, I32MIN * 10**18]
+        got_unscaled = [None if v is None else int(v.scaleb(18))
+                        for v in got]
+        assert got_unscaled == want_unscaled
+
+    def test_string_to_decimal_38_18(self):
+        # ref cast.rs:629 — scientific notation, padding, rounding
+        vals = [None, "1e-8", "1.012345678911111111e10", "1.42e-6",
+                "0.00000142", "123.456", "987.654",
+                "123456789012345.678901234567890",
+                "-123456789012345.678901234567890"]
+        got = _cast_values(vals, pa.utf8(), decimal(38, 18))
+        want = [None, 10000000000, 10123456789111111110000000000,
+                1420000000000, 1420000000000, 123456000000000000000,
+                987654000000000000000,
+                123456789012345678901234567890000,
+                -123456789012345678901234567890000]
+        with pydec.localcontext() as ctx:
+            ctx.prec = 76  # unscaling a decimal128 needs > the default 28
+            got_unscaled = [None if v is None else int(v.scaleb(18))
+                            for v in got]
+        assert got_unscaled == want
+
+    def test_decimal_to_string(self):
+        # ref cast.rs:661 — full scale with trailing zeros
+        unscaled = [None, 123 * 10**18, 987 * 10**18, 987654321 * 10**12,
+                    I32MAX * 10**18, I32MIN * 10**18]
+        vals = [None if u is None else pydec.Decimal(u).scaleb(-18)
+                for u in unscaled]
+        got = _cast_values(vals, pa.decimal128(38, 18), UTF8)
+        assert got == [None, "123.000000000000000000",
+                       "987.000000000000000000", "987.654321000000000000",
+                       "2147483647.000000000000000000",
+                       "-2147483648.000000000000000000"]
+
+    def test_string_to_bigint(self):
+        # ref cast.rs:692 — trim, fractional truncation, overflow -> null
+        vals = [None, "123", "987", "987.654", "123456789012345",
+                "-123456789012345", "999999999999999999999999999999999"]
+        got = _cast_values(vals, pa.utf8(), INT64)
+        assert got == [None, 123, 987, 987, 123456789012345,
+                       -123456789012345, None]
+
+    def test_string_to_date(self):
+        # ref cast.rs:722 — partial dates fill with 01; invalid -> null
+        vals = [None, "2001-02-03", "2001-03-04", "2001-04-05T06:07:08",
+                "2001-04", "2002", "2001-00", "2001-13", "9999-99",
+                "99999-01"]
+        got = _cast_values(vals, pa.utf8(), DataType(TypeId.DATE32))
+        strs = [None if d is None else d.isoformat() for d in got]
+        assert strs == [None, "2001-02-03", "2001-03-04", "2001-04-05",
+                        "2001-04-01", "2002-01-01", None, None, None, None]
+
+    def test_struct_to_string(self):
+        # ref cast.rs:755 — "{1, a, true}", nulls print as "null"
+        st = pa.struct([("i", pa.int32()), ("s", pa.utf8()),
+                        ("b", pa.bool_())])
+        vals = [{"i": 1, "s": "a", "b": True},
+                {"i": 2, "s": None, "b": False},
+                {"i": None, "s": "c", "b": True},
+                {"i": 4, "s": "d", "b": None},
+                {"i": None, "s": None, "b": None}]
+        got = _cast_values(vals, st, UTF8)
+        assert got == ["{1, a, true}", "{2, null, false}",
+                       "{null, c, true}", "{4, d, null}",
+                       "{null, null, null}"]
+
+    def test_map_to_string(self):
+        # ref cast.rs:872 — "{1 -> a, 2 -> b}"
+        mt = pa.map_(pa.int32(), pa.utf8())
+        vals = [[(1, "a"), (2, "b")], [(3, None)], None]
+        got = _cast_values(vals, mt, UTF8)
+        assert got == ["{1 -> a, 2 -> b}", "{3 -> null}", None]
+
+
+class TestDecimalRescale:
+    def test_widen_and_narrow_scale(self):
+        vals = [pydec.Decimal("1.23"), pydec.Decimal("-0.5"), None]
+        got = _cast_values(vals, pa.decimal128(10, 2), decimal(12, 4))
+        assert [None if v is None else str(v) for v in got] == \
+            ["1.2300", "-0.5000", None]
+        # HALF_UP when narrowing
+        vals = [pydec.Decimal("1.2350"), pydec.Decimal("-1.2350")]
+        got = _cast_values(vals, pa.decimal128(10, 4), decimal(10, 2))
+        assert [str(v) for v in got] == ["1.24", "-1.24"]
+
+    def test_overflow_to_null(self):
+        vals = [pydec.Decimal("99999.99"), pydec.Decimal("1.00")]
+        got = _cast_values(vals, pa.decimal128(7, 2), decimal(4, 2))
+        assert got[0] is None and str(got[1]) == "1.00"
+
+
+class TestAnsiMode:
+    def _with_ansi(self, fn):
+        config.conf.set(config.ANSI_ENABLED.key, True)
+        try:
+            return fn()
+        finally:
+            config.conf.unset(config.ANSI_ENABLED.key)
+
+    def test_cast_raises_on_malformed_string(self):
+        with pytest.raises(ValueError, match="CAST_INVALID_INPUT"):
+            self._with_ansi(
+                lambda: _cast_values(["12", "abc"], pa.utf8(), INT64))
+
+    def test_try_cast_still_nulls(self):
+        got = self._with_ansi(
+            lambda: _cast_values(["12", "abc"], pa.utf8(), INT64,
+                                 expr_cls=TryCast))
+        assert got == [12, None]
+
+    def test_cast_raises_on_decimal_overflow(self):
+        with pytest.raises(ValueError, match="CAST_INVALID_INPUT"):
+            self._with_ansi(lambda: _cast_values(
+                [pydec.Decimal("99999.99")], pa.decimal128(7, 2),
+                decimal(4, 2)))
+
+    def test_valid_input_passes_under_ansi(self):
+        got = self._with_ansi(
+            lambda: _cast_values(["12", "34"], pa.utf8(), INT64))
+        assert got == [12, 34]
+
+    def test_null_input_is_not_an_ansi_error(self):
+        got = self._with_ansi(
+            lambda: _cast_values([None, "7"], pa.utf8(), INT64))
+        assert got == [None, 7]
+
+
+class TestReviewRegressions:
+    def test_infinity_string_to_int_is_null(self):
+        got = _cast_values(["Infinity", "-Inf", "NaN", "5"], pa.utf8(),
+                           INT32, expr_cls=TryCast)
+        assert got == [None, None, None, 5]
+
+    def test_trim_string_disabled_nulls_padded_numerics(self):
+        config.conf.set(config.CAST_TRIM_STRING.key, False)
+        try:
+            got = _cast_values([" 12", "12"], pa.utf8(), INT64,
+                               expr_cls=TryCast)
+            assert got == [None, 12]
+            got = _cast_values([" 1.5", "1.5"], pa.utf8(), decimal(20, 2),
+                               expr_cls=TryCast)
+            assert got[0] is None and str(got[1]) == "1.50"
+        finally:
+            config.conf.unset(config.CAST_TRIM_STRING.key)
