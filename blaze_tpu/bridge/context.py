@@ -28,6 +28,12 @@ class TaskContext:
         if not self.is_running():
             raise TaskKilledError(
                 f"task stage={self.stage_id} partition={self.partition_id} killed")
+        probe = _host_task_probe
+        if probe is not None and not probe(self.stage_id,
+                                           self.partition_id):
+            raise TaskKilledError(
+                f"task stage={self.stage_id} "
+                f"partition={self.partition_id} killed by host")
 
 
 class TaskKilledError(RuntimeError):
@@ -64,3 +70,13 @@ class task_scope:
     def __exit__(self, *exc):
         _local.ctx = self._prev
         return False
+
+
+#: Host-engine task-liveness probe installed via the C-ABI callback
+#: surface (ref JniBridge.isTaskRunning)
+_host_task_probe = None
+
+
+def set_host_task_probe(fn) -> None:
+    global _host_task_probe
+    _host_task_probe = fn

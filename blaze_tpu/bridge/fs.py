@@ -88,20 +88,31 @@ class CallbackFs(Fs):
 
 class FsProvider:
     """scheme -> Fs registry (ref hadoop_fs.rs FsProvider, cached per
-    scheme like the reference's per-task fs cache)."""
+    scheme like the reference's per-task fs cache).  A registered
+    fallback serves every unknown scheme — the host-engine FS installed
+    through the C-ABI callback surface (openFileAsDataInputWrapper)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._fs: Dict[str, Fs] = {"": LocalFs(), "file": LocalFs()}
+        self._fallback: Optional[Fs] = None
 
     def register(self, scheme: str, fs: Fs) -> None:
         with self._lock:
             self._fs[scheme] = fs
 
+    def register_fallback(self, fs: Fs) -> None:
+        with self._lock:
+            self._fallback = fs
+
+    def unregister_fallback(self) -> None:
+        with self._lock:
+            self._fallback = None
+
     def provide(self, path: str) -> Fs:
         scheme = path.split("://", 1)[0] if "://" in path else ""
         with self._lock:
-            fs = self._fs.get(scheme)
+            fs = self._fs.get(scheme) or self._fallback
         if fs is None:
             raise KeyError(f"no filesystem registered for scheme "
                            f"{scheme!r} ({path})")

@@ -1,0 +1,303 @@
+"""Host-engine callback surface over the C ABI.
+
+Parity: the ~20 JNI statics the reference's native side calls back into
+(ref auron-core/.../jni/JniBridge.java:57+ — conf getters,
+openFileAsDataInputWrapper, getTaskOnHeapSpillManager, isTaskRunning,
+getAuronUDFWrapperContext) and the `define_conf!` lazy conf proxies
+(auron-jni-bridge/src/conf.rs:20-63).
+
+The C++ bridge (native/src/host_bridge.cpp blaze_register_callbacks)
+receives a `BlazeHostCallbacks` struct from the host and forwards the raw
+function addresses here; this module wraps them with ctypes and installs
+them into the engine's seams:
+
+  conf_get        -> a resolver layer in config.ConfSession
+  fs_*            -> a CallbackFs registered as the fallback filesystem
+  spill_*         -> a host-engine Spill tier (OnHeapSpillManager analog)
+  is_task_running -> the TaskContext cooperative-cancel probe
+  udf_eval        -> a `udf://` resource resolver (Arrow IPC round trip)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import io
+from typing import Dict, Optional
+
+import pyarrow as pa
+
+ABI_VERSION = 1
+
+# ctypes signatures mirroring BlazeHostCallbacks (host_bridge.cpp)
+SIGNATURES = {
+    "conf_get": ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int64),
+    "fs_open": ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_char_p),
+    "fs_size": ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_int64),
+    "fs_read": ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_int64,
+                                ctypes.c_int64,
+                                ctypes.POINTER(ctypes.c_uint8),
+                                ctypes.c_int64),
+    "fs_close": ctypes.CFUNCTYPE(None, ctypes.c_int64),
+    "spill_create": ctypes.CFUNCTYPE(ctypes.c_int64),
+    "spill_write": ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_uint8),
+                                    ctypes.c_int64),
+    "spill_read": ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_int64,
+                                   ctypes.c_int64,
+                                   ctypes.POINTER(ctypes.c_uint8),
+                                   ctypes.c_int64),
+    "spill_release": ctypes.CFUNCTYPE(None, ctypes.c_int64),
+    "is_task_running": ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_int64,
+                                        ctypes.c_int64),
+    "udf_eval": ctypes.CFUNCTYPE(
+        ctypes.c_int64, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64)),
+    "free_buffer": ctypes.CFUNCTYPE(None, ctypes.c_void_p),
+}
+
+_installed: Dict[str, object] = {}
+
+
+def installed() -> Dict[str, object]:
+    return dict(_installed)
+
+
+def uninstall() -> None:
+    """Remove every host hook (tests)."""
+    from blaze_tpu import config
+    from blaze_tpu.bridge import context, resource
+    from blaze_tpu.bridge.fs import fs_provider
+    from blaze_tpu.memory import spill as spill_mod
+    _installed.clear()
+    config.set_host_conf_provider(None)
+    context.set_host_task_probe(None)
+    resource.unregister_resolver("udf://")
+    fs_provider.unregister_fallback()
+    spill_mod.set_host_spill_factory(None)
+
+
+def install_from_addresses(version: int, addrs: Dict[str, int]) -> None:
+    """Called by blaze_register_callbacks with raw function addresses."""
+    if version != ABI_VERSION:
+        raise ValueError(f"host callback ABI version {version} != "
+                         f"{ABI_VERSION}")
+    fns = {}
+    for name, addr in addrs.items():
+        if addr:
+            fns[name] = SIGNATURES[name](addr)
+    install(fns)
+
+
+def install(fns: Dict[str, object]) -> None:
+    """Install ctypes-wrapped (or plain python, in tests) callbacks."""
+    _installed.clear()
+    _installed.update(fns)
+    if "conf_get" in fns:
+        _install_conf(fns["conf_get"])
+    if "fs_open" in fns and "fs_read" in fns:
+        _install_fs(fns)
+    if "spill_create" in fns:
+        _install_spill(fns)
+    if "is_task_running" in fns:
+        _install_task_probe(fns["is_task_running"])
+    if "udf_eval" in fns:
+        _install_udf(fns)
+
+
+# ---------------------------------------------------------------------------
+
+def _install_conf(conf_get) -> None:
+    from blaze_tpu import config
+
+    def resolver(key: str) -> Optional[str]:
+        buf = ctypes.create_string_buffer(4096)
+        found = conf_get(key.encode("utf-8"), buf, 4096)
+        if found == 1:
+            return buf.value.decode("utf-8")
+        return None
+
+    config.set_host_conf_provider(resolver)
+
+
+class _HostFile(io.RawIOBase):
+    """Random-access stream over host fs_read callbacks (the
+    FsDataInputWrapper analog)."""
+
+    def __init__(self, fns, fd: int, size: int):
+        self._fns = fns
+        self._fd = fd
+        self._size = size
+        self._pos = 0
+
+    def readable(self):
+        return True
+
+    def seekable(self):
+        return True
+
+    def seek(self, offset, whence=io.SEEK_SET):
+        if whence == io.SEEK_SET:
+            self._pos = offset
+        elif whence == io.SEEK_CUR:
+            self._pos += offset
+        else:
+            self._pos = self._size + offset
+        return self._pos
+
+    def tell(self):
+        return self._pos
+
+    def readinto(self, b):
+        n = len(b)
+        if n == 0:
+            return 0
+        buf = (ctypes.c_uint8 * n)()
+        got = self._fns["fs_read"](self._fd, self._pos, buf, n)
+        if got < 0:
+            raise IOError(f"host fs_read failed at {self._pos}")
+        b[:got] = bytes(buf[:got])
+        self._pos += got
+        return got
+
+    def close(self):
+        if not self.closed and "fs_close" in self._fns:
+            self._fns["fs_close"](self._fd)
+        super().close()
+
+
+def _install_fs(fns) -> None:
+    from blaze_tpu.bridge.fs import CallbackFs, fs_provider
+
+    def open_fn(path: str):
+        fd = fns["fs_open"](path.encode("utf-8"))
+        if fd <= 0:
+            raise FileNotFoundError(f"host fs_open failed for {path!r}")
+        if "fs_size" not in fns:
+            # without a size callback there is no SEEK_END; slurp the
+            # stream into memory so readers that seek from the end
+            # (parquet footers) still work
+            chunks = []
+            pos = 0
+            while True:
+                buf = (ctypes.c_uint8 * (1 << 20))()
+                got = fns["fs_read"](fd, pos, buf, 1 << 20)
+                if got < 0:
+                    raise IOError(f"host fs_read failed for {path!r}")
+                if got == 0:
+                    break
+                chunks.append(bytes(buf[:got]))
+                pos += got
+            if "fs_close" in fns:
+                fns["fs_close"](fd)
+            return io.BytesIO(b"".join(chunks))
+        size = fns["fs_size"](fd)
+        return io.BufferedReader(_HostFile(fns, fd, size))
+
+    def size_fn(path: str) -> int:
+        fd = fns["fs_open"](path.encode("utf-8"))
+        if fd <= 0:
+            raise FileNotFoundError(path)
+        try:
+            return int(fns["fs_size"](fd))
+        finally:
+            if "fs_close" in fns:
+                fns["fs_close"](fd)
+
+    fs_provider.register_fallback(CallbackFs(open_fn, size_fn=size_fn))
+
+
+def _install_spill(fns) -> None:
+    from blaze_tpu.memory import spill as spill_mod
+
+    class HostEngineSpill(spill_mod.Spill):
+        """Spill run stored by the host engine (OnHeapSpill analog,
+        spill.rs:180)."""
+
+        def __init__(self):
+            self._id = int(fns["spill_create"]())
+            if self._id <= 0:
+                # host declined (no on-heap room): local tiers take over
+                raise spill_mod.HostSpillUnavailable(
+                    "host spill_create declined")
+            self._len = 0
+
+        def write_batches(self, batches) -> int:
+            from blaze_tpu.shuffle.ipc import IpcCompressionWriter
+            sink = io.BytesIO()
+            w = IpcCompressionWriter(sink)
+            n = 0
+            for b in batches:
+                n += w.write_batch(b)
+            w.finish()
+            payload = sink.getvalue()
+            buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+            wrote = fns["spill_write"](self._id, buf, len(payload))
+            if wrote != len(payload):
+                raise IOError("host spill_write failed")
+            self._len = len(payload)
+            return n
+
+        def read_batches(self):
+            from blaze_tpu.shuffle.ipc import IpcCompressionReader
+            buf = (ctypes.c_uint8 * self._len)()
+            got = fns["spill_read"](self._id, 0, buf, self._len)
+            if got != self._len:
+                raise IOError("host spill_read failed")
+            yield from IpcCompressionReader(
+                io.BytesIO(bytes(buf))).read_batches()
+
+        def release(self):
+            if "spill_release" in fns:
+                fns["spill_release"](self._id)
+
+        @property
+        def stored_bytes(self) -> int:
+            return self._len
+
+    spill_mod.set_host_spill_factory(HostEngineSpill)
+
+
+def _install_task_probe(is_task_running) -> None:
+    from blaze_tpu.bridge import context
+
+    def probe(stage_id: int, partition_id: int) -> bool:
+        return bool(is_task_running(stage_id, partition_id))
+
+    context.set_host_task_probe(probe)
+
+
+def _install_udf(fns) -> None:
+    from blaze_tpu.bridge import resource
+
+    def factory(key: str):
+        name = key[len("udf://"):]
+
+        def call(*arrays: pa.Array):
+            rb = pa.record_batch(list(arrays),
+                                 names=[f"p{i}"
+                                        for i in range(len(arrays))])
+            sink = io.BytesIO()
+            with pa.ipc.new_stream(sink, rb.schema) as w:
+                w.write_batch(rb)
+            payload = sink.getvalue()
+            buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+            out_p = ctypes.c_void_p()
+            out_len = ctypes.c_int64()
+            rc = fns["udf_eval"](name.encode("utf-8"), buf, len(payload),
+                                 ctypes.byref(out_p),
+                                 ctypes.byref(out_len))
+            if rc != 0 or not out_p.value:
+                raise RuntimeError(f"host udf_eval({name!r}) failed "
+                                   f"rc={rc}")
+            data = ctypes.string_at(out_p.value, out_len.value)
+            if "free_buffer" in fns:
+                fns["free_buffer"](out_p)
+            with pa.ipc.open_stream(io.BytesIO(data)) as r:
+                out_rb = next(iter(r))
+            return out_rb.column(0)
+
+        return call
+
+    resource.register_resolver("udf://", factory)

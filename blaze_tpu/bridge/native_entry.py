@@ -34,6 +34,14 @@ def call_native(task_definition_json: str) -> int:
     return handle
 
 
+def call_native_bytes(task_definition: bytes) -> int:
+    """Raw protobuf TaskDefinition bytes — the preserved wire contract
+    (ref AuronCallNativeWrapper.java:170 getRawTaskDefinition).  The
+    runtime's decoder dispatches on the payload type, so the handle
+    bookkeeping is shared with the JSON entry."""
+    return call_native(task_definition)
+
+
 def next_batch(handle: int) -> Optional[bytes]:
     """Arrow IPC stream bytes for one batch; None = end (ref exec.rs:122)."""
     with _lock:

@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, Optional
 
 _lock = threading.Lock()
 _map: Dict[str, Any] = {}
+_resolvers: Dict[str, Callable[[str], Any]] = {}
 
 
 def put_resource(key: str, value: Any) -> None:
@@ -25,8 +26,29 @@ def put_resource(key: str, value: Any) -> None:
 def get_resource(key: str, remove: bool = False) -> Optional[Any]:
     with _lock:
         if remove:
-            return _map.pop(key, None)
-        return _map.get(key)
+            found = _map.pop(key, None)
+        else:
+            found = _map.get(key)
+        resolvers = list(_resolvers.items()) if found is None else ()
+    if found is not None:
+        return found
+    # prefix resolvers let the host engine lazily materialize resources
+    # (e.g. udf://<name> through the C-ABI udf_eval callback)
+    for prefix, factory in resolvers:
+        if key.startswith(prefix):
+            return factory(key)
+    return None
+
+
+def register_resolver(prefix: str, factory: Callable[[str], Any]) -> None:
+    """Lazy fallback for keys under `prefix` not present in the map."""
+    with _lock:
+        _resolvers[prefix] = factory
+
+
+def unregister_resolver(prefix: str) -> None:
+    with _lock:
+        _resolvers.pop(prefix, None)
 
 
 def get_or_create(key: str, factory: Callable[[], Any]) -> Any:

@@ -88,6 +88,9 @@ class ConfSession:
             for k in (opt.key, *opt.alt_keys):
                 if k in self._overrides:
                     return opt.parse(self._overrides[k])
+        hosted = host_conf_lookup(opt)
+        if hosted is not None:
+            return opt.parse(hosted)
         for k in (opt.key, *opt.alt_keys):
             env_key = "BLAZE_TPU_" + k.upper().replace(".", "_")
             if env_key in os.environ:
@@ -132,6 +135,35 @@ class _Scoped:
 
 #: Global session (the host bridge replaces/overlays this per task).
 conf = ConfSession()
+
+#: Host-engine conf resolver installed through the C-ABI callback surface
+#: (the define_conf! lazy JVM reads, auron-jni-bridge/src/conf.rs:20-63).
+#: Lookups are memoized per key like the reference's lazy proxies — the
+#: cross-ABI round trip must not sit in per-batch hot paths.
+_host_conf_provider: Optional[Callable[[str], Optional[str]]] = None
+_host_conf_cache: Dict[str, Optional[str]] = {}
+
+
+def set_host_conf_provider(fn: Optional[Callable[[str], Optional[str]]]
+                           ) -> None:
+    global _host_conf_provider
+    _host_conf_provider = fn
+    _host_conf_cache.clear()
+
+
+def host_conf_lookup(opt: "ConfigOption") -> Optional[str]:
+    fn = _host_conf_provider
+    if fn is None:
+        return None
+    for k in (opt.key, *opt.alt_keys):
+        if k in _host_conf_cache:
+            v = _host_conf_cache[k]
+        else:
+            v = fn(k)
+            _host_conf_cache[k] = v
+        if v is not None:
+            return v
+    return None
 
 
 def scoped(**kv: Any) -> _Scoped:

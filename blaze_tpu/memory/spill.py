@@ -131,15 +131,36 @@ class FileSpill(Spill):
             return 0
 
 
-_host_spill_budget = threading.Semaphore()  # placeholder; see try_new_spill
+class HostSpillUnavailable(RuntimeError):
+    """The host engine declined a spill allocation (no on-heap room); the
+    local tiers take over.  Any OTHER exception from the host factory is
+    a real bug and propagates."""
+
+
+#: Host-engine spill factory installed via the C-ABI callback surface
+#: (the OnHeapSpillManager inversion: the engine spills INTO host-managed
+#: storage when the host offers it, ref spill.rs:89)
+_host_spill_factory = None
+
+
+def set_host_spill_factory(factory) -> None:
+    global _host_spill_factory
+    _host_spill_factory = factory
 
 
 def try_new_spill(prefer_host: bool = True,
                   host_mem_available: Optional[bool] = None) -> Spill:
     """Choose the spill tier (ref spill.rs:89: on-heap if isOnHeapAvailable,
-    else getDirectWriteSpillToDiskFile).  The RAM tier is capped at
-    auron.onHeapSpill.memoryFraction of the manager budget; past that,
-    runs go straight to disk."""
+    else getDirectWriteSpillToDiskFile).  A host-engine spill manager
+    registered through the C ABI takes precedence; otherwise the RAM tier
+    applies up to auron.onHeapSpill.memoryFraction of the manager budget,
+    past which runs go straight to disk."""
+    factory = _host_spill_factory
+    if factory is not None and prefer_host:
+        try:
+            return factory()
+        except HostSpillUnavailable:
+            pass  # host refused (no capacity): fall through to local tiers
     if host_mem_available is None:
         if prefer_host:
             from blaze_tpu import config

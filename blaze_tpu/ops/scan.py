@@ -25,14 +25,28 @@ from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
 from blaze_tpu.schema import Schema
 
+def open_source(path: str):
+    """Local paths pass through; scheme'd paths (hdfs://, s3://...) open
+    through the registered FsProvider — the host-engine FS callback path
+    (ref hadoop_fs.rs InternalFileReader)."""
+    if "://" in path and not path.startswith("file://"):
+        from blaze_tpu.bridge.fs import fs_provider
+        return fs_provider.provide(path).open(path)
+    return path
+
+
 _META_CACHE: dict = {}
 
 
 def parquet_metadata(path: str):
     """Footer metadata cached across scans and fused-stage bound discovery
     (ref auron.parquet.metadataCacheSize; keyed by path + mtime so
-    rewritten files refresh)."""
+    rewritten files refresh).  Remote paths have no local mtime to
+    invalidate on, so they bypass the cache rather than serve stale
+    footers after an in-place rewrite."""
     import os
+    if "://" in path and not path.startswith("file://"):
+        return pq.ParquetFile(open_source(path)).metadata
     try:
         mtime = os.path.getmtime(path)
     except OSError:
@@ -40,7 +54,7 @@ def parquet_metadata(path: str):
     key = (path, mtime)
     md = _META_CACHE.get(key)
     if md is None:
-        md = pq.ParquetFile(path).metadata
+        md = pq.ParquetFile(open_source(path)).metadata
         limit = max(1, config.PARQUET_METADATA_CACHE_SIZE.get())
         if len(_META_CACHE) >= limit:
             _META_CACHE.pop(next(iter(_META_CACHE)))
@@ -117,7 +131,7 @@ class ParquetScanExec(ExecutionPlan):
     def execute(self, partition: int) -> BatchIterator:
         for path in self._file_groups[partition]:
             try:
-                f = pq.ParquetFile(path)
+                f = pq.ParquetFile(open_source(path))
             except Exception:
                 if config.IGNORE_CORRUPTED_FILES.get():
                     continue

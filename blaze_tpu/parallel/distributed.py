@@ -1,0 +1,126 @@
+"""Multi-host execution: jax.distributed initialization + a cross-process
+host-shuffle service.
+
+Parity mapping (SURVEY §5 distributed-communication backend):
+
+  * INTRA-slice, on-device: mesh collectives over ICI (parallel/mesh.py —
+    psum/all-to-all inside jit).  Multi-HOST meshes come from
+    `init_distributed`, after which `jax.devices()` spans every process
+    and the existing mesh/pjit code runs unchanged — XLA routes
+    collectives over ICI within a slice and DCN across slices.
+  * CROSS-process, host-side: the reference rides Spark's BlockManager /
+    an RSS (shuffle/rss.rs:45).  `HostShuffleService` is that transport
+    with the SAME `.data`/`.index` file contract: every process writes
+    its map outputs into a shared directory (NFS/FUSE/object-store
+    mount), commits with a marker file, and reducers wait for all maps
+    before reading their file segments.  Because the on-disk format is
+    identical to the single-process exchange, a plan does not change
+    shape when it crosses hosts — only the block source does.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+from blaze_tpu.shuffle.reader import FileSegmentBlock
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> int:
+    """Initialize jax.distributed so `jax.devices()` spans all hosts
+    (the NCCL/MPI bootstrap analog; jax reads JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID when args are None).  Returns the
+    global device count."""
+    import jax
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    return len(jax.devices())
+
+
+class HostShuffleService:
+    """Directory-backed cross-process shuffle exchange.
+
+    Layout under `root` for one shuffle:
+        shuffle-<id>-<map>.data / .index   (the AuronShuffleWriterBase
+                                            contract, :46-85)
+        shuffle-<id>-<map>.commit          (map-completion marker; the
+                                            MapStatus analog)
+    """
+
+    def __init__(self, root: str, shuffle_id: str, num_maps: int,
+                 num_reduces: int):
+        self.root = root
+        self.shuffle_id = shuffle_id
+        self.num_maps = num_maps
+        self.num_reduces = num_reduces
+        os.makedirs(root, exist_ok=True)
+
+    # -- map side -----------------------------------------------------------
+    def map_output_paths(self, map_id: int):
+        base = os.path.join(self.root,
+                            f"shuffle-{self.shuffle_id}-{map_id}")
+        return base + ".data", base + ".index"
+
+    def commit_map(self, map_id: int) -> None:
+        """Publish a finished map output (atomic via rename)."""
+        base = os.path.join(self.root,
+                            f"shuffle-{self.shuffle_id}-{map_id}")
+        tmp = base + ".commit.tmp"
+        with open(tmp, "w") as f:
+            f.write("ok")
+        os.replace(tmp, base + ".commit")
+
+    # -- reduce side --------------------------------------------------------
+    def wait_for_maps(self, timeout_s: float = 60.0,
+                      poll_s: float = 0.05) -> None:
+        """Block until every map has committed (the shuffle barrier)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            missing = [m for m in range(self.num_maps)
+                       if not os.path.exists(os.path.join(
+                           self.root,
+                           f"shuffle-{self.shuffle_id}-{m}.commit"))]
+            if not missing:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"shuffle {self.shuffle_id}: maps {missing} not "
+                    f"committed within {timeout_s}s")
+            time.sleep(poll_s)
+
+    def blocks_for(self, reduce_id: int) -> List[FileSegmentBlock]:
+        from blaze_tpu.shuffle.exchange import read_index_file
+        out = []
+        for m in range(self.num_maps):
+            data, index = self.map_output_paths(m)
+            offsets = read_index_file(index)
+            length = offsets[reduce_id + 1] - offsets[reduce_id]
+            if length > 0:
+                out.append(FileSegmentBlock(data, offsets[reduce_id],
+                                            length))
+        return out
+
+    def register_reader(self, resource_id: str) -> None:
+        """Expose this shuffle's blocks through the resource map so
+        IpcReaderExec plans can consume it by id."""
+        from blaze_tpu.bridge.resource import put_resource
+        put_resource(resource_id, self.blocks_for)
+
+    def cleanup(self) -> None:
+        for m in range(self.num_maps):
+            base = os.path.join(self.root,
+                                f"shuffle-{self.shuffle_id}-{m}")
+            for p in (base + ".data", base + ".index", base + ".commit"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass

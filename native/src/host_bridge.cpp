@@ -23,6 +23,7 @@
 // Panic safety: every entry point catches Python exceptions and returns
 // them through `err` (the handle_unwinded_scope analog, exec.rs:50).
 
+#define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
 #include <cstdint>
@@ -88,6 +89,99 @@ PyObject* bridge_module() {
 
 extern "C" {
 
+// ---------------------------------------------------------------------------
+// Host callback surface (the ~20-callback JNI static surface analog,
+// ref auron-core/.../jni/JniBridge.java:57+ getResource/conf getters/
+// openFileAsDataInputWrapper/getTaskOnHeapSpillManager/isTaskRunning/
+// getAuronUDFWrapperContext).  The host registers C function pointers
+// once per process; the engine calls back through them for conf values,
+// filesystem reads, on-host spill storage, task liveness, and UDF eval.
+// ---------------------------------------------------------------------------
+
+typedef struct BlazeHostCallbacks {
+  int64_t version;  // ABI version, currently 1
+  // conf: 1 = found (value written to buf, NUL-terminated, truncated to
+  // cap), 0 = not set
+  int64_t (*conf_get)(const char* key, char* buf, int64_t cap);
+  // filesystem (ref hadoop_fs.rs FsDataInputWrapper): open -> fd > 0 or
+  // -1; read at offset -> bytes read or -1
+  int64_t (*fs_open)(const char* path);
+  int64_t (*fs_size)(int64_t fd);
+  int64_t (*fs_read)(int64_t fd, int64_t offset, uint8_t* buf,
+                     int64_t len);
+  void (*fs_close)(int64_t fd);
+  // on-host spill storage (ref OnHeapSpillManager.java:25): create -> id,
+  // write appends, read at offset, release frees
+  int64_t (*spill_create)(void);
+  int64_t (*spill_write)(int64_t id, const uint8_t* buf, int64_t len);
+  int64_t (*spill_read)(int64_t id, int64_t offset, uint8_t* buf,
+                        int64_t len);
+  void (*spill_release)(int64_t id);
+  // cooperative cancel probe (ref JniBridge.isTaskRunning)
+  int32_t (*is_task_running)(int64_t stage_id, int64_t partition_id);
+  // UDF fallback eval (ref spark_udf_wrapper.rs:207-226): args and result
+  // are Arrow IPC stream bytes; host mallocs *out, engine frees it with
+  // free_buffer.  returns 0 on success.
+  int64_t (*udf_eval)(const char* name, const uint8_t* args_ipc,
+                      int64_t args_len, uint8_t** out_ipc,
+                      int64_t* out_len);
+  void (*free_buffer)(void* p);
+} BlazeHostCallbacks;
+
+// Register the callback table; pointers must stay valid for the process
+// lifetime.  Null entries disable the corresponding capability.
+int64_t blaze_register_callbacks(const BlazeHostCallbacks* cbs,
+                                 char** err) {
+  ensure_python();
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("blaze_tpu.bridge.host_callbacks");
+  if (!mod) {
+    if (err) *err = dup_cstr(fetch_error());
+    return -1;
+  }
+  PyObject* d = PyDict_New();
+  if (!d) {
+    Py_DECREF(mod);
+    if (err) *err = dup_cstr(fetch_error());
+    return -1;
+  }
+#define BLAZE_PUT(name)                                             \
+  do {                                                              \
+    PyObject* v = PyLong_FromVoidPtr((void*)(cbs->name));           \
+    if (!v || PyDict_SetItemString(d, #name, v) != 0) {             \
+      Py_XDECREF(v);                                                \
+      Py_DECREF(d);                                                 \
+      Py_DECREF(mod);                                               \
+      if (err) *err = dup_cstr(fetch_error());                      \
+      return -1;                                                    \
+    }                                                               \
+    Py_DECREF(v); /* SetItemString does not steal */                \
+  } while (0)
+  BLAZE_PUT(conf_get);
+  BLAZE_PUT(fs_open);
+  BLAZE_PUT(fs_size);
+  BLAZE_PUT(fs_read);
+  BLAZE_PUT(fs_close);
+  BLAZE_PUT(spill_create);
+  BLAZE_PUT(spill_write);
+  BLAZE_PUT(spill_read);
+  BLAZE_PUT(spill_release);
+  BLAZE_PUT(is_task_running);
+  BLAZE_PUT(udf_eval);
+  BLAZE_PUT(free_buffer);
+#undef BLAZE_PUT
+  PyObject* r = PyObject_CallMethod(mod, "install_from_addresses", "LO",
+                                    (long long)cbs->version, d);
+  Py_DECREF(d);
+  Py_DECREF(mod);
+  if (!r) {
+    if (err) *err = dup_cstr(fetch_error());
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
 // Create a runtime for one task; returns handle > 0, or 0 with *err set.
 int64_t blaze_call_native(const char* task_definition_json, char** err) {
   ensure_python();
@@ -99,6 +193,31 @@ int64_t blaze_call_native(const char* task_definition_json, char** err) {
   }
   PyObject* r = PyObject_CallMethod(mod, "call_native", "s",
                                     task_definition_json);
+  Py_DECREF(mod);
+  if (!r) {
+    *err = dup_cstr(fetch_error());
+    return 0;
+  }
+  int64_t handle = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return handle;
+}
+
+// Same as blaze_call_native but with raw protobuf TaskDefinition bytes —
+// the preserved wire contract (ref getRawTaskDefinition,
+// AuronCallNativeWrapper.java:170 / rt.rs:79-90).
+int64_t blaze_call_native_proto(const uint8_t* task_definition,
+                                int64_t len, char** err) {
+  ensure_python();
+  Gil gil;
+  PyObject* mod = bridge_module();
+  if (!mod) {
+    *err = dup_cstr(fetch_error());
+    return 0;
+  }
+  PyObject* r = PyObject_CallMethod(mod, "call_native_bytes", "y#",
+                                    (const char*)task_definition,
+                                    (Py_ssize_t)len);
   Py_DECREF(mod);
   if (!r) {
     *err = dup_cstr(fetch_error());
