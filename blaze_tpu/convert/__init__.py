@@ -1,0 +1,7 @@
+"""L6 plan-translation layer (the AuronConvertStrategy + AuronConverters +
+NativeConverters analog)."""
+
+from blaze_tpu.convert.spark import (ConversionError, ConversionResult,
+                                     convert_spark_plan)
+
+__all__ = ["ConversionError", "ConversionResult", "convert_spark_plan"]

