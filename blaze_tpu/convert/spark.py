@@ -196,7 +196,7 @@ _BINARY_OPS = {
     "And": "and", "Or": "or", "EqualTo": "==", "EqualNullSafe": "<=>",
     "LessThan": "<", "LessThanOrEqual": "<=", "GreaterThan": ">",
     "GreaterThanOrEqual": ">=", "Add": "+", "Subtract": "-",
-    "Multiply": "*", "Divide": "/", "Remainder": "%", "Pmod": "%",
+    "Multiply": "*", "Divide": "/", "Remainder": "%", "Pmod": "pmod",
 }
 
 # Catalyst expression class -> engine scalar_function name
@@ -592,13 +592,19 @@ def _convert_agg(node: dict, parts: int, log: List[str]
             raise ConversionError(_cls(ae),
                                   "expected AggregateExpression")
         mode_raw = str(ae.get("mode", "Partial"))
-        mode = ("partial" if "Partial" in mode_raw and
-                "Merge" not in mode_raw else
-                "partial_merge" if "PartialMerge" in mode_raw else
-                "final" if "Final" in mode_raw else None)
+        mode = ("partial_merge" if "PartialMerge" in mode_raw else
+                "partial" if "Partial" in mode_raw else
+                "final" if "Final" in mode_raw else
+                "complete" if "Complete" in mode_raw else None)
         if mode is None:
             raise ConversionError(c, f"unsupported agg mode {mode_raw!r}")
         modes.add(mode)
+        if len(modes) > 1:
+            # Spark distinct-aggregation stages mix modes in one node;
+            # the positional acc layout below assumes uniformity
+            raise ConversionError(
+                c, f"mixed aggregate modes {sorted(modes)} in one node "
+                   f"are not convertible")
         fn_node = ae["__children"][0]
         fn_cls = _cls(fn_node)
         fn = _AGG_FNS.get(fn_cls)
@@ -609,7 +615,7 @@ def _convert_agg(node: dict, parts: int, log: List[str]
         result_id = int((ae.get("resultId") or {}).get("id", -1))
         name = f"{fn}_{result_id}"
         nacc = _ACC_COUNTS[fn]
-        if mode == "partial":
+        if mode in ("partial", "complete"):
             args = [convert_expr(a, scope)
                     for a in fn_node["__children"]]
         else:
@@ -623,12 +629,21 @@ def _convert_agg(node: dict, parts: int, log: List[str]
         out_names.append(name)
 
     kind = "sort_agg" if c == "SortAggregateExec" else "hash_agg"
-    d = {"kind": kind, "input": child, "groupings": groupings,
-         "aggs": aggs}
-    # result scope: grouping attrs keep their ids; agg outputs use the
-    # AggregateExpression resultId (what downstream attrs reference)
+    d: Dict[str, Any] = {"kind": kind, "input": child,
+                         "groupings": groupings, "aggs": aggs}
+    # the physical output layout is [groups..., agg values...]; grouping
+    # attrs keep their exprIds, agg outputs take the AggregateExpression
+    # resultId (what downstream attrs reference)
+    phys = Scope(out_ids, out_names)
     if result_attrs and all(_cls(a) == "AttributeReference"
                             for a in result_attrs):
         ids, names = _attrs_of(result_attrs)
+        if ids != phys.ids:
+            # resultExpressions reorder the output: emit the projection
+            # Spark folds into the aggregate, else parents bind wrong
+            # physical columns
+            d = {"kind": "project", "input": d,
+                 "exprs": [phys.bind(i, n) for i, n in zip(ids, names)],
+                 "names": names}
         return d, Scope(ids, names)
-    return d, Scope(out_ids, out_names)
+    return d, phys

@@ -83,31 +83,35 @@ def _try_fuse_agg(node: ExecutionPlan) -> Optional["FusedPartialAggExec"]:
     in_schema = child.schema
 
     modes = {m for _, m, _ in aggs}
-    if modes == {AggMode.PARTIAL}:
-        complete = False
-    elif modes == {AggMode.COMPLETE}:
-        complete = True
-    else:
+    if len(modes) != 1:
         return None
+    mode = next(iter(modes))
+    complete = mode in (AggMode.COMPLETE, AggMode.FINAL)
+    merging = mode in (AggMode.PARTIAL_MERGE, AggMode.FINAL)
 
-    specs: List[Tuple[str, Optional[PhysicalExpr]]] = []
+    specs: List[Tuple[str, str, Optional[PhysicalExpr]]] = []
     for fn, _m, _name in aggs:
         if isinstance(fn, SumAgg):
-            kind = "sum"
+            out_kind = "sum"
         elif isinstance(fn, CountAgg):
-            kind = "count"
+            out_kind = "count"
         elif isinstance(fn, MinMaxAgg):
-            kind = fn.name  # "min" | "max"
+            out_kind = fn.name  # "min" | "max"
         else:
             return None
         arg = fn.children[0] if fn.children else None
+        if merging and arg is None:
+            return None  # merge modes must reference their acc column
         if arg is not None and not arg.data_type(in_schema).is_fixed_width:
             return None
-        if kind in ("sum", "min", "max"):
+        if out_kind in ("sum", "min", "max"):
             if arg is None or not (arg.data_type(in_schema).is_integer or
                                    arg.data_type(in_schema).is_floating):
                 return None
-        specs.append((kind, arg))
+        # merging counts SUMS the partial counts
+        reduce_kind = "sum" if (merging and out_kind == "count") \
+            else out_kind
+        specs.append((reduce_kind, out_kind, arg))
 
     key_types = [e.data_type(in_schema) for e, _ in groups]
     if not all(t.is_fixed_width for t in key_types):
@@ -123,9 +127,11 @@ def _try_fuse_agg(node: ExecutionPlan) -> Optional["FusedPartialAggExec"]:
                 total *= (hi - lo + 2)
             if total > config.FUSED_STAGE_CAPACITY.get():
                 ranges = None
-    if ranges is None and complete:
-        return None  # sorted path may overflow into pass-through partials
-    return FusedPartialAggExec(child, groups, aggs, specs, ranges, complete)
+    # the sorted path handles overflow two ways: PARTIAL degrades to
+    # pass-through (downstream re-merges); exact modes GROW the table
+    grow = complete or merging
+    return FusedPartialAggExec(child, groups, aggs, specs, ranges,
+                               complete, grow)
 
 
 def _discover_ranges(child: ExecutionPlan,
@@ -224,15 +230,16 @@ class FusedPartialAggExec(ExecutionPlan):
     keys: same output schema, single-XLA-program loop body."""
 
     def __init__(self, child: ExecutionPlan, group_exprs, aggs,
-                 specs: Sequence[Tuple[str, Optional[PhysicalExpr]]],
+                 specs: Sequence[Tuple[str, str, Optional[PhysicalExpr]]],
                  ranges: Optional[List[Tuple[int, int]]],
-                 complete: bool):
+                 complete: bool, grow: bool = False):
         super().__init__([child])
         self._group_exprs = list(group_exprs)
         self._aggs = list(aggs)
-        self._specs = list(specs)
+        self._specs = list(specs)  # (reduce_kind, out_kind, arg)
         self._ranges = ranges
         self._complete = complete
+        self._grow = grow  # exact modes grow the table instead of skipping
         self._in_schema = child.schema
         self._out_schema = self._build_schema()
 
@@ -272,7 +279,7 @@ class FusedPartialAggExec(ExecutionPlan):
         num_slots = 1
         for lo, hi in self._ranges:
             num_slots *= (hi - lo + 2)
-        kinds = [k for k, _ in self._specs]
+        kinds = [rk for rk, _ok, _a in self._specs]
         carry = None
         n_batches = 0
         for batch in self.children[0].execute(partition):
@@ -318,7 +325,7 @@ class FusedPartialAggExec(ExecutionPlan):
     # -- sorted: carry table + per-batch overflow check --------------------
     def _execute_sorted(self, partition: int) -> BatchIterator:
         carry_slots = config.ON_DEVICE_AGG_CAPACITY.get()
-        kinds = [k for k, _ in self._specs]
+        kinds = [rk for rk, _ok, _a in self._specs]
         merge_kinds = ["sum" if k == "count" else k for k in kinds]
         carry = None
         skipping = False
@@ -341,7 +348,20 @@ class FusedPartialAggExec(ExecutionPlan):
             # num_groups counts ALL boundaries even past the slot cap, and
             # merged >= per-batch count, so this ONE scalar sync per batch
             # covers both the batch table and the merge
-            if int(merged.num_groups) > carry_slots:
+            while int(merged.num_groups) > carry_slots:
+                if not self._grow:
+                    merged = None
+                    break
+                # exact modes (final/merge/complete) DOUBLE the table and
+                # re-merge — both inputs are still intact and lossless
+                carry_slots *= 2
+                self.metrics.add("table_grown", 1)
+                if carry is None:
+                    merged = _resize_table(table, merge_kinds, carry_slots)
+                else:
+                    merged = _merge_tables(carry, table, merge_kinds,
+                                           carry_slots)
+            if merged is None:
                 # degrade to pass-through partials
                 # (ref AGG_TRIGGER_PARTIAL_SKIPPING, agg_table.rs:108-122)
                 skipping = True
@@ -382,7 +402,7 @@ class FusedPartialAggExec(ExecutionPlan):
             kd.append(dv.data)
             kv.append(dv.validity)
         ad, av = [], []
-        for kind, arg in self._specs:
+        for _rk, _ok, arg in self._specs:
             if arg is None:
                 ad.append(None)
                 av.append(None)
@@ -400,9 +420,10 @@ class FusedPartialAggExec(ExecutionPlan):
         for (kd, kv), f in zip(keys, out_arrow):
             arrays.append(_to_arrow(kd, kv, f.type))
             i += 1
-        for (kind, _arg), a, v in zip(self._specs, accs, avalid):
+        for (_rk, out_kind, _arg), a, v in zip(self._specs, accs, avalid):
             f = out_arrow.field(i)
-            if kind == "count":
+            if out_kind == "count":
+                # count never nulls, whether counted or summed from accs
                 arrays.append(_to_arrow(a, np.ones(n, dtype=bool), f.type))
             else:
                 arrays.append(_to_arrow(a, v, f.type))

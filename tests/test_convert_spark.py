@@ -227,3 +227,71 @@ def test_wrappers_are_transparent(tmp_path):
     assert res.plan["kind"] == "parquet_scan"
     got = _run(res.plan)
     assert got["x"].tolist() == [1, 2]
+
+
+class TestReviewRegressions:
+    def test_reordered_result_expressions_emit_projection(self, tmp_path):
+        # resultExpressions [sum#10, k#1] vs physical [k, sum]: a parent
+        # binding sum#10 must get the SUMS, not the keys
+        t = pa.table({"k": pa.array([1, 1, 2], type=pa.int64()),
+                      "v": pa.array([10.0, 20.0, 5.0])})
+        files = _write(tmp_path, t)
+        k, v = attr("k", "long", 1), attr("v", "double", 2)
+        agg = plan_node(
+            "aggregate.HashAggregateExec",
+            {"groupingExpressions": [attr("k", "long", 1)],
+             "aggregateExpressions": [agg_expr("Sum",
+                                               attr("v", "double", 2),
+                                               "Complete", 10)],
+             "resultExpressions": [attr("s", "double", 10),
+                                   attr("k", "long", 1)]},
+            [scan_node([k[0], v[0]], files)])
+        top = plan_node("ProjectExec",
+                        {"projectList": [attr("s", "double", 10)]},
+                        [agg])
+        res = convert_spark_plan(top)
+        got = _run(res.plan)
+        assert sorted(got.iloc[:, 0].tolist()) == [5.0, 30.0]
+
+    def test_pmod_maps_to_spark_pmod(self, tmp_path):
+        t = pa.table({"x": pa.array([-7, 7], type=pa.int64())})
+        files = _write(tmp_path, t)
+        plan = plan_node(
+            "ProjectExec",
+            {"projectList": [alias(binexpr("Pmod", attr("x", "long", 1),
+                                           lit("3", "long")), "m", 2)]},
+            [scan_node([attr("x", "long", 1)[0]], files)])
+        res = convert_spark_plan(plan)
+        got = _run(res.plan)
+        assert got["m"].tolist() == [2, 1]  # Spark pmod, not Java %
+
+    def test_complete_mode_converts(self, tmp_path):
+        t = pa.table({"k": pa.array([1, 1, 2], type=pa.int64()),
+                      "v": pa.array([1.0, 2.0, 3.0])})
+        files = _write(tmp_path, t)
+        agg = plan_node(
+            "aggregate.HashAggregateExec",
+            {"groupingExpressions": [attr("k", "long", 1)],
+             "aggregateExpressions": [agg_expr("Sum",
+                                               attr("v", "double", 2),
+                                               "Complete", 10)]},
+            [scan_node([attr("k", "long", 1)[0],
+                        attr("v", "double", 2)[0]], files)])
+        res = convert_spark_plan(agg)
+        got = _run(res.plan).sort_values("k")
+        assert got.iloc[:, 1].tolist() == [3.0, 3.0]
+
+    def test_mixed_agg_modes_rejected(self, tmp_path):
+        t = pa.table({"k": pa.array([1], type=pa.int64()),
+                      "v": pa.array([1.0])})
+        files = _write(tmp_path, t)
+        agg = plan_node(
+            "aggregate.HashAggregateExec",
+            {"groupingExpressions": [attr("k", "long", 1)],
+             "aggregateExpressions": [
+                 agg_expr("Sum", attr("v", "double", 2), "Partial", 10),
+                 agg_expr("Sum", None, "PartialMerge", 11)]},
+            [scan_node([attr("k", "long", 1)[0],
+                        attr("v", "double", 2)[0]], files)])
+        with pytest.raises(ConversionError, match="mixed aggregate modes"):
+            convert_spark_plan(agg)

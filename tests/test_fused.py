@@ -179,13 +179,67 @@ class TestEligibility:
                       [(make_agg("avg", [col(3)]), AggMode.PARTIAL, "a")])
         assert not isinstance(fuse_plan(agg), FusedPartialAggExec)
 
-    def test_merge_modes_not_fused(self):
+    def test_mixed_modes_not_fused(self):
         t = _table(n=100)
         agg = AggExec(MemoryScanExec.from_arrow(t),
                       [(col(2, "store"), "store")],
-                      [(make_agg("sum", [col(3)]), AggMode.PARTIAL_MERGE,
-                        "s")])
+                      [(make_agg("sum", [col(3)]), AggMode.PARTIAL, "s"),
+                       (make_agg("count", [col(3)]), AggMode.FINAL, "c")])
         assert not isinstance(fuse_plan(agg), FusedPartialAggExec)
+
+
+class TestMergeModeFusion:
+    def _two_stage(self, t, partitions=2):
+        partial = AggExec(MemoryScanExec.from_arrow(t),
+                          [(col(1, "cust"), "cust")],
+                          [(make_agg("sum", [col(3)]), AggMode.PARTIAL,
+                            "s"),
+                           (make_agg("count", [col(3)]), AggMode.PARTIAL,
+                            "c")])
+        ex = LocalShuffleExchange(partial,
+                                  HashPartitioning([col(0)], partitions))
+        final = AggExec(ex, [(col(0, "cust"), "cust")],
+                        [(make_agg("sum", [col(1)]), AggMode.FINAL, "s"),
+                         (make_agg("count", [col(2)]), AggMode.FINAL,
+                          "c")])
+        return final
+
+    def test_final_mode_fuses_and_matches_pandas(self):
+        t = _table(n=6000)
+        plan = fuse_plan(self._two_stage(t))
+        assert isinstance(plan, FusedPartialAggExec)
+        assert plan.fused_mode == "sorted"
+        out = []
+        for p in range(plan.num_partitions):
+            out.extend(b.compact().to_arrow() for b in plan.execute(p))
+        got = pa.Table.from_batches([b for b in out if b.num_rows]) \
+            .to_pandas().sort_values("cust").reset_index(drop=True)
+        want = t.to_pandas().groupby("cust", as_index=False).agg(
+            s=("amt", "sum"), c=("amt", "count")) \
+            .sort_values("cust").reset_index(drop=True)
+        assert len(got) == len(want)
+        np.testing.assert_allclose(got.s.to_numpy(), want.s.to_numpy(),
+                                   rtol=1e-9)
+        assert (got.c.to_numpy() == want.c.to_numpy()).all()
+
+    def test_final_mode_grows_instead_of_skipping(self):
+        t = _table(n=6000)  # ~200 distinct cust per partition
+        config.conf.set(config.ON_DEVICE_AGG_CAPACITY.key, 16)
+        try:
+            plan = fuse_plan(self._two_stage(t, partitions=1))
+            assert isinstance(plan, FusedPartialAggExec)
+            out = [b.compact().to_arrow() for b in plan.execute(0)]
+            got = pa.Table.from_batches([b for b in out if b.num_rows]) \
+                .to_pandas().sort_values("cust").reset_index(drop=True)
+            assert plan.metrics.get("table_grown") >= 1
+            assert plan.metrics.get("partial_skipped") == 0
+        finally:
+            config.conf.unset(config.ON_DEVICE_AGG_CAPACITY.key)
+        want = t.to_pandas().groupby("cust", as_index=False).agg(
+            s=("amt", "sum")).sort_values("cust").reset_index(drop=True)
+        assert len(got) == len(want)
+        np.testing.assert_allclose(got.s.to_numpy(), want.s.to_numpy(),
+                                   rtol=1e-9)
 
     def test_config_gate(self):
         t = _table(n=100)
@@ -208,5 +262,7 @@ class TestEligibility:
                         [(make_agg("sum", [col(2)]), AggMode.PARTIAL_MERGE,
                           "amt_sum")])
         top = fuse_plan(final)
-        assert isinstance(top, AggExec)
+        # both stages fuse now: the top-level PARTIAL_MERGE and the inner
+        # PARTIAL under the exchange
+        assert isinstance(top, FusedPartialAggExec)
         assert isinstance(ex.children[0], FusedPartialAggExec)
