@@ -462,6 +462,24 @@ def _convert_node(node: dict, parts: int, log: List[str]
              "BroadcastHashJoinExec"):
         return _convert_join(node, parts, log)
 
+    if c == "BroadcastNestedLoopJoinExec":
+        _gate("bnlj", c)
+        left, lscope = _convert_node(ch[0], parts, log)
+        right, rscope = _convert_node(ch[1], parts, log)
+        import uuid
+        jt = _parse_join_type(node, c)
+        d: Dict[str, Any] = {"kind": "broadcast_nested_loop_join",
+                             "left": left, "right": right,
+                             "join_type": jt,
+                             "build_side": _parse_build_side(node),
+                             "broadcast_id":
+                                 f"conv-{uuid.uuid4().hex[:10]}"}
+        cond = _expr_tree(node.get("condition"))
+        if cond is not None:
+            d["join_filter"] = convert_expr(cond,
+                                            Scope.concat(lscope, rscope))
+        return d, _join_output_scope(jt, lscope, rscope)
+
     if c in ("HashAggregateExec", "ObjectHashAggregateExec",
              "SortAggregateExec"):
         return _convert_agg(node, parts, log)
@@ -508,6 +526,28 @@ _JOIN_TYPES = {
 }
 
 
+def _parse_join_type(node: dict, node_class: str) -> str:
+    jt_raw = str(node.get("joinType", "Inner"))
+    for k, v in _JOIN_TYPES.items():
+        if jt_raw.startswith(k):
+            return v
+    raise ConversionError(node_class, f"unsupported join type {jt_raw!r}")
+
+
+def _parse_build_side(node: dict) -> str:
+    return "left" if "Left" in str(node.get("buildSide", "BuildRight")) \
+        else "right"
+
+
+def _join_output_scope(jt: str, lscope: Scope, rscope: Scope) -> Scope:
+    """Output attributes per Spark join semantics."""
+    if jt in ("left_semi", "left_anti"):
+        return lscope
+    if jt == "existence":
+        return Scope(lscope.ids + [-2], lscope.names + ["exists"])
+    return Scope.concat(lscope, rscope)
+
+
 def _convert_join(node: dict, parts: int, log: List[str]
                   ) -> Tuple[Dict[str, Any], Scope]:
     c = _cls(node)
@@ -517,14 +557,7 @@ def _convert_join(node: dict, parts: int, log: List[str]
     ch = node["__children"]
     left, lscope = _convert_node(ch[0], parts, log)
     right, rscope = _convert_node(ch[1], parts, log)
-    jt_raw = str(node.get("joinType", "Inner"))
-    jt = None
-    for k, v in _JOIN_TYPES.items():
-        if jt_raw.startswith(k):
-            jt = v
-            break
-    if jt is None:
-        raise ConversionError(c, f"unsupported join type {jt_raw!r}")
+    jt = _parse_join_type(node, c)
     lkeys = [convert_expr(e, lscope)
              for e in _expr_list(node.get("leftKeys"))]
     rkeys = [convert_expr(e, rscope)
@@ -535,8 +568,7 @@ def _convert_join(node: dict, parts: int, log: List[str]
                          "left_keys": lkeys, "right_keys": rkeys,
                          "join_type": jt}
     if op in ("shj", "bhj"):
-        build = str(node.get("buildSide", "BuildRight"))
-        d["build_side"] = "left" if "Left" in build else "right"
+        d["build_side"] = _parse_build_side(node)
     if op == "bhj":
         import uuid
         d["broadcast_id"] = f"conv-{uuid.uuid4().hex[:10]}"
@@ -544,14 +576,7 @@ def _convert_join(node: dict, parts: int, log: List[str]
     if cond is not None:
         _gate("native.join.condition", c)
         d["join_filter"] = convert_expr(cond, Scope.concat(lscope, rscope))
-    # output scope per Spark join semantics
-    if jt == "left_semi" or jt == "left_anti":
-        out = lscope
-    elif jt == "existence":
-        out = Scope(lscope.ids + [-2], lscope.names + ["exists"])
-    else:
-        out = Scope.concat(lscope, rscope)
-    return d, out
+    return d, _join_output_scope(jt, lscope, rscope)
 
 
 _AGG_FNS = {

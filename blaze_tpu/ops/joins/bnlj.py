@@ -1,0 +1,255 @@
+"""Broadcast nested-loop join: non-equi joins over a broadcast side.
+
+Parity: Spark's BroadcastNestedLoopJoinExec, which the reference gates
+behind `auron.enable.bnlj` (SparkAuronConfiguration).  There is no keyed
+probe: every probe row pairs with every build row through the condition,
+chunked so the cross-product never materializes at once (same discipline
+as the SMJ run merge)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu import config
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import PhysicalExpr
+from blaze_tpu.ops.base import BatchIterator, CoalesceStream, ExecutionPlan
+from blaze_tpu.ops.joins.exec import JoinType, _null_out
+from blaze_tpu.schema import BOOL, Field, Schema
+
+
+class BroadcastNestedLoopJoinExec(ExecutionPlan):
+
+    def __init__(self, left: ExecutionPlan, right: ExecutionPlan,
+                 join_type: JoinType, build_side: str = "right",
+                 join_filter: Optional[PhysicalExpr] = None,
+                 existence_col: str = "exists",
+                 broadcast_id: Optional[str] = None):
+        super().__init__([left, right])
+        assert build_side in ("left", "right")
+        if join_type == JoinType.EXISTENCE and build_side != "right":
+            # existence output carries LEFT rows + flag; probing the left
+            # side requires the build on the right (Spark's BNLJ imposes
+            # the same restriction)
+            raise ValueError("existence BNLJ requires build_side='right'")
+        self.join_type = join_type
+        self.build_side = build_side
+        self.join_filter = join_filter
+        self._existence_col = existence_col
+        self._broadcast_id = broadcast_id or f"bnlj-{id(self)}"
+        self._out_schema = self._build_schema()
+        # matched-build state is shared across probe partitions (Spark
+        # unions matchedBroadcastRows); the LAST partition to finish
+        # emits the unmatched build rows
+        import threading
+        self._state_lock = threading.Lock()
+        self._build_matched: Optional[np.ndarray] = None
+        self._pending_partitions: Optional[set] = None
+
+    def _build_schema(self) -> Schema:
+        l, r = self.children[0].schema, self.children[1].schema
+        jt = self.join_type
+        if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            return l
+        if jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI):
+            return r
+        if jt == JoinType.EXISTENCE:
+            return Schema(list(l) + [Field(self._existence_col, BOOL,
+                                           False)])
+        fields = []
+        for f in l:
+            nullable = f.nullable or jt in (JoinType.RIGHT, JoinType.FULL)
+            fields.append(Field(f.name, f.data_type, nullable))
+        for f in r:
+            nullable = f.nullable or jt in (JoinType.LEFT, JoinType.FULL)
+            fields.append(Field(f.name, f.data_type, nullable))
+        return Schema(fields)
+
+    @property
+    def schema(self) -> Schema:
+        return self._out_schema
+
+    @property
+    def num_partitions(self) -> int:
+        probe = 0 if self.build_side == "right" else 1
+        return self.children[probe].num_partitions
+
+    def _collect_build(self) -> pa.Table:
+        from blaze_tpu.bridge.resource import get_or_create
+
+        def factory() -> pa.Table:
+            build = 1 if self.build_side == "right" else 0
+            child = self.children[build]
+            batches: List[pa.RecordBatch] = []
+            for p in range(child.num_partitions):
+                batches.extend(b.compact().to_arrow()
+                               for b in child.execute(p))
+            batches = [b for b in batches if b.num_rows]
+            if not batches:
+                return pa.Table.from_batches(
+                    [], schema=child.schema.to_arrow())
+            return pa.Table.from_batches(batches).combine_chunks()
+
+        # built once per broadcast, shared by every probe partition
+        # (the cached_build_hash_map pattern, broadcast_join_exec.rs:695)
+        return get_or_create(f"bnlj://{self._broadcast_id}", factory)
+
+    def execute(self, partition: int) -> BatchIterator:
+        build_tbl = self._collect_build()
+        probe_is_left = self.build_side == "right"
+        probe = self.children[0 if probe_is_left else 1]
+        with self._state_lock:
+            if self._build_matched is None:
+                self._build_matched = np.zeros(build_tbl.num_rows,
+                                               dtype=bool)
+                self._pending_partitions = set(range(self.num_partitions))
+        build_matched = self._build_matched
+
+        def gen():
+            for batch in probe.execute(partition):
+                batch = batch.compact()
+                if batch.num_rows == 0:
+                    continue
+                yield from self._join_batch(batch.to_arrow(), build_tbl,
+                                            build_matched, probe_is_left)
+            with self._state_lock:
+                self._pending_partitions.discard(partition)
+                last = not self._pending_partitions
+            if last:
+                yield from self._emit_unmatched_build(
+                    build_tbl, build_matched, probe_is_left)
+        return iter(CoalesceStream(gen(), metrics=self.metrics))
+
+    # ------------------------------------------------------------------
+    def _pairs(self, probe_rb: pa.RecordBatch, build_tbl: pa.Table):
+        """Chunked (p_idx, b_idx, keep) over the cross product."""
+        pn, bn = probe_rb.num_rows, build_tbl.num_rows
+        if bn == 0:
+            return
+        bs = config.BATCH_SIZE.get()
+        block = max(1, bs // bn)
+        for ps in range(0, pn, block):
+            pe = min(ps + block, pn)
+            p_idx = np.repeat(np.arange(ps, pe, dtype=np.int64), bn)
+            b_idx = np.tile(np.arange(bn, dtype=np.int64), pe - ps)
+            if self.join_filter is None:
+                yield p_idx, b_idx
+                continue
+            rb = self._joined(probe_rb, build_tbl, p_idx, b_idx)
+            cb = ColumnBatch.from_arrow(rb)
+            keep = np.asarray(
+                self.join_filter.evaluate(cb).as_mask(cb))[:rb.num_rows]
+            yield p_idx[keep], b_idx[keep]
+
+    def _joined(self, probe_rb, build_tbl, p_idx, b_idx) -> pa.RecordBatch:
+        pt = probe_rb.take(pa.array(p_idx, type=pa.int64()))
+        if build_tbl.num_rows:
+            bt = build_tbl.take(pa.array(np.where(b_idx < 0, 0, b_idx),
+                                         type=pa.int64()))
+            bt_cols = [c.combine_chunks() for c in bt.columns]
+            if (b_idx < 0).any():
+                mask = b_idx < 0
+                bt_cols = [_null_out(c, mask) for c in bt_cols]
+        else:
+            build_schema = self.children[
+                1 if self.build_side == "right" else 0].schema
+            bt_cols = [pa.nulls(len(b_idx), f.data_type.to_arrow())
+                       for f in build_schema]
+        probe_is_left = self.build_side == "right"
+        left_cols = list(pt.columns) if probe_is_left else bt_cols
+        right_cols = bt_cols if probe_is_left else list(pt.columns)
+        l, r = self.children[0].schema, self.children[1].schema
+        return pa.RecordBatch.from_arrays(
+            [a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
+             for a in left_cols + right_cols],
+            schema=pa.schema([f.to_arrow() for f in l] +
+                             [f.to_arrow() for f in r]))
+
+    def _project_out(self, rb: pa.RecordBatch) -> ColumnBatch:
+        out_arrow = self.schema.to_arrow()
+        arrays = [col.cast(f.type, safe=False)
+                  if not col.type.equals(f.type) else col
+                  for col, f in zip(rb.columns, out_arrow)]
+        out = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
+        self.metrics.add("output_rows", out.num_rows)
+        return ColumnBatch.from_arrow(out)
+
+    def _join_batch(self, probe_rb, build_tbl, build_matched,
+                    probe_is_left) -> Iterator[ColumnBatch]:
+        jt = self.join_type
+        pn = probe_rb.num_rows
+        probe_matched = np.zeros(pn, dtype=bool)
+        pair_emitting = jt in (JoinType.INNER, JoinType.LEFT,
+                               JoinType.RIGHT, JoinType.FULL)
+        for p_idx, b_idx in self._pairs(probe_rb, build_tbl):
+            probe_matched[p_idx] = True
+            build_matched[b_idx] = True
+            if pair_emitting and len(p_idx):
+                yield self._project_out(
+                    self._joined(probe_rb, build_tbl, p_idx, b_idx))
+
+        probe_semi = ((jt == JoinType.LEFT_SEMI and probe_is_left) or
+                      (jt == JoinType.RIGHT_SEMI and not probe_is_left))
+        probe_anti = ((jt == JoinType.LEFT_ANTI and probe_is_left) or
+                      (jt == JoinType.RIGHT_ANTI and not probe_is_left))
+        if probe_semi or probe_anti:
+            keep = np.nonzero(probe_matched if probe_semi
+                              else ~probe_matched)[0]
+            if len(keep):
+                yield ColumnBatch.from_arrow(
+                    probe_rb.take(pa.array(keep, type=pa.int64())))
+            return
+        if jt == JoinType.EXISTENCE:
+            arrays = list(probe_rb.columns) + \
+                [pa.array(probe_matched, type=pa.bool_())]
+            yield ColumnBatch.from_arrow(pa.RecordBatch.from_arrays(
+                arrays, schema=self.schema.to_arrow()))
+            return
+        outer_probe = (jt == JoinType.FULL or
+                       (jt == JoinType.LEFT and probe_is_left) or
+                       (jt == JoinType.RIGHT and not probe_is_left))
+        if outer_probe:
+            un = np.nonzero(~probe_matched)[0]
+            if len(un):
+                yield self._project_out(self._joined(
+                    probe_rb, build_tbl, un,
+                    np.full(len(un), -1, dtype=np.int64)))
+
+    def _emit_unmatched_build(self, build_tbl, build_matched,
+                              probe_is_left) -> Iterator[ColumnBatch]:
+        jt = self.join_type
+        build_outer = (jt == JoinType.FULL or
+                       (jt == JoinType.RIGHT and probe_is_left) or
+                       (jt == JoinType.LEFT and not probe_is_left))
+        build_semi = ((jt == JoinType.RIGHT_SEMI and probe_is_left) or
+                      (jt == JoinType.LEFT_SEMI and not probe_is_left))
+        build_anti = ((jt == JoinType.RIGHT_ANTI and probe_is_left) or
+                      (jt == JoinType.LEFT_ANTI and not probe_is_left))
+        if build_semi or build_anti:
+            want = build_matched if build_semi else ~build_matched
+            idx = np.nonzero(want)[0]
+            if len(idx):
+                rb = build_tbl.take(pa.array(idx, type=pa.int64())) \
+                    .combine_chunks()
+                yield ColumnBatch.from_arrow(rb.to_batches()[0])
+            return
+        if not build_outer or build_tbl.num_rows == 0:
+            return
+        idx = np.nonzero(~build_matched)[0]
+        if not len(idx):
+            return
+        bt = build_tbl.take(pa.array(idx, type=pa.int64()))
+        probe_schema = self.children[0 if probe_is_left else 1].schema
+        null_probe = [pa.nulls(len(idx), f.data_type.to_arrow())
+                      for f in probe_schema]
+        bt_cols = [c.combine_chunks() for c in bt.columns]
+        arrays = (null_probe + bt_cols) if probe_is_left else \
+            (bt_cols + null_probe)
+        rb = pa.RecordBatch.from_arrays(
+            arrays, schema=pa.schema(
+                [f.to_arrow() for f in self.children[0].schema] +
+                [f.to_arrow() for f in self.children[1].schema]))
+        yield self._project_out(rb)

@@ -139,6 +139,17 @@ def create_plan(d: Dict[str, Any]) -> ExecutionPlan:
                 else AggExecMode.SORT_AGG)
         return AggExec(child, groups, aggs, mode)
 
+    if k == "broadcast_nested_loop_join":
+        from blaze_tpu.ops.joins.bnlj import BroadcastNestedLoopJoinExec
+        left = create_plan(d["left"])
+        right = create_plan(d["right"])
+        flt = (expr_from_dict(d["join_filter"])
+               if d.get("join_filter") else None)
+        return BroadcastNestedLoopJoinExec(
+            left, right, JoinType(d.get("join_type", "inner")),
+            build_side=d.get("build_side", "right"), join_filter=flt,
+            broadcast_id=d.get("broadcast_id"))
+
     if k == "broadcast_join_build_hash_map":
         from blaze_tpu.ops.joins.exec import BuildHashMapExec
         keys = [expr_from_dict(e, in_schema) for e in d["keys"]]

@@ -1,0 +1,166 @@
+"""Broadcast nested-loop join tests vs pandas cross-merge oracles
+(the auron.enable.bnlj operator for non-equi joins)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.exprs import BinaryExpr, col
+from blaze_tpu.memory import MemManager
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.ops.joins import JoinType
+from blaze_tpu.ops.joins.bnlj import BroadcastNestedLoopJoinExec
+
+
+@pytest.fixture(autouse=True)
+def budget():
+    MemManager.init(4 << 30)
+
+
+def _tables(seed=0, nl=400, nr=60):
+    rng = np.random.default_rng(seed)
+    left = pa.table({"a": pa.array(rng.integers(0, 100, nl),
+                                   type=pa.int64()),
+                     "b": pa.array(rng.random(nl))})
+    right = pa.table({"lo": pa.array(rng.integers(0, 80, nr),
+                                     type=pa.int64()),
+                      "hi": pa.array(rng.integers(20, 100, nr),
+                                     type=pa.int64())})
+    return left, right
+
+
+def _run(plan):
+    out = [b.compact().to_arrow() for b in plan.execute(0)]
+    out = [b for b in out if b.num_rows]
+    return (pa.Table.from_batches(out).to_pandas() if out
+            else pd.DataFrame())
+
+
+def _oracle_pairs(left, right):
+    l = left.to_pandas().reset_index(names="li")
+    r = right.to_pandas().reset_index(names="ri")
+    x = l.merge(r, how="cross")
+    return x[(x.a >= x.lo) & (x.a <= x.hi)]
+
+
+def _band_filter():
+    # a between lo and hi, on the joined (left+right) schema
+    return BinaryExpr("and",
+                      BinaryExpr(">=", col(0), col(2)),
+                      BinaryExpr("<=", col(0), col(3)))
+
+
+@pytest.mark.parametrize("jt,expected", [
+    (JoinType.INNER, "pairs"),
+    (JoinType.LEFT, "left_rows"),
+    (JoinType.LEFT_SEMI, "semi"),
+    (JoinType.LEFT_ANTI, "anti"),
+    (JoinType.EXISTENCE, "existence"),
+    (JoinType.FULL, "full"),
+])
+def test_band_join(jt, expected):
+    left, right = _tables()
+    plan = BroadcastNestedLoopJoinExec(
+        MemoryScanExec.from_arrow(left, batch_rows=64),
+        MemoryScanExec.from_arrow(right),
+        jt, build_side="right", join_filter=_band_filter())
+    got = _run(plan)
+    pairs = _oracle_pairs(left, right)
+    matched_left = set(pairs.li)
+    matched_right = set(pairs.ri)
+    nl, nr = left.num_rows, right.num_rows
+    if expected == "pairs":
+        assert len(got) == len(pairs)
+    elif expected == "left_rows":
+        assert len(got) == len(pairs) + (nl - len(matched_left))
+    elif expected == "semi":
+        assert len(got) == len(matched_left)
+    elif expected == "anti":
+        assert len(got) == nl - len(matched_left)
+    elif expected == "existence":
+        assert len(got) == nl
+        assert int(got["exists"].sum()) == len(matched_left)
+    elif expected == "full":
+        assert len(got) == (len(pairs) + (nl - len(matched_left)) +
+                            (nr - len(matched_right)))
+
+
+def test_cross_join_no_condition():
+    left, right = _tables(nl=30, nr=7)
+    plan = BroadcastNestedLoopJoinExec(
+        MemoryScanExec.from_arrow(left), MemoryScanExec.from_arrow(right),
+        JoinType.INNER)
+    got = _run(plan)
+    assert len(got) == 30 * 7
+
+
+def test_empty_build_side():
+    left, _ = _tables(nl=10)
+    empty = pa.table({"lo": pa.array([], type=pa.int64()),
+                      "hi": pa.array([], type=pa.int64())})
+    plan = BroadcastNestedLoopJoinExec(
+        MemoryScanExec.from_arrow(left), MemoryScanExec.from_arrow(empty),
+        JoinType.LEFT, build_side="right", join_filter=_band_filter())
+    got = _run(plan)
+    assert len(got) == 10
+    assert got["lo"].isna().all()
+
+
+def test_converter_maps_bnlj(tmp_path):
+    import pyarrow.parquet as pq
+    from blaze_tpu.convert import convert_spark_plan
+    from blaze_tpu.plan import create_plan
+    import tests.test_convert_spark as C
+
+    left, right = _tables(nl=50, nr=10)
+    pl = str(tmp_path / "l.parquet")
+    pr = str(tmp_path / "r.parquet")
+    pq.write_table(left, pl)
+    pq.write_table(right, pr)
+    a, b = C.attr("a", "long", 1), C.attr("b", "double", 2)
+    lo, hi = C.attr("lo", "long", 3), C.attr("hi", "long", 4)
+    cond = C.binexpr("And",
+                     C.binexpr("GreaterThanOrEqual", C.attr("a", "long", 1),
+                               C.attr("lo", "long", 3)),
+                     C.binexpr("LessThanOrEqual", C.attr("a", "long", 1),
+                               C.attr("hi", "long", 4)))
+    join = C.plan_node(
+        "joins.BroadcastNestedLoopJoinExec",
+        {"joinType": "Inner", "buildSide": "BuildRight",
+         "condition": cond},
+        [C.scan_node([a[0], b[0]], [[pl]]),
+         C.plan_node("exchange.BroadcastExchangeExec", {},
+                     [C.scan_node([lo[0], hi[0]], [[pr]])])])
+    res = convert_spark_plan(join)
+    plan = create_plan(res.plan)
+    got = _run(plan)
+    assert len(got) == len(_oracle_pairs(left, right))
+
+
+def test_full_join_multi_partition_probe():
+    """Unmatched build rows must be emitted exactly ONCE across probe
+    partitions (matched state is shared; the last partition emits)."""
+    left, right = _tables(seed=5, nl=600, nr=40)
+    plan = BroadcastNestedLoopJoinExec(
+        MemoryScanExec.from_arrow(left, num_partitions=3, batch_rows=64),
+        MemoryScanExec.from_arrow(right),
+        JoinType.FULL, build_side="right", join_filter=_band_filter())
+    out = []
+    for p in range(plan.num_partitions):
+        out.extend(b.compact().to_arrow() for b in plan.execute(p))
+    got = pa.Table.from_batches([b for b in out if b.num_rows]).to_pandas()
+    pairs = _oracle_pairs(left, right)
+    nl, nr = left.num_rows, right.num_rows
+    want_rows = (len(pairs) + (nl - len(set(pairs.li))) +
+                 (nr - len(set(pairs.ri))))
+    assert len(got) == want_rows
+
+
+def test_existence_requires_build_right():
+    left, right = _tables(nl=10, nr=5)
+    with pytest.raises(ValueError, match="build_side"):
+        BroadcastNestedLoopJoinExec(
+            MemoryScanExec.from_arrow(left),
+            MemoryScanExec.from_arrow(right),
+            JoinType.EXISTENCE, build_side="left")
