@@ -2,6 +2,24 @@
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+On ANY failure (backend down, hung compile, mid-run UNAVAILABLE) it still
+prints one JSON line — with an "error" field — and exits nonzero fast.
+
+Harness structure (VERDICT r2 #1: the bench must survive a flaky TPU
+backend that can hang indefinitely inside init/compile):
+
+  supervisor (this process; never imports jax)
+    ├ probe: child process runs a tiny jit on the default backend under a
+    │        hard timeout, retried N times (first TPU init is slow)
+    └ attempt loop: child process runs the real benchmark under a hard
+      deadline; on timeout the whole process GROUP is SIGKILLed (no
+      orphans) and one retry gets a fresh backend init.  The supervisor
+      relays the child's JSON line, or prints its own error line.
+
+  child (--child): the benchmark body.  All engine tasks run as futures
+  with timeouts — a thread stuck in backend init converts to a TimeoutError
+  instead of wedging ThreadPoolExecutor.map; the child exits via os._exit
+  so stuck non-daemon threads can never turn an error into a hang.
 
 Workload (BASELINE.md config #1): the q01 `ctr` aggregation over SF1
 store_returns (287,514 rows), executed the way a Spark stage pair would
@@ -20,9 +38,14 @@ filter+aggregation, Spark-compatible murmur3 hash partitioning, framed IPC
 shuffle files, reduce-side merge.  Wall-clock covers ALL of it, including
 the dimension-table lookup that derives the date range.
 
-Baseline: the identical query on pyarrow's multithreaded C++ kernels
-(read -> filter -> group_by aggregate), the stand-in for Auron's CPU-native
-engine.  Correctness is asserted against it every run.
+Extras: a q06-shaped hash-join stage (store_returns ⋈ date_dim on
+date_sk, filter+join+agg) is also timed, as `join_*` fields — joins are
+the reference's bread and butter (BASELINE config #2) and were previously
+unmeasured (VERDICT r2 weak #4).
+
+Baseline: the identical queries on pyarrow's multithreaded C++ kernels,
+the stand-in for Auron's CPU-native engine.  Correctness is asserted
+against it every run.
 
 Roofline sanity (VERDICT r1 weak #1): the line also reports achieved
 input-bytes/s over the v5e HBM peak (~819 GB/s).  This pipeline is
@@ -34,15 +57,138 @@ from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 HBM_PEAK_BYTES_S = 819e9  # TPU v5e
 SCALE = float(os.environ.get("BLAZE_BENCH_SCALE", "1.0"))
 N_MAPS = int(os.environ.get("BLAZE_BENCH_MAPS", "4"))
 N_REDUCES = int(os.environ.get("BLAZE_BENCH_REDUCES", "4"))
 ITERS = int(os.environ.get("BLAZE_BENCH_ITERS", "5"))
+
+PROBE_TIMEOUT_S = float(os.environ.get("BLAZE_BENCH_PROBE_TIMEOUT", "150"))
+PROBE_TRIES = int(os.environ.get("BLAZE_BENCH_PROBE_TRIES", "2"))
+ATTEMPT_TIMEOUT_S = float(os.environ.get("BLAZE_BENCH_ATTEMPT_TIMEOUT",
+                                         "900"))
+ATTEMPTS = int(os.environ.get("BLAZE_BENCH_ATTEMPTS", "2"))
+STAGE_TIMEOUT_S = float(os.environ.get("BLAZE_BENCH_STAGE_TIMEOUT", "300"))
+
+METRIC_NAME = "tpcds_q01_sf%g_e2e_rows_per_sec" % SCALE
+
+
+# ===========================================================================
+# supervisor side (no jax imports anywhere on these paths)
+# ===========================================================================
+
+def _error_line(msg: str, **extras) -> None:
+    """The contract holds even in failure: one JSON line, then exit fast."""
+    rec = {"metric": METRIC_NAME, "value": 0, "unit": "rows/s",
+           "vs_baseline": 0, "error": msg[-2000:]}
+    rec.update(extras)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+_PROBE_CODE = r"""
+import os
+import jax
+# the axon plugin ignores the JAX_PLATFORMS env var; the override must go
+# through jax.config (same fix as __graft_entry__ / tests/conftest.py)
+if os.environ.get("BLAZE_BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BLAZE_BENCH_PLATFORM"])
+import jax.numpy as jnp
+x = jax.jit(lambda a: (a * 2).sum())(jnp.arange(128))
+x.block_until_ready()
+print("PROBE_OK", jax.default_backend(), len(jax.devices()))
+"""
+
+
+def _run_group(args, timeout_s):
+    """Run a child in its own process group; SIGKILL the whole group on
+    timeout so a thread wedged in backend init can't orphan anything."""
+    p = subprocess.Popen(args, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True,
+                         start_new_session=True,
+                         cwd=os.path.dirname(os.path.abspath(__file__)))
+    try:
+        out, err = p.communicate(timeout=timeout_s)
+        return p.returncode, out, err, False
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            p.kill()
+        out, err = p.communicate()
+        return -9, out, err, True
+
+
+def _probe_backend():
+    """Returns (platform, n_devices) or raises after bounded retries."""
+    last = ""
+    for i in range(PROBE_TRIES):
+        rc, out, err, timed_out = _run_group(
+            [sys.executable, "-c", _PROBE_CODE], PROBE_TIMEOUT_S)
+        for ln in out.splitlines():
+            if ln.startswith("PROBE_OK"):
+                _, platform, n = ln.split()
+                return platform, int(n)
+        last = ("probe attempt %d: %s" %
+                (i + 1, "hang killed after %gs" % PROBE_TIMEOUT_S
+                 if timed_out else (err or out).strip()[-500:]))
+        time.sleep(2)
+    raise RuntimeError("backend probe failed: " + last)
+
+
+def supervise() -> int:
+    t0 = time.perf_counter()
+    try:
+        platform, n_dev = _probe_backend()
+    except RuntimeError as e:
+        _error_line(str(e), stage="probe",
+                    harness_wall_s=round(time.perf_counter() - t0, 1))
+        return 1
+
+    last_err = ""
+    for attempt in range(ATTEMPTS):
+        rc, out, err, timed_out = _run_group(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            ATTEMPT_TIMEOUT_S)
+        line = None
+        for ln in reversed(out.splitlines()):
+            if ln.startswith("{"):
+                line = ln
+                break
+        if rc == 0 and line is not None:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                last_err = "attempt %d: unparseable output %r" % (
+                    attempt + 1, line[:200])
+                continue
+            if "error" not in rec:
+                rec["platform"] = platform
+                rec["n_devices"] = n_dev
+                print(json.dumps(rec))
+                sys.stdout.flush()
+                return 0
+            last_err = "attempt %d: %s" % (attempt + 1, rec["error"])
+        elif timed_out:
+            last_err = ("attempt %d: killed after %gs deadline"
+                        % (attempt + 1, ATTEMPT_TIMEOUT_S))
+        else:
+            last_err = "attempt %d: rc=%d %s" % (
+                attempt + 1, rc,
+                (line or (err or out).strip()[-800:]))
+    _error_line(last_err, stage="bench", platform=platform,
+                harness_wall_s=round(time.perf_counter() - t0, 1))
+    return 1
+
+
+# ===========================================================================
+# child side — the benchmark body
+# ===========================================================================
 
 SR_SCHEMA_D = {"fields": [
     {"name": "sr_returned_date_sk", "type": {"id": "int64"},
@@ -58,6 +204,25 @@ PARTIAL_SCHEMA_D = {"fields": [
     {"name": "ctr_total_return.sum", "type": {"id": "float64"},
      "nullable": True},
 ]}
+DD_SCHEMA_D = {"fields": [
+    {"name": "d_date_sk", "type": {"id": "int64"}, "nullable": True},
+    {"name": "d_year", "type": {"id": "int64"}, "nullable": True},
+]}
+
+
+def _tasks(fn, n, what):
+    """Run n tasks on a pool, but never wait unboundedly: a task wedged in
+    backend init becomes a TimeoutError (VERDICT r2 weak #1)."""
+    from concurrent.futures import ThreadPoolExecutor, wait
+    pool = ThreadPoolExecutor(max_workers=n)
+    futs = [pool.submit(fn, i) for i in range(n)]
+    done, not_done = wait(futs, timeout=STAGE_TIMEOUT_S)
+    if not_done:
+        pool.shutdown(wait=False, cancel_futures=True)
+        raise TimeoutError("%s: %d/%d tasks still running after %gs"
+                           % (what, len(not_done), n, STAGE_TIMEOUT_S))
+    pool.shutdown(wait=False)
+    return [f.result() for f in futs]
 
 
 def ensure_dataset():
@@ -155,7 +320,6 @@ def run_engine(sr_paths, dd_path, tmpdir):
     Tasks within a stage run on a thread pool (spark local[N]: one task
     per executor core; the engine's device work is async-dispatched, so
     concurrent tasks overlap their host round trips)."""
-    from concurrent.futures import ThreadPoolExecutor
     import pyarrow as pa
     from blaze_tpu.bridge.resource import put_resource
     from blaze_tpu.bridge.runtime import NativeExecutionRuntime
@@ -174,8 +338,7 @@ def run_engine(sr_paths, dd_path, tmpdir):
         finally:
             rt.finalize()
 
-    with ThreadPoolExecutor(max_workers=N_MAPS) as pool:
-        list(pool.map(run_map, range(N_MAPS)))
+    _tasks(run_map, N_MAPS, "q01 map stage")
 
     # ---- register reduce-side block map (the MapOutputTracker analog) ----
     offsets = [read_index_file(os.path.join(tmpdir, f"shuffle_{m}.index"))
@@ -208,8 +371,7 @@ def run_engine(sr_paths, dd_path, tmpdir):
             rt.finalize()
         return groups, total
 
-    with ThreadPoolExecutor(max_workers=N_REDUCES) as pool:
-        results = list(pool.map(run_reduce, range(N_REDUCES)))
+    results = _tasks(run_reduce, N_REDUCES, "q01 reduce stage")
     return sum(g for g, _ in results), sum(t for _, t in results)
 
 
@@ -231,9 +393,87 @@ def run_baseline(sr_paths, dd_path):
     return agg.num_rows, float(total if total is not None else 0.0)
 
 
-def main():
+# ---- q06-shaped join stage (BASELINE config #2 shape) ---------------------
+
+def join_td(sr_paths, dd_path, map_id):
+    """store_returns ⋈ date_dim on returned_date_sk, d_year=2000 filter on
+    the build side, count+sum aggregate — the broadcast-join stage shape."""
+    file_groups = [[] for _ in range(N_MAPS)]
+    file_groups[map_id] = [sr_paths[map_id]]
+    dd_groups = [[] for _ in range(N_MAPS)]
+    dd_groups[map_id] = [dd_path]
+    plan = {
+        "kind": "hash_agg",
+        "groupings": [],
+        "aggs": [{"fn": "count", "mode": "partial", "name": "cnt",
+                  "args": [_col("sr_ticket_number")]},
+                 {"fn": "sum", "mode": "partial", "name": "amt",
+                  "args": [_col("sr_return_amt")]}],
+        "input": {
+            "kind": "broadcast_join",
+            "join_type": "inner",
+            "left_keys": [_col("sr_returned_date_sk")],
+            "right_keys": [_col("d_date_sk")],
+            "left": {"kind": "parquet_scan", "schema": SR_SCHEMA_D,
+                     "file_groups": file_groups},
+            "right": {"kind": "filter",
+                      "predicates": [{"kind": "binary", "op": "==",
+                                      "l": _col("d_year"),
+                                      "r": _lit(2000)}],
+                      "input": {"kind": "parquet_scan",
+                                "schema": DD_SCHEMA_D,
+                                "file_groups": dd_groups}},
+            "build_side": "right"}}
+    return {"stage_id": 3, "partition_id": map_id,
+            "num_partitions": N_MAPS, "plan": plan}
+
+
+def run_join_engine(sr_paths, dd_path):
+    import pyarrow as pa
+    from blaze_tpu.bridge.runtime import NativeExecutionRuntime
+    from blaze_tpu.plan.proto_serde import task_definition_to_bytes
+
+    def run_map(m):
+        td = task_definition_to_bytes(join_td(sr_paths, dd_path, m))
+        rt = NativeExecutionRuntime(td).start()
+        cnt, amt = 0, 0.0
+        try:
+            for rb in rt.batches():
+                cnt += pa.compute.sum(rb.column(0)).as_py() or 0
+                amt += pa.compute.sum(rb.column(1)).as_py() or 0.0
+        finally:
+            rt.finalize()
+        return cnt, amt
+
+    results = _tasks(run_map, N_MAPS, "q06-shaped join stage")
+    return sum(c for c, _ in results), sum(a for _, a in results)
+
+
+def run_join_baseline(sr_paths, dd_path):
+    import pyarrow.compute as pc
+    import pyarrow.parquet as pq
+    sr = pq.read_table(sr_paths,
+                       columns=["sr_returned_date_sk", "sr_ticket_number",
+                                "sr_return_amt"])
+    dd = pq.read_table(dd_path, columns=["d_date_sk", "d_year"])
+    dd = dd.filter(pc.equal(dd["d_year"], 2000))
+    j = sr.join(dd, keys="sr_returned_date_sk", right_keys="d_date_sk",
+                join_type="inner")
+    cnt = pc.count(j["sr_ticket_number"]).as_py()
+    amt = pc.sum(j["sr_return_amt"]).as_py()
+    return int(cnt or 0), float(amt or 0.0)
+
+
+def child_main():
     import shutil
     import tempfile
+
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+
+    import numpy as np
 
     # large tiles cut per-batch host round trips (the dominant cost when
     # the device sits behind a network tunnel); device HBM fits them easily
@@ -271,9 +511,28 @@ def main():
             (got_total, want_total)
     tpu_s = float(np.median(times))
 
+    # join stage (q06 shape): correctness + timing vs pyarrow join
+    want_cnt, want_amt = run_join_baseline(sr_paths, dd_path)
+    jcpu_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run_join_baseline(sr_paths, dd_path)
+        jcpu_times.append(time.perf_counter() - t0)
+    join_cpu_s = float(np.median(jcpu_times))
+    jtimes = []
+    for i in range(max(3, ITERS // 2 + 1) + 1):
+        t0 = time.perf_counter()
+        got_cnt, got_amt = run_join_engine(sr_paths, dd_path)
+        if i > 0:
+            jtimes.append(time.perf_counter() - t0)
+        assert got_cnt == want_cnt, (got_cnt, want_cnt)
+        assert abs(got_amt - want_amt) / max(abs(want_amt), 1) < 1e-9, \
+            (got_amt, want_amt)
+    join_tpu_s = float(np.median(jtimes))
+
     bytes_per_s = input_bytes / tpu_s
     print(json.dumps({
-        "metric": "tpcds_q01_sf%g_e2e_rows_per_sec" % SCALE,
+        "metric": METRIC_NAME,
         "value": round(n_rows / tpu_s),
         "unit": "rows/s",
         "vs_baseline": round(cpu_s / tpu_s, 3),
@@ -285,12 +544,29 @@ def main():
         "roofline_frac": round(bytes_per_s / HBM_PEAK_BYTES_S, 6),
         "groups": int(want_groups),
         "maps": N_MAPS, "reduces": N_REDUCES,
+        "join_rows_per_sec": round(n_rows / join_tpu_s),
+        "join_vs_baseline": round(join_cpu_s / join_tpu_s, 3),
+        "join_wall_s": round(join_tpu_s, 4),
+        "join_baseline_wall_s": round(join_cpu_s, 4),
     }))
+    sys.stdout.flush()
 
 
 def _parquet_rows(path):
     import pyarrow.parquet as pq
     return pq.ParquetFile(path).metadata.num_rows
+
+
+def main():
+    if "--child" in sys.argv:
+        try:
+            child_main()
+        except BaseException:
+            import traceback
+            _error_line(traceback.format_exc())
+            os._exit(2)  # bypass stuck non-daemon threads
+        os._exit(0)
+    sys.exit(supervise())
 
 
 if __name__ == "__main__":

@@ -15,7 +15,10 @@ import pytest
 
 WORKER = r"""
 import json, os, sys
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The axon TPU plugin ignores the JAX_PLATFORMS env var (see conftest.py);
+# the override must go through jax.config before first backend use.
+import jax
+jax.config.update("jax_platforms", "cpu")
 import pyarrow as pa
 import pyarrow.parquet as pq
 import blaze_tpu
@@ -109,10 +112,16 @@ def test_two_processes_exchange_shuffle_files(tmp_path):
             [sys.executable, "-c", WORKER, json.dumps(cfg)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             cwd=os.path.dirname(os.path.dirname(__file__))))
-    for p in procs:
-        out, err = p.communicate(timeout=300)
-        assert p.returncode == 0, err.decode()[-2000:]
-        assert out.decode().startswith("OK")
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err.decode()[-2000:]
+            assert out.decode().startswith("OK")
+    finally:
+        for p in procs:  # never orphan a hung worker
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
 
     got = pa.concat_tables([pq.read_table(r) for r in results]).to_pandas()
     want = t.to_pandas().groupby("k", as_index=False).v.sum()
@@ -137,8 +146,8 @@ def test_init_distributed_smoke():
     """jax.distributed bootstrap in a subprocess (single-process world:
     the multi-host path with num_processes=1)."""
     code = (
-        "import os\n"
-        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
         "from blaze_tpu.parallel.distributed import init_distributed\n"
         "n = init_distributed('127.0.0.1:12355', 1, 0)\n"
         "print('DEVICES', n)\n")
