@@ -213,16 +213,8 @@ DD_SCHEMA_D = {"fields": [
 def _tasks(fn, n, what):
     """Run n tasks on a pool, but never wait unboundedly: a task wedged in
     backend init becomes a TimeoutError (VERDICT r2 weak #1)."""
-    from concurrent.futures import ThreadPoolExecutor, wait
-    pool = ThreadPoolExecutor(max_workers=n)
-    futs = [pool.submit(fn, i) for i in range(n)]
-    done, not_done = wait(futs, timeout=STAGE_TIMEOUT_S)
-    if not_done:
-        pool.shutdown(wait=False, cancel_futures=True)
-        raise TimeoutError("%s: %d/%d tasks still running after %gs"
-                           % (what, len(not_done), n, STAGE_TIMEOUT_S))
-    pool.shutdown(wait=False)
-    return [f.result() for f in futs]
+    from blaze_tpu.bridge.tasks import run_tasks
+    return run_tasks(fn, n, STAGE_TIMEOUT_S, what)
 
 
 def ensure_dataset():
@@ -530,9 +522,14 @@ def child_main():
             (got_amt, want_amt)
     join_tpu_s = float(np.median(jtimes))
 
+    from blaze_tpu.bridge.placement import placement_info
+    pi = placement_info()
     bytes_per_s = input_bytes / tpu_s
     print(json.dumps({
         "metric": METRIC_NAME,
+        "compute_placement": (pi.device_kind if pi else "unknown"),
+        "dispatch_rtt_ms": (round(pi.rtt_ms, 1) if pi else None),
+        "placement_policy": (pi.policy if pi else "unknown"),
         "value": round(n_rows / tpu_s),
         "unit": "rows/s",
         "vs_baseline": round(cpu_s / tpu_s, 3),

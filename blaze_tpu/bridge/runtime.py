@@ -37,8 +37,10 @@ class NativeExecutionRuntime:
 
     def __init__(self, task_definition: Dict[str, Any],
                  plan: Optional[ExecutionPlan] = None):
+        from blaze_tpu.bridge.placement import ensure_placement
         from blaze_tpu.plan import create_plan, decode_task_definition
         from blaze_tpu.plan.fused import fuse_plan
+        ensure_placement()  # once per process; may pin compute to host XLA
         td = decode_task_definition(task_definition)
         self.task = TaskContext(
             stage_id=td.get("stage_id", 0),

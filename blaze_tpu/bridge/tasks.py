@@ -1,0 +1,32 @@
+"""Bounded task-pool helper shared by the stage scheduler and bench.
+
+A task thread wedged inside backend init/compile must convert to a
+TimeoutError for the caller instead of hanging ThreadPoolExecutor
+forever (the failure mode of BENCH_r02: rc=124 with threads stuck in
+`jax.devices()`).  shutdown(wait=False) leaves any stuck thread behind;
+callers that must exit promptly despite one should use os._exit after
+reporting (bench.py child does)."""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor, wait
+from typing import Any, Callable, List, Optional
+
+
+def run_tasks(fn: Callable[[int], Any], n: int, timeout_s: float,
+              what: str, max_workers: Optional[int] = None) -> List[Any]:
+    pool = ThreadPoolExecutor(max_workers=max_workers or max(1, n))
+    futs = [pool.submit(fn, i) for i in range(n)]
+    done, not_done = wait(futs, timeout=timeout_s)
+    if not_done:
+        pool.shutdown(wait=False, cancel_futures=True)
+        # surface a completed task's REAL failure over the phantom hang:
+        # a sibling wedged in backend init must not mask the root cause
+        for f in done:
+            exc = f.exception()
+            if exc is not None:
+                raise exc
+        raise TimeoutError(f"{what}: {len(not_done)}/{n} tasks still "
+                           f"running after {timeout_s:g}s")
+    pool.shutdown(wait=False)
+    return [f.result() for f in futs]

@@ -255,7 +255,7 @@ INPUT_BATCH_PREFETCH = int_conf(
     "auron.input.batch.prefetch", 2,
     "Host->device double-buffering depth (the sync_channel(1) analog, rt.rs:142).")
 ON_DEVICE_AGG_CAPACITY = int_conf(
-    "auron.tpu.agg.table.capacity", 1 << 16,
+    "auron.tpu.agg.table.capacity", 1 << 18,
     "Static group slots for the fused sorted-table aggregation stage; "
     "overflow degrades to pass-through partials (plan/fused.py).")
 FUSED_STAGE_ENABLE = bool_conf(
@@ -263,12 +263,23 @@ FUSED_STAGE_ENABLE = bool_conf(
     "Rewrite eligible scan->filter->partial-agg subtrees into single-XLA-"
     "program fused stages (plan/fused.py fuse_plan).")
 FUSED_STAGE_CAPACITY = int_conf(
-    "auron.tpu.fused.stage.capacity", 1 << 22,
+    "auron.tpu.fused.stage.capacity", 1 << 24,
     "Max dense group-table slots (product of key ranges) for the fused "
     "dense-group-id path before falling back to the sorted table.")
 SORT_SPILL_BATCHES = int_conf(
     "auron.tpu.sort.inmem.batches", 64,
     "Batches buffered in device memory before external sort spills a run.")
+PLACEMENT = str_conf(
+    "auron.tpu.placement", "auto",
+    "Stage-compute placement: 'auto' probes accelerator dispatch RTT once "
+    "and falls back to the host XLA backend behind a slow interconnect; "
+    "'device' forces the accelerator; 'host' forces host XLA "
+    "(bridge/placement.py — the removeInefficientConverts analog for the "
+    "host<->device boundary).")
+PLACEMENT_RTT_THRESHOLD_MS = float_conf(
+    "auron.tpu.placement.rtt.threshold.ms", 5.0,
+    "Auto-placement cutoff: measured per-dispatch round trip above this "
+    "means the accelerator is remote/tunneled and stages run on host XLA.")
 CASE_SENSITIVE = bool_conf("spark.sql.caseSensitive", False, "Column name matching.")
 ANSI_ENABLED = bool_conf(
     "spark.sql.ansi.enabled", False,

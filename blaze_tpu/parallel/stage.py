@@ -216,6 +216,172 @@ def dense_partial_agg(gid: jax.Array, num_slots: int,
     return accs, avalid, occupied
 
 
+class HashAggCarry(NamedTuple):
+    """Device open-addressing group table (the agg_hash_map.rs analog,
+    ref agg_hash_map.rs open-addressing map keyed by grouping bytes).
+
+    TPU-first redesign: linear-probe insertion is expressed as a FIXED
+    number of scatter/gather rounds over the whole batch — no sort, no
+    per-row loop, no data-dependent shapes.  A multi-operand `lax.sort`
+    grouping program takes minutes to compile on TPU; this compiles in
+    seconds and runs at HBM speed."""
+
+    keys: Tuple[jax.Array, ...]        # stored key data, each (S,)
+    key_valid: Tuple[jax.Array, ...]
+    accs: Tuple[jax.Array, ...]
+    acc_valid: Tuple[jax.Array, ...]
+    used: jax.Array                    # (S,) bool
+
+
+def init_hash_carry(key_dtypes: Sequence, acc_kinds: Sequence[str],
+                    acc_dtypes: Sequence, num_slots: int) -> HashAggCarry:
+    keys = tuple(jnp.zeros(num_slots, dtype=dt) for dt in key_dtypes)
+    kvalid = tuple(jnp.zeros(num_slots, dtype=bool) for _ in key_dtypes)
+    accs, avalid = init_accumulators(acc_kinds, acc_dtypes, num_slots)
+    return HashAggCarry(keys, kvalid, accs, avalid,
+                        jnp.zeros(num_slots, dtype=bool))
+
+
+def hash_agg_step(carry: HashAggCarry,
+                  key_cols: Sequence[Tuple[jax.Array, jax.Array]],
+                  agg_specs: Sequence[Tuple[str, Optional[jax.Array],
+                                            Optional[jax.Array]]],
+                  mask: jax.Array, probe_rounds: int = 16):
+    """Insert one batch into the table.  Returns (new_carry, overflow,
+    num_groups); ATOMIC: when any row fails to place within probe_rounds,
+    the ORIGINAL carry is returned unchanged (overflow > 0) so the host
+    can grow/degrade and retry the whole batch losslessly."""
+    from blaze_tpu.kernels import hashing as H
+    S = carry.used.shape[0]
+    n = mask.shape[0]
+    row_idx = jnp.arange(n, dtype=jnp.int64)
+
+    # grouping normalizes -0.0 to 0.0 BEFORE hashing (Spark's
+    # NormalizeFloatingNumbers does this upstream of the hash, so the
+    # raw-bits hash kernel itself stays bit-exact with Spark)
+    key_cols = [(jnp.where(d == 0, jnp.abs(d), d), v)
+                if jnp.issubdtype(d.dtype, jnp.floating) else (d, v)
+                for d, v in key_cols]
+
+    cols = [(d, v, _dtype_of(d).id.value) for d, v in key_cols]
+    h = H.hash_columns(cols, seed=42, xp=jnp, algo="xxhash64")
+    h = h.astype(jnp.int64) & (S - 1)  # S is a power of two
+
+    used = carry.used
+    tkeys = list(carry.keys)
+    tkvalid = list(carry.key_valid)
+    placed = jnp.full(n, S, dtype=jnp.int64)  # S == unplaced sentinel
+    unplaced = mask
+    for r in range(probe_rounds):
+        slot = (h + r) & (S - 1)
+        used_g = jnp.take(used, slot)
+        can_claim = unplaced & ~used_g
+        # deterministic winner per slot: the lowest row index
+        claim = jnp.full(S, n, dtype=jnp.int64).at[
+            jnp.where(can_claim, slot, S)].min(row_idx, mode="drop")
+        winner = (jnp.take(claim, slot) == row_idx) & can_claim
+        wslot = jnp.where(winner, slot, S)
+        for i, (kd, kv) in enumerate(key_cols):
+            tkeys[i] = tkeys[i].at[wslot].set(kd, mode="drop")
+            tkvalid[i] = tkvalid[i].at[wslot].set(kv, mode="drop")
+        used = used.at[wslot].set(True, mode="drop")
+        # match AFTER claims so same-key rows placed this round unify
+        eq = jnp.take(used, slot)
+        for i, (kd, kv) in enumerate(key_cols):
+            sk = jnp.take(tkeys[i], slot)
+            sv = jnp.take(tkvalid[i], slot)
+            same = sk == kd
+            if jnp.issubdtype(kd.dtype, jnp.floating):
+                # grouping treats NaN as equal to NaN (Spark normalizes)
+                same = same | (jnp.isnan(sk) & jnp.isnan(kd))
+            # SQL grouping: null == null; valid keys compare by value
+            eq &= (sv == kv) & jnp.where(kv, same, True)
+        ok = unplaced & eq
+        placed = jnp.where(ok, slot, placed)
+        unplaced = unplaced & ~ok
+    overflow = jnp.sum(unplaced.astype(jnp.int32))
+
+    g = placed  # S sentinel drops out of every scatter below
+    new_accs, new_avalid = scatter_accumulate(
+        g, [(k, d, v) for k, d, v in agg_specs], mask,
+        carry.accs, carry.acc_valid)
+
+    new_carry = HashAggCarry(tuple(tkeys), tuple(tkvalid),
+                             tuple(new_accs), tuple(new_avalid), used)
+    keep_new = overflow == 0
+    sel = jax.tree_util.tree_map(
+        lambda nw, old: jnp.where(keep_new, nw, old), new_carry, carry)
+    num_groups = jnp.sum(sel.used.astype(jnp.int32))
+    return sel, overflow, num_groups
+
+
+def scatter_accumulate(g: jax.Array,
+                       agg_specs: Sequence[Tuple[str, Optional[jax.Array],
+                                                 Optional[jax.Array]]],
+                       mask: jax.Array, accs: Sequence[jax.Array],
+                       avalid: Sequence[jax.Array]):
+    """Shared in-place accumulate switch for the dense-gid and hash-table
+    carries: rows scatter into slot `g` (out-of-range drops).  Kept in one
+    place so null/identity semantics cannot diverge between paths."""
+    new_accs, new_avalid = [], []
+    for (kind, vd, vv), a, av in zip(agg_specs, accs, avalid):
+        cv = (vv if vv is not None else jnp.ones_like(mask)) & mask
+        if kind == "count":
+            a = a.at[g].add(cv.astype(a.dtype), mode="drop")
+        elif kind == "sum":
+            a = a.at[g].add(jnp.where(cv, vd.astype(a.dtype), 0),
+                            mode="drop")
+            av = av.at[g].max(cv, mode="drop")
+        elif kind == "min":
+            big = _identity(a.dtype, False)
+            a = a.at[g].min(jnp.where(cv, vd.astype(a.dtype), big),
+                            mode="drop")
+            av = av.at[g].max(cv, mode="drop")
+        elif kind == "max":
+            small = _identity(a.dtype, True)
+            a = a.at[g].max(jnp.where(cv, vd.astype(a.dtype), small),
+                            mode="drop")
+            av = av.at[g].max(cv, mode="drop")
+        else:
+            raise ValueError(f"unsupported agg kind {kind}")
+        new_accs.append(a)
+        new_avalid.append(av)
+    return new_accs, new_avalid
+
+
+def init_accumulators(kinds: Sequence[str], acc_dtypes: Sequence,
+                      num_slots: int):
+    """Identity-initialized accumulator columns (shared by both carries)."""
+    accs, avalid = [], []
+    for kind, dt in zip(kinds, acc_dtypes):
+        if kind == "count":
+            accs.append(jnp.zeros(num_slots, dtype=jnp.int64))
+            avalid.append(jnp.ones(num_slots, dtype=bool))
+            continue
+        if kind == "min":
+            accs.append(jnp.full(num_slots, _identity(dt, False), dtype=dt))
+        elif kind == "max":
+            accs.append(jnp.full(num_slots, _identity(dt, True), dtype=dt))
+        else:
+            accs.append(jnp.zeros(num_slots, dtype=dt))
+        avalid.append(jnp.zeros(num_slots, dtype=bool))
+    return tuple(accs), tuple(avalid)
+
+
+def rehash_carry(old: HashAggCarry, kinds: Sequence[str],
+                 new_slots: int, probe_rounds: int = 16):
+    """Re-insert an existing table into a larger one (the grow path).
+    `kinds` are the ORIGINAL accumulator kinds; stored accumulators
+    re-merge with merge semantics (count -> sum of counts)."""
+    key_dtypes = [k.dtype for k in old.keys]
+    acc_dtypes = [a.dtype for a in old.accs]
+    fresh = init_hash_carry(key_dtypes, kinds, acc_dtypes, new_slots)
+    specs = [("sum" if k == "count" else k, a, av)
+             for k, a, av in zip(kinds, old.accs, old.acc_valid)]
+    return hash_agg_step(fresh, list(zip(old.keys, old.key_valid)), specs,
+                         old.used, probe_rounds)
+
+
 def merge_agg_tables(table: AggTable,
                      merge_kinds: Sequence[str], num_slots: int) -> AggTable:
     """Re-aggregate a (possibly duplicated-key) table — the partial_merge

@@ -10,17 +10,18 @@ step 5).
 
 Two fused strategies, chosen at plan time:
 
-  * DENSE (pack_dense_keys + dense_partial_agg): every grouping key is an
-    integer column whose global [min, max] bounds are known — from parquet
-    row-group statistics or an in-memory table scan.  Group ids are pure
-    arithmetic; the loop body is a handful of scatter-reduces.  Zero host
-    syncs until the final table decode.
-  * SORTED (partial_agg_table): fixed-width keys without usable bounds.
-    A fixed-capacity sorted table carries across batches; one scalar
-    overflow check per batch.  On overflow the stage degrades to
-    pass-through partials (the AGG_TRIGGER_PARTIAL_SKIPPING analog,
-    ref agg_table.rs:108-122) — correct for PARTIAL mode because the
-    final-agg stage downstream re-merges.
+  * DENSE (pack_dense_keys + in-place scatter carry): every grouping key
+    is an integer column whose global [min, max] bounds are known — from
+    parquet row-group statistics or an in-memory table scan.  Group ids
+    are pure arithmetic; the loop body scatter-accumulates into a donated
+    carry (O(batch) per step).  Zero host syncs until the final decode.
+  * HASH (hash_agg_step, parallel/stage.py): fixed-width keys without
+    usable bounds.  A device open-addressing table (linear-probe rounds
+    of scatter/gather — no lax.sort, which takes minutes to compile on
+    TPU) carries across batches; one scalar overflow check per batch.
+    On overflow exact modes grow+rehash; PARTIAL degrades to batch-local
+    dedup pass-through (the AGG_TRIGGER_PARTIAL_SKIPPING analog,
+    ref agg_table.rs:108-122) because the final stage re-merges.
 
 Anything else (string keys, host aggs, avg/collect, merge modes) stays on
 the eager path.
@@ -45,8 +46,10 @@ from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
 from blaze_tpu.ops.basic import (DebugExec, FilterExec, FilterProjectExec,
                                  ProjectExec)
 from blaze_tpu.ops.scan import MemoryScanExec, ParquetScanExec
-from blaze_tpu.parallel.stage import (dense_partial_agg, pack_dense_keys,
-                                      partial_agg_table, unpack_dense_keys)
+from blaze_tpu.parallel.stage import (hash_agg_step, init_accumulators,
+                                      init_hash_carry, pack_dense_keys,
+                                      rehash_carry, scatter_accumulate,
+                                      unpack_dense_keys)
 from blaze_tpu.schema import Field, Schema
 
 
@@ -130,8 +133,50 @@ def _try_fuse_agg(node: ExecutionPlan) -> Optional["FusedPartialAggExec"]:
     # the sorted path handles overflow two ways: PARTIAL degrades to
     # pass-through (downstream re-merges); exact modes GROW the table
     grow = complete or merging
+    # absorb the filter/project chain between agg and source into the jit
+    # step when every expression traces (the CachedExprsEvaluator work
+    # moves INSIDE the XLA program: one dispatch per batch, ref rt.rs:156
+    # whole-chain-in-one-task)
+    source, chain = _absorbable_chain(child)
     return FusedPartialAggExec(child, groups, aggs, specs, ranges,
-                               complete, grow)
+                               complete, grow, source=source, chain=chain)
+
+
+def _absorbable_chain(child: ExecutionPlan):
+    """Peel Filter/Project/FilterProject off the agg's child.  Returns
+    (source_plan, chain_steps) where chain_steps apply source->agg order;
+    (child, []) when nothing absorbs."""
+    steps = []
+    node = child
+    while True:
+        if isinstance(node, FilterExec):
+            steps.append(("filter", node._predicates, None, None))
+        elif isinstance(node, ProjectExec):
+            steps.append(("project", None, node._exprs, node.schema))
+        elif isinstance(node, FilterProjectExec):
+            # appended top-down; the final reverse() restores filter-then-
+            # project execution order
+            steps.append(("project", None, node._exprs, node.schema))
+            steps.append(("filter", node._predicates, None, None))
+        else:
+            break
+        node = node.children[0]
+    steps.reverse()
+    return node, steps
+
+
+def _chain_cache_key(source_schema: Schema, chain, group_exprs, specs):
+    chain_k = []
+    for kind, preds, exprs, _schema in chain:
+        if kind == "filter":
+            chain_k.append(("f", tuple(p.cache_key() for p in preds)))
+        else:
+            chain_k.append(("p", tuple(e.cache_key() for e in exprs)))
+    return (tuple((f.name, f.data_type.id.value) for f in source_schema),
+            tuple(chain_k),
+            tuple(e.cache_key() for e, _ in group_exprs),
+            tuple((rk, ok, a.cache_key() if a is not None else None)
+                  for rk, ok, a in specs))
 
 
 def _discover_ranges(child: ExecutionPlan,
@@ -232,7 +277,8 @@ class FusedPartialAggExec(ExecutionPlan):
     def __init__(self, child: ExecutionPlan, group_exprs, aggs,
                  specs: Sequence[Tuple[str, str, Optional[PhysicalExpr]]],
                  ranges: Optional[List[Tuple[int, int]]],
-                 complete: bool, grow: bool = False):
+                 complete: bool, grow: bool = False,
+                 source: Optional[ExecutionPlan] = None, chain=None):
         super().__init__([child])
         self._group_exprs = list(group_exprs)
         self._aggs = list(aggs)
@@ -242,6 +288,20 @@ class FusedPartialAggExec(ExecutionPlan):
         self._grow = grow  # exact modes grow the table instead of skipping
         self._in_schema = child.schema
         self._out_schema = self._build_schema()
+        # chain absorption: iterate the SOURCE and run filter/project
+        # inside the jit step.  Falls back to the eager child when the
+        # chain doesn't trace (strings, host-only exprs).
+        self._source = source if source is not None else child
+        self._chain = list(chain or [])
+        self._prepare = None
+        self._prepare_key = None
+        if self._chain or source is not None:
+            self._prepare_key = _chain_cache_key(
+                self._source.schema, self._chain, self._group_exprs,
+                self._specs)
+            self._prepare = _prepare_factory(
+                self._prepare_key, self._source.schema, self._chain,
+                self._group_exprs, self._specs)
 
     def _build_schema(self) -> Schema:
         fields: List[Field] = []
@@ -274,6 +334,20 @@ class FusedPartialAggExec(ExecutionPlan):
         else:
             yield from self._execute_sorted(partition)
 
+    def _acc_dtypes(self) -> Tuple:
+        """Carry accumulator dtype per spec (no evaluation needed)."""
+        out = []
+        for rk, _ok, arg in self._specs:
+            if rk == "count" or arg is None:
+                out.append(jnp.int64)
+                continue
+            dt = arg.data_type(self._in_schema).jnp_dtype()
+            if rk == "sum":
+                dt = (jnp.float64 if jnp.issubdtype(dt, jnp.floating)
+                      else jnp.int64)
+            out.append(dt)
+        return tuple(out)
+
     # -- dense: no host syncs in the loop ----------------------------------
     def _execute_dense(self, partition: int) -> BatchIterator:
         num_slots = 1
@@ -282,13 +356,28 @@ class FusedPartialAggExec(ExecutionPlan):
         kinds = [rk for rk, _ok, _a in self._specs]
         carry = None
         n_batches = 0
-        for batch in self.children[0].execute(partition):
-            kd, kv, ad, av, mask = self._device_inputs(batch)
-            step = self._dense_step(batch.capacity, num_slots, tuple(kinds))
-            if carry is None:
-                carry = _init_carry(kinds, ad, num_slots)
-            carry = step(carry, kd, kv, ad, av, mask)
-            n_batches += 1
+        if self._prepare is not None:
+            step = _dense_chain_step_factory(self._prepare_key,
+                                             self._prepare[0],
+                                             tuple(self._ranges),
+                                             tuple(kinds), num_slots)
+            for batch in self._source.execute(partition):
+                cols_flat, mask = _source_inputs(batch)
+                if carry is None:
+                    carry = _init_carry(kinds, self._acc_dtypes(),
+                                        num_slots)
+                carry = step(carry, cols_flat, mask)
+                n_batches += 1
+        else:
+            for batch in self.children[0].execute(partition):
+                kd, kv, ad, av, mask = self._device_inputs(batch)
+                step = self._dense_step(batch.capacity, num_slots,
+                                        tuple(kinds))
+                if carry is None:
+                    carry = _init_carry(kinds, self._acc_dtypes(),
+                                        num_slots)
+                carry = step(carry, kd, kv, ad, av, mask)
+                n_batches += 1
         self.metrics.add("fused_batches", n_batches)
         if carry is None:
             return
@@ -310,7 +399,9 @@ class FusedPartialAggExec(ExecutionPlan):
         if count == 0:
             return
         padded = _bucket(count, num_slots)
-        slots_dev = jnp.argsort(~occupied, stable=True)[:padded]
+        # nonzero with a static size is an O(slots) scan (vs argsort's full
+        # sort) and keeps slot order; entries past `count` are fill
+        slots_dev = jnp.nonzero(occupied, size=padded, fill_value=0)[0]
         fetch = ([jnp.take(a, slots_dev) for a in accs],
                  [jnp.take(v, slots_dev) for v in avalid],
                  slots_dev)
@@ -322,71 +413,93 @@ class FusedPartialAggExec(ExecutionPlan):
             host_keys, [a[:count] for a in host_accs],
             [v[:count] for v in host_avalid])
 
-    # -- sorted: carry table + per-batch overflow check --------------------
+    # -- unbounded keys: device open-addressing hash table -----------------
+    # (ref agg_hash_map.rs; replaces the earlier sort-based table — a
+    # multi-operand lax.sort program takes minutes to COMPILE on TPU and
+    # the eager form blew the SF10 reduce-stage timeout outright)
     def _execute_sorted(self, partition: int) -> BatchIterator:
-        carry_slots = config.ON_DEVICE_AGG_CAPACITY.get()
-        kinds = [rk for rk, _ok, _a in self._specs]
-        merge_kinds = ["sum" if k == "count" else k for k in kinds]
+        slots = _pow2(config.ON_DEVICE_AGG_CAPACITY.get())
+        kinds = tuple(rk for rk, _ok, _a in self._specs)
         carry = None
         skipping = False
-        for batch in self.children[0].execute(partition):
-            kd, kv, ad, av, mask = self._device_inputs(batch)
-            # a batch cannot hold more groups than rows, so capacity slots
-            # make the per-batch table lossless
-            table = partial_agg_table(
-                list(zip(kd, kv)),
-                [(k, d, v) for k, d, v in zip(kinds, ad, av)],
-                mask, batch.capacity)
+        if self._prepare is not None:
+            # prepare is INLINED into the step jit: one dispatch per batch
+            # (a second program would pay another tunnel round trip and
+            # materialize kd/kv/ad/av between programs)
+            stream = self._source.execute(partition)
+            raw_step = _hash_chain_step_factory(self._prepare_key,
+                                                self._prepare[0], kinds)
+            step = lambda c, b: raw_step(c, *_source_inputs(b))  # noqa: E731
+        else:
+            stream = self.children[0].execute(partition)
+            raw_step = _hash_step_jit(kinds)
+            step = lambda c, b: raw_step(  # noqa: E731
+                c, *self._device_inputs(b))
+        key_dtypes = [e.data_type(self._in_schema).jnp_dtype()
+                      for e, _n in self._group_exprs]
+        for batch in stream:
             if skipping:
-                yield from self._emit_table(table)
+                # batch-local dedup then pass through (downstream
+                # re-merges) — ref AGG_TRIGGER_PARTIAL_SKIPPING,
+                # agg_table.rs:108-122
+                yield from self._emit_hash(
+                    self._insert_batch_local(step, key_dtypes, kinds,
+                                             batch))
                 continue
             if carry is None:
-                merged = _resize_table(table, merge_kinds, carry_slots)
-            else:
-                merged = _merge_tables(carry, table, merge_kinds,
-                                       carry_slots)
-            # num_groups counts ALL boundaries even past the slot cap, and
-            # merged >= per-batch count, so this ONE scalar sync per batch
-            # covers both the batch table and the merge
-            while int(merged.num_groups) > carry_slots:
+                carry = init_hash_carry(key_dtypes, kinds,
+                                        self._acc_dtypes(), slots)
+            new_carry, overflow, _ng = step(carry, batch)
+            while int(overflow) > 0:
                 if not self._grow:
-                    merged = None
+                    new_carry = None
                     break
-                # exact modes (final/merge/complete) DOUBLE the table and
-                # re-merge — both inputs are still intact and lossless
-                carry_slots *= 2
+                # exact modes (final/merge/complete) DOUBLE and rehash —
+                # the step is atomic, so carry is intact and lossless
+                slots *= 2
                 self.metrics.add("table_grown", 1)
-                if carry is None:
-                    merged = _resize_table(table, merge_kinds, carry_slots)
-                else:
-                    merged = _merge_tables(carry, table, merge_kinds,
-                                           carry_slots)
-            if merged is None:
-                # degrade to pass-through partials
-                # (ref AGG_TRIGGER_PARTIAL_SKIPPING, agg_table.rs:108-122)
+                bigger, re_ovf, _ = _rehash_jit(kinds, slots)(carry)
+                if int(re_ovf) > 0:
+                    continue  # rare probe clustering: double again
+                carry = bigger
+                new_carry, overflow, _ng = step(carry, batch)
+            if new_carry is None:
                 skipping = True
                 self.metrics.add("partial_skipped", 1)
                 if carry is not None:
-                    yield from self._emit_table(carry)
+                    yield from self._emit_hash(carry)
                     carry = None
-                yield from self._emit_table(table)
+                yield from self._emit_hash(
+                    self._insert_batch_local(step, key_dtypes, kinds,
+                                             batch))
                 continue
-            carry = merged
+            carry = new_carry
         if carry is not None:
-            yield from self._emit_table(carry)
+            yield from self._emit_hash(carry)
 
-    def _emit_table(self, table) -> BatchIterator:
-        # groups sit packed at the front of the table (gids are a cumsum),
-        # so only the valid prefix crosses the tunnel
-        count = int(jnp.minimum(table.num_groups, table.slot_valid.shape[0]))
+    def _insert_batch_local(self, step, key_dtypes, kinds, batch):
+        """One batch into a fresh table (grow-on-overflow; a batch has at
+        most capacity distinct groups, so this terminates)."""
+        slots = _pow2(2 * batch.capacity)
+        while True:
+            local = init_hash_carry(key_dtypes, kinds,
+                                    self._acc_dtypes(), slots)
+            out, overflow, _ng = step(local, batch)
+            if int(overflow) == 0:
+                return out
+            slots *= 2
+
+    def _emit_hash(self, carry) -> BatchIterator:
+        count = int(jnp.sum(carry.used))
         if count == 0:
             return
-        padded = _bucket(count, table.slot_valid.shape[0])
+        padded = _bucket(count, carry.used.shape[0])
+        sel = jnp.nonzero(carry.used, size=padded, fill_value=0)[0]
         keys_h, kvalid_h, accs_h, avalid_h = jax.device_get(
-            ([k[:padded] for k in table.keys],
-             [v[:padded] for v in table.key_valid],
-             [a[:padded] for a in table.accs],
-             [v[:padded] for v in table.acc_valid]))
+            ([jnp.take(k, sel) for k in carry.keys],
+             [jnp.take(v, sel) for v in carry.key_valid],
+             [jnp.take(a, sel) for a in carry.accs],
+             [jnp.take(v, sel) for v in carry.acc_valid]))
         keys = [(kd[:count], kv[:count])
                 for kd, kv in zip(keys_h, kvalid_h)]
         accs = [a[:count] for a in accs_h]
@@ -438,6 +551,107 @@ class FusedPartialAggExec(ExecutionPlan):
 
 import functools
 
+from blaze_tpu.batch import DeviceColumn
+
+
+def _source_inputs(batch: ColumnBatch):
+    """Flatten a source batch for the jit step: device columns become
+    (data, validity) pairs; host (string) columns pass as None — any
+    expression touching one failed the pre-trace and never reaches here."""
+    cols_flat = tuple((c.data, c.validity)
+                      if isinstance(c, DeviceColumn) else None
+                      for c in batch.columns)
+    return cols_flat, batch.row_mask()
+
+
+def _make_prepare(source_schema: Schema, chain, group_exprs, specs):
+    """The in-graph chain evaluator: rebuild the batch from traced arrays,
+    run filter/project expression trees, emit key/agg device columns."""
+    def prepare(cols_flat, mask):
+        cap = mask.shape[0]
+        cols = [DeviceColumn(f.data_type, cf[0], cf[1])
+                if cf is not None else None
+                for f, cf in zip(source_schema, cols_flat)]
+        batch = ColumnBatch(source_schema, cols, cap, selection=mask)
+        for kind, preds, exprs, out_schema in chain:
+            if kind == "filter":
+                m = None
+                for p in preds:
+                    pm = p.evaluate(batch).as_mask(batch)
+                    m = pm if m is None else (m & pm)
+                if m is not None:
+                    batch = batch.with_selection(m)
+            else:
+                new_cols = [e.evaluate(batch).to_column(cap)
+                            for e in exprs]
+                batch = ColumnBatch(out_schema, new_cols, cap,
+                                    batch.selection)
+        kd, kv, ad, av = [], [], [], []
+        for e, _name in group_exprs:
+            v = e.evaluate(batch).to_device(cap)
+            kd.append(v.data)
+            kv.append(v.validity)
+        for _rk, _ok, arg in specs:
+            if arg is None:
+                ad.append(None)
+                av.append(None)
+            else:
+                v = arg.evaluate(batch).to_device(cap)
+                ad.append(v.data)
+                av.append(v.validity)
+        return tuple(kd), tuple(kv), tuple(ad), tuple(av), batch.row_mask()
+    return prepare
+
+
+# key -> (raw_prepare, jitted_prepare) | None when the chain doesn't trace
+_PREPARE_CACHE: Dict = {}
+_DENSE_STEP_CACHE: Dict = {}
+_CACHE_LIMIT = 128  # bounded like _dense_step_factory's lru_cache
+
+
+def _evict_if_full(cache: Dict) -> None:
+    if len(cache) >= _CACHE_LIMIT:
+        cache.pop(next(iter(cache)))  # FIFO: oldest compiled entry
+
+
+def _prepare_factory(key, source_schema: Schema, chain, group_exprs,
+                     specs):
+    if key in _PREPARE_CACHE:
+        return _PREPARE_CACHE[key]
+    _evict_if_full(_PREPARE_CACHE)
+    prepare = _make_prepare(source_schema, chain, group_exprs, specs)
+    try:
+        fake_cols = tuple(
+            (jax.ShapeDtypeStruct((128,), f.data_type.jnp_dtype()),
+             jax.ShapeDtypeStruct((128,), jnp.bool_))
+            if f.data_type.is_fixed_width else None
+            for f in source_schema)
+        jax.eval_shape(prepare, fake_cols,
+                       jax.ShapeDtypeStruct((128,), jnp.bool_))
+        result = (prepare, jax.jit(prepare))
+    except Exception:
+        result = None  # strings / host-only exprs: stay on the eager path
+    _PREPARE_CACHE[key] = result
+    return result
+
+
+def _dense_chain_step_factory(key, prepare, ranges, kinds,
+                              num_slots: int):
+    skey = (key, ranges, kinds, num_slots)
+    step = _DENSE_STEP_CACHE.get(skey)
+    if step is not None:
+        return step
+    _evict_if_full(_DENSE_STEP_CACHE)
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(carry, cols_flat, mask):
+        kd, kv, ad, av, m = prepare(cols_flat, mask)
+        gid, _total = pack_dense_keys(list(zip(kd, kv)), list(ranges))
+        return _scatter_into_carry(carry, gid, kinds, ad, av, m, num_slots)
+
+    _DENSE_STEP_CACHE[skey] = step
+    return step
+
 
 @functools.lru_cache(maxsize=128)
 def _dense_step_factory(ranges, kinds, num_slots: int):
@@ -445,48 +659,33 @@ def _dense_step_factory(ranges, kinds, num_slots: int):
 
     @partial(jax.jit, donate_argnums=0)
     def step(carry, key_data, key_valid, agg_data, agg_valid, mask):
-        accs, avalid, occupied = carry
         gid, _total = pack_dense_keys(list(zip(key_data, key_valid)),
                                       ranges)
-        batch_specs = [(kind, vd, vv)
-                       for kind, vd, vv in zip(kinds, agg_data, agg_valid)]
-        a2, v2, occ2 = dense_partial_agg(gid, num_slots, batch_specs, mask)
-        new_a, new_v = [], []
-        for kind, a, av, b, bv in zip(kinds, accs, avalid, a2, v2):
-            if kind in ("sum", "count"):
-                new_a.append(a + b)
-                new_v.append(av | bv)
-            elif kind == "min":
-                both = av & bv
-                new_a.append(jnp.where(both, jnp.minimum(a, b),
-                                       jnp.where(bv, b, a)))
-                new_v.append(av | bv)
-            else:  # max
-                both = av & bv
-                new_a.append(jnp.where(both, jnp.maximum(a, b),
-                                       jnp.where(bv, b, a)))
-                new_v.append(av | bv)
-        return (tuple(new_a), tuple(new_v), occupied | occ2)
+        return _scatter_into_carry(carry, gid, kinds, agg_data, agg_valid,
+                                   mask, num_slots)
 
     return step
 
 
-def _init_carry(kinds, agg_data, num_slots: int):
-    accs, avalid = [], []
-    for kind, vd in zip(kinds, agg_data):
-        if kind == "count":
-            accs.append(jnp.zeros(num_slots, dtype=jnp.int64))
-            avalid.append(jnp.ones(num_slots, dtype=bool))
-            continue
-        if kind == "sum":
-            dt = (jnp.float64 if jnp.issubdtype(vd.dtype, jnp.floating)
-                  else jnp.int64)
-        else:
-            dt = vd.dtype
-        accs.append(jnp.zeros(num_slots, dtype=dt))
-        avalid.append(jnp.zeros(num_slots, dtype=bool))
+def _scatter_into_carry(carry, gid, kinds, agg_data, agg_valid, mask,
+                        num_slots: int):
+    """In-place (donated) scatter update: O(batch) work per step instead of
+    materializing and merging a full O(num_slots) per-batch table.  The
+    accumulate switch itself is shared with the hash table
+    (stage.scatter_accumulate) so null/identity semantics stay in one
+    place."""
+    accs, avalid, occupied = carry
+    g = jnp.where(mask, gid, num_slots)  # masked rows drop out of range
+    occupied = occupied.at[g].max(mask, mode="drop")
+    specs = [(k, d, v) for k, d, v in zip(kinds, agg_data, agg_valid)]
+    new_a, new_v = scatter_accumulate(g, specs, mask, accs, avalid)
+    return (tuple(new_a), tuple(new_v), occupied)
+
+
+def _init_carry(kinds, acc_dtypes, num_slots: int):
+    accs, avalid = init_accumulators(kinds, acc_dtypes, num_slots)
     occupied = jnp.zeros(num_slots, dtype=bool)
-    return (tuple(accs), tuple(avalid), occupied)
+    return (accs, avalid, occupied)
 
 
 def _bucket(count: int, cap: int) -> int:
@@ -498,26 +697,41 @@ def _bucket(count: int, cap: int) -> int:
     return min(b, cap)
 
 
-def _resize_table(t, merge_kinds, num_slots: int):
-    """Re-aggregate a lossless table into the carry capacity (caller has
-    checked num_groups fits)."""
-    keys = list(zip(t.keys, t.key_valid))
-    specs = [(kind, acc, av) for kind, acc, av in
-             zip(merge_kinds, t.accs, t.acc_valid)]
-    return partial_agg_table(keys, specs, t.slot_valid, num_slots)
+def _pow2(n: int) -> int:
+    return max(16, 1 << (int(n) - 1).bit_length())
 
 
-def _merge_tables(a, b, merge_kinds, num_slots: int):
-    keys = [(jnp.concatenate([ka, kb]), jnp.concatenate([va, vb]))
-            for (ka, kb), (va, vb) in
-            zip(zip(a.keys, b.keys), zip(a.key_valid, b.key_valid))]
-    specs = []
-    for kind, aa, ab, va, vb in zip(merge_kinds, a.accs, b.accs,
-                                    a.acc_valid, b.acc_valid):
-        specs.append((kind, jnp.concatenate([aa, ab]),
-                      jnp.concatenate([va, vb])))
-    mask = jnp.concatenate([a.slot_valid, b.slot_valid])
-    return partial_agg_table(keys, specs, mask, num_slots)
+@functools.lru_cache(maxsize=128)
+def _hash_step_jit(kinds):
+    """One compiled program per batch: probe-insert + scatter-accumulate
+    into the device hash table (kernels in parallel/stage.py)."""
+    def f(carry, kd, kv, ad, av, mask):
+        specs = [(k, d, v) for k, d, v in zip(kinds, ad, av)]
+        return hash_agg_step(carry, list(zip(kd, kv)), specs, mask)
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=128)
+def _rehash_jit(kinds, new_slots: int):
+    return jax.jit(lambda c: rehash_carry(c, list(kinds), new_slots))
+
+
+def _hash_chain_step_factory(key, prepare, kinds):
+    """Chain + probe-insert + accumulate as ONE compiled program."""
+    skey = ("hash", key, kinds)
+    step = _DENSE_STEP_CACHE.get(skey)
+    if step is not None:
+        return step
+    _evict_if_full(_DENSE_STEP_CACHE)
+
+    @jax.jit
+    def step(carry, cols_flat, mask):
+        kd, kv, ad, av, m = prepare(cols_flat, mask)
+        specs = [(k, d, v) for k, d, v in zip(kinds, ad, av)]
+        return hash_agg_step(carry, list(zip(kd, kv)), specs, m)
+
+    _DENSE_STEP_CACHE[skey] = step
+    return step
 
 
 def _to_arrow(data: np.ndarray, valid: np.ndarray,

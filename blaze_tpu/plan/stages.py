@@ -30,7 +30,6 @@ from __future__ import annotations
 import os
 import tempfile
 import uuid
-from concurrent.futures import ThreadPoolExecutor, wait
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -49,6 +48,7 @@ class Stage:
     resource_id: Optional[str]
     num_tasks: int = 1            # producer-side task count
     deps: List[int] = field(default_factory=list)
+    out_schema: Optional[Dict[str, Any]] = None
 
 
 class DagScheduler:
@@ -57,7 +57,10 @@ class DagScheduler:
     def __init__(self, work_dir: Optional[str] = None,
                  max_task_parallelism: int = 4,
                  task_timeout_s: float = 600.0):
+        self._owns_dir = work_dir is None
         self._dir = work_dir or tempfile.mkdtemp(prefix="blaze-dag-")
+        os.makedirs(self._dir, exist_ok=True)
+        self._files: List[str] = []
         self._par = max_task_parallelism
         self._timeout = task_timeout_s
         self._run_id = uuid.uuid4().hex[:10]
@@ -69,10 +72,12 @@ class DagScheduler:
     def split(self, plan: Dict[str, Any]) -> List[Stage]:
         """Returns stages in dependency order; the last one is the result
         stage (its output streams back to the caller, the collect path)."""
+        self.stages = []  # a scheduler instance may be reused per query
         root, deps = self._split_node(plan)
+        n_tasks, schema = self._plan_info(root)
         result = Stage(sid=len(self.stages), plan=root, partitioning=None,
-                       resource_id=None, deps=deps)
-        result.num_tasks = self._plan_partitions(root)
+                       resource_id=None, deps=deps, num_tasks=n_tasks,
+                       out_schema=schema)
         self.stages.append(result)
         return self.stages
 
@@ -87,12 +92,13 @@ class DagScheduler:
                 else int(part.get("num_partitions", 1))
             sid = len(self.stages)
             rid = f"stage://{self._run_id}/{sid}"
+            n_tasks, schema = self._plan_info(child)
             stage = Stage(sid=sid, plan=child, partitioning=part,
-                          resource_id=rid, deps=deps,
-                          num_tasks=self._plan_partitions(child))
+                          resource_id=rid, deps=deps, num_tasks=n_tasks,
+                          out_schema=schema)
             self.stages.append(stage)
             reader = {"kind": "ipc_reader", "resource_id": rid,
-                      "schema": self._plan_schema(child),
+                      "schema": schema,
                       "num_partitions": n_out}
             return reader, [sid]
         out = dict(d)
@@ -111,15 +117,12 @@ class DagScheduler:
         return out, deps
 
     @staticmethod
-    def _plan_schema(d: Dict[str, Any]) -> Dict[str, Any]:
+    def _plan_info(d: Dict[str, Any]):
+        """ONE planning pass per stage: (task count, output schema dict)."""
         from blaze_tpu.plan import create_plan
         from blaze_tpu.plan.types import schema_to_dict
-        return schema_to_dict(create_plan(d).schema)
-
-    @staticmethod
-    def _plan_partitions(d: Dict[str, Any]) -> int:
-        from blaze_tpu.plan import create_plan
-        return max(1, create_plan(d).num_partitions)
+        plan = create_plan(d)
+        return max(1, plan.num_partitions), schema_to_dict(plan.schema)
 
     # -- per-task plan rewrite --------------------------------------------
 
@@ -137,13 +140,16 @@ class DagScheduler:
                 new_groups: List[List[str]] = [[] for _ in range(n_tasks)]
                 new_groups[task] = all_files
             else:
-                if len(groups) != n_tasks and len(groups) != 1:
+                if len(groups) > n_tasks:
                     raise ValueError(
                         f"scan has {len(groups)} file groups but the stage "
                         f"runs {n_tasks} tasks; repartition the input")
-                src = groups[task % len(groups)]
+                # in-process semantics: partition p of a scan with fewer
+                # groups than the stage yields nothing (ops emit only for
+                # partition < child.num_partitions)
                 new_groups = [[] for _ in range(n_tasks)]
-                new_groups[task] = list(src)
+                if task < len(groups):
+                    new_groups[task] = list(groups[task])
             out["file_groups"] = new_groups
             return out
         # build sides of broadcast joins are full copies for every task
@@ -169,14 +175,9 @@ class DagScheduler:
     # -- execution ---------------------------------------------------------
 
     def _run_tasks(self, fn, n: int, what: str) -> List[Any]:
-        pool = ThreadPoolExecutor(max_workers=min(self._par, max(1, n)))
-        futs = [pool.submit(fn, i) for i in range(n)]
-        done, not_done = wait(futs, timeout=self._timeout)
-        if not_done:
-            pool.shutdown(wait=False, cancel_futures=True)
-            raise TimeoutError(f"{what}: {len(not_done)}/{n} tasks hung")
-        pool.shutdown(wait=False)
-        return [f.result() for f in futs]
+        from blaze_tpu.bridge.tasks import run_tasks
+        return run_tasks(fn, n, self._timeout, what,
+                         max_workers=min(self._par, max(1, n)))
 
     def _run_producer(self, stage: Stage) -> None:
         from blaze_tpu.bridge.runtime import NativeExecutionRuntime
@@ -184,16 +185,23 @@ class DagScheduler:
         from blaze_tpu.shuffle.exchange import read_index_file
         from blaze_tpu.shuffle.reader import FileSegmentBlock
 
+        os.makedirs(self._dir, exist_ok=True)
+
         part = dict(stage.partitioning)
         if part["kind"] == "single":
             part = {"kind": "single", "num_partitions": 1}
+
+        for m in range(stage.num_tasks):
+            data = os.path.join(
+                self._dir, f"s{self._run_id}-{stage.sid}-{m}.data")
+            self._files += [data, data[:-5] + ".index"]
 
         def run_map(m: int) -> None:
             data = os.path.join(
                 self._dir, f"s{self._run_id}-{stage.sid}-{m}.data")
             plan = {"kind": "shuffle_writer", "partitioning": part,
                     "data_file": data,
-                    "index_file": data.replace(".data", ".index"),
+                    "index_file": data[:-5] + ".index",
                     "input": self._per_task(stage.plan, m,
                                             stage.num_tasks)}
             td = task_definition_to_bytes(
@@ -214,8 +222,7 @@ class DagScheduler:
             data = os.path.join(
                 self._dir, f"s{self._run_id}-{stage.sid}-{m}.data")
             outputs.append((data,
-                            read_index_file(data.replace(".data",
-                                                         ".index"))))
+                            read_index_file(data[:-5] + ".index")))
 
         def blocks_for(reduce_id: int):
             for data, offsets in outputs:
@@ -237,8 +244,7 @@ class DagScheduler:
             for st in stages[:-1]:
                 self._run_producer(st)
             result = stages[-1]
-            out_schema = schema_from_dict(
-                self._plan_schema(result.plan)).to_arrow()
+            out_schema = schema_from_dict(result.out_schema).to_arrow()
 
             def run_result(p: int) -> List[pa.RecordBatch]:
                 td = task_definition_to_bytes(
@@ -265,6 +271,16 @@ class DagScheduler:
         for rid in self._resources:
             remove_resource(rid)
         self._resources = []
+        for path in self._files:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._files = []
+        if self._owns_dir:
+            import shutil
+            # recreated lazily by the next _run_producer if reused
+            shutil.rmtree(self._dir, ignore_errors=True)
 
     # -- observability -----------------------------------------------------
 

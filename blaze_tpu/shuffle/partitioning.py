@@ -14,6 +14,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
@@ -40,6 +41,22 @@ class SinglePartitioning(Partitioning):
         return np.zeros(batch.num_rows, dtype=np.int32)
 
 
+# One compiled kernel per (column type signature, partition count): the
+# murmur3 chain is ~100 elementwise primitives; dispatched eagerly they
+# dominate the whole shuffle write (profiled at ~80% of q01 map wall).
+import functools
+
+
+@functools.lru_cache(maxsize=128)
+def _hash_pmod_jit(tids: Tuple[str, ...], n_parts: int):
+    def f(flat_cols):
+        cols = [(v, val, tid)
+                for (v, val), tid in zip(flat_cols, tids)]
+        h = H.hash_columns(cols, seed=42, xp=jnp, algo="murmur3")
+        return H.pmod(h, n_parts, xp=jnp)
+    return jax.jit(f)
+
+
 class HashPartitioning(Partitioning):
     def __init__(self, exprs: Sequence[PhysicalExpr], num_partitions: int):
         self.exprs = list(exprs)
@@ -48,28 +65,33 @@ class HashPartitioning(Partitioning):
     def partition_ids(self, batch: ColumnBatch) -> np.ndarray:
         n = batch.num_rows
         cap = batch.capacity
-        cols = []
+        flat_cols = []
+        tids = []
         for e in self.exprs:
             v = e.evaluate(batch)
             if v.is_device:
-                cols.append((v.data, v.validity, v.dtype.id.value))
+                flat_cols.append((v.data, v.validity))
+                tids.append(v.dtype.id.value)
             else:
                 # host (string) columns are exact-length; pad the byte
                 # matrix to the batch capacity so mixed string+fixed key
                 # hashes line up lane-for-lane
                 arr = v.to_host(n)
                 (mat, lengths), valid = H.string_column_to_padded_bytes(arr)
-                full = np.zeros((cap, mat.shape[1]), dtype=mat.dtype)
-                full[:mat.shape[0]] = mat
+                # pow2 width bucket: one compile per bucket, not per batch
+                w = max(4, 1 << (mat.shape[1] - 1).bit_length()) \
+                    if mat.shape[1] else 4
+                full = np.zeros((cap, w), dtype=mat.dtype)
+                full[:mat.shape[0], :mat.shape[1]] = mat
                 full_len = np.zeros(cap, dtype=lengths.dtype)
                 full_len[:len(lengths)] = lengths
                 pad_valid = np.zeros(cap, dtype=bool)
                 pad_valid[:len(valid)] = valid
-                cols.append(((jnp.asarray(full), jnp.asarray(full_len)),
-                             jnp.asarray(pad_valid), "utf8"))
-        h = H.hash_columns(cols, seed=42, xp=jnp, algo="murmur3",
-                           num_rows=cap)
-        pids = H.pmod(h, self.num_partitions, xp=jnp)
+                flat_cols.append(((jnp.asarray(full),
+                                   jnp.asarray(full_len)),
+                                  jnp.asarray(pad_valid)))
+                tids.append("utf8")
+        pids = _hash_pmod_jit(tuple(tids), self.num_partitions)(flat_cols)
         return np.asarray(pids)[:n].astype(np.int32)
 
 

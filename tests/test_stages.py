@@ -1,0 +1,156 @@
+"""Stage-DAG scheduler tests: whole multi-stage plans (with exchanges)
+executed task-by-task over the protobuf wire (VERDICT r2 #3 — the
+production path: plan split -> TaskDefinition bytes -> NativeExecutionRuntime
+-> shuffle files -> ipc_reader)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.itest import generate
+from blaze_tpu.itest.queries import QUERIES
+from blaze_tpu.itest.runner import compare_frames
+from blaze_tpu.itest.tpcds_data import write_parquet_splits
+from blaze_tpu.memory import MemManager
+from blaze_tpu.plan.stages import DagScheduler
+
+
+@pytest.fixture(autouse=True)
+def budget():
+    MemManager.init(4 << 30)
+
+
+def _table_from(got: pa.Table) -> pd.DataFrame:
+    return got.to_pandas() if got.num_rows else pd.DataFrame(
+        {n: [] for n in got.schema.names})
+
+
+def test_two_stage_agg_over_wire(tmp_path):
+    rng = np.random.default_rng(7)
+    n = 30_000
+    t = pa.table({"k": pa.array(rng.integers(0, 500, n), type=pa.int64()),
+                  "v": pa.array(rng.random(n))})
+    paths = []
+    for i in range(2):
+        p = str(tmp_path / f"in-{i}.parquet")
+        pq.write_table(t.slice(i * (n // 2), n // 2), p)
+        paths.append(p)
+    schema = {"fields": [
+        {"name": "k", "type": {"id": "int64"}, "nullable": True},
+        {"name": "v", "type": {"id": "float64"}, "nullable": True}]}
+    plan = {
+        "kind": "hash_agg",
+        "groupings": [{"expr": {"kind": "column", "index": 0},
+                       "name": "k"}],
+        "aggs": [{"fn": "sum", "mode": "final", "name": "s",
+                  "args": [{"kind": "column", "index": 1}]}],
+        "input": {
+            "kind": "local_exchange",
+            "partitioning": {"kind": "hash",
+                             "exprs": [{"kind": "column", "index": 0}],
+                             "num_partitions": 3},
+            "input": {
+                "kind": "hash_agg",
+                "groupings": [{"expr": {"kind": "column", "name": "k"},
+                               "name": "k"}],
+                "aggs": [{"fn": "sum", "mode": "partial", "name": "s",
+                          "args": [{"kind": "column", "name": "v"}]}],
+                "input": {"kind": "parquet_scan", "schema": schema,
+                          "file_groups": [[paths[0]], [paths[1]]]}}}}
+    sched = DagScheduler(work_dir=str(tmp_path / "dag"))
+    got = sched.run_collect(plan).to_pandas()
+    assert len(sched.stages) == 2
+    assert sched.stages[0].num_tasks == 2      # two map splits
+    assert sched.stages[-1].num_tasks == 3     # three reducers
+    want = t.to_pandas().groupby("k", as_index=False).v.sum() \
+        .rename(columns={"v": "s"})
+    got = got.sort_values("k").reset_index(drop=True)
+    want = want.sort_values("k").reset_index(drop=True)
+    assert len(got) == len(want)
+    np.testing.assert_allclose(got["s"].to_numpy(), want["s"].to_numpy(),
+                               rtol=1e-9)
+
+
+@pytest.mark.parametrize("qname", ["q01", "q06", "q95"])
+def test_tpcds_query_over_wire(qname, tmp_path):
+    """The itest queries run through the FULL wire path: stage split,
+    per-task proto TaskDefinitions, shuffle files, block-map readers."""
+    builder, table_names = QUERIES[qname]
+    tables = generate(table_names, scale=0.2)
+    paths = write_parquet_splits(tables, str(tmp_path), 2)
+    plan_dict, oracle = builder(paths, tables, 2)
+    got = DagScheduler(work_dir=str(tmp_path / "dag")).run_collect(
+        plan_dict)
+    err = compare_frames(_table_from(got), oracle())
+    assert err is None, f"{qname}: {err}"
+
+
+def test_broadcast_build_over_exchange(tmp_path):
+    """A broadcast join whose BUILD side contains an exchange: every task
+    must see ALL build rows (BroadcastJoinExec pulls every partition of
+    its build child's ipc_reader)."""
+    import uuid as _uuid
+    rng = np.random.default_rng(11)
+    n = 8_000
+    fact = pa.table({"k": pa.array(rng.integers(0, 40, n), type=pa.int64()),
+                     "v": pa.array(rng.random(n))})
+    fpaths = []
+    for i in range(2):
+        p = str(tmp_path / f"fact-{i}.parquet")
+        pq.write_table(fact.slice(i * (n // 2), n // 2), p)
+        fpaths.append(p)
+    dim = pa.table({"k": pa.array(np.arange(40), type=pa.int64()),
+                    "w": pa.array(rng.random(40))})
+    dpath = str(tmp_path / "dim.parquet")
+    pq.write_table(dim, dpath)
+    fschema = {"fields": [
+        {"name": "k", "type": {"id": "int64"}, "nullable": True},
+        {"name": "v", "type": {"id": "float64"}, "nullable": True}]}
+    dschema = {"fields": [
+        {"name": "k", "type": {"id": "int64"}, "nullable": True},
+        {"name": "w", "type": {"id": "float64"}, "nullable": True}]}
+    # build side: dim scan -> partial/final agg pair over an exchange
+    build = {
+        "kind": "hash_agg",
+        "groupings": [{"expr": {"kind": "column", "index": 0},
+                       "name": "k"}],
+        "aggs": [{"fn": "sum", "mode": "final", "name": "w",
+                  "args": [{"kind": "column", "index": 1}]}],
+        "input": {
+            "kind": "local_exchange",
+            "partitioning": {"kind": "hash",
+                             "exprs": [{"kind": "column", "index": 0}],
+                             "num_partitions": 3},
+            "input": {
+                "kind": "hash_agg",
+                "groupings": [{"expr": {"kind": "column", "name": "k"},
+                               "name": "k"}],
+                "aggs": [{"fn": "sum", "mode": "partial", "name": "w",
+                          "args": [{"kind": "column", "name": "w"}]}],
+                "input": {"kind": "parquet_scan", "schema": dschema,
+                          "file_groups": [[dpath]]}}}}
+    plan = {
+        "kind": "hash_agg",
+        "groupings": [],
+        "aggs": [{"fn": "count", "mode": "partial", "name": "cnt",
+                  "args": [{"kind": "column", "index": 0}]},
+                 {"fn": "sum", "mode": "partial", "name": "wsum",
+                  "args": [{"kind": "column", "index": 3}]}],
+        "input": {"kind": "broadcast_join", "join_type": "inner",
+                  "left": {"kind": "parquet_scan", "schema": fschema,
+                           "file_groups": [[fpaths[0]], [fpaths[1]]]},
+                  "right": build,
+                  "left_keys": [{"kind": "column", "index": 0}],
+                  "right_keys": [{"kind": "column", "index": 0}],
+                  "build_side": "right",
+                  "broadcast_id": f"t-{_uuid.uuid4().hex[:8]}"}}
+    got = DagScheduler(work_dir=str(tmp_path / "dag")).run_collect(plan)
+    df = got.to_pandas()  # one partial row per result task
+    f = fact.to_pandas()
+    d = dim.to_pandas().groupby("k", as_index=False).w.sum()
+    j = f.merge(d, on="k")
+    assert int(df.iloc[:, 0].sum()) == len(j)  # every fact row matched once
+    np.testing.assert_allclose(float(df.iloc[:, 1].sum()),
+                               float(j.w.sum()), rtol=1e-9)
