@@ -269,6 +269,11 @@ FUSED_STAGE_CAPACITY = int_conf(
 SORT_SPILL_BATCHES = int_conf(
     "auron.tpu.sort.inmem.batches", 64,
     "Batches buffered in device memory before external sort spills a run.")
+UDF_FALLBACK_ENABLE = bool_conf(
+    "auron.udf.fallback.enable", True,
+    "Wrap unsupported expressions as host-evaluated UDFs during plan "
+    "conversion (convertExprWithFallback, NativeConverters.scala:399) "
+    "instead of rejecting the subtree.")
 PLACEMENT = str_conf(
     "auron.tpu.placement", "auto",
     "Stage-compute placement: 'auto' probes accelerator dispatch RTT once "

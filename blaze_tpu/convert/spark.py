@@ -52,6 +52,15 @@ class ConversionResult:
     output_ids: List[int]           # exprIds of the root's output attrs
     output_names: List[str]
     converted_nodes: List[str] = field(default_factory=list)
+    # expressions wrapped by the UDF fallback: the driver registers a
+    # host evaluator under `udf://<name>` for each BEFORE executing
+    # (the SparkAuronUDFWrapperContext registration step)
+    wrapped_udfs: List[Dict[str, str]] = field(default_factory=list)
+
+
+import threading as _threading
+
+_wrap_ctx = _threading.local()
 
 
 # ---------------------------------------------------------------------------
@@ -299,6 +308,49 @@ def convert_expr(node: dict, scope: Scope) -> Dict[str, Any]:
                              "resource and use kind=udf)")
 
 
+def _unparse(node: dict) -> dict:
+    """Tree back to plain JSON (children nested) — the `serialized`
+    payload a host-side evaluator receives for wrapped expressions."""
+    out = {k: v for k, v in node.items() if k != "__children"}
+    out["children"] = [_unparse(c) for c in node["__children"]]
+    return out
+
+
+def convert_expr_with_fallback(node: dict, scope: Scope) -> Dict[str, Any]:
+    """convertExprWithFallback (NativeConverters.scala:399): unsupported
+    expressions wrap into a host-evaluated UDF whose params are the
+    (recursively converted) children.  Execution requires the host to
+    register the evaluator under `udf://<name>` (the
+    SparkAuronUDFWrapperContext analog, bridge/host_callbacks.py)."""
+    if _cls(node) == "Alias":  # transparent: wrap the aliased child
+        return convert_expr_with_fallback(node["__children"][0], scope)
+    try:
+        return convert_expr(node, scope)
+    except ConversionError as err:
+        if not config.UDF_FALLBACK_ENABLE.get():
+            raise
+        c = _cls(node)
+        dt = node.get("dataType")
+        if dt is None:
+            raise ConversionError(
+                c, f"cannot wrap (no dataType); inner: {err.reason}")
+        import hashlib
+        import json as _json
+        serialized = _json.dumps(_unparse(node), sort_keys=True,
+                                 default=str)
+        digest = hashlib.sha256(serialized.encode()).hexdigest()[:10]
+        args = [convert_expr_with_fallback(a, scope)
+                for a in node["__children"]]
+        name = f"spark:{c}#{digest}"
+        sink = getattr(_wrap_ctx, "items", None)
+        if sink is not None:
+            sink.append({"name": name, "class": c,
+                         "serialized": serialized})
+        return {"kind": "udf", "name": name,
+                "args": args, "type": _type_from_catalyst(dt),
+                "serialized": serialized}
+
+
 def _parse_literal(v, t: Dict[str, Any]):
     """toJSON renders literal values as strings; coerce to the type."""
     if v is None:
@@ -351,8 +403,13 @@ def convert_spark_plan(plan_json, num_partitions: int = 1
         raise ConversionError("<plan>", "disabled by auron.enabled")
     root = _tree(plan_json)
     converted: List[str] = []
-    plan, scope = _convert_node(root, num_partitions, converted)
-    return ConversionResult(plan, scope.ids, scope.names, converted)
+    _wrap_ctx.items = []
+    try:
+        plan, scope = _convert_node(root, num_partitions, converted)
+        return ConversionResult(plan, scope.ids, scope.names, converted,
+                                wrapped_udfs=list(_wrap_ctx.items))
+    finally:
+        _wrap_ctx.items = None
 
 
 def _convert_node(node: dict, parts: int, log: List[str]
@@ -393,7 +450,8 @@ def _convert_node(node: dict, parts: int, log: List[str]
         exprs = _expr_list(node.get("projectList"))
         ids, names = _attrs_of(exprs)
         return ({"kind": "project", "input": child,
-                 "exprs": [convert_expr(e, scope) for e in exprs],
+                 "exprs": [convert_expr_with_fallback(e, scope)
+                           for e in exprs],
                  "names": names},
                 Scope(ids, names))
 
@@ -402,7 +460,8 @@ def _convert_node(node: dict, parts: int, log: List[str]
         child, scope = _convert_node(ch[0], parts, log)
         cond = _expr_tree(node.get("condition"))
         return ({"kind": "filter", "input": child,
-                 "predicates": [convert_expr(cond, scope)]}, scope)
+                 "predicates": [convert_expr_with_fallback(cond, scope)]},
+                scope)
 
     if c == "SortExec":
         _gate("sort", c)
@@ -497,7 +556,185 @@ def _convert_node(node: dict, parts: int, log: List[str]
                  "projections": projections, "names": names},
                 Scope(ids, names))
 
+    if c == "WindowExec":
+        _gate("window", c)
+        return _convert_window(node, parts, log)
+
+    if c == "WindowGroupLimitExec":
+        _gate("window.group.limit", c)
+        # the engine (and the proto WindowGroupLimit, auron.proto:600)
+        # filter with RANK semantics: exact for Rank, a safe superset for
+        # RowNumber (the downstream filter still applies) — but DenseRank
+        # keeps rows rank-filtering would wrongly drop
+        rank_fn = _expr_tree(node.get("rankLikeFunction"))
+        if rank_fn is not None and _cls(rank_fn) == "DenseRank":
+            raise ConversionError(
+                c, "DenseRank group-limit has no rank-filter encoding")
+        child, scope = _convert_node(ch[0], parts, log)
+        order = _sort_specs(_expr_list(node.get("orderSpec")), scope)
+        part_by = [convert_expr(e, scope)
+                   for e in _expr_list(node.get("partitionSpec"))]
+        # rank-filter only: no output column added (proto auron.proto:600
+        # window_group_limit; engine: WindowExec(funcs=[], group_limit=k))
+        return ({"kind": "window", "input": child, "functions": [],
+                 "partition_by": part_by, "order_by": order,
+                 "group_limit": int(node.get("limit", 0))}, scope)
+
+    if c == "GenerateExec":
+        _gate("generate", c)
+        return _convert_generate(node, parts, log)
+
     raise ConversionError(c, "unsupported plan node")
+
+
+_WINDOW_RANK_CLASSES = {
+    "RowNumber": "row_number", "Rank": "rank", "DenseRank": "dense_rank",
+    "PercentRank": "percent_rank", "CumeDist": "cume_dist",
+}
+
+
+def _convert_window(node: dict, parts: int, log: List[str]
+                    ) -> Tuple[Dict[str, Any], Scope]:
+    c = "WindowExec"
+    ch = node["__children"]
+    child, scope = _convert_node(ch[0], parts, log)
+    part_by = [convert_expr(e, scope)
+               for e in _expr_list(node.get("partitionSpec"))]
+    order = _sort_specs(_expr_list(node.get("orderSpec")), scope)
+    functions = []
+    out_ids = list(scope.ids)
+    out_names = list(scope.names)
+    for we in _expr_list(node.get("windowExpression")):
+        if _cls(we) != "Alias":
+            raise ConversionError(_cls(we),
+                                  "expected Alias(WindowExpression)")
+        name = we.get("name", f"w{len(functions)}")
+        wid = _expr_id(we)
+        wex = we["__children"][0]
+        if _cls(wex) != "WindowExpression":
+            raise ConversionError(_cls(wex), "expected WindowExpression")
+        fn = wex["__children"][0]
+        fcls = _cls(fn)
+        fch = fn["__children"]
+        if fcls == "AggregateExpression" and len(wex["__children"]) > 1:
+            # the engine's running aggregate implements the DEFAULT frame
+            # (RANGE UNBOUNDED PRECEDING .. CURRENT ROW); any other frame
+            # would convert silently into wrong values
+            _check_default_frame(wex["__children"][1])
+        if fcls in _WINDOW_RANK_CLASSES:
+            functions.append({"kind": _WINDOW_RANK_CLASSES[fcls],
+                              "name": name})
+        elif fcls in ("Lead", "Lag"):
+            d: Dict[str, Any] = {"kind": fcls.lower(), "name": name,
+                                 "expr": convert_expr(fch[0], scope)}
+            if len(fch) > 1 and _cls(fch[1]) == "Literal":
+                d["offset"] = int(fch[1].get("value", 1))
+            if len(fch) > 2 and _cls(fch[2]) == "Literal" \
+                    and fch[2].get("value") is not None:
+                t = _type_from_catalyst(fch[2].get("dataType"))
+                d["default"] = _parse_literal(fch[2].get("value"), t)
+            functions.append(d)
+        elif fcls == "NthValue":
+            d = {"kind": "nth_value", "name": name,
+                 "expr": convert_expr(fch[0], scope),
+                 "ignore_nulls": bool(node.get("ignoreNulls", False)
+                                      or fn.get("ignoreNulls", False))}
+            if len(fch) > 1 and _cls(fch[1]) == "Literal":
+                d["n"] = int(fch[1].get("value", 1))
+            functions.append(d)
+        elif fcls == "AggregateExpression":
+            agg_fn = fch[0]
+            afcls = _cls(agg_fn)
+            fn_name = _AGG_FNS.get(afcls)
+            if fn_name is None:
+                raise ConversionError(afcls,
+                                      "unsupported window aggregate")
+            functions.append({
+                "kind": "agg", "fn": fn_name, "name": name,
+                "args": [convert_expr_with_fallback(a, scope)
+                         for a in agg_fn["__children"]]})
+        else:
+            raise ConversionError(fcls, "unsupported window function")
+        out_ids.append(wid)
+        out_names.append(name)
+    return ({"kind": "window", "input": child, "functions": functions,
+             "partition_by": part_by, "order_by": order},
+            Scope(out_ids, out_names))
+
+
+def _check_default_frame(spec: dict) -> None:
+    """Reject aggregate-over-window frames the engine cannot honor."""
+    for n in _walk_tree(spec):
+        if _cls(n) == "SpecifiedWindowFrame":
+            bounds = [_cls(b) for b in n["__children"]]
+            ftype = str(n.get("frameType", ""))
+            ok = ("Unbounded" in (bounds[0] if bounds else "")
+                  and "CurrentRow" in (bounds[1] if len(bounds) > 1
+                                       else "")
+                  and "Row" not in ftype)
+            if not ok:
+                raise ConversionError(
+                    "SpecifiedWindowFrame",
+                    f"unsupported window frame {ftype} {bounds} (only "
+                    f"the default RANGE UNBOUNDED PRECEDING..CURRENT "
+                    f"ROW converts)")
+
+
+def _walk_tree(node: dict):
+    yield node
+    for c in node.get("__children", []):
+        yield from _walk_tree(c)
+
+
+_GENERATOR_CLASSES = {"Explode": ("explode", False),
+                      "PosExplode": ("posexplode", True)}
+
+
+def _convert_generate(node: dict, parts: int, log: List[str]
+                      ) -> Tuple[Dict[str, Any], Scope]:
+    c = "GenerateExec"
+    ch = node["__children"]
+    child, scope = _convert_node(ch[0], parts, log)
+    gen_node = _expr_tree(node.get("generator"))
+    if gen_node is None:
+        raise ConversionError(c, "missing generator")
+    gcls = _cls(gen_node)
+    outer = bool(node.get("outer", False))
+    if gcls in _GENERATOR_CLASSES:
+        kind, _pos = _GENERATOR_CLASSES[gcls]
+        gen: Dict[str, Any] = {
+            "kind": kind, "outer": outer,
+            "child": convert_expr(gen_node["__children"][0], scope)}
+    elif gcls == "JsonTuple":
+        gch = gen_node["__children"]
+        fields = []
+        for f in gch[1:]:
+            if _cls(f) != "Literal":
+                raise ConversionError("JsonTuple", "non-literal field")
+            fields.append(str(f.get("value")))
+        gen = {"kind": "json_tuple", "outer": outer,
+               "child": convert_expr(gch[0], scope), "fields": fields}
+    else:
+        raise ConversionError(gcls, "unsupported generator")
+    req_attrs = _expr_list(node.get("requiredChildOutput"))
+    req_names = []
+    req_ids = []
+    for a in req_attrs:
+        req_ids.append(_expr_id(a))
+        req_names.append(a.get("name", ""))
+    gen_attrs = _expr_list(node.get("generatorOutput"))
+    gids, gnames = _attrs_of(gen_attrs)
+    required_cols = [scope._index[i] for i in req_ids
+                     if i in scope._index]
+    out_names = req_names + gnames
+    # the engine generator names its output columns itself (col/pos);
+    # rename to the Catalyst generatorOutput attribute names so parents
+    # bind the names Spark assigned
+    d = {"kind": "rename_columns",
+         "input": {"kind": "generate", "input": child, "generator": gen,
+                   "required_cols": required_cols},
+         "names": out_names}
+    return d, Scope(req_ids + gids, out_names)
 
 
 def _partitioning(p, scope: Scope, parts: int) -> Dict[str, Any]:
@@ -575,7 +812,8 @@ def _convert_join(node: dict, parts: int, log: List[str]
     cond = _expr_tree(node.get("condition"))
     if cond is not None:
         _gate("native.join.condition", c)
-        d["join_filter"] = convert_expr(cond, Scope.concat(lscope, rscope))
+        d["join_filter"] = convert_expr_with_fallback(
+            cond, Scope.concat(lscope, rscope))
     return d, _join_output_scope(jt, lscope, rscope)
 
 

@@ -1,0 +1,106 @@
+"""Conversion strategy: per-node convertibility tagging + island removal.
+
+Parity: AuronConvertStrategy (AuronConvertStrategy.scala:49 `apply` tags
+every plan node with convertibleTag/convertStrategyTag/
+neverConvertReasonTag before AuronConverters rewrites the tree, and
+`removeInefficientConverts` (:205) un-converts native islands whose
+row<->columnar boundary cost exceeds their benefit).
+
+The executable path (`convert_spark_plan`) still requires a fully
+convertible tree — this engine has no Spark to hand the remainder back
+to.  What this module provides is the decision layer in front of it:
+which subtrees WOULD convert, why the others won't (the neverConvertReason
+surfaced in the reference's UI fallback tab), and which convertible nodes
+should stay un-converted because they'd be isolated islands.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from blaze_tpu.convert.spark import (ConversionError, _cls, _convert_node,
+                                     _tree)
+
+
+@dataclass
+class NodeTag:
+    """convertibleTag + neverConvertReasonTag for one plan node."""
+
+    node_class: str
+    convertible: bool
+    reason: str = ""                      # neverConvertReason
+    children: List["NodeTag"] = field(default_factory=list)
+
+    def walk(self):
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+
+# nodes that are pure plumbing — never counted as islands and never
+# demoted (the reference's AlwaysConvert set: scans and exchanges keep
+# their native form regardless of neighbors)
+_ALWAYS_CONVERT = {
+    "FileSourceScanExec", "ShuffleExchangeExec", "BroadcastExchangeExec",
+}
+_TRANSPARENT = {
+    "InputAdapter", "WholeStageCodegenExec", "AQEShuffleReadExec",
+    "ShuffleQueryStageExec", "ColumnarToRowExec", "RowToColumnarExec",
+    "AdaptiveSparkPlanExec",
+}
+
+
+def tag_plan(plan_json, num_partitions: int = 1) -> NodeTag:
+    """AuronConvertStrategy.apply: attempt conversion of every subtree
+    and record per-node convertibility with reasons."""
+    root = _tree(plan_json)
+    return _tag(root, num_partitions)
+
+
+def _tag(node: dict, parts: int) -> NodeTag:
+    c = _cls(node)
+    children = [ch for ch in node["__children"]]
+    try:
+        _convert_node(node, parts, [])
+        ok, reason = True, ""
+    except ConversionError as e:
+        ok, reason = False, f"{e.node_class}: {e.reason}"
+    except Exception as e:  # malformed JSON etc.
+        ok, reason = False, f"{c}: {e}"
+    return NodeTag(c, ok, reason, [_tag(ch, parts) for ch in children])
+
+
+def remove_inefficient_converts(tag: NodeTag,
+                                parent_convertible: Optional[bool] = None
+                                ) -> NodeTag:
+    """removeInefficientConverts (AuronConvertStrategy.scala:205): a
+    convertible node surrounded by unconvertible neighbors is an island —
+    each boundary pays a row<->columnar transition, so isolated islands
+    convert at a loss and are demoted (unless always-convert)."""
+    if tag.convertible and tag.node_class not in _ALWAYS_CONVERT \
+            and tag.node_class not in _TRANSPARENT:
+        parent_native = bool(parent_convertible)
+        children_native = any(c.convertible for c in tag.children)
+        has_children = bool(tag.children)
+        if not parent_native and has_children and not children_native:
+            tag = NodeTag(tag.node_class, False,
+                          "inefficient isolated conversion "
+                          "(removeInefficientConverts)", tag.children)
+    tag.children = [remove_inefficient_converts(c, tag.convertible)
+                    for c in tag.children]
+    return tag
+
+
+def explain(tag: NodeTag) -> str:
+    """The fallback report (what the reference's Auron UI tab shows)."""
+    lines = []
+
+    def rec(t: NodeTag, depth: int):
+        mark = "native" if t.convertible else f"FALLBACK [{t.reason}]"
+        lines.append("  " * depth + f"{t.node_class}: {mark}")
+        for ch in t.children:
+            rec(ch, depth + 1)
+
+    rec(tag, 0)
+    return "\n".join(lines)

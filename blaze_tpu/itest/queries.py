@@ -1,13 +1,16 @@
 """TPC-DS progression queries as plan-IR dicts (BASELINE.md configs).
 
-Parity role: dev/auron-it query set.  Unlike round 1 (hand-built operator
-objects), every query here is a JSON-IR plan dict decoded through
-`blaze_tpu.plan.create_plan` — the same vocabulary the protobuf wire
-boundary maps onto — so the itest tier exercises the planner path
-end-to-end (VERDICT r1 weak #9).  Fact tables are read from parquet file
-splits; exchanges are `local_exchange` nodes; aggregations use
-partial/final pairs exactly as a Spark plan would emit them (COMPLETE has
-no wire encoding).
+Parity role: dev/auron-it query set.  DEMOTED to the secondary tier
+since round 3: the PRIMARY integration tier is
+tests/test_spark_fixtures.py, which drives the same queries from
+checked-in Spark `toJSON` fixtures (itest/spark_plans.py) through the
+L6 converter, the stage-DAG scheduler, and per-task protobuf
+TaskDefinitions — the full production path.  This module remains the
+oracle source (shared with the fixture tier) and the direct-IR
+regression net for the in-process planner path.  Fact tables are read
+from parquet file splits; exchanges are `local_exchange` nodes;
+aggregations use partial/final pairs exactly as a Spark plan would emit
+them (COMPLETE has no wire encoding).
 
 Queries:
   q01 — customers returning >1.2x their store's average (config #1)

@@ -1,0 +1,553 @@
+"""TPC-DS progression queries as Spark `toJSON` physical-plan JSON.
+
+Parity role: the plan corpus the L6 converter consumes in production
+(AuronConverters.scala:189 receives executed SparkPlans; here the same
+trees arrive as their TreeNode.toJSON rendering, the format a thin JVM
+shim emits — convert/spark.py module docstring).  These builders author
+the plans in SPARK's vocabulary — FileSourceScanExec / FilterExec /
+BroadcastHashJoinExec / HashAggregateExec(Partial|Final) /
+ShuffleExchangeExec / ExpandExec / TakeOrderedAndProjectExec — with
+Catalyst exprId-based attribute identity, exactly as Spark 3.5 serializes
+them (verified against the field names NativeConverters.scala:140-213 and
+AuronConverters.scala:212-271 consume).  No JVM exists in this
+environment, so the corpus is synthesized rather than captured from a
+live Spark; the checked-in fixtures under tests/fixtures/ pin the JSON
+byte-for-byte so any converter change against the format is visible in
+review.
+
+Each builder returns (plan_json_array, oracle) where the oracle is shared
+with itest/queries.py (QueryResultComparator analog).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+from blaze_tpu.itest import queries as Q
+
+CAT = "org.apache.spark.sql.catalyst.expressions."
+EXEC = "org.apache.spark.sql.execution."
+
+_ids = itertools.count(1000)
+
+
+def _reset_ids() -> None:
+    global _ids
+    _ids = itertools.count(1000)
+
+
+def _catalyst_type(t: pa.DataType) -> Any:
+    if pa.types.is_int64(t):
+        return "long"
+    if pa.types.is_int32(t):
+        return "integer"
+    if pa.types.is_float64(t):
+        return "double"
+    if pa.types.is_float32(t):
+        return "float"
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return "string"
+    if pa.types.is_boolean(t):
+        return "boolean"
+    if pa.types.is_date32(t):
+        return "date"
+    if pa.types.is_list_(t):
+        return {"type": "array",
+                "elementType": _catalyst_type(t.value_type),
+                "containsNull": True}
+    raise TypeError(f"no catalyst mapping for {t}")
+
+
+class A:
+    """A Catalyst attribute: stable (name, dataType, exprId)."""
+
+    def __init__(self, name: str, dt: Any, eid: Optional[int] = None):
+        self.name = name
+        self.dt = dt
+        self.id = next(_ids) if eid is None else eid
+
+    def ref(self) -> List[dict]:
+        return [{"class": CAT + "AttributeReference", "num-children": 0,
+                 "name": self.name, "dataType": self.dt, "nullable": True,
+                 "metadata": {},
+                 "exprId": {"product-class":
+                            CAT + "ExprId", "id": self.id, "jvmId": "u"},
+                 "qualifier": []}]
+
+
+def lit(value, dt) -> List[dict]:
+    return [{"class": CAT + "Literal", "num-children": 0,
+             "value": None if value is None else str(value),
+             "dataType": dt}]
+
+
+def e2(cls: str, l: List[dict], r: List[dict]) -> List[dict]:
+    return [{"class": CAT + cls, "num-children": 2}] + l + r
+
+
+def not_(child: List[dict]) -> List[dict]:
+    return [{"class": CAT + "Not", "num-children": 1}] + child
+
+
+def alias(child: List[dict], a: A) -> List[dict]:
+    return [{"class": CAT + "Alias", "num-children": 1, "name": a.name,
+             "exprId": {"id": a.id, "jvmId": "u"}}] + child
+
+
+def in_list(child: List[dict], values: List[str], dt: str) -> List[dict]:
+    items = [lit(v, dt) for v in values]
+    out = [{"class": CAT + "In",
+            "num-children": 1 + len(items)}] + child
+    for i in items:
+        out += i
+    return out
+
+
+def sort_order(child: List[dict], desc: bool = False) -> List[dict]:
+    return [{"class": CAT + "SortOrder", "num-children": 1,
+             "direction": "Descending" if desc else "Ascending",
+             "nullOrdering": "NullsLast" if desc else "NullsFirst"}] + child
+
+
+def agg_expr(fn_cls: str, arg: Optional[List[dict]], mode: str,
+             result: A) -> List[dict]:
+    fn = [{"class": CAT + f"aggregate.{fn_cls}",
+           "num-children": 1 if arg else 0}] + (arg or [])
+    return [{"class": CAT + "aggregate.AggregateExpression",
+             "num-children": 1, "mode": mode, "isDistinct": False,
+             "resultId": {"id": result.id, "jvmId": "u"}}] + fn
+
+
+def node(cls: str, fields: dict, children: List[List[dict]]) -> List[dict]:
+    out = [{"class": EXEC + cls, "num-children": len(children), **fields}]
+    for c in children:
+        out += c
+    return out
+
+
+class Table:
+    """Scan-side attribute book-keeping for one table."""
+
+    def __init__(self, name: str, arrow: pa.Table,
+                 files: List[List[str]]):
+        self.name = name
+        self.files = files
+        self.attrs: Dict[str, A] = {
+            f.name: A(f.name, _catalyst_type(f.type))
+            for f in arrow.schema}
+
+    def a(self, col: str) -> A:
+        return self.attrs[col]
+
+    def scan(self, cols: Optional[List[str]] = None) -> List[dict]:
+        names = cols or list(self.attrs)
+        return [{"class": EXEC + "FileSourceScanExec", "num-children": 0,
+                 "output": [self.attrs[n].ref() for n in names],
+                 "files": self.files}]
+
+
+def filter_(cond: List[dict], child: List[dict]) -> List[dict]:
+    return node("FilterExec", {"condition": [cond]}, [child])
+
+
+def project(named: List[List[dict]], child: List[dict]) -> List[dict]:
+    return node("ProjectExec", {"projectList": named}, [child])
+
+
+def exchange(keys: List[A], n: int, child: List[dict]) -> List[dict]:
+    part = [{"class": CAT + "HashPartitioning",
+             "num-children": len(keys), "numPartitions": n}]
+    for k in keys:
+        part += k.ref()
+    return node("exchange.ShuffleExchangeExec",
+                {"outputPartitioning": part}, [child])
+
+
+def single_exchange(child: List[dict]) -> List[dict]:
+    return node("exchange.ShuffleExchangeExec",
+                {"outputPartitioning": [
+                    {"class": CAT + "SinglePartition$",
+                     "num-children": 0}]}, [child])
+
+
+def bcast(child: List[dict]) -> List[dict]:
+    return node("exchange.BroadcastExchangeExec", {}, [child])
+
+
+def sort(keys: List[A], child: List[dict], desc: bool = False
+         ) -> List[dict]:
+    return node("SortExec",
+                {"sortOrder": [sort_order(k.ref(), desc) for k in keys]},
+                [child])
+
+
+def _join(cls: str, lkeys: List[A], rkeys: List[A], left: List[dict],
+          right: List[dict], jt: str = "Inner",
+          build: Optional[str] = "BuildRight",
+          cond: Optional[List[dict]] = None) -> List[dict]:
+    fields: Dict[str, Any] = {
+        "leftKeys": [k.ref() for k in lkeys],
+        "rightKeys": [k.ref() for k in rkeys],
+        "joinType": jt}
+    if build is not None:
+        fields["buildSide"] = build
+    if cond is not None:
+        fields["condition"] = [cond]
+    return node(cls, fields, [left, right])
+
+
+def bhj(lkeys, rkeys, left, right, jt="Inner", cond=None) -> List[dict]:
+    return _join("joins.BroadcastHashJoinExec", lkeys, rkeys, left,
+                 bcast(right), jt=jt, cond=cond)
+
+
+def shj(lkeys, rkeys, left, right, jt="Inner", cond=None) -> List[dict]:
+    return _join("joins.ShuffledHashJoinExec", lkeys, rkeys, left, right,
+                 jt=jt, cond=cond)
+
+
+def smj(lkeys, rkeys, left, right, jt="Inner", cond=None) -> List[dict]:
+    return _join("joins.SortMergeJoinExec", lkeys, rkeys,
+                 sort(lkeys, left), sort(rkeys, right), jt=jt, build=None,
+                 cond=cond)
+
+
+def hash_agg(groups: List[A],
+             aggs: List[Tuple[str, Optional[List[dict]], str, A]],
+             child: List[dict]) -> List[dict]:
+    return node("aggregate.HashAggregateExec",
+                {"groupingExpressions": [g.ref() for g in groups],
+                 "aggregateExpressions": [
+                     agg_expr(fn, arg, mode, res)
+                     for fn, arg, mode, res in aggs]},
+                [child])
+
+
+def partial_final(groups: List[A],
+                  fns: List[Tuple[str, List[dict], A]],
+                  partitions: int, child: List[dict],
+                  with_exchange: bool = True) -> List[dict]:
+    """The Partial -> (exchange) -> Final pair Spark emits."""
+    partial = hash_agg(groups, [(fn, arg, "Partial", res)
+                                for fn, arg, res in fns], child)
+    mid = exchange(groups, partitions, partial) if with_exchange \
+        else partial
+    return hash_agg(groups, [(fn, None, "Final", res)
+                             for fn, _arg, res in fns], mid)
+
+
+def take_ordered(limit: int, keys: List[A], proj: List[A],
+                 child: List[dict]) -> List[dict]:
+    return node("TakeOrderedAndProjectExec",
+                {"limit": limit,
+                 "sortOrder": [sort_order(k.ref()) for k in keys],
+                 "projectList": [p.ref() for p in proj]},
+                [child])
+
+
+# ===========================================================================
+# queries — structures mirror itest/queries.py (oracles are shared)
+# ===========================================================================
+
+def q01(paths, tables, partitions: int = 2):
+    _reset_ids()
+    sr = Table("store_returns", tables["store_returns"],
+               paths["store_returns"])
+    dd = Table("date_dim", tables["date_dim"], paths["date_dim"])
+    st = Table("store", tables["store"], paths["store"])
+    cu = Table("customer", tables["customer"], paths["customer"])
+
+    dd_flt = filter_(e2("EqualTo", dd.a("d_year").ref(),
+                        lit(2000, "integer")), dd.scan())
+    sr_dd = bhj([sr.a("sr_returned_date_sk")], [dd.a("d_date_sk")],
+                sr.scan(), dd_flt)
+
+    total = A("ctr_total_return", "double")
+    ctr = partial_final(
+        [sr.a("sr_customer_sk"), sr.a("sr_store_sk")],
+        [("Sum", sr.a("sr_return_amt").ref(), total)],
+        partitions, sr_dd)
+
+    avg_ret = A("avg_return", "double")
+    avg_by_store = partial_final(
+        [sr.a("sr_store_sk")], [("Average", total.ref(), avg_ret)],
+        partitions,
+        exchange([sr.a("sr_store_sk")], partitions, ctr),
+        with_exchange=False)
+
+    ctr2 = exchange([sr.a("sr_store_sk")], partitions, ctr)
+    joined = smj([sr.a("sr_store_sk")], [sr.a("sr_store_sk")],
+                 ctr2, avg_by_store)
+    flt = filter_(e2("GreaterThan", total.ref(),
+                     e2("Multiply", avg_ret.ref(),
+                        lit(1.2, "double"))), joined)
+    st_flt = filter_(e2("EqualTo", st.a("s_state").ref(),
+                        lit("TN", "string")), st.scan())
+    j_store = bhj([sr.a("sr_store_sk")], [st.a("s_store_sk")], flt,
+                  st_flt)
+    j_cust = bhj([sr.a("sr_customer_sk")], [cu.a("c_customer_sk")],
+                 j_store, cu.scan())
+    cid = cu.a("c_customer_id")
+    plan = take_ordered(100, [cid], [cid],
+                        project([cid.ref()], j_cust))
+
+    _plan, oracle = Q.q01(paths, tables, partitions)
+    return plan, oracle
+
+
+def q06(paths, tables, partitions: int = 4):
+    _reset_ids()
+    ss = Table("store_sales", tables["store_sales"],
+               paths["store_sales"])
+    it = Table("item", tables["item"], paths["item"])
+    it2 = Table("item", tables["item"], paths["item"])  # second scan
+
+    avg_price = A("avg_price", "double")
+    cat_avg = partial_final(
+        [it2.a("i_category")],
+        [("Average", it2.a("i_current_price").ref(), avg_price)],
+        partitions, it2.scan(), with_exchange=False)
+    it_j = bhj([it.a("i_category")], [it2.a("i_category")], it.scan(),
+               cat_avg)
+    it_flt = filter_(e2("GreaterThan", it.a("i_current_price").ref(),
+                        e2("Multiply", avg_price.ref(),
+                           lit(1.2, "double"))), it_j)
+    ss_j = bhj([ss.a("ss_item_sk")], [it.a("i_item_sk")], ss.scan(),
+               it_flt)
+    cnt = A("cnt", "long")
+    counted = partial_final(
+        [ss.a("ss_store_sk")],
+        [("Count", ss.a("ss_sold_date_sk").ref(), cnt)],
+        partitions, ss_j)
+    plan = sort([ss.a("ss_store_sk")], single_exchange(counted))
+
+    _plan, oracle = Q.q06(paths, tables, partitions)
+    return plan, oracle
+
+
+def q17(paths, tables, partitions: int = 4):
+    _reset_ids()
+    ss = Table("store_sales", tables["store_sales"],
+               paths["store_sales"])
+    sr = Table("store_returns", tables["store_returns"],
+               paths["store_returns"])
+    cs = Table("catalog_sales", tables["catalog_sales"],
+               paths["catalog_sales"])
+    st = Table("store", tables["store"], paths["store"])
+    it = Table("item", tables["item"], paths["item"])
+
+    def window(tbl, col, lo, hi):
+        return filter_(
+            e2("And",
+               e2("GreaterThanOrEqual", tbl.a(col).ref(),
+                  lit(lo, "long")),
+               e2("LessThanOrEqual", tbl.a(col).ref(),
+                  lit(hi, "long"))), tbl.scan())
+
+    ss_f = window(ss, "ss_sold_date_sk", *Q.SS_WINDOW)
+    sr_f = window(sr, "sr_returned_date_sk", *Q.SR_CS_WINDOW)
+    cs_f = window(cs, "cs_sold_date_sk", *Q.SR_CS_WINDOW)
+
+    ss_ex = exchange([ss.a("ss_ticket_number"), ss.a("ss_item_sk")],
+                     partitions, ss_f)
+    sr_ex = exchange([sr.a("sr_ticket_number"), sr.a("sr_item_sk")],
+                     partitions, sr_f)
+    ss_sr = shj([ss.a("ss_ticket_number"), ss.a("ss_item_sk")],
+                [sr.a("sr_ticket_number"), sr.a("sr_item_sk")],
+                ss_ex, sr_ex)
+
+    left_ex = exchange([sr.a("sr_customer_sk"), sr.a("sr_item_sk")],
+                       partitions, ss_sr)
+    cs_ex = exchange([cs.a("cs_bill_customer_sk"), cs.a("cs_item_sk")],
+                     partitions, cs_f)
+    three = shj([sr.a("sr_customer_sk"), sr.a("sr_item_sk")],
+                [cs.a("cs_bill_customer_sk"), cs.a("cs_item_sk")],
+                left_ex, cs_ex)
+
+    j_it = bhj([ss.a("ss_item_sk")], [it.a("i_item_sk")], three,
+               it.scan())
+    j_st = bhj([ss.a("ss_store_sk")], [st.a("s_store_sk")], j_it,
+               st.scan())
+
+    res = [A("store_sales_cnt", "long"), A("store_sales_avg", "double"),
+           A("store_returns_cnt", "long"),
+           A("store_returns_avg", "double"),
+           A("catalog_sales_cnt", "long"),
+           A("catalog_sales_avg", "double")]
+    stats = partial_final(
+        [it.a("i_item_id"), st.a("s_state")],
+        [("Count", ss.a("ss_quantity").ref(), res[0]),
+         ("Average", ss.a("ss_quantity").ref(), res[1]),
+         ("Count", sr.a("sr_return_quantity").ref(), res[2]),
+         ("Average", sr.a("sr_return_quantity").ref(), res[3]),
+         ("Count", cs.a("cs_quantity").ref(), res[4]),
+         ("Average", cs.a("cs_quantity").ref(), res[5])],
+        partitions, j_st)
+    keys = [it.a("i_item_id"), st.a("s_state")]
+    plan = take_ordered(100, keys, keys + res, stats)
+
+    _plan, oracle = Q.q17(paths, tables, partitions)
+    return plan, oracle
+
+
+def q18(paths, tables, partitions: int = 4):
+    _reset_ids()
+    cs = Table("catalog_sales", tables["catalog_sales"],
+               paths["catalog_sales"])
+    cd = Table("customer_demographics", tables["customer_demographics"],
+               paths["customer_demographics"])
+    cu = Table("customer", tables["customer"], paths["customer"])
+    ca = Table("customer_address", tables["customer_address"],
+               paths["customer_address"])
+    it = Table("item", tables["item"], paths["item"])
+
+    cs_f = filter_(
+        e2("And",
+           e2("GreaterThanOrEqual", cs.a("cs_sold_date_sk").ref(),
+              lit(Q.Y1998[0], "long")),
+           e2("LessThanOrEqual", cs.a("cs_sold_date_sk").ref(),
+              lit(Q.Y1998[1], "long"))), cs.scan())
+    cd_f = filter_(
+        e2("And",
+           e2("EqualTo", cd.a("cd_gender").ref(), lit("F", "string")),
+           e2("EqualTo", cd.a("cd_education_status").ref(),
+              lit("Unknown", "string"))), cd.scan())
+    j_cd = bhj([cs.a("cs_bill_cdemo_sk")], [cd.a("cd_demo_sk")], cs_f,
+               cd_f)
+
+    cs_ex = exchange([cs.a("cs_bill_customer_sk")], partitions, j_cd)
+    cu_ex = exchange([cu.a("c_customer_sk")], partitions, cu.scan())
+    j_cu = shj([cs.a("cs_bill_customer_sk")], [cu.a("c_customer_sk")],
+               cs_ex, cu_ex)
+
+    ca_f = filter_(in_list(ca.a("ca_state").ref(), Q.Q18_STATES,
+                           "string"), ca.scan())
+    j_ca = bhj([cu.a("c_current_addr_sk")], [ca.a("ca_address_sk")],
+               j_cu, ca_f)
+    j_it = bhj([cs.a("cs_item_sk")], [it.a("i_item_sk")], j_ca,
+               it.scan())
+
+    # ROLLUP via ExpandExec: 5 grouping sets + grouping id
+    grp = [it.a("i_item_id"), ca.a("ca_country"), ca.a("ca_state"),
+           ca.a("ca_county")]
+    vals = [cs.a("cs_quantity"), cs.a("cs_list_price"),
+            cs.a("cs_coupon_amt"), cs.a("cs_net_profit")]
+    out_attrs = [A("i_item_id", "string"), A("ca_country", "string"),
+                 A("ca_state", "string"), A("ca_county", "string"),
+                 A("g_id", "long"),
+                 A("cs_quantity", "long"), A("cs_list_price", "double"),
+                 A("cs_coupon_amt", "double"),
+                 A("cs_net_profit", "double")]
+    projections = []
+    for kept, gid in ((4, 0), (3, 1), (2, 3), (1, 7), (0, 15)):
+        row = [grp[i].ref() if i < kept else lit(None, "string")
+               for i in range(4)]
+        row.append(lit(gid, "long"))
+        row.extend(v.ref() for v in vals)
+        projections.append(row)
+    expanded = node("ExpandExec",
+                    {"projections": projections,
+                     "output": [a.ref() for a in out_attrs]}, [j_it])
+
+    res = [A("agg1", "double"), A("agg2", "double"), A("agg3", "double"),
+           A("agg4", "double")]
+    stats = partial_final(
+        out_attrs[:5],
+        [("Average", out_attrs[5].ref(), res[0]),
+         ("Average", out_attrs[6].ref(), res[1]),
+         ("Average", out_attrs[7].ref(), res[2]),
+         ("Average", out_attrs[8].ref(), res[3])],
+        partitions, expanded)
+    order = [out_attrs[4]] + out_attrs[:4]
+    plan = take_ordered(100, order, out_attrs[:5] + res, stats)
+
+    _plan, oracle = Q.q18(paths, tables, partitions)
+
+    def reordered_oracle():
+        # queries.py emits [i_item_id..county, g_id, aggs]; this plan's
+        # TakeOrderedAndProject emits the same layout
+        return oracle()
+
+    return plan, reordered_oracle
+
+
+def q95(paths, tables, partitions: int = 4):
+    _reset_ids()
+    ws = Table("web_sales", tables["web_sales"], paths["web_sales"])
+    wh = Table("web_sales", tables["web_sales"], paths["web_sales"])
+    wr = Table("web_returns", tables["web_returns"],
+               paths["web_returns"])
+    ca = Table("customer_address", tables["customer_address"],
+               paths["customer_address"])
+
+    ws1 = filter_(
+        e2("And",
+           e2("And",
+              e2("GreaterThanOrEqual", ws.a("ws_ship_date_sk").ref(),
+                 lit(Q.Q95_WINDOW[0], "long")),
+              e2("LessThanOrEqual", ws.a("ws_ship_date_sk").ref(),
+                 lit(Q.Q95_WINDOW[1], "long"))),
+           e2("LessThanOrEqual", ws.a("ws_web_site_sk").ref(),
+              lit(2, "long"))), ws.scan())
+    ca_f = filter_(e2("EqualTo", ca.a("ca_state").ref(),
+                      lit("IL", "string")), ca.scan())
+    ws1 = bhj([ws.a("ws_ship_addr_sk")], [ca.a("ca_address_sk")], ws1,
+              ca_f)
+    keep = [ws.a("ws_order_number"), ws.a("ws_warehouse_sk"),
+            ws.a("ws_ext_ship_cost"), ws.a("ws_net_profit")]
+    ws1 = project([k.ref() for k in keep], ws1)
+    ws1_ex = exchange([ws.a("ws_order_number")], partitions, ws1)
+
+    wh_on = A("wh_order_number", "long")
+    wh_wh = A("wh_warehouse_sk", "long")
+    ws_all = project(
+        [alias(wh.a("ws_order_number").ref(), wh_on),
+         alias(wh.a("ws_warehouse_sk").ref(), wh_wh)], wh.scan())
+    ws_all_ex = exchange([wh_on], partitions, ws_all)
+
+    semi = shj([ws.a("ws_order_number")], [wh_on], ws1_ex, ws_all_ex,
+               jt="LeftSemi",
+               cond=not_(e2("EqualTo", ws.a("ws_warehouse_sk").ref(),
+                            wh_wh.ref())))
+
+    wr_on = A("wr_order_number", "long")
+    wr_ex = exchange(
+        [wr_on], partitions,
+        project([alias(wr.a("wr_order_number").ref(), wr_on)],
+                wr.scan()))
+    anti = shj([ws.a("ws_order_number")], [wr_on], semi, wr_ex,
+               jt="LeftAnti")
+
+    ship = A("ship_cost", "double")
+    prof = A("net_profit", "double")
+    per_order = partial_final(
+        [ws.a("ws_order_number")],
+        [("Sum", ws.a("ws_ext_ship_cost").ref(), ship),
+         ("Sum", ws.a("ws_net_profit").ref(), prof)],
+        partitions, anti, with_exchange=False)
+
+    oc = A("order_count", "long")
+    tsc = A("total_ship_cost", "double")
+    tnp = A("total_net_profit", "double")
+    plan = partial_final(
+        [],
+        [("Count", ws.a("ws_order_number").ref(), oc),
+         ("Sum", ship.ref(), tsc), ("Sum", prof.ref(), tnp)],
+        1, single_exchange(per_order), with_exchange=False)
+
+    _plan, oracle = Q.q95(paths, tables, partitions)
+    return plan, oracle
+
+
+SPARK_QUERIES = {
+    "q01": (q01, ["store_returns", "date_dim", "store", "customer"]),
+    "q06": (q06, ["store_sales", "item"]),
+    "q17": (q17, ["store_sales", "store_returns", "catalog_sales",
+                  "store", "item"]),
+    "q18": (q18, ["catalog_sales", "customer_demographics", "customer",
+                  "customer_address", "item"]),
+    "q95": (q95, ["web_sales", "web_returns", "customer_address"]),
+}

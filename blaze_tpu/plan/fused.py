@@ -358,7 +358,7 @@ class FusedPartialAggExec(ExecutionPlan):
         n_batches = 0
         if self._prepare is not None:
             step = _dense_chain_step_factory(self._prepare_key,
-                                             self._prepare[0],
+                                             self._prepare,
                                              tuple(self._ranges),
                                              tuple(kinds), num_slots)
             for batch in self._source.execute(partition):
@@ -428,7 +428,7 @@ class FusedPartialAggExec(ExecutionPlan):
             # materialize kd/kv/ad/av between programs)
             stream = self._source.execute(partition)
             raw_step = _hash_chain_step_factory(self._prepare_key,
-                                                self._prepare[0], kinds)
+                                                self._prepare, kinds)
             step = lambda c, b: raw_step(c, *_source_inputs(b))  # noqa: E731
         else:
             stream = self.children[0].execute(partition)
@@ -628,7 +628,7 @@ def _prepare_factory(key, source_schema: Schema, chain, group_exprs,
             for f in source_schema)
         jax.eval_shape(prepare, fake_cols,
                        jax.ShapeDtypeStruct((128,), jnp.bool_))
-        result = (prepare, jax.jit(prepare))
+        result = prepare  # consumers inline it into their own jit step
     except Exception:
         result = None  # strings / host-only exprs: stay on the eager path
     _PREPARE_CACHE[key] = result

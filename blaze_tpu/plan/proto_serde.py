@@ -321,9 +321,15 @@ def expr_from_proto(e: pb.PhysicalExprNode) -> Dict[str, Any]:
                 "r": expr_from_proto(e.sc_or_expr.right)}
     if kind == "spark_udf_wrapper_expr":
         u = e.spark_udf_wrapper_expr
-        return {"kind": "udf", "name": u.expr_string,
-                "args": [expr_from_proto(p) for p in u.params],
-                "type": type_from_proto(u.return_type)}
+        d = {"kind": "udf", "name": u.expr_string,
+             "args": [expr_from_proto(p) for p in u.params],
+             "type": type_from_proto(u.return_type)}
+        payload = u.serialized.decode("utf-8", "backslashreplace")
+        if payload and payload != u.expr_string:
+            # the wrapped-expression payload (converter fallback) rides
+            # the wire so the host evaluator can interpret it
+            d["serialized"] = payload
+        return d
     if kind == "spark_scalar_subquery_wrapper_expr":
         s = e.spark_scalar_subquery_wrapper_expr
         return {"kind": "scalar_subquery",
@@ -525,7 +531,7 @@ def expr_to_proto(d: Dict[str, Any]) -> pb.PhysicalExprNode:
     if k == "udf":
         u = e.spark_udf_wrapper_expr
         u.expr_string = d["name"]
-        u.serialized = d["name"].encode("utf-8")
+        u.serialized = d.get("serialized", d["name"]).encode("utf-8")
         u.return_type.CopyFrom(type_to_proto(d["type"]))
         u.return_nullable = True
         for a in d.get("args", []):

@@ -1,0 +1,284 @@
+"""Converter completeness (VERDICT r2 #5): WindowExec / GenerateExec /
+WindowGroupLimitExec conversion, SparkUDFWrapper-style expression
+fallback, and the convert-strategy tagging + removeInefficientConverts
+pass (ref NativeConverters.scala:399, AuronConvertStrategy.scala:205)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu import config
+from blaze_tpu.bridge.resource import put_resource, remove_resource
+from blaze_tpu.convert import ConversionError, convert_spark_plan
+from blaze_tpu.convert.strategy import (explain, remove_inefficient_converts,
+                                        tag_plan)
+from blaze_tpu.memory import MemManager
+from blaze_tpu.plan import create_plan
+
+CAT = "org.apache.spark.sql.catalyst.expressions."
+EXEC = "org.apache.spark.sql.execution."
+
+
+@pytest.fixture(autouse=True)
+def budget():
+    MemManager.init(4 << 30)
+
+
+def attr(name, dt, eid):
+    return [{"class": CAT + "AttributeReference", "num-children": 0,
+             "name": name, "dataType": dt, "nullable": True,
+             "exprId": {"id": eid, "jvmId": "u"}}]
+
+
+def lit(value, dt):
+    return [{"class": CAT + "Literal", "num-children": 0,
+             "value": value, "dataType": dt}]
+
+
+def sort_order(child, desc=False):
+    return [{"class": CAT + "SortOrder", "num-children": 1,
+             "direction": ("Descending" if desc else "Ascending"),
+             "nullOrdering": ("NullsLast" if desc else "NullsFirst")}] + \
+        child
+
+
+def scan_node(attrs, files):
+    return [{"class": EXEC + "FileSourceScanExec", "num-children": 0,
+             "output": [a for a in attrs], "files": files}]
+
+
+def plan_node(cls, fields, children):
+    out = [{"class": EXEC + cls, "num-children": len(children), **fields}]
+    for c in children:
+        out += c
+    return out
+
+
+def window_expr(fn_nodes, name, eid):
+    """Alias(WindowExpression(fn, WindowSpecDefinition()))"""
+    spec = [{"class": CAT + "WindowSpecDefinition", "num-children": 0}]
+    wex = [{"class": CAT + "WindowExpression", "num-children": 2}] + \
+        fn_nodes + spec
+    return [{"class": CAT + "Alias", "num-children": 1, "name": name,
+             "exprId": {"id": eid, "jvmId": "u"}}] + wex
+
+
+def _write(tmp_path, t, name="t.parquet"):
+    p = str(tmp_path / name)
+    pq.write_table(t, p)
+    return [[p]]
+
+
+def _run(ir):
+    plan = create_plan(ir)
+    out = []
+    for p in range(plan.num_partitions):
+        out.extend(b.compact().to_arrow() for b in plan.execute(p))
+    out = [b for b in out if b.num_rows]
+    return (pa.Table.from_batches(out).to_pandas() if out
+            else pd.DataFrame())
+
+
+# -- WindowExec -------------------------------------------------------------
+
+def test_window_rank_and_agg(tmp_path):
+    # pre-sorted by (g, v): Spark guarantees WindowExec input ordering by
+    # inserting a SortExec below it
+    t = pa.table({"g": pa.array([1, 1, 1, 2, 2], type=pa.int64()),
+                  "v": pa.array([10.0, 20.0, 30.0, 5.0, 7.0])})
+    files = _write(tmp_path, t)
+    g, v = attr("g", "long", 1), attr("v", "double", 2)
+    rn = window_expr([{"class": CAT + "RowNumber", "num-children": 0}],
+                     "rn", 10)
+    sm = window_expr(
+        [{"class": CAT + "aggregate.AggregateExpression",
+          "num-children": 1, "mode": "Complete",
+          "resultId": {"id": 99, "jvmId": "u"}},
+         {"class": CAT + "aggregate.Sum", "num-children": 1}] +
+        attr("v", "double", 2), "running_sum", 11)
+    plan = plan_node(
+        "window.WindowExec",
+        {"windowExpression": [rn, sm],
+         "partitionSpec": [attr("g", "long", 1)],
+         "orderSpec": [sort_order(attr("v", "double", 2))]},
+        [scan_node([g[0], v[0]], files)])
+    res = convert_spark_plan(plan)
+    assert res.output_names == ["g", "v", "rn", "running_sum"]
+    got = _run(res.plan)
+    df = got.sort_values(["g", "v"]).reset_index(drop=True)
+    assert df["rn"].tolist() == [1, 2, 3, 1, 2]
+    np.testing.assert_allclose(df["running_sum"].tolist(),
+                               [10.0, 30.0, 60.0, 5.0, 12.0])
+
+
+def test_window_lead_lag(tmp_path):
+    t = pa.table({"g": pa.array([1, 1, 1], type=pa.int64()),
+                  "v": pa.array([1.0, 2.0, 3.0])})
+    files = _write(tmp_path, t)
+    g, v = attr("g", "long", 1), attr("v", "double", 2)
+    ld = window_expr(
+        [{"class": CAT + "Lead", "num-children": 3}] +
+        attr("v", "double", 2) + lit("1", "integer") + lit(None, "double"),
+        "nxt", 10)
+    plan = plan_node(
+        "window.WindowExec",
+        {"windowExpression": [ld],
+         "partitionSpec": [attr("g", "long", 1)],
+         "orderSpec": [sort_order(attr("v", "double", 2))]},
+        [scan_node([g[0], v[0]], files)])
+    got = _run(convert_spark_plan(plan).plan)
+    vals = got["nxt"].tolist()
+    assert vals[:2] == [2.0, 3.0] and pd.isna(vals[2])
+
+
+def test_window_group_limit(tmp_path):
+    t = pa.table({"g": pa.array([1, 1, 1, 2, 2], type=pa.int64()),
+                  "v": pa.array([10.0, 30.0, 20.0, 5.0, 7.0])})
+    files = _write(tmp_path, t)
+    g, v = attr("g", "long", 1), attr("v", "double", 2)
+    plan = plan_node(
+        "window.WindowGroupLimitExec",
+        {"partitionSpec": [attr("g", "long", 1)],
+         "orderSpec": [sort_order(attr("v", "double", 2))],
+         "limit": 1,
+         "rankLikeFunction": [{"class": CAT + "RowNumber",
+                               "num-children": 0}]},
+        [scan_node([g[0], v[0]], files)])
+    res = convert_spark_plan(plan)
+    assert res.output_names == ["g", "v"]  # filter only, no rank column
+    got = _run(res.plan).sort_values("g").reset_index(drop=True)
+    assert got["v"].tolist() == [10.0, 5.0]  # min v per group
+
+
+# -- GenerateExec -----------------------------------------------------------
+
+def test_generate_explode(tmp_path):
+    t = pa.table({"k": pa.array([1, 2], type=pa.int64()),
+                  "xs": pa.array([[10, 20], [30]],
+                                 type=pa.list_(pa.int64()))})
+    files = _write(tmp_path, t)
+    k = attr("k", "long", 1)
+    xs = [{"class": CAT + "AttributeReference", "num-children": 0,
+           "name": "xs",
+           "dataType": {"type": "array", "elementType": "long",
+                        "containsNull": True},
+           "nullable": True, "exprId": {"id": 2, "jvmId": "u"}}]
+    gen = [{"class": CAT + "Explode", "num-children": 1}] + xs
+    plan = plan_node(
+        "GenerateExec",
+        {"generator": [gen], "outer": False,
+         "requiredChildOutput": [k],
+         "generatorOutput": [attr("x", "long", 3)]},
+        [scan_node([k[0], xs[0]], files)])
+    res = convert_spark_plan(plan)
+    assert res.output_names == ["k", "x"]
+    got = _run(res.plan)
+    assert sorted(zip(got["k"], got["x"])) == [(1, 10), (1, 20), (2, 30)]
+
+
+# -- expression fallback ----------------------------------------------------
+
+def test_unsupported_expr_wraps_as_udf(tmp_path):
+    t = pa.table({"x": pa.array([1, 2, 3], type=pa.int64())})
+    files = _write(tmp_path, t)
+    weird = [{"class": CAT + "ScalaUDF", "num-children": 1,
+              "dataType": "long"}] + attr("x", "long", 1)
+    plan = plan_node(
+        "ProjectExec",
+        {"projectList": [
+            [{"class": CAT + "Alias", "num-children": 1, "name": "y",
+              "exprId": {"id": 5, "jvmId": "u"}}] + weird]},
+        [scan_node([attr("x", "long", 1)[0]], files)])
+    res = convert_spark_plan(plan)  # converts: wrapped, not rejected
+    wrapped = res.plan["exprs"][0]
+    assert wrapped["kind"] == "udf"
+    assert wrapped["name"].startswith("spark:ScalaUDF#")
+    assert "serialized" in wrapped
+
+    # host registers the evaluator (SparkAuronUDFWrapperContext analog)
+    def times_ten(col):
+        return pa.compute.multiply(col, 10)
+    rid = f"udf://{wrapped['name']}"
+    put_resource(rid, times_ten)
+    try:
+        got = _run(res.plan)
+        assert got["y"].tolist() == [10, 20, 30]
+    finally:
+        remove_resource(rid)
+
+
+def test_fallback_disabled_raises(tmp_path):
+    t = pa.table({"x": pa.array([1], type=pa.int64())})
+    files = _write(tmp_path, t)
+    weird = [{"class": CAT + "ScalaUDF", "num-children": 1,
+              "dataType": "long"}] + attr("x", "long", 1)
+    plan = plan_node("ProjectExec", {"projectList": [weird]},
+                     [scan_node([attr("x", "long", 1)[0]], files)])
+    config.conf.set(config.UDF_FALLBACK_ENABLE.key, False)
+    try:
+        with pytest.raises(ConversionError, match="ScalaUDF"):
+            convert_spark_plan(plan)
+    finally:
+        config.conf.unset(config.UDF_FALLBACK_ENABLE.key)
+
+
+# -- strategy tagging -------------------------------------------------------
+
+def test_tag_plan_reports_reasons(tmp_path):
+    t = pa.table({"x": pa.array([1], type=pa.int64())})
+    files = _write(tmp_path, t)
+    plan = plan_node(
+        "CollectLimitExec",  # unsupported top node
+        {"limit": 5},
+        [plan_node("FilterExec",
+                   {"condition":
+                    [{"class": CAT + "GreaterThan", "num-children": 2}] +
+                    attr("x", "long", 1) + lit("0", "long")},
+                   [scan_node([attr("x", "long", 1)[0]], files)])])
+    tag = tag_plan(plan)
+    assert not tag.convertible
+    assert "CollectLimitExec" in tag.reason
+    assert tag.children[0].convertible          # filter subtree converts
+    assert tag.children[0].children[0].convertible  # scan converts
+    report = explain(tag)
+    assert "FALLBACK" in report and "native" in report
+
+
+def test_remove_inefficient_converts_demotes_islands(tmp_path):
+    t = pa.table({"x": pa.array([1], type=pa.int64())})
+    files = _write(tmp_path, t)
+    # sort over an unsupported child: sort WOULD convert in isolation but
+    # its child and parent are not native -> demote
+    unsupported_child = plan_node("MysteryExec", {},
+                                  [scan_node([attr("x", "long", 1)[0]],
+                                             files)])
+    plan = plan_node(
+        "CollectLimitExec", {"limit": 5},
+        [plan_node("SortExec",
+                   {"sortOrder": [sort_order(attr("x", "long", 1))]},
+                   [unsupported_child])])
+    tag = tag_plan(plan)
+    sort_tag = tag.children[0]
+    # subtree-based tagging: sort's subtree includes the unsupported
+    # child, so it is already unconvertible with the child's reason
+    assert not sort_tag.convertible
+    assert "MysteryExec" in sort_tag.reason
+
+    # an island in the middle: project(x) under an unsupported parent
+    # whose own child is unsupported
+    island = plan_node(
+        "CollectLimitExec", {"limit": 1},
+        [plan_node("ProjectExec",
+                   {"projectList": [attr("x", "long", 1)]},
+                   [plan_node("MysteryExec", {},
+                              [scan_node([attr("x", "long", 1)[0]],
+                                         files)])])])
+    tag2 = tag_plan(island)
+    # force the middle node convertible to model the per-node tagging the
+    # reference does, then check the island rule demotes it
+    tag2.children[0].convertible = True
+    out = remove_inefficient_converts(tag2)
+    assert not out.children[0].convertible
+    assert "removeInefficientConverts" in out.children[0].reason
