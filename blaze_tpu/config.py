@@ -262,6 +262,11 @@ FUSED_STAGE_ENABLE = bool_conf(
     "auron.tpu.fused.stage.enable", True,
     "Rewrite eligible scan->filter->partial-agg subtrees into single-XLA-"
     "program fused stages (plan/fused.py fuse_plan).")
+FUSED_FOLD_WINDOW = int_conf(
+    "auron.tpu.fused.fold.window", 1,
+    "Source batches folded through ONE XLA program in the fused dense "
+    "path (fori_loop over stacked inputs): divides dispatch count and "
+    "keeps the group-table carry in place inside the program.")
 FUSED_STAGE_CAPACITY = int_conf(
     "auron.tpu.fused.stage.capacity", 1 << 24,
     "Max dense group-table slots (product of key ranges) for the fused "

@@ -316,12 +316,24 @@ def _unparse(node: dict) -> dict:
     return out
 
 
+def _collect_attrs(node: dict, out: List[dict]) -> None:
+    if _cls(node) == "AttributeReference":
+        if _expr_id(node) not in {_expr_id(a) for a in out}:
+            out.append(node)
+        return
+    for c in node["__children"]:
+        _collect_attrs(c, out)
+
+
 def convert_expr_with_fallback(node: dict, scope: Scope) -> Dict[str, Any]:
-    """convertExprWithFallback (NativeConverters.scala:399): unsupported
-    expressions wrap into a host-evaluated UDF whose params are the
-    (recursively converted) children.  Execution requires the host to
-    register the evaluator under `udf://<name>` (the
-    SparkAuronUDFWrapperContext analog, bridge/host_callbacks.py)."""
+    """convertExprWithFallback (NativeConverters.scala:399): when any part
+    of an expression fails to convert, the WHOLE subtree wraps into one
+    host-evaluated UDF whose params are the attribute references the
+    subtree reads (the SparkUDFWrapper contract: the host evaluates the
+    serialized expression from column inputs — natively-supported
+    ancestors are not wrapped separately and no nesting occurs).
+    Execution requires the host to register the evaluator under
+    `udf://<name>` (bridge/host_callbacks.py)."""
     if _cls(node) == "Alias":  # transparent: wrap the aliased child
         return convert_expr_with_fallback(node["__children"][0], scope)
     try:
@@ -336,11 +348,16 @@ def convert_expr_with_fallback(node: dict, scope: Scope) -> Dict[str, Any]:
                 c, f"cannot wrap (no dataType); inner: {err.reason}")
         import hashlib
         import json as _json
-        serialized = _json.dumps(_unparse(node), sort_keys=True,
-                                 default=str)
+        attrs: List[dict] = []
+        _collect_attrs(node, attrs)
+        payload = {"expr": _unparse(node),
+                   "params": [{"id": _expr_id(a),
+                               "name": a.get("name", "")}
+                              for a in attrs]}
+        serialized = _json.dumps(payload, sort_keys=True, default=str)
         digest = hashlib.sha256(serialized.encode()).hexdigest()[:10]
-        args = [convert_expr_with_fallback(a, scope)
-                for a in node["__children"]]
+        args = [scope.bind(_expr_id(a), a.get("name", ""))
+                for a in attrs]
         name = f"spark:{c}#{digest}"
         sink = getattr(_wrap_ctx, "items", None)
         if sink is not None:
@@ -724,8 +741,12 @@ def _convert_generate(node: dict, parts: int, log: List[str]
         req_names.append(a.get("name", ""))
     gen_attrs = _expr_list(node.get("generatorOutput"))
     gids, gnames = _attrs_of(gen_attrs)
-    required_cols = [scope._index[i] for i in req_ids
-                     if i in scope._index]
+    missing = [i for i in req_ids if i not in scope._index]
+    if missing:
+        raise ConversionError(
+            c, f"requiredChildOutput exprIds {missing} not found in "
+               f"child output — positional binding would shift")
+    required_cols = [scope._index[i] for i in req_ids]
     out_names = req_names + gnames
     # the engine generator names its output columns itself (col/pos);
     # rename to the Catalyst generatorOutput attribute names so parents

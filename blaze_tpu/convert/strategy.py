@@ -52,23 +52,62 @@ _TRANSPARENT = {
 
 
 def tag_plan(plan_json, num_partitions: int = 1) -> NodeTag:
-    """AuronConvertStrategy.apply: attempt conversion of every subtree
-    and record per-node convertibility with reasons."""
+    """AuronConvertStrategy.apply: bottom-up per-node convertibility.
+
+    A node whose child subtree fails is still tagged on ITS OWN merits
+    when the child exposes output attributes: the child is substituted
+    with a synthetic scan over those attributes (the ConvertToNative
+    boundary the reference inserts at non-native leaves).  Children
+    without discoverable output fall back to whole-subtree testing."""
     root = _tree(plan_json)
     return _tag(root, num_partitions)
 
 
+def _placeholder_for(node: dict) -> Optional[dict]:
+    """A convertible stand-in exposing the same output attributes."""
+    out = node.get("output")
+    if not out:
+        return None
+    ph = {"class": "org.apache.spark.sql.execution.FileSourceScanExec",
+          "num-children": 0, "output": out,
+          "files": [["placeholder://convert-to-native"]],
+          "__children": []}
+    return ph
+
+
 def _tag(node: dict, parts: int) -> NodeTag:
     c = _cls(node)
-    children = [ch for ch in node["__children"]]
+    children = node["__children"]
+    child_tags = [_tag(ch, parts) for ch in children]
+    # test THIS node with children replaced by placeholders wherever the
+    # child's output attrs are known — islands become visible AND each
+    # per-node test stops re-converting whole subtrees (without this,
+    # tagging is O(n^2) in plan size)
+    test_node = node
+    subs = []
+    changed = False
+    for ch, t in zip(children, child_tags):
+        ph = _placeholder_for(ch)
+        if ph is not None:
+            subs.append(ph)
+            changed = True
+        elif t.convertible:
+            subs.append(ch)
+        else:
+            return NodeTag(c, False,
+                           f"child not convertible: {t.reason}",
+                           child_tags)
+    if changed:
+        test_node = dict(node)
+        test_node["__children"] = subs
     try:
-        _convert_node(node, parts, [])
+        _convert_node(test_node, parts, [])
         ok, reason = True, ""
     except ConversionError as e:
         ok, reason = False, f"{e.node_class}: {e.reason}"
     except Exception as e:  # malformed JSON etc.
         ok, reason = False, f"{c}: {e}"
-    return NodeTag(c, ok, reason, [_tag(ch, parts) for ch in children])
+    return NodeTag(c, ok, reason, child_tags)
 
 
 def remove_inefficient_converts(tag: NodeTag,

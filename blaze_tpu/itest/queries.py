@@ -548,3 +548,430 @@ QUERIES: Dict[str, Tuple[Callable, list]] = {
                   "customer_address", "item"]),
     "q95": (q95, ["web_sales", "web_returns", "customer_address"]),
 }
+
+
+# ---------------------------------------------------------------------------
+# round-3 breadth: brand-revenue family, ratio-over-window family,
+# cumulative windows, rollup+rank, and a Generate-bearing workload
+# (VERDICT r2 #9: 15+ queries, rows 18/19 exercised by the harness)
+# ---------------------------------------------------------------------------
+
+def _brand_revenue(paths, tables, partitions, moy, price_col,
+                   group_cols=("i_brand_id", "i_brand")):
+    """The q03/q42/q52/q55 shape: dd(moy) ⨝ ss ⨝ item, revenue by brand."""
+    ss, it, dd = tables["store_sales"], tables["item"], tables["date_dim"]
+
+    dd_f = filter_(scan(paths, tables, "date_dim"),
+                   binop("==", c("d_moy"), lit(moy, "int32")))
+    j_dd = join("broadcast_join", scan(paths, tables, "store_sales"),
+                dd_f, [c("ss_sold_date_sk")], [c("d_date_sk")])
+    j_it = join("broadcast_join", j_dd, scan(paths, tables, "item"),
+                [c("ss_item_sk")], [c("i_item_sk")])
+    groups = [(c("d_year"), "d_year")] + \
+        [(c(g), g) for g in group_cols]
+    rev = _partial_final(j_it, groups,
+                         [("sum", "revenue", [c(price_col)])], partitions)
+    single = exchange(rev, [ci(0)], 1)
+    n = len(groups)
+    plan = sort_limit(single, [(ci(n), True), (ci(1), False)], 100)
+
+    def oracle():
+        ssd, itd, ddd = (ss.to_pandas(), it.to_pandas(), dd.to_pandas())
+        m = ssd.merge(ddd[ddd.d_moy == moy], left_on="ss_sold_date_sk",
+                      right_on="d_date_sk")
+        m = m.merge(itd, left_on="ss_item_sk", right_on="i_item_sk")
+        out = (m.groupby(["d_year"] + list(group_cols), as_index=False)
+               .agg(revenue=(price_col, "sum")))
+        out = out.sort_values(["revenue", list(out.columns)[1]],
+                              ascending=[False, True])[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q03(paths, tables, partitions: int = 2):
+    return _brand_revenue(paths, tables, partitions, 11,
+                          "ss_ext_sales_price")
+
+
+def q42(paths, tables, partitions: int = 2):
+    return _brand_revenue(paths, tables, partitions, 12,
+                          "ss_ext_sales_price", ("i_category",))
+
+
+def q52(paths, tables, partitions: int = 2):
+    return _brand_revenue(paths, tables, partitions, 12,
+                          "ss_ext_sales_price")
+
+
+def q55(paths, tables, partitions: int = 2):
+    return _brand_revenue(paths, tables, partitions, 11,
+                          "ss_sales_price")
+
+
+def q07(paths, tables, partitions: int = 4):
+    """ss ⨝ cd(gender/edu) ⨝ dd ⨝ item ⨝ promotion, avg stats by item."""
+    ss, cd, it = (tables["store_sales"], tables["customer_demographics"],
+                  tables["item"])
+    pr, dd = tables["promotion"], tables["date_dim"]
+
+    cd_f = filter_(scan(paths, tables, "customer_demographics"),
+                   binop("==", c("cd_gender"), lit("M", "utf8")),
+                   binop("==", c("cd_education_status"),
+                         lit("College", "utf8")))
+    j_cd = join("broadcast_join", scan(paths, tables, "store_sales"),
+                cd_f, [c("ss_cdemo_sk")], [c("cd_demo_sk")])
+    dd_f = filter_(scan(paths, tables, "date_dim"),
+                   binop("==", c("d_year"), lit(2000, "int32")))
+    j_dd = join("broadcast_join", j_cd, dd_f,
+                [c("ss_sold_date_sk")], [c("d_date_sk")])
+    pr_f = filter_(scan(paths, tables, "promotion"),
+                   binop("==", c("p_channel_email"), lit("N", "utf8")))
+    j_pr = join("broadcast_join", j_dd, pr_f,
+                [c("ss_promo_sk")], [c("p_promo_sk")])
+    j_it = join("broadcast_join", j_pr, scan(paths, tables, "item"),
+                [c("ss_item_sk")], [c("i_item_sk")])
+    stats = _partial_final(
+        j_it, [(c("i_item_id"), "i_item_id")],
+        [("avg", "agg1", [c("ss_quantity")]),
+         ("avg", "agg2", [c("ss_list_price")]),
+         ("avg", "agg3", [c("ss_coupon_amt")]),
+         ("avg", "agg4", [c("ss_sales_price")])], partitions)
+    single = exchange(stats, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(0), False)], 100)
+
+    def oracle():
+        ssd, cdd, itd = ss.to_pandas(), cd.to_pandas(), it.to_pandas()
+        prd, ddd = pr.to_pandas(), dd.to_pandas()
+        m = ssd.merge(cdd[(cdd.cd_gender == "M") &
+                          (cdd.cd_education_status == "College")],
+                      left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+        m = m.merge(ddd[ddd.d_year == 2000], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+        m = m.merge(prd[prd.p_channel_email == "N"],
+                    left_on="ss_promo_sk", right_on="p_promo_sk")
+        m = m.merge(itd, left_on="ss_item_sk", right_on="i_item_sk")
+        out = m.groupby("i_item_id", as_index=False).agg(
+            agg1=("ss_quantity", "mean"), agg2=("ss_list_price", "mean"),
+            agg3=("ss_coupon_amt", "mean"),
+            agg4=("ss_sales_price", "mean"))
+        return out.sort_values("i_item_id")[:100].reset_index(drop=True)
+
+    return plan, oracle
+
+
+def _ratio_over_window(paths, tables, partitions, fact, date_col,
+                       item_col, price_col, window):
+    """The q12/q20/q98 shape: revenue by item, plus each item's share of
+    its class total via an UNBOUNDED window aggregate."""
+    ft, it = tables[fact], tables["item"]
+
+    f = filter_(scan(paths, tables, fact),
+                binop(">=", c(date_col), lit(window[0])),
+                binop("<=", c(date_col), lit(window[1])))
+    j = join("broadcast_join", f, scan(paths, tables, "item"),
+             [c(item_col)], [c("i_item_sk")])
+    rev = _partial_final(
+        j, [(c("i_item_id"), "i_item_id"), (c("i_class"), "i_class")],
+        [("sum", "itemrevenue", [c(price_col)])], partitions)
+    # co-locate each class in one partition, sort, whole-partition window
+    ex = exchange(rev, [ci(1)], 1)
+    srt = {"kind": "sort", "input": ex,
+           "specs": [{"expr": ci(1), "descending": False,
+                      "nulls_first": True},
+                     {"expr": ci(0), "descending": False,
+                      "nulls_first": True}]}
+    win = {"kind": "window", "input": srt,
+           "functions": [{"kind": "agg", "fn": "sum",
+                          "name": "classrevenue", "running": False,
+                          "args": [ci(2)]}],
+           "partition_by": [ci(1)], "order_by": []}
+    plan = project(
+        win,
+        [ci(0), ci(1), ci(2),
+         binop("/", binop("*", ci(2), lit(100.0, "float64")), ci(3))],
+        ["i_item_id", "i_class", "itemrevenue", "revenueratio"])
+
+    def oracle():
+        fd, itd = ft.to_pandas(), it.to_pandas()
+        m = fd[(fd[date_col] >= window[0]) & (fd[date_col] <= window[1])]
+        m = m.merge(itd, left_on=item_col, right_on="i_item_sk")
+        out = (m.groupby(["i_item_id", "i_class"], as_index=False)
+               .agg(itemrevenue=(price_col, "sum")))
+        out["revenueratio"] = out.itemrevenue * 100.0 / \
+            out.groupby("i_class").itemrevenue.transform("sum")
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+Q12_WINDOW = _day_range(730, 760)
+
+
+def q12(paths, tables, partitions: int = 2):
+    return _ratio_over_window(paths, tables, partitions, "web_sales",
+                              "ws_sold_date_sk", "ws_item_sk",
+                              "ws_ext_sales_price", Q12_WINDOW)
+
+
+def q20(paths, tables, partitions: int = 2):
+    return _ratio_over_window(paths, tables, partitions, "catalog_sales",
+                              "cs_sold_date_sk", "cs_item_sk",
+                              "cs_sales_price", Q12_WINDOW)
+
+
+def q98(paths, tables, partitions: int = 2):
+    return _ratio_over_window(paths, tables, partitions, "store_sales",
+                              "ss_sold_date_sk", "ss_item_sk",
+                              "ss_ext_sales_price", Q12_WINDOW)
+
+
+Q51_WINDOW = _day_range(700, 760)
+
+
+def q51(paths, tables, partitions: int = 2):
+    """Cumulative web vs store revenue per item/date (FULL OUTER join of
+    two windowed streams — the q51 shape with max-over-cumulative)."""
+    ws, ss = tables["web_sales"], tables["store_sales"]
+
+    def daily(fact, date_col, item_col, price_col):
+        f = filter_(scan(paths, tables, fact),
+                    binop(">=", c(date_col), lit(Q51_WINDOW[0])),
+                    binop("<=", c(date_col), lit(Q51_WINDOW[1])))
+        d = _partial_final(
+            f, [(c(item_col), "item_sk"), (c(date_col), "date_sk")],
+            [("sum", "rev", [c(price_col)])], partitions)
+        ex = exchange(d, [ci(0)], 1)
+        srt = {"kind": "sort", "input": ex,
+               "specs": [{"expr": ci(0), "descending": False,
+                          "nulls_first": True},
+                         {"expr": ci(1), "descending": False,
+                          "nulls_first": True}]}
+        return {"kind": "window", "input": srt,
+                "functions": [{"kind": "agg", "fn": "sum",
+                               "name": "cume", "running": True,
+                               "args": [ci(2)]}],
+                "partition_by": [ci(0)],
+                "order_by": [{"expr": ci(1), "descending": False,
+                              "nulls_first": True}]}
+
+    web = daily("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                "ws_ext_sales_price")
+    store = daily("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                  "ss_ext_sales_price")
+    j = join("sort_merge_join", web, store, [ci(0), ci(1)],
+             [ci(0), ci(1)], jt="full")
+    flt = filter_(j, binop(">", ci(3), {"kind": "coalesce",
+                                        "args": [ci(7), lit(0.0,
+                                                            "float64")]}))
+    plan = sort_limit(flt, [(ci(0), False), (ci(1), False)], 100)
+
+    def oracle():
+        wsd, ssd = ws.to_pandas(), ss.to_pandas()
+
+        def cume(fd, date_col, item_col, price_col):
+            f = fd[(fd[date_col] >= Q51_WINDOW[0]) &
+                   (fd[date_col] <= Q51_WINDOW[1])]
+            d = (f.groupby([item_col, date_col], as_index=False)
+                 .agg(rev=(price_col, "sum"))
+                 .rename(columns={item_col: "item_sk",
+                                  date_col: "date_sk"}))
+            d = d.sort_values(["item_sk", "date_sk"])
+            d["cume"] = d.groupby("item_sk").rev.cumsum()
+            return d
+
+        w = cume(wsd, "ws_sold_date_sk", "ws_item_sk",
+                 "ws_ext_sales_price").rename(columns={
+                     "item_sk": "item_w", "date_sk": "date_w",
+                     "rev": "rev_w", "cume": "cume_w"})
+        s = cume(ssd, "ss_sold_date_sk", "ss_item_sk",
+                 "ss_ext_sales_price").rename(columns={
+                     "item_sk": "item_s", "date_sk": "date_s",
+                     "rev": "rev_s", "cume": "cume_s"})
+        # FULL join keeps both key sets (8 columns), like the engine plan
+        m = w.merge(s, left_on=["item_w", "date_w"],
+                    right_on=["item_s", "date_s"], how="outer")
+        m = m[m.cume_w > m.cume_s.fillna(0.0)]
+        out = m[["item_w", "date_w", "rev_w", "cume_w",
+                 "item_s", "date_s", "rev_s", "cume_s"]]
+        out = out.sort_values(["item_w", "date_w"])[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q67(paths, tables, partitions: int = 2):
+    """Rollup(category, class) of store revenue + rank() within category
+    by revenue desc, rank <= 10 (the q67 shape: Expand + window rank)."""
+    ss, it, dd = tables["store_sales"], tables["item"], tables["date_dim"]
+
+    dd_f = filter_(scan(paths, tables, "date_dim"),
+                   binop("==", c("d_year"), lit(1999, "int32")))
+    j_dd = join("broadcast_join", scan(paths, tables, "store_sales"),
+                dd_f, [c("ss_sold_date_sk")], [c("d_date_sk")])
+    j_it = join("broadcast_join", j_dd, scan(paths, tables, "item"),
+                [c("ss_item_sk")], [c("i_item_sk")])
+    nul = {"kind": "literal", "value": None, "type": {"id": "utf8"}}
+    projections = []
+    for kept, gid in ((2, 0), (1, 1), (0, 3)):
+        row = [c("i_category") if kept >= 1 else nul,
+               c("i_class") if kept >= 2 else nul,
+               lit(gid), c("ss_ext_sales_price")]
+        projections.append(row)
+    expanded = {"kind": "expand", "input": j_it,
+                "projections": projections,
+                "names": ["i_category", "i_class", "g_id",
+                          "ss_ext_sales_price"]}
+    rev = _partial_final(
+        expanded,
+        [(ci(0), "i_category"), (ci(1), "i_class"), (ci(2), "g_id")],
+        [("sum", "sumsales", [ci(3)])], partitions)
+    ex = exchange(rev, [ci(0)], 1)
+    srt = {"kind": "sort", "input": ex,
+           "specs": [{"expr": ci(0), "descending": False,
+                      "nulls_first": True},
+                     {"expr": ci(3), "descending": True,
+                      "nulls_first": False}]}
+    win = {"kind": "window", "input": srt,
+           "functions": [{"kind": "rank", "name": "rk"}],
+           "partition_by": [ci(0)],
+           "order_by": [{"expr": ci(3), "descending": True,
+                         "nulls_first": False}]}
+    flt = filter_(win, binop("<=", ci(4), lit(10)))
+    plan = sort_limit(flt, [(ci(0), False), (ci(4), False)], 100)
+
+    def oracle():
+        ssd, itd, ddd = ss.to_pandas(), it.to_pandas(), dd.to_pandas()
+        m = ssd.merge(ddd[ddd.d_year == 1999],
+                      left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(itd, left_on="ss_item_sk", right_on="i_item_sk")
+        frames = []
+        for kept, gid in ((2, 0), (1, 1), (0, 3)):
+            keys = ["i_category", "i_class"][:kept] if kept else []
+            if keys:
+                g = m.groupby(keys, as_index=False, dropna=False).agg(
+                    sumsales=("ss_ext_sales_price", "sum"))
+            else:
+                g = pd.DataFrame(
+                    {"sumsales": [m.ss_ext_sales_price.sum()]})
+            for col_name in ["i_category", "i_class"][kept:]:
+                g[col_name] = None
+            g["g_id"] = gid
+            frames.append(g[["i_category", "i_class", "g_id",
+                             "sumsales"]])
+        allf = pd.concat(frames, ignore_index=True)
+        allf["rk"] = (allf.sort_values("sumsales", ascending=False)
+                      .groupby("i_category", dropna=False)
+                      .sumsales.rank(method="min", ascending=False))
+        allf = allf[allf.rk <= 10]
+        out = allf.sort_values(["i_category", "rk"])[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def gq1(paths, tables, partitions: int = 2):
+    """Generate-bearing workload: posexplode the clickstream list column,
+    join items, count clicks by category (exercises inventory row 19
+    through the integration harness)."""
+    wc, it = tables["web_clickstreams"], tables["item"]
+
+    gen = {"kind": "generate",
+           "input": scan(paths, tables, "web_clickstreams"),
+           "generator": {"kind": "posexplode",
+                         "child": c("wc_clicked_items"), "outer": False},
+           "required_cols": [0]}
+    renamed = {"kind": "rename_columns", "input": gen,
+               "names": ["wc_session_sk", "pos", "item_sk"]}
+    j = join("broadcast_join", renamed, scan(paths, tables, "item"),
+             [ci(2)], [c("i_item_sk")])
+    counted = _partial_final(
+        j, [(c("i_category"), "i_category")],
+        [("count", "clicks", [ci(0)])], partitions)
+    single = exchange(counted, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(0), False)], 100)
+
+    def oracle():
+        wcd = wc.to_pandas()
+        itd = it.to_pandas()
+        rows = []
+        for _sess, items in zip(wcd.wc_session_sk,
+                                wcd.wc_clicked_items):
+            if items is not None:
+                rows.extend(items)
+        e = pd.DataFrame({"item_sk": rows})
+        m = e.merge(itd, left_on="item_sk", right_on="i_item_sk")
+        out = (m.groupby("i_category", as_index=False)
+               .agg(clicks=("item_sk", "count"))
+               .sort_values("i_category"))
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q19(paths, tables, partitions: int = 2):
+    """Brand revenue through customer/address joins (q19 shape without
+    the manager filter; exercises the 4-join chain)."""
+    ss, it, dd = tables["store_sales"], tables["item"], tables["date_dim"]
+    cu, ca, st = (tables["customer"], tables["customer_address"],
+                  tables["store"])
+
+    dd_f = filter_(scan(paths, tables, "date_dim"),
+                   binop("==", c("d_year"), lit(1999, "int32")),
+                   binop("==", c("d_moy"), lit(11, "int32")))
+    j_dd = join("broadcast_join", scan(paths, tables, "store_sales"),
+                dd_f, [c("ss_sold_date_sk")], [c("d_date_sk")])
+    j_it = join("broadcast_join", j_dd, scan(paths, tables, "item"),
+                [c("ss_item_sk")], [c("i_item_sk")])
+    cs_ex = exchange(j_it, [c("ss_customer_sk")], partitions)
+    cu_ex = exchange(scan(paths, tables, "customer"),
+                     [c("c_customer_sk")], partitions)
+    j_cu = join("hash_join", cs_ex, cu_ex, [c("ss_customer_sk")],
+                [c("c_customer_sk")])
+    j_ca = join("broadcast_join", j_cu,
+                scan(paths, tables, "customer_address"),
+                [c("c_current_addr_sk")], [c("ca_address_sk")])
+    j_st = join("broadcast_join", j_ca, scan(paths, tables, "store"),
+                [c("ss_store_sk")], [c("s_store_sk")])
+    rev = _partial_final(
+        j_st, [(c("i_brand_id"), "brand_id"), (c("i_brand"), "brand")],
+        [("sum", "ext_price", [c("ss_ext_sales_price")])], partitions)
+    single = exchange(rev, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(2), True), (ci(0), False)], 100)
+
+    def oracle():
+        ssd, itd, ddd = ss.to_pandas(), it.to_pandas(), dd.to_pandas()
+        cud, cad, std = cu.to_pandas(), ca.to_pandas(), st.to_pandas()
+        m = ssd.merge(ddd[(ddd.d_year == 1999) & (ddd.d_moy == 11)],
+                      left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(itd, left_on="ss_item_sk", right_on="i_item_sk")
+        m = m.merge(cud, left_on="ss_customer_sk",
+                    right_on="c_customer_sk")
+        m = m.merge(cad, left_on="c_current_addr_sk",
+                    right_on="ca_address_sk")
+        m = m.merge(std, left_on="ss_store_sk", right_on="s_store_sk")
+        out = (m.groupby(["i_brand_id", "i_brand"], as_index=False)
+               .agg(ext_price=("ss_ext_sales_price", "sum")))
+        out = out.sort_values(["ext_price", "i_brand_id"],
+                              ascending=[False, True])[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+QUERIES.update({
+    "q03": (q03, ["store_sales", "item", "date_dim"]),
+    "q07": (q07, ["store_sales", "customer_demographics", "item",
+                  "promotion", "date_dim"]),
+    "q12": (q12, ["web_sales", "item"]),
+    "q19": (q19, ["store_sales", "item", "date_dim", "customer",
+                  "customer_address", "store"]),
+    "q20": (q20, ["catalog_sales", "item"]),
+    "q42": (q42, ["store_sales", "item", "date_dim"]),
+    "q51": (q51, ["web_sales", "store_sales"]),
+    "q52": (q52, ["store_sales", "item", "date_dim"]),
+    "q55": (q55, ["store_sales", "item", "date_dim"]),
+    "q67": (q67, ["store_sales", "item", "date_dim"]),
+    "q98": (q98, ["store_sales", "item"]),
+    "gq1": (gq1, ["web_clickstreams", "item"]),
+})

@@ -1,0 +1,99 @@
+"""Per-query speedup report: run every itest query, compare against the
+pandas oracle, and print the TPCDSSuite-style table.
+
+Parity: dev/auron-it Main.scala/QueryRunner.scala (each query runs
+baseline and accelerated, QueryResultComparator checks results, per-query
+speedup is logged).  Usage:
+
+    python -m blaze_tpu.itest.report [--scale 0.2] [--partitions 2]
+                                     [--queries q01,q06,...] [--wire]
+
+`--wire` routes execution through the DagScheduler (per-task protobuf
+TaskDefinitions + shuffle files) instead of the in-process planner path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+
+
+def run_report(scale: float, partitions: int, names=None,
+               wire: bool = False):
+    import pandas as pd
+
+    from blaze_tpu.itest import generate
+    from blaze_tpu.itest.queries import QUERIES
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.itest.tpcds_data import write_parquet_splits
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.plan import create_plan
+    from blaze_tpu.plan.fused import fuse_plan
+    from blaze_tpu.plan.stages import DagScheduler
+
+    MemManager.init(4 << 30)
+    rows = []
+    for qname in sorted(names or QUERIES):
+        builder, table_names = QUERIES[qname]
+        tables = generate(table_names, scale=scale)
+        with tempfile.TemporaryDirectory(prefix=f"blaze-it-{qname}-") \
+                as tmp:
+            paths = write_parquet_splits(tables, tmp, partitions)
+            plan_dict, oracle = builder(paths, tables, partitions)
+            t0 = time.perf_counter()
+            if wire:
+                got_tbl = DagScheduler(
+                    work_dir=tmp + "/dag").run_collect(plan_dict)
+            else:
+                plan = fuse_plan(create_plan(plan_dict))
+                got_tbl = plan.execute_collect().to_arrow()
+                import pyarrow as pa
+                if isinstance(got_tbl, pa.RecordBatch):
+                    got_tbl = pa.Table.from_batches([got_tbl])
+            engine_s = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            want = oracle()
+            oracle_s = time.perf_counter() - t1
+            got = got_tbl.to_pandas() if got_tbl.num_rows else \
+                pd.DataFrame({n: [] for n in got_tbl.schema.names})
+            err = compare_frames(got, want)
+            rows.append({
+                "query": qname, "rows": int(got_tbl.num_rows),
+                "engine_s": round(engine_s, 3),
+                "baseline_s": round(oracle_s, 3),
+                "speedup": round(oracle_s / max(engine_s, 1e-9), 3),
+                "passed": err is None, "detail": err or ""})
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=0.2)
+    ap.add_argument("--partitions", type=int, default=2)
+    ap.add_argument("--queries", type=str, default="")
+    ap.add_argument("--wire", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    names = [q for q in args.queries.split(",") if q] or None
+    rows = run_report(args.scale, args.partitions, names, args.wire)
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        hdr = f"{'query':6} {'rows':>8} {'engine_s':>9} " \
+              f"{'baseline_s':>11} {'speedup':>8}  status"
+        print(hdr)
+        print("-" * len(hdr))
+        for r in rows:
+            status = "OK" if r["passed"] else f"FAIL {r['detail'][:50]}"
+            print(f"{r['query']:6} {r['rows']:>8} {r['engine_s']:>9} "
+                  f"{r['baseline_s']:>11} {r['speedup']:>8}  {status}")
+        n_fail = sum(not r["passed"] for r in rows)
+        print(f"\n{len(rows)} queries, {n_fail} failed")
+    return 1 if any(not r["passed"] for r in rows) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

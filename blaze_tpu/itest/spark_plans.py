@@ -465,13 +465,7 @@ def q18(paths, tables, partitions: int = 4):
     plan = take_ordered(100, order, out_attrs[:5] + res, stats)
 
     _plan, oracle = Q.q18(paths, tables, partitions)
-
-    def reordered_oracle():
-        # queries.py emits [i_item_id..county, g_id, aggs]; this plan's
-        # TakeOrderedAndProject emits the same layout
-        return oracle()
-
-    return plan, reordered_oracle
+    return plan, oracle
 
 
 def q95(paths, tables, partitions: int = 4):

@@ -25,12 +25,14 @@ SF1_ROWS = {
     "date_dim": 73_049,
     "item": 18_000,
     "warehouse": 5,
+    "promotion": 300,
+    "web_clickstreams": 50_000,
 }
 
 
 def _rows(name: str, scale: float) -> int:
     base = SF1_ROWS[name]
-    if name in ("store", "date_dim", "warehouse"):
+    if name in ("store", "date_dim", "warehouse", "promotion"):
         return base  # dimension tables do not scale
     if name == "customer_demographics":
         # fixed-size cross-product dimension in TPC-DS
@@ -116,6 +118,12 @@ def gen_store_sales(scale: float, seed: int = 15) -> pa.Table:
         "ss_ext_sales_price": pa.array(np.round(rng.random(n) * 300, 2)),
         "ss_quantity": pa.array(rng.integers(1, 100, n).astype(np.int32)),
         "ss_ticket_number": pa.array(np.arange(1, n + 1)),
+        "ss_cdemo_sk": pa.array(
+            rng.integers(1, _rows("customer_demographics", scale) + 1, n)),
+        "ss_promo_sk": pa.array(rng.integers(1, 301, n)),
+        "ss_list_price": pa.array(np.round(rng.random(n) * 320, 2)),
+        "ss_coupon_amt": pa.array(np.round(rng.random(n) * 40, 2)),
+        "ss_sales_price": pa.array(np.round(rng.random(n) * 280, 2)),
     })
 
 
@@ -155,6 +163,10 @@ def gen_web_sales(scale: float, seed: int = 18) -> pa.Table:
             rng.integers(1, _rows("warehouse", scale) + 1, n)),
         "ws_ext_ship_cost": pa.array(np.round(rng.random(n) * 100, 2)),
         "ws_net_profit": pa.array(np.round(rng.random(n) * 200 - 40, 2)),
+        "ws_sold_date_sk": pa.array(
+            rng.integers(2450815, 2450815 + date_n, n)),
+        "ws_item_sk": pa.array(rng.integers(1, _rows("item", scale) + 1, n)),
+        "ws_ext_sales_price": pa.array(np.round(rng.random(n) * 300, 2)),
     })
 
 
@@ -200,11 +212,48 @@ def gen_item(scale: float, seed: int = 16) -> pa.Table:
     n = _rows("item", scale)
     rng = np.random.default_rng(seed)
     cats = np.array(["Books", "Home", "Sports", "Music", "Electronics"])
+    brands = np.array([f"brand_{i}" for i in range(50)])
+    classes = np.array([f"class_{i}" for i in range(16)])
+    brand_ids = rng.integers(1, 51, n)
     return pa.table({
         "i_item_sk": pa.array(np.arange(1, n + 1)),
         "i_item_id": pa.array([f"I{i:09d}" for i in range(1, n + 1)]),
         "i_category": pa.array(cats[rng.integers(0, len(cats), n)]),
+        "i_class": pa.array(classes[rng.integers(0, len(classes), n)]),
+        "i_brand_id": pa.array(brand_ids.astype(np.int32)),
+        "i_brand": pa.array(brands[brand_ids - 1]),
+        "i_manager_id": pa.array(rng.integers(1, 100, n).astype(np.int32)),
         "i_current_price": pa.array(np.round(rng.random(n) * 100, 2)),
+    })
+
+
+def gen_promotion(scale: float, seed: int = 22) -> pa.Table:
+    n = _rows("promotion", scale)
+    rng = np.random.default_rng(seed)
+    yn = np.array(["Y", "N"])
+    return pa.table({
+        "p_promo_sk": pa.array(np.arange(1, n + 1)),
+        "p_channel_email": pa.array(yn[rng.integers(0, 2, n)]),
+        "p_channel_event": pa.array(yn[rng.integers(0, 2, n)]),
+    })
+
+
+def gen_web_clickstreams(scale: float, seed: int = 23) -> pa.Table:
+    """Synthetic clickstream with a LIST column: the Generate-bearing
+    integration workload (TPC-DS has no array columns; the reference
+    exercises Generate through the Spark suites instead)."""
+    n = _rows("web_clickstreams", scale)
+    rng = np.random.default_rng(seed)
+    n_items = _rows("item", scale)
+    lengths = rng.integers(0, 6, n)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lengths, out=offsets[1:])
+    values = rng.integers(1, n_items + 1, int(offsets[-1]))
+    pages = pa.ListArray.from_arrays(pa.array(offsets, type=pa.int32()),
+                                     pa.array(values, type=pa.int64()))
+    return pa.table({
+        "wc_session_sk": pa.array(np.arange(1, n + 1)),
+        "wc_clicked_items": pages,
     })
 
 
@@ -220,6 +269,8 @@ GENERATORS = {
     "customer_demographics": gen_customer_demographics,
     "customer_address": gen_customer_address,
     "item": gen_item,
+    "promotion": gen_promotion,
+    "web_clickstreams": gen_web_clickstreams,
 }
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import enum
 from typing import Iterator, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
@@ -46,27 +47,57 @@ class JoinType(enum.Enum):
     EXISTENCE = "existence"  # left rows + bool `exists` column
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=128)
+def _hash_valid_jit(tids: Tuple[str, ...]):
+    """One compiled program per key-type signature: chained xxhash64 +
+    any-null mask (eagerly this is ~100 dispatches per batch and
+    dominated the probe, like the partitioner before it was jitted)."""
+    def f(flat_cols):
+        cols = [(v, val, tid)
+                for (v, val), tid in zip(flat_cols, tids)]
+        h = H.hash_columns(cols, seed=42, xp=jnp, algo="xxhash64")
+        anyn = None
+        for (v, val) in flat_cols:
+            nv = ~val
+            anyn = nv if anyn is None else (anyn | nv)
+        return h, anyn
+    return jax.jit(f)
+
+
 def _device_hash_keys(batch: ColumnBatch, key_exprs: Sequence[PhysicalExpr]
                       ) -> Tuple[np.ndarray, np.ndarray, List[pa.Array]]:
     """(hash int64[num_rows], any_null bool[num_rows], key arrays host)."""
     n = batch.num_rows
-    cols = []
+    cap = batch.capacity
+    flat_cols = []
+    tids = []
     key_arrays = []
-    any_null = np.zeros(n, dtype=bool)
     for e in key_exprs:
         v = e.evaluate(batch)
         arr = v.to_host(n)
         key_arrays.append(arr)
         if v.is_device:
-            cols.append((v.data, v.validity, _tid(v.dtype)))
-            any_null |= ~np.asarray(v.validity)[:n]
+            flat_cols.append((v.data, v.validity))
+            tids.append(_tid(v.dtype))
         else:
             (mat, lengths), valid = H.string_column_to_padded_bytes(arr)
-            cols.append(((jnp.asarray(mat), jnp.asarray(lengths)),
-                         jnp.asarray(_pad(valid, mat.shape[0])), "utf8"))
-            any_null |= ~valid
-    h = H.hash_columns(cols, seed=42, xp=jnp, algo="xxhash64")
-    return np.asarray(h)[:n], any_null, key_arrays
+            # pad rows to capacity (lanes must line up with fixed-width
+            # keys) and width to a pow2 bucket (bounded recompiles)
+            w = max(4, 1 << (mat.shape[1] - 1).bit_length()) \
+                if mat.shape[1] else 4
+            full = np.zeros((cap, w), dtype=mat.dtype)
+            full[:mat.shape[0], :mat.shape[1]] = mat
+            full_len = np.zeros(cap, dtype=lengths.dtype)
+            full_len[:len(lengths)] = lengths
+            flat_cols.append(((jnp.asarray(full), jnp.asarray(full_len)),
+                              jnp.asarray(_pad(valid, cap))))
+            tids.append("utf8")
+    h, anyn = _hash_valid_jit(tuple(tids))(flat_cols)
+    h_np, anyn_np = jax.device_get((h, anyn))
+    return h_np[:n], anyn_np[:n].copy(), key_arrays
 
 
 def _pad(v: np.ndarray, n: int) -> np.ndarray:

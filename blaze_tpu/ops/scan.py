@@ -110,12 +110,23 @@ class ParquetScanExec(ExecutionPlan):
 
     def __init__(self, schema: Schema, file_groups: Sequence[Sequence[str]],
                  projection: Optional[Sequence[str]] = None,
-                 predicate=None, batch_rows: Optional[int] = None):
+                 predicate=None, batch_rows: Optional[int] = None,
+                 partition_schema: Optional[Schema] = None,
+                 partition_values: Optional[Sequence[Sequence[Sequence]]]
+                 = None):
         super().__init__()
         self._file_schema = schema
         self._projection = list(projection) if projection is not None else None
-        self._schema = (Schema([schema.field(n) for n in self._projection])
-                        if self._projection is not None else schema)
+        file_part = (Schema([schema.field(n) for n in self._projection])
+                     if self._projection is not None else schema)
+        # Hive-style partition-constant columns appended after file
+        # columns (ref FileScanExecConf.partition_schema +
+        # PartitionedFile.partition_values, planner.rs:170-200)
+        self._partition_schema = partition_schema
+        self._partition_values = partition_values  # [group][file][field]
+        self._file_part = file_part
+        self._schema = (Schema(list(file_part) + list(partition_schema))
+                        if partition_schema is not None else file_part)
         self._file_groups = [list(g) for g in file_groups]
         self._predicate = predicate
         self._batch_rows = batch_rows or config.BATCH_SIZE.get()
@@ -129,7 +140,7 @@ class ParquetScanExec(ExecutionPlan):
         return len(self._file_groups)
 
     def execute(self, partition: int) -> BatchIterator:
-        for path in self._file_groups[partition]:
+        for fidx, path in enumerate(self._file_groups[partition]):
             try:
                 f = pq.ParquetFile(open_source(path))
             except Exception:
@@ -144,10 +155,28 @@ class ParquetScanExec(ExecutionPlan):
             columns = self._projection
             for rb in f.iter_batches(batch_size=self._batch_rows,
                                      row_groups=row_groups, columns=columns):
-                rb = _align_schema(rb, self._schema)
+                rb = _align_schema(rb, self._file_part)
+                rb = self._append_partition_cols(rb, partition, fidx)
                 cb = ColumnBatch.from_arrow(rb)
                 self.metrics.add("output_rows", cb.num_rows)
                 yield cb
+
+    def _append_partition_cols(self, rb: pa.RecordBatch, partition: int,
+                               fidx: int) -> pa.RecordBatch:
+        if self._partition_schema is None:
+            return rb
+        values = []
+        if self._partition_values is not None:
+            group = self._partition_values[partition]
+            values = list(group[fidx]) if fidx < len(group) else []
+        arrays = list(rb.columns)
+        for i, fld in enumerate(self._partition_schema):
+            v = values[i] if i < len(values) else None
+            at = fld.data_type.to_arrow()
+            arrays.append(pa.nulls(rb.num_rows, type=at) if v is None
+                          else pa.array([v] * rb.num_rows, type=at))
+        return pa.RecordBatch.from_arrays(
+            arrays, schema=self._schema.to_arrow())
 
     def _prune_row_groups(self, f: pq.ParquetFile) -> List[int]:
         md = f.metadata

@@ -267,12 +267,13 @@ def hash_agg_step(carry: HashAggCarry,
     h = H.hash_columns(cols, seed=42, xp=jnp, algo="xxhash64")
     h = h.astype(jnp.int64) & (S - 1)  # S is a power of two
 
-    used = carry.used
-    tkeys = list(carry.keys)
-    tkvalid = list(carry.key_valid)
-    placed = jnp.full(n, S, dtype=jnp.int64)  # S == unplaced sentinel
-    unplaced = mask
-    for r in range(probe_rounds):
+    used0 = carry.used
+    tkeys0 = tuple(carry.keys)
+    tkvalid0 = tuple(carry.key_valid)
+    placed0 = jnp.full(n, S, dtype=jnp.int64)  # S == unplaced sentinel
+
+    def round_body(state):
+        r, used, tkeys, tkvalid, placed, unplaced = state
         slot = (h + r) & (S - 1)
         used_g = jnp.take(used, slot)
         can_claim = unplaced & ~used_g
@@ -281,15 +282,16 @@ def hash_agg_step(carry: HashAggCarry,
             jnp.where(can_claim, slot, S)].min(row_idx, mode="drop")
         winner = (jnp.take(claim, slot) == row_idx) & can_claim
         wslot = jnp.where(winner, slot, S)
-        for i, (kd, kv) in enumerate(key_cols):
-            tkeys[i] = tkeys[i].at[wslot].set(kd, mode="drop")
-            tkvalid[i] = tkvalid[i].at[wslot].set(kv, mode="drop")
+        tkeys = tuple(tk.at[wslot].set(kd, mode="drop")
+                      for tk, (kd, _kv) in zip(tkeys, key_cols))
+        tkvalid = tuple(tv.at[wslot].set(kv, mode="drop")
+                        for tv, (_kd, kv) in zip(tkvalid, key_cols))
         used = used.at[wslot].set(True, mode="drop")
         # match AFTER claims so same-key rows placed this round unify
         eq = jnp.take(used, slot)
-        for i, (kd, kv) in enumerate(key_cols):
-            sk = jnp.take(tkeys[i], slot)
-            sv = jnp.take(tkvalid[i], slot)
+        for tk, tv, (kd, kv) in zip(tkeys, tkvalid, key_cols):
+            sk = jnp.take(tk, slot)
+            sv = jnp.take(tv, slot)
             same = sk == kd
             if jnp.issubdtype(kd.dtype, jnp.floating):
                 # grouping treats NaN as equal to NaN (Spark normalizes)
@@ -299,6 +301,20 @@ def hash_agg_step(carry: HashAggCarry,
         ok = unplaced & eq
         placed = jnp.where(ok, slot, placed)
         unplaced = unplaced & ~ok
+        return (r + 1, used, tkeys, tkvalid, placed, unplaced)
+
+    def round_cond(state):
+        r, _used, _tk, _tv, _placed, unplaced = state
+        # early exit: most batches place everything in 1-2 rounds — on
+        # the host backend the remaining rounds' S-sized claim arrays
+        # would dominate the whole step
+        return (r < probe_rounds) & jnp.any(unplaced)
+
+    _r, used, tkeys, tkvalid, placed, unplaced = jax.lax.while_loop(
+        round_cond, round_body,
+        (jnp.int32(0), used0, tkeys0, tkvalid0, placed0, mask))
+    tkeys = list(tkeys)
+    tkvalid = list(tkvalid)
     overflow = jnp.sum(unplaced.astype(jnp.int32))
 
     g = placed  # S sentinel drops out of every scatter below

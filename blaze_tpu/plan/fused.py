@@ -130,6 +130,13 @@ def _try_fuse_agg(node: ExecutionPlan) -> Optional["FusedPartialAggExec"]:
                 total *= (hi - lo + 2)
             if total > config.FUSED_STAGE_CAPACITY.get():
                 ranges = None
+            elif total > (1 << 20):
+                # sparsity heuristic: a table much larger than the input
+                # can't be dense — the O(slots) carry traffic loses to
+                # the hash table (distinct groups <= rows by definition)
+                rows = _source_row_count(child)
+                if rows is not None and total > 4 * rows:
+                    ranges = None
     # the sorted path handles overflow two ways: PARTIAL degrades to
     # pass-through (downstream re-merges); exact modes GROW the table
     grow = complete or merging
@@ -177,6 +184,28 @@ def _chain_cache_key(source_schema: Schema, chain, group_exprs, specs):
             tuple(e.cache_key() for e, _ in group_exprs),
             tuple((rk, ok, a.cache_key() if a is not None else None)
                   for rk, ok, a in specs))
+
+
+def _source_row_count(child: ExecutionPlan):
+    """Total input rows from scan metadata (parquet footers / in-memory
+    partitions); None when the source is opaque."""
+    node = child
+    while isinstance(node, _FUSABLE_CHAIN):
+        node = node.children[0]
+    if isinstance(node, ParquetScanExec):
+        from blaze_tpu.ops.scan import parquet_metadata
+        total = 0
+        for group in node._file_groups:
+            for path in group:
+                try:
+                    total += parquet_metadata(path).num_rows
+                except Exception:
+                    return None
+        return total
+    if isinstance(node, MemoryScanExec):
+        return sum(cb.num_rows for part in node._partitions
+                   for cb in part)
+    return None
 
 
 def _discover_ranges(child: ExecutionPlan,
@@ -357,17 +386,22 @@ class FusedPartialAggExec(ExecutionPlan):
         carry = None
         n_batches = 0
         if self._prepare is not None:
-            step = _dense_chain_step_factory(self._prepare_key,
-                                             self._prepare,
-                                             tuple(self._ranges),
-                                             tuple(kinds), num_slots)
-            for batch in self._source.execute(partition):
-                cols_flat, mask = _source_inputs(batch)
+            # fold a WINDOW of batches through one XLA program: the
+            # dispatch count drops by the window size and the carry is
+            # updated in place inside the program (no per-batch
+            # full-table copies — they dominated on backends without
+            # donation and on tunneled devices)
+            fold = _dense_fold_factory(self._prepare_key, self._prepare,
+                                       tuple(self._ranges), tuple(kinds),
+                                       num_slots)
+            for cols_stacked, masks, count in _batch_windows(
+                    self._source.execute(partition),
+                    config.FUSED_FOLD_WINDOW.get()):
                 if carry is None:
                     carry = _init_carry(kinds, self._acc_dtypes(),
                                         num_slots)
-                carry = step(carry, cols_flat, mask)
-                n_batches += 1
+                carry = fold(carry, cols_stacked, masks)
+                n_batches += count
         else:
             for batch in self.children[0].execute(partition):
                 kd, kv, ad, av, mask = self._device_inputs(batch)
@@ -603,7 +637,7 @@ def _make_prepare(source_schema: Schema, chain, group_exprs, specs):
     return prepare
 
 
-# key -> (raw_prepare, jitted_prepare) | None when the chain doesn't trace
+# key -> raw prepare fn | None when the chain doesn't trace
 _PREPARE_CACHE: Dict = {}
 _DENSE_STEP_CACHE: Dict = {}
 _CACHE_LIMIT = 128  # bounded like _dense_step_factory's lru_cache
@@ -635,22 +669,63 @@ def _prepare_factory(key, source_schema: Schema, chain, group_exprs,
     return result
 
 
-def _dense_chain_step_factory(key, prepare, ranges, kinds,
-                              num_slots: int):
-    skey = (key, ranges, kinds, num_slots)
-    step = _DENSE_STEP_CACHE.get(skey)
-    if step is not None:
-        return step
+def _batch_windows(stream, window: int):
+    """Stack up to `window` source batches into (cols_stacked, masks,
+    count) with uniform capacity (tail batches pad with masked lanes)."""
+    buf = []
+    for batch in stream:
+        buf.append(_source_inputs(batch))
+        if len(buf) >= window:
+            yield _stack_window(buf)
+            buf = []
+    if buf:
+        yield _stack_window(buf)
+
+
+def _stack_window(items):
+    cap = max(m.shape[0] for _c, m in items)
+
+    def padto(a):
+        if a.shape[0] == cap:
+            return a
+        widths = [(0, cap - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths)
+
+    masks = jnp.stack([padto(m) for _c, m in items])
+    ncols = len(items[0][0])
+    cols = []
+    for i in range(ncols):
+        if items[0][0][i] is None:
+            cols.append(None)
+        else:
+            cols.append((jnp.stack([padto(c[i][0]) for c, _m in items]),
+                         jnp.stack([padto(c[i][1]) for c, _m in items])))
+    return tuple(cols), masks, len(items)
+
+
+def _dense_fold_factory(key, prepare, ranges, kinds, num_slots: int):
+    """ONE XLA program folding a whole window of batches into the carry
+    (fori_loop keeps the carry in place inside the program)."""
+    skey = ("fold", key, ranges, kinds, num_slots)
+    fold = _DENSE_STEP_CACHE.get(skey)
+    if fold is not None:
+        return fold
     _evict_if_full(_DENSE_STEP_CACHE)
 
     @partial(jax.jit, donate_argnums=0)
-    def step(carry, cols_flat, mask):
-        kd, kv, ad, av, m = prepare(cols_flat, mask)
-        gid, _total = pack_dense_keys(list(zip(kd, kv)), list(ranges))
-        return _scatter_into_carry(carry, gid, kinds, ad, av, m, num_slots)
+    def fold(carry, cols_stacked, masks):
+        def body(b, c):
+            cols_b = tuple(
+                None if col is None else (col[0][b], col[1][b])
+                for col in cols_stacked)
+            kd, kv, ad, av, m = prepare(cols_b, masks[b])
+            gid, _total = pack_dense_keys(list(zip(kd, kv)), list(ranges))
+            return _scatter_into_carry(c, gid, kinds, ad, av, m,
+                                       num_slots)
+        return jax.lax.fori_loop(0, masks.shape[0], body, carry)
 
-    _DENSE_STEP_CACHE[skey] = step
-    return step
+    _DENSE_STEP_CACHE[skey] = fold
+    return fold
 
 
 @functools.lru_cache(maxsize=128)

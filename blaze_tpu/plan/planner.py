@@ -66,9 +66,13 @@ def create_plan(d: Dict[str, Any]) -> ExecutionPlan:
         schema = schema_from_dict(d["schema"])
         pred = (expr_from_dict(d["predicate"], schema)
                 if d.get("predicate") else None)
+        pschema = (schema_from_dict(d["partition_schema"])
+                   if d.get("partition_schema") else None)
         return ParquetScanExec(schema, d["file_groups"],
                                projection=d.get("projection"),
-                               predicate=pred)
+                               predicate=pred,
+                               partition_schema=pschema,
+                               partition_values=d.get("partition_values"))
     if k == "memory_scan":
         import pyarrow as pa
         schema = schema_from_dict(d["schema"])

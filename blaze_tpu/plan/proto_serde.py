@@ -663,12 +663,20 @@ def _file_groups_from_conf(conf: pb.FileScanExecConf
     groups: List[List[str]] = [[] for _ in range(n)]
     paths = [f.path for f in conf.file_group.files]
     groups[min(idx, n - 1)] = paths
-    for f in conf.file_group.files:
-        if f.partition_values:
-            raise NotImplementedError(
-                "partition-constant columns not wired for proto scans yet")
     schema = schema_from_proto(conf.schema)
-    return groups, schema
+    extra: Dict[str, Any] = {}
+    if conf.HasField("partition_schema") and \
+            len(conf.partition_schema.columns):
+        extra["partition_schema"] = schema_from_proto(
+            conf.partition_schema)
+        pvals: List[List[List[Any]]] = [[] for _ in range(n)]
+        pvals[min(idx, n - 1)] = [
+            [scalar_from_proto(sv)[0] for sv in f.partition_values]
+            for f in conf.file_group.files]
+        extra["partition_values"] = pvals
+    elif any(f.partition_values for f in conf.file_group.files):
+        raise ValueError("partition_values without partition_schema")
+    return groups, schema, extra
 
 
 def plan_from_proto(n: pb.PhysicalPlanNode) -> Dict[str, Any]:
@@ -678,9 +686,9 @@ def plan_from_proto(n: pb.PhysicalPlanNode) -> Dict[str, Any]:
 
     if kind in ("parquet_scan", "orc_scan"):
         node = n.parquet_scan if kind == "parquet_scan" else n.orc_scan
-        groups, schema = _file_groups_from_conf(node.base_conf)
+        groups, schema, extra = _file_groups_from_conf(node.base_conf)
         d: Dict[str, Any] = {"kind": kind, "schema": schema,
-                             "file_groups": groups}
+                             "file_groups": groups, **extra}
         if node.base_conf.projection:
             names = [schema["fields"][i]["name"]
                      for i in node.base_conf.projection]
@@ -996,8 +1004,17 @@ def plan_to_proto(d: Dict[str, Any]) -> pb.PhysicalPlanNode:
         conf.num_partitions = len(groups)
         idx = non_empty[0] if non_empty else 0
         conf.partition_index = idx
-        for path in groups[idx]:
-            conf.file_group.files.add(path=path)
+        pschema = d.get("partition_schema")
+        pvals = (d.get("partition_values") or [])
+        group_vals = pvals[idx] if idx < len(pvals) else []
+        for fi, path in enumerate(groups[idx]):
+            pf = conf.file_group.files.add(path=path)
+            if pschema is not None and fi < len(group_vals):
+                for v, fld in zip(group_vals[fi], pschema["fields"]):
+                    pf.partition_values.append(
+                        scalar_to_proto(v, fld["type"]))
+        if pschema is not None:
+            conf.partition_schema.CopyFrom(schema_to_proto(pschema))
         conf.schema.CopyFrom(schema_to_proto(d["schema"]))
         if d.get("projection"):
             names = [f["name"] for f in d["schema"]["fields"]]

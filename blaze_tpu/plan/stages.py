@@ -55,12 +55,19 @@ class DagScheduler:
     """Split at exchanges, then run stages bottom-up over the proto wire."""
 
     def __init__(self, work_dir: Optional[str] = None,
-                 max_task_parallelism: int = 4,
+                 max_task_parallelism: Optional[int] = None,
                  task_timeout_s: float = 600.0):
         self._owns_dir = work_dir is None
         self._dir = work_dir or tempfile.mkdtemp(prefix="blaze-dag-")
         os.makedirs(self._dir, exist_ok=True)
         self._files: List[str] = []
+        if max_task_parallelism is None:
+            # executor sizing knob (ref rt.rs:108-112 tokio worker threads
+            # = TOKIO_WORKER_THREADS_PER_CPU x task cpus)
+            from blaze_tpu import config
+            per_cpu = max(1, config.TOKIO_WORKER_THREADS_PER_CPU.get())
+            max_task_parallelism = min(16, per_cpu *
+                                       max(1, (os.cpu_count() or 4) // 2))
         self._par = max_task_parallelism
         self._timeout = task_timeout_s
         self._run_id = uuid.uuid4().hex[:10]

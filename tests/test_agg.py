@@ -220,3 +220,50 @@ def test_host_udaf_fallback():
     d = dict(zip(out.column("k").to_pylist(), out.column("gm").to_pylist()))
     assert d[1] == pytest.approx(4.0)
     assert d[2] == pytest.approx(3.0)
+
+
+def test_high_cardinality_string_keys_under_budget(tmp_path):
+    """VERDICT r2 #8: a high-cardinality string group-by under a small
+    MemManager budget must spill (dictionary bytes are charged to the
+    budget) and still produce exact results."""
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.plan import create_plan
+
+    rng = np.random.default_rng(42)
+    n = 60_000
+    keys = [f"customer_{i:08d}" for i in rng.integers(0, 30_000, n)]
+    t = pa.table({"k": pa.array(keys),
+                  "v": pa.array(rng.random(n))})
+    src = str(tmp_path / "hc.parquet")
+    pq.write_table(t, src)
+    ir = {"kind": "hash_agg",
+          "groupings": [{"expr": {"kind": "column", "name": "k"},
+                         "name": "k"}],
+          "aggs": [{"fn": "sum", "mode": "complete", "name": "s",
+                    "args": [{"kind": "column", "name": "v"}]}],
+          "input": {"kind": "parquet_scan",
+                    "schema": {"fields": [
+                        {"name": "k", "type": {"id": "utf8"},
+                         "nullable": True},
+                        {"name": "v", "type": {"id": "float64"},
+                         "nullable": True}]},
+                    "file_groups": [[src]]}}
+    MemManager.init(512 << 10)  # 512 KiB: far below dict + partials
+    try:
+        plan = create_plan(ir)
+        out = pa.Table.from_batches(
+            [b.compact().to_arrow() for b in plan.execute(0)])
+        spills = plan.collect_metrics().get("spill_count") or 0
+        for ch in getattr(plan.collect_metrics(), "children", []):
+            spills += ch.get("spill_count") or 0
+        assert spills > 0, "expected spills under a 512KiB budget"
+    finally:
+        MemManager.init(4 << 30)
+    got = out.to_pandas().sort_values("k").reset_index(drop=True)
+    want = (t.to_pandas().groupby("k", as_index=False).v.sum()
+            .sort_values("k").reset_index(drop=True))
+    assert len(got) == len(want)
+    np.testing.assert_allclose(got["s"].to_numpy(), want.v.to_numpy(),
+                               rtol=1e-9)

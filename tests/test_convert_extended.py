@@ -249,36 +249,43 @@ def test_tag_plan_reports_reasons(tmp_path):
 def test_remove_inefficient_converts_demotes_islands(tmp_path):
     t = pa.table({"x": pa.array([1], type=pa.int64())})
     files = _write(tmp_path, t)
-    # sort over an unsupported child: sort WOULD convert in isolation but
-    # its child and parent are not native -> demote
-    unsupported_child = plan_node("MysteryExec", {},
-                                  [scan_node([attr("x", "long", 1)[0]],
-                                             files)])
-    plan = plan_node(
-        "CollectLimitExec", {"limit": 5},
-        [plan_node("SortExec",
-                   {"sortOrder": [sort_order(attr("x", "long", 1))]},
-                   [unsupported_child])])
-    tag = tag_plan(plan)
-    sort_tag = tag.children[0]
-    # subtree-based tagging: sort's subtree includes the unsupported
-    # child, so it is already unconvertible with the child's reason
-    assert not sort_tag.convertible
-    assert "MysteryExec" in sort_tag.reason
-
-    # an island in the middle: project(x) under an unsupported parent
-    # whose own child is unsupported
+    # an island in the middle: project(x) is convertible ON ITS OWN
+    # MERITS (its unsupported child exposes output attrs, so tagging
+    # substitutes a ConvertToNative-style placeholder), but its parent
+    # and child are not native -> the island rule demotes it
+    unsupported = [{"class": EXEC + "MysteryExec", "num-children": 1,
+                    "output": [attr("x", "long", 1)]}] + \
+        scan_node([attr("x", "long", 1)[0]], files)
     island = plan_node(
         "CollectLimitExec", {"limit": 1},
         [plan_node("ProjectExec",
                    {"projectList": [attr("x", "long", 1)]},
-                   [plan_node("MysteryExec", {},
-                              [scan_node([attr("x", "long", 1)[0]],
-                                         files)])])])
+                   [unsupported])])
     tag2 = tag_plan(island)
-    # force the middle node convertible to model the per-node tagging the
-    # reference does, then check the island rule demotes it
-    tag2.children[0].convertible = True
+    proj_tag = tag2.children[0]
+    assert proj_tag.convertible          # per-node tagging via placeholder
+    assert not proj_tag.children[0].convertible
     out = remove_inefficient_converts(tag2)
     assert not out.children[0].convertible
     assert "removeInefficientConverts" in out.children[0].reason
+
+    # a node whose unsupported child has NO output attrs cannot be
+    # tagged independently: the child's reason propagates
+    blind = plan_node(
+        "SortExec",
+        {"sortOrder": [sort_order(attr("x", "long", 1))]},
+        [plan_node("MysteryExec", {},
+                   [scan_node([attr("x", "long", 1)[0]], files)])])
+    tag3 = tag_plan(blind)
+    assert not tag3.convertible
+    assert "MysteryExec" in tag3.reason
+
+
+def test_pyspark_shim_importable_and_gated():
+    """The PySpark driver shim (convert/shim.py) is exercised only where
+    pyspark exists; here we pin its import surface so refactors keep it
+    loadable."""
+    from blaze_tpu.convert import shim
+    assert callable(shim.execute_dataframe)
+    assert callable(shim.extract_plan_json)
+    pytest.importorskip("pyspark")  # full path needs a JVM + Spark

@@ -1,0 +1,92 @@
+"""VERDICT r2 #10 parity holes: OrcSinkExec coverage and partition-
+constant columns riding the proto wire (ref orc_sink_exec.rs:568,
+planner.rs:170-200 FileScanExecConf partition values)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.memory import MemManager
+from blaze_tpu.plan import create_plan
+from blaze_tpu.plan.proto_serde import (plan_from_proto, plan_to_proto,
+                                        task_definition_to_bytes)
+
+
+@pytest.fixture(autouse=True)
+def budget():
+    MemManager.init(4 << 30)
+
+
+def test_orc_sink_roundtrip(tmp_path):
+    from pyarrow import orc
+    t = pa.table({"k": pa.array([3, 1, 2], type=pa.int64()),
+                  "s": pa.array(["c", "a", "b"])})
+    src = str(tmp_path / "in.parquet")
+    pq.write_table(t, src)
+    out = str(tmp_path / "orc_out")
+    ir = {"kind": "orc_sink", "path": out,
+          "input": {"kind": "parquet_scan",
+                    "schema": {"fields": [
+                        {"name": "k", "type": {"id": "int64"},
+                         "nullable": True},
+                        {"name": "s", "type": {"id": "utf8"},
+                         "nullable": True}]},
+                    "file_groups": [[src]]}}
+    plan = create_plan(ir)
+    list(plan.execute(0))
+    files = sorted((tmp_path / "orc_out").iterdir())
+    assert len(files) == 1 and files[0].suffix == ".orc"
+    back = orc.read_table(str(files[0]))
+    assert back.equals(t)
+    # and the sink rides the proto wire
+    decoded = plan_from_proto(plan_to_proto(ir))
+    assert decoded["kind"] == "orc_sink"
+
+
+def test_partition_values_over_proto_wire(tmp_path):
+    """Hive-partitioned scan: the file carries (k, v); partition columns
+    (p_date) are constants attached per file — the connector-scan shape
+    that previously could not ride the wire."""
+    t = pa.table({"k": pa.array([1, 2], type=pa.int64()),
+                  "v": pa.array([10.0, 20.0])})
+    src = str(tmp_path / "part0.parquet")
+    pq.write_table(t, src)
+    ir = {"kind": "parquet_scan",
+          "schema": {"fields": [
+              {"name": "k", "type": {"id": "int64"}, "nullable": True},
+              {"name": "v", "type": {"id": "float64"},
+               "nullable": True}]},
+          "partition_schema": {"fields": [
+              {"name": "p_state", "type": {"id": "utf8"},
+               "nullable": True},
+              {"name": "p_year", "type": {"id": "int64"},
+               "nullable": True}]},
+          "partition_values": [[["CA", 2001]]],
+          "file_groups": [[src]]}
+
+    # direct execution appends the constants
+    got = pa.Table.from_batches(
+        [b.compact().to_arrow() for b in create_plan(ir).execute(0)])
+    assert got.column_names == ["k", "v", "p_state", "p_year"]
+    assert got.column("p_state").to_pylist() == ["CA", "CA"]
+    assert got.column("p_year").to_pylist() == [2001, 2001]
+
+    # proto round trip preserves schema + values
+    decoded = plan_from_proto(plan_to_proto(ir))
+    assert decoded["partition_values"] == [[["CA", 2001]]]
+    assert [f["name"] for f in decoded["partition_schema"]["fields"]] == \
+        ["p_state", "p_year"]
+
+    # and the full TaskDefinition wire executes
+    from blaze_tpu.bridge.runtime import NativeExecutionRuntime
+    td = task_definition_to_bytes(
+        {"stage_id": 0, "partition_id": 0, "num_partitions": 1,
+         "plan": ir})
+    rt = NativeExecutionRuntime(td).start()
+    try:
+        rows = list(rt.batches())
+    finally:
+        rt.finalize()
+    wired = pa.Table.from_batches(rows)
+    assert wired.column("p_year").to_pylist() == [2001, 2001]
