@@ -14,6 +14,13 @@ boundary).  XLA wants static shapes, so the TPU-native equivalent is:
   * variable-width columns (utf8/binary/nested) stay host-resident as Arrow
     arrays and join the device columns only through dedicated kernels
     (offsets+bytes form) — TPU has no pointers.
+
+Residency: when compute placement pins to host (placement.host_resident),
+"device" column buffers are plain numpy arrays — the glue ops here dispatch
+through xputil.xp_of so padding/masking/compaction run as numpy (no eager
+XLA program launches), while jit'd stage kernels consume the numpy operands
+directly.  With a locally-attached accelerator the buffers are jax arrays
+and every path routes through jnp exactly as before.
 """
 
 from __future__ import annotations
@@ -28,8 +35,14 @@ import pyarrow as pa
 
 from blaze_tpu import config
 from blaze_tpu.schema import DataType, Field, Schema, TypeId
+from blaze_tpu.xputil import asnp, xp_of
 
 LANE = 128  # TPU lane width; device buffers are padded to a multiple of this
+
+
+def _host_resident() -> bool:
+    from blaze_tpu.bridge.placement import host_resident
+    return host_resident()
 
 
 def round_capacity(n: int) -> int:
@@ -72,7 +85,7 @@ class DeviceColumn:
     """Fixed-width column resident on device: padded data + validity."""
 
     dtype: DataType
-    data: jax.Array      # (capacity,)
+    data: jax.Array      # (capacity,); numpy when host-resident
     validity: jax.Array  # (capacity,) bool; False in padding
 
     @property
@@ -89,6 +102,8 @@ class DeviceColumn:
         data[:n] = values
         v = np.zeros(capacity, dtype=bool)
         v[:n] = True if valid is None else valid
+        if _host_resident():
+            return DeviceColumn(dtype, data, v)
         return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(v))
 
     @staticmethod
@@ -96,6 +111,13 @@ class DeviceColumn:
         arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
         values = _arrow_fixed_values(arr, dtype)
         valid = _unpack_validity(arr)
+        if capacity == len(arr) and _host_resident():
+            # zero-copy: numpy views over the Arrow buffers (host-resident
+            # batches are unpadded, and nothing mutates column data in
+            # place)
+            return DeviceColumn(dtype,
+                                values.astype(dtype.np_dtype(), copy=False),
+                                valid)
         return DeviceColumn.from_numpy(values, valid, dtype, capacity)
 
     def to_arrow(self, num_rows: int, selection: Optional[np.ndarray] = None,
@@ -108,22 +130,22 @@ class DeviceColumn:
             values = values[:num_rows]
             valid = valid[:num_rows]
         else:
-            values = np.asarray(self.data)[:num_rows]
-            valid = np.asarray(self.validity)[:num_rows]
+            values = asnp(self.data)[:num_rows]
+            valid = asnp(self.validity)[:num_rows]
         if selection is not None:
             values = values[selection[:num_rows]]
             valid = valid[selection[:num_rows]]
-        mask = ~valid
+        mask = None if valid.all() else ~valid  # no nulls -> zero-copy
         at = self.dtype.to_arrow()
         if self.dtype.id == TypeId.DECIMAL:
-            ints = pa.array(values, mask=mask)
             # unscaled int64 -> decimal128 via arrow cast of the raw integers,
             # then reinterpret scale (arrow cast would rescale, so build
             # decimal from pieces instead)
             import decimal as pydec
             scale = self.dtype.scale
+            null = np.zeros(len(values), bool) if mask is None else mask
             py = [None if m else pydec.Decimal(int(v)).scaleb(-scale)
-                  for v, m in zip(values.tolist(), mask.tolist())]
+                  for v, m in zip(values.tolist(), null.tolist())]
             return pa.array(py, type=at)
         if self.dtype.id == TypeId.BOOL:
             return pa.array(values.astype(bool), type=at, mask=mask)
@@ -131,8 +153,8 @@ class DeviceColumn:
 
     def take_host(self, indices: np.ndarray) -> "DeviceColumn":
         """Gather rows host-side (compaction boundary)."""
-        values = np.asarray(self.data)[indices]
-        valid = np.asarray(self.validity)[indices]
+        values = asnp(self.data)[indices]
+        valid = asnp(self.validity)[indices]
         return DeviceColumn.from_numpy(values, valid, self.dtype,
                                        round_capacity(len(indices)))
 
@@ -187,7 +209,13 @@ class ColumnBatch:
             arrays = list(rb.columns)
         schema = Schema.from_arrow(rb.schema)
         n = rb.num_rows
-        cap = capacity or round_capacity(n)
+        if capacity is not None:
+            cap = capacity
+        elif _host_resident():
+            cap = n  # unpadded: numpy needs no static shapes; buffers wrap
+            # the Arrow memory zero-copy (jit consumers re-pad on entry)
+        else:
+            cap = round_capacity(n)
         cols: List[Column] = []
         for arr, f in zip(arrays, schema):
             if f.data_type.is_fixed_width:
@@ -224,10 +252,20 @@ class ColumnBatch:
     def column(self, i: int) -> Column:
         return self.columns[i]
 
+    def _xp(self):
+        """Array namespace for this batch's buffers (numpy when
+        host-resident, jnp for device arrays or inside a jit trace)."""
+        probe = [self.selection]
+        for c in self.columns:
+            if isinstance(c, DeviceColumn):
+                probe.append(c.data)
+                break
+        return xp_of(*probe)
+
     def row_mask(self) -> jax.Array:
         """Device bool mask over capacity: in-range AND selected."""
         cap = self.capacity
-        base = jnp.arange(cap) < self.num_rows
+        base = self._xp().arange(cap) < self.num_rows
         if self.selection is not None:
             base = base & self.selection
         return base
@@ -239,7 +277,7 @@ class ColumnBatch:
             return self.num_rows
         c = getattr(self, "_sel_count", None)
         if c is None:
-            c = int(jnp.sum(self.row_mask()))
+            c = int(self._xp().sum(self.row_mask()))
             self._sel_count = c  # dataclasses.replace drops the cache
         return c
 
@@ -261,8 +299,11 @@ class ColumnBatch:
         count = self.selected_count()
         if count == self.num_rows:
             return replace(self, selection=None)
-        if any(isinstance(c, HostColumn) for c in self.columns):
-            sel_np = np.asarray(self.row_mask())
+        if self._xp() is np or any(isinstance(c, HostColumn)
+                                   for c in self.columns):
+            # host-resident (or string-bearing) batches compact with one
+            # numpy fancy-index pass — no XLA program launches
+            sel_np = asnp(self.row_mask())
             indices = np.nonzero(sel_np)[0]
             cols = [c.take_host(indices) for c in self.columns]
             return ColumnBatch(self.schema, cols, len(indices), None)
@@ -294,7 +335,10 @@ class ColumnBatch:
         for i in dev_idx:
             to_fetch.append(self.columns[i].data)
             to_fetch.append(self.columns[i].validity)
-        fetched = jax.device_get(to_fetch) if to_fetch else []
+        if to_fetch and all(isinstance(x, np.ndarray) for x in to_fetch):
+            fetched = to_fetch  # host-resident: nothing to sync
+        else:
+            fetched = jax.device_get(to_fetch) if to_fetch else []
         pos = 0
         sel = None
         if self.selection is not None:
@@ -323,14 +367,15 @@ class ColumnBatch:
         cols: List[Column] = []
         for i, f in enumerate(schema):
             if f.data_type.is_fixed_width:
-                vals = jnp.concatenate(
+                xp = xp_of(*[b.columns[i].data for b in batches])
+                vals = xp.concatenate(
                     [b.columns[i].data[:b.num_rows] for b in batches])
-                valid = jnp.concatenate(
+                valid = xp.concatenate(
                     [b.columns[i].validity[:b.num_rows] for b in batches])
                 pad = cap - total
                 if pad > 0:
-                    vals = jnp.pad(vals, (0, pad))
-                    valid = jnp.pad(valid, (0, pad))
+                    vals = xp.pad(vals, (0, pad))
+                    valid = xp.pad(valid, (0, pad))
                 cols.append(DeviceColumn(f.data_type, vals, valid))
             else:
                 arrs = [b.columns[i].array for b in batches]

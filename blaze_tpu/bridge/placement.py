@@ -66,17 +66,21 @@ def ensure_placement() -> PlacementInfo:
 
         from blaze_tpu import config
         policy = config.PLACEMENT.get()
+        if policy == "host":
+            # forced host must NOT touch the accelerator at all — the
+            # override exists precisely for a wedged backend, so decide
+            # BEFORE any call that would initialize the default backend
+            # (jax.default_backend() plugs in the accelerator runtime)
+            jax.config.update("jax_platforms", "cpu")
+            cpu = jax.local_devices(backend="cpu")[0]
+            jax.config.update("jax_default_device", cpu)
+            _info = PlacementInfo("cpu", "unknown (not initialized)", -1.0,
+                                  policy)
+            return _info
         platform = jax.default_backend()
         if platform == "cpu" or policy == "device":
             _info = PlacementInfo("cpu" if platform == "cpu" else platform,
                                   platform, 0.0, policy)
-            return _info
-        if policy == "host":
-            # forced host must NOT touch the accelerator at all — the
-            # override exists precisely for a wedged backend
-            cpu = jax.local_devices(backend="cpu")[0]
-            jax.config.update("jax_default_device", cpu)
-            _info = PlacementInfo("cpu", platform, -1.0, policy)
             return _info
         rtt = _measure_rtt_ms()
         threshold = config.PLACEMENT_RTT_THRESHOLD_MS.get()
@@ -97,3 +101,16 @@ def ensure_placement() -> PlacementInfo:
 
 def placement_info() -> Optional[PlacementInfo]:
     return _info
+
+
+def host_resident() -> bool:
+    """True when per-batch columns should live as numpy arrays (compute
+    pinned to host XLA): glue ops then run as numpy with nanosecond
+    dispatch while the fused loops stay jit'd (see xputil.py).  Before
+    placement is decided, fall back to the default backend — tests run
+    with JAX_PLATFORMS=cpu and get the fast path; a live accelerator
+    keeps device residency."""
+    if _info is not None:
+        return _info.device_kind == "cpu"
+    import jax
+    return jax.default_backend() == "cpu"

@@ -54,15 +54,42 @@ class NativeExecutionRuntime:
         self._error: Optional[BaseException] = None
         self._finalized = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # host-pinned compute has no async device work to overlap with the
+        # consumer: the producer thread + queue handoff would only add GIL
+        # contention and context switches, so pull batches synchronously
+        # (the reference's tokio runtime is the analog of the THREADED
+        # path, rt.rs:114-140; host mode ~ its current_thread runtime)
+        from blaze_tpu.bridge.placement import host_resident
+        self._sync = host_resident()
+        self._sync_iter = None
 
     # -- lifecycle (ref rt.rs:76 start) ------------------------------------
     def start(self) -> "NativeExecutionRuntime":
+        if self._sync:
+            return self
         self._thread = threading.Thread(target=self._produce, daemon=True,
                                         name=f"blaze-task-"
                                              f"{self.task.stage_id}."
                                              f"{self.task.partition_id}")
         self._thread.start()
         return self
+
+    def _sync_batches(self) -> Iterator[pa.RecordBatch]:
+        with task_scope(self.task):
+            stream = self.plan.execute(self.task.partition_id)
+            stats = config.INPUT_BATCH_STATISTICS.get()
+            for batch in stream:
+                if self._finalized.is_set():
+                    return
+                rb = batch.compact().to_arrow()
+                if rb.num_rows == 0:
+                    continue
+                if stats:
+                    m = self.plan.metrics
+                    m.add("output_batches_total", 1)
+                    m.add("output_rows_total", rb.num_rows)
+                    m.add("output_bytes_total", rb.nbytes)
+                yield rb
 
     def _produce(self) -> None:
         try:
@@ -102,6 +129,10 @@ class NativeExecutionRuntime:
                    ) -> Optional[pa.RecordBatch]:
         """Next output batch, or None at end-of-stream.  Raises the
         producer's error if it failed."""
+        if self._sync:
+            if self._sync_iter is None:
+                self._sync_iter = self._sync_batches()
+            return next(self._sync_iter, None)
         if self._error is not None:
             raise self._error
         item = self._queue.get(timeout=timeout)
@@ -122,6 +153,9 @@ class NativeExecutionRuntime:
     def finalize(self) -> MetricNode:
         self._finalized.set()
         self.task.is_running = lambda: False
+        if self._sync:
+            self._sync_iter = None
+            return self.plan.collect_metrics()
         # drain so a blocked producer can observe the flag and exit
         try:
             while True:

@@ -13,9 +13,24 @@ from concurrent.futures import ThreadPoolExecutor, wait
 from typing import Any, Callable, List, Optional
 
 
+def default_task_parallelism(n: int) -> int:
+    """Concurrent task slots.  Device placement overlaps host round trips,
+    so one slot per task; host placement runs tasks serially by default —
+    the per-task work is Python-orchestrated (GIL) around C++ kernels that
+    already use every core intra-op, and measured 4-task concurrency on a
+    2-core host was 2.5x SLOWER than serial (GIL contention + thread
+    thrash).  `auron.tpu.host.taskParallelism` overrides."""
+    from blaze_tpu.bridge.placement import host_resident
+    if not host_resident():
+        return max(1, n)
+    from blaze_tpu import config
+    return max(1, min(n, config.HOST_TASK_PARALLELISM.get()))
+
+
 def run_tasks(fn: Callable[[int], Any], n: int, timeout_s: float,
               what: str, max_workers: Optional[int] = None) -> List[Any]:
-    pool = ThreadPoolExecutor(max_workers=max_workers or max(1, n))
+    pool = ThreadPoolExecutor(max_workers=max_workers or
+                              default_task_parallelism(n))
     futs = [pool.submit(fn, i) for i in range(n)]
     done, not_done = wait(futs, timeout=timeout_s)
     if not_done:

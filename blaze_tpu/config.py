@@ -290,6 +290,22 @@ PLACEMENT_RTT_THRESHOLD_MS = float_conf(
     "auron.tpu.placement.rtt.threshold.ms", 5.0,
     "Auto-placement cutoff: measured per-dispatch round trip above this "
     "means the accelerator is remote/tunneled and stages run on host XLA.")
+FUSED_HOST_COLLECT_ROWS = int_conf(
+    "auron.tpu.fused.hostVectorized.collectRows", 1 << 21,
+    "Buffered input rows before the host-vectorized agg re-merges into "
+    "its running acc table (bounds memory by distinct groups; the "
+    "InMemTable spill-trigger analog).")
+FUSED_HOST_VECTORIZED_ENABLE = bool_conf(
+    "auron.tpu.fused.hostVectorized", True,
+    "Under host placement, run eligible fused aggregations through "
+    "Arrow's multithreaded C++ hash aggregation instead of XLA-CPU "
+    "programs (plan/fused.py _execute_host_vectorized).")
+HOST_TASK_PARALLELISM = int_conf(
+    "auron.tpu.host.taskParallelism", 1,
+    "Concurrent task slots under host placement.  Host tasks are "
+    "Python-orchestrated around intra-op-parallel C++ kernels, so serial "
+    "tasks with all cores inside each kernel beat GIL-contended task "
+    "concurrency (the TASK_CPUS analog for the host path).")
 CASE_SENSITIVE = bool_conf("spark.sql.caseSensitive", False, "Column name matching.")
 ANSI_ENABLED = bool_conf(
     "spark.sql.ansi.enabled", False,
@@ -359,9 +375,10 @@ PARQUET_METADATA_CACHE_SIZE = int_conf(
     "Parquet footer/metadata entries cached across scans and bound "
     "discovery (ops/scan.py parquet_metadata).", category="scan")
 IO_COMPRESSION_CODEC = str_conf(
-    "io.compression.codec", "zstd",
-    "Shuffle IPC frame codec: zstd | raw (lz4 is not in this build and "
-    "maps to raw).  Unset, auron.spill.compression.codec applies.",
+    "io.compression.codec", "lz4",
+    "Shuffle IPC frame codec: lz4 (reference default, Arrow C++ "
+    "lz4-frame) | zstd | raw.  Unset, auron.spill.compression.codec "
+    "applies.  lz4 falls back to raw when Arrow lacks the codec.",
     category="shuffle")
 IO_COMPRESSION_ZSTD_LEVEL = int_conf(
     "io.compression.zstd.level", 1,

@@ -21,6 +21,7 @@ import pyarrow as pa
 
 from blaze_tpu.batch import ColumnBatch, DeviceColumn, HostColumn
 from blaze_tpu.schema import DataType, Schema, TypeId
+from blaze_tpu.xputil import xp_of
 
 
 @dataclass
@@ -42,7 +43,7 @@ class ColVal:
     def device(dtype: DataType, data: jax.Array,
                validity: Optional[jax.Array] = None) -> "ColVal":
         if validity is None:
-            validity = jnp.ones(data.shape[0], dtype=bool)
+            validity = xp_of(data).ones(data.shape[0], dtype=bool)
         return ColVal(dtype, data=data, validity=validity)
 
     @staticmethod
@@ -86,6 +87,8 @@ class ColVal:
         np_mask = np.asarray(vals.fill_null(False), dtype=bool)
         padded = np.zeros(batch.capacity, dtype=bool)
         padded[:len(np_mask)] = np_mask
+        if batch._xp() is np:
+            return padded
         return jnp.asarray(padded)
 
 
@@ -150,14 +153,17 @@ class Literal(PhysicalExpr):
     def evaluate(self, batch: ColumnBatch) -> ColVal:
         cap = batch.capacity
         if self.dtype.is_fixed_width:
+            # numpy constants are safe both eagerly (host residency) and
+            # inside jit traces (embedded as XLA constants)
+            xp = batch._xp()
             if self.value is None:
-                data = jnp.zeros(cap, dtype=self.dtype.jnp_dtype())
+                data = xp.zeros(cap, dtype=self.dtype.jnp_dtype())
                 return ColVal(self.dtype, data=data,
-                              validity=jnp.zeros(cap, dtype=bool),
+                              validity=xp.zeros(cap, dtype=bool),
                               literal=True)
-            data = jnp.full(cap, self.value, dtype=self.dtype.jnp_dtype())
+            data = xp.full(cap, self.value, dtype=self.dtype.jnp_dtype())
             return ColVal(self.dtype, data=data,
-                          validity=jnp.ones(cap, dtype=bool), literal=True)
+                          validity=xp.ones(cap, dtype=bool), literal=True)
         arr = pa.array([self.value] * batch.num_rows, type=self.dtype.to_arrow())
         return ColVal(self.dtype, array=arr, literal=True)
 

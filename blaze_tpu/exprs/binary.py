@@ -20,11 +20,13 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pyarrow.compute as pc
 
 from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.exprs.base import ColVal, PhysicalExpr
 from blaze_tpu.schema import BOOL, DataType, Schema, TypeId
+from blaze_tpu.xputil import xp_of
 
 
 def _both_valid(a: ColVal, b: ColVal) -> jax.Array:
@@ -132,8 +134,9 @@ def _compare(op: str, a: ColVal, b: ColVal) -> ColVal:
         # grouping equality, ref eq_comparator.rs)
         eq = null_aware_eq(x, a.validity, y, b.validity)
         return ColVal.device(BOOL, eq)
-    fns = {"==": jnp.equal, "!=": jnp.not_equal, "<": jnp.less,
-           "<=": jnp.less_equal, ">": jnp.greater, ">=": jnp.greater_equal}
+    import operator as _op
+    fns = {"==": _op.eq, "!=": _op.ne, "<": _op.lt,
+           "<=": _op.le, ">": _op.gt, ">=": _op.ge}
     data = fns[op](x, y)
     valid = _both_valid(a, b)
     return ColVal(BOOL, data=data & valid, validity=valid)
@@ -141,65 +144,67 @@ def _compare(op: str, a: ColVal, b: ColVal) -> ColVal:
 
 def _arith(op: str, a: ColVal, b: ColVal, out_dtype: DataType) -> ColVal:
     x, y = _promote(a, b)
+    xp = xp_of(x, y)
     valid = _both_valid(a, b)
     is_float = jnp.issubdtype(x.dtype, jnp.floating)
 
     if op in ("/", "%", "pmod") and not is_float:
         zero = y == 0
         valid = valid & ~zero
-        y = jnp.where(zero, jnp.ones_like(y), y)  # avoid div-by-zero traps
+        y = xp.where(zero, xp.ones_like(y), y)  # avoid div-by-zero traps
 
-    if op == "+":
-        data = x + y
-    elif op == "-":
-        data = x - y
-    elif op == "*":
-        data = x * y
-    elif op == "/":
-        if is_float:
-            data = x / y          # inf/nan like Spark double division
-        elif a.dtype.id == TypeId.DECIMAL or b.dtype.id == TypeId.DECIMAL:
-            data = x // y         # decimal div handled by planner rescale
+    with np.errstate(all="ignore"):  # numpy path: inf/nan silently, like XLA
+        if op == "+":
+            data = x + y
+        elif op == "-":
+            data = x - y
+        elif op == "*":
+            data = x * y
+        elif op == "/":
+            if is_float:
+                data = x / y      # inf/nan like Spark double division
+            elif a.dtype.id == TypeId.DECIMAL or b.dtype.id == TypeId.DECIMAL:
+                data = x // y     # decimal div handled by planner rescale
+            else:
+                # Spark integral `/` yields double; `div` yields long.  The
+                # planner emits Cast around this node; here: truncating int
+                # div like Java (toward zero), not floor
+                q = xp.abs(x) // xp.abs(y)
+                data = xp.where((x < 0) ^ (y < 0), -q, q)
+        elif op == "%":
+            if is_float:
+                data = xp.where(xp.isfinite(y) | xp.isnan(y),
+                                x - xp.trunc(x / y) * y, x)
+                data = xp.where(xp.isinf(y) & xp.isfinite(x), x, data)
+            else:
+                # Java %: sign follows dividend
+                r = xp.abs(x) % xp.abs(y)
+                data = xp.where(x < 0, -r, r)
+        elif op == "pmod":
+            # Spark pmod: ((x % y) + y) % y, sign follows divisor magnitude
+            if is_float:
+                r = x - xp.trunc(x / y) * y
+                data = xp.where((r != 0) & ((r < 0) != (y < 0)), r + y, r)
+            else:
+                r = xp.abs(x) % xp.abs(y)
+                r = xp.where(x < 0, -r, r)
+                data = xp.where(r < 0, r + xp.abs(y), r)
+        elif op == "&":
+            data = x & y
+        elif op == "|":
+            data = x | y
+        elif op == "^":
+            data = x ^ y
+        elif op == "<<":
+            data = x << (y.astype(x.dtype) & (x.dtype.itemsize * 8 - 1))
+        elif op == ">>":
+            data = x >> (y.astype(x.dtype) & (x.dtype.itemsize * 8 - 1))
         else:
-            # Spark integral `/` yields double; `div` yields long.  The
-            # planner emits Cast around this node; here: truncating int div
-            # like Java (toward zero), not floor
-            q = jnp.abs(x) // jnp.abs(y)
-            data = jnp.where((x < 0) ^ (y < 0), -q, q)
-    elif op == "%":
-        if is_float:
-            data = jnp.where(jnp.isfinite(y) | jnp.isnan(y),
-                             x - jnp.trunc(x / y) * y, x)
-            data = jnp.where(jnp.isinf(y) & jnp.isfinite(x), x, data)
-        else:
-            # Java %: sign follows dividend
-            r = jnp.abs(x) % jnp.abs(y)
-            data = jnp.where(x < 0, -r, r)
-    elif op == "pmod":
-        # Spark pmod: ((x % y) + y) % y, sign follows divisor's magnitude
-        if is_float:
-            r = x - jnp.trunc(x / y) * y
-            data = jnp.where((r != 0) & ((r < 0) != (y < 0)), r + y, r)
-        else:
-            r = jnp.abs(x) % jnp.abs(y)
-            r = jnp.where(x < 0, -r, r)
-            data = jnp.where(r < 0, r + jnp.abs(y), r)
-    elif op == "&":
-        data = x & y
-    elif op == "|":
-        data = x | y
-    elif op == "^":
-        data = x ^ y
-    elif op == "<<":
-        data = x << (y.astype(x.dtype) & (x.dtype.itemsize * 8 - 1))
-    elif op == ">>":
-        data = x >> (y.astype(x.dtype) & (x.dtype.itemsize * 8 - 1))
-    else:
-        raise TypeError(f"unknown arithmetic op {op}")
+            raise TypeError(f"unknown arithmetic op {op}")
 
     if out_dtype.is_fixed_width and data.dtype != out_dtype.jnp_dtype():
         data = data.astype(out_dtype.jnp_dtype())
-    data = jnp.where(valid, data, jnp.zeros_like(data))
+    data = xp.where(valid, data, xp.zeros_like(data))
     return ColVal(out_dtype, data=data, validity=valid)
 
 

@@ -63,10 +63,10 @@ class Cast(PhysicalExpr):
         return out
 
     def _ansi_check_device(self, v_in: ColVal, valid_out, batch) -> None:
-        import jax.numpy as jnp
+        from blaze_tpu.xputil import xp_of
         mask = batch.row_mask()
         lost = v_in.validity & ~valid_out & mask
-        if bool(jnp.any(lost)):
+        if bool(xp_of(lost).any(lost)):
             raise ValueError(
                 f"[CAST_INVALID_INPUT] cast to {self.to!r} failed in ANSI "
                 f"mode (use try_cast to tolerate malformed input)")

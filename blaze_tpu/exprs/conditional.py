@@ -19,6 +19,7 @@ import pyarrow.compute as pc
 from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.exprs.base import ColVal, PhysicalExpr
 from blaze_tpu.schema import BOOL, DataType, Schema
+from blaze_tpu.xputil import xp_of
 
 
 @dataclass(frozen=True, repr=False)
@@ -113,21 +114,23 @@ class CaseWhen(PhysicalExpr):
         if not dtype.is_fixed_width:
             return self._evaluate_host(batch, dtype)
         cap = batch.capacity
-        # evaluate lazily from the last branch backwards under jnp.where
+        xp = batch._xp()
+        # evaluate lazily from the last branch backwards under xp.where
         if self.otherwise is not None:
             acc = self.otherwise.evaluate(batch).to_device(cap)
             data, valid = acc.data.astype(dtype.jnp_dtype()), acc.validity
         else:
-            data = jnp.zeros(cap, dtype=dtype.jnp_dtype())
-            valid = jnp.zeros(cap, dtype=bool)
-        taken = jnp.zeros(cap, dtype=bool)
+            data = xp.zeros(cap, dtype=dtype.jnp_dtype())
+            valid = xp.zeros(cap, dtype=bool)
+        taken = xp.zeros(cap, dtype=bool)
         for pred_e, val_e in self.branches:
             pred = pred_e.evaluate(batch)
             hit = pred.as_mask(batch) & ~taken if pred.is_device else \
                 pred.as_mask(batch) & ~taken
             val = val_e.evaluate(batch).to_device(cap)
-            data = jnp.where(hit, val.data.astype(dtype.jnp_dtype()), data)
-            valid = jnp.where(hit, val.validity, valid)
+            xp = xp_of(data, val.data, hit)
+            data = xp.where(hit, val.data.astype(dtype.jnp_dtype()), data)
+            valid = xp.where(hit, val.validity, valid)
             taken = taken | hit
         # rows where no branch fired and no ELSE keep validity False
         return ColVal(dtype, data=data, validity=valid)
@@ -174,7 +177,8 @@ class Coalesce(PhysicalExpr):
         for e in self.args[1:]:
             v = e.evaluate(batch).to_device(cap)
             fill = ~valid & v.validity
-            data = jnp.where(fill, v.data.astype(dtype.jnp_dtype()), data)
+            xp = xp_of(data, v.data, fill)
+            data = xp.where(fill, v.data.astype(dtype.jnp_dtype()), data)
             valid = valid | v.validity
         return ColVal(dtype, data=data, validity=valid)
 
@@ -201,9 +205,10 @@ class InList(PhysicalExpr):
         has_null_member = any(x is None for x in self.values)
         members = [x for x in self.values if x is not None]
         if v.is_device:
-            hit = jnp.zeros(v.data.shape[0], dtype=bool)
+            xp = xp_of(v.data)
+            hit = xp.zeros(v.data.shape[0], dtype=bool)
             for m in members:
-                hit = hit | (v.data == jnp.asarray(m, dtype=v.data.dtype))
+                hit = hit | (v.data == xp.asarray(m, dtype=v.data.dtype))
             # no match + a null member -> NULL (the null could have matched)
             valid = (v.validity & hit) if has_null_member else v.validity
             data = hit if not self.negated else ~hit

@@ -22,6 +22,7 @@ from blaze_tpu.bridge.context import current_task
 from blaze_tpu.exprs.base import ColVal, PhysicalExpr
 from blaze_tpu.schema import (BOOL, DataType, Field, INT32, INT64, FLOAT64,
                               Schema, TypeId)
+from blaze_tpu.xputil import xp_of
 
 
 @dataclass(frozen=True, repr=False)
@@ -34,7 +35,10 @@ class RowNum(PhysicalExpr):
 
     def evaluate(self, batch: ColumnBatch) -> ColVal:
         base = getattr(batch, "row_num_offset", 0)
-        data = jnp.arange(batch.capacity, dtype=jnp.int64) + jnp.int64(base)
+        xp = batch._xp()
+        # python-int base: adding a jnp scalar would promote a numpy
+        # operand back to a jax array and defeat host residency
+        data = xp.arange(batch.capacity, dtype=jnp.int64) + int(base)
         return ColVal.device(INT64, data)
 
     def cache_key(self):
@@ -51,7 +55,7 @@ class SparkPartitionId(PhysicalExpr):
     def evaluate(self, batch: ColumnBatch) -> ColVal:
         pid = current_task().partition_id
         return ColVal.device(
-            INT32, jnp.full(batch.capacity, pid, dtype=jnp.int32))
+            INT32, batch._xp().full(batch.capacity, pid, dtype=jnp.int32))
 
 
 @dataclass(frozen=True, repr=False)
@@ -65,8 +69,9 @@ class MonotonicallyIncreasingId(PhysicalExpr):
     def evaluate(self, batch: ColumnBatch) -> ColVal:
         base = getattr(batch, "row_num_offset", 0)
         pid = current_task().partition_id
-        rows = jnp.arange(batch.capacity, dtype=jnp.int64) + jnp.int64(base)
-        return ColVal.device(INT64, (jnp.int64(pid) << 33) | rows)
+        xp = batch._xp()
+        rows = xp.arange(batch.capacity, dtype=jnp.int64) + int(base)
+        return ColVal.device(INT64, (int(pid) << 33) | rows)
 
     def cache_key(self):
         return ("mono_id", id(self))
@@ -118,7 +123,7 @@ class BloomFilterMightContain(PhysicalExpr):
         if filt is None:
             # filter not built (empty build side): everything might match
             return ColVal.device(
-                BOOL, jnp.ones(batch.capacity, dtype=bool))
+                BOOL, batch._xp().ones(batch.capacity, dtype=bool))
         v = self.value.evaluate(batch)
         if not v.is_device:
             v = v.to_device(batch.capacity)

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from blaze_tpu.schema import DataType, TypeId
+from blaze_tpu.xputil import xp_of
 
 _US_PER_DAY = 86_400_000_000
 
@@ -54,6 +55,7 @@ def cast_column(data: jax.Array, validity: Optional[jax.Array],
                              (src.precision, src.scale) == (dst.precision, dst.scale)):
         return data, validity
 
+    jnp = xp_of(data, validity)  # numpy for host-resident columns
     s, d = src.id, dst.id
     v = validity
 
@@ -158,6 +160,7 @@ def cast_column(data: jax.Array, validity: Optional[jax.Array],
 
 def _rescale_decimal(data, validity, src: DataType, dst: DataType):
     """decimal(p1,s1) -> decimal(p2,s2) on int64 unscaled values."""
+    jnp = xp_of(data, validity)
     diff = dst.scale - src.scale
     if diff >= 0:
         # pre-multiplication overflow guard (same wraparound hazard as above)

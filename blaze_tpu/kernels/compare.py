@@ -17,9 +17,12 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple
 
 import jax
-import jax.numpy as jnp
+import jax.numpy as _jnp
 
 from blaze_tpu.schema import DataType, TypeId
+from blaze_tpu.xputil import xp_of
+
+import numpy as np
 
 
 def order_key(data: jax.Array, validity: Optional[jax.Array], dtype: DataType,
@@ -39,6 +42,7 @@ def order_key(data: jax.Array, validity: Optional[jax.Array], dtype: DataType,
     NaN value-keys are zeroed and -0.0 normalized to +0.0, so the same
     operands double as grouping keys (NaN == NaN, -0.0 == 0.0, null == null).
     """
+    jnp = xp_of(data, validity)
     tid = dtype.id
     n = data.shape[0]
     if tid in (TypeId.FLOAT32, TypeId.FLOAT64):
@@ -82,10 +86,14 @@ def lexsort_indices(keys: Sequence[jax.Array], valid_mask: Optional[jax.Array] =
     """Stable lexicographic sort permutation over equal-length key arrays.
 
     Invalid rows (masked) sort to the very end regardless of keys."""
+    jnp = xp_of(*keys, valid_mask)
     n = keys[0].shape[0]
     ops = list(keys)
     if valid_mask is not None:
         ops = [jnp.where(valid_mask, jnp.uint8(0), jnp.uint8(1))] + ops
+    if jnp is np:
+        # np.lexsort is a stable lexicographic sort; LAST key is primary
+        return np.lexsort(tuple(ops[::-1])).astype(np.int32)
     perm = jnp.arange(n, dtype=jnp.int32)
     out = jax.lax.sort(tuple(ops) + (perm,), num_keys=len(ops), is_stable=True)
     return out[-1]
@@ -97,6 +105,7 @@ def null_aware_eq(a_data: jax.Array, a_valid: Optional[jax.Array],
     """SQL <=> / grouping equality: null == null, NaN == NaN (Spark grouping).
 
     The eq_comparator analog (ref arrow/eq_comparator.rs)."""
+    jnp = xp_of(a_data, a_valid, b_data, b_valid)
     eq = a_data == b_data
     if jnp.issubdtype(a_data.dtype, jnp.floating) and nan_equal:
         eq = eq | (jnp.isnan(a_data) & jnp.isnan(b_data))
@@ -111,6 +120,7 @@ def rows_differ_from_prev(keys: Sequence[jax.Array]) -> jax.Array:
     Row 0 is always a boundary.  Feeds segmented aggregation (group ids =
     cumsum(boundaries) - 1), the sort-based replacement for the reference's
     agg hash map (ref agg/agg_hash_map.rs — see SURVEY.md §7 hard-part 3)."""
+    jnp = xp_of(*keys)
     n = keys[0].shape[0]
     diff = jnp.zeros(n, dtype=bool)
     for k in keys:

@@ -14,9 +14,42 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from blaze_tpu.kernels import compare
 from blaze_tpu.schema import DataType
+from blaze_tpu.xputil import xp_of
+
+
+# -- numpy fallbacks for the segment reductions (host-resident batches) ----
+# np.bincount covers sums exactly for floats; integer sums use add.at to
+# keep int64 exactness; min/max use the ufunc.at scatter form.
+
+def _in_range(v, gids, num_segments):
+    """XLA scatter drops out-of-range segment ids (mode=drop); match it."""
+    gids = np.asarray(gids)
+    ok = (gids >= 0) & (gids < num_segments)
+    if bool(ok.all()):
+        return v, gids
+    return np.asarray(v)[ok], gids[ok]
+
+
+def _np_segment_sum(v, gids, num_segments):
+    v, gids = _in_range(np.asarray(v), gids, num_segments)
+    if np.issubdtype(v.dtype, np.floating):
+        return np.bincount(gids, weights=v, minlength=num_segments
+                           )[:num_segments].astype(v.dtype)
+    out = np.zeros(num_segments, dtype=np.int64)
+    np.add.at(out, gids, v.astype(np.int64))
+    return out
+
+
+def _np_segment_reduce(v, gids, num_segments, ufunc, identity):
+    v, gids = _in_range(np.asarray(v), gids, num_segments)
+    out = np.full(num_segments, identity, dtype=v.dtype)
+    with np.errstate(invalid="ignore"):  # NaN propagates, like XLA min/max
+        ufunc.at(out, gids, v)
+    return out
 
 
 def sort_indices(columns: Sequence[Tuple[jax.Array, Optional[jax.Array], DataType]],
@@ -36,6 +69,7 @@ def group_ids_from_sorted(keys: Sequence[jax.Array], valid_mask: jax.Array
 
     Returns (group_ids, num_groups).  Invalid rows get group id = capacity-1
     bucket beyond num_groups (callers slice by num_groups)."""
+    jnp = xp_of(*keys, valid_mask)
     n = keys[0].shape[0]
     boundary = compare.rows_differ_from_prev(keys) & valid_mask
     # first valid row must open a group even if equal to an invalid row 0
@@ -49,28 +83,42 @@ def group_ids_from_sorted(keys: Sequence[jax.Array], valid_mask: jax.Array
 
 def segment_sum(values: jax.Array, gids: jax.Array, num_segments: int,
                 valid: Optional[jax.Array] = None) -> jax.Array:
-    v = values if valid is None else jnp.where(valid, values, 0)
+    xp = xp_of(values, gids, valid)
+    v = values if valid is None else xp.where(valid, values, 0)
+    if xp is np:
+        return _np_segment_sum(v, gids, num_segments)
     return jax.ops.segment_sum(v, gids, num_segments=num_segments)
 
 
 def segment_count(valid: jax.Array, gids: jax.Array, num_segments: int) -> jax.Array:
+    if xp_of(valid, gids) is np:
+        return _np_segment_sum(np.asarray(valid, dtype=np.int64), gids,
+                               num_segments)
     return jax.ops.segment_sum(valid.astype(jnp.int64), gids,
                                num_segments=num_segments)
 
 
 def segment_min(values: jax.Array, gids: jax.Array, num_segments: int,
                 valid: Optional[jax.Array] = None) -> jax.Array:
+    xp = xp_of(values, gids, valid)
     if valid is not None:
-        big = _identity_for(values.dtype, minimum=False)
-        values = jnp.where(valid, values, big)
+        big = _identity_for(values.dtype, minimum=False, xp=xp)
+        values = xp.where(valid, values, big)
+    if xp is np:
+        return _np_segment_reduce(values, gids, num_segments, np.minimum,
+                                  _identity_for(values.dtype, False, np))
     return jax.ops.segment_min(values, gids, num_segments=num_segments)
 
 
 def segment_max(values: jax.Array, gids: jax.Array, num_segments: int,
                 valid: Optional[jax.Array] = None) -> jax.Array:
+    xp = xp_of(values, gids, valid)
     if valid is not None:
-        small = _identity_for(values.dtype, minimum=True)
-        values = jnp.where(valid, values, small)
+        small = _identity_for(values.dtype, minimum=True, xp=xp)
+        values = xp.where(valid, values, small)
+    if xp is np:
+        return _np_segment_reduce(values, gids, num_segments, np.maximum,
+                                  _identity_for(values.dtype, True, np))
     return jax.ops.segment_max(values, gids, num_segments=num_segments)
 
 
@@ -79,12 +127,18 @@ def segment_first(values: jax.Array, valid: jax.Array, gids: jax.Array,
     """First row's value per segment, null or not — Spark
     first(ignoreNulls=false) semantics; rows pre-sorted => deterministic.
     Empty segments (segment_min identity = int64 max) come back invalid."""
+    xp = xp_of(values, valid, gids)
     n = values.shape[0]
-    pos = jnp.arange(n, dtype=jnp.int64)
-    first_pos = jax.ops.segment_min(pos, gids, num_segments=num_segments)
+    pos = xp.arange(n, dtype=xp.int64)
+    if xp is np:
+        first_pos = _np_segment_reduce(pos, gids, num_segments, np.minimum,
+                                       np.int64(n))
+    else:
+        first_pos = jax.ops.segment_min(pos, gids,
+                                        num_segments=num_segments)
     has_rows = first_pos < n
-    idx = jnp.clip(first_pos, 0, n - 1)
-    return jnp.take(values, idx), jnp.take(valid, idx) & has_rows
+    idx = xp.clip(first_pos, 0, n - 1)
+    return xp.take(values, idx), xp.take(valid, idx) & has_rows
 
 
 def segment_first_ignores_null(values: jax.Array, valid: jax.Array,
@@ -92,26 +146,38 @@ def segment_first_ignores_null(values: jax.Array, valid: jax.Array,
                                ) -> Tuple[jax.Array, jax.Array]:
     """First NON-NULL value per segment — Spark first(ignoreNulls=true)
     (ref agg/first_ignores_null.rs)."""
+    xp = xp_of(values, valid, gids)
     n = values.shape[0]
-    pos = jnp.where(valid, jnp.arange(n, dtype=jnp.int64), jnp.int64(n))
-    first_pos = jax.ops.segment_min(pos, gids, num_segments=num_segments)
+    pos = xp.where(valid, xp.arange(n, dtype=xp.int64), xp.int64(n))
+    if xp is np:
+        first_pos = _np_segment_reduce(pos, gids, num_segments, np.minimum,
+                                       np.int64(n))
+    else:
+        first_pos = jax.ops.segment_min(pos, gids,
+                                        num_segments=num_segments)
     has_valid = first_pos < n
-    idx = jnp.clip(first_pos, 0, n - 1)
-    return jnp.take(values, idx), has_valid
+    idx = xp.clip(first_pos, 0, n - 1)
+    return xp.take(values, idx), has_valid
 
 
-def _identity_for(dtype, minimum: bool):
+def _identity_for(dtype, minimum: bool, xp=jnp):
     if jnp.issubdtype(dtype, jnp.floating):
-        return jnp.array(-jnp.inf if minimum else jnp.inf, dtype=dtype)
+        return xp.array(-jnp.inf if minimum else jnp.inf, dtype=dtype)
     if dtype == jnp.bool_:
-        return jnp.array(minimum is True and False or True, dtype=dtype)
+        return xp.array(minimum is True and False or True, dtype=dtype)
     info = jnp.iinfo(dtype)
-    return jnp.array(info.min if minimum else info.max, dtype=dtype)
+    return xp.array(info.min if minimum else info.max, dtype=dtype)
 
 
 def segment_boundaries_to_offsets(gids: jax.Array, num_groups: jax.Array,
                                   capacity: int) -> jax.Array:
     """Per-group start offsets (int32[capacity+1]) from dense sorted gids."""
+    xp = xp_of(gids, num_groups)
+    if xp is np:
+        counts = np.bincount(np.where(gids < capacity, gids, capacity),
+                             minlength=capacity + 1)[:capacity]
+        return np.concatenate([np.zeros(1, counts.dtype),
+                               np.cumsum(counts)])
     counts = jnp.bincount(jnp.where(gids < capacity, gids, capacity),
                           length=capacity + 1)[:capacity]
     return jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)])

@@ -38,6 +38,7 @@ from blaze_tpu.ops.agg.functions import AggFunction
 from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
 from blaze_tpu.ops.sort import merge_sorted_batches
 from blaze_tpu.schema import DataType, Field, INT64, Schema, TypeId
+from blaze_tpu.xputil import xp_of
 
 
 class AggMode(enum.Enum):
@@ -185,6 +186,7 @@ class _AggState(MemConsumer):
         key_vals = [e.evaluate(batch) for e, _ in op._group_exprs]
         key_dev = self._encode_keys(key_vals, batch)
 
+        xp = xp_of(valid_mask, *[d for d, _v in key_dev])
         if self.num_keys:
             operands = []
             for (data, valid), _ in zip(key_dev, range(self.num_keys)):
@@ -193,14 +195,14 @@ class _AggState(MemConsumer):
                 operands.append(b)
                 operands.append(k)
             perm = compare.lexsort_indices(operands, valid_mask)
-            sorted_ops = [jnp.take(o, perm) for o in operands]
-            sorted_valid = jnp.take(valid_mask, perm)
+            sorted_ops = [xp.take(o, perm) for o in operands]
+            sorted_valid = xp.take(valid_mask, perm)
             gids, ng = K.group_ids_from_sorted(sorted_ops, sorted_valid)
             num_groups = int(ng)
         else:
-            perm = jnp.arange(cap)
+            perm = xp.arange(cap)
             sorted_valid = valid_mask
-            gids = jnp.where(valid_mask, 0, 1)
+            gids = xp.where(valid_mask, 0, 1)
             num_groups = 1
 
         if num_groups == 0:
@@ -209,8 +211,8 @@ class _AggState(MemConsumer):
         # per-group key values
         sink = _ArrowSink()
         for (data, valid), cv in zip(key_dev, key_vals):
-            sd = jnp.take(data, perm)
-            sv = jnp.take(valid, perm) & sorted_valid
+            sd = xp.take(data, perm)
+            sv = xp.take(valid, perm) & sorted_valid
             kd, kv = K.segment_first(sd, sv, gids, num_groups)
             sink.add_device(kd, kv, num_groups)
 
@@ -235,8 +237,8 @@ class _AggState(MemConsumer):
                 args = []
                 for c in cols:
                     dv = c.to_device(cap)
-                    args.append((jnp.take(dv.data, perm),
-                                 jnp.take(dv.validity, perm) & sorted_valid))
+                    args.append((xp.take(dv.data, perm),
+                                 xp.take(dv.validity, perm) & sorted_valid))
                 if raw:
                     accs = fn.partial_update(args, gids, num_groups)
                 else:
@@ -314,6 +316,9 @@ class _AggState(MemConsumer):
         codes = np.zeros(cap, dtype=np.int64)
         codes[:len(arr)][valid[:len(arr)]] = mapping[
             np.asarray(idx.fill_null(0), dtype=np.int64)[valid[:len(arr)]]]
+        from blaze_tpu.bridge.placement import host_resident
+        if host_resident():
+            return codes, valid
         return jnp.asarray(codes), jnp.asarray(valid)
 
     def _dict_bytes(self) -> int:
@@ -373,6 +378,7 @@ class _AggState(MemConsumer):
         op = self.op
         cap = cb.capacity
         valid_mask = cb.row_mask()
+        xp = cb._xp()
         if self.num_keys:
             operands = []
             for i in range(self.num_keys):
@@ -381,22 +387,22 @@ class _AggState(MemConsumer):
                                          False, True)
                 operands.extend([b, k])
             perm = compare.lexsort_indices(operands, valid_mask)
-            sorted_ops = [jnp.take(o, perm) for o in operands]
-            sorted_valid = jnp.take(valid_mask, perm)
+            sorted_ops = [xp.take(o, perm) for o in operands]
+            sorted_valid = xp.take(valid_mask, perm)
             gids, ng = K.group_ids_from_sorted(sorted_ops, sorted_valid)
             num_groups = int(ng)
         else:
-            perm = jnp.arange(cap)
+            perm = xp.arange(cap)
             sorted_valid = valid_mask
-            gids = jnp.where(valid_mask, 0, 1)
+            gids = xp.where(valid_mask, 0, 1)
             num_groups = 1
         if num_groups == 0:
             return None
         sink = _ArrowSink()
         for i in range(self.num_keys):
             col = cb.columns[i]
-            sd = jnp.take(col.data, perm)
-            sv = jnp.take(col.validity, perm) & sorted_valid
+            sd = xp.take(col.data, perm)
+            sv = xp.take(col.validity, perm) & sorted_valid
             kd, kv = K.segment_first(sd, sv, gids, num_groups)
             sink.add_device(kd, kv, num_groups)
         j = self.num_keys
@@ -417,8 +423,8 @@ class _AggState(MemConsumer):
                 args = []
                 for t in range(nacc):
                     col = cb.columns[j + t]
-                    args.append((jnp.take(col.data, perm),
-                                 jnp.take(col.validity, perm) & sorted_valid))
+                    args.append((xp.take(col.data, perm),
+                                 xp.take(col.validity, perm) & sorted_valid))
                 accs = fn.partial_merge(args, gids, num_groups)
                 for ad, av in accs:
                     sink.add_device(ad, av, num_groups)
@@ -617,7 +623,11 @@ class _ArrowSink:
     def materialize(self) -> List[pa.Array]:
         pending = [(it[1], it[2]) for it in self._items
                    if isinstance(it, tuple)]
-        fetched = jax.device_get(pending) if pending else []
+        if pending and all(isinstance(d, np.ndarray) and
+                           isinstance(v, np.ndarray) for d, v in pending):
+            fetched = pending  # host-resident: no sync needed
+        else:
+            fetched = jax.device_get(pending) if pending else []
         out: List[pa.Array] = []
         j = 0
         for it in self._items:

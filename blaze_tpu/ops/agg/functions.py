@@ -29,6 +29,7 @@ import pyarrow as pa
 from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.exprs import PhysicalExpr
 from blaze_tpu.kernels import sort as K
+from blaze_tpu.xputil import xp_of
 from blaze_tpu.schema import (BOOL, BINARY, DataType, Field, FLOAT64, INT64,
                               Schema, TypeId)
 
@@ -129,22 +130,23 @@ class CountAgg(AggFunction):
         return INT64
 
     def partial_update(self, args, gids, n):
+        xp = xp_of(gids)
         if self.children:
             _, valid = args[0]
             c = K.segment_count(valid, gids, n)
         else:
-            ones = jnp.ones(gids.shape[0], dtype=bool)
+            ones = xp.ones(gids.shape[0], dtype=bool)
             c = K.segment_count(ones, gids, n)
-        return ((c, jnp.ones(n, dtype=bool)),)
+        return ((c, xp.ones(n, dtype=bool)),)
 
     def partial_merge(self, accs, gids, n):
         data, valid = accs[0]
         c = K.segment_sum(data, gids, n, valid)
-        return ((c, jnp.ones(c.shape[0], dtype=bool)),)
+        return ((c, xp_of(c).ones(c.shape[0], dtype=bool)),)
 
     def final_eval(self, accs):
         data, _ = accs[0]
-        return data, jnp.ones(data.shape[0], dtype=bool)
+        return data, xp_of(data).ones(data.shape[0], dtype=bool)
 
 
 class AvgAgg(AggFunction):
@@ -175,26 +177,27 @@ class AvgAgg(AggFunction):
         else:  # int and decimal-unscaled sums stay exact in int64
             s = K.segment_sum(data.astype(jnp.int64), gids, n, valid)
         c = K.segment_count(valid, gids, n)
-        return ((s, c > 0), (c, jnp.ones(n, dtype=bool)))
+        return ((s, c > 0), (c, xp_of(c).ones(n, dtype=bool)))
 
     def partial_merge(self, accs, gids, n):
         (s_d, s_v), (c_d, c_v) = accs
         s = K.segment_sum(s_d, gids, n, s_v)
         c = K.segment_sum(c_d, gids, n, c_v)
-        return ((s, c > 0), (c, jnp.ones(c.shape[0], dtype=bool)))
+        return ((s, c > 0), (c, xp_of(c).ones(c.shape[0], dtype=bool)))
 
     def final_eval(self, accs):
         (s_d, _), (c_d, _) = accs
+        xp = xp_of(s_d, c_d)
         valid = c_d > 0
-        denom = jnp.where(valid, c_d, 1)
+        denom = xp.where(valid, c_d, 1)
         if self.input_type is not None and self.input_type.id == TypeId.DECIMAL:
             # decimal(p,s) -> decimal(p+4, s+4): unscaled*10^4 / count, HALF_UP
-            num = s_d * jnp.int64(10_000)
+            num = s_d * xp.int64(10_000)
             half = denom // 2
-            adj = jnp.where(num >= 0, num + half, num - half)
-            q = jnp.sign(adj) * (jnp.abs(adj) // denom)
+            adj = xp.where(num >= 0, num + half, num - half)
+            q = xp.sign(adj) * (xp.abs(adj) // denom)
             return q, valid
-        return s_d / denom.astype(jnp.float64), valid
+        return s_d / denom.astype(xp.float64), valid
 
 
 class MinMaxAgg(AggFunction):
@@ -213,7 +216,8 @@ class MinMaxAgg(AggFunction):
         fn = K.segment_min if self.minimum else K.segment_max
         out = fn(data, gids, n, valid)
         has = K.segment_count(valid, gids, n) > 0
-        out = jnp.where(has, out, jnp.zeros_like(out))
+        xp = xp_of(out, has)
+        out = xp.where(has, out, xp.zeros_like(out))
         return ((out, has),)
 
     def partial_update(self, args, gids, n):
@@ -248,9 +252,10 @@ class FirstAgg(AggFunction):
         if self.ignores_null:
             v, has = K.segment_first_ignores_null(data, valid, gids, n)
             return ((v, has),)
+        xp = xp_of(data, valid)
         v, vvalid = K.segment_first(data, valid, gids, n)
-        has_rows = K.segment_count(jnp.ones_like(valid), gids, n) > 0
-        return ((v, vvalid), (has_rows, jnp.ones(n, dtype=bool)))
+        has_rows = K.segment_count(xp.ones_like(valid), gids, n) > 0
+        return ((v, vvalid), (has_rows, xp.ones(n, dtype=bool)))
 
     def partial_merge(self, accs, gids, n):
         if self.ignores_null:
@@ -265,7 +270,7 @@ class FirstAgg(AggFunction):
             valid, has.astype(bool), gids, n)
         any_has = K.segment_count(has.astype(bool), gids, n) > 0
         return ((v, vv.astype(bool) & any_has),
-                (any_has, jnp.ones(n, dtype=bool)))
+                (any_has, xp_of(any_has).ones(n, dtype=bool)))
 
     def final_eval(self, accs):
         return accs[0]

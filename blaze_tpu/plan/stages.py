@@ -182,9 +182,12 @@ class DagScheduler:
     # -- execution ---------------------------------------------------------
 
     def _run_tasks(self, fn, n: int, what: str) -> List[Any]:
-        from blaze_tpu.bridge.tasks import run_tasks
-        return run_tasks(fn, n, self._timeout, what,
-                         max_workers=min(self._par, max(1, n)))
+        from blaze_tpu.bridge.tasks import default_task_parallelism, run_tasks
+        # host placement caps slots harder than the executor-size knob:
+        # serial tasks around intra-op-parallel C++ kernels beat
+        # GIL-contended task concurrency (see default_task_parallelism)
+        workers = min(self._par, default_task_parallelism(n))
+        return run_tasks(fn, n, self._timeout, what, max_workers=workers)
 
     def _run_producer(self, stage: Stage) -> None:
         from blaze_tpu.bridge.runtime import NativeExecutionRuntime

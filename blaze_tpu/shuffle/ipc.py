@@ -5,8 +5,8 @@ IpcCompressionWriter, `:135` IpcCompressionReader) — the one wire/disk format
 shared by shuffle `.data` files, spill files and broadcast byte arrays.
 
 Frame layout (little-endian):
-    [u8  codec]  0 = raw, 1 = zstd  (lz4 is not in this environment; the
-                 codec byte keeps the format open, ref SPILL_COMPRESSION_CODEC)
+    [u8  codec]  0 = raw, 1 = zstd, 2 = lz4-frame (the reference's default
+                 shuffle codec, via Arrow C++; ref SPILL_COMPRESSION_CODEC)
     [u32 length] compressed payload size
     [payload]    one Arrow IPC *stream* (schema + N record batches)
 
@@ -29,6 +29,14 @@ from blaze_tpu import config
 _HEADER = struct.Struct("<BI")
 CODEC_RAW = 0
 CODEC_ZSTD = 1
+CODEC_LZ4 = 2
+
+
+def _lz4():
+    try:
+        return pa.Codec("lz4") if pa.Codec.is_available("lz4") else None
+    except Exception:
+        return None
 
 
 def _get_codec() -> int:
@@ -37,12 +45,21 @@ def _get_codec() -> int:
     # the io.* family landed) still applies
     if config.conf.is_set(config.IO_COMPRESSION_CODEC):
         name = config.IO_COMPRESSION_CODEC.get().lower()
-    else:
+    elif config.conf.is_set(config.SPILL_COMPRESSION_CODEC):
         name = config.SPILL_COMPRESSION_CODEC.get().lower()
+    else:
+        name = config.IO_COMPRESSION_CODEC.get().lower()  # default: lz4
+    if name == "lz4" and _lz4() is not None:
+        return CODEC_LZ4
     return CODEC_ZSTD if name in ("zstd", "zstandard") else CODEC_RAW
 
 
 def _compress(codec: int, payload: bytes) -> bytes:
+    if codec == CODEC_LZ4:
+        # lz4 payloads lead with the raw size (Arrow's Codec.decompress
+        # requires it); the frame codec byte keys the layout
+        return (struct.pack("<I", len(payload)) +
+                _lz4().compress(payload, asbytes=True))
     if codec == CODEC_ZSTD:
         from blaze_tpu.bridge.native import get_codec
         native = get_codec()
@@ -55,6 +72,16 @@ def _compress(codec: int, payload: bytes) -> bytes:
 
 
 def _decompress(codec: int, payload: bytes) -> bytes:
+    if codec == CODEC_LZ4:
+        codec_obj = _lz4()
+        if codec_obj is None:
+            raise RuntimeError(
+                "shuffle frame is lz4-compressed but this Arrow build "
+                "lacks the lz4 codec; set io.compression.codec=zstd on "
+                "the writing side")
+        (raw_size,) = struct.unpack_from("<I", payload)
+        return codec_obj.decompress(payload[4:], decompressed_size=raw_size,
+                                    asbytes=True)
     if codec == CODEC_ZSTD:
         from blaze_tpu.bridge.native import get_codec
         native = get_codec()

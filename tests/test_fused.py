@@ -141,6 +141,8 @@ class TestSorted:
     def test_overflow_degrades_to_passthrough_and_final_agg_fixes_it(self):
         t = _table(n=4000)
         config.conf.set(config.ON_DEVICE_AGG_CAPACITY.key, 16)
+        # device hash-table mechanics under test: bypass the Arrow path
+        config.conf.set(config.FUSED_HOST_VECTORIZED_ENABLE.key, False)
         try:
             partial = fuse_plan(self._plan_with_computed_key(t))
             assert partial.fused_mode == "sorted"
@@ -155,6 +157,7 @@ class TestSorted:
             assert int(partial.metrics.get("partial_skipped")) >= 1
         finally:
             config.conf.unset(config.ON_DEVICE_AGG_CAPACITY.key)
+            config.conf.unset(config.FUSED_HOST_VECTORIZED_ENABLE.key)
         df = t.to_pandas()
         df["kmod"] = df.cust % 50
         want = df.groupby("kmod").amt.sum().reset_index() \
@@ -225,6 +228,10 @@ class TestMergeModeFusion:
     def test_final_mode_grows_instead_of_skipping(self):
         t = _table(n=6000)  # ~200 distinct cust per partition
         config.conf.set(config.ON_DEVICE_AGG_CAPACITY.key, 16)
+        # this test exercises the DEVICE hash-table growth mechanics; the
+        # host-vectorized Arrow path (default under host placement) never
+        # builds that table
+        config.conf.set(config.FUSED_HOST_VECTORIZED_ENABLE.key, False)
         try:
             plan = fuse_plan(self._two_stage(t, partitions=1))
             assert isinstance(plan, FusedPartialAggExec)
@@ -235,6 +242,7 @@ class TestMergeModeFusion:
             assert plan.metrics.get("partial_skipped") == 0
         finally:
             config.conf.unset(config.ON_DEVICE_AGG_CAPACITY.key)
+            config.conf.unset(config.FUSED_HOST_VECTORIZED_ENABLE.key)
         want = t.to_pandas().groupby("cust", as_index=False).agg(
             s=("amt", "sum")).sort_values("cust").reset_index(drop=True)
         assert len(got) == len(want)
@@ -266,3 +274,76 @@ class TestMergeModeFusion:
         # PARTIAL under the exchange
         assert isinstance(top, FusedPartialAggExec)
         assert isinstance(ex.children[0], FusedPartialAggExec)
+
+
+class TestHostVectorized:
+    """The Arrow C++ hash-agg path taken under host placement
+    (plan/fused.py _execute_host_vectorized) must be bit-compatible with
+    the device hash-table path across null keys, all-null sums, count
+    modes and the merge threshold."""
+
+    def _run(self, plan):
+        out = []
+        for p in range(plan.num_partitions):
+            out.extend(b.compact().to_arrow() for b in plan.execute(p))
+        return pa.Table.from_batches([b for b in out if b.num_rows])
+
+    def test_matches_device_path_with_null_keys(self):
+        t = _table(n=8000, nulls=True)
+        def build():
+            scan = MemoryScanExec.from_arrow(t)
+            flt = FilterExec(scan, [BinaryExpr(">", col(0, "date"),
+                                               lit(150))])
+            return fuse_plan(AggExec(
+                flt, [(col(1, "cust"), "cust")],
+                [(make_agg("sum", [col(3)]), AggMode.PARTIAL, "s"),
+                 (make_agg("count", [col(3)]), AggMode.PARTIAL, "c"),
+                 (make_agg("min", [col(0)]), AggMode.PARTIAL, "mn"),
+                 (make_agg("max", [col(0)]), AggMode.PARTIAL, "mx")]))
+        host = self._run(build()).to_pandas().sort_values(
+            "cust", na_position="first").reset_index(drop=True)
+        config.conf.set(config.FUSED_HOST_VECTORIZED_ENABLE.key, False)
+        try:
+            dev = self._run(build()).to_pandas().sort_values(
+                "cust", na_position="first").reset_index(drop=True)
+        finally:
+            config.conf.unset(config.FUSED_HOST_VECTORIZED_ENABLE.key)
+        assert len(host) == len(dev)
+        np.testing.assert_allclose(host["s.sum"].to_numpy(float),
+                                   dev["s.sum"].to_numpy(float), rtol=1e-9)
+        assert (host["c.count"].to_numpy() ==
+                dev["c.count"].to_numpy()).all()
+        assert (host["mn.min"].to_numpy(float) ==
+                dev["mn.min"].to_numpy(float)).all()
+
+    def test_merge_threshold_re_merges(self):
+        # force the incremental acc-table merge by shrinking the buffer
+        t = _table(n=5000)
+        scan = MemoryScanExec.from_arrow(t, batch_rows=256)
+        plan = fuse_plan(AggExec(
+            scan, [(col(1, "cust"), "cust")],
+            [(make_agg("sum", [col(3)]), AggMode.PARTIAL, "s")]))
+        assert isinstance(plan, FusedPartialAggExec)
+        config.conf.set(config.FUSED_HOST_COLLECT_ROWS.key, 512)
+        try:
+            got = self._run(plan).to_pandas()
+        finally:
+            config.conf.unset(config.FUSED_HOST_COLLECT_ROWS.key)
+        got = got.groupby("cust", as_index=False)["s.sum"].sum() \
+            .sort_values("cust").reset_index(drop=True)
+        want = t.to_pandas().groupby("cust", as_index=False).amt.sum() \
+            .sort_values("cust").reset_index(drop=True)
+        np.testing.assert_allclose(got["s.sum"].to_numpy(),
+                                   want["amt"].to_numpy(), rtol=1e-9)
+
+    def test_float_keys_stay_on_device_path(self):
+        t = pa.table({"k": pa.array([1.0, float("nan"), float("nan")]),
+                      "v": pa.array([1.0, 2.0, 3.0])})
+        plan = fuse_plan(AggExec(
+            MemoryScanExec.from_arrow(t), [(col(0, "k"), "k")],
+            [(make_agg("sum", [col(1)]), AggMode.PARTIAL, "s")]))
+        assert isinstance(plan, FusedPartialAggExec)
+        assert not plan._host_vectorized_eligible()
+        # NaN keys group together (Spark NormalizeFloatingNumbers)
+        out = self._run(plan)
+        assert out.num_rows == 2
