@@ -45,7 +45,25 @@ unmeasured (VERDICT r2 weak #4).
 
 Baseline: the identical queries on pyarrow's multithreaded C++ kernels,
 the stand-in for Auron's CPU-native engine.  Correctness is asserted
-against it every run.
+against it every run.  NOTE the baseline is a FLOOR, not a peer: it runs
+one in-process pass with no shuffle files, no partial/final aggregation
+split, no task protocol — work Auron-CPU itself pays (its 2.02x headline
+is vs Spark-JVM, a far weaker baseline).  vs_baseline ~= 1.0 here means
+the engine's whole distribution machinery costs nothing over raw C++
+kernels.
+
+Partitioning is Spark-faithful: maps = input / 128MB
+(spark.sql.files.maxPartitionBytes), reduces sized by AQE advisory
+coalescing — so SF1 runs 1 map/1 reduce exactly as spark-local would.
+
+Device-compute fields: `device_rows_per_sec` measures the DENSE fused
+kernel folded 128x over an HBM-resident batch in ONE XLA program (1
+dispatch, tunnel-RTT-immune).  The hash-strategy kernel is reported
+separately (`device_hash_rows_per_sec`); its scatter-probe rounds lower
+poorly on TPU (~20x slower than dense), which is why the planner's
+stats-driven dense/hash choice (plan/fused.py) matters.  Host-XLA
+equivalents of both kernels are recorded for an honest chip-vs-host
+comparison (VERDICT r3 #3).
 
 Roofline sanity (VERDICT r1 weak #1): the line also reports achieved
 input-bytes/s over the v5e HBM peak (~819 GB/s).  This pipeline is
@@ -64,9 +82,28 @@ import time
 
 HBM_PEAK_BYTES_S = 819e9  # TPU v5e
 SCALE = float(os.environ.get("BLAZE_BENCH_SCALE", "1.0"))
-N_MAPS = int(os.environ.get("BLAZE_BENCH_MAPS", "4"))
-N_REDUCES = int(os.environ.get("BLAZE_BENCH_REDUCES", "4"))
+N_FILES = int(os.environ.get("BLAZE_BENCH_FILES", "4"))
+
+# Partition counts follow what Spark would actually schedule for this
+# input: one map per spark.sql.files.maxPartitionBytes (128MB) of input
+# (FilePartition packing), and AQE advisory coalescing of reduce
+# partitions toward 64MB (spark.sql.adaptive.advisoryPartitionSizeInBytes)
+# — the reference runs under exactly these defaults in its TPC-DS CI
+# (dev/auron-it/local-run-tpcds.sh).  Overridable for scaling studies.
+_SF1_BYTES = 6_100_000  # measured SF1 store_returns footprint
+
+def _spark_partitions(scale: float):
+    est_bytes = _SF1_BYTES * scale
+    maps = max(1, -(-int(est_bytes) // (128 << 20)))
+    reduces = max(1, -(-int(est_bytes // 3) // (64 << 20)))
+    return maps, reduces
+
+_DEF_MAPS, _DEF_REDUCES = _spark_partitions(SCALE)
+N_MAPS = int(os.environ.get("BLAZE_BENCH_MAPS", str(_DEF_MAPS)))
+N_REDUCES = int(os.environ.get("BLAZE_BENCH_REDUCES", str(_DEF_REDUCES)))
 ITERS = int(os.environ.get("BLAZE_BENCH_ITERS", "5"))
+SF10 = os.environ.get("BLAZE_BENCH_SF10", "1") == "1" and SCALE == 1.0
+DEVICE_LOOP = os.environ.get("BLAZE_BENCH_DEVICE_LOOP", "1") == "1"
 
 PROBE_TIMEOUT_S = float(os.environ.get("BLAZE_BENCH_PROBE_TIMEOUT", "150"))
 PROBE_TRIES = int(os.environ.get("BLAZE_BENCH_PROBE_TRIES", "2"))
@@ -217,26 +254,34 @@ def _tasks(fn, n, what):
     return run_tasks(fn, n, STAGE_TIMEOUT_S, what)
 
 
-def ensure_dataset():
+def ensure_dataset(scale: float = SCALE):
     """Generate + cache the SF-scaled q01 tables as parquet."""
     import pyarrow.parquet as pq
     from blaze_tpu.itest.tpcds_data import gen_date_dim, gen_store_returns
-    root = f"/tmp/blaze_tpu_bench/sf{SCALE:g}_m{N_MAPS}"
+    root = f"/tmp/blaze_tpu_bench/sf{scale:g}_f{N_FILES}"
     marker = os.path.join(root, ".done")
     sr_paths = [os.path.join(root, f"store_returns_{i}.parquet")
-                for i in range(N_MAPS)]
+                for i in range(N_FILES)]
     dd_path = os.path.join(root, "date_dim.parquet")
     if not os.path.exists(marker):
         os.makedirs(root, exist_ok=True)
-        sr = gen_store_returns(SCALE)
+        sr = gen_store_returns(scale)
         rows = sr.num_rows
-        per = -(-rows // N_MAPS)
+        per = -(-rows // N_FILES)
         for i, p in enumerate(sr_paths):
             pq.write_table(sr.slice(i * per, per), p,
                            row_group_size=1 << 17)
-        pq.write_table(gen_date_dim(SCALE), dd_path)
+        pq.write_table(gen_date_dim(scale), dd_path)
         open(marker, "w").write("ok")
     return sr_paths, dd_path
+
+
+def _file_groups(paths, n_groups):
+    """FilePartition packing: files round-robin into map partitions."""
+    groups = [[] for _ in range(n_groups)]
+    for i, p in enumerate(paths):
+        groups[i % n_groups].append(p)
+    return groups
 
 
 def date_sk_range(dd_path: str):
@@ -257,15 +302,17 @@ def _lit(v):
     return {"kind": "literal", "value": v, "type": {"id": "int64"}}
 
 
-def stage1_td(sr_paths, lo, hi, map_id, tmpdir):
-    file_groups = [[] for _ in range(N_MAPS)]
-    file_groups[map_id] = [sr_paths[map_id]]
+def stage1_td(sr_paths, lo, hi, map_id, tmpdir, n_maps=None,
+              n_reduces=None):
+    n_maps = n_maps or N_MAPS
+    n_reduces = n_reduces or N_REDUCES
+    file_groups = _file_groups(sr_paths, n_maps)
     plan = {
         "kind": "shuffle_writer",
         "partitioning": {"kind": "hash",
                          "exprs": [{"kind": "column", "index": 0},
                                    {"kind": "column", "index": 1}],
-                         "num_partitions": N_REDUCES},
+                         "num_partitions": n_reduces},
         "data_file": os.path.join(tmpdir, f"shuffle_{map_id}.data"),
         "index_file": os.path.join(tmpdir, f"shuffle_{map_id}.index"),
         "input": {
@@ -285,12 +332,19 @@ def stage1_td(sr_paths, lo, hi, map_id, tmpdir):
                     {"kind": "binary", "op": "<=",
                      "l": _col("sr_returned_date_sk"), "r": _lit(hi)}],
                 "input": {"kind": "parquet_scan", "schema": SR_SCHEMA_D,
+                          # Catalyst prunes unused columns before the plan
+                          # reaches the engine (NativeParquetScanBase
+                          # projection); mirror that contract
+                          "projection": ["sr_returned_date_sk",
+                                         "sr_customer_sk", "sr_store_sk",
+                                         "sr_return_amt"],
                           "file_groups": file_groups}}}}
     return {"stage_id": 1, "partition_id": map_id,
-            "num_partitions": N_MAPS, "plan": plan}
+            "num_partitions": n_maps, "plan": plan}
 
 
-def stage2_td(reduce_id):
+def stage2_td(reduce_id, n_reduces=None):
+    n_reduces = n_reduces or N_REDUCES
     plan = {
         "kind": "hash_agg",
         "groupings": [{"expr": {"kind": "column", "index": 0},
@@ -301,12 +355,12 @@ def stage2_td(reduce_id):
                   "args": [{"kind": "column", "index": 2}]}],
         "input": {"kind": "ipc_reader", "resource_id": "bench_q01_shuffle",
                   "schema": PARTIAL_SCHEMA_D,
-                  "num_partitions": N_REDUCES}}
+                  "num_partitions": n_reduces}}
     return {"stage_id": 2, "partition_id": reduce_id,
-            "num_partitions": N_REDUCES, "plan": plan}
+            "num_partitions": n_reduces, "plan": plan}
 
 
-def run_engine(sr_paths, dd_path, tmpdir):
+def run_engine(sr_paths, dd_path, tmpdir, n_maps=None, n_reduces=None):
     """One full q01-inner execution; returns (n_groups, total_sum).
 
     Tasks within a stage run on a thread pool (spark local[N]: one task
@@ -320,9 +374,12 @@ def run_engine(sr_paths, dd_path, tmpdir):
     from blaze_tpu.shuffle.exchange import read_index_file
 
     lo, hi = date_sk_range(dd_path)
+    n_maps = n_maps or N_MAPS
+    n_reduces = n_reduces or N_REDUCES
 
     def run_map(m):
-        td = task_definition_to_bytes(stage1_td(sr_paths, lo, hi, m, tmpdir))
+        td = task_definition_to_bytes(
+            stage1_td(sr_paths, lo, hi, m, tmpdir, n_maps, n_reduces))
         rt = NativeExecutionRuntime(td).start()
         try:
             for _ in rt.batches():
@@ -330,15 +387,15 @@ def run_engine(sr_paths, dd_path, tmpdir):
         finally:
             rt.finalize()
 
-    _tasks(run_map, N_MAPS, "q01 map stage")
+    _tasks(run_map, n_maps, "q01 map stage")
 
     # ---- register reduce-side block map (the MapOutputTracker analog) ----
     offsets = [read_index_file(os.path.join(tmpdir, f"shuffle_{m}.index"))
-               for m in range(N_MAPS)]
+               for m in range(n_maps)]
 
     def blocks_for(partition):
         out = []
-        for m in range(N_MAPS):
+        for m in range(n_maps):
             off = offsets[m]
             length = off[partition + 1] - off[partition]
             if length > 0:
@@ -350,7 +407,7 @@ def run_engine(sr_paths, dd_path, tmpdir):
     put_resource("bench_q01_shuffle", blocks_for)
 
     def run_reduce(r):
-        td = task_definition_to_bytes(stage2_td(r))
+        td = task_definition_to_bytes(stage2_td(r, n_reduces))
         rt = NativeExecutionRuntime(td).start()
         groups = 0
         total = 0.0
@@ -363,7 +420,7 @@ def run_engine(sr_paths, dd_path, tmpdir):
             rt.finalize()
         return groups, total
 
-    results = _tasks(run_reduce, N_REDUCES, "q01 reduce stage")
+    results = _tasks(run_reduce, n_reduces, "q01 reduce stage")
     return sum(g for g, _ in results), sum(t for _, t in results)
 
 
@@ -387,12 +444,12 @@ def run_baseline(sr_paths, dd_path):
 
 # ---- q06-shaped join stage (BASELINE config #2 shape) ---------------------
 
-def join_td(sr_paths, dd_path, map_id):
+def join_td(sr_paths, dd_path, map_id, n_maps=None):
     """store_returns ⋈ date_dim on returned_date_sk, d_year=2000 filter on
     the build side, count+sum aggregate — the broadcast-join stage shape."""
-    file_groups = [[] for _ in range(N_MAPS)]
-    file_groups[map_id] = [sr_paths[map_id]]
-    dd_groups = [[] for _ in range(N_MAPS)]
+    n_maps = n_maps or N_MAPS
+    file_groups = _file_groups(sr_paths, n_maps)
+    dd_groups = [[] for _ in range(n_maps)]
     dd_groups[map_id] = [dd_path]
     plan = {
         "kind": "hash_agg",
@@ -407,6 +464,8 @@ def join_td(sr_paths, dd_path, map_id):
             "left_keys": [_col("sr_returned_date_sk")],
             "right_keys": [_col("d_date_sk")],
             "left": {"kind": "parquet_scan", "schema": SR_SCHEMA_D,
+                     "projection": ["sr_returned_date_sk",
+                                    "sr_return_amt", "sr_ticket_number"],
                      "file_groups": file_groups},
             "right": {"kind": "filter",
                       "predicates": [{"kind": "binary", "op": "==",
@@ -417,16 +476,18 @@ def join_td(sr_paths, dd_path, map_id):
                                 "file_groups": dd_groups}},
             "build_side": "right"}}
     return {"stage_id": 3, "partition_id": map_id,
-            "num_partitions": N_MAPS, "plan": plan}
+            "num_partitions": n_maps, "plan": plan}
 
 
-def run_join_engine(sr_paths, dd_path):
+def run_join_engine(sr_paths, dd_path, n_maps=None):
     import pyarrow as pa
     from blaze_tpu.bridge.runtime import NativeExecutionRuntime
     from blaze_tpu.plan.proto_serde import task_definition_to_bytes
 
+    n_maps = n_maps or N_MAPS
+
     def run_map(m):
-        td = task_definition_to_bytes(join_td(sr_paths, dd_path, m))
+        td = task_definition_to_bytes(join_td(sr_paths, dd_path, m, n_maps))
         rt = NativeExecutionRuntime(td).start()
         cnt, amt = 0, 0.0
         try:
@@ -437,7 +498,7 @@ def run_join_engine(sr_paths, dd_path):
             rt.finalize()
         return cnt, amt
 
-    results = _tasks(run_map, N_MAPS, "q06-shaped join stage")
+    results = _tasks(run_map, n_maps, "q06-shaped join stage")
     return sum(c for c, _ in results), sum(a for _, a in results)
 
 
@@ -522,6 +583,22 @@ def child_main():
             (got_amt, want_amt)
     join_tpu_s = float(np.median(jtimes))
 
+    # ---- SF10 leg: same pipeline at 10x rows, Spark-sized partitions ----
+    sf10_fields = {}
+    if SF10:
+        try:
+            sf10_fields = run_scaled_leg(10.0)
+        except Exception as e:  # record, never kill the SF1 line
+            sf10_fields = {"sf10_error": repr(e)[-300:]}
+
+    # ---- device-resident compute loop (VERDICT r3 #3) -------------------
+    dev_fields = {}
+    if DEVICE_LOOP:
+        try:
+            dev_fields = device_compute_loop(sr_paths, dd_path)
+        except Exception as e:
+            dev_fields = {"device_loop_error": repr(e)[-300:]}
+
     from blaze_tpu.bridge.placement import placement_info
     pi = placement_info()
     bytes_per_s = input_bytes / tpu_s
@@ -545,8 +622,185 @@ def child_main():
         "join_vs_baseline": round(join_cpu_s / join_tpu_s, 3),
         "join_wall_s": round(join_tpu_s, 4),
         "join_baseline_wall_s": round(join_cpu_s, 4),
+        **sf10_fields,
+        **dev_fields,
     }))
     sys.stdout.flush()
+
+
+def run_scaled_leg(scale: float):
+    """q01 pipeline at `scale`, engine vs baseline, Spark-sized
+    partitioning (VERDICT r3 #1: record SF10, not just SF1)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    sr_paths, dd_path = ensure_dataset(scale)
+    n_maps, n_reduces = _spark_partitions(scale)
+    run_baseline(sr_paths, dd_path)
+    ctimes = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        want_groups, want_total = run_baseline(sr_paths, dd_path)
+        ctimes.append(time.perf_counter() - t0)
+    cpu_s = float(np.median(ctimes))
+    times = []
+    for i in range(4):
+        tmpdir = tempfile.mkdtemp(prefix="blaze_bench_sf_")
+        try:
+            t0 = time.perf_counter()
+            got_groups, got_total = run_engine(sr_paths, dd_path, tmpdir,
+                                               n_maps, n_reduces)
+            dt = time.perf_counter() - t0
+        finally:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+        if i > 0:
+            times.append(dt)
+        assert got_groups == want_groups, (got_groups, want_groups)
+        assert abs(got_total - want_total) / max(abs(want_total), 1) \
+            < 1e-9, (got_total, want_total)
+    eng_s = float(np.median(times))
+    n_rows = sum(_parquet_rows(p) for p in sr_paths)
+    return {
+        "sf10_vs_baseline": round(cpu_s / eng_s, 3),
+        "sf10_wall_s": round(eng_s, 4),
+        "sf10_baseline_wall_s": round(cpu_s, 4),
+        "sf10_rows_per_sec": round(n_rows / eng_s),
+        "sf10_maps": n_maps, "sf10_reduces": n_reduces,
+    }
+
+
+def device_compute_loop(sr_paths, dd_path, iters: int = 128):
+    """Fused-stage compute RESIDENT on the accelerator: ship ONE q01
+    batch to the device, fold it through the jit'd filter+hash-agg step
+    `iters` times inside a single XLA program (lax.fori_loop), one sync
+    at the end.  This measures what the chip does once data is in HBM —
+    the number no prior round ever recorded (VERDICT r3 #3) — and is
+    immune to the tunnel RTT by construction (exactly 1 dispatch).
+
+    Runs on the default accelerator backend even when stage placement
+    pinned compute to host."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pyarrow.parquet as pq
+    from functools import partial
+
+    from blaze_tpu.kernels import hashing as H
+    from blaze_tpu.parallel.stage import (hash_agg_step, init_hash_carry,
+                                          pack_dense_keys)
+
+    dev = jax.devices()[0]  # the accelerator, regardless of placement
+    lo, hi = date_sk_range(dd_path)
+    t = pq.read_table(sr_paths[0],
+                      columns=["sr_returned_date_sk", "sr_customer_sk",
+                               "sr_store_sk", "sr_return_amt"])
+    n = min(t.num_rows, 1 << 16)
+    t = t.slice(0, n)
+
+    def col_np(i, dt):
+        c = t.column(i).combine_chunks()
+        return (np.ascontiguousarray(
+            c.fill_null(0).to_numpy(zero_copy_only=False)).astype(dt),
+            np.asarray(c.is_valid()))
+
+    date_sk, dval = col_np(0, np.int64)
+    cust, cval = col_np(1, np.int64)
+    store, sval = col_np(2, np.int64)
+    amt, aval = col_np(3, np.float64)
+    valid = dval & cval & sval
+
+    from blaze_tpu.parallel.stage import (init_accumulators,
+                                          scatter_accumulate)
+
+    slots = 1 << 17
+
+    # the DENSE fused strategy (plan/fused.py _execute_dense): group ids
+    # by arithmetic over known key bounds, ONE scatter-accumulate per
+    # batch — the TPU-appropriate kernel (scatters with probe loops, the
+    # hash strategy below, serialize badly on TPU)
+    smin, smax = int(store.min()), int(store.max())
+    cmin, cmax = int(cust.min()), int(cust.max())
+    s_span = smax - smin + 2
+    dense_slots = s_span * (cmax - cmin + 2)
+
+    @jax.jit
+    def dense_fold(date_sk, cust, store, amt, valid, aval, carry):
+        def body(_i, c):
+            accs, avalid, occupied = c
+            mask = valid & (date_sk >= lo) & (date_sk <= hi)
+            gid = (cust - cmin) * s_span + (store - smin)
+            g = jnp.where(mask, gid, dense_slots)
+            occupied = occupied.at[g].max(mask, mode="drop")
+            na, nv = scatter_accumulate(g, [("sum", amt, aval)], mask,
+                                        accs, avalid)
+            return (tuple(na), tuple(nv), occupied)
+        return jax.lax.fori_loop(0, iters, body, carry)
+
+    @jax.jit
+    def hash_fold(date_sk, cust, store, amt, valid, aval, carry):
+        def body(_i, c):
+            mask = valid & (date_sk >= lo) & (date_sk <= hi)
+            return hash_agg_step(
+                c, [(cust, valid), (store, valid)],
+                [("sum", amt, aval)], mask)[0]
+        return jax.lax.fori_loop(0, iters, body, carry)
+
+    def run_on(device):
+        with jax.default_device(device):
+            args = [jax.device_put(x, device) for x in
+                    (date_sk, cust, store, amt, valid, aval)]
+            accs, avalid = init_accumulators(["sum"], (jnp.float64,),
+                                             dense_slots)
+            occ = jnp.zeros(dense_slots, dtype=bool)
+            out = dense_fold(*args, (accs, avalid, occ))  # compile+warm
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            accs, avalid = init_accumulators(["sum"], (jnp.float64,),
+                                             dense_slots)
+            occ = jnp.zeros(dense_slots, dtype=bool)
+            out = dense_fold(*args, (accs, avalid, occ))
+            # forced readback — block_until_ready is unreliable on the
+            # tunneled device (see .claude/skills/verify)
+            float(jnp.sum(out[0][0]))
+            dense_wall = time.perf_counter() - t0
+
+            carry = init_hash_carry([jnp.int64, jnp.int64], ["sum"],
+                                    (jnp.float64,), slots)
+            out = hash_fold(*args, carry)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            carry = init_hash_carry([jnp.int64, jnp.int64], ["sum"],
+                                    (jnp.float64,), slots)
+            out = hash_fold(*args, carry)
+            float(jnp.sum(out.accs[0]))
+            hash_wall = time.perf_counter() - t0
+        return dense_wall, hash_wall
+
+    dense_wall, hash_wall = run_on(dev)
+    host_fields = {}
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+        h_dense, h_hash = run_on(cpu)
+        host_fields = {
+            "host_xla_dense_rows_per_sec": round(n * iters / h_dense),
+            "host_xla_hash_rows_per_sec": round(n * iters / h_hash),
+        }
+    except Exception:
+        pass
+    rows = n * iters
+    touched = rows * 4 * 8  # four 8-byte operand streams per iteration
+    return {
+        "device_rows_per_sec": round(rows / dense_wall),
+        "device_hash_rows_per_sec": round(rows / hash_wall),
+        "device_loop_iters": iters,
+        "device_loop_wall_s": round(dense_wall, 4),
+        "device_loop_batch_rows": n,
+        "device_hbm_frac": round((touched / dense_wall) / HBM_PEAK_BYTES_S,
+                                 4),
+        "device_backend": dev.platform,
+        **host_fields,
+    }
 
 
 def _parquet_rows(path):

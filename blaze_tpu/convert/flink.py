@@ -163,13 +163,16 @@ def convert_flink_plan(plan_json, num_partitions: int = 1
     if isinstance(plan_json, str):
         plan_json = json.loads(plan_json)
     nodes = {n["id"]: n for n in plan_json.get("nodes", [])}
-    targets = {e["target"] for e in plan_json.get("edges", [])}
-    downstream = {e["source"]: e["target"]
-                  for e in plan_json.get("edges", [])}
-    roots = [nid for nid in nodes if nid not in targets]
-    sources = [nid for nid in nodes if nid not in downstream or
-               nodes[nid]["type"].startswith(
-                   "stream-exec-table-source-scan")]
+    downstream: Dict[Any, Any] = {}
+    for e in plan_json.get("edges", []):
+        if e["source"] in downstream:
+            # a COMPILE-PLAN with fan-out is not a single operator chain;
+            # silently keeping one edge would mis-walk the DAG
+            raise ConversionError("<flink-plan>",
+                                  f"node {e['source']} has multiple "
+                                  f"outgoing edges (DAG fan-out is not "
+                                  f"supported)")
+        downstream[e["source"]] = e["target"]
     src = [nid for nid in nodes
            if nodes[nid]["type"].split("_")[0]
            == "stream-exec-table-source-scan"]

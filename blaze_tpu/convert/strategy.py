@@ -117,18 +117,21 @@ def remove_inefficient_converts(tag: NodeTag,
     convertible node surrounded by unconvertible neighbors is an island —
     each boundary pays a row<->columnar transition, so isolated islands
     convert at a loss and are demoted (unless always-convert)."""
+    out = tag
     if tag.convertible and tag.node_class not in _ALWAYS_CONVERT \
             and tag.node_class not in _TRANSPARENT:
         parent_native = bool(parent_convertible)
         children_native = any(c.convertible for c in tag.children)
         has_children = bool(tag.children)
         if not parent_native and has_children and not children_native:
-            tag = NodeTag(tag.node_class, False,
+            out = NodeTag(tag.node_class, False,
                           "inefficient isolated conversion "
                           "(removeInefficientConverts)", tag.children)
-    tag.children = [remove_inefficient_converts(c, tag.convertible)
-                    for c in tag.children]
-    return tag
+    # rebuild rather than mutating the caller's tree in place — this
+    # function returns new nodes, so it must be pure all the way down
+    return NodeTag(out.node_class, out.convertible, out.reason,
+                   [remove_inefficient_converts(c, out.convertible)
+                    for c in out.children])
 
 
 def explain(tag: NodeTag) -> str:

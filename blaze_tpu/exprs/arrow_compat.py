@@ -1,0 +1,98 @@
+"""PhysicalExpr -> pyarrow.compute.Expression translation (host engine).
+
+Under host placement the scan+filter leg of an eligible fused stage runs
+as an Arrow dataset scan with the predicate pushed into the C++ scanner —
+the host-engine analog of the reference pushing predicates into the
+DataFusion parquet source (ref parquet_exec.rs:70 page filtering).  Only
+expressions whose Arrow semantics are IDENTICAL to the engine's translate;
+anything else returns None and the caller keeps the engine-side filter.
+
+Intentionally excluded:
+  * floating-point equality (NaN/-0.0 normalization differs),
+  * arithmetic (overflow/div-by-zero semantics are Spark-specific),
+  * string predicates beyond equality (collation/locale edge cases).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pyarrow.compute as pc
+
+from blaze_tpu.exprs.base import BoundReference, Literal, PhysicalExpr
+from blaze_tpu.exprs.binary import BinaryExpr
+from blaze_tpu.exprs.conditional import InList, IsNotNull, IsNull, Not
+from blaze_tpu.schema import Schema, TypeId
+
+
+_CMP = {"==": "equal", "!=": "not_equal", "<": "less", "<=": "less_equal",
+        ">": "greater", ">=": "greater_equal"}
+
+
+def to_arrow_filter(expr: PhysicalExpr, schema: Schema
+                    ) -> Optional[pc.Expression]:
+    """Translate a predicate, or None when semantics could diverge."""
+    if isinstance(expr, BinaryExpr):
+        if expr.op in ("and", "or"):
+            le = to_arrow_filter(expr.left, schema)
+            re = to_arrow_filter(expr.right, schema)
+            if le is None or re is None:
+                return None
+            # pc.Expression &/| are Kleene, matching the engine's
+            # three-valued logic; the scanner drops null-valued rows,
+            # matching FilterExec's null-counts-as-False selection
+            return (le & re) if expr.op == "and" else (le | re)
+        if expr.op in _CMP:
+            lt = expr.left.data_type(schema)
+            rt = expr.right.data_type(schema)
+            for t in (lt, rt):
+                if t.is_floating and expr.op in ("==", "!="):
+                    return None  # NaN/-0.0 normalization differs
+                if t.id == TypeId.DECIMAL:
+                    return None  # unscaled-int64 representation
+            le = _operand(expr.left, schema)
+            re = _operand(expr.right, schema)
+            if le is None or re is None:
+                return None
+            return _cmp(expr.op, le, re)
+        return None
+    if isinstance(expr, IsNull):
+        c = _operand(expr.child, schema)
+        return c.is_null() if c is not None else None
+    if isinstance(expr, IsNotNull):
+        c = _operand(expr.child, schema)
+        return c.is_valid() if c is not None else None
+    if isinstance(expr, Not):
+        c = to_arrow_filter(expr.child, schema)
+        return ~c if c is not None else None
+    if isinstance(expr, InList) and not expr.negated:
+        t = expr.child.data_type(schema)
+        if t.is_floating or t.id == TypeId.DECIMAL:
+            return None
+        if any(v is None for v in expr.values):
+            return None  # null members: three-valued membership
+        c = _operand(expr.child, schema)
+        if c is None:
+            return None
+        import pyarrow as pa
+        return c.isin(pa.array(list(expr.values), type=t.to_arrow()))
+    return None
+
+
+def _cmp(op: str, le, re):
+    import operator as _op
+    fns = {"==": _op.eq, "!=": _op.ne, "<": _op.lt, "<=": _op.le,
+           ">": _op.gt, ">=": _op.ge}
+    return fns[op](le, re)
+
+
+def _operand(expr: PhysicalExpr, schema: Schema):
+    if isinstance(expr, BoundReference):
+        return pc.field(schema[expr.index].name)
+    if isinstance(expr, Literal):
+        if expr.value is None:
+            return None
+        import pyarrow as pa
+        return pc.scalar(pa.scalar(expr.value,
+                                   type=expr.dtype.to_arrow()))
+    return None

@@ -318,6 +318,22 @@ def hash_columns(columns: Sequence[Tuple], seed: int = 42, xp=jnp,
     return seeds.view(xp.int64)
 
 
+def norm_float_keys(flat_cols, tids, xp):
+    """Normalize -0.0 -> 0.0 and NaN -> one canonical pattern in float
+    key columns before hashing.  Spark inserts NormalizeFloatingNumbers
+    upstream of HashPartitioning, grouping and join-key hashing — the
+    hash kernels themselves stay raw/bit-exact (the hash() SQL function
+    does NOT normalize)."""
+    import numpy as _np
+    out = []
+    for (v, val), tid in zip(flat_cols, tids):
+        if tid in ("float32", "float64"):
+            v = xp.where(v == 0, xp.abs(v), v)
+            v = xp.where(xp.isnan(v), xp.array(_np.nan, dtype=v.dtype), v)
+        out.append((v, val))
+    return out
+
+
 def pmod(hashes, n: int, xp=jnp):
     """Spark's non-negative modulo for partition ids
     (ref shuffle/mod.rs:164-189: pmod(murmur3(cols, 42), num_partitions))."""

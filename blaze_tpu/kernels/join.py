@@ -1,0 +1,109 @@
+"""Device join-probe kernels: match counting + bounded pair expansion.
+
+Parity target: joins/join_hash_map.rs:277 (JoinHashMap probe) and
+bhj/semi_join.rs — the reference probes a pointer-linked hash map row by
+row.  The TPU-native form keeps the build side as a HASH-SORTED table
+(hashes ascending, with a unique-hash run-length index) and probes with
+two jit'd programs:
+
+  1. `probe_counts`: vectorized binary search of every probe hash into the
+     unique build hashes -> (start, count) per probe row.  One XLA program,
+     no data-dependent shapes.
+  2. `expand_pairs`: two-pass expansion — exclusive-scan of counts gives
+     each probe row its output offset; a bounded gather materializes
+     (probe_idx, build_idx) pair arrays of STATIC size `cap`.  Rows past a
+     probe's count are masked invalid.  The true total comes back with the
+     pairs; if it exceeds `cap` the caller re-invokes with the next
+     power-of-two bucket (bounded recompiles, same overflow-chunking
+     discipline as the fused agg table).
+
+Hash collisions are verified by the caller against the real key columns,
+so a colliding pair can never produce a wrong join row.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_runs(sorted_hashes: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(unique_hashes, run_start, run_count) for an ascending hash array."""
+    uh, start, count = np.unique(sorted_hashes, return_index=True,
+                                 return_counts=True)
+    return uh, start.astype(np.int64), count.astype(np.int64)
+
+
+@jax.jit
+def probe_counts(unique_hashes: jax.Array, run_start: jax.Array,
+                 run_count: jax.Array, probe_hashes: jax.Array,
+                 probe_null: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-probe-row (start, count) into the sorted build table.
+
+    Null-key probe rows count 0 (SQL equi-join semantics)."""
+    pos = jnp.searchsorted(unique_hashes, probe_hashes)
+    n_unique = unique_hashes.shape[0]
+    pos_c = jnp.clip(pos, 0, max(n_unique - 1, 0))
+    hit = (pos < n_unique) & (jnp.take(unique_hashes, pos_c) == probe_hashes)
+    hit = hit & ~probe_null
+    start = jnp.where(hit, jnp.take(run_start, pos_c), 0)
+    count = jnp.where(hit, jnp.take(run_count, pos_c), 0)
+    return start, count
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def expand_pairs(start: jax.Array, count: jax.Array, cap: int
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Bounded two-pass expansion of (start, count) runs into pair arrays.
+
+    Returns (probe_idx[cap], sorted_pos[cap], valid[cap], total).
+    `sorted_pos` indexes the hash-sorted build order; the caller maps it
+    through the build permutation.  Entries at output offset >= cap are
+    dropped (caller grows `cap` and retries when total > cap)."""
+    n = start.shape[0]
+    offsets = jnp.cumsum(count) - count  # exclusive scan
+    total = offsets[-1] + count[-1] if n else jnp.int64(0)
+    # scatter probe-row boundaries into the output domain, then a
+    # max-scan assigns each output slot its probe row (vectorized
+    # "which run am I in": standard scan-based expansion)
+    slot_probe = jnp.zeros(cap, dtype=jnp.int64).at[
+        jnp.where(count > 0, offsets, cap)].max(
+        jnp.arange(n, dtype=jnp.int64), mode="drop")
+    slot_probe = jax.lax.associative_scan(jnp.maximum, slot_probe)
+    out_pos = jnp.arange(cap, dtype=jnp.int64)
+    valid = out_pos < jnp.minimum(total, cap)
+    p = jnp.clip(slot_probe, 0, max(n - 1, 0))
+    within = out_pos - jnp.take(offsets, p)
+    sorted_pos = jnp.take(start, p) + within
+    return p, sorted_pos, valid, total
+
+
+def _pow2_at_least(n: int) -> int:
+    return max(1024, 1 << int(max(n, 1) - 1).bit_length())
+
+
+def probe_expand_device(unique_hashes, run_start, run_count, sorted_idx,
+                        probe_hashes, probe_null
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Full device probe: counts + expansion entirely as XLA programs,
+    ONE scalar sync for the total, one D2H for the final pair arrays.
+    Overflow grows the static output bucket and re-runs (cached compile
+    per bucket)."""
+    start, count = probe_counts(unique_hashes, run_start, run_count,
+                                probe_hashes, probe_null)
+    total = int(jnp.sum(count))
+    if total == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z
+    cap = _pow2_at_least(total)
+    p, sorted_pos, valid, _t = expand_pairs(start, count, cap)
+    p_np, sp_np, v_np = jax.device_get((p, sorted_pos, valid))
+    p_np = p_np[v_np[: len(p_np)]][:total]
+    sp_np = sp_np[v_np[: len(sp_np)]][:total]
+    b_np = np.asarray(sorted_idx)[sp_np]
+    return p_np, b_np

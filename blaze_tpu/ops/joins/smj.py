@@ -36,10 +36,11 @@ class _Run:
 
     @property
     def is_null_key(self) -> bool:
-        # flag 0 = NULL (sorts first), flag 2 = NaN (sorts last); neither
-        # ever matches across sides — mirroring the hash-probe path, where
-        # pc.equal drops both
-        return any(k[0] != 1 for k in self.key)
+        # flag 0 = NULL (sorts first) never matches across sides.  NaN
+        # (flag 2, sorts last) DOES match NaN: Spark treats NaN as a
+        # normal value in join keys (NaN semantics doc; grouping and
+        # joins both normalize NaN), so only nulls are excluded here.
+        return any(k[0] == 0 for k in self.key)
 
 
 def _key_tuple(arrays: List[pa.Array], row: int) -> Tuple:
@@ -52,8 +53,10 @@ def _key_tuple(arrays: List[pa.Array], row: int) -> Tuple:
             py = v.as_py()
             if isinstance(py, float) and py != py:
                 # NaN poisons tuple comparison (both < and > come back
-                # False, reading as a bogus match); give it its own
-                # sorts-last, never-matching flag
+                # False); encode it as a sorts-last flag with a fixed
+                # payload so NaN == NaN, matching Spark join semantics.
+                # (-0.0 needs no special case: tuple comparison already
+                # treats -0.0 == 0.0.)
                 out.append((2, 0))
             else:
                 out.append((1, py))

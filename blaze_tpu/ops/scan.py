@@ -116,17 +116,36 @@ class ParquetScanExec(ExecutionPlan):
                  = None):
         super().__init__()
         self._file_schema = schema
-        self._projection = list(projection) if projection is not None else None
-        file_part = (Schema([schema.field(n) for n in self._projection])
-                     if self._projection is not None else schema)
-        # Hive-style partition-constant columns appended after file
-        # columns (ref FileScanExecConf.partition_schema +
-        # PartitionedFile.partition_values, planner.rs:170-200)
+        # Hive-style partition-constant columns: the reference's
+        # relation.schema is file columns + partition columns, and the
+        # projection selects from that COMBINED space in projection order
+        # (ref FileScanExecConf, NativeParquetScanBase.scala:55,
+        # planner.rs:170-200).  A projected plan emits exactly the
+        # projected columns; an unprojected one emits file cols + all
+        # partition cols.
         self._partition_schema = partition_schema
         self._partition_values = partition_values  # [group][file][field]
+        part_names = ({f.name for f in partition_schema}
+                      if partition_schema is not None else set())
+        self._projection = list(projection) if projection is not None else None
+        if self._projection is not None:
+            file_part = Schema([schema.field(n) for n in self._projection
+                                if n not in part_names])
+            self._out_partition_fields = [
+                partition_schema.field(n) for n in self._projection
+                if n in part_names] if partition_schema is not None else []
+            combined = {f.name: f for f in schema}
+            if partition_schema is not None:
+                combined.update({f.name: f for f in partition_schema})
+            self._schema = Schema([combined[n] for n in self._projection])
+        else:
+            file_part = schema
+            self._out_partition_fields = (list(partition_schema)
+                                          if partition_schema is not None
+                                          else [])
+            self._schema = (Schema(list(schema) + list(partition_schema))
+                            if partition_schema is not None else schema)
         self._file_part = file_part
-        self._schema = (Schema(list(file_part) + list(partition_schema))
-                        if partition_schema is not None else file_part)
         self._file_groups = [list(g) for g in file_groups]
         self._predicate = predicate
         self._batch_rows = batch_rows or config.BATCH_SIZE.get()
@@ -152,26 +171,36 @@ class ParquetScanExec(ExecutionPlan):
                              f.metadata.num_row_groups - len(row_groups))
             if not row_groups:
                 continue
-            columns = self._projection
+            columns = ([f.name for f in self._file_part]
+                       if self._projection is not None else None)
             for rb in f.iter_batches(batch_size=self._batch_rows,
                                      row_groups=row_groups, columns=columns):
                 rb = _align_schema(rb, self._file_part)
-                rb = self._append_partition_cols(rb, partition, fidx)
+                rb = self._assemble_output(rb, partition, fidx)
                 cb = ColumnBatch.from_arrow(rb)
                 self.metrics.add("output_rows", cb.num_rows)
                 yield cb
 
-    def _append_partition_cols(self, rb: pa.RecordBatch, partition: int,
-                               fidx: int) -> pa.RecordBatch:
-        if self._partition_schema is None:
+    def _assemble_output(self, rb: pa.RecordBatch, partition: int,
+                         fidx: int) -> pa.RecordBatch:
+        """Merge file columns with the projected partition constants into
+        self._schema order (projection may interleave the two)."""
+        if not self._out_partition_fields:
             return rb
-        values = []
+        values: dict = {}
         if self._partition_values is not None:
             group = self._partition_values[partition]
-            values = list(group[fidx]) if fidx < len(group) else []
-        arrays = list(rb.columns)
-        for i, fld in enumerate(self._partition_schema):
-            v = values[i] if i < len(values) else None
+            if fidx < len(group):
+                values = {f.name: v for f, v in
+                          zip(self._partition_schema, group[fidx])}
+        by_name = {rb.schema.field(i).name: rb.column(i)
+                   for i in range(rb.num_columns)}
+        arrays = []
+        for fld in self._schema:
+            if fld.name in by_name:
+                arrays.append(by_name[fld.name])
+                continue
+            v = values.get(fld.name)
             at = fld.data_type.to_arrow()
             arrays.append(pa.nulls(rb.num_rows, type=at) if v is None
                           else pa.array([v] * rb.num_rows, type=at))

@@ -256,10 +256,17 @@ def hash_agg_step(carry: HashAggCarry,
     n = mask.shape[0]
     row_idx = jnp.arange(n, dtype=jnp.int64)
 
-    # grouping normalizes -0.0 to 0.0 BEFORE hashing (Spark's
-    # NormalizeFloatingNumbers does this upstream of the hash, so the
-    # raw-bits hash kernel itself stays bit-exact with Spark)
-    key_cols = [(jnp.where(d == 0, jnp.abs(d), d), v)
+    # grouping normalizes -0.0 to 0.0 AND NaN to one canonical bit
+    # pattern BEFORE hashing (Spark's NormalizeFloatingNumbers does both
+    # upstream of the hash, so the raw-bits hash kernel itself stays
+    # bit-exact with Spark).  Without the NaN leg, differently-encoded
+    # NaNs hash to different slots while the slot-match treats any
+    # NaN == NaN — keys could land in two groups.
+    def _norm(d):
+        d = jnp.where(d == 0, jnp.abs(d), d)
+        return jnp.where(jnp.isnan(d), jnp.array(jnp.nan, dtype=d.dtype), d)
+
+    key_cols = [(_norm(d), v)
                 if jnp.issubdtype(d.dtype, jnp.floating) else (d, v)
                 for d, v in key_cols]
 

@@ -405,7 +405,10 @@ class FusedPartialAggExec(ExecutionPlan):
         # re-merge threshold bounds memory by distinct groups instead of
         # input rows (the InMemTable mem_used -> spill trigger analog)
         limit = config.FUSED_HOST_COLLECT_ROWS.get()
-        for batch in self.children[0].execute(partition):
+        stream = self._host_scan_stream(partition)
+        if stream is None:
+            stream = self.children[0].execute(partition)
+        for batch in stream:
             tbl = self._host_keys_args_table(batch, key_names)
             if tbl is None or tbl.num_rows == 0:
                 continue
@@ -426,6 +429,47 @@ class FusedPartialAggExec(ExecutionPlan):
             chunk = out.slice(off, min(bs, out.num_rows - off))
             self.metrics.add("output_rows", chunk.num_rows)
             yield ColumnBatch.from_arrow(chunk)
+
+    def _host_scan_stream(self, partition: int):
+        """Push the absorbed filter chain into an Arrow dataset scanner
+        (C++-evaluated predicate + projection, the parquet_exec.rs
+        pushdown analog) when the source is a plain parquet scan and
+        every predicate translates exactly; None -> engine-side path."""
+        from blaze_tpu.exprs.arrow_compat import to_arrow_filter
+        from blaze_tpu.ops.scan import ParquetScanExec, open_source
+        src = self._source
+        if not isinstance(src, ParquetScanExec):
+            return None
+        if src._partition_schema is not None:
+            return None  # partition constants need engine-side assembly
+        filt = None
+        for kind, preds, _exprs, _schema in self._chain:
+            if kind != "filter":
+                return None
+            for p in preds or ():
+                e = to_arrow_filter(p, src.schema)
+                if e is None:
+                    return None
+                filt = e if filt is None else (filt & e)
+        paths = src._file_groups[partition]
+        if not paths:
+            return iter(())
+        try:
+            import pyarrow.dataset as ds
+            dataset = ds.dataset([open_source(p) for p in paths],
+                                 format="parquet",
+                                 schema=src._file_part.to_arrow())
+            scanner = dataset.scanner(filter=filt, batch_size=1 << 20,
+                                      use_threads=True)
+        except Exception:
+            return None  # schema evolution etc.: engine-side scan
+
+        def gen():
+            for rb in scanner.to_batches():
+                if rb.num_rows:
+                    self.metrics.add("pushdown_rows", rb.num_rows)
+                    yield ColumnBatch.from_arrow(rb)
+        return gen()
 
     def _host_keys_args_table(self, batch: ColumnBatch, key_names):
         """Evaluate keys + agg args on the (numpy-resident) batch and pack
@@ -700,8 +744,8 @@ class FusedPartialAggExec(ExecutionPlan):
         kd, kv = [], []
         for e, _name in self._group_exprs:
             dv = e.evaluate(batch).to_device(cap)
-            kd.append(dv.data)
-            kv.append(dv.validity)
+            kd.append(_pad_lane(dv.data))
+            kv.append(_pad_lane(dv.validity))
         ad, av = [], []
         for _rk, _ok, arg in self._specs:
             if arg is None:
@@ -709,9 +753,10 @@ class FusedPartialAggExec(ExecutionPlan):
                 av.append(None)
             else:
                 dv = arg.evaluate(batch).to_device(cap)
-                ad.append(dv.data)
-                av.append(dv.validity)
-        return tuple(kd), tuple(kv), tuple(ad), tuple(av), batch.row_mask()
+                ad.append(_pad_lane(dv.data))
+                av.append(_pad_lane(dv.validity))
+        return (tuple(kd), tuple(kv), tuple(ad), tuple(av),
+                _pad_lane(batch.row_mask()))
 
     def _emit_rows(self, keys, accs, avalid) -> BatchIterator:
         n = len(accs[0]) if accs else len(keys[0][0])
@@ -742,14 +787,27 @@ import functools
 from blaze_tpu.batch import DeviceColumn
 
 
+def _pad_lane(a):
+    """Pad a host-resident (numpy) array up to the 128-lane tile before it
+    enters a jit program — unpadded lengths would compile one program per
+    distinct tail-batch size."""
+    if not isinstance(a, np.ndarray):
+        return a
+    from blaze_tpu.batch import round_capacity
+    cap = round_capacity(a.shape[0])
+    if cap == a.shape[0]:
+        return a
+    return np.pad(a, (0, cap - a.shape[0]))
+
+
 def _source_inputs(batch: ColumnBatch):
     """Flatten a source batch for the jit step: device columns become
     (data, validity) pairs; host (string) columns pass as None — any
     expression touching one failed the pre-trace and never reaches here."""
-    cols_flat = tuple((c.data, c.validity)
+    cols_flat = tuple((_pad_lane(c.data), _pad_lane(c.validity))
                       if isinstance(c, DeviceColumn) else None
                       for c in batch.columns)
-    return cols_flat, batch.row_mask()
+    return cols_flat, _pad_lane(batch.row_mask())
 
 
 def _make_prepare(source_schema: Schema, chain, group_exprs, specs):

@@ -690,7 +690,13 @@ def plan_from_proto(n: pb.PhysicalPlanNode) -> Dict[str, Any]:
         d: Dict[str, Any] = {"kind": kind, "schema": schema,
                              "file_groups": groups, **extra}
         if node.base_conf.projection:
-            names = [schema["fields"][i]["name"]
+            # projection indices address file schema + partition schema
+            # combined, in that order (ref NativeParquetScanBase.scala:55:
+            # relation.schema = file columns + partition columns)
+            all_fields = list(schema["fields"])
+            if "partition_schema" in extra:
+                all_fields += list(extra["partition_schema"]["fields"])
+            names = [all_fields[i]["name"]
                      for i in node.base_conf.projection]
             d["projection"] = names
         if kind == "parquet_scan" and node.pruning_predicates:
@@ -1018,6 +1024,9 @@ def plan_to_proto(d: Dict[str, Any]) -> pb.PhysicalPlanNode:
         conf.schema.CopyFrom(schema_to_proto(d["schema"]))
         if d.get("projection"):
             names = [f["name"] for f in d["schema"]["fields"]]
+            if d.get("partition_schema"):
+                names += [f["name"]
+                          for f in d["partition_schema"]["fields"]]
             for p in d["projection"]:
                 conf.projection.append(names.index(p))
         if k == "parquet_scan" and d.get("predicate"):

@@ -50,6 +50,9 @@ import functools
 @functools.lru_cache(maxsize=128)
 def _hash_pmod_jit(tids: Tuple[str, ...], n_parts: int):
     def f(flat_cols):
+        # Spark inserts NormalizeFloatingNumbers upstream of
+        # HashPartitioning: -0.0/NaN variants must land on ONE reducer
+        flat_cols = H.norm_float_keys(flat_cols, tids, jnp)
         cols = [(v, val, tid)
                 for (v, val), tid in zip(flat_cols, tids)]
         h = H.hash_columns(cols, seed=42, xp=jnp, algo="murmur3")
@@ -63,14 +66,26 @@ class HashPartitioning(Partitioning):
         self.num_partitions = num_partitions
 
     def partition_ids(self, batch: ColumnBatch) -> np.ndarray:
+        from blaze_tpu.bridge.placement import host_resident
+        from blaze_tpu.xputil import asnp
         n = batch.num_rows
-        cap = batch.capacity
+        if self.num_partitions == 1:
+            # pmod(h, 1) == 0 for every row: skip the hash chain
+            return np.zeros(n, dtype=np.int32)
+        on_host = host_resident()
+        # host batches are unpadded; hashing in numpy avoids one jit
+        # compile per distinct tail-batch length
+        cap = n if on_host else batch.capacity
         flat_cols = []
         tids = []
         for e in self.exprs:
             v = e.evaluate(batch)
             if v.is_device:
-                flat_cols.append((v.data, v.validity))
+                if on_host:
+                    flat_cols.append((asnp(v.data)[:cap],
+                                      asnp(v.validity)[:cap]))
+                else:
+                    flat_cols.append((v.data, v.validity))
                 tids.append(v.dtype.id.value)
             else:
                 # host (string) columns are exact-length; pad the byte
@@ -87,10 +102,20 @@ class HashPartitioning(Partitioning):
                 full_len[:len(lengths)] = lengths
                 pad_valid = np.zeros(cap, dtype=bool)
                 pad_valid[:len(valid)] = valid
-                flat_cols.append(((jnp.asarray(full),
-                                   jnp.asarray(full_len)),
-                                  jnp.asarray(pad_valid)))
+                if on_host:
+                    flat_cols.append(((full, full_len), pad_valid))
+                else:
+                    flat_cols.append(((jnp.asarray(full),
+                                       jnp.asarray(full_len)),
+                                      jnp.asarray(pad_valid)))
                 tids.append("utf8")
+        if on_host:
+            flat_cols = H.norm_float_keys(flat_cols, tids, np)
+            cols = [(v, val, tid)
+                    for (v, val), tid in zip(flat_cols, tids)]
+            h = H.hash_columns(cols, seed=42, xp=np, algo="murmur3")
+            return np.asarray(H.pmod(h, self.num_partitions,
+                                     xp=np))[:n].astype(np.int32)
         pids = _hash_pmod_jit(tuple(tids), self.num_partitions)(flat_cols)
         return np.asarray(pids)[:n].astype(np.int32)
 

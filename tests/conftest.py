@@ -17,3 +17,35 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def _build_native_libs() -> None:
+    """Build the C++ libs (zstd IPC codec + host bridge) so their tests
+    are always load-bearing instead of skipped (VERDICT r3 #9).  Cached:
+    rebuilds only when a source/CMake file is newer than the libs."""
+    import glob
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(root, "native")
+    build = os.path.join(native, "build")
+    libs = (glob.glob(os.path.join(build, "**", "libblaze_*.so"),
+                      recursive=True) if os.path.isdir(build) else [])
+    srcs = (glob.glob(os.path.join(native, "src", "*")) +
+            [os.path.join(native, "CMakeLists.txt")])
+    try:
+        if libs and srcs:
+            newest_src = max(os.path.getmtime(p) for p in srcs)
+            oldest_lib = min(os.path.getmtime(p) for p in libs)
+            if oldest_lib >= newest_src:
+                return
+        subprocess.run(["cmake", "-S", native, "-B", build, "-G", "Ninja"],
+                       check=True, capture_output=True, timeout=300)
+        subprocess.run(["cmake", "--build", build], check=True,
+                       capture_output=True, timeout=600)
+    except Exception as e:  # missing toolchain/files: tests fall to skips
+        import warnings
+        warnings.warn(f"native lib build failed ({e}); "
+                      f"bridge/codec tests will skip")
+
+
+_build_native_libs()

@@ -90,3 +90,47 @@ def test_partition_values_over_proto_wire(tmp_path):
         rt.finalize()
     wired = pa.Table.from_batches(rows)
     assert wired.column("p_year").to_pylist() == [2001, 2001]
+
+
+def test_projection_selects_partition_columns_in_order(tmp_path):
+    """Reference FileScanExecConf semantics (ADVICE r3 #1): projection
+    indices address file schema + partition schema COMBINED, output is
+    exactly the projected columns in projection order — a plan projecting
+    one partition column must not gain trailing extras, and one projecting
+    none must emit file columns only."""
+    t = pa.table({"k": pa.array([1, 2], type=pa.int64()),
+                  "v": pa.array([1.5, 2.5])})
+    src = str(tmp_path / "part.parquet")
+    pq.write_table(t, src)
+    base = {"kind": "parquet_scan",
+            "schema": {"fields": [
+                {"name": "k", "type": {"id": "int64"}, "nullable": True},
+                {"name": "v", "type": {"id": "float64"}, "nullable": True}]},
+            "partition_schema": {"fields": [
+                {"name": "region", "type": {"id": "utf8"}, "nullable": True},
+                {"name": "year", "type": {"id": "int64"}, "nullable": True}]},
+            "partition_values": [[["CA", 2001]]],
+            "file_groups": [[src]]}
+
+    # interleaved projection incl. ONE partition column
+    ir = dict(base, projection=["year", "k"])
+    rt = plan_from_proto(plan_to_proto(ir))
+    out = pa.Table.from_batches(
+        [b.compact().to_arrow() for b in create_plan(rt).execute(0)])
+    assert out.column_names == ["year", "k"]
+    assert out.column("year").to_pylist() == [2001, 2001]
+    assert out.column("k").to_pylist() == [1, 2]
+
+    # projection of file columns only: NO trailing partition columns
+    ir2 = dict(base, projection=["v"])
+    rt2 = plan_from_proto(plan_to_proto(ir2))
+    out2 = pa.Table.from_batches(
+        [b.compact().to_arrow() for b in create_plan(rt2).execute(0)])
+    assert out2.column_names == ["v"]
+
+    # no projection: file columns + ALL partition columns (default)
+    rt3 = plan_from_proto(plan_to_proto(base))
+    out3 = pa.Table.from_batches(
+        [b.compact().to_arrow() for b in create_plan(rt3).execute(0)])
+    assert out3.column_names == ["k", "v", "region", "year"]
+    assert out3.column("region").to_pylist() == ["CA", "CA"]

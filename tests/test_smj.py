@@ -151,14 +151,16 @@ def test_shj_falls_back_to_smj_on_large_build():
     assert len(got) == len(want)
 
 
-def test_smj_nan_float_keys_never_match():
-    """NaN poisons Python tuple comparison (< and > both False); the run
-    merge must treat NaN keys like nulls — never matching, like the hash
-    path's pc.equal."""
-    left = pa.table({"lk": pa.array([1.0, 2.0, float("nan")]),
-                     "lv": pa.array([10, 20, 30], type=pa.int64())})
-    right = pa.table({"rk": pa.array([2.0, 3.0, float("nan")]),
-                      "rv": pa.array([200, 300, 400], type=pa.int64())})
+def test_smj_nan_float_keys_match_like_spark():
+    """Spark treats NaN as a NORMAL value in join keys (NaN semantics
+    doc; NormalizeFloatingNumbers applies to join keys): NaN joins NaN.
+    NULL keys still never match.  SMJ, the vectorized hash probe, and
+    the Acero host path must all agree."""
+    left = pa.table({"lk": pa.array([1.0, 2.0, float("nan"), None]),
+                     "lv": pa.array([10, 20, 30, 40], type=pa.int64())})
+    right = pa.table({"rk": pa.array([2.0, 3.0, float("nan"), None]),
+                      "rv": pa.array([200, 300, 400, 500],
+                                     type=pa.int64())})
     smj = SortMergeJoinExec(
         MemoryScanExec.from_arrow(left), MemoryScanExec.from_arrow(right),
         [col(0)], [col(0)], JoinType.INNER)
@@ -166,5 +168,7 @@ def test_smj_nan_float_keys_never_match():
         MemoryScanExec.from_arrow(left), MemoryScanExec.from_arrow(right),
         [col(0)], [col(0)], JoinType.INNER)
     a, b = _run(smj), _run(shj)
-    assert len(a) == len(b) == 1
+    assert len(a) == len(b) == 2  # 2.0 match + NaN match; nulls drop
+    a = a.sort_values("lv")
     assert a.iloc[0].lk == 2.0 and a.iloc[0].rv == 200
+    assert a.iloc[1].rv == 400  # NaN joined NaN
