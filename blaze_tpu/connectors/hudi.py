@@ -1,13 +1,26 @@
 """Hudi scan provider.
 
-Parity: thirdparty/auron-hudi (960 LoC) — copy-on-write tables scan base
-parquet files directly; merge-on-read snapshot queries are resolved
-engine-side to the compacted base + log-merged files before splits reach
-the native scan (matching the reference, which also defers MOR merging).
+Parity: thirdparty/auron-hudi (960 LoC).  Copy-on-write tables scan base
+parquet files directly.  Merge-on-read snapshot reads are COMPACTED
+ENGINE-SIDE before splits reach the native scan: each split's log blocks
+merge onto its base file by record key — latest ordering value wins,
+`_hoodie_is_deleted` rows drop — and the merged result is materialized
+once (cached by base/log mtimes) as the split's scan path.  Log blocks
+arrive parquet-serialized: the host engine (which reads Hudi's avro log
+format in the JVM, like the reference) hands the engine columnar blocks,
+matching how the reference defers format decoding to the engine side.
+
+Descriptor shape:
+  {"splits": [{"path": base.parquet, "partition_values": {...},
+               "log_files": [block.parquet, ...],        # MOR only
+               "record_key": "_hoodie_record_key",       # default
+               "ordering_field": "_hoodie_commit_time"}]}  # default
 """
 
 from __future__ import annotations
 
+import os
+import tempfile
 from typing import List
 
 from blaze_tpu import config
@@ -18,16 +31,99 @@ ENABLE_HUDI = config.bool_conf(
     "auron.enable.hudi.scan", True,
     "Route Hudi table scans through the native provider.")
 
+DELETE_MARKER = "_hoodie_is_deleted"
+
+
+def _merge_mor(base_path: str, log_files: List[str], record_key: str,
+               ordering_field: str) -> str:
+    """Compact base + log blocks to one parquet file; returns its path.
+    Cached by content mtimes so a split re-resolved in another task reuses
+    the artifact (the compaction-plan analog of Hudi's inline compactor)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    import pyarrow.parquet as pq
+
+    import hashlib
+    h = hashlib.sha1()
+    for p in [base_path] + list(log_files):
+        st = os.stat(p)
+        h.update(f"{p}|{st.st_mtime_ns}|{st.st_size}\n".encode())
+    h.update(f"{record_key}|{ordering_field}".encode())
+    key = h.hexdigest()[:20]  # content digest: stable across processes,
+    # ns-mtime + size guards same-second rewrites
+    out_dir = os.path.join(tempfile.gettempdir(), "blaze_tpu_hudi_mor")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, f"compact-{key}.parquet")
+    if os.path.exists(out_path):
+        return out_path
+
+    base = pq.read_table(base_path)
+    logs = [pq.read_table(p) for p in log_files]
+    # newest-wins: base first, then log blocks in commit order; a later
+    # row with the same record key supersedes every earlier one.  Log
+    # blocks project to base columns (+ the delete marker, which a base
+    # file normally lacks — permissive concat null-fills it there).
+    pieces = [base]
+    for lg in logs:
+        keep = [c for c in lg.schema.names
+                if c in base.schema.names or c == DELETE_MARKER]
+        pieces.append(lg.select(keep))
+    allt = pa.concat_tables(pieces, promote_options="permissive")
+    seq = pa.array(range(allt.num_rows), type=pa.int64())
+    allt = allt.append_column("__seq", seq)
+    # per record key keep the row with the max (ordering_field, __seq)
+    sort_keys = [(record_key, "ascending")]
+    if ordering_field in allt.schema.names:
+        sort_keys.append((ordering_field, "ascending"))
+    sort_keys.append(("__seq", "ascending"))
+    allt = allt.sort_by(sort_keys)
+    keys = allt.column(record_key)
+    import numpy as np
+    k = keys.to_numpy(zero_copy_only=False)
+    # last row of each equal-key run is the winner
+    last = np.ones(len(k), dtype=bool)
+    if len(k) > 1:
+        last[:-1] = k[:-1] != k[1:]
+    merged = allt.filter(pa.array(last))
+    if DELETE_MARKER in merged.schema.names:
+        alive = pc.fill_null(
+            pc.invert(merged.column(DELETE_MARKER).cast("bool")), True)
+        merged = merged.filter(alive)
+        if DELETE_MARKER not in base.schema.names:
+            merged = merged.drop_columns([DELETE_MARKER])
+    merged = merged.drop_columns(["__seq"])
+    # atomic materialization: a concurrent resolver or a kill mid-write
+    # must never surface a truncated artifact under the cache path
+    fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".tmp")
+    os.close(fd)
+    try:
+        pq.write_table(merged, tmp)
+        os.rename(tmp, out_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return out_path
+
 
 class HudiScanProvider(ScanProvider):
     name = "hudi"
     enable_conf = ENABLE_HUDI
 
     def resolve_splits(self, descriptor: dict) -> List[ScanSplit]:
-        return [ScanSplit(path=s["path"],
-                          file_format=s.get("format", "parquet"),
-                          partition_values=s.get("partition_values", {}))
-                for s in descriptor.get("splits", [])]
+        out: List[ScanSplit] = []
+        for s in descriptor.get("splits", []):
+            path = s["path"]
+            logs = s.get("log_files") or []
+            if logs:  # merge-on-read: compact engine-side before scanning
+                path = _merge_mor(
+                    path, logs,
+                    s.get("record_key", "_hoodie_record_key"),
+                    s.get("ordering_field", "_hoodie_commit_time"))
+            out.append(ScanSplit(path=path,
+                                 file_format=s.get("format", "parquet"),
+                                 partition_values=s.get("partition_values",
+                                                        {})))
+        return out
 
 
 register_provider(HudiScanProvider())

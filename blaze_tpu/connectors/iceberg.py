@@ -46,36 +46,66 @@ class IcebergDeleteFilter(DeleteFilter):
                     out.add(int(pos))
         return out
 
-    def _equality_rows(self, split: ScanSplit):
+    def _equality_tables(self, split: ScanSplit):
         for df in split.delete_files:
             if df.endswith(".pos.parquet"):
                 continue
             t = pq.read_table(df)
-            cols = t.schema.names
-            yield cols, set(map(tuple, zip(*[t.column(c).to_pylist()
-                                             for c in cols])))
+            yield t.schema.names, t
 
     def apply(self, batch: ColumnBatch, split: ScanSplit,
               row_offset: int) -> ColumnBatch:
         if not split.delete_files:
             return batch
-        import jax.numpy as jnp
         n = batch.num_rows
         keep = np.ones(batch.capacity, dtype=bool)
         pos = self._positions_for(split)
         if pos:
             rows = np.arange(row_offset, row_offset + n)
             keep[:n] &= ~np.isin(rows, list(pos))
-        for cols, deleted in self._equality_rows(split):
-            idxs = [batch.schema.index_of(c) for c in cols]
-            rb = batch.to_arrow()
-            vals = list(zip(*[rb.column(batch.schema.index_of(c)).to_pylist()
-                              for c in cols]))
-            hit = np.array([tuple(v) in deleted for v in vals])
-            mask_n = np.ones(n, dtype=bool)
-            mask_n[:len(hit)] = ~hit
-            keep[:n] &= mask_n
+        rb = None
+        for cols, dt in self._equality_tables(split):
+            if rb is None:
+                rb = batch.to_arrow()
+            keep[:n] &= ~self._equality_hits(rb, cols, dt, batch)
+        from blaze_tpu.bridge.placement import host_resident
+        if host_resident():
+            return batch.with_selection(keep)
+        import jax.numpy as jnp
         return batch.with_selection(jnp.asarray(keep))
+
+    def _equality_hits(self, rb, cols, dt, batch: ColumnBatch
+                       ) -> np.ndarray:
+        """Rows of `rb` matched by the delete table — an Arrow C++ semi
+        join instead of per-row Python tuple-set membership (a 100K-row
+        delete file took seconds; this is milliseconds).  Delete rows
+        containing NULL keep the Python path: Iceberg equality treats
+        null == null as a match, which Acero join semantics do not."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        n = rb.num_rows
+        hit = np.zeros(n, dtype=bool)
+        key_cols = [rb.column(batch.schema.index_of(c)) for c in cols]
+        null_mask = None
+        for c in cols:
+            m = pc.is_null(dt.column(c))
+            null_mask = m if null_mask is None else pc.or_(null_mask, m)
+        clean = dt.filter(pc.invert(null_mask))
+        if clean.num_rows:
+            probe = pa.table(
+                key_cols + [pa.array(np.arange(n, dtype=np.int64))],
+                names=list(cols) + ["__row"])
+            matched = probe.join(clean.select(cols), keys=list(cols),
+                                 join_type="left semi")
+            hit[np.asarray(matched.column("__row"))] = True
+        nulls = dt.filter(null_mask)
+        if nulls.num_rows:
+            deleted = set(map(tuple, zip(*[nulls.column(c).to_pylist()
+                                           for c in cols])))
+            vals = zip(*[kc.to_pylist() for kc in key_cols])
+            hit |= np.fromiter((tuple(v) in deleted for v in vals),
+                               dtype=bool, count=n)
+        return hit
 
 
 class IcebergScanProvider(ScanProvider):

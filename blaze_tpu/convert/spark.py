@@ -922,7 +922,17 @@ def _convert_agg(node: dict, parts: int, log: List[str]
     if result_attrs and all(_cls(a) == "AttributeReference"
                             for a in result_attrs):
         ids, names = _attrs_of(result_attrs)
+        ng = len(groupings)
         if ids != phys.ids:
+            if (ids[:ng] == phys.ids[:ng] and
+                    not any(i in phys.ids for i in ids[ng:])):
+                # real Spark partial aggregates expose their
+                # aggBufferAttributes (e.g. sum#110) as output ids — not
+                # the AggregateExpression resultId the synthesized corpus
+                # used.  Same physical layout [groups..., acc columns...],
+                # different identity: adopt the attrs verbatim (caught by
+                # the hand-captured Spark 3.5 fixture, VERDICT r3 #5)
+                return d, Scope(ids, names)
             # resultExpressions reorder the output: emit the projection
             # Spark folds into the aggregate, else parents bind wrong
             # physical columns

@@ -86,7 +86,8 @@ class BinaryExpr(PhysicalExpr):
         return _arith(self.op, a, b, self.data_type(batch.schema))
 
     def _evaluate_host(self, batch: ColumnBatch, a: ColVal, b: ColVal) -> ColVal:
-        """String/binary comparisons and concat run on host Arrow arrays."""
+        """String/binary comparisons, Kleene and/or over mixed host/device
+        operands, and concat run on host Arrow arrays."""
         n = batch.num_rows
         ha, hb = a.to_host(n), b.to_host(n)
         fns: dict[str, Callable] = {
@@ -95,6 +96,11 @@ class BinaryExpr(PhysicalExpr):
         }
         if self.op in fns:
             return ColVal.host(BOOL, fns[self.op](ha, hb))
+        if self.op in ("and", "or"):
+            # one side host (e.g. an in_list over strings), one device:
+            # three-valued logic via Arrow's Kleene kernels
+            f = pc.and_kleene if self.op == "and" else pc.or_kleene
+            return ColVal.host(BOOL, f(ha.cast("bool"), hb.cast("bool")))
         if self.op == "<=>":
             eq = pc.equal(ha, hb)
             both_null = pc.and_(pc.is_null(ha), pc.is_null(hb))

@@ -8,3 +8,6 @@ from blaze_tpu.itest.tpcds_data import generate, write_parquet_dataset
 __all__ = ["QueryResult", "check_plan_stability", "compare_frames",
            "normalize_plan", "run_query", "generate",
            "write_parquet_dataset"]
+
+# register the breadth-extension queries into QUERIES (import side effect)
+from blaze_tpu.itest import queries_ext  # noqa: E402,F401

@@ -13,6 +13,9 @@ import numpy as np
 import pyarrow as pa
 
 SF1_ROWS = {
+    "household_demographics": 7_200,
+    "time_dim": 86_400,
+    "reason": 35,
     "store_returns": 287_514,
     "store_sales": 2_880_404,
     "catalog_sales": 1_441_548,
@@ -32,7 +35,8 @@ SF1_ROWS = {
 
 def _rows(name: str, scale: float) -> int:
     base = SF1_ROWS[name]
-    if name in ("store", "date_dim", "warehouse", "promotion"):
+    if name in ("store", "date_dim", "warehouse", "promotion",
+                "household_demographics", "time_dim", "reason"):
         return base  # dimension tables do not scale
     if name == "customer_demographics":
         # fixed-size cross-product dimension in TPC-DS
@@ -50,6 +54,9 @@ def gen_date_dim(scale: float, seed: int = 11) -> pa.Table:
         "d_year": pa.array(year.astype(np.int32)),
         "d_moy": pa.array(np.minimum(moy, 12).astype(np.int32)),
         "d_dom": pa.array(((np.arange(n) % 31) + 1).astype(np.int32)),
+        "d_dow": pa.array((np.arange(n) % 7).astype(np.int32)),
+        "d_qoy": pa.array((((np.minimum(moy, 12) - 1) // 3) + 1)
+                          .astype(np.int32)),
     })
 
 
@@ -101,6 +108,8 @@ def gen_store_returns(scale: float, seed: int = 14) -> pa.Table:
         "sr_item_sk": pa.array(rng.integers(1, _rows("item", scale) + 1, n)),
         "sr_return_quantity": pa.array(
             rng.integers(1, 50, n).astype(np.int32)),
+        "sr_reason_sk": pa.array(rng.integers(1, 36, n)),
+        "sr_net_loss": pa.array(np.round(rng.random(n) * 60, 2)),
     })
 
 
@@ -124,6 +133,11 @@ def gen_store_sales(scale: float, seed: int = 15) -> pa.Table:
         "ss_list_price": pa.array(np.round(rng.random(n) * 320, 2)),
         "ss_coupon_amt": pa.array(np.round(rng.random(n) * 40, 2)),
         "ss_sales_price": pa.array(np.round(rng.random(n) * 280, 2)),
+        "ss_net_profit": pa.array(np.round(rng.random(n) * 120 - 20, 2)),
+        "ss_hdemo_sk": pa.array(rng.integers(1, 7_201, n)),
+        "ss_addr_sk": pa.array(
+            rng.integers(1, _rows("customer_address", scale) + 1, n)),
+        "ss_sold_time_sk": pa.array(rng.integers(0, 86_400, n)),
     })
 
 
@@ -144,6 +158,8 @@ def gen_catalog_sales(scale: float, seed: int = 17) -> pa.Table:
         "cs_coupon_amt": pa.array(np.round(rng.random(n) * 50, 2)),
         "cs_sales_price": pa.array(np.round(rng.random(n) * 250, 2)),
         "cs_net_profit": pa.array(np.round(rng.random(n) * 100 - 20, 2)),
+        "cs_promo_sk": pa.array(rng.integers(1, 301, n)),
+        "cs_ext_sales_price": pa.array(np.round(rng.random(n) * 280, 2)),
     })
 
 
@@ -167,6 +183,10 @@ def gen_web_sales(scale: float, seed: int = 18) -> pa.Table:
             rng.integers(2450815, 2450815 + date_n, n)),
         "ws_item_sk": pa.array(rng.integers(1, _rows("item", scale) + 1, n)),
         "ws_ext_sales_price": pa.array(np.round(rng.random(n) * 300, 2)),
+        "ws_bill_customer_sk": pa.array(
+            rng.integers(1, _rows("customer", scale) + 1, n)),
+        "ws_quantity": pa.array(rng.integers(1, 100, n).astype(np.int32)),
+        "ws_sales_price": pa.array(np.round(rng.random(n) * 260, 2)),
     })
 
 
@@ -191,6 +211,8 @@ def gen_customer_demographics(scale: float, seed: int = 20) -> pa.Table:
         "cd_gender": pa.array(genders[rng.integers(0, 2, n)]),
         "cd_education_status": pa.array(edu[rng.integers(0, len(edu), n)]),
         "cd_dep_count": pa.array(rng.integers(0, 7, n).astype(np.int32)),
+        "cd_marital_status": pa.array(
+            np.array(["S", "M", "D", "W", "U"])[rng.integers(0, 5, n)]),
     })
 
 
@@ -203,6 +225,9 @@ def gen_customer_address(scale: float, seed: int = 21) -> pa.Table:
     return pa.table({
         "ca_address_sk": pa.array(np.arange(1, n + 1)),
         "ca_state": pa.array(states[rng.integers(0, len(states), n)]),
+        "ca_city": pa.array(
+            np.array([f"city_{i}" for i in range(60)])[
+                rng.integers(0, 60, n)]),
         "ca_county": pa.array(counties[rng.integers(0, len(counties), n)]),
         "ca_country": pa.array(np.array(["United States"]).repeat(n)),
     })
@@ -257,7 +282,42 @@ def gen_web_clickstreams(scale: float, seed: int = 23) -> pa.Table:
     })
 
 
+def gen_household_demographics(scale: float, seed: int = 24) -> pa.Table:
+    n = _rows("household_demographics", scale)
+    rng = np.random.default_rng(seed)
+    pot = np.array([">10000", "5001-10000", "1001-5000", "501-1000",
+                    "0-500", "Unknown"])
+    return pa.table({
+        "hd_demo_sk": pa.array(np.arange(1, n + 1)),
+        "hd_dep_count": pa.array(rng.integers(0, 10, n).astype(np.int32)),
+        "hd_vehicle_count": pa.array(
+            rng.integers(-1, 5, n).astype(np.int32)),
+        "hd_buy_potential": pa.array(pot[rng.integers(0, len(pot), n)]),
+    })
+
+
+def gen_time_dim(scale: float, seed: int = 25) -> pa.Table:
+    n = _rows("time_dim", scale)
+    t = np.arange(n)
+    return pa.table({
+        "t_time_sk": pa.array(t),
+        "t_hour": pa.array((t // 3600).astype(np.int32)),
+        "t_minute": pa.array(((t % 3600) // 60).astype(np.int32)),
+    })
+
+
+def gen_reason(scale: float, seed: int = 26) -> pa.Table:
+    n = _rows("reason", scale)
+    return pa.table({
+        "r_reason_sk": pa.array(np.arange(1, n + 1)),
+        "r_reason_desc": pa.array([f"reason {i}" for i in range(1, n + 1)]),
+    })
+
+
 GENERATORS = {
+    "household_demographics": gen_household_demographics,
+    "time_dim": gen_time_dim,
+    "reason": gen_reason,
     "date_dim": gen_date_dim,
     "store": gen_store,
     "customer": gen_customer,

@@ -81,6 +81,7 @@ class MemManager:
         self._consumers: List[MemConsumer] = []
         self.total_spill_count = 0
         self.total_spilled_bytes = 0
+        self.peak_used = 0
 
     # -- singleton wiring (ref MemManager::init, lib.rs:46) ---------------
     @classmethod
@@ -120,7 +121,10 @@ class MemManager:
     # -- pressure handling -------------------------------------------------
     def on_mem_updated(self, updated: MemConsumer) -> None:
         with self._lock:
-            overflow = self.mem_used - self.total
+            used = self.mem_used
+            if used > self.peak_used:
+                self.peak_used = used
+            overflow = used - self.total
             cap = self.consumer_cap()
             # a consumer far over its fair share spills even without global
             # overflow, so one giant sort cannot starve later operators

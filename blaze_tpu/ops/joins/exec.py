@@ -388,13 +388,21 @@ class BaseJoinExec(ExecutionPlan):
         names across sides both work.  Float keys normalize -0.0 -> 0.0
         and NaN -> one canonical pattern (Acero hashes raw bits; Spark's
         NormalizeFloatingNumbers runs upstream of the join)."""
+        from blaze_tpu.exprs.base import BoundReference
         tbl = (pa.Table.from_batches([rb_or_tbl])
                if isinstance(rb_or_tbl, pa.RecordBatch) else rb_or_tbl)
         n = tbl.num_rows
-        cb = ColumnBatch.from_arrow(tbl.combine_chunks())
+        cb = None
         key_cols = []
         for e in keys:
-            arr = e.evaluate(cb).to_host(n)
+            if isinstance(e, BoundReference):
+                arr = tbl.column(e.index)  # zero-copy; no batch rebuild
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = arr.combine_chunks()
+            else:
+                if cb is None:
+                    cb = ColumnBatch.from_arrow(tbl.combine_chunks())
+                arr = e.evaluate(cb).to_host(n)
             if pa.types.is_floating(arr.type):
                 arr = pc.add(arr, 0.0)  # -0.0 + 0.0 == +0.0
                 nan = pa.scalar(float("nan"), type=arr.type)

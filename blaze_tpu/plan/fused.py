@@ -397,29 +397,63 @@ class FusedPartialAggExec(ExecutionPlan):
     def _execute_host_vectorized(self, partition: int) -> BatchIterator:
         import pyarrow as pa
 
+        from blaze_tpu.memory import MemConsumer, MemManager
+
         key_names = [n for _e, n in self._group_exprs]
 
-        chunks: List[pa.Table] = []
-        chunk_rows = 0
-        merged: Optional[pa.Table] = None
+        state = {"chunks": [], "rows": 0, "bytes": 0, "merged": None}
+
+        class _Consumer(MemConsumer):
+            """Budget discipline for the buffered raw chunks: memory
+            pressure forces the acc-table re-merge early (the InMemTable
+            mem_used -> spill trigger analog, ref agg_table.rs:323)."""
+
+            def __init__(c):
+                super().__init__("host_vectorized_agg")
+
+            def spill(c) -> int:
+                if not state["chunks"]:
+                    return 0
+                released = state["bytes"]
+                state["merged"] = self._host_group_by(
+                    state["chunks"], state["merged"], key_names)
+                state["chunks"] = []
+                state["rows"] = 0
+                state["bytes"] = 0
+                c.update_mem_used(
+                    state["merged"].nbytes if state["merged"] is not None
+                    else 0)
+                return released
+
+        consumer = _Consumer()
+        consumer.set_spillable(MemManager.get())
         # re-merge threshold bounds memory by distinct groups instead of
-        # input rows (the InMemTable mem_used -> spill trigger analog)
+        # input rows
         limit = config.FUSED_HOST_COLLECT_ROWS.get()
+        merged_bytes = 0
         stream = self._host_scan_stream(partition)
         if stream is None:
             stream = self.children[0].execute(partition)
-        for batch in stream:
-            tbl = self._host_keys_args_table(batch, key_names)
-            if tbl is None or tbl.num_rows == 0:
-                continue
-            chunks.append(tbl)
-            chunk_rows += tbl.num_rows
-            if chunk_rows >= limit:
-                merged = self._host_group_by(chunks, merged, key_names)
-                chunks = []
-                chunk_rows = 0
-        if chunks or merged is not None:
-            merged = self._host_group_by(chunks, merged, key_names)
+        try:
+            for batch in stream:
+                tbl = self._host_keys_args_table(batch, key_names)
+                if tbl is None or tbl.num_rows == 0:
+                    continue
+                state["chunks"].append(tbl)
+                state["rows"] += tbl.num_rows
+                state["bytes"] += tbl.nbytes  # running total: O(1)/batch
+                if state["merged"] is not None:
+                    merged_bytes = state["merged"].nbytes
+                consumer.update_mem_used(state["bytes"] + merged_bytes)
+                if state["rows"] >= limit:
+                    consumer.spill()
+                    self.metrics.add("host_vectorized_merges", 1)
+            if state["chunks"] or state["merged"] is not None:
+                state["merged"] = self._host_group_by(
+                    state["chunks"], state["merged"], key_names)
+        finally:
+            consumer.unregister()
+        merged = state["merged"]
         if merged is None:
             return
         self.metrics.add("host_vectorized_batches", 1)

@@ -121,3 +121,44 @@ def test_non_kafka_connector_rejected():
     opts["connector"] = "filesystem"
     with pytest.raises(ConversionError, match="unsupported connector"):
         convert_flink_plan(plan_json)
+
+
+def test_micro_batch_runtime_operator():
+    """The FlinkAuronCalcOperator analog (VERDICT r3 #8): a converted
+    COMPILE-PLAN executes END-TO-END through protobuf TaskDefinition
+    bytes + NativeExecutionRuntime as a micro-batch loop, with kafka
+    offsets advancing across batches (checkpoint/restore state)."""
+    from blaze_tpu.convert.flink_runtime import FlinkMicroBatchOperator
+    from blaze_tpu.ops.kafka import KafkaRecord
+
+    plan_json = _compiled_plan(
+        ROWS,  # inline mock data is ignored by the runtime operator
+        projection=[_ref(0, "BIGINT"),
+                    _call("*", [_ref(1, "DOUBLE"),
+                                _lit(2.0, "DOUBLE")], "DOUBLE")],
+        condition=_call(">", [_ref(1, "DOUBLE"), _lit(8.0, "DOUBLE")]))
+    op = FlinkMicroBatchOperator(plan_json)
+
+    def recs(rows, base):
+        return [[KafkaRecord(value=json.dumps(r).encode(),
+                             offset=base + i)
+                 for i, r in enumerate(rows)]]
+
+    # micro-batch 1: two records, one passes the filter
+    out1 = op.run_micro_batch(recs(ROWS[:2], 0))
+    got1 = [tuple(r) for rb in out1
+            for r in zip(*[c.to_pylist() for c in rb.columns])]
+    assert got1 == [(1, 20.0), (2, 111.0)]
+    assert op.offsets[0] == 2
+
+    # checkpoint, then micro-batch 2
+    ckpt = op.snapshot_state()
+    out2 = op.run_micro_batch(recs(ROWS[2:], 2))
+    got2 = sorted(tuple(r) for rb in out2
+                  for r in zip(*[c.to_pylist() for c in rb.columns]))
+    assert got2 == [(4, 198.0)]  # amount 7.25 filtered out
+    assert op.offsets[0] == 4 and op.batches_run == 2
+
+    # restore rolls offsets back (at-least-once replay contract)
+    op.restore_state(ckpt)
+    assert op.offsets[0] == 2
