@@ -293,6 +293,12 @@ PLACEMENT_RTT_THRESHOLD_MS = float_conf(
     "auron.tpu.placement.rtt.threshold.ms", 5.0,
     "Auto-placement cutoff: measured per-dispatch round trip above this "
     "means the accelerator is remote/tunneled and stages run on host XLA.")
+COLUMN_PRUNING_ENABLE = bool_conf(
+    "auron.tpu.columnPruning", True,
+    "Engine-side column-pruning pass over decoded plans (the Catalyst "
+    "ColumnPruning analog, plan/column_pruning.py): scans narrow to the "
+    "columns referenced above them.  Plans from Spark arrive pruned "
+    "already; this recovers the behavior for directly-authored IR.")
 FUSED_HOST_COLLECT_ROWS = int_conf(
     "auron.tpu.fused.hostVectorized.collectRows", 1 << 21,
     "Buffered input rows before the host-vectorized agg re-merges into "

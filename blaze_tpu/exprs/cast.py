@@ -189,6 +189,45 @@ def _parse_string(arr: pa.Array, to: DataType) -> pa.Array:
     return _try_cast(arr, t)
 
 
+def _spark_to_integer(s: str, lo: int, hi: int):
+    """Spark UTF8String.toLong/toInt semantics (ref cast.rs:394
+    to_integer, itself ported from Spark): optional sign, decimal digits,
+    an optional '.' whose fractional part must be all digits (the value
+    truncates), anything else -> null.  Scientific notation is REJECTED
+    for integral casts ("1e3" -> null), unlike a double round-trip."""
+    if not s:
+        return None
+    neg = s[0] == "-"
+    i = 1 if s[0] in "+-" else 0
+    if i == 1 and len(s) == 1:
+        return None
+    if i == len(s):
+        return None
+    mag_limit = -lo if neg else hi  # asymmetric two's-complement bounds
+    result = 0
+    n = len(s)
+    saw_digit = False
+    while i < n:
+        ch = s[i]
+        i += 1
+        if ch == ".":
+            break
+        if not ("0" <= ch <= "9"):
+            return None
+        saw_digit = True
+        result = result * 10 + (ord(ch) - 48)
+        if result > mag_limit:
+            return None
+    if not saw_digit:
+        return None
+    # fractional part: verified well-formed, value ignored (truncation)
+    while i < n:
+        if not ("0" <= s[i] <= "9"):
+            return None
+        i += 1
+    return -result if neg else result
+
+
 def _string_to_integral(arr: pa.Array, to: DataType) -> pa.Array:
     lo, hi = cast_kernels._int_bounds(to.id)
     trim = config.CAST_TRIM_STRING.get()
@@ -201,17 +240,9 @@ def _string_to_integral(arr: pa.Array, to: DataType) -> pa.Array:
         if trim:
             s = s.strip()
         elif s != s.strip():
-            out.append(None)  # Decimal() tolerates padding on its own
-            continue
-        try:
-            # OverflowError: Decimal('Infinity') survives parsing but has
-            # no integral value
-            i = int(pydec.Decimal(s).to_integral_value(
-                rounding=pydec.ROUND_DOWN))
-        except (pydec.InvalidOperation, ValueError, OverflowError):
             out.append(None)
             continue
-        out.append(i if lo <= i <= hi else None)
+        out.append(_spark_to_integer(s, lo, hi))
     return pa.array(out, type=to.to_arrow())
 
 
@@ -231,23 +262,63 @@ def _try_cast(arr: pa.Array, t: pa.DataType) -> pa.Array:
     return pa.array(out, type=t)
 
 
-def _try_strptime_date(arr: pa.Array) -> pa.Array:
+def _spark_to_date(s: str):
+    """SparkDateTimeUtils.stringToDate port (ref cast.rs:471 to_date):
+    [+-]yyyy[-[m]m[-[d]d]], year 4-7 digits, month/day 1-2 digits; a
+    ' '/'T' suffix is allowed only after all three segments; otherwise
+    the whole input must be consumed."""
     import datetime
+    s = s.strip()
+    if not s:
+        return None
+
+    def valid_digits(segment: int, digits: int) -> bool:
+        return (segment == 0 and 4 <= digits <= 7) or \
+            (segment != 0 and 0 < digits <= 2)
+
+    segments = [1, 1, 1]
+    sign = 1
+    i = 0
+    cur_val = 0
+    cur_digits = 0
+    j = 0
+    if s[0] in "+-":
+        sign = -1 if s[0] == "-" else 1
+        j = 1
+    n = len(s)
+    while j < n and i < 3 and s[j] not in " T":
+        ch = s[j]
+        if i < 2 and ch == "-":
+            if not valid_digits(i, cur_digits):
+                return None
+            segments[i] = cur_val
+            cur_val = 0
+            cur_digits = 0
+            i += 1
+        else:
+            if not ("0" <= ch <= "9"):
+                return None
+            cur_val = cur_val * 10 + (ord(ch) - 48)
+            cur_digits += 1
+        j += 1
+    if not valid_digits(i, cur_digits):
+        return None
+    if i < 2 and j < n:
+        # yyyy / yyyy-[m]m forms must consume the entire input
+        return None
+    segments[i] = cur_val
+    if segments[0] > 9999 or segments[1] > 12 or segments[2] > 31:
+        return None
+    try:
+        return datetime.date(sign * segments[0], segments[1], segments[2])
+    except ValueError:
+        return None
+
+
+def _try_strptime_date(arr: pa.Array) -> pa.Array:
     out = []
     for x in arr:
-        if not x.is_valid:
-            out.append(None)
-            continue
-        s = x.as_py().strip()
-        try:
-            # Spark accepts yyyy, yyyy-mm, yyyy-mm-dd, and timestamps
-            parts = s.split("T")[0].split(" ")[0].split("-")
-            y = int(parts[0])
-            m = int(parts[1]) if len(parts) > 1 else 1
-            d = int(parts[2]) if len(parts) > 2 else 1
-            out.append(datetime.date(y, m, d))
-        except (ValueError, IndexError):
-            out.append(None)
+        out.append(_spark_to_date(x.as_py()) if x.is_valid else None)
     return pa.array(out, type=pa.date32())
 
 

@@ -326,6 +326,37 @@ class CollectAgg(AggFunction):
         return accs[0]
 
 
+class CombineUniqueAgg(CollectAgg):
+    """brickhouse.combine_unique (ref agg/brickhouse/combine_unique.rs):
+    collect_set over the FLATTENED elements of a list-typed input —
+    merges arrays across rows into one deduplicated array."""
+
+    def __init__(self, children):
+        super().__init__(children, distinct=True)
+        self.name = "combine_unique"
+
+    def acc_fields(self, s):
+        return [Field("items", self.output_type(s))]
+
+    def output_type(self, s):
+        # validated here (not acc_fields) so COMPLETE/FINAL planning,
+        # which only consults output_type, rejects non-array input at
+        # plan time instead of crashing mid-update
+        t = self.children[0].data_type(s)
+        if t.id != TypeId.LIST:
+            raise TypeError("combine_unique expects an array input")
+        return t
+
+    def host_update(self, args, gids, num_segments):
+        lists = args[0]
+        out = [[] for _ in range(num_segments)]
+        for v, g in zip(lists, gids):
+            if g < num_segments and v.is_valid:
+                out[g].extend(e for e in v.as_py() if e is not None)
+        out = [list(dict.fromkeys(x)) for x in out]
+        return [pa.array(out, type=lists.type)]
+
+
 class BloomFilterAgg(AggFunction):
     """bloom_filter_agg for runtime-filter joins (ref agg/bloom_filter.rs:312):
     global (ungrouped) Spark-compatible bloom built from int64 hashes."""
@@ -480,6 +511,8 @@ def make_agg(name: str, children: Sequence[PhysicalExpr], **kw) -> AggFunction:
         return CollectAgg(children, distinct=False)
     if name == "collect_set":
         return CollectAgg(children, distinct=True)
+    if name in ("combine_unique", "brickhouse.combine_unique"):
+        return CombineUniqueAgg(children)
     if name == "bloom_filter":
         return BloomFilterAgg(children, **kw)
     if name == "udaf":

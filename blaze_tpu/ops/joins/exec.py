@@ -752,16 +752,24 @@ class BroadcastJoinExec(BaseJoinExec):
         self._broadcast_id = broadcast_id or f"bhj-{id(self)}"
 
     def _get_join_map(self, partition: int) -> JoinMap:
+        build = 1 if self.build_side == "right" else 0
+        child = self.children[build]
+
         def factory():
-            build = 1 if self.build_side == "right" else 0
-            child = self.children[build]
             keys = self.right_keys if build == 1 else self.left_keys
             batches = []
             for p in range(child.num_partitions):
                 batches.extend(b.compact().to_arrow()
                                for b in child.execute(p))
             return build_join_map(iter(batches), child.schema, keys)
-        return get_or_create(f"join_map://{self._broadcast_id}", factory)
+        # the cache key folds the build-side output schema: plan rewrites
+        # (column pruning) may narrow the build columns per consumer, and
+        # two plans sharing one broadcast_id must not serve each other
+        # positionally-different build tables
+        sig = ",".join(f.name for f in child.schema)
+        return get_or_create(
+            f"join_map://{self._broadcast_id}/{hash(sig) & 0xffffffff:x}",
+            factory)
 
 
 class BuildHashMapExec(ExecutionPlan):

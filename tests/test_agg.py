@@ -267,3 +267,23 @@ def test_high_cardinality_string_keys_under_budget(tmp_path):
     assert len(got) == len(want)
     np.testing.assert_allclose(got["s"].to_numpy(), want.v.to_numpy(),
                                rtol=1e-9)
+
+
+def test_combine_unique_flattens_arrays():
+    """brickhouse.combine_unique: union of list elements per group
+    (ref agg/brickhouse/combine_unique.rs — collect_set over flattened
+    input arrays)."""
+    import pyarrow as pa
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops import AggExec, AggMode, MemoryScanExec
+    from blaze_tpu.ops.agg.functions import make_agg
+    t = pa.table({"g": pa.array([1, 1, 2, 2]),
+                  "a": pa.array([[1, 2], [2, 3, None], [5], None],
+                                type=pa.list_(pa.int64()))})
+    plan = AggExec(MemoryScanExec.from_arrow(t), [(col(0, "g"), "g")],
+                   [(make_agg("combine_unique", [col(1)]),
+                     AggMode.COMPLETE, "u")])
+    out = pa.Table.from_batches(
+        [b.compact().to_arrow() for b in plan.execute(0)]).to_pandas()
+    got = {int(r.g): sorted(r.u) for r in out.itertuples()}
+    assert got == {1: [1, 2, 3], 2: [5]}

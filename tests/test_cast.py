@@ -193,3 +193,82 @@ class TestReviewRegressions:
             assert got[0] is None and str(got[1]) == "1.50"
         finally:
             config.conf.unset(config.CAST_TRIM_STRING.key)
+
+
+class TestReferenceCastVectors:
+    """Bit-for-bit vectors from the reference's cast test module
+    (ref datafusion-ext-commons/src/arrow/cast.rs:532-754)."""
+
+    def _cast(self, arr, to):
+        from blaze_tpu.batch import ColumnBatch
+        from blaze_tpu.exprs import col
+        from blaze_tpu.exprs.cast import Cast
+        from blaze_tpu.schema import Schema
+        t = pa.table({"c": arr})
+        cb = ColumnBatch.from_arrow(t)
+        v = Cast(col(0), to).evaluate(cb)
+        return v.to_host(cb.num_rows)
+
+    def test_float_to_int(self):
+        # ref cast.rs:553 test_float_to_int: truncate, saturate, NaN -> 0
+        import blaze_tpu.schema as S
+        f = pa.array([None, 123.456, 987.654, 2**31 - 1 + 10000.0,
+                      -(2**31) - 10000.0, float("inf"), float("-inf"),
+                      float("nan")], type=pa.float64())
+        got = self._cast(f, S.INT32).to_pylist()
+        assert got == [None, 123, 987, 2**31 - 1, -(2**31),
+                       2**31 - 1, -(2**31), 0]
+
+    def test_string_to_bigint(self):
+        # ref cast.rs:692 test_string_to_bigint: truncation at '.',
+        # overflow -> null; plus the scientific-notation rejection the
+        # to_integer port mandates
+        import blaze_tpu.schema as S
+        arr = pa.array([None, "123", "987", "987.654",
+                        "123456789012345", "-123456789012345",
+                        "999999999999999999999999999999999",
+                        "1e3", "12.a", "+7", "-", "", "a1"])
+        got = self._cast(arr, S.INT64).to_pylist()
+        assert got == [None, 123, 987, 987, 123456789012345,
+                       -123456789012345, None, None, None, 7, None,
+                       None, None]
+
+    def test_string_to_date(self):
+        # ref cast.rs:722 test_string_to_date (Spark stringToDate rules)
+        import blaze_tpu.schema as S
+        arr = pa.array([None, "2001-02-03", "2001-03-04",
+                        "2001-04-05T06:07:08", "2001-04", "2002",
+                        "2001-00", "2001-13", "9999-99", "99999-01",
+                        "01", "2001-04extra"])
+        got = [None if v is None else str(v) for v in
+               self._cast(arr, S.DATE32).to_pylist()]
+        assert got == [None, "2001-02-03", "2001-03-04", "2001-04-05",
+                       "2001-04-01", "2002-01-01", None, None, None,
+                       None, None, None]
+
+    def test_int_to_decimal_and_back(self):
+        # ref cast.rs:605/661: int -> decimal(p,s), decimal -> plain string
+        import blaze_tpu.schema as S
+        dec = S.DataType(S.TypeId.DECIMAL, 10, 2)
+        arr = pa.array([None, 1, 23, 456], type=pa.int64())
+        d = self._cast(arr, dec)
+        assert [None if v is None else str(v) for v in d.to_pylist()] == \
+            [None, "1.00", "23.00", "456.00"]
+        s = self._cast(d, S.UTF8)
+        assert s.to_pylist() == [None, "1.00", "23.00", "456.00"]
+
+    def test_string_to_decimal_scientific(self):
+        # ref cast.rs:629 + to_plain_string: e-notation parses exactly
+        import blaze_tpu.schema as S
+        dec = S.DataType(S.TypeId.DECIMAL, 12, 3)
+        arr = pa.array(["1.5e2", "-2E1", "0.001", "bogus", None])
+        got = [None if v is None else str(v) for v in
+               self._cast(arr, dec).to_pylist()]
+        assert got == ["150.000", "-20.000", "0.001", None, None]
+
+    def test_boolean_to_string(self):
+        # ref cast.rs:541
+        import blaze_tpu.schema as S
+        arr = pa.array([None, True, False])
+        assert self._cast(arr, S.UTF8).to_pylist() == [None, "true",
+                                                       "false"]
