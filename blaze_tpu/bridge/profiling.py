@@ -61,7 +61,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def do_GET(self):
-        if self.path == "/status":
+        if self.path == "/auron":
+            from blaze_tpu.bridge import ui
+            self._send(200, json.dumps(
+                {"executions": ui.executions(),
+                 "fallback_summary": ui.fallback_summary()}))
+        elif self.path == "/auron.html":
+            from blaze_tpu.bridge import ui
+            self._send(200, ui.executions_html(), ctype="text/html")
+        elif self.path == "/status":
             self._send(200, json.dumps(engine_status()))
         elif self.path == "/metrics":
             with _lock:
@@ -88,6 +96,7 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, json.dumps({"error": "unknown path",
                                         "paths": ["/status", "/metrics",
+                                                  "/auron", "/auron.html",
                                                   "/trace/start",
                                                   "/trace/stop"]}))
 

@@ -1,0 +1,154 @@
+"""Per-query conversion/observability store — the Auron SQL tab analog.
+
+Parity: auron-spark-ui (AuronSQLTab / AuronSQLAppStatusListener /
+AuronAllExecutionsPage): the reference adds a Spark UI tab listing every
+SQL execution with which operators ran natively, which fell back, and
+WHY (the neverConvertReasonTag surfaced per node).  Here the same store
+lives in-process and is served by the profiling HTTP service
+(bridge/profiling.py) as `/auron` (JSON) and `/auron.html` (the
+AllExecutionsPage analog).
+
+Feeding it:
+  * `convert_spark_plan` records each conversion automatically
+    (converted nodes + UDF-wrapped expressions);
+  * `record_tagging(qid, tag)` accepts a convert-strategy NodeTag tree
+    so per-node fallback REASONS appear (strategy.tag_plan output);
+  * `record_completion(qid, wall_s, metrics)` attaches runtime results.
+"""
+
+from __future__ import annotations
+
+import html
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_MAX = 128
+_executions: "Dict[str, ExecutionEntry]" = {}
+_order: List[str] = []
+_qid_counter = itertools.count(1)
+
+
+@dataclass
+class ExecutionEntry:
+    query_id: str
+    started_at: float
+    converted_nodes: List[str] = field(default_factory=list)
+    fallbacks: List[Dict[str, str]] = field(default_factory=list)
+    wrapped_udfs: List[Dict[str, str]] = field(default_factory=list)
+    wall_s: Optional[float] = None
+    metrics: Optional[dict] = None
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "query_id": self.query_id,
+            "started_at": self.started_at,
+            "native_nodes": len(self.converted_nodes),
+            "converted_nodes": self.converted_nodes,
+            "fallbacks": self.fallbacks,
+            "wrapped_udfs": self.wrapped_udfs,
+            "wall_s": self.wall_s,
+            "metrics": self.metrics,
+        }
+
+
+def next_query_id() -> str:
+    return f"q-{next(_qid_counter)}"
+
+
+def _entry(query_id: str) -> ExecutionEntry:
+    e = _executions.get(query_id)
+    if e is None:
+        e = ExecutionEntry(query_id, time.time())
+        _executions[query_id] = e
+        _order.append(query_id)
+        if len(_order) > _MAX:
+            dead = _order.pop(0)
+            _executions.pop(dead, None)
+    return e
+
+
+def record_conversion(query_id: str, converted_nodes: List[str],
+                      wrapped_udfs: List[Dict[str, str]]) -> None:
+    with _lock:
+        e = _entry(query_id)
+        e.converted_nodes = list(converted_nodes)
+        e.wrapped_udfs = list(wrapped_udfs)
+
+
+def record_tagging(query_id: str, tag) -> None:
+    """Flatten a convert-strategy NodeTag tree into per-node fallback
+    reasons (the neverConvertReasonTag surface)."""
+    rows: List[Dict[str, str]] = []
+
+    def rec(t):
+        if not t.convertible:
+            rows.append({"node": t.node_class, "reason": t.reason or ""})
+        for c in t.children:
+            rec(c)
+
+    rec(tag)
+    with _lock:
+        _entry(query_id).fallbacks = rows
+
+
+def record_completion(query_id: str, wall_s: float,
+                      metrics: Optional[dict] = None) -> None:
+    with _lock:
+        e = _entry(query_id)
+        e.wall_s = round(wall_s, 4)
+        e.metrics = metrics
+
+
+def executions() -> List[Dict[str, Any]]:
+    with _lock:
+        return [_executions[q].as_dict() for q in _order]
+
+
+def fallback_summary() -> Dict[str, int]:
+    """Reason -> occurrence count across recorded executions (what the
+    reference's tab aggregates for 'why didn't this run natively')."""
+    out: Dict[str, int] = {}
+    with _lock:
+        for e in _executions.values():
+            for f in e.fallbacks:
+                key = f"{f['node']}: {f['reason']}"
+                out[key] = out.get(key, 0) + 1
+    return out
+
+
+def reset() -> None:
+    with _lock:
+        _executions.clear()
+        _order.clear()
+
+
+def executions_html() -> str:
+    """The AuronAllExecutionsPage analog: one table, newest first."""
+    rows = []
+    for e in reversed(executions()):
+        fb = "<br>".join(
+            f"{html.escape(f['node'])}: {html.escape(f['reason'])}"
+            for f in e["fallbacks"]) or "—"
+        udfs = ", ".join(html.escape(u.get("name", "?"))
+                         for u in e["wrapped_udfs"]) or "—"
+        rows.append(
+            f"<tr><td>{html.escape(e['query_id'])}</td>"
+            f"<td>{e['native_nodes']}</td>"
+            f"<td>{len(e['fallbacks'])}</td>"
+            f"<td>{fb}</td><td>{udfs}</td>"
+            f"<td>{e['wall_s'] if e['wall_s'] is not None else '—'}</td>"
+            f"</tr>")
+    return (
+        "<html><head><title>Auron Executions</title><style>"
+        "body{font-family:sans-serif}table{border-collapse:collapse}"
+        "td,th{border:1px solid #999;padding:4px 8px;"
+        "vertical-align:top}</style></head><body>"
+        "<h2>Auron SQL Executions</h2>"
+        "<table><tr><th>query</th><th>native nodes</th>"
+        "<th>fallbacks</th><th>fallback reasons</th>"
+        "<th>wrapped UDFs</th><th>wall (s)</th></tr>"
+        + "".join(rows) + "</table></body></html>")

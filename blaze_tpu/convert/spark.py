@@ -56,6 +56,9 @@ class ConversionResult:
     # host evaluator under `udf://<name>` for each BEFORE executing
     # (the SparkAuronUDFWrapperContext registration step)
     wrapped_udfs: List[Dict[str, str]] = field(default_factory=list)
+    # Auron-tab correlation handle: callers attach runtime results via
+    # ui.record_completion(query_id, wall_s, metrics)
+    query_id: str = ""
 
 
 import threading as _threading
@@ -423,8 +426,15 @@ def convert_spark_plan(plan_json, num_partitions: int = 1
     _wrap_ctx.items = []
     try:
         plan, scope = _convert_node(root, num_partitions, converted)
-        return ConversionResult(plan, scope.ids, scope.names, converted,
-                                wrapped_udfs=list(_wrap_ctx.items))
+        # feed the Auron-tab store (ref AuronSQLAppStatusListener); the
+        # returned query_id lets the runtime attach wall time/metrics
+        from blaze_tpu.bridge import ui
+        qid = ui.next_query_id()
+        result = ConversionResult(plan, scope.ids, scope.names, converted,
+                                  wrapped_udfs=list(_wrap_ctx.items),
+                                  query_id=qid)
+        ui.record_conversion(qid, converted, result.wrapped_udfs)
+        return result
     finally:
         _wrap_ctx.items = None
 

@@ -187,14 +187,15 @@ def _arith(op: str, a: ColVal, b: ColVal, out_dtype: DataType) -> ColVal:
                 r = xp.abs(x) % xp.abs(y)
                 data = xp.where(x < 0, -r, r)
         elif op == "pmod":
-            # Spark pmod: ((x % y) + y) % y, sign follows divisor magnitude
-            if is_float:
-                r = x - xp.trunc(x / y) * y
-                data = xp.where((r != 0) & ((r < 0) != (y < 0)), r + y, r)
-            else:
-                r = xp.abs(x) % xp.abs(y)
-                r = xp.where(x < 0, -r, r)
-                data = xp.where(r < 0, r + xp.abs(y), r)
+            # Spark Pmod: r = x % y (Java %: truncated, sign follows
+            # dividend); if r < 0 then (r + y) % y else r — NOT
+            # floor-mod: a non-negative remainder stays put even for a
+            # negative divisor (pmod(7,-3)=1, pmod(-7,-3)=-1).
+            # xp.fmod IS Java % for both ints and floats: it handles
+            # inf divisors (fmod(5.0, inf)=5.0) and INT64_MIN (where an
+            # abs()-based form overflows) — both corpus/review-verified.
+            r = xp.fmod(x, y)
+            data = xp.where(r < 0, xp.fmod(r + y, y), r)
         elif op == "&":
             data = x & y
         elif op == "|":

@@ -20,8 +20,23 @@ from blaze_tpu.funcs.common import host as _host, per_row as _per_row
 
 
 def _list_type(ts):
+    """make_array-style: element types in -> list<element> out."""
     item = ts[0] if ts else UTF8
     return DataType(TypeId.LIST, children=(Field("item", item),))
+
+
+def _same_list_type(ts):
+    """array-in, array-out (array_distinct/array_union): identity type.
+    Wrapping through _list_type double-nested the type and broke any
+    consumer that trusted the declared schema (corpus-caught)."""
+    return ts[0] if ts else _list_type(ts)
+
+
+def _element_type(ts):
+    t = ts[0] if ts else UTF8
+    if t.id == TypeId.LIST:
+        return t.children[0].data_type
+    return t
 
 
 @register("make_array", _list_type)
@@ -55,7 +70,7 @@ def _size(args, batch, out_type):
     return ColVal.host(INT32, pc_list_len(a).cast(pa.int32()))
 
 
-@register("array_union", _list_type)
+@register("array_union", _same_list_type)
 def _array_union(args, batch, out_type):
     a, b = _host(args, batch)
     py = []
@@ -67,7 +82,7 @@ def _array_union(args, batch, out_type):
     return ColVal.host(out_type, pa.array(py, type=a.type))
 
 
-@register("array_distinct", _list_type)
+@register("array_distinct", _same_list_type)
 def _array_distinct(args, batch, out_type):
     (a,) = _host(args, batch)
     py = [None if not x.is_valid else list(dict.fromkeys(x.as_py() or []))
@@ -75,7 +90,7 @@ def _array_distinct(args, batch, out_type):
     return ColVal.host(out_type, pa.array(py, type=a.type))
 
 
-@register("array_max")
+@register("array_max", _element_type)
 def _array_max(args, batch, out_type):
     (a,) = _host(args, batch)
     py = []
@@ -86,7 +101,7 @@ def _array_max(args, batch, out_type):
     return ColVal.host(out_type, pa.array(py, type=a.type.value_type))
 
 
-@register("array_min")
+@register("array_min", _element_type)
 def _array_min(args, batch, out_type):
     (a,) = _host(args, batch)
     py = []

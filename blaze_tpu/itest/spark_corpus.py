@@ -1,0 +1,467 @@
+"""Spark-semantics conformance corpus (the auron-spark-tests analog).
+
+Parity: the reference re-runs 14.8K LoC of Spark's own SQL suites under
+the accelerator, governed by an include/exclude DSL
+(ref auron-spark-tests/common/.../SparkTestSettings.scala:28-160:
+`enableSuite[T]`, `include`, `exclude`, `includeByPrefix`,
+`excludeAllAuronTests`).  No Spark runtime exists in this image, so the
+corpus itself is vendored: hand-written vectors whose EXPECTED values
+encode documented Spark behavior (1-based string indexing, Java division
+and modulo, HALF_UP round vs HALF_EVEN bround, concat_ws null-skipping,
+three-valued logic, NaN ordering in greatest/least, non-ANSI
+overflow-wraps and div-by-zero-null...).  Each case runs through the
+REAL engine path: IR dict -> create_plan -> execute over a memory scan.
+
+The DSL mirrors the reference's:
+
+    settings = CorpusSettings()
+    settings.enable_suite("StringFunctionsSuite") \\
+            .exclude("substring_index - negative count", reason="...")
+    results = run_corpus(settings)
+
+`exclude(..., reason=...)` entries are the declared-divergence ledger —
+exactly how the reference records cases the accelerator intentionally
+fails (SparkTestSettings exclusion comments).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+
+# ---------------------------------------------------------------------------
+# DSL (ref SparkTestSettings.scala)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SuiteSettings:
+    name: str
+    included: Optional[List[str]] = None   # None = all
+    excluded: Dict[str, str] = field(default_factory=dict)  # case -> reason
+    include_prefixes: List[str] = field(default_factory=list)
+
+    def include(self, *names: str) -> "SuiteSettings":
+        if self.included is None:
+            self.included = []
+        self.included.extend(names)
+        return self
+
+    def include_by_prefix(self, *prefixes: str) -> "SuiteSettings":
+        self.include_prefixes.extend(prefixes)
+        return self
+
+    def exclude(self, name: str, reason: str = "") -> "SuiteSettings":
+        self.excluded[name] = reason
+        return self
+
+    def selects(self, case_name: str) -> bool:
+        if case_name in self.excluded:
+            return False
+        if self.included is None and not self.include_prefixes:
+            return True
+        if self.included and case_name in self.included:
+            return True
+        return any(case_name.startswith(p) for p in self.include_prefixes)
+
+
+class CorpusSettings:
+    def __init__(self):
+        self.suites: Dict[str, SuiteSettings] = {}
+
+    def enable_suite(self, name: str) -> SuiteSettings:
+        if name not in SUITES:
+            raise KeyError(f"unknown suite {name!r}; have {sorted(SUITES)}")
+        s = SuiteSettings(name)
+        self.suites[name] = s
+        return s
+
+    def enable_all(self) -> "CorpusSettings":
+        for name in SUITES:
+            self.enable_suite(name)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# case model
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Case:
+    """One conformance vector: expression(s) over an input column set."""
+
+    name: str
+    input: pa.Table                      # input columns c0..cn
+    exprs: List[dict]                    # IR expression dicts
+    expected: List[tuple]                # rows of expected output
+    rtol: float = 0.0                    # float tolerance (0 = exact)
+
+
+def _col(i: int) -> dict:
+    return {"kind": "column", "index": i}
+
+
+def _lit(v, t="int64") -> dict:
+    return {"kind": "literal", "value": v, "type": {"id": t}}
+
+
+def _fn(name: str, *args, rt: Optional[str] = None) -> dict:
+    d = {"kind": "scalar_function", "name": name, "args": list(args)}
+    if rt:
+        d["return_type"] = {"id": rt}
+    return d
+
+
+def _bin(op, l, r) -> dict:
+    return {"kind": "binary", "op": op, "l": l, "r": r}
+
+
+SUITES: Dict[str, List[Case]] = {}
+
+
+def _suite(name: str):
+    def deco(build: Callable[[], List[Case]]):
+        SUITES[name] = build()
+        return build
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# suites
+# ---------------------------------------------------------------------------
+
+I64MAX = (1 << 63) - 1
+I64MIN = -(1 << 63)
+
+
+@_suite("ArithmeticSuite")
+def _arith():
+    ints = pa.table({"a": pa.array([7, -7, 7, -7, None, I64MAX]),
+                     "b": pa.array([3, 3, -3, -3, 3, 1])})
+    return [
+        Case("division by zero yields null (non-ANSI)",
+             pa.table({"a": pa.array([10, 0, None])}),
+             [_bin("%", _col(0), _lit(0))],
+             [(None,), (None,), (None,)]),
+        Case("java modulo sign follows dividend",
+             ints, [_bin("%", _col(0), _col(1))],
+             [(1,), (-1,), (1,), (-1,), (None,), (0,)]),
+        Case("pmod sign follows divisor",
+             ints, [_bin("pmod", _col(0), _col(1))],
+             [(1,), (2,), (1,), (-1,), (None,), (0,)]),
+        Case("int64 overflow wraps (non-ANSI two's complement)",
+             pa.table({"a": pa.array([I64MAX])}),
+             [_bin("+", _col(0), _lit(1))],
+             [(I64MIN,)]),
+        Case("float division by zero gives infinity",
+             pa.table({"a": pa.array([1.0, -1.0, 0.0])}),
+             [_bin("/", _col(0), _lit(0.0, "float64"))],
+             [(float("inf"),), (float("-inf"),), (float("nan"),)]),
+    ]
+
+
+@_suite("StringFunctionsSuite")
+def _strings():
+    s = pa.table({"s": pa.array(["Spark SQL", "", None, "abcdef"])})
+    return [
+        Case("substring is 1-based",
+             s, [_fn("substring", _col(0), _lit(1), _lit(5), rt="utf8")],
+             [("Spark",), ("",), (None,), ("abcde",)]),
+        Case("substring negative start counts from end",
+             s, [_fn("substring", _col(0), _lit(-3), _lit(3), rt="utf8")],
+             [("SQL",), ("",), (None,), ("def",)]),
+        Case("instr is 1-based, 0 when absent",
+             s, [_fn("instr", _col(0), _lit("SQL", "utf8"), rt="int32")],
+             [(7,), (0,), (None,), (0,)]),
+        Case("concat null poisons",
+             s, [_fn("concat", _col(0), _lit("!", "utf8"), rt="utf8")],
+             [("Spark SQL!",), ("!",), (None,), ("abcdef!",)]),
+        Case("concat_ws skips nulls",
+             pa.table({"a": pa.array(["x", None]),
+                       "b": pa.array(["y", "z"])}),
+             [_fn("concat_ws", _lit(",", "utf8"), _col(0), _col(1),
+                  rt="utf8")],
+             [("x,y",), ("z",)]),
+        Case("lpad truncates when longer than target",
+             pa.table({"s": pa.array(["abcd"])}),
+             [_fn("lpad", _col(0), _lit(2), _lit("#", "utf8"),
+                  rt="utf8")],
+             [("ab",)]),
+        Case("initcap capitalizes each word",
+             pa.table({"s": pa.array(["sPark sql"])}),
+             [_fn("initcap", _col(0), rt="utf8")],
+             [("Spark Sql",)]),
+        Case("substring_index positive and sign",
+             pa.table({"s": pa.array(["www.apache.org"] * 2),
+                       "n": pa.array([2, -2])}),
+             [_fn("substring_index", _col(0), _lit(".", "utf8"), _col(1),
+                  rt="utf8")],
+             [("www.apache",), ("apache.org",)]),
+        Case("translate maps and drops",
+             pa.table({"s": pa.array(["AaBbCc"])}),
+             [_fn("translate", _col(0), _lit("abc", "utf8"),
+                  _lit("12", "utf8"), rt="utf8")],
+             [("A1B2C",)]),
+        Case("repeat and reverse",
+             pa.table({"s": pa.array(["ab"])}),
+             [_fn("repeat", _col(0), _lit(3), rt="utf8"),
+              _fn("reverse", _col(0), rt="utf8")],
+             [("ababab", "ba")]),
+        Case("length counts characters not bytes",
+             pa.table({"s": pa.array(["héllo"])}),
+             [_fn("length", _col(0), rt="int32")],
+             [(5,)]),
+        Case("ascii and chr",
+             pa.table({"s": pa.array(["A"]), "n": pa.array([66])}),
+             [_fn("ascii", _col(0), rt="int32"),
+              _fn("chr", _col(1), rt="utf8")],
+             [(65, "B")]),
+    ]
+
+
+@_suite("MathSuite")
+def _math():
+    return [
+        Case("round is HALF_UP away from zero",
+             pa.table({"a": pa.array([2.5, 3.5, -2.5, 0.35])}),
+             [_fn("round", _col(0), rt="float64"),
+              _fn("round", _col(0), _lit(1), rt="float64")],
+             [(3.0, 2.5), (4.0, 3.5), (-3.0, -2.5), (0.0, 0.4)],
+             rtol=1e-9),
+        Case("bround is HALF_EVEN",
+             pa.table({"a": pa.array([2.5, 3.5, -2.5])}),
+             [_fn("bround", _col(0), rt="float64")],
+             [(2.0,), (4.0,), (-2.0,)]),
+        Case("signum and abs",
+             pa.table({"a": pa.array([-5.0, 0.0, 7.5])}),
+             [_fn("signum", _col(0), rt="float64"),
+              _fn("abs", _col(0), rt="float64")],
+             [(-1.0, 5.0), (0.0, 0.0), (1.0, 7.5)]),
+        Case("greatest skips nulls, NaN is largest",
+             pa.table({"a": pa.array([1.0, None, float("nan")]),
+                       "b": pa.array([2.0, 3.0, 2.0])}),
+             [_fn("greatest", _col(0), _col(1), rt="float64")],
+             [(2.0,), (3.0,), (float("nan"),)]),
+        Case("least skips nulls",
+             pa.table({"a": pa.array([1.0, None]),
+                       "b": pa.array([2.0, 3.0])}),
+             [_fn("least", _col(0), _col(1), rt="float64")],
+             [(1.0,), (3.0,)]),
+        Case("nanvl replaces NaN only",
+             pa.table({"a": pa.array([float("nan"), 1.0]),
+                       "b": pa.array([9.0, 9.0])}),
+             [_fn("nanvl", _col(0), _col(1), rt="float64")],
+             [(9.0,), (1.0,)]),
+    ]
+
+
+@_suite("ConditionalSuite")
+def _cond():
+    return [
+        Case("three-valued AND",
+             pa.table({"a": pa.array([True, True, False, None]),
+                       "b": pa.array([None, True, None, None])}),
+             [_bin("and", _col(0), _col(1))],
+             [(None,), (True,), (False,), (None,)]),
+        Case("three-valued OR",
+             pa.table({"a": pa.array([True, False, None]),
+                       "b": pa.array([None, None, None])}),
+             [_bin("or", _col(0), _col(1))],
+             [(True,), (None,), (None,)]),
+        Case("in-list with null member is never FALSE",
+             pa.table({"a": pa.array([1, 2, None])}),
+             [{"kind": "in_list", "child": _col(0),
+               "values": [1, None], "type": {"id": "int64"}}],
+             [(True,), (None,), (None,)]),
+        Case("coalesce picks first non-null",
+             pa.table({"a": pa.array([None, 1], type=pa.int64()),
+                       "b": pa.array([2, 3], type=pa.int64())}),
+             [{"kind": "coalesce", "args": [_col(0), _col(1)]}],
+             [(2,), (1,)]),
+        Case("null-safe equal",
+             pa.table({"a": pa.array([1, None, None]),
+                       "b": pa.array([1, None, 2])}),
+             [_bin("<=>", _col(0), _col(1))],
+             [(True,), (True,), (False,)]),
+        Case("case with no match and no else is null",
+             pa.table({"a": pa.array([1, 5])}),
+             [{"kind": "case",
+               "branches": [[_bin("==", _col(0), _lit(1)), _lit(10)]]}],
+             [(10,), (None,)]),
+    ]
+
+
+@_suite("DateTimeSuite")
+def _dates():
+    import datetime as dt
+    d = pa.table({"d": pa.array([dt.date(2001, 2, 28),
+                                 dt.date(2000, 1, 31)])})
+    return [
+        Case("date_add / date_sub",
+             d, [_fn("date_add", _col(0), _lit(1), rt="date32"),
+                 _fn("date_sub", _col(0), _lit(28), rt="date32")],
+             [(dt.date(2001, 3, 1), dt.date(2001, 1, 31)),
+              (dt.date(2000, 2, 1), dt.date(2000, 1, 3))]),
+        Case("add_months clamps to month end",
+             d, [_fn("add_months", _col(0), _lit(1), rt="date32")],
+             [(dt.date(2001, 3, 28),), (dt.date(2000, 2, 29),)]),
+        Case("last_day",
+             d, [_fn("last_day", _col(0), rt="date32")],
+             [(dt.date(2001, 2, 28),), (dt.date(2000, 1, 31),)]),
+        Case("year month day dayofweek",
+             d, [_fn("year", _col(0), rt="int32"),
+                 _fn("month", _col(0), rt="int32"),
+                 _fn("dayofweek", _col(0), rt="int32")],
+             [(2001, 2, 4), (2000, 1, 2)]),  # dayofweek: 1=Sunday
+        Case("datediff is signed",
+             pa.table({"a": pa.array([dt.date(2001, 1, 10)]),
+                       "b": pa.array([dt.date(2001, 1, 1)])}),
+             [_fn("datediff", _col(0), _col(1), rt="int32")],
+             [(9,)]),
+        Case("months_between 31-day fraction",
+             pa.table({"a": pa.array([dt.date(2001, 3, 31)]),
+                       "b": pa.array([dt.date(2001, 2, 28)])}),
+             [_fn("months_between", _col(0), _col(1), rt="float64")],
+             [(1.0,)]),
+    ]
+
+
+@_suite("HashSuite")
+def _hash():
+    # Spark-generated vectors (seed 42): hash(1L)= -7723843922299065623?
+    # — authoritative int vectors already live in tests/test_hashing.py;
+    # here the corpus pins the EXPRESSION surface (int32 output, null
+    # handling: null input leaves the seed untouched)
+    return [
+        Case("murmur3 null input keeps seed",
+             pa.table({"a": pa.array([None], type=pa.int64())}),
+             [_fn("murmur3_hash", _col(0), rt="int32")],
+             [(42,)]),
+        Case("crc32 of utf8 bytes",
+             pa.table({"s": pa.array(["ABC"])}),
+             [_fn("crc32", _col(0), rt="int64")],
+             [(2743272264,)]),
+        Case("md5 hex",
+             pa.table({"s": pa.array(["abc"])}),
+             [_fn("md5", _col(0), rt="utf8")],
+             [("900150983cd24fb0d6963f7d28e17f72",)]),
+        Case("sha2-256 hex",
+             pa.table({"s": pa.array(["abc"])}),
+             [_fn("sha2", _col(0), _lit(256), rt="utf8")],
+             [("ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff"
+               "61f20015ad",)]),
+    ]
+
+
+@_suite("CollectionSuite")
+def _coll():
+    lst = pa.table({"a": pa.array([[1, 2, 2, None], [], None],
+                                  type=pa.list_(pa.int64()))})
+    return [
+        Case("size of null is -1 (legacy spark.sql.legacy.sizeOfNull)",
+             lst, [_fn("size", _col(0), rt="int32")],
+             [(4,), (0,), (-1,)]),
+        Case("array_distinct keeps order",
+             pa.table({"a": pa.array([[3, 1, 3, 2]],
+                                     type=pa.list_(pa.int64()))}),
+             [_fn("array_distinct", _col(0))],
+             [([3, 1, 2],)]),
+        Case("array_contains null semantics",
+             lst, [_fn("array_contains", _col(0), _lit(2), rt="bool")],
+             [(True,), (False,), (None,)]),
+        Case("element_at is 1-based",
+             pa.table({"a": pa.array([[10, 20]],
+                                     type=pa.list_(pa.int64()))}),
+             [_fn("element_at", _col(0), _lit(2), rt="int64")],
+             [(20,)]),
+        Case("str_to_map default delimiters",
+             pa.table({"s": pa.array(["a:1,b:2"])}),
+             [_fn("map_keys", _fn("str_to_map", _col(0)))],
+             [(["a", "b"],)]),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# runner (ref SparkQueryTestsBase: run case, compare, report)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CaseResult:
+    suite: str
+    case: str
+    passed: bool
+    detail: str = ""
+
+
+def _values_equal(got, want, rtol: float) -> bool:
+    if want is None or got is None:
+        return got is None and want is None
+    if isinstance(want, float):
+        if math.isnan(want):
+            return isinstance(got, float) and math.isnan(got)
+        if rtol:
+            return got == want or abs(got - want) <= rtol * abs(want)
+        return float(got) == want
+    return got == want
+
+
+def run_case(suite: str, case: Case) -> CaseResult:
+    from blaze_tpu.bridge.resource import put_resource
+    from blaze_tpu.plan import create_plan
+    from blaze_tpu.plan.types import schema_to_dict
+    from blaze_tpu.schema import Schema
+
+    rid = f"corpus://{suite}/{case.name}"
+    put_resource(rid, case.input)
+    ir = {"kind": "project",
+          "exprs": case.exprs,
+          "names": [f"o{i}" for i in range(len(case.exprs))],
+          "input": {"kind": "memory_scan", "resource_id": rid,
+                    "schema": schema_to_dict(
+                        Schema.from_arrow(case.input.schema)),
+                    "num_partitions": 1}}
+    try:
+        plan = create_plan(ir)
+        batches = [b.compact().to_arrow() for b in plan.execute(0)]
+        tbl = (pa.Table.from_batches(batches) if batches
+               else pa.Table.from_batches(
+                   [], schema=pa.schema(
+                       [(f"o{i}", pa.null())
+                        for i in range(len(case.exprs))])))
+        got = [tuple(r) for r in zip(*[c.to_pylist()
+                                       for c in tbl.columns])] \
+            if tbl.num_rows else []
+    except Exception as e:  # noqa: BLE001 — recorded, like a test failure
+        return CaseResult(suite, case.name, False, f"raised {e!r}")
+    if len(got) != len(case.expected):
+        return CaseResult(suite, case.name, False,
+                          f"rows {len(got)} != {len(case.expected)}")
+    for i, (g, w) in enumerate(zip(got, case.expected)):
+        if len(g) != len(w):
+            return CaseResult(suite, case.name, False,
+                              f"row {i}: arity {len(g)} != {len(w)}")
+        for j, (gv, wv) in enumerate(zip(g, w)):
+            if not _values_equal(gv, wv, case.rtol):
+                return CaseResult(
+                    suite, case.name, False,
+                    f"row {i} col {j}: got {gv!r}, want {wv!r}")
+    return CaseResult(suite, case.name, True)
+
+
+def run_corpus(settings: CorpusSettings) -> List[CaseResult]:
+    out: List[CaseResult] = []
+    for sname, ss in settings.suites.items():
+        for case in SUITES[sname]:
+            if ss.selects(case.name):
+                out.append(run_case(sname, case))
+    return out
+
+
+def default_settings() -> CorpusSettings:
+    """The checked-in settings: every suite enabled; exclusions document
+    declared divergences (the SparkTestSettings exclusion-ledger analog).
+    An empty ledger means full conformance on the vendored corpus."""
+    return CorpusSettings().enable_all()

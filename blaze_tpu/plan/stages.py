@@ -309,6 +309,12 @@ def execute_spark_plan_json(plan_json, num_partitions: int = 2,
     """Front door: Spark `toJSON` physical plan -> converter -> stage DAG
     -> protobuf tasks -> engine.  The full L6->wire->L3 production path in
     one call (ref: what AuronConverters + Spark's scheduler do together)."""
+    import time as _time
+
+    from blaze_tpu.bridge import ui
     from blaze_tpu.convert.spark import convert_spark_plan
     res = convert_spark_plan(plan_json, num_partitions=num_partitions)
-    return DagScheduler(work_dir=work_dir).run_collect(res.plan)
+    t0 = _time.perf_counter()
+    out = DagScheduler(work_dir=work_dir).run_collect(res.plan)
+    ui.record_completion(res.query_id, _time.perf_counter() - t0)
+    return out

@@ -13,6 +13,8 @@ import pandas as pd
 import pyarrow as pa
 import pytest
 
+pytestmark = pytest.mark.dist  # deselect with -m 'not dist'
+
 WORKER = r"""
 import json, os, sys
 # The axon TPU plugin ignores the JAX_PLATFORMS env var (see conftest.py);

@@ -12,6 +12,8 @@ import pandas as pd
 import pyarrow as pa
 import pytest
 
+pytestmark = pytest.mark.slow  # deselect with -m 'not slow'
+
 from blaze_tpu.exprs import col
 from blaze_tpu.memory import MemManager
 from blaze_tpu.ops import (AggExec, AggMode, MemoryScanExec, SortExec,

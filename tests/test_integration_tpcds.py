@@ -14,6 +14,8 @@ import os
 
 import pytest
 
+pytestmark = pytest.mark.slow  # deselect with -m 'not slow'
+
 from blaze_tpu.itest import check_plan_stability, generate, run_query
 from blaze_tpu.itest.queries import QUERIES
 from blaze_tpu.itest.tpcds_data import write_parquet_splits
