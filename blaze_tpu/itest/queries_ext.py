@@ -1003,13 +1003,15 @@ def q65(paths, tables, partitions: int = 2):
     return plan, oracle
 
 
-def q73(paths, tables, partitions: int = 2):
-    """Tickets with 1-5 items bought by high-dependency households
-    (count by ticket, HAVING range — the q73/q79 shape)."""
+def _ticket_counts(paths, tables, partitions, hd_preds, hd_oracle,
+                   lo, hi):
+    """q73/q34/q79 family: per-(ticket, customer) item counts for a
+    household-demographics selection, HAVING count BETWEEN lo AND hi,
+    joined back to customer."""
     ss, hd, cu = (tables["store_sales"],
                   tables["household_demographics"], tables["customer"])
     hd_f = filter_(scan(paths, tables, "household_demographics"),
-                   binop(">", c("hd_dep_count"), lit(6, "int32")))
+                   *hd_preds)
     j_hd = join("broadcast_join", scan(paths, tables, "store_sales"),
                 hd_f, [c("ss_hdemo_sk")], [c("hd_demo_sk")])
     cnt = _partial_final(
@@ -1017,8 +1019,8 @@ def q73(paths, tables, partitions: int = 2):
         [(c("ss_ticket_number"), "ticket"),
          (c("ss_customer_sk"), "customer_sk")],
         [("count", "cnt", [c("ss_item_sk")])], partitions)
-    flt = filter_(cnt, binop("and", binop(">=", ci(2), lit(1)),
-                             binop("<=", ci(2), lit(5))))
+    flt = filter_(cnt, binop("and", binop(">=", ci(2), lit(lo)),
+                             binop("<=", ci(2), lit(hi))))
     j_cu = join("hash_join", exchange(flt, [ci(1)], partitions),
                 exchange(scan(paths, tables, "customer"),
                          [c("c_customer_sk")], partitions),
@@ -1032,11 +1034,11 @@ def q73(paths, tables, partitions: int = 2):
     def oracle():
         ssd, hdd = ss.to_pandas(), hd.to_pandas()
         cud = cu.to_pandas()
-        m = ssd.merge(hdd[hdd.hd_dep_count > 6],
-                      left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        m = ssd.merge(hd_oracle(hdd), left_on="ss_hdemo_sk",
+                      right_on="hd_demo_sk")
         g = m.groupby(["ss_ticket_number", "ss_customer_sk"],
                       as_index=False).agg(cnt=("ss_item_sk", "count"))
-        g = g[(g.cnt >= 1) & (g.cnt <= 5)]
+        g = g[(g.cnt >= lo) & (g.cnt <= hi)]
         g = g.merge(cud, left_on="ss_customer_sk",
                     right_on="c_customer_sk")
         out = g[["c_customer_id", "ss_ticket_number", "cnt"]].rename(
@@ -1046,6 +1048,15 @@ def q73(paths, tables, partitions: int = 2):
         return out.reset_index(drop=True)
 
     return plan, oracle
+
+
+def q73(paths, tables, partitions: int = 2):
+    """Tickets by high-dependency households (q73 shape)."""
+    return _ticket_counts(
+        paths, tables, partitions,
+        [binop(">", c("hd_dep_count"), lit(6, "int32"))],
+        lambda hdd: hdd[hdd.hd_dep_count > 6], 1, 5)
+
 
 
 def q93(paths, tables, partitions: int = 2):
@@ -1338,4 +1349,354 @@ QUERIES.update({
     "q96": (q96, ["store_sales", "time_dim",
                   "household_demographics", "store"]),
     "q97": (q97, ["store_sales", "catalog_sales"]),
+})
+
+
+# ---------------------------------------------------------------------------
+# third batch: window lag (q47/q57), hd-count tickets (q34/q68/q79),
+# time buckets (q88), catalog anti/semi (q94-shape) and ship-latency (q99)
+# ---------------------------------------------------------------------------
+
+def _lag_over_monthly(paths, tables, partitions, fact, date_col, item_col,
+                      price_col):
+    """The q47/q57 shape: monthly brand revenue with LAG/LEAD over the
+    (brand, year) window ordered by month."""
+    ft, it, dd = tables[fact], tables["item"], tables["date_dim"]
+
+    dd_f = filter_(scan(paths, tables, "date_dim"),
+                   binop("==", c("d_year"), lit(1999, "int32")))
+    j_dd = join("broadcast_join", scan(paths, tables, fact), dd_f,
+                [c(date_col)], [c("d_date_sk")])
+    j_it = join("broadcast_join", j_dd, scan(paths, tables, "item"),
+                [c(item_col)], [c("i_item_sk")])
+    rev = _partial_final(
+        j_it,
+        [(c("i_brand_id"), "brand_id"), (c("d_moy"), "moy")],
+        [("sum", "sum_sales", [c(price_col)])], partitions)
+    ex = exchange(rev, [ci(0)], 1)
+    srt = {"kind": "sort", "input": ex,
+           "specs": [{"expr": ci(0), "descending": False,
+                      "nulls_first": True},
+                     {"expr": ci(1), "descending": False,
+                      "nulls_first": True}]}
+    win = {"kind": "window", "input": srt,
+           "functions": [
+               {"kind": "lag", "name": "psum", "offset": 1,
+                "expr": ci(2)},
+               {"kind": "lead", "name": "nsum", "offset": 1,
+                "expr": ci(2)}],
+           "partition_by": [ci(0)],
+           "order_by": [{"expr": ci(1), "descending": False,
+                         "nulls_first": True}]}
+    plan = sort_limit(win, [(ci(0), False), (ci(1), False)], 100)
+
+    def oracle():
+        fd, itd, ddd = ft.to_pandas(), it.to_pandas(), dd.to_pandas()
+        m = fd.merge(ddd[ddd.d_year == 1999], left_on=date_col,
+                     right_on="d_date_sk")
+        m = m.merge(itd, left_on=item_col, right_on="i_item_sk")
+        g = (m.groupby(["i_brand_id", "d_moy"], as_index=False)
+             .agg(sum_sales=(price_col, "sum"))
+             .rename(columns={"i_brand_id": "brand_id", "d_moy": "moy"}))
+        g = g.sort_values(["brand_id", "moy"]).reset_index(drop=True)
+        g["psum"] = g.groupby("brand_id").sum_sales.shift(1)
+        g["nsum"] = g.groupby("brand_id").sum_sales.shift(-1)
+        return g.sort_values(["brand_id", "moy"])[:100] \
+            .reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q47(paths, tables, partitions: int = 2):
+    return _lag_over_monthly(paths, tables, partitions, "store_sales",
+                             "ss_sold_date_sk", "ss_item_sk",
+                             "ss_sales_price")
+
+
+def q57(paths, tables, partitions: int = 2):
+    return _lag_over_monthly(paths, tables, partitions, "catalog_sales",
+                             "cs_sold_date_sk", "cs_item_sk",
+                             "cs_sales_price")
+
+
+def q34(paths, tables, partitions: int = 2):
+    """q34 shape: ticket counts for buy-potential households with a
+    vehicle (distinct hd selection from q73).  NOTE the synthetic
+    generator makes ss_ticket_number unique per row, so the HAVING lower
+    bound is 1 (a >=2 bound would select nothing and test only the
+    empty path — review-caught)."""
+    return _ticket_counts(
+        paths, tables, partitions,
+        [binop("or",
+               binop("==", c("hd_buy_potential"), lit(">10000", "utf8")),
+               binop("==", c("hd_buy_potential"),
+                     lit("Unknown", "utf8"))),
+         binop(">", c("hd_vehicle_count"), lit(0, "int32"))],
+        lambda hdd: hdd[(hdd.hd_buy_potential.isin([">10000",
+                                                    "Unknown"])) &
+                        (hdd.hd_vehicle_count > 0)], 1, 20)
+
+
+
+def q68(paths, tables, partitions: int = 2):
+    """q46's sibling: start-of-month (d_dom <= 2) city sales with
+    extended amounts by ticket — the real q68 pairs this day-of-month
+    filter with demographic predicates."""
+    ss, dd, st = (tables["store_sales"], tables["date_dim"],
+                  tables["store"])
+    hd, ca = (tables["household_demographics"],
+              tables["customer_address"])
+    dd_f = filter_(scan(paths, tables, "date_dim"),
+                   binop("<=", c("d_dom"), lit(2, "int32")))
+    j_dd = join("broadcast_join", scan(paths, tables, "store_sales"),
+                dd_f, [c("ss_sold_date_sk")], [c("d_date_sk")])
+    j_st = join("broadcast_join", j_dd, scan(paths, tables, "store"),
+                [c("ss_store_sk")], [c("s_store_sk")])
+    hd_f = filter_(scan(paths, tables, "household_demographics"),
+                   binop("or",
+                         binop("==", c("hd_dep_count"), lit(3, "int32")),
+                         binop("==", c("hd_vehicle_count"),
+                               lit(4, "int32"))))
+    j_hd = join("broadcast_join", j_st, hd_f,
+                [c("ss_hdemo_sk")], [c("hd_demo_sk")])
+    j_ca = join("hash_join",
+                exchange(j_hd, [c("ss_addr_sk")], partitions),
+                exchange(scan(paths, tables, "customer_address"),
+                         [c("ca_address_sk")], partitions),
+                [c("ss_addr_sk")], [c("ca_address_sk")])
+    out_agg = _partial_final(
+        j_ca,
+        [(c("ca_city"), "ca_city"),
+         (c("ss_ticket_number"), "ss_ticket_number")],
+        [("sum", "ext_price", [c("ss_ext_sales_price")]),
+         ("sum", "list_price", [c("ss_list_price")])], partitions)
+    single = exchange(out_agg, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(0), False), (ci(1), False)], 100)
+
+    def oracle():
+        ssd, ddd, std = ss.to_pandas(), dd.to_pandas(), st.to_pandas()
+        hdd, cad = hd.to_pandas(), ca.to_pandas()
+        m = ssd.merge(ddd[ddd.d_dom <= 2], left_on="ss_sold_date_sk",
+                      right_on="d_date_sk")
+        m = m.merge(std, left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(hdd[(hdd.hd_dep_count == 3) |
+                        (hdd.hd_vehicle_count == 4)],
+                    left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        m = m.merge(cad, left_on="ss_addr_sk", right_on="ca_address_sk")
+        out = m.groupby(["ca_city", "ss_ticket_number"],
+                        as_index=False).agg(
+            ext_price=("ss_ext_sales_price", "sum"),
+            list_price=("ss_list_price", "sum"))
+        out = out.sort_values(["ca_city", "ss_ticket_number"])[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q79(paths, tables, partitions: int = 2):
+    """Per-ticket profit for high-dep or no-vehicle households (q79)."""
+    ss, hd, st, cu = (tables["store_sales"],
+                      tables["household_demographics"],
+                      tables["store"], tables["customer"])
+    hd_f = filter_(scan(paths, tables, "household_demographics"),
+                   binop("or",
+                         binop("==", c("hd_dep_count"), lit(6, "int32")),
+                         binop(">", c("hd_vehicle_count"),
+                               lit(2, "int32"))))
+    j_hd = join("broadcast_join", scan(paths, tables, "store_sales"),
+                hd_f, [c("ss_hdemo_sk")], [c("hd_demo_sk")])
+    j_st = join("broadcast_join", j_hd, scan(paths, tables, "store"),
+                [c("ss_store_sk")], [c("s_store_sk")])
+    g = _partial_final(
+        j_st,
+        [(c("ss_ticket_number"), "ticket"),
+         (c("ss_customer_sk"), "customer_sk"),
+         (c("s_store_name"), "s_store_name")],
+        [("sum", "amt", [c("ss_coupon_amt")]),
+         ("sum", "profit", [c("ss_net_profit")])], partitions)
+    j_cu = join("hash_join", exchange(g, [ci(1)], partitions),
+                exchange(scan(paths, tables, "customer"),
+                         [c("c_customer_sk")], partitions),
+                [ci(1)], [c("c_customer_sk")])
+    picked = project(j_cu, [c("c_customer_id"), ci(0), ci(2), ci(3),
+                            ci(4)],
+                     ["c_customer_id", "ticket", "s_store_name", "amt",
+                      "profit"])
+    single = exchange(picked, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(0), False), (ci(1), False)], 100)
+
+    def oracle():
+        ssd, hdd = ss.to_pandas(), hd.to_pandas()
+        std, cud = st.to_pandas(), cu.to_pandas()
+        m = ssd.merge(hdd[(hdd.hd_dep_count == 6) |
+                          (hdd.hd_vehicle_count > 2)],
+                      left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        m = m.merge(std, left_on="ss_store_sk", right_on="s_store_sk")
+        g = m.groupby(["ss_ticket_number", "ss_customer_sk",
+                       "s_store_name"], as_index=False).agg(
+            amt=("ss_coupon_amt", "sum"),
+            profit=("ss_net_profit", "sum"))
+        g = g.merge(cud, left_on="ss_customer_sk",
+                    right_on="c_customer_sk")
+        out = g[["c_customer_id", "ss_ticket_number", "s_store_name",
+                 "amt", "profit"]].rename(
+            columns={"ss_ticket_number": "ticket"})
+        out = out.sort_values(["c_customer_id", "ticket"])[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q88(paths, tables, partitions: int = 2):
+    """Eight half-hour traffic counts unioned (the q88 time-bucket
+    shape over time_dim + household_demographics)."""
+    ss, td, hd = (tables["store_sales"], tables["time_dim"],
+                  tables["household_demographics"])
+    hd_f = filter_(scan(paths, tables, "household_demographics"),
+                   binop("<=", c("hd_dep_count"), lit(5, "int32")))
+    legs = []
+    buckets = [(8, 0, 30), (8, 30, 60), (9, 0, 30), (9, 30, 60),
+               (10, 0, 30), (10, 30, 60), (11, 0, 30), (11, 30, 60)]
+    for i, (hour, mlo, mhi) in enumerate(buckets):
+        td_f = filter_(scan(paths, tables, "time_dim"),
+                       binop("==", c("t_hour"), lit(hour, "int32")),
+                       binop(">=", c("t_minute"), lit(mlo, "int32")),
+                       binop("<", c("t_minute"), lit(mhi, "int32")))
+        j_td = join("broadcast_join", scan(paths, tables, "store_sales"),
+                    td_f, [c("ss_sold_time_sk")], [c("t_time_sk")])
+        j_hd = join("broadcast_join", j_td, hd_f,
+                    [c("ss_hdemo_sk")], [c("hd_demo_sk")])
+        leg = _global_agg(j_hd, [("count", "cnt",
+                                  [c("ss_ticket_number")])])
+        legs.append(project(leg, [lit(i), ci(0)], ["bucket", "cnt"]))
+    u = {"kind": "union", "inputs": legs}
+    plan = sort_limit(u, [(ci(0), False)], 10)
+
+    def oracle():
+        ssd, tdd, hdd = ss.to_pandas(), td.to_pandas(), hd.to_pandas()
+        hsel = hdd[hdd.hd_dep_count <= 5]
+        rows = []
+        for i, (hour, mlo, mhi) in enumerate(buckets):
+            t = tdd[(tdd.t_hour == hour) & (tdd.t_minute >= mlo) &
+                    (tdd.t_minute < mhi)]
+            m = ssd.merge(t, left_on="ss_sold_time_sk",
+                          right_on="t_time_sk")
+            m = m.merge(hsel, left_on="ss_hdemo_sk",
+                        right_on="hd_demo_sk")
+            rows.append({"bucket": i, "cnt": len(m)})
+        return pd.DataFrame(rows)
+
+    return plan, oracle
+
+
+def q94(paths, tables, partitions: int = 2):
+    """Catalog orders shipped cross-warehouse with no return: q94 is the
+    catalog twin of q95 (EXISTS different-warehouse + NOT EXISTS
+    return)."""
+    cs, cr = tables["catalog_sales"], tables["catalog_returns"]
+
+    base = project(filter_(scan(paths, tables, "catalog_sales"),
+                           binop("<=", c("cs_call_center_sk"), lit(3))),
+                   [c("cs_order_number"), c("cs_warehouse_sk"),
+                    c("cs_ext_sales_price"), c("cs_net_profit")],
+                   ["order_number", "warehouse_sk", "price", "profit"])
+    base_ex = exchange(base, [ci(0)], partitions)
+    all_cs = project(scan(paths, tables, "catalog_sales"),
+                     [c("cs_order_number"), c("cs_warehouse_sk")],
+                     ["o2", "w2"])
+    all_ex = exchange(all_cs, [ci(0)], partitions)
+    semi = join("hash_join", base_ex, all_ex, [ci(0)], [ci(0)],
+                jt="left_semi", flt=binop("!=", ci(1), ci(5)))
+    cr_ex = exchange(project(scan(paths, tables, "catalog_returns"),
+                             [c("cr_order_number")], ["cr_order_number"]),
+                     [ci(0)], partitions)
+    anti = join("hash_join", semi, cr_ex, [ci(0)], [ci(0)],
+                jt="left_anti")
+    per_order = agg(
+        agg(anti, [(ci(0), "order_number")],
+            [("sum", "partial", "price", [ci(2)]),
+             ("sum", "partial", "profit", [ci(3)])]),
+        [(ci(0), "order_number")],
+        [("sum", "final", "price", [ci(1)]),
+         ("sum", "final", "profit", [ci(2)])])
+    single = exchange(per_order, [ci(0)], 1)
+    plan = _global_agg(single,
+                       [("count", "order_count", [ci(0)]),
+                        ("sum", "total_price", [ci(1)]),
+                        ("sum", "total_profit", [ci(2)])])
+
+    def oracle():
+        csd, crd = cs.to_pandas(), cr.to_pandas()
+        f = csd[csd.cs_call_center_sk <= 3]
+        wh = csd.groupby("cs_order_number").cs_warehouse_sk.agg(set)
+        ok = f[f.apply(lambda r: bool(
+            wh.get(r.cs_order_number, set()) - {r.cs_warehouse_sk}),
+            axis=1)] if len(f) else f
+        ok = ok[~ok.cs_order_number.isin(set(crd.cr_order_number))]
+        return pd.DataFrame({
+            "order_count": [ok.cs_order_number.nunique()],
+            "total_price": [ok.cs_ext_sales_price.sum() if len(ok)
+                            else None],
+            "total_profit": [ok.cs_net_profit.sum() if len(ok)
+                             else None]})
+
+    return plan, oracle
+
+
+def q99(paths, tables, partitions: int = 2):
+    """Catalog ship-latency buckets by warehouse (the q99 case-when
+    pivot over cs_ship_date - cs_sold_date)."""
+    cs, wh = tables["catalog_sales"], tables["warehouse"]
+    j_wh = join("broadcast_join", scan(paths, tables, "catalog_sales"),
+                scan(paths, tables, "warehouse"),
+                [c("cs_warehouse_sk")], [c("w_warehouse_sk")])
+    diff = binop("-", c("cs_ship_date_sk"), c("cs_sold_date_sk"))
+    bucket = lambda lo, hi: _case(
+        [(binop("and", binop(">", diff, lit(lo)),
+                binop("<=", diff, lit(hi))), lit(1))], lit(0))
+    proj = project(
+        j_wh,
+        [c("w_warehouse_name"),
+         _case([(binop("<=", diff, lit(30)), lit(1))], lit(0)),
+         bucket(30, 60), bucket(60, 90), bucket(90, 120),
+         _case([(binop(">", diff, lit(120)), lit(1))], lit(0))],
+        ["w_warehouse_name", "d30", "d60", "d90", "d120", "dmore"])
+    out_agg = _partial_final(
+        proj, [(ci(0), "w_warehouse_name")],
+        [("sum", n, [ci(i + 1)]) for i, n in
+         enumerate(["d30", "d60", "d90", "d120", "dmore"])], partitions)
+    single = exchange(out_agg, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(0), False)], 100)
+
+    def oracle():
+        m = cs.to_pandas().merge(wh.to_pandas(),
+                                 left_on="cs_warehouse_sk",
+                                 right_on="w_warehouse_sk")
+        d = m.cs_ship_date_sk - m.cs_sold_date_sk
+        m = m.assign(
+            d30=(d <= 30).astype(int),
+            d60=((d > 30) & (d <= 60)).astype(int),
+            d90=((d > 60) & (d <= 90)).astype(int),
+            d120=((d > 90) & (d <= 120)).astype(int),
+            dmore=(d > 120).astype(int))
+        out = m.groupby("w_warehouse_name", as_index=False)[
+            ["d30", "d60", "d90", "d120", "dmore"]].sum()
+        return out.sort_values("w_warehouse_name")[:100] \
+            .reset_index(drop=True)
+
+    return plan, oracle
+
+
+QUERIES.update({
+    "q34": (q34, ["store_sales", "household_demographics", "customer"]),
+    "q47": (q47, ["store_sales", "item", "date_dim"]),
+    "q57": (q57, ["catalog_sales", "item", "date_dim"]),
+    "q68": (q68, ["store_sales", "date_dim", "store",
+                  "household_demographics", "customer_address"]),
+    "q79": (q79, ["store_sales", "household_demographics", "store",
+                  "customer"]),
+    "q88": (q88, ["store_sales", "time_dim",
+                  "household_demographics"]),
+    "q94": (q94, ["catalog_sales", "catalog_returns"]),
+    "q99": (q99, ["catalog_sales", "warehouse"]),
 })

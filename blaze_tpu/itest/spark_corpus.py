@@ -384,6 +384,83 @@ def _coll():
     ]
 
 
+@_suite("RegexpLikeSuite")
+def _regexp():
+    s = pa.table({"s": pa.array(["Spark", "park", None, "SPARK"])})
+    return [
+        Case("LIKE percent and underscore",
+             s, [{"kind": "like", "child": _col(0), "pattern": "%par_"}],
+             # '%' matches empty or any prefix: both "Spark" and "park"
+             # satisfy %par_ ; SPARK fails case-sensitively
+             [(True,), (True,), (None,), (False,)]),
+        Case("LIKE is case sensitive",
+             s, [{"kind": "like", "child": _col(0), "pattern": "spark"}],
+             [(False,), (False,), (None,), (False,)]),
+        Case("RLIKE finds substring matches",
+             s, [{"kind": "rlike", "child": _col(0),
+                  "pattern": "ar(k|t)"}],
+             [(True,), (True,), (None,), (False,)]),
+        Case("regexp_replace all occurrences",
+             pa.table({"s": pa.array(["a1b2c3"])}),
+             [_fn("regexp_replace", _col(0), _lit("[0-9]", "utf8"),
+                  _lit("#", "utf8"), rt="utf8")],
+             [("a#b#c#",)]),
+        Case("regexp_extract group and no-match empty",
+             pa.table({"s": pa.array(["100-200", "foo"])}),
+             [_fn("regexp_extract", _col(0),
+                  _lit(r"(\d+)-(\d+)", "utf8"), _lit(2), rt="utf8")],
+             [("200",), ("",)]),
+        Case("split drops nothing by default",
+             pa.table({"s": pa.array(["a,b,,c"])}),
+             [_fn("split", _col(0), _lit(",", "utf8"))],
+             [(["a", "b", "", "c"],)]),
+    ]
+
+
+@_suite("JsonSuite")
+def _json():
+    j = pa.table({"j": pa.array(
+        ['{"a": 1, "b": {"c": "x"}, "d": [5, 6]}', "not json", None])})
+    return [
+        Case("get_json_object dotted path",
+             j, [_fn("get_json_object", _col(0), _lit("$.b.c", "utf8"),
+                     rt="utf8")],
+             [("x",), (None,), (None,)]),
+        Case("get_json_object array index",
+             j, [_fn("get_json_object", _col(0), _lit("$.d[1]", "utf8"),
+                     rt="utf8")],
+             [("6",), (None,), (None,)]),
+        Case("get_json_object missing key is null",
+             j, [_fn("get_json_object", _col(0), _lit("$.zz", "utf8"),
+                     rt="utf8")],
+             [(None,), (None,), (None,)]),
+    ]
+
+
+@_suite("DecimalSuite")
+def _decimal():
+    dec = {"id": "decimal", "precision": 10, "scale": 2}
+    return [
+        Case("cast int to decimal renders full scale",
+             pa.table({"a": pa.array([7, None])}),
+             [{"kind": "cast",
+               "child": {"kind": "cast", "child": _col(0), "type": dec},
+               "type": {"id": "utf8"}}],
+             [("7.00",), (None,)]),
+        Case("decimal overflow to null (non-ANSI)",
+             pa.table({"a": pa.array([10 ** 12])}),
+             [{"kind": "cast", "child": _col(0),
+               "type": {"id": "decimal", "precision": 5, "scale": 2}}],
+             [(None,)]),
+        Case("string to decimal HALF_UP at scale",
+             pa.table({"s": pa.array(["1.005", "-1.005"])}),
+             [{"kind": "cast",
+               "child": {"kind": "cast", "child": _col(0), "type": dec},
+               "type": {"id": "utf8"}}],
+             [("1.01",), ("-1.01",)]),
+    ]
+
+
 # ---------------------------------------------------------------------------
 # runner (ref SparkQueryTestsBase: run case, compare, report)
 # ---------------------------------------------------------------------------

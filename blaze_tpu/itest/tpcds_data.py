@@ -145,9 +145,9 @@ def gen_catalog_sales(scale: float, seed: int = 17) -> pa.Table:
     n = _rows("catalog_sales", scale)
     rng = np.random.default_rng(seed)
     date_n = min(_rows("date_dim", scale), SALES_DATE_DAYS)
+    sold = rng.integers(2450815, 2450815 + date_n, n)
     return pa.table({
-        "cs_sold_date_sk": pa.array(
-            rng.integers(2450815, 2450815 + date_n, n)),
+        "cs_sold_date_sk": pa.array(sold),
         "cs_bill_customer_sk": pa.array(
             rng.integers(1, _rows("customer", scale) + 1, n)),
         "cs_bill_cdemo_sk": pa.array(
@@ -160,6 +160,26 @@ def gen_catalog_sales(scale: float, seed: int = 17) -> pa.Table:
         "cs_net_profit": pa.array(np.round(rng.random(n) * 100 - 20, 2)),
         "cs_promo_sk": pa.array(rng.integers(1, 301, n)),
         "cs_ext_sales_price": pa.array(np.round(rng.random(n) * 280, 2)),
+        "cs_ship_date_sk": pa.array(
+            sold + rng.integers(1, 150, n)),  # latency 1-149 days: every
+        #                                       q99 bucket gets real rows
+        "cs_warehouse_sk": pa.array(
+            rng.integers(1, _rows("warehouse", scale) + 1, n)),
+        "cs_order_number": pa.array(rng.integers(1, max(1, n // 2) + 1,
+                                                 n)),
+        "cs_ship_mode_sk": pa.array(rng.integers(1, 21, n)),
+        "cs_call_center_sk": pa.array(rng.integers(1, 7, n)),
+    })
+
+
+def gen_catalog_returns(scale: float, seed: int = 28) -> pa.Table:
+    n = max(1, int(144_067 * scale))
+    rng = np.random.default_rng(seed)
+    cs_n = _rows("catalog_sales", scale)
+    return pa.table({
+        "cr_order_number": pa.array(
+            rng.integers(1, max(1, cs_n // 2) + 1, n)),
+        "cr_return_amount": pa.array(np.round(rng.random(n) * 90, 2)),
     })
 
 
@@ -282,6 +302,17 @@ def gen_web_clickstreams(scale: float, seed: int = 23) -> pa.Table:
     })
 
 
+def gen_warehouse(scale: float, seed: int = 27) -> pa.Table:
+    n = _rows("warehouse", scale)
+    return pa.table({
+        "w_warehouse_sk": pa.array(np.arange(1, n + 1)),
+        "w_warehouse_name": pa.array([f"warehouse_{i}"
+                                      for i in range(1, n + 1)]),
+        "w_state": pa.array(np.array(["TN", "CA", "NY", "TX", "WA"])
+                            [np.arange(n) % 5]),
+    })
+
+
 def gen_household_demographics(scale: float, seed: int = 24) -> pa.Table:
     n = _rows("household_demographics", scale)
     rng = np.random.default_rng(seed)
@@ -315,6 +346,7 @@ def gen_reason(scale: float, seed: int = 26) -> pa.Table:
 
 
 GENERATORS = {
+    "warehouse": gen_warehouse,
     "household_demographics": gen_household_demographics,
     "time_dim": gen_time_dim,
     "reason": gen_reason,
@@ -324,6 +356,7 @@ GENERATORS = {
     "store_returns": gen_store_returns,
     "store_sales": gen_store_sales,
     "catalog_sales": gen_catalog_sales,
+    "catalog_returns": gen_catalog_returns,
     "web_sales": gen_web_sales,
     "web_returns": gen_web_returns,
     "customer_demographics": gen_customer_demographics,
