@@ -430,6 +430,16 @@ class FusedPartialAggExec(ExecutionPlan):
         # re-merge threshold bounds memory by distinct groups instead of
         # input rows
         limit = config.FUSED_HOST_COLLECT_ROWS.get()
+        # partial-agg skipping (the AGG_TRIGGER_PARTIAL_SKIPPING analog,
+        # ref agg_table.rs:108-122): a PARTIAL aggregation whose observed
+        # cardinality ratio is too high stops aggregating and passes raw
+        # rows through in acc form — the final stage re-merges
+        can_skip = (not self._complete and not self._grow and
+                    config.PARTIAL_AGG_SKIPPING_ENABLE.get())
+        skip_ratio = config.PARTIAL_AGG_SKIPPING_RATIO.get()
+        skip_min = config.PARTIAL_AGG_SKIPPING_MIN_ROWS.get()
+        rows_seen = 0
+        skipping = False
         merged_bytes = 0
         stream = self._host_scan_stream(partition)
         if stream is None:
@@ -439,15 +449,35 @@ class FusedPartialAggExec(ExecutionPlan):
                 tbl = self._host_keys_args_table(batch, key_names)
                 if tbl is None or tbl.num_rows == 0:
                     continue
+                if skipping:
+                    yield from self._host_passthrough(tbl, key_names)
+                    continue
+                rows_seen += tbl.num_rows
                 state["chunks"].append(tbl)
                 state["rows"] += tbl.num_rows
                 state["bytes"] += tbl.nbytes  # running total: O(1)/batch
                 if state["merged"] is not None:
                     merged_bytes = state["merged"].nbytes
                 consumer.update_mem_used(state["bytes"] + merged_bytes)
-                if state["rows"] >= limit:
+                # the skip decision checkpoints at minRows-sized strides
+                # (not only the much larger collect limit), so the
+                # protection engages on partitions far below collectRows
+                check_skip = (can_skip and not skipping and
+                              rows_seen >= skip_min)
+                if state["rows"] >= limit or check_skip:
                     consumer.spill()
                     self.metrics.add("host_vectorized_merges", 1)
+                    m = state["merged"]
+                    if (check_skip and m is not None and
+                            m.num_rows / max(1, rows_seen) > skip_ratio):
+                        skipping = True
+                        self.metrics.add("partial_skipped", 1)
+                        yield from self._emit_host(m, key_names)
+                        state["merged"] = None
+                        consumer.update_mem_used(0)
+                    elif check_skip:
+                        # ratio low: aggregation pays off — stop probing
+                        can_skip = False
             if state["chunks"] or state["merged"] is not None:
                 state["merged"] = self._host_group_by(
                     state["chunks"], state["merged"], key_names)
@@ -457,12 +487,49 @@ class FusedPartialAggExec(ExecutionPlan):
         if merged is None:
             return
         self.metrics.add("host_vectorized_batches", 1)
-        out = self._host_finalize(merged, key_names)
+        yield from self._emit_host(merged, key_names)
+
+    def _emit_host(self, merged, key_names) -> BatchIterator:
+        yield from self._emit_batches(self._host_finalize(merged,
+                                                          key_names))
+
+    def _emit_batches(self, rb) -> BatchIterator:
         bs = config.BATCH_SIZE.get()
-        for off in range(0, out.num_rows, bs):
-            chunk = out.slice(off, min(bs, out.num_rows - off))
+        for off in range(0, rb.num_rows, bs):
+            chunk = rb.slice(off, min(bs, rb.num_rows - off))
             self.metrics.add("output_rows", chunk.num_rows)
             yield ColumnBatch.from_arrow(chunk)
+
+    def _host_passthrough(self, tbl, key_names) -> BatchIterator:
+        """One raw keys/args table emitted in PARTIAL-output (acc) form
+        without grouping: sum acc = the value, count acc = 1 per valid
+        row (1 per row for count(*)), min/max acc = the value.  The
+        downstream FINAL aggregation re-merges (partial skipping)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        out_arrow = self._out_schema.to_arrow()
+        arrays = []
+        for i, f in enumerate(out_arrow):
+            if i < len(key_names):
+                col = tbl.column(i)
+            else:
+                spec_i = i - len(key_names)
+                rk, _ok, arg = self._specs[spec_i]
+                src = tbl.column(len(key_names) + spec_i)
+                if rk == "count":
+                    col = (pa.array(np.ones(tbl.num_rows,
+                                            dtype=np.int64))
+                           if arg is None else
+                           pc.if_else(pc.is_valid(src), 1, 0))
+                else:
+                    col = src
+            if isinstance(col, pa.ChunkedArray):
+                col = col.combine_chunks()
+            if not col.type.equals(f.type):
+                col = col.cast(f.type, safe=False)
+            arrays.append(col)
+        rb = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
+        yield from self._emit_batches(rb)
 
     def _host_scan_stream(self, partition: int):
         """Push the absorbed filter chain into an Arrow dataset scanner

@@ -347,3 +347,49 @@ class TestHostVectorized:
         # NaN keys group together (Spark NormalizeFloatingNumbers)
         out = self._run(plan)
         assert out.num_rows == 2
+
+
+class TestHostPartialSkipping:
+    def test_high_cardinality_partial_skips_and_final_fixes_it(self):
+        """Host-vectorized PARTIAL agg over near-unique keys must degrade
+        to pass-through (AGG_TRIGGER_PARTIAL_SKIPPING analog) while the
+        FINAL stage still produces exact results."""
+        import numpy as np
+        n = 4000
+        rng = np.random.default_rng(3)
+        t = pa.table({"k": pa.array(np.arange(n)),  # all-distinct keys
+                      "v": pa.array(rng.random(n))})
+        config.conf.set(config.FUSED_HOST_COLLECT_ROWS.key, 512)
+        config.conf.set(config.PARTIAL_AGG_SKIPPING_MIN_ROWS.key, 256)
+        try:
+            partial = fuse_plan(AggExec(
+                MemoryScanExec.from_arrow(t, batch_rows=256),
+                [(col(0, "k"), "k")],
+                [(make_agg("sum", [col(1)]), AggMode.PARTIAL, "s"),
+                 (make_agg("count", [col(1)]), AggMode.PARTIAL, "c")]))
+            assert isinstance(partial, FusedPartialAggExec)
+            ex = LocalShuffleExchange(partial,
+                                      HashPartitioning([col(0)], 2))
+            final = AggExec(ex, [(col(0, "k"), "k")],
+                            [(make_agg("sum", [col(1)]), AggMode.FINAL,
+                              "s"),
+                             (make_agg("count", [col(2)]), AggMode.FINAL,
+                              "c")])
+            out = []
+            for p in range(2):
+                out.extend(b.compact().to_arrow()
+                           for b in final.execute(p))
+            got = pa.Table.from_batches(
+                [b for b in out if b.num_rows]).to_pandas() \
+                .sort_values("k").reset_index(drop=True)
+            assert int(partial.metrics.get("partial_skipped") or 0) >= 1
+        finally:
+            config.conf.unset(config.FUSED_HOST_COLLECT_ROWS.key)
+            config.conf.unset(config.PARTIAL_AGG_SKIPPING_MIN_ROWS.key)
+        want = t.to_pandas().groupby("k", as_index=False).agg(
+            s=("v", "sum"), c=("v", "count")).sort_values("k") \
+            .reset_index(drop=True)
+        assert len(got) == len(want)
+        np.testing.assert_allclose(got.s.to_numpy(), want.s.to_numpy(),
+                                   rtol=1e-9)
+        assert (got.c.to_numpy() == want.c.to_numpy()).all()
