@@ -1700,3 +1700,463 @@ QUERIES.update({
     "q94": (q94, ["catalog_sales", "catalog_returns"]),
     "q99": (q99, ["catalog_sales", "warehouse"]),
 })
+
+
+# ---------------------------------------------------------------------------
+# fourth batch: year-over-year self joins (q04/q11/q31), weekly self join
+# (q59), web ship buckets (q62), warehouse month pivot (q66), rank over
+# state rollup (q70), windowed deviation (q89), above-average web (q92)
+# ---------------------------------------------------------------------------
+
+def _yearly_customer_totals(paths, tables, partitions, fact, cust_col,
+                            date_col, price_col, year):
+    f = join("broadcast_join", scan(paths, tables, fact),
+             filter_(scan(paths, tables, "date_dim"),
+                     binop("==", c("d_year"), lit(year, "int32"))),
+             [c(date_col)], [c("d_date_sk")])
+    return _partial_final(f, [(c(cust_col), "customer_sk")],
+                          [("sum", "total", [c(price_col)])], partitions)
+
+
+def _yoy_growth(paths, tables, partitions, fact, cust_col, date_col,
+                price_col):
+    """The q04/q11 skeleton: customers whose year-2 spend grew vs year 1
+    in THIS channel (the real queries compare growth across channels;
+    the self-join-on-customer shape is identical)."""
+    cu = tables["customer"]
+    y1 = _yearly_customer_totals(paths, tables, partitions, fact,
+                                 cust_col, date_col, price_col, 1999)
+    y2 = _yearly_customer_totals(paths, tables, partitions, fact,
+                                 cust_col, date_col, price_col, 2000)
+    j = join("hash_join", exchange(y1, [ci(0)], partitions),
+             exchange(y2, [ci(0)], partitions), [ci(0)], [ci(0)])
+    grown = filter_(j, binop(">", ci(3), ci(1)))
+    j_cu = join("hash_join", exchange(grown, [ci(0)], partitions),
+                exchange(scan(paths, tables, "customer"),
+                         [c("c_customer_sk")], partitions),
+                [ci(0)], [c("c_customer_sk")])
+    picked = project(j_cu, [c("c_customer_id"), ci(1), ci(3)],
+                     ["c_customer_id", "year1_total", "year2_total"])
+    single = exchange(picked, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(0), False)], 100)
+
+    ft, dd = tables[fact], tables["date_dim"]
+
+    def oracle():
+        fd, ddd, cud = ft.to_pandas(), dd.to_pandas(), cu.to_pandas()
+
+        def year_tot(y):
+            m = fd.merge(ddd[ddd.d_year == y], left_on=date_col,
+                         right_on="d_date_sk")
+            return (m.groupby(cust_col, as_index=False)
+                    .agg(total=(price_col, "sum")))
+
+        a = year_tot(1999).rename(columns={"total": "year1_total"})
+        b = year_tot(2000).rename(columns={"total": "year2_total"})
+        m = a.merge(b, on=cust_col)
+        m = m[m.year2_total > m.year1_total]
+        m = m.merge(cud, left_on=cust_col, right_on="c_customer_sk")
+        out = m[["c_customer_id", "year1_total", "year2_total"]] \
+            .sort_values("c_customer_id")[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q04(paths, tables, partitions: int = 2):
+    return _yoy_growth(paths, tables, partitions, "catalog_sales",
+                       "cs_bill_customer_sk", "cs_sold_date_sk",
+                       "cs_sales_price")
+
+
+def q11(paths, tables, partitions: int = 2):
+    return _yoy_growth(paths, tables, partitions, "web_sales",
+                       "ws_bill_customer_sk", "ws_sold_date_sk",
+                       "ws_ext_sales_price")
+
+
+def q31(paths, tables, partitions: int = 2):
+    """County quarter-over-quarter growth: ss by (county, quarter) self-
+    joined across q1->q2, compared against the same web growth."""
+    ss, ws = tables["store_sales"], tables["web_sales"]
+    ca, dd, cu = (tables["customer_address"], tables["date_dim"],
+                  tables["customer"])
+
+    def county_q(fact, cust_col, date_col, price_col, qoy, name):
+        f = join("broadcast_join", scan(paths, tables, fact),
+                 filter_(scan(paths, tables, "date_dim"),
+                         binop("==", c("d_year"), lit(2000, "int32")),
+                         binop("==", c("d_qoy"), lit(qoy, "int32"))),
+                 [c(date_col)], [c("d_date_sk")])
+        j_cu = join("hash_join", exchange(f, [c(cust_col)], partitions),
+                    exchange(scan(paths, tables, "customer"),
+                             [c("c_customer_sk")], partitions),
+                    [c(cust_col)], [c("c_customer_sk")])
+        j_ca = join("broadcast_join", j_cu,
+                    scan(paths, tables, "customer_address"),
+                    [c("c_current_addr_sk")], [c("ca_address_sk")])
+        return _partial_final(j_ca, [(c("ca_county"), "county")],
+                              [("sum", name, [c(price_col)])],
+                              partitions)
+
+    ss1 = county_q("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                   "ss_ext_sales_price", 1, "ss1")
+    ss2 = county_q("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                   "ss_ext_sales_price", 2, "ss2")
+    ws1 = county_q("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                   "ws_ext_sales_price", 1, "ws1")
+    ws2 = county_q("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                   "ws_ext_sales_price", 2, "ws2")
+    j = join("sort_merge_join", exchange(ss1, [ci(0)], partitions),
+             exchange(ss2, [ci(0)], partitions), [ci(0)], [ci(0)])
+    j = join("sort_merge_join", j,
+             exchange(ws1, [ci(0)], partitions), [ci(0)], [ci(0)])
+    j = join("sort_merge_join", j,
+             exchange(ws2, [ci(0)], partitions), [ci(0)], [ci(0)])
+    # web growth > store growth  <=>  ws2/ws1 > ss2/ss1, cross-
+    # multiplied (all sums positive): ws2*ss1 > ss2*ws1
+    grown = filter_(j, binop(">", binop("*", ci(7), ci(1)),
+                             binop("*", ci(3), ci(5))))
+    picked = project(grown, [ci(0), ci(1), ci(3), ci(5), ci(7)],
+                     ["county", "ss1", "ss2", "ws1", "ws2"])
+    single = exchange(picked, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(0), False)], 100)
+
+    def oracle():
+        ssd, wsd = ss.to_pandas(), ws.to_pandas()
+        cad, ddd, cud = (ca.to_pandas(), dd.to_pandas(),
+                         cu.to_pandas())
+
+        def cq(fd, cust_col, date_col, price_col, qoy):
+            m = fd.merge(ddd[(ddd.d_year == 2000) & (ddd.d_qoy == qoy)],
+                         left_on=date_col, right_on="d_date_sk")
+            m = m.merge(cud, left_on=cust_col, right_on="c_customer_sk")
+            m = m.merge(cad, left_on="c_current_addr_sk",
+                        right_on="ca_address_sk")
+            return (m.groupby("ca_county", as_index=False)
+                    .agg(v=(price_col, "sum"))
+                    .rename(columns={"ca_county": "county"}))
+
+        s1 = cq(ssd, "ss_customer_sk", "ss_sold_date_sk",
+                "ss_ext_sales_price", 1).rename(columns={"v": "ss1"})
+        s2 = cq(ssd, "ss_customer_sk", "ss_sold_date_sk",
+                "ss_ext_sales_price", 2).rename(columns={"v": "ss2"})
+        w1 = cq(wsd, "ws_bill_customer_sk", "ws_sold_date_sk",
+                "ws_ext_sales_price", 1).rename(columns={"v": "ws1"})
+        w2 = cq(wsd, "ws_bill_customer_sk", "ws_sold_date_sk",
+                "ws_ext_sales_price", 2).rename(columns={"v": "ws2"})
+        m = s1.merge(s2, on="county").merge(w1, on="county") \
+            .merge(w2, on="county")
+        m = m[m.ws2 * m.ss1 > m.ss2 * m.ws1]
+        out = m.sort_values("county")[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q59(paths, tables, partitions: int = 2):
+    """Weekly store revenue: this-year vs next-year same-week self join
+    (the q59 d_week_seq shape)."""
+    ss, dd, st = (tables["store_sales"], tables["date_dim"],
+                  tables["store"])
+
+    def weekly(year):
+        f = join("broadcast_join", scan(paths, tables, "store_sales"),
+                 filter_(scan(paths, tables, "date_dim"),
+                         binop("==", c("d_year"), lit(year, "int32"))),
+                 [c("ss_sold_date_sk")], [c("d_date_sk")])
+        j_st = join("broadcast_join", f, scan(paths, tables, "store"),
+                    [c("ss_store_sk")], [c("s_store_sk")])
+        # week-of-year aligns weeks ACROSS years (d_week_seq is global)
+        wk = binop("%", c("d_week_seq"), lit(53))
+        p = project(j_st, [c("s_store_name"), wk,
+                           c("ss_ext_sales_price")],
+                    ["store_name", "wk", "price"])
+        return _partial_final(
+            p, [(ci(0), "store_name"), (ci(1), "wk")],
+            [("sum", "sales", [ci(2)])], partitions)
+
+    a = weekly(1999)
+    b = weekly(2000)
+    j = join("sort_merge_join",
+             exchange(a, [ci(0), ci(1)], partitions),
+             exchange(b, [ci(0), ci(1)], partitions),
+             [ci(0), ci(1)], [ci(0), ci(1)])
+    picked = project(j, [ci(0), ci(1), ci(2), ci(5)],
+                     ["store_name", "wk", "sales_y1", "sales_y2"])
+    single = exchange(picked, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(0), False), (ci(1), False)], 100)
+
+    def oracle():
+        ssd, ddd, std = ss.to_pandas(), dd.to_pandas(), st.to_pandas()
+
+        def wkly(year):
+            m = ssd.merge(ddd[ddd.d_year == year],
+                          left_on="ss_sold_date_sk",
+                          right_on="d_date_sk")
+            m = m.merge(std, left_on="ss_store_sk",
+                        right_on="s_store_sk")
+            m["wk"] = m.d_week_seq % 53
+            return (m.groupby(["s_store_name", "wk"], as_index=False)
+                    .agg(sales=("ss_ext_sales_price", "sum"))
+                    .rename(columns={"s_store_name": "store_name"}))
+
+        m = wkly(1999).merge(wkly(2000), on=["store_name", "wk"],
+                             suffixes=("_y1", "_y2"))
+        out = m.rename(columns={"sales_y1": "sales_y1",
+                                "sales_y2": "sales_y2"})
+        out = out.sort_values(["store_name", "wk"])[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q62(paths, tables, partitions: int = 2):
+    """Web ship-latency buckets by site (q62 shape; q99's web twin over
+    ws_ship_date - ws_sold_date grouped by web site)."""
+    ws = tables["web_sales"]
+    diff = binop("-", c("ws_ship_date_sk"), c("ws_sold_date_sk"))
+    bucket = lambda lo, hi: _case(
+        [(binop("and", binop(">", diff, lit(lo)),
+                binop("<=", diff, lit(hi))), lit(1))], lit(0))
+    proj = project(
+        scan(paths, tables, "web_sales"),
+        [c("ws_web_site_sk"),
+         _case([(binop("<=", diff, lit(30)), lit(1))], lit(0)),
+         bucket(30, 60), bucket(60, 90), bucket(90, 120),
+         _case([(binop(">", diff, lit(120)), lit(1))], lit(0))],
+        ["web_site_sk", "d30", "d60", "d90", "d120", "dmore"])
+    out_agg = _partial_final(
+        proj, [(ci(0), "web_site_sk")],
+        [("sum", n, [ci(i + 1)]) for i, n in
+         enumerate(["d30", "d60", "d90", "d120", "dmore"])], partitions)
+    single = exchange(out_agg, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(0), False)], 100)
+
+    def oracle():
+        m = ws.to_pandas()
+        d = m.ws_ship_date_sk - m.ws_sold_date_sk
+        m = m.assign(
+            d30=(d <= 30).astype(int),
+            d60=((d > 30) & (d <= 60)).astype(int),
+            d90=((d > 60) & (d <= 90)).astype(int),
+            d120=((d > 90) & (d <= 120)).astype(int),
+            dmore=(d > 120).astype(int))
+        out = m.groupby("ws_web_site_sk", as_index=False)[
+            ["d30", "d60", "d90", "d120", "dmore"]].sum() \
+            .rename(columns={"ws_web_site_sk": "web_site_sk"})
+        return out.sort_values("web_site_sk")[:100] \
+            .reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q66(paths, tables, partitions: int = 2):
+    """Warehouse monthly sales pivot (q66 shape: 12 case-when month sums
+    by warehouse over web sales)."""
+    ws, wh, dd = (tables["web_sales"], tables["warehouse"],
+                  tables["date_dim"])
+    j_dd = join("broadcast_join", scan(paths, tables, "web_sales"),
+                filter_(scan(paths, tables, "date_dim"),
+                        binop("==", c("d_year"), lit(1999, "int32"))),
+                [c("ws_sold_date_sk")], [c("d_date_sk")])
+    j_wh = join("broadcast_join", j_dd, scan(paths, tables, "warehouse"),
+                [c("ws_warehouse_sk")], [c("w_warehouse_sk")])
+    month_exprs = [
+        _case([(binop("==", c("d_moy"), lit(m, "int32")),
+                c("ws_ext_sales_price"))], lit(0.0, "float64"))
+        for m in range(1, 13)]
+    names = [f"m{m:02d}_sales" for m in range(1, 13)]
+    proj = project(j_wh, [c("w_warehouse_name")] + month_exprs,
+                   ["w_warehouse_name"] + names)
+    out_agg = _partial_final(
+        proj, [(ci(0), "w_warehouse_name")],
+        [("sum", n, [ci(i + 1)]) for i, n in enumerate(names)],
+        partitions)
+    single = exchange(out_agg, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(0), False)], 100)
+
+    def oracle():
+        m = ws.to_pandas().merge(
+            dd.to_pandas().query("d_year == 1999"),
+            left_on="ws_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(wh.to_pandas(), left_on="ws_warehouse_sk",
+                    right_on="w_warehouse_sk")
+        for mo in range(1, 13):
+            m[f"m{mo:02d}_sales"] = m.ws_ext_sales_price.where(
+                m.d_moy == mo, 0.0)
+        out = m.groupby("w_warehouse_name", as_index=False)[
+            [f"m{mo:02d}_sales" for mo in range(1, 13)]].sum()
+        return out.sort_values("w_warehouse_name")[:100] \
+            .reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q70(paths, tables, partitions: int = 2):
+    """State/county profit rollup + rank() within state (q70 shape)."""
+    ss, st, dd = (tables["store_sales"], tables["store"],
+                  tables["date_dim"])
+    j_dd = join("broadcast_join", scan(paths, tables, "store_sales"),
+                filter_(scan(paths, tables, "date_dim"),
+                        binop("==", c("d_year"), lit(2000, "int32"))),
+                [c("ss_sold_date_sk")], [c("d_date_sk")])
+    j_st = join("broadcast_join", j_dd, scan(paths, tables, "store"),
+                [c("ss_store_sk")], [c("s_store_sk")])
+    nul = {"kind": "literal", "value": None, "type": {"id": "utf8"}}
+    projections = []
+    for kept, gid in ((2, 0), (1, 1), (0, 3)):
+        projections.append(
+            [c("s_state") if kept >= 1 else nul,
+             c("s_store_name") if kept >= 2 else nul,
+             lit(gid), c("ss_net_profit")])
+    expanded = {"kind": "expand", "input": j_st,
+                "projections": projections,
+                "names": ["s_state", "s_store_name", "g_id",
+                          "ss_net_profit"]}
+    rolled = _partial_final(
+        expanded,
+        [(ci(0), "s_state"), (ci(1), "s_store_name"), (ci(2), "g_id")],
+        [("sum", "total_profit", [ci(3)])], partitions)
+    ex = exchange(rolled, [ci(0)], 1)
+    srt = {"kind": "sort", "input": ex,
+           "specs": [{"expr": ci(0), "descending": False,
+                      "nulls_first": True},
+                     {"expr": ci(3), "descending": True,
+                      "nulls_first": False}]}
+    win = {"kind": "window", "input": srt,
+           "functions": [{"kind": "rank", "name": "rk"}],
+           "partition_by": [ci(0)],
+           "order_by": [{"expr": ci(3), "descending": True,
+                         "nulls_first": False}]}
+    flt = filter_(win, binop("<=", ci(4), lit(5)))
+    plan = sort_limit(flt, [(ci(0), False), (ci(4), False)], 100)
+
+    def oracle():
+        m = ss.to_pandas().merge(
+            dd.to_pandas().query("d_year == 2000"),
+            left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(st.to_pandas(), left_on="ss_store_sk",
+                    right_on="s_store_sk")
+        frames = []
+        for kept, gid in ((2, 0), (1, 1), (0, 3)):
+            keys = ["s_state", "s_store_name"][:kept] if kept else []
+            if keys:
+                g = m.groupby(keys, as_index=False, dropna=False).agg(
+                    total_profit=("ss_net_profit", "sum"))
+            else:
+                g = pd.DataFrame(
+                    {"total_profit": [m.ss_net_profit.sum()]})
+            for cn in ["s_state", "s_store_name"][kept:]:
+                g[cn] = None
+            g["g_id"] = gid
+            frames.append(g[["s_state", "s_store_name", "g_id",
+                             "total_profit"]])
+        allf = pd.concat(frames, ignore_index=True)
+        allf["rk"] = (allf.sort_values("total_profit", ascending=False)
+                      .groupby("s_state", dropna=False)
+                      .total_profit.rank(method="min", ascending=False))
+        allf = allf[allf.rk <= 5]
+        out = allf.sort_values(["s_state", "rk"])[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q89(paths, tables, partitions: int = 2):
+    """Monthly class revenue vs the class's yearly average: window AVG
+    partition + deviation filter (q89 shape)."""
+    ss, it, dd = (tables["store_sales"], tables["item"],
+                  tables["date_dim"])
+    j_dd = join("broadcast_join", scan(paths, tables, "store_sales"),
+                filter_(scan(paths, tables, "date_dim"),
+                        binop("==", c("d_year"), lit(1999, "int32"))),
+                [c("ss_sold_date_sk")], [c("d_date_sk")])
+    j_it = join("broadcast_join", j_dd, scan(paths, tables, "item"),
+                [c("ss_item_sk")], [c("i_item_sk")])
+    rev = _partial_final(
+        j_it,
+        [(c("i_category"), "i_category"), (c("i_class"), "i_class"),
+         (c("d_moy"), "d_moy")],
+        [("sum", "sum_sales", [c("ss_sales_price")])], partitions)
+    ex = exchange(rev, [ci(0)], 1)
+    srt = {"kind": "sort", "input": ex,
+           "specs": [{"expr": ci(0), "descending": False,
+                      "nulls_first": True},
+                     {"expr": ci(1), "descending": False,
+                      "nulls_first": True},
+                     {"expr": ci(2), "descending": False,
+                      "nulls_first": True}]}
+    win = {"kind": "window", "input": srt,
+           "functions": [{"kind": "agg", "fn": "avg",
+                          "name": "avg_monthly", "running": False,
+                          "args": [ci(3)]}],
+           "partition_by": [ci(0), ci(1)], "order_by": []}
+    flt = filter_(win, binop(">", ci(3),
+                             binop("*", ci(4), lit(1.1, "float64"))))
+    plan = sort_limit(flt, [(ci(0), False), (ci(1), False),
+                            (ci(2), False)], 100)
+
+    def oracle():
+        m = ss.to_pandas().merge(
+            dd.to_pandas().query("d_year == 1999"),
+            left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(it.to_pandas(), left_on="ss_item_sk",
+                    right_on="i_item_sk")
+        g = (m.groupby(["i_category", "i_class", "d_moy"],
+                       as_index=False)
+             .agg(sum_sales=("ss_sales_price", "sum")))
+        g["avg_monthly"] = g.groupby(["i_category", "i_class"]) \
+            .sum_sales.transform("mean")
+        g = g[g.sum_sales > 1.1 * g.avg_monthly]
+        out = g.sort_values(["i_category", "i_class", "d_moy"])[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q92(paths, tables, partitions: int = 2):
+    """Web sales above 1.3x the item's average discount: per-item avg
+    subquery joined back (q92/q65-family threshold shape)."""
+    ws = tables["web_sales"]
+    per_item = _partial_final(
+        scan(paths, tables, "web_sales"),
+        [(c("ws_item_sk"), "item_sk")],
+        [("avg", "avg_price", [c("ws_ext_sales_price")])], partitions)
+    j = join("hash_join",
+             exchange(scan(paths, tables, "web_sales"),
+                      [c("ws_item_sk")], partitions),
+             exchange(per_item, [ci(0)], partitions),
+             [c("ws_item_sk")], [ci(0)])
+    flt = filter_(j, binop(">", c("ws_ext_sales_price"),
+                           binop("*", c("avg_price"),
+                                 lit(1.3, "float64"))))
+    total = project(flt, [c("ws_ext_sales_price")], ["p"])
+    plan = _global_agg(total, [("sum", "total_excess", [ci(0)]),
+                               ("count", "n_rows", [ci(0)])])
+
+    def oracle():
+        m = ws.to_pandas()
+        avg = m.groupby("ws_item_sk").ws_ext_sales_price \
+            .transform("mean")
+        f = m[m.ws_ext_sales_price > 1.3 * avg]
+        return pd.DataFrame({
+            "total_excess": [f.ws_ext_sales_price.sum() if len(f)
+                             else None],
+            "n_rows": [len(f)]})
+
+    return plan, oracle
+
+
+QUERIES.update({
+    "q04": (q04, ["catalog_sales", "date_dim", "customer"]),
+    "q11": (q11, ["web_sales", "date_dim", "customer"]),
+    "q31": (q31, ["store_sales", "web_sales", "customer_address",
+                  "date_dim", "customer"]),
+    "q59": (q59, ["store_sales", "date_dim", "store"]),
+    "q62": (q62, ["web_sales"]),
+    "q66": (q66, ["web_sales", "warehouse", "date_dim"]),
+    "q70": (q70, ["store_sales", "store", "date_dim"]),
+    "q89": (q89, ["store_sales", "item", "date_dim"]),
+    "q92": (q92, ["web_sales"]),
+})

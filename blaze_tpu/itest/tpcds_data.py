@@ -55,6 +55,7 @@ def gen_date_dim(scale: float, seed: int = 11) -> pa.Table:
         "d_moy": pa.array(np.minimum(moy, 12).astype(np.int32)),
         "d_dom": pa.array(((np.arange(n) % 31) + 1).astype(np.int32)),
         "d_dow": pa.array((np.arange(n) % 7).astype(np.int32)),
+        "d_week_seq": pa.array((np.arange(n) // 7 + 1).astype(np.int32)),
         "d_qoy": pa.array((((np.minimum(moy, 12) - 1) // 3) + 1)
                           .astype(np.int32)),
     })

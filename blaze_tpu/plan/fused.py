@@ -438,6 +438,10 @@ class FusedPartialAggExec(ExecutionPlan):
                     config.PARTIAL_AGG_SKIPPING_ENABLE.get())
         skip_ratio = config.PARTIAL_AGG_SKIPPING_RATIO.get()
         skip_min = config.PARTIAL_AGG_SKIPPING_MIN_ROWS.get()
+        next_check = skip_min  # re-probe every minRows stride: clustered
+        # inputs whose tail turns high-cardinality must still trip the
+        # protection (matches the non-fused path's per-flush check,
+        # ops/agg/exec.py _should_skip_partials)
         rows_seen = 0
         skipping = False
         merged_bytes = 0
@@ -463,7 +467,7 @@ class FusedPartialAggExec(ExecutionPlan):
                 # (not only the much larger collect limit), so the
                 # protection engages on partitions far below collectRows
                 check_skip = (can_skip and not skipping and
-                              rows_seen >= skip_min)
+                              rows_seen >= next_check)
                 if state["rows"] >= limit or check_skip:
                     consumer.spill()
                     self.metrics.add("host_vectorized_merges", 1)
@@ -476,8 +480,7 @@ class FusedPartialAggExec(ExecutionPlan):
                         state["merged"] = None
                         consumer.update_mem_used(0)
                     elif check_skip:
-                        # ratio low: aggregation pays off — stop probing
-                        can_skip = False
+                        next_check = rows_seen + skip_min
             if state["chunks"] or state["merged"] is not None:
                 state["merged"] = self._host_group_by(
                     state["chunks"], state["merged"], key_names)
