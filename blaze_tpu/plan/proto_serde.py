@@ -910,6 +910,9 @@ def _join_from_proto(kind: str, n: pb.PhysicalPlanNode) -> Dict[str, Any]:
             d["broadcast_id"] = node.cached_build_hash_map_id
         if node.is_null_aware_anti_join:
             d["null_aware_anti"] = True
+        if not node.on:
+            # keyless broadcast join = nested-loop join (see encode)
+            d["kind"] = "broadcast_nested_loop_join"
     else:  # sort_merge_join
         if node.HasField("filter"):
             d["join_filter"] = expr_from_proto(node.filter.expression)
@@ -1142,6 +1145,28 @@ def plan_to_proto(d: Dict[str, Any]) -> pb.PhysicalPlanNode:
         return _agg_to_proto(d)
     if k in ("sort_merge_join", "hash_join", "broadcast_join"):
         return _join_to_proto(d)
+    if k == "broadcast_nested_loop_join":
+        # no dedicated wire node (ref auron.proto PhysicalPlanType): a
+        # KEYLESS broadcast_join IS a nested-loop join — encode as
+        # broadcast_join with an empty `on` list; decode reverses it.
+        # The wire node has no filter field; for INNER joins a residual
+        # condition is equivalent to a FilterExec over the cross product,
+        # so lift it (outer variants would change null-extension
+        # semantics and are rejected)
+        filt = d.get("join_filter")
+        if filt is not None and d.get("join_type", "inner") != "inner":
+            raise ValueError(
+                "outer broadcast_nested_loop_join with a join_filter "
+                "has no wire encoding (lifting would change "
+                "null-extension semantics)")
+        bare = {key: v for key, v in d.items() if key != "join_filter"}
+        inner = _join_to_proto(dict(bare, kind="broadcast_join",
+                                    left_keys=[], right_keys=[]))
+        if filt is None:
+            return inner
+        n.filter.input.CopyFrom(inner)
+        n.filter.expr.append(expr_to_proto(filt))
+        return n
     if k == "broadcast_join_build_hash_map":
         n.broadcast_join_build_hash_map.input.CopyFrom(
             plan_to_proto(d["input"]))
@@ -1320,9 +1345,54 @@ def _generate_to_proto(d: Dict[str, Any]) -> pb.PhysicalPlanNode:
             g.generator_output.append(field_to_proto(f))
     else:
         raise ValueError(f"cannot encode generator {gk!r}")
-    for name in d.get("required_child_output", []):
+    req_names = d.get("required_child_output")
+    if req_names is None:
+        # The wire carries NAMES (proto `required_child_output`); an
+        # untranslated/absent list used to serialize empty, which decodes
+        # as "keep zero child columns" and silently narrowed the output
+        # (wire-report-caught on gq1).  Index form translates via the
+        # child's output names; the keep-all default enumerates them all;
+        # ambiguous duplicate names cannot ride this name-keyed wire
+        # field and raise rather than rebinding to the wrong column.
+        names = _output_names_of(d["input"])
+        if d.get("required_cols") is not None:
+            req_names = [names[i] for i in d["required_cols"]]
+        else:
+            req_names = list(names)  # keep-all (GenerateExec default)
+        dupes = {x for x in req_names if names.count(x) > 1}
+        if dupes:
+            raise ValueError(
+                f"generate required columns {sorted(dupes)} are "
+                f"ambiguous duplicate names; the wire carries names — "
+                f"rename the child columns first")
+    for name in req_names or []:
         g.required_child_output.append(name)
     return n
+
+
+def _output_names_of(d: Dict[str, Any]) -> List[str]:
+    """Output column names of a plan dict WITHOUT constructing operator
+    trees (serialization must not depend on execution-time resources,
+    e.g. memory_scan/udtf resource-map entries).  Falls back to the
+    planner for exotic shapes."""
+    k = d.get("kind")
+    if k in ("parquet_scan", "orc_scan"):
+        if d.get("projection"):
+            return list(d["projection"])
+        names = [f["name"] for f in d["schema"]["fields"]]
+        if d.get("partition_schema"):
+            names += [f["name"] for f in d["partition_schema"]["fields"]]
+        return names
+    if k in ("ipc_reader", "ffi_reader", "empty_partitions",
+             "memory_scan", "kafka_scan"):
+        return [f["name"] for f in d["schema"]["fields"]]
+    if k in ("project", "rename_columns", "expand"):
+        return list(d["names"])
+    if k in ("filter", "limit", "sort", "local_exchange", "debug",
+             "coalesce_batches"):
+        return _output_names_of(d["input"])
+    from blaze_tpu.plan.planner import create_plan as _cp
+    return [f.name for f in _cp(d).schema]
 
 
 # ---------------------------------------------------------------------------

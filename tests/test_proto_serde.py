@@ -534,3 +534,64 @@ class TestReviewRegressions2:
         build = plan.children[1]
         assert isinstance(build, BuildHashMapExec)
         assert build.cache_id == "bc-7"
+
+
+def test_bnlj_rides_the_wire_as_keyless_broadcast_join():
+    """broadcast_nested_loop_join has no dedicated proto node (matching
+    the reference's PhysicalPlanType oneof); it encodes as a keyless
+    broadcast_join and decodes back (review/report-caught: the wire tier
+    crashed on q24's BNLJ scalar-threshold stage)."""
+    import pytest
+    from blaze_tpu.plan.proto_serde import plan_from_proto, plan_to_proto
+    mem = {"kind": "empty_partitions", "num_partitions": 1,
+           "schema": {"fields": [
+               {"name": "a", "type": {"id": "int64"}, "nullable": True}]}}
+    d = {"kind": "broadcast_nested_loop_join", "left": mem, "right": mem,
+         "left_keys": [], "right_keys": [], "join_type": "inner",
+         "build_side": "right"}
+    back = plan_from_proto(plan_to_proto(d))
+    assert back["kind"] == "broadcast_nested_loop_join"
+    assert back["join_type"] == "inner"
+    # an INNER residual condition lifts into a filter over the cross
+    # product (wire-equivalent); outer variants are rejected
+    filt = {"kind": "binary", "op": ">",
+            "l": {"kind": "column", "index": 0},
+            "r": {"kind": "literal", "value": 0, "type": {"id": "int64"}}}
+    lifted = plan_from_proto(plan_to_proto(dict(d, join_filter=filt)))
+    assert lifted["kind"] == "filter"
+    assert lifted["input"]["kind"] == "broadcast_nested_loop_join"
+    with pytest.raises(ValueError, match="no wire encoding"):
+        plan_to_proto(dict(d, join_type="left", join_filter=filt))
+
+
+def test_generate_required_cols_survive_the_wire(tmp_path):
+    """generate's `required_cols` (index form) must translate to the
+    wire's name-based required_child_output — an empty list decodes as
+    'keep no child columns' and silently narrows the output schema
+    (wire-report-caught on gq1)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.plan import create_plan
+    from blaze_tpu.plan.proto_serde import plan_from_proto, plan_to_proto
+    t = pa.table({"sk": pa.array([1, 2]),
+                  "items": pa.array([[1, 2], [3]],
+                                    type=pa.list_(pa.int64()))})
+    p = str(tmp_path / "g.parquet")
+    pq.write_table(t, p)
+    ir = {"kind": "generate",
+          "generator": {"kind": "posexplode",
+                        "child": {"kind": "column", "name": "items"},
+                        "outer": False},
+          "required_cols": [0],
+          "input": {"kind": "parquet_scan", "schema": {"fields": [
+              {"name": "sk", "type": {"id": "int64"}, "nullable": True},
+              {"name": "items", "type": {"id": "list", "children": [
+                  {"name": "item", "type": {"id": "int64"},
+                   "nullable": True}]}, "nullable": True}]},
+              "file_groups": [[p]]}}
+    direct = create_plan(ir)
+    wired = create_plan(plan_from_proto(plan_to_proto(ir)))
+    assert [f.name for f in wired.schema] == \
+        [f.name for f in direct.schema]
+    assert len(wired.schema) == 3  # sk + pos + exploded element
