@@ -538,10 +538,12 @@ def child_main():
     input_bytes = sum(os.path.getsize(p) for p in sr_paths)
     n_rows = sum(_parquet_rows(p) for p in sr_paths)
 
-    # baseline (warm + timed)
+    # baseline (warm + timed).  The shared 2-CPU box is noisy: medians
+    # over MORE iterations keep one descheduled run from defining either
+    # side of the ratio
     run_baseline(sr_paths, dd_path)
     cpu_times = []
-    for _ in range(max(3, ITERS // 2 + 1)):
+    for _ in range(max(7, ITERS)):
         t0 = time.perf_counter()
         want_groups, want_total = run_baseline(sr_paths, dd_path)
         cpu_times.append(time.perf_counter() - t0)
@@ -549,7 +551,7 @@ def child_main():
 
     # engine: warmup run compiles the fused stage, then timed runs
     times = []
-    for i in range(ITERS + 1):
+    for i in range(max(7, ITERS) + 1):
         tmpdir = tempfile.mkdtemp(prefix="blaze_bench_")
         try:
             t0 = time.perf_counter()
@@ -567,13 +569,13 @@ def child_main():
     # join stage (q06 shape): correctness + timing vs pyarrow join
     want_cnt, want_amt = run_join_baseline(sr_paths, dd_path)
     jcpu_times = []
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
         run_join_baseline(sr_paths, dd_path)
         jcpu_times.append(time.perf_counter() - t0)
     join_cpu_s = float(np.median(jcpu_times))
     jtimes = []
-    for i in range(max(3, ITERS // 2 + 1) + 1):
+    for i in range(max(5, ITERS) + 1):
         t0 = time.perf_counter()
         got_cnt, got_amt = run_join_engine(sr_paths, dd_path)
         if i > 0:

@@ -1386,7 +1386,7 @@ def _output_names_of(d: Dict[str, Any]) -> List[str]:
     if k in ("ipc_reader", "ffi_reader", "empty_partitions",
              "memory_scan", "kafka_scan"):
         return [f["name"] for f in d["schema"]["fields"]]
-    if k in ("project", "rename_columns", "expand"):
+    if k in ("project", "filter_project", "rename_columns", "expand"):
         return list(d["names"])
     if k in ("filter", "limit", "sort", "local_exchange", "debug",
              "coalesce_batches"):
