@@ -2160,3 +2160,192 @@ QUERIES.update({
     "q89": (q89, ["store_sales", "item", "date_dim"]),
     "q92": (q92, ["web_sales"]),
 })
+
+
+# ---------------------------------------------------------------------------
+# fifth batch: 3-channel manufacturer union (q33/q56/q60), zip in-list
+# (q45), am/pm scalar ratio over BNLJ (q90)
+# ---------------------------------------------------------------------------
+
+def _three_channel_by_item_attr(paths, tables, partitions, attr,
+                                attr_filter_vals):
+    """q33/q56/q60 shape: per-channel revenue for items in a category
+    selection, all three channels unioned, re-aggregated by item attr."""
+    ss, cs, ws, it, dd = (tables["store_sales"], tables["catalog_sales"],
+                          tables["web_sales"], tables["item"],
+                          tables["date_dim"])
+    it_f = filter_(scan(paths, tables, "item"),
+                   {"kind": "in_list", "child": c("i_category"),
+                    "values": list(attr_filter_vals),
+                    "type": {"id": "utf8"}})
+    legs = []
+    for fact, date_col, item_col, price_col in (
+            ("store_sales", "ss_sold_date_sk", "ss_item_sk",
+             "ss_ext_sales_price"),
+            ("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+             "cs_ext_sales_price"),
+            ("web_sales", "ws_sold_date_sk", "ws_item_sk",
+             "ws_ext_sales_price")):
+        j_dd = join("broadcast_join", scan(paths, tables, fact),
+                    filter_(scan(paths, tables, "date_dim"),
+                            binop("==", c("d_year"), lit(1999, "int32")),
+                            binop("==", c("d_moy"), lit(5, "int32"))),
+                    [c(date_col)], [c("d_date_sk")])
+        j_it = join("broadcast_join", j_dd, it_f,
+                    [c(item_col)], [c("i_item_sk")])
+        leg = _partial_final(j_it, [(c(attr), "attr")],
+                             [("sum", "total_sales", [c(price_col)])],
+                             partitions)
+        legs.append(leg)
+    u = {"kind": "union", "inputs": legs}
+    merged = _partial_final(u, [(ci(0), "attr")],
+                            [("sum", "total_sales", [ci(1)])], partitions)
+    single = exchange(merged, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(1), True), (ci(0), False)], 100)
+
+    def oracle():
+        itd = it.to_pandas()
+        isel = itd[itd.i_category.isin(attr_filter_vals)]
+        ddd = dd.to_pandas()
+        dsel = ddd[(ddd.d_year == 1999) & (ddd.d_moy == 5)]
+        frames = []
+        for tbl, date_col, item_col, price_col in (
+                (ss, "ss_sold_date_sk", "ss_item_sk",
+                 "ss_ext_sales_price"),
+                (cs, "cs_sold_date_sk", "cs_item_sk",
+                 "cs_ext_sales_price"),
+                (ws, "ws_sold_date_sk", "ws_item_sk",
+                 "ws_ext_sales_price")):
+            m = tbl.to_pandas().merge(dsel, left_on=date_col,
+                                      right_on="d_date_sk")
+            m = m.merge(isel, left_on=item_col, right_on="i_item_sk")
+            frames.append(m.groupby(attr, as_index=False)
+                          .agg(total_sales=(price_col, "sum")))
+        allf = pd.concat(frames, ignore_index=True)
+        out = (allf.groupby(attr, as_index=False).total_sales.sum()
+               .rename(columns={attr: "attr"}))
+        out = out.sort_values(["total_sales", "attr"],
+                              ascending=[False, True])[:100]
+        return out.reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q33(paths, tables, partitions: int = 2):
+    return _three_channel_by_item_attr(paths, tables, partitions,
+                                       "i_manufact_id", ["Books"])
+
+
+def q56(paths, tables, partitions: int = 2):
+    return _three_channel_by_item_attr(paths, tables, partitions,
+                                       "i_item_id", ["Home", "Music"])
+
+
+def q60(paths, tables, partitions: int = 2):
+    return _three_channel_by_item_attr(paths, tables, partitions,
+                                       "i_item_id", ["Sports"])
+
+
+def q45(paths, tables, partitions: int = 2):
+    """Web sales by customer zip, kept when the zip prefix is in a list
+    OR the item is in a chosen set (the q45 disjunction)."""
+    ws, cu, ca, it = (tables["web_sales"], tables["customer"],
+                      tables["customer_address"], tables["item"])
+    j_cu = join("hash_join",
+                exchange(scan(paths, tables, "web_sales"),
+                         [c("ws_bill_customer_sk")], partitions),
+                exchange(scan(paths, tables, "customer"),
+                         [c("c_customer_sk")], partitions),
+                [c("ws_bill_customer_sk")], [c("c_customer_sk")])
+    j_ca = join("broadcast_join", j_cu,
+                scan(paths, tables, "customer_address"),
+                [c("c_current_addr_sk")], [c("ca_address_sk")])
+    j_it = join("broadcast_join", j_ca, scan(paths, tables, "item"),
+                [c("ws_item_sk")], [c("i_item_sk")])
+    zip2 = {"kind": "scalar_function", "name": "substring",
+            "args": [c("ca_zip"), lit(1, "int32"), lit(2, "int32")],
+            "return_type": {"id": "utf8"}}
+    flt = filter_(j_it, binop(
+        "or",
+        {"kind": "in_list", "child": zip2,
+         "values": ["85", "86", "88"], "type": {"id": "utf8"}},
+        {"kind": "in_list", "child": c("i_item_sk"),
+         "values": [2, 3, 5, 7, 11, 13, 17, 19],
+         "type": {"id": "int64"}}))
+    out_agg = _partial_final(
+        flt, [(c("ca_zip"), "ca_zip")],
+        [("sum", "total", [c("ws_ext_sales_price")])], partitions)
+    single = exchange(out_agg, [ci(0)], 1)
+    plan = sort_limit(single, [(ci(0), False)], 100)
+
+    def oracle():
+        m = ws.to_pandas().merge(cu.to_pandas(),
+                                 left_on="ws_bill_customer_sk",
+                                 right_on="c_customer_sk")
+        m = m.merge(ca.to_pandas(), left_on="c_current_addr_sk",
+                    right_on="ca_address_sk")
+        m = m.merge(it.to_pandas(), left_on="ws_item_sk",
+                    right_on="i_item_sk")
+        keep = (m.ca_zip.str[:2].isin(["85", "86", "88"]) |
+                m.ws_item_sk.isin([2, 3, 5, 7, 11, 13, 17, 19]))
+        f = m[keep]
+        out = f.groupby("ca_zip", as_index=False).agg(
+            total=("ws_ext_sales_price", "sum"))
+        return out.sort_values("ca_zip")[:100].reset_index(drop=True)
+
+    return plan, oracle
+
+
+def q90(paths, tables, partitions: int = 2):
+    """AM/PM sales-count ratio: two global counts combined through a
+    broadcast nested-loop join (the q90 scalar-ratio shape)."""
+    ss, td = tables["store_sales"], tables["time_dim"]
+
+    def bucket_count(h_lo, h_hi, name):
+        td_f = filter_(scan(paths, tables, "time_dim"),
+                       binop(">=", c("t_hour"), lit(h_lo, "int32")),
+                       binop("<", c("t_hour"), lit(h_hi, "int32")))
+        j = join("broadcast_join", scan(paths, tables, "store_sales"),
+                 td_f, [c("ss_sold_time_sk")], [c("t_time_sk")])
+        return _global_agg(j, [("count", name,
+                                [c("ss_ticket_number")])])
+
+    am = bucket_count(8, 12, "amc")
+    pm = bucket_count(14, 18, "pmc")
+    crossed = {"kind": "broadcast_nested_loop_join",
+               "left": am, "right": pm, "join_type": "inner",
+               "build_side": "right"}
+    ratio = project(
+        crossed,
+        [ci(0), ci(1),
+         binop("/", {"kind": "cast", "child": ci(0),
+                     "type": {"id": "float64"}},
+               {"kind": "cast", "child": ci(1),
+                "type": {"id": "float64"}})],
+        ["am_count", "pm_count", "am_pm_ratio"])
+    plan = ratio
+
+    def oracle():
+        ssd, tdd = ss.to_pandas(), td.to_pandas()
+        am_n = len(ssd.merge(
+            tdd[(tdd.t_hour >= 8) & (tdd.t_hour < 12)],
+            left_on="ss_sold_time_sk", right_on="t_time_sk"))
+        pm_n = len(ssd.merge(
+            tdd[(tdd.t_hour >= 14) & (tdd.t_hour < 18)],
+            left_on="ss_sold_time_sk", right_on="t_time_sk"))
+        return pd.DataFrame({"am_count": [am_n], "pm_count": [pm_n],
+                             "am_pm_ratio": [am_n / pm_n]})
+
+    return plan, oracle
+
+
+QUERIES.update({
+    "q33": (q33, ["store_sales", "catalog_sales", "web_sales", "item",
+                  "date_dim"]),
+    "q45": (q45, ["web_sales", "customer", "customer_address", "item"]),
+    "q56": (q56, ["store_sales", "catalog_sales", "web_sales", "item",
+                  "date_dim"]),
+    "q60": (q60, ["store_sales", "catalog_sales", "web_sales", "item",
+                  "date_dim"]),
+    "q90": (q90, ["store_sales", "time_dim"]),
+})

@@ -251,6 +251,11 @@ def gen_customer_address(scale: float, seed: int = 21) -> pa.Table:
                 rng.integers(0, 60, n)]),
         "ca_county": pa.array(counties[rng.integers(0, len(counties), n)]),
         "ca_country": pa.array(np.array(["United States"]).repeat(n)),
+        "ca_zip": pa.array(np.char.zfill(
+            rng.integers(0, 100000, n).astype(str), 5)),  # real leading
+        #                                                    zeros: "08540"
+        "ca_gmt_offset": pa.array(
+            rng.integers(-8, -4, n).astype(np.int32)),
     })
 
 
@@ -269,6 +274,8 @@ def gen_item(scale: float, seed: int = 16) -> pa.Table:
         "i_brand_id": pa.array(brand_ids.astype(np.int32)),
         "i_brand": pa.array(brands[brand_ids - 1]),
         "i_manager_id": pa.array(rng.integers(1, 100, n).astype(np.int32)),
+        "i_manufact_id": pa.array(
+            rng.integers(1, 1001, n).astype(np.int32)),
         "i_current_price": pa.array(np.round(rng.random(n) * 100, 2)),
     })
 
