@@ -538,51 +538,52 @@ def child_main():
     input_bytes = sum(os.path.getsize(p) for p in sr_paths)
     n_rows = sum(_parquet_rows(p) for p in sr_paths)
 
-    # baseline (warm + timed).  The shared 2-CPU box is noisy: medians
-    # over MORE iterations keep one descheduled run from defining either
-    # side of the ratio
-    run_baseline(sr_paths, dd_path)
+    # Warm both sides, then time them INTERLEAVED (B,E,B,E,...): the
+    # shared 2-CPU box is noisy, and separate timing blocks let one
+    # descheduled stretch define a whole side of the ratio.  Alternating
+    # samples expose both sides to the same load; medians per side.
+    want_groups, want_total = run_baseline(sr_paths, dd_path)  # warm
+    warmdir = tempfile.mkdtemp(prefix="blaze_bench_")
+    try:  # engine warmup compiles the fused stage
+        run_engine(sr_paths, dd_path, warmdir)
+    finally:
+        shutil.rmtree(warmdir, ignore_errors=True)
     cpu_times = []
+    times = []
     for _ in range(max(7, ITERS)):
         t0 = time.perf_counter()
         want_groups, want_total = run_baseline(sr_paths, dd_path)
         cpu_times.append(time.perf_counter() - t0)
-    cpu_s = float(np.median(cpu_times))
-
-    # engine: warmup run compiles the fused stage, then timed runs
-    times = []
-    for i in range(max(7, ITERS) + 1):
         tmpdir = tempfile.mkdtemp(prefix="blaze_bench_")
         try:
             t0 = time.perf_counter()
             got_groups, got_total = run_engine(sr_paths, dd_path, tmpdir)
-            dt = time.perf_counter() - t0
+            times.append(time.perf_counter() - t0)
         finally:
             shutil.rmtree(tmpdir, ignore_errors=True)
-        if i > 0:  # drop the compile run
-            times.append(dt)
         assert got_groups == want_groups, (got_groups, want_groups)
         assert abs(got_total - want_total) / max(abs(want_total), 1) < 1e-9, \
             (got_total, want_total)
+    cpu_s = float(np.median(cpu_times))
     tpu_s = float(np.median(times))
 
-    # join stage (q06 shape): correctness + timing vs pyarrow join
+    # join stage (q06 shape): correctness + timing vs pyarrow join,
+    # interleaved for the same reason as above
     want_cnt, want_amt = run_join_baseline(sr_paths, dd_path)
+    run_join_engine(sr_paths, dd_path)  # warm
     jcpu_times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        run_join_baseline(sr_paths, dd_path)
-        jcpu_times.append(time.perf_counter() - t0)
-    join_cpu_s = float(np.median(jcpu_times))
     jtimes = []
-    for i in range(max(5, ITERS) + 1):
+    for _ in range(max(5, ITERS)):
+        t0 = time.perf_counter()
+        want_cnt, want_amt = run_join_baseline(sr_paths, dd_path)
+        jcpu_times.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
         got_cnt, got_amt = run_join_engine(sr_paths, dd_path)
-        if i > 0:
-            jtimes.append(time.perf_counter() - t0)
+        jtimes.append(time.perf_counter() - t0)
         assert got_cnt == want_cnt, (got_cnt, want_cnt)
         assert abs(got_amt - want_amt) / max(abs(want_amt), 1) < 1e-9, \
             (got_amt, want_amt)
+    join_cpu_s = float(np.median(jcpu_times))
     join_tpu_s = float(np.median(jtimes))
 
     # ---- SF10 leg: same pipeline at 10x rows, Spark-sized partitions ----
@@ -639,36 +640,58 @@ def run_scaled_leg(scale: float):
     import numpy as np
     sr_paths, dd_path = ensure_dataset(scale)
     n_maps, n_reduces = _spark_partitions(scale)
-    run_baseline(sr_paths, dd_path)
+    want_groups, want_total = run_baseline(sr_paths, dd_path)
+    warmdir = tempfile.mkdtemp(prefix="blaze_bench_sf_")
+    try:
+        run_engine(sr_paths, dd_path, warmdir, n_maps, n_reduces)
+    finally:
+        shutil.rmtree(warmdir, ignore_errors=True)
     ctimes = []
-    for _ in range(3):
+    times = []
+    for _ in range(3):  # interleaved B,E pairs (see child_main)
         t0 = time.perf_counter()
         want_groups, want_total = run_baseline(sr_paths, dd_path)
         ctimes.append(time.perf_counter() - t0)
-    cpu_s = float(np.median(ctimes))
-    times = []
-    for i in range(4):
         tmpdir = tempfile.mkdtemp(prefix="blaze_bench_sf_")
         try:
             t0 = time.perf_counter()
             got_groups, got_total = run_engine(sr_paths, dd_path, tmpdir,
                                                n_maps, n_reduces)
-            dt = time.perf_counter() - t0
+            times.append(time.perf_counter() - t0)
         finally:
             shutil.rmtree(tmpdir, ignore_errors=True)
-        if i > 0:
-            times.append(dt)
         assert got_groups == want_groups, (got_groups, want_groups)
         assert abs(got_total - want_total) / max(abs(want_total), 1) \
             < 1e-9, (got_total, want_total)
+    cpu_s = float(np.median(ctimes))
     eng_s = float(np.median(times))
     n_rows = sum(_parquet_rows(p) for p in sr_paths)
+    # join leg at scale: the runtime-filter advantage grows with probe
+    # size (join cost scales with rows probed; the filter caps it)
+    want_cnt, want_amt = run_join_baseline(sr_paths, dd_path)
+    run_join_engine(sr_paths, dd_path, n_maps)  # warm
+    jc, je = [], []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        want_cnt, want_amt = run_join_baseline(sr_paths, dd_path)
+        jc.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        got_cnt, got_amt = run_join_engine(sr_paths, dd_path, n_maps)
+        je.append(time.perf_counter() - t0)
+        assert got_cnt == want_cnt, (got_cnt, want_cnt)
+        assert abs(got_amt - want_amt) / max(abs(want_amt), 1) < 1e-9, \
+            (got_amt, want_amt)
+    jcpu_s = float(np.median(jc))
+    jeng_s = float(np.median(je))
     return {
         "sf10_vs_baseline": round(cpu_s / eng_s, 3),
         "sf10_wall_s": round(eng_s, 4),
         "sf10_baseline_wall_s": round(cpu_s, 4),
         "sf10_rows_per_sec": round(n_rows / eng_s),
         "sf10_maps": n_maps, "sf10_reduces": n_reduces,
+        "sf10_join_vs_baseline": round(jcpu_s / jeng_s, 3),
+        "sf10_join_wall_s": round(jeng_s, 4),
+        "sf10_join_baseline_wall_s": round(jcpu_s, 4),
     }
 
 

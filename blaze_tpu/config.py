@@ -229,11 +229,13 @@ PARTIAL_AGG_SKIPPING_ENABLE = bool_conf(
     "Pass rows through un-aggregated when partial-agg cardinality is too high "
     "(ref agg_table.rs:108-122).")
 PARTIAL_AGG_SKIPPING_RATIO = float_conf(
-    "auron.partialAggSkipping.ratio", 0.8,
-    "Cardinality/rows ratio beyond which partial agg switches to pass-through.")
+    "auron.partialAggSkipping.ratio", 0.9,
+    "Cardinality/rows ratio beyond which partial agg switches to "
+    "pass-through (reference default 0.9, SparkAuronConfiguration.java).")
 PARTIAL_AGG_SKIPPING_MIN_ROWS = int_conf(
-    "auron.partialAggSkipping.minRows", 8192 * 25,
-    "Rows observed before partial-agg skipping may trigger.")
+    "auron.partialAggSkipping.minRows", 50000,
+    "Rows observed before partial-agg skipping may trigger (the "
+    "reference defaults to 5x its 10000-row batch size).")
 SPILL_COMPRESSION_CODEC = str_conf(
     "auron.spill.compression.codec", "zstd", "Codec for spill files + shuffle IPC.")
 SHUFFLE_COMPRESSION_TARGET_BUF_SIZE = int_conf(
@@ -304,6 +306,30 @@ FUSED_HOST_COLLECT_ROWS = int_conf(
     "Buffered input rows before the host-vectorized agg re-merges into "
     "its running acc table (bounds memory by distinct groups; the "
     "InMemTable spill-trigger analog).")
+SCAN_EAGER_FILE_BYTES = int_conf(
+    "auron.tpu.scan.eagerFileBytes", 128 << 20,
+    "Local parquet files up to this size decode eagerly per file "
+    "(multithreaded read_row_groups, re-sliced zero-copy to the batch "
+    "size); larger files stream through iter_batches for bounded "
+    "memory.")
+SHUFFLE_FILE_CODEC = str_conf(
+    "auron.tpu.shuffle.localFileCodec", "raw",
+    "Frame codec for staged rows written to local shuffle .data files "
+    "(page-cache-backed disk: compression costs critical-path CPU and "
+    "saves nothing; frames stay self-describing so any reader handles "
+    "any mix).  Set to lz4 when .data segments are mostly fetched "
+    "across the network.  Spill frames and RSS pushes always use "
+    "io.compression.codec.")
+JOIN_RUNTIME_FILTER_ENABLE = bool_conf(
+    "auron.tpu.join.runtimeFilter", True,
+    "Drop probe rows outside the build side's join-key [min, max] before "
+    "hash-probing (the runtime-filter join analog; ref bloom_filter agg "
+    "+ bloom_filter_might_contain.rs).")
+FUSED_HOST_EAGER_SCAN_BYTES = int_conf(
+    "auron.tpu.fused.hostVectorized.eagerScanBytes", 128 << 20,
+    "Parquet inputs up to this size read eagerly (pq.read_table + "
+    "vectorized filter) inside the host-vectorized fused stage; larger "
+    "inputs stream through the dataset scanner for bounded memory.")
 FUSED_HOST_VECTORIZED_ENABLE = bool_conf(
     "auron.tpu.fused.hostVectorized", True,
     "Under host placement, run eligible fused aggregations through "
@@ -370,6 +396,14 @@ UDAF_FALLBACK_TYPED_IMPERATIVE_ROW_SIZE = int_conf(
 CAST_TRIM_STRING = bool_conf(
     "auron.cast.trimString", True,
     "Trim whitespace before string->numeric/date casts (Spark behavior).",
+    category="operator")
+PARTIAL_AGG_SKIPPING_PROBE_ROWS = int_conf(
+    "auron.tpu.partialAggSkipping.probeRows", 16384,
+    "Uniform-sample size for the cardinality-ratio probe that drives "
+    "partial-agg skipping (minRows still gates WHEN the probe may run; "
+    "this bounds what it costs).  The sample is strided across the "
+    "whole buffer, so repeated keys depress the ratio and the skip "
+    "decision errs toward keeping the aggregation.",
     category="operator")
 PARTIAL_AGG_SKIPPING_SKIP_SPILL = bool_conf(
     "auron.partialAggSkipping.skipSpill", False,

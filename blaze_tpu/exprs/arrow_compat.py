@@ -1,4 +1,4 @@
-"""PhysicalExpr -> pyarrow.compute.Expression translation (host engine).
+"""PhysicalExpr -> Arrow predicate translation (host engine).
 
 Under host placement the scan+filter leg of an eligible fused stage runs
 as an Arrow dataset scan with the predicate pushed into the C++ scanner —
@@ -6,6 +6,12 @@ the host-engine analog of the reference pushing predicates into the
 DataFusion parquet source (ref parquet_exec.rs:70 page filtering).  Only
 expressions whose Arrow semantics are IDENTICAL to the engine's translate;
 anything else returns None and the caller keeps the engine-side filter.
+
+Two output forms share ONE eligibility/translation walker (`_walk`), so
+the semantic-exclusion rules cannot drift between them:
+  * to_arrow_filter  -> pyarrow.compute.Expression (dataset scanner)
+  * eval_filter_mask -> boolean mask over a materialized table (direct
+    compute kernels, cheaper than Acero plan construction)
 
 Intentionally excluded:
   * floating-point equality (NaN/-0.0 normalization differs),
@@ -29,19 +35,94 @@ _CMP = {"==": "equal", "!=": "not_equal", "<": "less", "<=": "less_equal",
         ">": "greater", ">=": "greater_equal"}
 
 
-def to_arrow_filter(expr: PhysicalExpr, schema: Schema
-                    ) -> Optional[pc.Expression]:
-    """Translate a predicate, or None when semantics could diverge."""
+class _ExpressionOps:
+    """Builds a deferred pc.Expression (dataset scanner pushdown)."""
+
+    def __init__(self, schema: Schema):
+        self._schema = schema
+
+    def column(self, index: int):
+        return pc.field(self._schema[index].name)
+
+    def literal(self, value, arrow_type):
+        import pyarrow as pa
+        return pc.scalar(pa.scalar(value, type=arrow_type))
+
+    def and_(self, l, r):
+        # pc.Expression &/| are Kleene, matching the engine's
+        # three-valued logic; the scanner drops null-valued rows,
+        # matching FilterExec's null-counts-as-False selection
+        return l & r
+
+    def or_(self, l, r):
+        return l | r
+
+    def not_(self, v):
+        return ~v
+
+    def cmp(self, op: str, l, r):
+        import operator as _op
+        fns = {"==": _op.eq, "!=": _op.ne, "<": _op.lt, "<=": _op.le,
+               ">": _op.gt, ">=": _op.ge}
+        return fns[op](l, r)
+
+    def is_null(self, v):
+        return v.is_null()
+
+    def is_valid(self, v):
+        return v.is_valid()
+
+    def isin(self, v, values):
+        return v.isin(values)
+
+
+class _MaskOps:
+    """Evaluates eagerly with compute kernels over a materialized
+    Table/RecordBatch — identical Kleene semantics, no Acero plan."""
+
+    def __init__(self, tbl):
+        self._tbl = tbl
+
+    def column(self, index: int):
+        return self._tbl.column(index)
+
+    def literal(self, value, arrow_type):
+        import pyarrow as pa
+        return pa.scalar(value, type=arrow_type)
+
+    def and_(self, l, r):
+        return pc.and_kleene(l, r)
+
+    def or_(self, l, r):
+        return pc.or_kleene(l, r)
+
+    def not_(self, v):
+        return pc.invert(v)
+
+    def cmp(self, op: str, l, r):
+        return getattr(pc, _CMP[op])(l, r)
+
+    def is_null(self, v):
+        return pc.is_null(v)
+
+    def is_valid(self, v):
+        return pc.is_valid(v)
+
+    def isin(self, v, values):
+        return pc.is_in(v, value_set=values)
+
+
+def _walk(expr: PhysicalExpr, schema: Schema, ops):
+    """Translate a predicate through `ops`, or None when Arrow semantics
+    could diverge from the engine's.  THE single copy of the eligibility
+    rules for both output forms."""
     if isinstance(expr, BinaryExpr):
         if expr.op in ("and", "or"):
-            le = to_arrow_filter(expr.left, schema)
-            re = to_arrow_filter(expr.right, schema)
+            le = _walk(expr.left, schema, ops)
+            re = _walk(expr.right, schema, ops)
             if le is None or re is None:
                 return None
-            # pc.Expression &/| are Kleene, matching the engine's
-            # three-valued logic; the scanner drops null-valued rows,
-            # matching FilterExec's null-counts-as-False selection
-            return (le & re) if expr.op == "and" else (le | re)
+            return ops.and_(le, re) if expr.op == "and" else ops.or_(le, re)
         if expr.op in _CMP:
             lt = expr.left.data_type(schema)
             rt = expr.right.data_type(schema)
@@ -50,49 +131,72 @@ def to_arrow_filter(expr: PhysicalExpr, schema: Schema
                     return None  # NaN/-0.0 normalization differs
                 if t.id == TypeId.DECIMAL:
                     return None  # unscaled-int64 representation
-            le = _operand(expr.left, schema)
-            re = _operand(expr.right, schema)
+            le = _operand(expr.left, schema, ops)
+            re = _operand(expr.right, schema, ops)
             if le is None or re is None:
                 return None
-            return _cmp(expr.op, le, re)
+            return ops.cmp(expr.op, le, re)
         return None
     if isinstance(expr, IsNull):
-        c = _operand(expr.child, schema)
-        return c.is_null() if c is not None else None
+        c = _operand(expr.child, schema, ops)
+        return ops.is_null(c) if c is not None else None
     if isinstance(expr, IsNotNull):
-        c = _operand(expr.child, schema)
-        return c.is_valid() if c is not None else None
+        c = _operand(expr.child, schema, ops)
+        return ops.is_valid(c) if c is not None else None
     if isinstance(expr, Not):
-        c = to_arrow_filter(expr.child, schema)
-        return ~c if c is not None else None
+        # Arrow is_in maps null membership to false (never null), so any
+        # InList ANYWHERE under a NOT can flip a row the engine drops
+        # (null) into one Arrow keeps (true) — decline rather than
+        # diverge.  Outside a NOT the false-vs-null difference is
+        # unobservable (both drop the row through every and/or path).
+        if _contains_inlist(expr.child):
+            return None
+        c = _walk(expr.child, schema, ops)
+        return ops.not_(c) if c is not None else None
     if isinstance(expr, InList) and not expr.negated:
         t = expr.child.data_type(schema)
         if t.is_floating or t.id == TypeId.DECIMAL:
             return None
         if any(v is None for v in expr.values):
             return None  # null members: three-valued membership
-        c = _operand(expr.child, schema)
+        c = _operand(expr.child, schema, ops)
         if c is None:
             return None
         import pyarrow as pa
-        return c.isin(pa.array(list(expr.values), type=t.to_arrow()))
+        return ops.isin(c, pa.array(list(expr.values), type=t.to_arrow()))
     return None
 
 
-def _cmp(op: str, le, re):
-    import operator as _op
-    fns = {"==": _op.eq, "!=": _op.ne, "<": _op.lt, "<=": _op.le,
-           ">": _op.gt, ">=": _op.ge}
-    return fns[op](le, re)
+def _contains_inlist(expr: PhysicalExpr) -> bool:
+    if isinstance(expr, InList):
+        return True
+    if isinstance(expr, BinaryExpr):
+        return _contains_inlist(expr.left) or _contains_inlist(expr.right)
+    if isinstance(expr, Not):
+        return _contains_inlist(expr.child)
+    if isinstance(expr, (IsNull, IsNotNull)):
+        return _contains_inlist(expr.child)
+    return False
 
 
-def _operand(expr: PhysicalExpr, schema: Schema):
+def _operand(expr: PhysicalExpr, schema: Schema, ops):
     if isinstance(expr, BoundReference):
-        return pc.field(schema[expr.index].name)
+        return ops.column(expr.index)
     if isinstance(expr, Literal):
         if expr.value is None:
             return None
-        import pyarrow as pa
-        return pc.scalar(pa.scalar(expr.value,
-                                   type=expr.dtype.to_arrow()))
+        return ops.literal(expr.value, expr.dtype.to_arrow())
     return None
+
+
+def to_arrow_filter(expr: PhysicalExpr, schema: Schema
+                    ) -> Optional[pc.Expression]:
+    """Translate a predicate to a scanner Expression, or None."""
+    return _walk(expr, schema, _ExpressionOps(schema))
+
+
+def eval_filter_mask(expr: PhysicalExpr, schema: Schema, tbl):
+    """Evaluate a predicate as a boolean mask over a materialized
+    Table/RecordBatch, or None when it doesn't translate — callers fall
+    back to Table.filter(Expression)."""
+    return _walk(expr, schema, _MaskOps(tbl))

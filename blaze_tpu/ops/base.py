@@ -53,6 +53,16 @@ class ExecutionPlan:
         """Pull-stream of batches for one partition."""
         raise NotImplementedError
 
+    def arrow_batches(self, partition: int):
+        """Pull-stream of Arrow record batches.  Host-resident consumers
+        (Acero joins, host-vectorized agg) use this to stay
+        Arrow-resident; sources that already hold Arrow data override it
+        to skip the ColumnBatch round trip entirely."""
+        for cb in self.execute(partition):
+            cb = cb.compact()
+            if cb.num_rows:
+                yield cb.to_arrow()
+
     def execute_collect(self) -> "ColumnBatch":
         """All partitions concatenated (test/driver helper)."""
         out = []

@@ -39,7 +39,10 @@ class BroadcastNestedLoopJoinExec(ExecutionPlan):
         self.build_side = build_side
         self.join_filter = join_filter
         self._existence_col = existence_col
-        self._broadcast_id = broadcast_id or f"bnlj-{id(self)}"
+        # process-unique, never recycled (id(self) can be reused by a new
+        # object and would hit a stale resource-map cache entry)
+        from blaze_tpu.ops.joins.exec import _local_bid
+        self._broadcast_id = broadcast_id or f"bnlj-{next(_local_bid)}"
         self._out_schema = self._build_schema()
         # matched-build state is shared across probe partitions (Spark
         # unions matchedBroadcastRows); the LAST partition to finish

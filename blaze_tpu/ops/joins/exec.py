@@ -18,6 +18,7 @@ mirroring how the reference shares probe code between SHJ and BHJ.
 from __future__ import annotations
 
 import enum
+import itertools
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -34,6 +35,9 @@ from blaze_tpu.exprs import PhysicalExpr
 from blaze_tpu.kernels import hashing as H
 from blaze_tpu.ops.base import BatchIterator, CoalesceStream, ExecutionPlan
 from blaze_tpu.schema import BOOL, Field, Schema, TypeId
+
+# process-unique default broadcast ids (see BroadcastJoinExec.__init__)
+_local_bid = itertools.count()
 
 
 class JoinType(enum.Enum):
@@ -420,37 +424,149 @@ class BaseJoinExec(ExecutionPlan):
         instead of re-running Acero per chunk — Acero rebuilds its
         build-side hash table on every Table.join call, while JoinMap
         hashes the build side exactly once."""
-        import itertools
         limit = config.FUSED_HOST_COLLECT_ROWS.get()
-        chunks: List[ColumnBatch] = []
-        rows = 0
-        stream = probe.execute(partition)
-        overflowed = False
-        for batch in stream:
-            batch = batch.compact()
-            if batch.num_rows == 0:
-                continue
-            chunks.append(batch)
-            rows += batch.num_rows
-            if rows >= limit:
-                overflowed = True
-                break
-        if overflowed:
-            yield from self._stream_probe(
-                jmap, itertools.chain(chunks, stream), probe_keys,
-                probe_is_left)
-            return
         build_is_left = not probe_is_left
         build_keys = self.left_keys if build_is_left else self.right_keys
         build_tbl = self._join_key_table(
             jmap.schema, jmap.table, build_keys,
             "l" if build_is_left else "r")
-        yield from self._pa_join_once(build_tbl,
-                                      [b.to_arrow() for b in chunks],
-                                      probe_keys, probe_is_left)
+        # the build side is materialized BEFORE probe collection, so the
+        # join-key runtime filter applies DURING collection: probe rows
+        # outside the build key range never occupy collect memory (and a
+        # selective filter keeps large probes under the collect limit
+        # instead of tipping them onto the streaming path)
+        prefilter, covered = self._collect_prefilter(build_tbl, probe_keys,
+                                                     probe_is_left)
+        chunks: List[pa.RecordBatch] = []
+        rows = 0
+        # Arrow-resident collection: sources that hold Arrow data (scans)
+        # stream it straight through without a ColumnBatch round trip
+        stream = probe.arrow_batches(partition)
+        overflowed = False
+        for rb in stream:
+            if prefilter is not None and rb.num_rows:
+                rb = prefilter(rb)
+            if rb.num_rows == 0:
+                continue
+            chunks.append(rb)
+            rows += rb.num_rows
+            if rows >= limit:
+                overflowed = True
+                break
+        if overflowed:
+            yield from self._stream_probe(
+                jmap,
+                (ColumnBatch.from_arrow(b) for b in
+                 itertools.chain(chunks, stream)),
+                probe_keys, probe_is_left)
+            return
+        yield from self._pa_join_once(build_tbl, chunks, probe_keys,
+                                      probe_is_left, skip_filter_keys=covered)
+
+    def _runtime_filter_drop_ok(self, probe_is_left: bool) -> bool:
+        """Whether dropping never-matching probe rows is semantics-
+        preserving: inner joins and probe-side semi joins only."""
+        jt = self.join_type
+        return (jt == JoinType.INNER or
+                (jt == JoinType.LEFT_SEMI and probe_is_left) or
+                (jt == JoinType.RIGHT_SEMI and not probe_is_left))
+
+    @staticmethod
+    def _range_mask(col, mn, mx):
+        return pc.and_(pc.greater_equal(col, mn), pc.less_equal(col, mx))
+
+    def _collect_prefilter(self, build_tbl, probe_keys,
+                           probe_is_left: bool):
+        """(closure, covered-keys) pair: the closure drops probe rows
+        outside the build side's integer join-key [min, max] ranges,
+        applied batch-by-batch while the probe is being collected;
+        `covered` lists the key positions it handled so the join-time
+        filter skips them.  (None, frozenset()) when inapplicable
+        (non-droppable join type, computed/non-integer keys)."""
+        none = (None, frozenset())
+        if not (self._runtime_filter_drop_ok(probe_is_left)
+                and config.JOIN_RUNTIME_FILTER_ENABLE.get()):
+            return none
+        bprefix = "l" if not probe_is_left else "r"
+        ranges = []
+        empty = build_tbl.num_rows == 0
+        if not empty:
+            from blaze_tpu.exprs.base import BoundReference
+            for i, e in enumerate(probe_keys):
+                if not isinstance(e, BoundReference):
+                    continue
+                bcol = build_tbl.column(f"__{bprefix}k{i}")
+                if not pa.types.is_integer(bcol.type):
+                    continue
+                mm = pc.min_max(bcol)
+                if not mm["min"].is_valid:
+                    empty = True  # all-null build keys: nothing matches
+                    break
+                ranges.append((i, e.index, mm["min"], mm["max"]))
+        metrics = self.metrics
+        if empty:
+            def drop_all(rb):
+                metrics.add("runtime_filter_pruned", rb.num_rows)
+                return rb.slice(0, 0)
+            return drop_all, frozenset(range(len(probe_keys)))
+        if not ranges:
+            return none
+
+        def apply(rb):
+            mask = None
+            for _k, idx, mn, mx in ranges:
+                m = self._range_mask(rb.column(idx), mn, mx)
+                mask = m if mask is None else pc.and_kleene(mask, m)
+            out = rb.filter(mask)
+            metrics.add("runtime_filter_pruned",
+                        rb.num_rows - out.num_rows)
+            return out
+        return apply, frozenset(k for k, *_r in ranges)
+
+    def _runtime_filter_probe(self, build_tbl, probe_tbl, pprefix: str,
+                              probe_is_left: bool,
+                              skip_keys: frozenset = frozenset()):
+        """Join-key runtime filter: before probing, drop probe rows whose
+        integer key falls outside the build side's [min, max] — the
+        engine-side analog of the reference's runtime-filter joins
+        (bloom_filter agg + bloom_filter_might_contain.rs pushed into the
+        probe scan).  One vectorized comparison pass over the probe
+        replaces hash-probing every row that cannot possibly match.
+
+        Only join types where a non-matching probe row produces no output
+        may drop rows (inner, probe-side semi); null keys never match an
+        equi-join, so the null-dropping comparison semantics are exact."""
+        if (not self._runtime_filter_drop_ok(probe_is_left)
+                or not config.JOIN_RUNTIME_FILTER_ENABLE.get()
+                or probe_tbl.num_rows == 0):
+            return probe_tbl
+        if build_tbl.num_rows == 0:
+            return probe_tbl.slice(0, 0)  # inner/semi vs empty build
+        bprefix = "r" if pprefix == "l" else "l"
+        for i in range(len(self.left_keys)):
+            if i in skip_keys:  # already pruned during probe collection
+                continue
+            bcol = build_tbl.column(f"__{bprefix}k{i}")
+            if not pa.types.is_integer(bcol.type):
+                continue
+            mm = pc.min_max(bcol)
+            if not mm["min"].is_valid:
+                probe_tbl = probe_tbl.slice(0, 0)  # all-null build keys
+                break
+            before = probe_tbl.num_rows
+            probe_tbl = probe_tbl.filter(self._range_mask(
+                probe_tbl.column(f"__{pprefix}k{i}"),
+                mm["min"], mm["max"]))
+            self.metrics.add("runtime_filter_pruned",
+                             before - probe_tbl.num_rows)
+            if probe_tbl.num_rows == 0:
+                break
+        return probe_tbl
 
     def _pa_join_once(self, build_tbl, probe_chunks, probe_keys,
-                      probe_is_left: bool) -> Iterator[ColumnBatch]:
+                      probe_is_left: bool,
+                      skip_filter_keys: frozenset = frozenset()
+                      ) -> Iterator[ColumnBatch]:
         probe_schema = self.children[0 if probe_is_left else 1].schema
         pprefix = "l" if probe_is_left else "r"
         if probe_chunks:
@@ -460,6 +576,9 @@ class BaseJoinExec(ExecutionPlan):
                 [], schema=probe_schema.to_arrow())
         probe_tbl = self._join_key_table(probe_schema, probe_pa,
                                          probe_keys, pprefix)
+        probe_tbl = self._runtime_filter_probe(build_tbl, probe_tbl,
+                                               pprefix, probe_is_left,
+                                               skip_keys=skip_filter_keys)
         left_tbl = probe_tbl if probe_is_left else build_tbl
         right_tbl = build_tbl if probe_is_left else probe_tbl
         lk = [f"__lk{i}" for i in range(len(self.left_keys))]
@@ -749,7 +868,10 @@ class BroadcastJoinExec(BaseJoinExec):
 
     def __init__(self, *args, broadcast_id: Optional[str] = None, **kw):
         super().__init__(*args, **kw)
-        self._broadcast_id = broadcast_id or f"bhj-{id(self)}"
+        # default ids must be process-unique FOREVER, not id(self):
+        # CPython reuses freed addresses, and a recycled id would serve a
+        # stale build map out of the long-lived resource-map cache
+        self._broadcast_id = broadcast_id or f"bhj-{next(_local_bid)}"
 
     def _get_join_map(self, partition: int) -> JoinMap:
         build = 1 if self.build_side == "right" else 0

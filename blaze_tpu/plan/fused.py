@@ -29,6 +29,7 @@ the eager path.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -445,12 +446,8 @@ class FusedPartialAggExec(ExecutionPlan):
         rows_seen = 0
         skipping = False
         merged_bytes = 0
-        stream = self._host_scan_stream(partition)
-        if stream is None:
-            stream = self.children[0].execute(partition)
         try:
-            for batch in stream:
-                tbl = self._host_keys_args_table(batch, key_names)
+            for tbl in self._host_input_tables(partition, key_names):
                 if tbl is None or tbl.num_rows == 0:
                     continue
                 if skipping:
@@ -464,23 +461,45 @@ class FusedPartialAggExec(ExecutionPlan):
                     merged_bytes = state["merged"].nbytes
                 consumer.update_mem_used(state["bytes"] + merged_bytes)
                 # the skip decision checkpoints at minRows-sized strides
-                # (not only the much larger collect limit), so the
-                # protection engages on partitions far below collectRows
-                check_skip = (can_skip and not skipping and
-                              rows_seen >= next_check)
-                if state["rows"] >= limit or check_skip:
-                    consumer.spill()
-                    self.metrics.add("host_vectorized_merges", 1)
-                    m = state["merged"]
-                    if (check_skip and m is not None and
-                            m.num_rows / max(1, rows_seen) > skip_ratio):
+                # (not only the much larger collect limit) on a BOUNDED
+                # probe — a distinct-count over a UNIFORM row sample of
+                # everything buffered, NOT a full merge (the reference
+                # measures the ratio on the minRows-row prefix its hash
+                # table absorbed, agg_table.rs:108-122; a uniform sample
+                # across the whole buffer additionally catches cyclic
+                # keys whose repeats a prefix/tail window would miss).
+                # Skipping then releases the raw buffer straight through
+                # without ever aggregating it.
+                # NOTE: update_mem_used above may have spilled THIS
+                # consumer synchronously, emptying the chunk buffer —
+                # nothing left to probe until more rows arrive
+                if can_skip and rows_seen >= next_check \
+                        and state["chunks"]:
+                    probe = self._sample_rows(
+                        state["chunks"], state["rows"],
+                        min(skip_min,
+                            config.PARTIAL_AGG_SKIPPING_PROBE_ROWS.get()))
+                    distinct = probe.group_by(
+                        key_names, use_threads=True).aggregate([])
+                    if (distinct.num_rows / max(1, probe.num_rows)
+                            > skip_ratio):
                         skipping = True
                         self.metrics.add("partial_skipped", 1)
-                        yield from self._emit_host(m, key_names)
-                        state["merged"] = None
+                        if state["merged"] is not None:
+                            yield from self._emit_host(state["merged"],
+                                                       key_names)
+                            state["merged"] = None
+                        for c in state["chunks"]:
+                            yield from self._host_passthrough(c, key_names)
+                        state["chunks"] = []
+                        state["rows"] = 0
+                        state["bytes"] = 0
                         consumer.update_mem_used(0)
-                    elif check_skip:
-                        next_check = rows_seen + skip_min
+                        continue
+                    next_check = rows_seen + skip_min
+                if state["rows"] >= limit:
+                    consumer.spill()
+                    self.metrics.add("host_vectorized_merges", 1)
             if state["chunks"] or state["merged"] is not None:
                 state["merged"] = self._host_group_by(
                     state["chunks"], state["merged"], key_names)
@@ -534,11 +553,108 @@ class FusedPartialAggExec(ExecutionPlan):
         rb = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
         yield from self._emit_batches(rb)
 
-    def _host_scan_stream(self, partition: int):
-        """Push the absorbed filter chain into an Arrow dataset scanner
-        (C++-evaluated predicate + projection, the parquet_exec.rs
-        pushdown analog) when the source is a plain parquet scan and
-        every predicate translates exactly; None -> engine-side path."""
+    @staticmethod
+    def _mask_filter(tbl, preds, schema, filt):
+        """Conjunction of direct-kernel masks (cheaper than Acero's
+        Table.filter(Expression) plan construction); Expression fallback
+        when any predicate declines."""
+        from blaze_tpu.exprs.arrow_compat import eval_filter_mask
+        import pyarrow.compute as pc
+        mask = None
+        for p in preds:
+            m = eval_filter_mask(p, schema, tbl)
+            if m is None:
+                return tbl.filter(filt)
+            mask = m if mask is None else pc.and_kleene(mask, m)
+        return tbl.filter(mask)
+
+    @staticmethod
+    def _sample_rows(chunks, total_rows: int, max_rows: int):
+        """Uniform strided row sample (≤ max_rows) across all buffered
+        chunks.  A sample that spans the whole buffer sees key REPEATS
+        that any contiguous window would miss (e.g. keys cycling with a
+        period longer than the window), so the cardinality ratio it
+        yields under-estimates on repetitive data — the conservative
+        direction for the skip decision."""
+        tbl = (chunks[0] if len(chunks) == 1
+               else pa.concat_tables(chunks))
+        if total_rows <= max_rows:
+            return tbl
+        stride = total_rows / max_rows
+        idx = np.minimum((np.arange(max_rows) * stride).astype(np.int64),
+                         total_rows - 1)
+        return tbl.take(idx)
+
+    def _host_input_tables(self, partition: int, key_names):
+        """Iterator of keys+args Arrow tables for the host-vectorized agg.
+
+        Three paths, fastest first:
+          1. pushdown scan -> Arrow-resident column selection (every
+             grouping/arg expression is a bare column): record batches go
+             from the parquet reader into the agg with ZERO numpy round
+             trips;
+          2. pushdown scan -> ColumnBatch expression evaluation;
+          3. engine-side child stream (partition constants, non-arrow
+             predicates, non-parquet sources).
+        """
+        scan = self._host_scan_arrow(partition)
+        if scan is None:
+            for batch in self.children[0].execute(partition):
+                yield self._host_keys_args_table(batch, key_names)
+            return
+        idxs = self._bare_column_indices()
+        for rb in scan:
+            if rb.num_rows == 0:
+                continue
+            self.metrics.add("pushdown_rows", rb.num_rows)
+            if idxs is not None:
+                cols = [rb.column(i) for i in idxs]
+                names = list(key_names) + [
+                    f"__arg{i}" for i in range(len(self._specs))]
+                yield pa.table(cols, names=names)
+            elif isinstance(rb, pa.RecordBatch):
+                yield self._host_keys_args_table(
+                    ColumnBatch.from_arrow(rb), key_names)
+            else:
+                # eager reads hand back a Table: convert chunk-wise (a
+                # combine_chunks of >2 GiB string data would overflow
+                # 32-bit offsets)
+                for piece in rb.to_batches():
+                    if piece.num_rows:
+                        yield self._host_keys_args_table(
+                            ColumnBatch.from_arrow(piece), key_names)
+
+    def _bare_column_indices(self):
+        """Source-schema column index per key+arg when every expression is
+        a BoundReference (valid only for an all-filter chain, where the
+        agg input schema IS the source schema); None otherwise."""
+        if any(kind != "filter" for kind, *_rest in self._chain):
+            return None
+        idxs = []
+        for e, _n in self._group_exprs:
+            if not isinstance(e, BoundReference):
+                return None
+            idxs.append(e.index)
+        for _rk, _ok, arg in self._specs:
+            if arg is None:  # count(*): any column carries the row count
+                idxs.append(idxs[0])
+            elif isinstance(arg, BoundReference):
+                idxs.append(arg.index)
+            else:
+                return None
+        return idxs
+
+    def _host_scan_arrow(self, partition: int):
+        """Push the absorbed filter chain into Arrow's C++ parquet reader
+        (predicate + projection pushdown, the parquet_exec.rs analog) when
+        the source is a plain parquet scan and every predicate translates
+        exactly; None -> engine-side path.  Yields Arrow record batches
+        (or tables).
+
+        Small inputs take an EAGER read (pq.read_table + vectorized
+        mask): measurably faster than the dataset scanner, which pays
+        per-fragment scheduling overhead.  Inputs above the eager
+        threshold stream through the scanner for bounded memory."""
         from blaze_tpu.exprs.arrow_compat import to_arrow_filter
         from blaze_tpu.ops.scan import ParquetScanExec, open_source
         src = self._source
@@ -547,6 +663,7 @@ class FusedPartialAggExec(ExecutionPlan):
         if src._partition_schema is not None:
             return None  # partition constants need engine-side assembly
         filt = None
+        plain_preds = []
         for kind, preds, _exprs, _schema in self._chain:
             if kind != "filter":
                 return None
@@ -555,25 +672,33 @@ class FusedPartialAggExec(ExecutionPlan):
                 if e is None:
                     return None
                 filt = e if filt is None else (filt & e)
+                plain_preds.append(p)
         paths = src._file_groups[partition]
         if not paths:
             return iter(())
+        import pyarrow.parquet as pq
+        eager_limit = config.FUSED_HOST_EAGER_SCAN_BYTES.get()
         try:
+            local = all(isinstance(p, str) and os.path.exists(p)
+                        for p in paths)
+            if (local and sum(os.path.getsize(p) for p in paths)
+                    <= eager_limit):
+                tbl = pq.read_table(
+                    paths, columns=[f.name for f in src._file_part],
+                    use_threads=True)
+                if plain_preds:
+                    tbl = self._mask_filter(tbl, plain_preds, src.schema,
+                                            filt)
+                return iter((tbl,))
             import pyarrow.dataset as ds
             dataset = ds.dataset([open_source(p) for p in paths],
                                  format="parquet",
                                  schema=src._file_part.to_arrow())
             scanner = dataset.scanner(filter=filt, batch_size=1 << 20,
                                       use_threads=True)
+            return scanner.to_batches()
         except Exception:
             return None  # schema evolution etc.: engine-side scan
-
-        def gen():
-            for rb in scanner.to_batches():
-                if rb.num_rows:
-                    self.metrics.add("pushdown_rows", rb.num_rows)
-                    yield ColumnBatch.from_arrow(rb)
-        return gen()
 
     def _host_keys_args_table(self, batch: ColumnBatch, key_names):
         """Evaluate keys + agg args on the (numpy-resident) batch and pack

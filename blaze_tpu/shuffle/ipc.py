@@ -39,6 +39,13 @@ def _lz4():
         return None
 
 
+def _codec_from_name(name: str) -> int:
+    name = name.lower()
+    if name == "lz4" and _lz4() is not None:
+        return CODEC_LZ4
+    return CODEC_ZSTD if name in ("zstd", "zstandard") else CODEC_RAW
+
+
 def _get_codec() -> int:
     # io.compression.codec governs shuffle frames when explicitly set;
     # otherwise the spill codec key (which governed this framing before
@@ -49,9 +56,7 @@ def _get_codec() -> int:
         name = config.SPILL_COMPRESSION_CODEC.get().lower()
     else:
         name = config.IO_COMPRESSION_CODEC.get().lower()  # default: lz4
-    if name == "lz4" and _lz4() is not None:
-        return CODEC_LZ4
-    return CODEC_ZSTD if name in ("zstd", "zstandard") else CODEC_RAW
+    return _codec_from_name(name)
 
 
 def _compress(codec: int, payload: bytes) -> bytes:
@@ -98,9 +103,12 @@ def _decompress(codec: int, payload: bytes) -> bytes:
 class IpcCompressionWriter:
     """Streams record batches into framed compressed IPC blocks."""
 
-    def __init__(self, sink: BinaryIO, target_frame_bytes: Optional[int] = None):
+    def __init__(self, sink: BinaryIO,
+                 target_frame_bytes: Optional[int] = None,
+                 codec_name: Optional[str] = None):
         self._sink = sink
-        self._codec = _get_codec()
+        self._codec = (_codec_from_name(codec_name) if codec_name
+                       else _get_codec())
         self._target = (target_frame_bytes or
                         config.SHUFFLE_COMPRESSION_TARGET_BUF_SIZE.get())
         self._pending: List[pa.RecordBatch] = []

@@ -85,6 +85,9 @@ class ShuffleRepartitioner(MemConsumer):
     def spill(self) -> int:
         if not self._staged:
             return 0
+        # spills keep the wire codec (not the local-file codec): spilled
+        # frames are copied verbatim into whatever sink write()/write_rss
+        # merges them into, which for RSS is a network push
         spill = _PartitionedSpill()
         with open(spill.path, "wb") as f:
             spill.offsets = self._write_partitioned(f)
@@ -98,10 +101,32 @@ class ShuffleRepartitioner(MemConsumer):
             self._metrics.add("spilled_bytes", released)
         return released
 
-    def _write_partitioned(self, sink: BinaryIO) -> List[int]:
+    def _write_partitioned(self, sink: BinaryIO,
+                           codec_name: Optional[str] = None) -> List[int]:
         """Sort staged rows by pid, write per-partition frames; returns
-        cumulative offsets (n+1)."""
+        cumulative offsets (n+1).
+
+        `codec_name` overrides the frame codec for staged rows headed to
+        a LOCAL .data file: page-cache-backed disk where compression
+        costs CPU on the critical path and saves nothing, so
+        `auron.tpu.shuffle.localFileCodec` (default raw) applies there.
+        Frames are self-describing (leading codec byte), so readers —
+        including remote fetchers — handle any mix; set the conf to lz4
+        for deployments where .data segments ship over the network more
+        often than they are read back locally.  Spill frames and RSS
+        pushes keep the io.compression.codec wire codec (spills may be
+        merged verbatim into an RSS push, shuffle/rss.rs analog)."""
         n_parts = self.partitioning.num_partitions
+        if n_parts == 1:
+            # single reduce partition: every row is partition 0 — skip
+            # the pid sort/take entirely and stream staged batches out
+            w = IpcCompressionWriter(sink, codec_name=codec_name)
+            for staged in self._staged:
+                w.write_batch(pa.RecordBatch.from_arrays(
+                    list(staged.columns)[1:],
+                    names=list(staged.schema.names)[1:]))
+            w.finish()
+            return [0, sink.tell()]
         tbl = pa.Table.from_batches(self._staged).combine_chunks()
         rb = tbl.to_batches()[0]
         pids = np.asarray(rb.column(0))
@@ -117,7 +142,7 @@ class ShuffleRepartitioner(MemConsumer):
         for p in range(n_parts):
             s, e = int(starts[p]), int(ends[p])
             if e > s:
-                w = IpcCompressionWriter(sink)
+                w = IpcCompressionWriter(sink, codec_name=codec_name)
                 for off in range(s, e, bs):
                     w.write_batch(payload.slice(off, min(bs, e - off)))
                 w.finish()
@@ -130,7 +155,8 @@ class ShuffleRepartitioner(MemConsumer):
         mem_offsets: List[int] = []
         mem_buf = io.BytesIO()
         if self._staged:
-            mem_offsets = self._write_partitioned(mem_buf)
+            mem_offsets = self._write_partitioned(
+                mem_buf, codec_name=config.SHUFFLE_FILE_CODEC.get())
             self._staged = []
             self._staged_bytes = 0
             self.update_mem_used(0)
