@@ -76,13 +76,15 @@ class NativeExecutionRuntime:
         return self
 
     def _sync_batches(self) -> Iterator[pa.RecordBatch]:
+        # arrow_batches: plans whose output is already Arrow-resident
+        # (fused host agg, scans) skip the ColumnBatch round trip; the
+        # base implementation is exactly the old compact().to_arrow()
         with task_scope(self.task):
-            stream = self.plan.execute(self.task.partition_id)
+            stream = self.plan.arrow_batches(self.task.partition_id)
             stats = config.INPUT_BATCH_STATISTICS.get()
-            for batch in stream:
+            for rb in stream:
                 if self._finalized.is_set():
                     return
-                rb = batch.compact().to_arrow()
                 if rb.num_rows == 0:
                     continue
                 if stats:
@@ -95,12 +97,11 @@ class NativeExecutionRuntime:
     def _produce(self) -> None:
         try:
             with task_scope(self.task):
-                stream = self.plan.execute(self.task.partition_id)
+                stream = self.plan.arrow_batches(self.task.partition_id)
                 stats = config.INPUT_BATCH_STATISTICS.get()
-                for batch in stream:  # HOT LOOP (ref rt.rs:175-192)
+                for rb in stream:  # HOT LOOP (ref rt.rs:175-192)
                     if self._finalized.is_set():
                         return
-                    rb = batch.compact().to_arrow()
                     if rb.num_rows == 0:
                         continue
                     if stats:

@@ -33,6 +33,17 @@ SF1_ROWS = {
 }
 
 
+def _date_ordered(tbl: pa.Table, date_col: str) -> pa.Table:
+    """Fact tables come out of dsdgen in date order (rows are emitted per
+    calendar date), so real TPC-DS parquet loads carry strong date-key
+    clustering and selective row-group min/max statistics — the layout
+    the reference's parquet page/row-group filtering exists to exploit
+    (ref conf.rs:43 `enable.pageFiltering`, parquet_exec.rs).  The
+    uniform-random dates emitted here previously were unfaithful in
+    exactly the way that disabled that feature; sort to match dsdgen."""
+    return tbl.sort_by([(date_col, "ascending")])
+
+
 def _rows(name: str, scale: float) -> int:
     base = SF1_ROWS[name]
     if name in ("store", "date_dim", "warehouse", "promotion",
@@ -98,7 +109,7 @@ def gen_store_returns(scale: float, seed: int = 14) -> pa.Table:
     null_mask = rng.random(n) < 0.02
     cust = rng.integers(1, _rows("customer", scale) + 1, n).astype(float)
     cust[null_mask] = np.nan
-    return pa.table({
+    return _date_ordered(pa.table({
         "sr_returned_date_sk": pa.array(
             rng.integers(2450815, 2450815 + date_n, n)),
         "sr_customer_sk": pa.array(
@@ -111,14 +122,14 @@ def gen_store_returns(scale: float, seed: int = 14) -> pa.Table:
             rng.integers(1, 50, n).astype(np.int32)),
         "sr_reason_sk": pa.array(rng.integers(1, 36, n)),
         "sr_net_loss": pa.array(np.round(rng.random(n) * 60, 2)),
-    })
+    }), "sr_returned_date_sk")
 
 
 def gen_store_sales(scale: float, seed: int = 15) -> pa.Table:
     n = _rows("store_sales", scale)
     rng = np.random.default_rng(seed)
     date_n = min(_rows("date_dim", scale), SALES_DATE_DAYS)
-    return pa.table({
+    return _date_ordered(pa.table({
         "ss_sold_date_sk": pa.array(
             rng.integers(2450815, 2450815 + date_n, n)),
         "ss_customer_sk": pa.array(
@@ -139,7 +150,7 @@ def gen_store_sales(scale: float, seed: int = 15) -> pa.Table:
         "ss_addr_sk": pa.array(
             rng.integers(1, _rows("customer_address", scale) + 1, n)),
         "ss_sold_time_sk": pa.array(rng.integers(0, 86_400, n)),
-    })
+    }), "ss_sold_date_sk")
 
 
 def gen_catalog_sales(scale: float, seed: int = 17) -> pa.Table:
@@ -147,7 +158,7 @@ def gen_catalog_sales(scale: float, seed: int = 17) -> pa.Table:
     rng = np.random.default_rng(seed)
     date_n = min(_rows("date_dim", scale), SALES_DATE_DAYS)
     sold = rng.integers(2450815, 2450815 + date_n, n)
-    return pa.table({
+    return _date_ordered(pa.table({
         "cs_sold_date_sk": pa.array(sold),
         "cs_bill_customer_sk": pa.array(
             rng.integers(1, _rows("customer", scale) + 1, n)),
@@ -170,7 +181,7 @@ def gen_catalog_sales(scale: float, seed: int = 17) -> pa.Table:
                                                  n)),
         "cs_ship_mode_sk": pa.array(rng.integers(1, 21, n)),
         "cs_call_center_sk": pa.array(rng.integers(1, 7, n)),
-    })
+    }), "cs_sold_date_sk")
 
 
 def gen_catalog_returns(scale: float, seed: int = 28) -> pa.Table:
@@ -189,7 +200,7 @@ def gen_web_sales(scale: float, seed: int = 18) -> pa.Table:
     rng = np.random.default_rng(seed)
     date_n = min(_rows("date_dim", scale), SALES_DATE_DAYS)
     n_orders = max(1, n // 3)  # ~3 line items per order
-    return pa.table({
+    return _date_ordered(pa.table({
         "ws_ship_date_sk": pa.array(
             rng.integers(2450815, 2450815 + date_n, n)),
         "ws_ship_addr_sk": pa.array(
@@ -208,7 +219,7 @@ def gen_web_sales(scale: float, seed: int = 18) -> pa.Table:
             rng.integers(1, _rows("customer", scale) + 1, n)),
         "ws_quantity": pa.array(rng.integers(1, 100, n).astype(np.int32)),
         "ws_sales_price": pa.array(np.round(rng.random(n) * 260, 2)),
-    })
+    }), "ws_sold_date_sk")
 
 
 def gen_web_returns(scale: float, seed: int = 19) -> pa.Table:

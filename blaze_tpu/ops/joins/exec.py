@@ -435,13 +435,18 @@ class BaseJoinExec(ExecutionPlan):
         # outside the build key range never occupy collect memory (and a
         # selective filter keeps large probes under the collect limit
         # instead of tipping them onto the streaming path)
-        prefilter, covered = self._collect_prefilter(build_tbl, probe_keys,
-                                                     probe_is_left)
+        prefilter, covered, rf_ranges = self._collect_prefilter(
+            build_tbl, probe_keys, probe_is_left)
+        prune_pred = self._scan_prune_pred(probe, rf_ranges)
         chunks: List[pa.RecordBatch] = []
         rows = 0
         # Arrow-resident collection: sources that hold Arrow data (scans)
-        # stream it straight through without a ColumnBatch round trip
-        stream = probe.arrow_batches(partition)
+        # stream it straight through without a ColumnBatch round trip;
+        # parquet probes additionally row-group-prune by the runtime
+        # filter for THIS read only
+        stream = (probe.arrow_batches(partition, extra_prune=prune_pred)
+                  if prune_pred is not None
+                  else probe.arrow_batches(partition))
         overflowed = False
         for rb in stream:
             if prefilter is not None and rb.num_rows:
@@ -463,6 +468,37 @@ class BaseJoinExec(ExecutionPlan):
         yield from self._pa_join_once(build_tbl, chunks, probe_keys,
                                       probe_is_left, skip_filter_keys=covered)
 
+    @staticmethod
+    def _scan_prune_pred(probe, rf_ranges):
+        """Build-side join-key [min, max] runtime filter as a
+        scan-granularity pruning predicate for the probe's parquet scan —
+        with date-clustered fact tables whole row groups outside the
+        build key range are never decoded (the reference pushes its bloom
+        runtime filters into the probe scan the same way:
+        bloom_filter_might_contain.rs + parquet page filtering).
+        Row-exact filtering still happens in the collect prefilter; the
+        predicate is handed to ONE arrow_batches read (never stored on
+        the shared plan node).  None when inapplicable."""
+        from blaze_tpu.exprs.base import BoundReference, Literal
+        from blaze_tpu.exprs.binary import BinaryExpr
+        from blaze_tpu.ops.scan import ParquetScanExec
+        if (not rf_ranges or not isinstance(probe, ParquetScanExec)
+                or probe._out_partition_fields
+                or not config.PARQUET_ENABLE_PAGE_FILTERING.get()):
+            return None
+        pred = None
+        for _k, idx, mn, mx in rf_ranges:
+            if idx >= len(probe.schema):
+                continue
+            f = probe.schema[idx]
+            col = BoundReference(idx, f.name)
+            rng = BinaryExpr(
+                "and",
+                BinaryExpr(">=", col, Literal(mn.as_py(), f.data_type)),
+                BinaryExpr("<=", col, Literal(mx.as_py(), f.data_type)))
+            pred = rng if pred is None else BinaryExpr("and", pred, rng)
+        return pred
+
     def _runtime_filter_drop_ok(self, probe_is_left: bool) -> bool:
         """Whether dropping never-matching probe rows is semantics-
         preserving: inner joins and probe-side semi joins only."""
@@ -481,9 +517,11 @@ class BaseJoinExec(ExecutionPlan):
         outside the build side's integer join-key [min, max] ranges,
         applied batch-by-batch while the probe is being collected;
         `covered` lists the key positions it handled so the join-time
-        filter skips them.  (None, frozenset()) when inapplicable
-        (non-droppable join type, computed/non-integer keys)."""
-        none = (None, frozenset())
+        filter skips them; `ranges` [(key, probe_col, min, max)] lets the
+        caller push scan-granularity pruning.  (None, frozenset(), [])
+        when inapplicable (non-droppable join type, computed/non-integer
+        keys)."""
+        none = (None, frozenset(), [])
         if not (self._runtime_filter_drop_ok(probe_is_left)
                 and config.JOIN_RUNTIME_FILTER_ENABLE.get()):
             return none
@@ -508,7 +546,7 @@ class BaseJoinExec(ExecutionPlan):
             def drop_all(rb):
                 metrics.add("runtime_filter_pruned", rb.num_rows)
                 return rb.slice(0, 0)
-            return drop_all, frozenset(range(len(probe_keys)))
+            return drop_all, frozenset(range(len(probe_keys))), []
         if not ranges:
             return none
 
@@ -521,7 +559,7 @@ class BaseJoinExec(ExecutionPlan):
             metrics.add("runtime_filter_pruned",
                         rb.num_rows - out.num_rows)
             return out
-        return apply, frozenset(k for k, *_r in ranges)
+        return apply, frozenset(k for k, *_r in ranges), ranges
 
     def _runtime_filter_probe(self, build_tbl, probe_tbl, pprefix: str,
                               probe_is_left: bool,

@@ -19,22 +19,105 @@ from blaze_tpu.schema import Schema
 Interval = Tuple[Optional[object], Optional[object], bool]  # (min, max, has_nulls)
 
 
+def _name_to_col(md):
+    return {md.schema.column(i).name: i for i in range(len(md.schema))}
+
+
+def _group_stats(rg, name_to_col, strict_nulls: bool) -> dict:
+    """Per-column (min, max, has_nulls) for one row group.
+
+    strict_nulls: a MISSING null_count counts as "may have nulls" — the
+    always-match direction is only sound when absence of nulls is
+    PROVEN; the may-match direction stays permissive."""
+    stats = {}
+    for name, ci in name_to_col.items():
+        col = rg.column(ci)
+        if col.statistics is not None and col.statistics.has_min_max:
+            nc = col.statistics.null_count
+            has_nulls = ((nc is None or nc > 0) if strict_nulls
+                         else (nc or 0) > 0)
+            stats[name] = (col.statistics.min, col.statistics.max,
+                           has_nulls)
+    return stats
+
+
 def prune_with_stats(md, schema: Schema, predicate: PhysicalExpr,
                      groups: List[int]) -> List[int]:
-    name_to_col = {md.schema.column(i).name: i
-                   for i in range(len(md.schema))}
+    name_to_col = _name_to_col(md)
     keep = []
     for g in groups:
-        rg = md.row_group(g)
-        stats = {}
-        for name, ci in name_to_col.items():
-            col = rg.column(ci)
-            if col.statistics is not None and col.statistics.has_min_max:
-                stats[name] = (col.statistics.min, col.statistics.max,
-                               (col.statistics.null_count or 0) > 0)
+        stats = _group_stats(md.row_group(g), name_to_col,
+                             strict_nulls=False)
         if _may_match(predicate, schema, stats):
             keep.append(g)
     return keep
+
+
+def groups_always_match(md, schema: Schema, predicate: PhysicalExpr,
+                        groups: List[int]) -> bool:
+    """True only when stats PROVE every row of every listed group
+    satisfies `predicate` — lets the caller elide the filter mask for
+    fully-covered groups (the common case for a range predicate over a
+    date-clustered fact table).  Conservative: False when unsure."""
+    name_to_col = _name_to_col(md)
+    for g in groups:
+        stats = _group_stats(md.row_group(g), name_to_col,
+                             strict_nulls=True)
+        if not _always_match(predicate, schema, stats):
+            return False
+    return True
+
+
+def _always_match(pred: PhysicalExpr, schema: Schema, stats: dict) -> bool:
+    """True only when stats prove ALL rows match (a null comparison
+    evaluates null, which a filter drops, so a column with nulls in the
+    group can never prove always-match)."""
+    if isinstance(pred, BinaryExpr):
+        if pred.op == "and":
+            return (_always_match(pred.left, schema, stats) and
+                    _always_match(pred.right, schema, stats))
+        if pred.op == "or":
+            return (_always_match(pred.left, schema, stats) or
+                    _always_match(pred.right, schema, stats))
+        if pred.op in ("==", "<", "<=", ">", ">="):
+            name, lit, op = (_col_name(pred.left, schema),
+                             _lit_value(pred.right), pred.op)
+            if name is None and _col_name(pred.right, schema) is not None:
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                        "==": "=="}
+                name, lit, op = (_col_name(pred.right, schema),
+                                 _lit_value(pred.left), flip[pred.op])
+            if name is None or lit is None or name not in stats:
+                return False
+            mn, mx, has_nulls = stats[name]
+            if has_nulls:
+                return False
+            # parquet float/double min/max statistics IGNORE NaN rows,
+            # and a NaN comparison is false under the filter — floating
+            # stats can never PROVE all rows match (DataFusion applies
+            # the same restriction)
+            if isinstance(mn, float) or isinstance(mx, float):
+                return False
+            try:
+                if op == "==":
+                    return mn == lit == mx
+                if op == "<":
+                    return mx < lit
+                if op == "<=":
+                    return mx <= lit
+                if op == ">":
+                    return mn > lit
+                if op == ">=":
+                    return mn >= lit
+            except TypeError:
+                return False
+        return False
+    if isinstance(pred, IsNotNull):
+        name = _col_name(pred.child, schema)
+        if name is not None and name in stats:
+            return not stats[name][2]
+        return False
+    return False
 
 
 def _col_name(expr: PhysicalExpr, schema: Schema) -> Optional[str]:

@@ -162,20 +162,34 @@ class ParquetScanExec(ExecutionPlan):
         for rb in self.arrow_batches(partition):
             yield ColumnBatch.from_arrow(rb)
 
-    def arrow_batches(self, partition: int):
+    def arrow_batches(self, partition: int, extra_prune=None):
         """Arrow-resident scan stream.  Files under the eager threshold
         decode with pq.read_row_groups (multithreaded column decode,
         measurably faster than the single-threaded iter_batches slicer);
         batches re-slice zero-copy to the engine batch size.  Larger
-        files stream through iter_batches for bounded memory."""
+        files stream through iter_batches for bounded memory.
+
+        `extra_prune`: a pruning-ONLY predicate scoped to THIS read —
+        joins pass the build-side join-key [min, max] runtime filter here
+        so row groups provably outside the build range never decode (the
+        reference pushes its bloom runtime filters into the probe scan
+        the same way, ref bloom_filter_might_contain.rs + parquet page
+        filtering).  It prunes via statistics only; exact row filtering
+        stays with the caller.  Passing it per-read keeps the shared
+        plan node immutable across partitions/executions."""
         import os
+        prune_pred = self._predicate
+        if extra_prune is not None:
+            from blaze_tpu.exprs.binary import BinaryExpr
+            prune_pred = (extra_prune if prune_pred is None
+                          else BinaryExpr("and", prune_pred, extra_prune))
         eager_limit = config.SCAN_EAGER_FILE_BYTES.get()
         group = self._file_groups[partition]
         columns = ([f.name for f in self._file_part]
                    if self._projection is not None else None)
         # whole-group fast path: one multithreaded read across all files
         # (parallelism spans files, not just row groups within one)
-        if (len(group) > 1 and self._predicate is None
+        if (len(group) > 1 and prune_pred is None
                 and not self._out_partition_fields
                 and all(isinstance(p, str) and os.path.exists(p)
                         for p in group)
@@ -200,7 +214,7 @@ class ParquetScanExec(ExecutionPlan):
                 if config.IGNORE_CORRUPTED_FILES.get():
                     continue
                 raise
-            row_groups = self._prune_row_groups(f)
+            row_groups = self._prune_row_groups(f, prune_pred)
             self.metrics.add("pruned_row_groups",
                              f.metadata.num_row_groups - len(row_groups))
             if not row_groups:
@@ -247,14 +261,15 @@ class ParquetScanExec(ExecutionPlan):
         return pa.RecordBatch.from_arrays(
             arrays, schema=self._schema.to_arrow())
 
-    def _prune_row_groups(self, f: pq.ParquetFile) -> List[int]:
+    def _prune_row_groups(self, f: pq.ParquetFile,
+                          prune_pred=None) -> List[int]:
         md = f.metadata
         all_groups = list(range(md.num_row_groups))
-        if (self._predicate is None or
+        if (prune_pred is None or
                 not config.PARQUET_ENABLE_PAGE_FILTERING.get()):
             return all_groups
         from blaze_tpu.ops.pruning import prune_with_stats
-        return prune_with_stats(md, self._file_schema, self._predicate,
+        return prune_with_stats(md, self._file_schema, prune_pred,
                                 all_groups)
 
 

@@ -358,19 +358,33 @@ class FusedPartialAggExec(ExecutionPlan):
     def fused_mode(self) -> str:
         return "dense" if self._ranges is not None else "sorted"
 
-    def execute(self, partition: int) -> BatchIterator:
+    def _use_host_vectorized(self) -> bool:
         from blaze_tpu.bridge.placement import host_resident
-        if (config.FUSED_HOST_VECTORIZED_ENABLE.get() and host_resident()
-                and self._host_vectorized_eligible()):
+        return (config.FUSED_HOST_VECTORIZED_ENABLE.get() and
+                host_resident() and self._host_vectorized_eligible())
+
+    def execute(self, partition: int) -> BatchIterator:
+        if self._use_host_vectorized():
             # host placement: Arrow's multithreaded C++ hash aggregation
             # (GIL-releasing) is the host-engine analog of the reference's
             # native vectorized agg — faster than driving XLA-CPU programs
             # batch-by-batch from Python (ref agg_table.rs InMemTable)
-            yield from self._execute_host_vectorized(partition)
+            for rb in self._execute_host_vectorized(partition):
+                yield ColumnBatch.from_arrow(rb)
         elif self._ranges is not None:
             yield from self._execute_dense(partition)
         else:
             yield from self._execute_sorted(partition)
+
+    def arrow_batches(self, partition: int):
+        """Arrow-resident output: the host-vectorized path produces Arrow
+        record batches natively; handing them to Arrow-resident consumers
+        (runtime root, shuffle writer, Acero joins) skips the
+        ColumnBatch round trip in both directions."""
+        if self._use_host_vectorized():
+            yield from self._execute_host_vectorized(partition)
+        else:
+            yield from super().arrow_batches(partition)
 
     # -- host placement: Arrow C++ hash aggregation ------------------------
     def _host_vectorized_eligible(self) -> bool:
@@ -515,12 +529,14 @@ class FusedPartialAggExec(ExecutionPlan):
         yield from self._emit_batches(self._host_finalize(merged,
                                                           key_names))
 
-    def _emit_batches(self, rb) -> BatchIterator:
+    def _emit_batches(self, rb):
+        """Arrow record-batch chunks (the host-vectorized generators stay
+        Arrow-resident; execute() wraps into ColumnBatch at the edge)."""
         bs = config.BATCH_SIZE.get()
         for off in range(0, rb.num_rows, bs):
             chunk = rb.slice(off, min(bs, rb.num_rows - off))
             self.metrics.add("output_rows", chunk.num_rows)
-            yield ColumnBatch.from_arrow(chunk)
+            yield chunk
 
     def _host_passthrough(self, tbl, key_names) -> BatchIterator:
         """One raw keys/args table emitted in PARTIAL-output (acc) form
@@ -598,6 +614,14 @@ class FusedPartialAggExec(ExecutionPlan):
              predicates, non-parquet sources).
         """
         scan = self._host_scan_arrow(partition)
+        if scan is None and not self._chain:
+            # sources that natively hold Arrow data (IpcReader: the
+            # reduce-side merge input) stream it in without a ColumnBatch
+            # round trip, same as the pushdown-scan path
+            from blaze_tpu.ops.base import ExecutionPlan as _EP
+            src = self._source
+            if type(src).arrow_batches is not _EP.arrow_batches:
+                scan = src.arrow_batches(partition)
         if scan is None:
             for batch in self.children[0].execute(partition):
                 yield self._host_keys_args_table(batch, key_names)
@@ -683,13 +707,12 @@ class FusedPartialAggExec(ExecutionPlan):
                         for p in paths)
             if (local and sum(os.path.getsize(p) for p in paths)
                     <= eager_limit):
-                tbl = pq.read_table(
-                    paths, columns=[f.name for f in src._file_part],
-                    use_threads=True)
+                columns = [f.name for f in src._file_part]
                 if plain_preds:
-                    tbl = self._mask_filter(tbl, plain_preds, src.schema,
-                                            filt)
-                return iter((tbl,))
+                    return self._eager_pruned_read(
+                        paths, columns, plain_preds, src, filt)
+                return iter((pq.read_table(paths, columns=columns,
+                                           use_threads=True),))
             import pyarrow.dataset as ds
             dataset = ds.dataset([open_source(p) for p in paths],
                                  format="parquet",
@@ -699,6 +722,60 @@ class FusedPartialAggExec(ExecutionPlan):
             return scanner.to_batches()
         except Exception:
             return None  # schema evolution etc.: engine-side scan
+
+    def _eager_pruned_read(self, paths, columns, plain_preds, src, filt):
+        """Eager read with row-group statistics pruning + mask elision.
+
+        Parity: the reference's parquet row-group/page filtering (ref
+        conf.rs:43 `enable.pageFiltering`, parquet_exec.rs page_filtering)
+        applied to the eager host path.  A metadata-only pass drops row
+        groups the predicate provably never matches; groups the stats
+        prove FULLY matching skip the vectorized mask entirely (range
+        predicates over date-clustered fact tables make both the common
+        case).  Falls back to one whole read_table when nothing prunes —
+        identical cost to the pre-pruning path."""
+        import functools
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from blaze_tpu.exprs.binary import BinaryExpr
+        from blaze_tpu.ops.pruning import (groups_always_match,
+                                           prune_with_stats)
+        from blaze_tpu.ops.scan import open_source
+
+        pred = functools.reduce(
+            lambda a, b: BinaryExpr("and", a, b), plain_preds)
+        files = []          # (ParquetFile, kept_groups)
+        kept_total = 0
+        groups_total = 0
+        all_covered = True
+        for p in paths:
+            f = pq.ParquetFile(open_source(p))
+            md = f.metadata
+            kept = prune_with_stats(md, src.schema, pred,
+                                    list(range(md.num_row_groups)))
+            groups_total += md.num_row_groups
+            kept_total += len(kept)
+            if kept:
+                files.append((f, kept))
+                if all_covered and not groups_always_match(
+                        md, src.schema, pred, kept):
+                    all_covered = False
+        self.metrics.add("pruned_row_groups", groups_total - kept_total)
+        if kept_total == groups_total:
+            # nothing pruned: single multithreaded read across files
+            tbl = pq.read_table(paths, columns=columns, use_threads=True)
+            if not all_covered:
+                tbl = self._mask_filter(tbl, plain_preds, src.schema, filt)
+            return iter((tbl,))
+        if not files:
+            return iter(())
+        parts = [f.read_row_groups(kept, columns=columns,
+                                   use_threads=True)
+                 for f, kept in files]
+        tbl = parts[0] if len(parts) == 1 else pa.concat_tables(parts)
+        if not all_covered:
+            tbl = self._mask_filter(tbl, plain_preds, src.schema, filt)
+        return iter((tbl,))
 
     def _host_keys_args_table(self, batch: ColumnBatch, key_names):
         """Evaluate keys + agg args on the (numpy-resident) batch and pack

@@ -72,16 +72,23 @@ class IpcReaderExec(ExecutionPlan):
         return self._num_partitions
 
     def execute(self, partition: int) -> BatchIterator:
+        def gen():
+            for rb in self.arrow_batches(partition):
+                yield ColumnBatch.from_arrow(rb)
+        return iter(CoalesceStream(gen(), metrics=self.metrics))
+
+    def arrow_batches(self, partition: int):
+        """Arrow-resident read: decoded IPC frames go straight to
+        Arrow-resident consumers (the reduce-side host agg) without a
+        ColumnBatch round trip."""
         source = get_resource(self.resource_id)
         if source is None:
             raise KeyError(f"shuffle resource {self.resource_id!r} not found")
         blocks = source(partition) if callable(source) else source
-        def gen():
-            for block in blocks:
-                for rb in read_block(block):
-                    self.metrics.add("output_rows", rb.num_rows)
-                    yield ColumnBatch.from_arrow(rb)
-        return iter(CoalesceStream(gen(), metrics=self.metrics))
+        for block in blocks:
+            for rb in read_block(block):
+                self.metrics.add("output_rows", rb.num_rows)
+                yield rb
 
 
 class IpcWriterExec(ExecutionPlan):

@@ -72,11 +72,37 @@ class ShuffleRepartitioner(MemConsumer):
         if batch.num_rows == 0:
             return
         current_task().check_running()
+        if self.partitioning.num_partitions == 1:
+            self._stage(batch.to_arrow())
+            return
         pids = self.partitioning.partition_ids(batch)
         rb = batch.to_arrow()
         arrays = [pa.array(pids, type=pa.int32())] + list(rb.columns)
         staged = pa.RecordBatch.from_arrays(
             arrays, names=["__pid"] + list(rb.schema.names))
+        self._stage(staged)
+
+    def insert_arrow(self, rb) -> None:
+        """Arrow-resident insert: with ONE reduce partition no partition
+        ids are needed at all — the batch stages as-is (partition-id
+        work and the ColumnBatch round trip both vanish); multi-partition
+        falls back through ColumnBatch for the device pid kernel."""
+        if rb.num_rows == 0:
+            return
+        if self.partitioning.num_partitions == 1:
+            current_task().check_running()
+            if isinstance(rb, pa.Table):
+                for piece in rb.to_batches():
+                    if piece.num_rows:
+                        self._stage(piece)
+            else:
+                self._stage(rb)
+            return
+        if isinstance(rb, pa.Table):
+            rb = rb.combine_chunks().to_batches()[0]
+        self.insert_batch(ColumnBatch.from_arrow(rb))
+
+    def _stage(self, staged) -> None:
         self._staged.append(staged)
         self._staged_bytes += staged.nbytes
         self.update_mem_used(self._staged_bytes)
@@ -118,13 +144,12 @@ class ShuffleRepartitioner(MemConsumer):
         merged verbatim into an RSS push, shuffle/rss.rs analog)."""
         n_parts = self.partitioning.num_partitions
         if n_parts == 1:
-            # single reduce partition: every row is partition 0 — skip
-            # the pid sort/take entirely and stream staged batches out
+            # single reduce partition: every row is partition 0 — the
+            # insert paths stage batches WITHOUT a __pid column here, so
+            # they stream out as-is (no pid sort/take, no column strip)
             w = IpcCompressionWriter(sink, codec_name=codec_name)
             for staged in self._staged:
-                w.write_batch(pa.RecordBatch.from_arrays(
-                    list(staged.columns)[1:],
-                    names=list(staged.schema.names)[1:]))
+                w.write_batch(staged)
             w.finish()
             return [0, sink.tell()]
         tbl = pa.Table.from_batches(self._staged).combine_chunks()
@@ -241,10 +266,23 @@ class ShuffleWriterExec(ExecutionPlan):
         rep = ShuffleRepartitioner(self.partitioning, self.schema,
                                    self.metrics)
         rep.set_spillable(MemManager.get())
+        child = self.children[0]
+        # single-partition writes take the Arrow-resident insert (no
+        # partition ids needed) when the child natively produces Arrow;
+        # multi-partition keeps ColumnBatch — partition ids come from the
+        # device pid kernel, and round-tripping Arrow through
+        # insert_arrow would ADD conversions for device-resident children
+        arrow_native = (self.partitioning.num_partitions == 1
+                        and type(child).arrow_batches
+                        is not ExecutionPlan.arrow_batches)
         try:
             with self.metrics.timer("elapsed_compute"):
-                for batch in self.children[0].execute(partition):
-                    rep.insert_batch(batch)
+                if arrow_native:
+                    for rb in child.arrow_batches(partition):
+                        rep.insert_arrow(rb)
+                else:
+                    for batch in child.execute(partition):
+                        rep.insert_batch(batch)
                 self.partition_lengths = rep.write(self.data_file,
                                                    self.index_file)
             self.metrics.add("data_size", sum(self.partition_lengths))

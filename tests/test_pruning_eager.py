@@ -1,0 +1,188 @@
+"""Eager-scan row-group pruning + mask elision + join runtime-filter
+scan pruning (ref parquet page filtering conf.rs:43; runtime-filter
+pushdown bloom_filter_might_contain.rs)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.plan.planner import create_plan
+from blaze_tpu.plan.fused import fuse_plan
+
+
+SCHEMA = {"fields": [
+    {"name": "dt", "type": {"id": "int64"}, "nullable": True},
+    {"name": "k", "type": {"id": "int64"}, "nullable": True},
+    {"name": "v", "type": {"id": "float64"}, "nullable": True},
+]}
+
+
+def _col(name):
+    return {"kind": "column", "name": name}
+
+
+def _lit(v):
+    return {"kind": "literal", "value": v, "type": {"id": "int64"}}
+
+
+def _write(tmp_path, with_nulls=False, rows=20_000, group=2048):
+    rng = np.random.default_rng(3)
+    dt = np.sort(rng.integers(0, 1000, rows))
+    k = rng.integers(0, 50, rows)
+    v = np.round(rng.random(rows), 3)
+    cols = {"dt": pa.array(dt), "k": pa.array(k), "v": pa.array(v)}
+    if with_nulls:
+        m = rng.random(rows) < 0.01
+        cols["dt"] = pa.array(np.where(m, None, dt).tolist(),
+                              type=pa.int64())
+    t = pa.table(cols)
+    p = os.path.join(str(tmp_path), "t.parquet")
+    pq.write_table(t, p, row_group_size=group)
+    return t, p
+
+
+def _agg_plan(path, lo, hi):
+    return {
+        "kind": "hash_agg",
+        "groupings": [{"expr": _col("k"), "name": "k"}],
+        "aggs": [{"fn": "sum", "mode": "partial", "name": "s",
+                  "args": [_col("v")]}],
+        "input": {"kind": "filter",
+                  "predicates": [
+                      {"kind": "binary", "op": ">=", "l": _col("dt"),
+                       "r": _lit(lo)},
+                      {"kind": "binary", "op": "<=", "l": _col("dt"),
+                       "r": _lit(hi)}],
+                  "input": {"kind": "parquet_scan", "schema": SCHEMA,
+                            "file_groups": [[path]]}}}
+
+
+def _run_sum(plan_dict):
+    plan = fuse_plan(create_plan(plan_dict))
+    total = {}
+    for cb in plan.execute(0):
+        rb = cb.compact().to_arrow()
+        for kk, ss in zip(rb.column(0).to_pylist(),
+                          rb.column(1).to_pylist()):
+            total[kk] = total.get(kk, 0.0) + (ss or 0.0)
+    return plan, total
+
+
+def _oracle(t, lo, hi):
+    mask = pc.and_(pc.greater_equal(t["dt"], lo),
+                   pc.less_equal(t["dt"], hi))
+    f = t.filter(mask)
+    agg = f.group_by(["k"]).aggregate([("v", "sum")])
+    return dict(zip(agg["k"].to_pylist(), agg["v_sum"].to_pylist()))
+
+
+def test_eager_pruned_read_matches_oracle_and_prunes(tmp_path):
+    t, p = _write(tmp_path)
+    lo, hi = 300, 600
+    plan, got = _run_sum(_agg_plan(p, lo, hi))
+    want = _oracle(t, lo, hi)
+    assert set(got) == set(want)
+    for kk in want:
+        assert abs(got[kk] - want[kk]) < 1e-9
+    # clustered dt + narrow range => some of the ~10 groups pruned
+    pruned = _find_metric(plan, "pruned_row_groups")
+    assert pruned and pruned > 0
+
+
+def test_mask_not_elided_when_nulls_present(tmp_path):
+    """Null dt rows must be dropped by the filter even in row groups the
+    stats say are fully covered (always-match must refuse when
+    null_count > 0)."""
+    t, p = _write(tmp_path, with_nulls=True)
+    lo, hi = 0, 1000  # covers EVERY non-null row: elision would be
+    #                   tempting, but nulls must still drop
+    _plan, got = _run_sum(_agg_plan(p, lo, hi))
+    want = _oracle(t, lo, hi)
+    assert set(got) == set(want)
+    for kk in want:
+        assert abs(got[kk] - want[kk]) < 1e-9
+    # sanity: the oracle really dropped rows (nulls exist)
+    assert sum(1 for v in t["dt"].to_pylist() if v is None) > 0
+
+
+def test_always_match_refuses_float_stats():
+    """Parquet float min/max stats ignore NaN; always-match must never
+    trust them."""
+    from blaze_tpu.exprs.base import BoundReference, Literal
+    from blaze_tpu.exprs.binary import BinaryExpr
+    from blaze_tpu.ops.pruning import groups_always_match
+    from blaze_tpu.schema import Schema
+
+    t = pa.table({"x": pa.array([1.0, float("nan"), 5.0])})
+    import io
+    buf = io.BytesIO()
+    pq.write_table(t, buf)
+    md = pq.ParquetFile(io.BytesIO(buf.getvalue())).metadata
+    schema = Schema.from_arrow(t.schema)
+    pred = BinaryExpr("<=", BoundReference(0, "x"),
+                      Literal(1e9, schema[0].data_type))
+    assert not groups_always_match(md, schema, pred, [0])
+
+
+def test_join_runtime_filter_prunes_probe_scan(tmp_path):
+    """Build-side [min,max] runtime filter reaches the probe scan as
+    row-group pruning; results equal pyarrow's join."""
+    rng = np.random.default_rng(5)
+    rows = 30_000
+    dt = np.sort(rng.integers(0, 1000, rows))
+    probe = pa.table({"dt": pa.array(dt),
+                      "pv": pa.array(rng.random(rows))})
+    pp = os.path.join(str(tmp_path), "probe.parquet")
+    pq.write_table(probe, pp, row_group_size=2048)
+    build = pa.table({"bk": pa.array(np.arange(450, 475)),
+                      "bv": pa.array(np.arange(25, dtype=np.float64))})
+    bp = os.path.join(str(tmp_path), "build.parquet")
+    pq.write_table(build, bp)
+
+    plan_dict = {
+        "kind": "broadcast_join",
+        "join_type": "inner",
+        "left_keys": [_col("dt")],
+        "right_keys": [_col("bk")],
+        "left": {"kind": "parquet_scan",
+                 "schema": {"fields": [
+                     {"name": "dt", "type": {"id": "int64"},
+                      "nullable": True},
+                     {"name": "pv", "type": {"id": "float64"},
+                      "nullable": True}]},
+                 "file_groups": [[pp]]},
+        "right": {"kind": "parquet_scan",
+                  "schema": {"fields": [
+                      {"name": "bk", "type": {"id": "int64"},
+                       "nullable": True},
+                      {"name": "bv", "type": {"id": "float64"},
+                       "nullable": True}]},
+                  "file_groups": [[bp]]},
+        "build_side": "right"}
+    plan = fuse_plan(create_plan(plan_dict))
+    out_rows = 0
+    for cb in plan.execute(0):
+        out_rows += cb.compact().to_arrow().num_rows
+    want = probe.join(build, keys="dt", right_keys="bk",
+                      join_type="inner")
+    assert out_rows == want.num_rows
+    # the probe scan must have skipped most of its ~15 row groups
+    scan = plan.children[0]
+    pruned = _find_metric(scan, "pruned_row_groups")
+    assert pruned and pruned > 5
+
+
+def _find_metric(plan, name):
+    """Search the plan tree for a metric value."""
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        v = node.metrics.get(name) if hasattr(node, "metrics") else None
+        if v:
+            return v
+        stack.extend(getattr(node, "children", []) or [])
+    return None
