@@ -282,9 +282,17 @@ def ensure_dataset(scale: float = SCALE):
 def _scratch_dir(prefix):
     """Shuffle scratch on the RAM disk when available — the standard
     spark.local.dir-on-tmpfs deployment (shuffle files are transient;
-    ext4 journaling is pure overhead for them)."""
+    ext4 journaling is pure overhead for them).  Containers often mount
+    a tiny /dev/shm (docker default 64 MB), so require real headroom or
+    fall back to /tmp."""
     import tempfile
-    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    base = None
+    try:
+        sv = os.statvfs("/dev/shm")
+        if sv.f_bavail * sv.f_frsize >= (2 << 30):
+            base = "/dev/shm"
+    except OSError:
+        pass
     return tempfile.mkdtemp(prefix=prefix, dir=base)
 
 

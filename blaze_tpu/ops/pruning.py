@@ -23,6 +23,19 @@ def _name_to_col(md):
     return {md.schema.column(i).name: i for i in range(len(md.schema))}
 
 
+def pred_columns(pred: PhysicalExpr, schema: Schema) -> set:
+    """Column names the predicate references (for stats extraction)."""
+    out = set()
+    stack = [pred]
+    while stack:
+        e = stack.pop()
+        name = _col_name(e, schema)
+        if name is not None:
+            out.add(name)
+        stack.extend(getattr(e, "children", lambda: ())() or ())
+    return out
+
+
 def _group_stats(rg, name_to_col, strict_nulls: bool) -> dict:
     """Per-column (min, max, has_nulls) for one row group.
 
@@ -41,9 +54,16 @@ def _group_stats(rg, name_to_col, strict_nulls: bool) -> dict:
     return stats
 
 
+def _pred_cols_map(md, schema: Schema, predicate: PhysicalExpr) -> dict:
+    """name->column-index restricted to predicate-referenced columns —
+    stats extraction cost scales with the predicate, not the schema."""
+    wanted = pred_columns(predicate, schema)
+    return {n: i for n, i in _name_to_col(md).items() if n in wanted}
+
+
 def prune_with_stats(md, schema: Schema, predicate: PhysicalExpr,
                      groups: List[int]) -> List[int]:
-    name_to_col = _name_to_col(md)
+    name_to_col = _pred_cols_map(md, schema, predicate)
     keep = []
     for g in groups:
         stats = _group_stats(md.row_group(g), name_to_col,
@@ -59,13 +79,22 @@ def groups_always_match(md, schema: Schema, predicate: PhysicalExpr,
     satisfies `predicate` — lets the caller elide the filter mask for
     fully-covered groups (the common case for a range predicate over a
     date-clustered fact table).  Conservative: False when unsure."""
-    name_to_col = _name_to_col(md)
+    covered, _boundary = split_covered(md, schema, predicate, groups)
+    return len(covered) == len(groups)
+
+
+def split_covered(md, schema: Schema, predicate: PhysicalExpr,
+                  groups: List[int]):
+    """(covered, boundary): kept groups whose stats PROVE full predicate
+    coverage (filter mask elidable) vs the rest — one metadata pass."""
+    name_to_col = _pred_cols_map(md, schema, predicate)
+    covered, boundary = [], []
     for g in groups:
         stats = _group_stats(md.row_group(g), name_to_col,
                              strict_nulls=True)
-        if not _always_match(predicate, schema, stats):
-            return False
-    return True
+        (covered if _always_match(predicate, schema, stats)
+         else boundary).append(g)
+    return covered, boundary
 
 
 def _always_match(pred: PhysicalExpr, schema: Schema, stats: dict) -> bool:

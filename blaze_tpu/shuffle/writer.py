@@ -65,6 +65,57 @@ class ShuffleRepartitioner(MemConsumer):
         self._staged_bytes = 0
         self._spills: List[_PartitionedSpill] = []
         self._metrics = metrics
+        self._stream_sink: Optional[BinaryIO] = None
+        self._stream_writer: Optional[IpcCompressionWriter] = None
+        self._stream_file: Optional[str] = None
+        self._stream_tmp: Optional[str] = None
+
+    # -- streaming single-partition mode -----------------------------------
+    def open_stream(self, data_file: str) -> bool:
+        """Single-reduce-partition local writes stream frames straight
+        into the .data file as batches arrive: no staging buffer, no
+        end-of-task serialization hump, and upstream compute overlaps
+        shuffle IO.  Only valid before the first insert; multi-partition
+        layouts still need the staged pid sort."""
+        if (self.partitioning.num_partitions != 1 or self._staged
+                or self._spills):
+            return False
+        # write to a task-private temp path, os.replace at finalize: a
+        # failed/speculative attempt can never leave a truncated .data
+        # at the final path or truncate a sibling attempt's output
+        # (AuronShuffleWriterBase's tmp-file + commit discipline)
+        self._stream_tmp = (f"{data_file}.inprogress"
+                            f".{os.getpid()}.{id(self):x}")
+        self._stream_sink = open(self._stream_tmp, "wb")
+        self._stream_file = data_file
+        return True
+
+    def _stream_write(self, rb) -> None:
+        if self._stream_writer is None:
+            self._stream_writer = IpcCompressionWriter(
+                self._stream_sink,
+                codec_name=config.SHUFFLE_FILE_CODEC.get())
+        if isinstance(rb, pa.Table):
+            for piece in rb.to_batches():
+                if piece.num_rows:
+                    self._stream_writer.write_batch(piece)
+        else:
+            self._stream_writer.write_batch(rb)
+
+    def close(self) -> None:
+        """Abandon an un-finalized stream (task failure path): the
+        temp file is removed, the final path never existed."""
+        if self._stream_sink is not None:
+            try:
+                self._stream_sink.close()
+            except OSError:
+                pass
+            try:
+                os.unlink(self._stream_tmp)
+            except OSError:
+                pass
+            self._stream_sink = None
+            self._stream_writer = None
 
     # -- insert (ref ShuffleRepartitioner::insert_batch, shuffle/mod.rs:55)
     def insert_batch(self, batch: ColumnBatch) -> None:
@@ -73,7 +124,10 @@ class ShuffleRepartitioner(MemConsumer):
             return
         current_task().check_running()
         if self.partitioning.num_partitions == 1:
-            self._stage(batch.to_arrow())
+            if self._stream_sink is not None:
+                self._stream_write(batch.to_arrow())
+            else:
+                self._stage(batch.to_arrow())
             return
         pids = self.partitioning.partition_ids(batch)
         rb = batch.to_arrow()
@@ -91,7 +145,9 @@ class ShuffleRepartitioner(MemConsumer):
             return
         if self.partitioning.num_partitions == 1:
             current_task().check_running()
-            if isinstance(rb, pa.Table):
+            if self._stream_sink is not None:
+                self._stream_write(rb)
+            elif isinstance(rb, pa.Table):
                 for piece in rb.to_batches():
                     if piece.num_rows:
                         self._stage(piece)
@@ -177,6 +233,21 @@ class ShuffleRepartitioner(MemConsumer):
     # -- final write (ref shuffle_write, shuffle/mod.rs:58) ----------------
     def write(self, data_file: str, index_file: str) -> List[int]:
         """Merge spills + staged rows into .data/.index; returns lengths."""
+        if self._stream_sink is not None:
+            # streaming mode: frames are already on disk; finish, commit
+            # via atomic rename, then index
+            assert data_file == self._stream_file
+            if self._stream_writer is not None:
+                self._stream_writer.finish()
+            end = self._stream_sink.tell()
+            self._stream_sink.close()
+            self._stream_sink = None
+            self._stream_writer = None
+            os.replace(self._stream_tmp, data_file)
+            with open(index_file, "wb") as idx:
+                idx.write(struct.pack("<q", 0))
+                idx.write(struct.pack("<q", end))
+            return [end]
         mem_offsets: List[int] = []
         mem_buf = io.BytesIO()
         if self._staged:
@@ -277,6 +348,9 @@ class ShuffleWriterExec(ExecutionPlan):
                         is not ExecutionPlan.arrow_batches)
         try:
             with self.metrics.timer("elapsed_compute"):
+                # single-reduce local writes stream frames to disk as
+                # they arrive (compute/IO overlap, no staging hump)
+                rep.open_stream(self.data_file)
                 if arrow_native:
                     for rb in child.arrow_batches(partition):
                         rep.insert_arrow(rb)
@@ -287,6 +361,7 @@ class ShuffleWriterExec(ExecutionPlan):
                                                    self.index_file)
             self.metrics.add("data_size", sum(self.partition_lengths))
         finally:
+            rep.close()
             rep.unregister()
         return iter(())
 
