@@ -405,6 +405,13 @@ PARTIAL_AGG_SKIPPING_PROBE_ROWS = int_conf(
     "whole buffer, so repeated keys depress the ratio and the skip "
     "decision errs toward keeping the aggregation.",
     category="operator")
+SMJ_ACERO_ENABLE = bool_conf(
+    "auron.tpu.smj.acero.enable", True,
+    "Sort-merge joins whose sides fit the host collect budget run "
+    "through Arrow's C++ hash join with the output re-sorted by the "
+    "join keys (preserving SMJ's ordering contract); larger inputs "
+    "keep the spillable streaming merge.",
+    category="operator")
 PARTIAL_AGG_SKIPPING_SKIP_SPILL = bool_conf(
     "auron.partialAggSkipping.skipSpill", False,
     "Under memory pressure, switch a partial agg to pass-through instead "

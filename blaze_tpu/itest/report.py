@@ -25,6 +25,22 @@ def run_report(scale: float, partitions: int, names=None,
                wire: bool = False, budget_bytes: int = 4 << 30):
     import pandas as pd
 
+    # engine init (backend probe + placement decision) amortizes across
+    # the report, not charged to whichever query happens to run first —
+    # the dev/auron-it harness likewise starts one Spark session before
+    # timing any query
+    import os as _os
+    if _os.environ.get("JAX_PLATFORMS"):
+        import jax
+        # the axon plugin ignores the env var; force through jax.config
+        try:
+            jax.config.update("jax_platforms",
+                              _os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+    from blaze_tpu.bridge.placement import ensure_placement
+    ensure_placement()
+
     from blaze_tpu.itest import generate
     from blaze_tpu.itest.queries import QUERIES
     from blaze_tpu.itest.runner import compare_frames

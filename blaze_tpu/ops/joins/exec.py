@@ -827,10 +827,115 @@ class SortMergeJoinExec(BaseJoinExec):
                 return child
         return SortExec(child, [(k, False, True) for k in keys])
 
+    def _acero_sorted(self, partition: int):
+        """Materialized host path: both sides within the collect budget
+        join through Arrow's C++ hash join, and the OUTPUT re-sorts by
+        the join keys (ascending, nulls first) to preserve SMJ's
+        output-ordering contract for downstream consumers.  Returns None
+        — falling back to the streaming run-cursor merge — when a side
+        overflows the budget (the spillable path exists precisely for
+        that), keys are computed expressions, or Acero lacks the join
+        type.  A run-cursor merge over N one-row key runs is O(N)
+        Python; this path replaces it with two vectorized passes (the
+        q97 distinct-pair FULL OUTER was 200x slower streaming)."""
+        from blaze_tpu.bridge.placement import host_resident
+        from blaze_tpu.exprs.base import BoundReference
+        if (not host_resident() or not self._pa_join_eligible()
+                or not config.SMJ_ACERO_ENABLE.get()):
+            return None  # EXISTENCE is already outside _PA_JOIN_TYPES
+        if not all(isinstance(k, BoundReference)
+                   for k in self.left_keys + self.right_keys):
+            return None
+        limit = config.FUSED_HOST_COLLECT_ROWS.get()
+        sides = []
+        for i in (0, 1):
+            chunks, rows = [], 0
+            stream = self.children[i].arrow_batches(partition)
+            for rb in stream:
+                if rb.num_rows == 0:
+                    continue
+                chunks.append(rb)
+                rows += rb.num_rows
+                if rows > limit:
+                    # hand everything consumed so far back to execute():
+                    # when child output is already key-sorted, the
+                    # streaming merge resumes from these chunks without
+                    # re-reading the input
+                    return ("overflow", i, sides, chunks, stream)
+            sides.append(chunks)
+        build_tbl = self._join_key_table(
+            self.children[1].schema,
+            (pa.Table.from_batches(sides[1]) if sides[1]
+             else pa.Table.from_batches(
+                 [], schema=self.children[1].schema.to_arrow())),
+            self.right_keys, "r")
+
+        def gen():
+            out = list(self._pa_join_once(build_tbl, sides[0],
+                                          self.left_keys, True))
+            if not out:
+                return
+            tbl = pa.Table.from_batches(
+                [cb.compact().to_arrow() for cb in out])
+            order = self._smj_output_order(tbl)
+            if order is not None:
+                tbl = tbl.take(order)
+            bs = config.BATCH_SIZE.get()
+            for off in range(0, tbl.num_rows, bs):
+                yield ColumnBatch.from_arrow(
+                    tbl.slice(off, min(bs, tbl.num_rows - off))
+                    .combine_chunks())
+        return gen()
+
+    def _smj_output_order(self, tbl):
+        """Sort indices restoring key order (nulls first).  Key columns
+        live at the BoundReference positions of whichever side(s) the
+        output carries; FULL/RIGHT joins coalesce left/right keys (the
+        unmatched side's key is null)."""
+        jt = self.join_type
+        nl = len(self.children[0].schema)
+        if jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI):
+            keys = [tbl.column(k.index) for k in self.right_keys]
+        elif jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
+                    JoinType.INNER, JoinType.LEFT):
+            keys = [tbl.column(k.index) for k in self.left_keys]
+        elif jt in (JoinType.RIGHT, JoinType.FULL):
+            keys = [pc.coalesce(tbl.column(lk.index),
+                                tbl.column(nl + rk.index))
+                    for lk, rk in zip(self.left_keys, self.right_keys)]
+        else:
+            return None
+        kt = pa.table(keys, names=[f"k{i}" for i in range(len(keys))])
+        return pc.sort_indices(
+            kt, sort_keys=[(f"k{i}", "ascending")
+                           for i in range(len(keys))],
+            null_placement="at_start")
+
     def execute(self, partition: int) -> BatchIterator:
         from blaze_tpu.ops.joins.smj import MergeJoiner, _RunCursor
-        left = self._sorted_child(0)
-        right = self._sorted_child(1)
+        acero = self._acero_sorted(partition)
+        l_stream = r_stream = None
+        if isinstance(acero, tuple):
+            # collect-budget overflow on side i.  If every side whose
+            # data was already consumed is ALREADY key-sorted (children
+            # are SortExecs in translated plans), resume the streaming
+            # merge from the buffered chunks — no re-read; otherwise
+            # fall through to full re-execution (a fresh SortExec would
+            # have to see all rows anyway).
+            _tag, i, done, part_chunks, rest = acero
+            consumed = list(range(i + 1))
+            if all(self._sorted_child(j) is self.children[j]
+                   for j in consumed):
+                chained = itertools.chain(part_chunks, rest)
+                if i == 0:
+                    l_stream = chained
+                else:
+                    l_stream = iter(done[0])
+                    r_stream = chained
+            acero = None
+        if acero is not None:
+            # output_rows is counted inside _pa_join_once already
+            return iter(acero)
 
         def arrow_stream(plan):
             for b in plan.execute(partition):
@@ -838,13 +943,18 @@ class SortMergeJoinExec(BaseJoinExec):
                 if rb.num_rows:
                     yield rb
 
+        if l_stream is None:
+            l_stream = arrow_stream(self._sorted_child(0))
+        if r_stream is None:
+            r_stream = arrow_stream(self._sorted_child(1))
+
         joiner = MergeJoiner(self.children[0].schema,
                              self.children[1].schema, self.schema,
                              self.join_type, self.join_filter,
                              self._existence_col)
-        lcur = _RunCursor(arrow_stream(left), self.left_keys,
+        lcur = _RunCursor(l_stream, self.left_keys,
                           self.children[0].schema)
-        rcur = _RunCursor(arrow_stream(right), self.right_keys,
+        rcur = _RunCursor(r_stream, self.right_keys,
                           self.children[1].schema)
 
         def gen():

@@ -21,6 +21,7 @@ from typing import List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
+import pyarrow.compute as pc
 
 from blaze_tpu import config
 from blaze_tpu.batch import ColumnBatch
@@ -28,7 +29,7 @@ from blaze_tpu.memory import MemConsumer, try_new_spill
 from blaze_tpu.exprs import PhysicalExpr
 from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
 from blaze_tpu.ops.sort import host_sort_keys
-from blaze_tpu.schema import (DataType, Field, FLOAT64, INT32, INT64, Schema)
+from blaze_tpu.schema import (DataType, Field, FLOAT64, INT32, INT64, Schema, TypeId)
 
 
 class WindowRankType(enum.Enum):
@@ -227,21 +228,22 @@ class WindowExec(ExecutionPlan):
         in_schema = self.children[0].schema
         cb = ColumnBatch.from_arrow(rb)
 
-        part_seg, order_change = self._segments(rb, cb)
-        # positions & per-partition geometry (device prefix scans)
-        pos = jnp.arange(n, dtype=jnp.int64)
-        seg_start = _segment_start(part_seg, pos)
-        row_number = (pos - seg_start + 1).astype(jnp.int32)
+        xp = _window_xp()
+        part_seg, order_change = self._segments(rb, cb, xp)
+        # positions & per-partition geometry (prefix scans; xp = numpy
+        # on host placement, jnp on device)
+        pos = xp.arange(n, dtype=xp.int64)
+        seg_start = _segment_start(part_seg, pos, xp)
+        row_number = (pos - seg_start + 1).astype(xp.int32)
         # partition sizes via boundary scatter
-        part_size = _segment_size(part_seg, n)
+        part_size = _segment_size(part_seg, n, xp)
 
         # rank: position of the last (partition-or-order) change before/at row
         change = part_seg | order_change
-        rank = (pos - _running_max_where(change, pos) + 0).astype(jnp.int64)
-        rank_val = (_running_max_where(change, pos) - seg_start + 1
-                    ).astype(jnp.int32)
-        dense = _segmented_cumsum(order_change & ~part_seg, part_seg
-                                  ).astype(jnp.int32) + 1
+        rank_pos = _running_max_where(change, pos, xp)
+        rank_val = (rank_pos - seg_start + 1).astype(xp.int32)
+        dense = _segmented_cumsum(order_change & ~part_seg, part_seg,
+                                  xp).astype(xp.int32) + 1
 
         out_cols: List[pa.Array] = list(rb.columns)
         np_part_seg = np.asarray(part_seg)
@@ -249,14 +251,14 @@ class WindowExec(ExecutionPlan):
             if isinstance(f, RankFunc):
                 out_cols.append(self._rank_col(f, row_number, rank_val, dense,
                                                part_size, seg_start, change,
-                                               pos, n))
+                                               pos, n, xp))
             elif isinstance(f, LeadLagFunc):
                 out_cols.append(self._lead_lag(f, cb, np_part_seg, n))
             elif isinstance(f, NthValueFunc):
                 out_cols.append(self._nth_value(f, cb, seg_start, part_size, n))
             elif isinstance(f, WindowAggFunc):
                 out_cols.append(self._window_agg(f, cb, rb, part_seg,
-                                                 order_change, n))
+                                                 order_change, n, xp))
             else:
                 raise TypeError(f"unknown window function {f}")
 
@@ -295,7 +297,7 @@ class WindowExec(ExecutionPlan):
                 part_seg[1:] |= k[1:] != k[:-1]
         return part_seg
 
-    def _segments(self, rb: pa.RecordBatch, cb: ColumnBatch):
+    def _segments(self, rb: pa.RecordBatch, cb: ColumnBatch, xp=jnp):
         """(partition_boundary, order_change) bool arrays over rows."""
         n = rb.num_rows
         part_seg = self._part_boundaries(rb, cb)
@@ -312,10 +314,10 @@ class WindowExec(ExecutionPlan):
                 order_change[1:] |= k[1:] != k[:-1]
         else:
             order_change = np.ones(n, dtype=bool)
-        return jnp.asarray(part_seg), jnp.asarray(order_change)
+        return xp.asarray(part_seg), xp.asarray(order_change)
 
     def _rank_col(self, f: RankFunc, row_number, rank_val, dense, part_size,
-                  seg_start, change, pos, n) -> pa.Array:
+                  seg_start, change, pos, n, xp=jnp) -> pa.Array:
         k = f.kind
         if k == WindowRankType.ROW_NUMBER:
             return pa.array(np.asarray(row_number), type=pa.int32())
@@ -324,14 +326,14 @@ class WindowExec(ExecutionPlan):
         if k == WindowRankType.DENSE_RANK:
             return pa.array(np.asarray(dense), type=pa.int32())
         if k == WindowRankType.PERCENT_RANK:
-            denom = jnp.maximum(part_size - 1, 1).astype(jnp.float64)
-            out = (rank_val.astype(jnp.float64) - 1.0) / denom
-            out = jnp.where(part_size == 1, 0.0, out)
+            denom = xp.maximum(part_size - 1, 1).astype(xp.float64)
+            out = (rank_val.astype(xp.float64) - 1.0) / denom
+            out = xp.where(part_size == 1, 0.0, out)
             return pa.array(np.asarray(out), type=pa.float64())
         # CUME_DIST: (last row position with same order value + 1 - start)/size
-        last_same = _next_change_pos(change, pos, n)
-        out = (last_same - seg_start).astype(jnp.float64) / \
-            part_size.astype(jnp.float64)
+        last_same = _next_change_pos(change, pos, n, xp)
+        out = (last_same - seg_start).astype(xp.float64) / \
+            part_size.astype(xp.float64)
         return pa.array(np.asarray(out), type=pa.float64())
 
     def _lead_lag(self, f: LeadLagFunc, cb: ColumnBatch, part_seg: np.ndarray,
@@ -344,8 +346,8 @@ class WindowExec(ExecutionPlan):
         safe = np.clip(idx, 0, n - 1)
         ok &= pid[safe] == pid  # stay inside the partition
         shifted = vals.take(pa.array(safe, type=pa.int64()))
-        py = [shifted[i].as_py() if ok[i] else f.default for i in range(n)]
-        return pa.array(py, type=vals.type)
+        default = pa.scalar(f.default, type=vals.type)
+        return pc.if_else(pa.array(ok), shifted, default)
 
     def _nth_value(self, f: NthValueFunc, cb: ColumnBatch, seg_start,
                    part_size, n: int) -> pa.Array:
@@ -369,128 +371,178 @@ class WindowExec(ExecutionPlan):
             ok = (f.n - 1) < np.asarray(part_size)
         safe = np.clip(target, 0, n - 1)
         taken = vals.take(pa.array(safe, type=pa.int64()))
-        py = [taken[i].as_py() if ok[i] else None for i in range(n)]
-        return pa.array(py, type=vals.type)
+        return pc.if_else(pa.array(ok), taken,
+                          pa.scalar(None, type=vals.type))
 
     def _window_agg(self, f: WindowAggFunc, cb: ColumnBatch,
-                    rb: pa.RecordBatch, part_seg, order_change, n
+                    rb: pa.RecordBatch, part_seg, order_change, n, xp=jnp
                     ) -> pa.Array:
         from blaze_tpu.ops.agg.functions import (AvgAgg, CountAgg, MinMaxAgg,
                                                  SumAgg)
         e = f.agg.children[0] if f.agg.children else None
-        v = e.evaluate(cb).to_device(cb.capacity) if e is not None else None
-        data = v.data[:n] if v is not None else jnp.ones(n, dtype=jnp.int64)
-        valid = v.validity[:n] if v is not None else jnp.ones(n, dtype=bool)
+        if e is not None:
+            v = e.evaluate(cb)
+            host_fast = (xp is np and
+                         e.data_type(cb.schema).id != TypeId.DECIMAL)
+            if host_fast:
+                arr = v.to_host(n)
+                data = np.asarray(arr.cast(
+                    pa.float64() if pa.types.is_floating(arr.type)
+                    else pa.int64(), safe=False).fill_null(0))
+                valid = np.asarray(arr.is_valid())
+            else:
+                # decimals keep the unscaled-int64 device representation
+                # on either placement (a float/int cast would truncate
+                # the fraction)
+                dv = v.to_device(cb.capacity)
+                data = dv.data[:n]
+                valid = dv.validity[:n]
+                if xp is np:
+                    data = np.asarray(data)
+                    valid = np.asarray(valid)
+        else:
+            data = xp.ones(n, dtype=xp.int64)
+            valid = xp.ones(n, dtype=bool)
         running = f.running and bool(self.order_by)
         if isinstance(f.agg, CountAgg):
-            acc = _segmented_cumsum(valid.astype(jnp.int64), part_seg)
-            out, ovalid = acc, jnp.ones(n, dtype=bool)
+            acc = _segmented_cumsum(valid.astype(xp.int64), part_seg, xp)
+            out, ovalid = acc, xp.ones(n, dtype=bool)
         elif isinstance(f.agg, (SumAgg, AvgAgg)):
-            dt = jnp.float64 if jnp.issubdtype(data.dtype, jnp.floating) \
-                else jnp.int64
-            s = _segmented_cumsum(jnp.where(valid, data.astype(dt), 0),
-                                  part_seg)
-            c = _segmented_cumsum(valid.astype(jnp.int64), part_seg)
+            dt = xp.float64 if xp.issubdtype(data.dtype, xp.floating) \
+                else xp.int64
+            s = _segmented_cumsum(xp.where(valid, data.astype(dt), 0),
+                                  part_seg, xp)
+            c = _segmented_cumsum(valid.astype(xp.int64), part_seg, xp)
             if isinstance(f.agg, SumAgg):
                 out, ovalid = s, c > 0
             else:
-                out = s.astype(jnp.float64) / jnp.maximum(c, 1)
+                out = s.astype(xp.float64) / xp.maximum(c, 1)
                 ovalid = c > 0
         elif isinstance(f.agg, MinMaxAgg):
-            big = jnp.iinfo(jnp.int64).max if not jnp.issubdtype(
-                data.dtype, jnp.floating) else jnp.inf
-            fill = big if f.agg.minimum else (-big if not jnp.issubdtype(
-                data.dtype, jnp.floating) else -jnp.inf)
-            x = jnp.where(valid, data, jnp.asarray(fill, dtype=data.dtype))
-            out = _segmented_cummin(x, part_seg) if f.agg.minimum \
-                else _segmented_cummax(x, part_seg)
-            ovalid = _segmented_cumsum(valid.astype(jnp.int64), part_seg) > 0
+            big = xp.iinfo(xp.int64).max if not xp.issubdtype(
+                data.dtype, xp.floating) else xp.inf
+            fill = big if f.agg.minimum else (-big if not xp.issubdtype(
+                data.dtype, xp.floating) else -xp.inf)
+            x = xp.where(valid, data, xp.asarray(fill, dtype=data.dtype))
+            out = _segmented_cummin(x, part_seg, xp) if f.agg.minimum \
+                else _segmented_cummax(x, part_seg, xp)
+            ovalid = _segmented_cumsum(valid.astype(xp.int64), part_seg,
+                                       xp) > 0
         else:
             raise TypeError(f"window agg {f.agg.name} unsupported")
         if not running:
             # whole-partition frame: broadcast the partition's last value
-            last = _partition_last(out, part_seg, n)
+            last = _partition_last(out, part_seg, n, xp)
             out = last
-            ovalid = _partition_last(ovalid.astype(jnp.int64), part_seg, n) > 0
+            ovalid = _partition_last(ovalid.astype(xp.int64), part_seg, n,
+                                     xp) > 0
         else:
             # RANGE frame: ties (same order value) share the frame end value
             last_same = _next_change_pos(part_seg | order_change,
-                                         jnp.arange(n, dtype=jnp.int64), n) - 1
-            out = jnp.take(out, last_same)
-            ovalid = jnp.take(ovalid, last_same)
+                                         xp.arange(n, dtype=xp.int64),
+                                         n, xp) - 1
+            out = xp.take(out, last_same)
+            ovalid = xp.take(ovalid, last_same)
         d = np.asarray(out)
         m = ~np.asarray(ovalid)
         return pa.array(d, mask=m)
 
 
-# -- prefix-scan helpers (device) -------------------------------------------
+# -- prefix-scan helpers ------------------------------------------------------
+# xp-parameterized: device placement runs them as jnp (XLA fuses the scan
+# chains); host placement runs numpy directly — eagerly dispatched jnp on
+# the CPU backend compiles one tiny XLA program per op PER SHAPE, which
+# dominated window-heavy queries (q51: ~4s of compiles for ~0.1s of work).
 
-def _segment_start(part_seg, pos):
-    return _running_max_where(part_seg, pos)
+def _window_xp():
+    from blaze_tpu.bridge.placement import host_resident
+    return np if host_resident() else jnp
 
 
-def _running_max_where(mask, pos):
-    """For each row, the position of the most recent row where mask=True."""
+def _cummax(x, xp):
+    if xp is np:
+        return np.maximum.accumulate(x)
     import jax.lax
-    marked = jnp.where(mask, pos, jnp.int64(-1))
-    return jax.lax.cummax(marked)
+    return jax.lax.cummax(x)
 
 
-def _segment_size(part_seg, n):
-    pos = jnp.arange(n, dtype=jnp.int64)
-    start = _segment_start(part_seg, pos)
+def _cummin(x, xp):
+    if xp is np:
+        return np.minimum.accumulate(x)
+    import jax.lax
+    return jax.lax.cummin(x)
+
+
+def _segment_start(part_seg, pos, xp=jnp):
+    return _running_max_where(part_seg, pos, xp)
+
+
+def _running_max_where(mask, pos, xp=jnp):
+    """For each row, the position of the most recent row where mask=True."""
+    marked = xp.where(mask, pos, xp.int64(-1))
+    return _cummax(marked, xp)
+
+
+def _segment_size(part_seg, n, xp=jnp):
+    pos = xp.arange(n, dtype=xp.int64)
+    start = _segment_start(part_seg, pos, xp)
     # size = next_start - start; next start found from the right
-    is_last = jnp.concatenate([part_seg[1:], jnp.ones(1, dtype=bool)])
-    end_pos = _next_true_pos(is_last, pos, n)
+    is_last = xp.concatenate([part_seg[1:], xp.ones(1, dtype=bool)])
+    end_pos = _next_true_pos(is_last, pos, n, xp)
     return end_pos - start + 1
 
 
-def _next_true_pos(mask, pos, n):
+def _next_true_pos(mask, pos, n, xp=jnp):
     """Position of the next row (>= current) where mask is True."""
-    import jax.lax
-    marked = jnp.where(mask, pos, jnp.int64(n))
-    return jnp.flip(jax.lax.cummin(jnp.flip(marked)))
+    marked = xp.where(mask, pos, xp.int64(n))
+    return xp.flip(_cummin(xp.flip(marked), xp))
 
 
-def _next_change_pos(change, pos, n):
+def _next_change_pos(change, pos, n, xp=jnp):
     """Exclusive end of the run of rows equal to this row: position of the
     next change after current, or n."""
-    nxt = jnp.concatenate([change[1:], jnp.ones(1, dtype=bool)])
-    return _next_true_pos(nxt, pos, n) + 1
+    nxt = xp.concatenate([change[1:], xp.ones(1, dtype=bool)])
+    return _next_true_pos(nxt, pos, n, xp) + 1
 
 
-def _partition_last(values, part_seg, n):
+def _partition_last(values, part_seg, n, xp=jnp):
     """Broadcast each partition's LAST row value to all its rows."""
-    pos = jnp.arange(n, dtype=jnp.int64)
-    is_last = jnp.concatenate([part_seg[1:], jnp.ones(1, dtype=bool)])
-    last_pos = _next_true_pos(is_last, pos, n)
-    return jnp.take(values, jnp.clip(last_pos, 0, n - 1))
+    pos = xp.arange(n, dtype=xp.int64)
+    is_last = xp.concatenate([part_seg[1:], xp.ones(1, dtype=bool)])
+    last_pos = _next_true_pos(is_last, pos, n, xp)
+    return xp.take(values, xp.clip(last_pos, 0, n - 1))
 
 
-def _segmented_cumsum(values, part_seg):
+def _segmented_cumsum(values, part_seg, xp=jnp):
     """Cumulative sum restarting at each partition boundary."""
-    total = jnp.cumsum(values)
-    pos = jnp.arange(values.shape[0], dtype=jnp.int64)
-    start = _segment_start(part_seg, pos)
-    base = jnp.take(total, jnp.maximum(start - 1, 0))
-    base = jnp.where(start == 0, jnp.zeros_like(base), base)
+    total = xp.cumsum(values)
+    pos = xp.arange(values.shape[0], dtype=xp.int64)
+    start = _segment_start(part_seg, pos, xp)
+    base = xp.take(total, xp.maximum(start - 1, 0))
+    base = xp.where(start == 0, xp.zeros_like(base), base)
     return total - base
 
 
-def _segmented_cummax(values, part_seg):
+def _segmented_cummax(values, part_seg, xp=jnp):
     n = values.shape[0]
-    pid = jnp.cumsum(part_seg.astype(jnp.int64)) - 1
+    pid = xp.cumsum(part_seg.astype(xp.int64)) - 1
+    if xp is np:
+        import pandas as pd
+        # segmented running max in C; skipna=False propagates NaN like
+        # the device path's jnp.maximum (NaN dominates a running max)
+        return pd.Series(values).groupby(np.asarray(pid)) \
+            .cummax(skipna=False).to_numpy()
     # log-steps doubling scan bounded by segment membership
     out = values
     shift = 1
     while shift < n:
-        prev = jnp.concatenate([out[:shift], out[:-shift]])
-        prev_pid = jnp.concatenate([pid[:shift], pid[:-shift]])
-        ok = (jnp.arange(n) >= shift) & (prev_pid == pid)
-        out = jnp.where(ok, jnp.maximum(out, prev), out)
+        prev = xp.concatenate([out[:shift], out[:-shift]])
+        prev_pid = xp.concatenate([pid[:shift], pid[:-shift]])
+        ok = (xp.arange(n) >= shift) & (prev_pid == pid)
+        out = xp.where(ok, xp.maximum(out, prev), out)
         shift *= 2
     return out
 
 
-def _segmented_cummin(values, part_seg):
-    return -_segmented_cummax(-values, part_seg)
+def _segmented_cummin(values, part_seg, xp=jnp):
+    return -_segmented_cummax(-values, part_seg, xp)

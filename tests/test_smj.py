@@ -47,8 +47,19 @@ def _sorted_frames(df):
     return df.sort_values(list(df.columns)).reset_index(drop=True)
 
 
+@pytest.fixture(params=["acero", "streaming"])
+def smj_path(request):
+    """Both SMJ host paths stay covered: the Acero materialized join
+    and the streaming run-cursor merge it falls back to."""
+    key = config.SMJ_ACERO_ENABLE.key
+    old = config.SMJ_ACERO_ENABLE.get()
+    config.conf.set(key, request.param == "acero")
+    yield request.param
+    config.conf.set(key, old)
+
+
 @pytest.mark.parametrize("jt", list(JoinType))
-def test_smj_matches_hash_join(jt):
+def test_smj_matches_hash_join(jt, smj_path):
     left, right = _tables()
     smj = SortMergeJoinExec(
         MemoryScanExec.from_arrow(left, batch_rows=512),
@@ -66,7 +77,7 @@ def test_smj_matches_hash_join(jt):
                                       check_exact=False, atol=1e-9)
 
 
-def test_smj_with_join_filter():
+def test_smj_with_join_filter(smj_path):
     left, right = _tables(seed=3, n_left=1000, n_right=800)
     flt = BinaryExpr(">", col(1), col(3))  # lv > rv on joined schema
     smj = SortMergeJoinExec(
@@ -83,7 +94,7 @@ def test_smj_with_join_filter():
                                       check_exact=False, atol=1e-9)
 
 
-def test_smj_multi_key():
+def test_smj_multi_key(smj_path):
     rng = np.random.default_rng(5)
     left = pa.table({"a": pa.array(rng.integers(0, 20, 2000)),
                      "b": pa.array(rng.integers(0, 10, 2000)),
@@ -115,7 +126,7 @@ def test_smj_exploits_presorted_children():
     assert len(got) == len(want)
 
 
-def test_smj_string_keys():
+def test_smj_string_keys(smj_path):
     left = pa.table({"k": pa.array(["a", "b", "b", None, "c"]),
                      "v": pa.array([1, 2, 3, 4, 5], type=pa.int64())})
     right = pa.table({"k": pa.array(["b", "c", "c", None]),
@@ -151,7 +162,7 @@ def test_shj_falls_back_to_smj_on_large_build():
     assert len(got) == len(want)
 
 
-def test_smj_nan_float_keys_match_like_spark():
+def test_smj_nan_float_keys_match_like_spark(smj_path):
     """Spark treats NaN as a NORMAL value in join keys (NaN semantics
     doc; NormalizeFloatingNumbers applies to join keys): NaN joins NaN.
     NULL keys still never match.  SMJ, the vectorized hash probe, and
@@ -172,3 +183,34 @@ def test_smj_nan_float_keys_match_like_spark():
     a = a.sort_values("lv")
     assert a.iloc[0].lk == 2.0 and a.iloc[0].rv == 200
     assert a.iloc[1].rv == 400  # NaN joined NaN
+
+
+def test_smj_acero_overflow_resumes_streaming():
+    """Collect-budget overflow mid-Acero-collection hands the consumed
+    chunks to the streaming merge (sorted children) or re-executes
+    (unsorted children) — results identical either way."""
+    left, right = _tables(seed=3)
+    key = config.FUSED_HOST_COLLECT_ROWS.key
+    old = config.FUSED_HOST_COLLECT_ROWS.get()
+    try:
+        for presort in (True, False):
+            l_scan = MemoryScanExec.from_arrow(left, batch_rows=256)
+            r_scan = MemoryScanExec.from_arrow(right, batch_rows=256)
+            lk, rk = [col(0, "lk")], [col(0, "rk")]
+            if presort:
+                l_in = SortExec(l_scan, [(lk[0], False, True)])
+                r_in = SortExec(r_scan, [(rk[0], False, True)])
+            else:
+                l_in, r_in = l_scan, r_scan
+            config.conf.set(key, old)
+            want = _run(SortMergeJoinExec(l_in, r_in, lk, rk,
+                                          JoinType.INNER))
+            config.conf.set(key, 500)  # forces overflow on both sides
+            got = _run(SortMergeJoinExec(l_in, r_in, lk, rk,
+                                         JoinType.INNER))
+            assert len(got) == len(want), (presort, len(got), len(want))
+            gs = got.sort_values(list(got.columns)).reset_index(drop=True)
+            ws = want.sort_values(list(want.columns)).reset_index(drop=True)
+            pd.testing.assert_frame_equal(gs, ws)
+    finally:
+        config.conf.set(key, old)
