@@ -11,3 +11,4 @@ __all__ = ["QueryResult", "check_plan_stability", "compare_frames",
 
 # register the breadth-extension queries into QUERIES (import side effect)
 from blaze_tpu.itest import queries_ext  # noqa: E402,F401
+from blaze_tpu.itest import queries_ext2  # noqa: E402,F401

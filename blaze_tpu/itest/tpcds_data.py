@@ -13,6 +13,7 @@ import numpy as np
 import pyarrow as pa
 
 SF1_ROWS = {
+    "inventory": 783_000,
     "household_demographics": 7_200,
     "time_dim": 86_400,
     "reason": 35,
@@ -188,11 +189,19 @@ def gen_catalog_returns(scale: float, seed: int = 28) -> pa.Table:
     n = max(1, int(144_067 * scale))
     rng = np.random.default_rng(seed)
     cs_n = _rows("catalog_sales", scale)
-    return pa.table({
+    date_n = min(_rows("date_dim", scale), SALES_DATE_DAYS)
+    return _date_ordered(pa.table({
         "cr_order_number": pa.array(
             rng.integers(1, max(1, cs_n // 2) + 1, n)),
         "cr_return_amount": pa.array(np.round(rng.random(n) * 90, 2)),
-    })
+        "cr_item_sk": pa.array(rng.integers(1, _rows("item", scale) + 1, n)),
+        "cr_returning_customer_sk": pa.array(
+            rng.integers(1, _rows("customer", scale) + 1, n)),
+        "cr_returned_date_sk": pa.array(
+            rng.integers(2450815, 2450815 + date_n, n)),
+        "cr_call_center_sk": pa.array(rng.integers(1, 7, n)),
+        "cr_net_loss": pa.array(np.round(rng.random(n) * 70, 2)),
+    }), "cr_returned_date_sk")
 
 
 def gen_web_sales(scale: float, seed: int = 18) -> pa.Table:
@@ -226,10 +235,18 @@ def gen_web_returns(scale: float, seed: int = 19) -> pa.Table:
     n = _rows("web_returns", scale)
     rng = np.random.default_rng(seed)
     n_orders = max(1, _rows("web_sales", scale) // 3)
-    return pa.table({
+    date_n = min(_rows("date_dim", scale), SALES_DATE_DAYS)
+    return _date_ordered(pa.table({
         "wr_order_number": pa.array(rng.integers(1, n_orders + 1, n)),
         "wr_return_amt": pa.array(np.round(rng.random(n) * 80, 2)),
-    })
+        "wr_item_sk": pa.array(rng.integers(1, _rows("item", scale) + 1, n)),
+        "wr_returning_customer_sk": pa.array(
+            rng.integers(1, _rows("customer", scale) + 1, n)),
+        "wr_returned_date_sk": pa.array(
+            rng.integers(2450815, 2450815 + date_n, n)),
+        "wr_reason_sk": pa.array(rng.integers(1, 36, n)),
+        "wr_net_loss": pa.array(np.round(rng.random(n) * 50, 2)),
+    }), "wr_returned_date_sk")
 
 
 def gen_customer_demographics(scale: float, seed: int = 20) -> pa.Table:
@@ -321,6 +338,24 @@ def gen_web_clickstreams(scale: float, seed: int = 23) -> pa.Table:
     })
 
 
+def gen_inventory(scale: float, seed: int = 29) -> pa.Table:
+    """Weekly on-hand snapshots (TPC-DS inventory): one row per
+    (week, item-sample, warehouse); dsdgen emits them in date order."""
+    n = max(1, int(783_000 * scale))
+    rng = np.random.default_rng(seed)
+    week_starts = np.arange(0, SALES_DATE_DAYS, 7)
+    return _date_ordered(pa.table({
+        "inv_date_sk": pa.array(
+            2450815 + week_starts[rng.integers(0, len(week_starts), n)]),
+        "inv_item_sk": pa.array(
+            rng.integers(1, _rows("item", scale) + 1, n)),
+        "inv_warehouse_sk": pa.array(
+            rng.integers(1, _rows("warehouse", scale) + 1, n)),
+        "inv_quantity_on_hand": pa.array(
+            rng.integers(0, 1000, n).astype(np.int32)),
+    }), "inv_date_sk")
+
+
 def gen_warehouse(scale: float, seed: int = 27) -> pa.Table:
     n = _rows("warehouse", scale)
     return pa.table({
@@ -365,6 +400,7 @@ def gen_reason(scale: float, seed: int = 26) -> pa.Table:
 
 
 GENERATORS = {
+    "inventory": gen_inventory,
     "warehouse": gen_warehouse,
     "household_demographics": gen_household_demographics,
     "time_dim": gen_time_dim,

@@ -234,8 +234,23 @@ class _AggState(MemConsumer):
                 for a in accs:
                     sink.add_host(a)
             else:
+                from blaze_tpu.ops.agg.functions import CountAgg
                 args = []
                 for c in cols:
+                    if not c.dtype.is_fixed_width and \
+                            isinstance(fn, CountAgg):
+                        # count(utf8_col): only the validity mask feeds
+                        # the kernel — values never reach it, so don't
+                        # try a device materialization.  Other var-width
+                        # aggs (max(utf8)) stay on the loud-failure path
+                        # rather than reducing over a validity mask.
+                        av = np.zeros(cap, dtype=bool)
+                        av[:len(c.array)] = np.asarray(c.array.is_valid())
+                        av = av if xp is np else jnp.asarray(av)
+                        tv = xp.take(av, perm)
+                        args.append((tv.astype(xp.int8),
+                                     tv & sorted_valid))
+                        continue
                     dv = c.to_device(cap)
                     args.append((xp.take(dv.data, perm),
                                  xp.take(dv.validity, perm) & sorted_valid))

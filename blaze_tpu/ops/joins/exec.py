@@ -130,6 +130,34 @@ def _device_hash_keys(batch: ColumnBatch, key_exprs: Sequence[PhysicalExpr]
     return h_np[:n], anyn_np[:n].copy(), key_arrays
 
 
+def _promote_key_types(left_tbl, right_tbl, lk, rk):
+    """Promote mismatched-width join-key pairs to a common type —
+    Acero requires exact key-type equality, while Spark inserts the
+    widening casts upstream (hand-built plans may not)."""
+    for ln, rn in zip(lk, rk):
+        lt = left_tbl.column(ln).type
+        rt = right_tbl.column(rn).type
+        if lt.equals(rt):
+            continue
+        if pa.types.is_integer(lt) and pa.types.is_integer(rt):
+            common = pa.int64()
+        elif (pa.types.is_floating(lt) or pa.types.is_floating(rt)) and \
+                (pa.types.is_integer(lt) or pa.types.is_floating(lt)) and \
+                (pa.types.is_integer(rt) or pa.types.is_floating(rt)):
+            common = pa.float64()
+        else:
+            continue  # let Acero raise its own descriptive error
+        if not lt.equals(common):
+            i = left_tbl.schema.get_field_index(ln)
+            left_tbl = left_tbl.set_column(
+                i, ln, left_tbl.column(ln).cast(common, safe=False))
+        if not rt.equals(common):
+            i = right_tbl.schema.get_field_index(rn)
+            right_tbl = right_tbl.set_column(
+                i, rn, right_tbl.column(rn).cast(common, safe=False))
+    return left_tbl, right_tbl
+
+
 def _pad(v: np.ndarray, n: int) -> np.ndarray:
     if len(v) == n:
         return v
@@ -621,6 +649,8 @@ class BaseJoinExec(ExecutionPlan):
         right_tbl = build_tbl if probe_is_left else probe_tbl
         lk = [f"__lk{i}" for i in range(len(self.left_keys))]
         rk = [f"__rk{i}" for i in range(len(self.right_keys))]
+        left_tbl, right_tbl = _promote_key_types(left_tbl, right_tbl,
+                                                 lk, rk)
         joined = left_tbl.join(right_tbl, keys=lk, right_keys=rk,
                                join_type=self._PA_JOIN_TYPES[self.join_type],
                                use_threads=True)

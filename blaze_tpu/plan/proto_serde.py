@@ -1280,6 +1280,16 @@ def _window_to_proto(d: Dict[str, Any]) -> pb.PhysicalPlanNode:
         we.field.name = f["name"]
         fk = f["kind"]
         if fk == "agg":
+            if f.get("running") is False and d.get("order_by"):
+                # the wire (like the reference's WindowExprNode) carries
+                # no frame spec: whole-partition aggregation is encoded
+                # by an EMPTY order_spec (Spark semantics) — an agg that
+                # wants it WITH ordering would silently decode as a
+                # running frame, so refuse loudly
+                raise ValueError(
+                    "whole-partition window agg frame with order_by has "
+                    "no wire encoding; drop order_by (partition-sorted "
+                    "input still groups correctly)")
             we.func_type = pb.Agg
             we.agg_func = _AGG_FN_ENCODE[f["fn"]]
             for c in f.get("args", []):
