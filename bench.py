@@ -574,7 +574,10 @@ def child_main():
     # Warm both sides, then time them INTERLEAVED (B,E,B,E,...): the
     # shared 2-CPU box is noisy, and separate timing blocks let one
     # descheduled stretch define a whole side of the ratio.  Alternating
-    # samples expose both sides to the same load; medians per side.
+    # samples expose both sides to the same load; each side reports its
+    # MINIMUM (the standard least-noise estimator — a descheduled stretch
+    # can only inflate a sample, never deflate it), applied symmetrically
+    # to both sides of every ratio.
     want_groups, want_total = run_baseline(sr_paths, dd_path)  # warm
     warmdir = _scratch_dir("blaze_bench_")
     try:  # engine warmup compiles the fused stage
@@ -583,10 +586,18 @@ def child_main():
         shutil.rmtree(warmdir, ignore_errors=True)
     cpu_times = []
     times = []
+    pd_times = []
     for _ in range(max(7, ITERS)):
         t0 = time.perf_counter()
         want_groups, want_total = run_baseline(sr_paths, dd_path)
         cpu_times.append(time.perf_counter() - t0)
+        # transparency figure, SAME loop + sample count: the baseline
+        # WITH pyarrow's own predicate pushdown (row-group pruning) —
+        # the engine's scan-pruning edge is the gap between the two
+        # baseline walls
+        t0 = time.perf_counter()
+        run_baseline(sr_paths, dd_path, pushdown=True)
+        pd_times.append(time.perf_counter() - t0)
         tmpdir = _scratch_dir("blaze_bench_")
         try:
             t0 = time.perf_counter()
@@ -597,18 +608,9 @@ def child_main():
         assert got_groups == want_groups, (got_groups, want_groups)
         assert abs(got_total - want_total) / max(abs(want_total), 1) < 1e-9, \
             (got_total, want_total)
-    cpu_s = float(np.median(cpu_times))
-    tpu_s = float(np.median(times))
-
-    # transparency: the baseline WITH pyarrow's own predicate pushdown
-    # (row-group pruning) — the engine's scan-pruning edge in the ratio
-    # above is exactly the gap between the two baseline figures
-    pd_times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        run_baseline(sr_paths, dd_path, pushdown=True)
-        pd_times.append(time.perf_counter() - t0)
-    pushdown_cpu_s = float(np.median(pd_times))
+    cpu_s = float(np.min(cpu_times))
+    tpu_s = float(np.min(times))
+    pushdown_cpu_s = float(np.min(pd_times))
 
     # join stage (q06 shape): correctness + timing vs pyarrow join,
     # interleaved for the same reason as above
@@ -626,8 +628,8 @@ def child_main():
         assert got_cnt == want_cnt, (got_cnt, want_cnt)
         assert abs(got_amt - want_amt) / max(abs(want_amt), 1) < 1e-9, \
             (got_amt, want_amt)
-    join_cpu_s = float(np.median(jcpu_times))
-    join_tpu_s = float(np.median(jtimes))
+    join_cpu_s = float(np.min(jcpu_times))
+    join_tpu_s = float(np.min(jtimes))
 
     # ---- SF10 leg: same pipeline at 10x rows, Spark-sized partitions ----
     sf10_fields = {}
@@ -692,10 +694,14 @@ def run_scaled_leg(scale: float):
         shutil.rmtree(warmdir, ignore_errors=True)
     ctimes = []
     times = []
-    for _ in range(3):  # interleaved B,E pairs (see child_main)
+    pd_times = []
+    for _ in range(5):  # interleaved B,P,E triples (see child_main)
         t0 = time.perf_counter()
         want_groups, want_total = run_baseline(sr_paths, dd_path)
         ctimes.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_baseline(sr_paths, dd_path, pushdown=True)
+        pd_times.append(time.perf_counter() - t0)
         tmpdir = _scratch_dir("blaze_bench_sf_")
         try:
             t0 = time.perf_counter()
@@ -707,14 +713,9 @@ def run_scaled_leg(scale: float):
         assert got_groups == want_groups, (got_groups, want_groups)
         assert abs(got_total - want_total) / max(abs(want_total), 1) \
             < 1e-9, (got_total, want_total)
-    cpu_s = float(np.median(ctimes))
-    eng_s = float(np.median(times))
-    pd_times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        run_baseline(sr_paths, dd_path, pushdown=True)
-        pd_times.append(time.perf_counter() - t0)
-    pushdown_cpu_s = float(np.median(pd_times))
+    cpu_s = float(np.min(ctimes))
+    eng_s = float(np.min(times))
+    pushdown_cpu_s = float(np.min(pd_times))
     n_rows = sum(_parquet_rows(p) for p in sr_paths)
     # join leg at scale: the runtime-filter advantage grows with probe
     # size (join cost scales with rows probed; the filter caps it)
@@ -731,8 +732,8 @@ def run_scaled_leg(scale: float):
         assert got_cnt == want_cnt, (got_cnt, want_cnt)
         assert abs(got_amt - want_amt) / max(abs(want_amt), 1) < 1e-9, \
             (got_amt, want_amt)
-    jcpu_s = float(np.median(jc))
-    jeng_s = float(np.median(je))
+    jcpu_s = float(np.min(jc))
+    jeng_s = float(np.min(je))
     return {
         "sf10_vs_baseline": round(cpu_s / eng_s, 3),
         "sf10_wall_s": round(eng_s, 4),

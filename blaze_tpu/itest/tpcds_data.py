@@ -341,7 +341,7 @@ def gen_web_clickstreams(scale: float, seed: int = 23) -> pa.Table:
 def gen_inventory(scale: float, seed: int = 29) -> pa.Table:
     """Weekly on-hand snapshots (TPC-DS inventory): one row per
     (week, item-sample, warehouse); dsdgen emits them in date order."""
-    n = max(1, int(783_000 * scale))
+    n = _rows("inventory", scale)
     rng = np.random.default_rng(seed)
     week_starts = np.arange(0, SALES_DATE_DAYS, 7)
     return _date_ordered(pa.table({

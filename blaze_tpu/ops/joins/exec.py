@@ -130,32 +130,34 @@ def _device_hash_keys(batch: ColumnBatch, key_exprs: Sequence[PhysicalExpr]
     return h_np[:n], anyn_np[:n].copy(), key_arrays
 
 
-def _promote_key_types(left_tbl, right_tbl, lk, rk):
-    """Promote mismatched-width join-key pairs to a common type —
-    Acero requires exact key-type equality, while Spark inserts the
-    widening casts upstream (hand-built plans may not)."""
-    for ln, rn in zip(lk, rk):
-        lt = left_tbl.column(ln).type
-        rt = right_tbl.column(rn).type
-        if lt.equals(rt):
+def promote_join_key_exprs(lkeys, rkeys, lschema, rschema):
+    """Widen mismatched join-key expression pairs to a common numeric
+    type (int/int -> int64, numeric mix -> float64) so every join path
+    hashes/compares identical types — the murmur/xxhash probe hashes
+    int32 and int64 of equal value differently.  Spark's analyzer
+    inserts these casts during resolution; hand-built plans may not."""
+    from blaze_tpu.exprs.cast import Cast
+    from blaze_tpu.schema import FLOAT64, INT64
+    out_l, out_r = [], []
+    for le, re in zip(lkeys, rkeys):
+        lt = le.data_type(lschema)
+        rt = re.data_type(rschema)
+        if lt.id == rt.id:
+            out_l.append(le)
+            out_r.append(re)
             continue
-        if pa.types.is_integer(lt) and pa.types.is_integer(rt):
-            common = pa.int64()
-        elif (pa.types.is_floating(lt) or pa.types.is_floating(rt)) and \
-                (pa.types.is_integer(lt) or pa.types.is_floating(lt)) and \
-                (pa.types.is_integer(rt) or pa.types.is_floating(rt)):
-            common = pa.float64()
+        if lt.is_integer and rt.is_integer:
+            common = INT64
+        elif ((lt.is_integer or lt.is_floating) and
+              (rt.is_integer or rt.is_floating)):
+            common = FLOAT64
         else:
-            continue  # let Acero raise its own descriptive error
-        if not lt.equals(common):
-            i = left_tbl.schema.get_field_index(ln)
-            left_tbl = left_tbl.set_column(
-                i, ln, left_tbl.column(ln).cast(common, safe=False))
-        if not rt.equals(common):
-            i = right_tbl.schema.get_field_index(rn)
-            right_tbl = right_tbl.set_column(
-                i, rn, right_tbl.column(rn).cast(common, safe=False))
-    return left_tbl, right_tbl
+            out_l.append(le)
+            out_r.append(re)
+            continue
+        out_l.append(le if lt.id == common.id else Cast(le, common))
+        out_r.append(re if rt.id == common.id else Cast(re, common))
+    return out_l, out_r
 
 
 def _pad(v: np.ndarray, n: int) -> np.ndarray:
@@ -309,8 +311,14 @@ class BaseJoinExec(ExecutionPlan):
                  null_aware_anti: bool = False):
         super().__init__([left, right])
         assert build_side in ("left", "right")
-        self.left_keys = list(left_keys)
-        self.right_keys = list(right_keys)
+        # widen mismatched key pairs ONCE here so every probe path —
+        # Acero one-shot, streaming run cursors, device hash probe —
+        # sees identical key types (Spark's analyzer inserts these casts;
+        # hand-built plans may not).  The cached broadcast build-map path
+        # (BuildHashMapExec) still relies on the upstream cast guarantee:
+        # its map is hashed before this node exists.
+        self.left_keys, self.right_keys = promote_join_key_exprs(
+            list(left_keys), list(right_keys), left.schema, right.schema)
         self.join_type = join_type
         self.build_side = build_side
         self.join_filter = join_filter
@@ -649,8 +657,6 @@ class BaseJoinExec(ExecutionPlan):
         right_tbl = build_tbl if probe_is_left else probe_tbl
         lk = [f"__lk{i}" for i in range(len(self.left_keys))]
         rk = [f"__rk{i}" for i in range(len(self.right_keys))]
-        left_tbl, right_tbl = _promote_key_types(left_tbl, right_tbl,
-                                                 lk, rk)
         joined = left_tbl.join(right_tbl, keys=lk, right_keys=rk,
                                join_type=self._PA_JOIN_TYPES[self.join_type],
                                use_threads=True)

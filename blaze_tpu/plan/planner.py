@@ -58,37 +58,6 @@ class CoalesceBatchesExec(ExecutionPlan):
                                    self._batch_size, metrics=self.metrics))
 
 
-def _promote_join_key_types(lkeys, rkeys, lschema, rschema):
-    """Widen mismatched join-key pairs to a common numeric type at PLAN
-    time, so every downstream path — Acero one-shot, streaming run
-    cursors, and the murmur/xxhash device probe (which hashes int32 and
-    int64 of equal value differently) — sees identical key types.
-    Spark inserts these casts during analysis; hand-built or partially
-    translated plans may not."""
-    from blaze_tpu.exprs.cast import Cast
-    from blaze_tpu.schema import FLOAT64, INT64
-    out_l, out_r = [], []
-    for le, re in zip(lkeys, rkeys):
-        lt = le.data_type(lschema)
-        rt = re.data_type(rschema)
-        if lt.id == rt.id:
-            out_l.append(le)
-            out_r.append(re)
-            continue
-        if lt.is_integer and rt.is_integer:
-            common = INT64
-        elif ((lt.is_integer or lt.is_floating) and
-              (rt.is_integer or rt.is_floating)):
-            common = FLOAT64
-        else:
-            out_l.append(le)
-            out_r.append(re)
-            continue
-        out_l.append(le if lt.id == common.id else Cast(le, common))
-        out_r.append(re if rt.id == common.id else Cast(re, common))
-    return out_l, out_r
-
-
 def create_plan(d: Dict[str, Any]) -> ExecutionPlan:
     """Decode one plan node (and recursively its children)."""
     k = d["kind"]
@@ -195,8 +164,6 @@ def create_plan(d: Dict[str, Any]) -> ExecutionPlan:
         right = create_plan(d["right"])
         lkeys = [expr_from_dict(e, left.schema) for e in d["left_keys"]]
         rkeys = [expr_from_dict(e, right.schema) for e in d["right_keys"]]
-        lkeys, rkeys = _promote_join_key_types(lkeys, rkeys, left.schema,
-                                               right.schema)
         jt = JoinType(d.get("join_type", "inner"))
         flt = None
         if d.get("join_filter"):
