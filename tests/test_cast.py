@@ -272,3 +272,41 @@ class TestReferenceCastVectors:
         arr = pa.array([None, True, False])
         assert self._cast(arr, S.UTF8).to_pylist() == [None, "true",
                                                        "false"]
+
+
+class TestNestedToString:
+    """Reference vectors: cast.rs test_nested_struct_to_string /
+    test_struct_to_string_with_null_struct / test_nested_map_to_string."""
+
+    def _cast_utf8(self, arr):
+        import pyarrow as pa
+        from blaze_tpu.batch import ColumnBatch
+        from blaze_tpu.exprs.base import BoundReference
+        from blaze_tpu.exprs.cast import Cast
+        from blaze_tpu.schema import DataType, TypeId
+        t = pa.table({"x": arr})
+        cb = ColumnBatch.from_arrow(t.combine_chunks())
+        e = Cast(BoundReference(0, "x"), DataType(TypeId.UTF8))
+        return e.evaluate(cb).to_host(cb.num_rows).to_pylist()
+
+    def test_nested_struct_to_string(self):
+        import pyarrow as pa
+        outer = pa.array(
+            [{"i": {"a": 1, "b": "x"}, "c": 5},
+             {"i": None, "c": 6}],
+            type=pa.struct([("i", pa.struct([("a", pa.int64()),
+                                             ("b", pa.string())])),
+                            ("c", pa.int64())]))
+        assert self._cast_utf8(outer) == ["{{1, x}, 5}", "{null, 6}"]
+
+    def test_null_struct_row_stays_null(self):
+        import pyarrow as pa
+        arr = pa.array([{"a": 1}, None],
+                       type=pa.struct([("a", pa.int64())]))
+        assert self._cast_utf8(arr) == ["{1}", None]
+
+    def test_map_to_string_spark_format(self):
+        import pyarrow as pa
+        m = pa.array([[("k1", 1), ("k2", 2)], None],
+                     type=pa.map_(pa.string(), pa.int64()))
+        assert self._cast_utf8(m) == ["{k1 -> 1, k2 -> 2}", None]
