@@ -33,14 +33,33 @@ def _ftype(ts):
 
 
 for _name, _fn in {
-    "sqrt": jnp.sqrt, "exp": jnp.exp, "ln": jnp.log, "log10": jnp.log10,
-    "log2": jnp.log2, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
-    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
-    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
-    "cbrt": jnp.cbrt, "degrees": jnp.degrees, "radians": jnp.radians,
-    "expm1": jnp.expm1, "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt, "exp": jnp.exp, "sin": jnp.sin, "cos": jnp.cos,
+    "tan": jnp.tan, "asin": jnp.arcsin, "acos": jnp.arccos,
+    "atan": jnp.arctan, "sinh": jnp.sinh, "cosh": jnp.cosh,
+    "tanh": jnp.tanh, "cbrt": jnp.cbrt, "degrees": jnp.degrees,
+    "radians": jnp.radians, "expm1": jnp.expm1,
 }.items():
     register(_name, _ftype)(_unary(_fn))
+
+
+def _log_family(math_fn, lower_bound):
+    """Spark returns NULL for log args at or below the asymptote
+    (ln/log10/log2: x <= 0; log1p: x <= -1) — its UnaryLogExpression
+    null-guards exactly `input <= yAsymptote`, which NaN FAILS, so a
+    NaN input stays NaN (not NULL)."""
+    def impl(args, batch, out_type):
+        (v,) = _dev(args, batch)
+        data = v.data.astype(jnp.float64)
+        ok = v.validity & ~(data <= lower_bound)
+        out = math_fn(jnp.where(ok, data, 1.0 + lower_bound + 1.0))
+        return ColVal(out_type, data=out, validity=ok)
+    return impl
+
+
+for _name, _fn, _lo in (("ln", jnp.log, 0.0), ("log10", jnp.log10, 0.0),
+                        ("log2", jnp.log2, 0.0),
+                        ("log1p", jnp.log1p, -1.0)):
+    register(_name, _ftype)(_log_family(_fn, _lo))
 
 
 @register("abs")

@@ -146,12 +146,38 @@ def _substring(args, batch, out_type):
     return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
 
 
-@register("instr", _int32)
 @register("locate", _int32)
 @register("position", _int32)
+def _locate(args, batch, out_type):
+    """Spark's locate/position take (substr, str[, start]) — the REVERSE
+    of instr/strpos's (str, substr).  The wire's Strpos decodes to
+    "strpos" (DataFusion order, ref planner.rs:1379), so only
+    Catalyst-order call sites reach this swap.  An optional 1-based
+    `start` offsets the search; Spark returns 0 when start < 1 and NULL
+    when start is NULL."""
+    import pyarrow.compute as pc
+    n = batch.num_rows
+    base = _instr([args[1], args[0]], batch, out_type)
+    if len(args) <= 2:
+        return base
+    starts = args[2].to_host(n).to_pylist()
+    hays = args[1].to_host(n).to_pylist()
+    needles = args[0].to_host(n).to_pylist()
+    out = []
+    for st, h, nd in zip(starts, hays, needles):
+        if st is None or h is None or nd is None:
+            out.append(None)
+        elif st < 1:
+            out.append(0)
+        else:
+            pos = h.find(nd, st - 1)
+            out.append(0 if pos < 0 else pos + 1)
+    return ColVal.host(INT32, pa.array(out, type=pa.int32()))
+
+
+@register("strpos", _int32)
+@register("instr", _int32)
 def _instr(args, batch, out_type):
-    # locate(substr, str) vs instr(str, substr): Spark argument orders differ;
-    # the planner normalizes to (str, substr) before reaching here
     hay = args[0].to_host(batch.num_rows)
     arr1 = args[1].to_host(batch.num_rows)
     try:

@@ -258,6 +258,127 @@ def _math():
     ]
 
 
+@_suite("MathEdgeSuite")
+def _math_edge():
+    import math as _m
+    return [
+        Case("ln/log of non-positive is null (Spark, not -inf)",
+             pa.table({"a": pa.array([0.0, -1.0, _m.e])}),
+             [_fn("ln", _col(0), rt="float64")],
+             [(None,), (None,), (1.0,)], rtol=1e-12),
+        Case("log10 and log2 exact powers",
+             pa.table({"a": pa.array([100.0, 8.0])}),
+             [_fn("log10", _col(0), rt="float64"),
+              _fn("log2", _col(0), rt="float64")],
+             [(2.0, _m.log2(100.0)), (_m.log10(8.0), 3.0)], rtol=1e-12),
+        Case("sqrt of negative is NaN",
+             pa.table({"a": pa.array([-1.0, 4.0])}),
+             [_fn("sqrt", _col(0), rt="float64")],
+             [(float("nan"),), (2.0,)]),
+        Case("pow zero zero is one; cbrt of negative is real",
+             pa.table({"a": pa.array([0.0, -8.0])}),
+             [_fn("pow", _col(0), _lit(0.0, "float64"), rt="float64"),
+              _fn("cbrt", _col(0), rt="float64")],
+             [(1.0, 0.0), (1.0, -2.0)], rtol=1e-12),
+        Case("expm1/log1p stay precise near zero",
+             pa.table({"a": pa.array([0.0, 1e-10])}),
+             [_fn("expm1", _col(0), rt="float64"),
+              _fn("log1p", _col(0), rt="float64")],
+             [(0.0, 0.0), (1.00000000005e-10, 9.9999999995e-11)],
+             rtol=1e-9),
+        Case("atan2 quadrants",
+             pa.table({"y": pa.array([1.0, -1.0]),
+                       "x": pa.array([1.0, -1.0])}),
+             [_fn("atan2", _col(0), _col(1), rt="float64")],
+             [(_m.pi / 4,), (-3 * _m.pi / 4,)], rtol=1e-12),
+    ]
+
+
+@_suite("DateTimeEdgeSuite")
+def _dates_edge():
+    import datetime as dt
+    d = pa.table({"d": pa.array([dt.date(2001, 1, 31),
+                                 dt.date(2001, 2, 3)])})
+    return [
+        Case("add_months clamps to month end",
+             d, [_fn("add_months", _col(0), _lit(1),
+                     rt="date32")],
+             [(dt.date(2001, 2, 28),), (dt.date(2001, 3, 3),)]),
+        Case("last_day of february",
+             d, [_fn("last_day", _col(0), rt="date32")],
+             [(dt.date(2001, 1, 31),), (dt.date(2001, 2, 28),)]),
+        Case("datediff sign",
+             pa.table({"a": pa.array([dt.date(2001, 1, 1)]),
+                       "b": pa.array([dt.date(2000, 12, 31)])}),
+             [_fn("datediff", _col(0), _col(1), rt="int32"),
+              _fn("datediff", _col(1), _col(0), rt="int32")],
+             [(1, -1)]),
+        Case("weekday is 0-Monday while dayofweek is 1-Sunday",
+             pa.table({"d": pa.array([dt.date(2001, 1, 1)])}),  # a Monday
+             [_fn("weekday", _col(0), rt="int32"),
+              _fn("dayofweek", _col(0), rt="int32")],
+             [(0, 2)]),
+        Case("months_between integer when both month ends",
+             pa.table({"a": pa.array([dt.date(2001, 3, 31)]),
+                       "b": pa.array([dt.date(2001, 2, 28)])}),
+             [_fn("months_between", _col(0), _col(1), rt="float64")],
+             [(1.0,)], rtol=1e-9),
+    ]
+
+
+@_suite("CryptoSuite")
+def _crypto():
+    s = pa.table({"s": pa.array(["ABC", None])})
+    return [
+        Case("md5 digest",
+             s, [_fn("md5", _col(0), rt="utf8")],
+             [("902fbdd2b1df0c4f70b4a5d23525e932",), (None,)]),
+        Case("sha1 digest",
+             s, [_fn("sha1", _col(0), rt="utf8")],
+             [("3c01bdbb26f358bab27f267924aa2c9a03fcfdb8",), (None,)]),
+        Case("sha2-256 digest",
+             s, [_fn("sha2", _col(0), _lit(256), rt="utf8")],
+             [("b5d4045c3f466fa91fe2cc6abe79232a1a57cdf1"
+               "04f7a26e716e0a1e2789df78",), (None,)]),
+        Case("crc32 value",
+             s, [_fn("crc32", _col(0), rt="int64")],
+             [(2743272264,), (None,)]),
+    ]
+
+
+@_suite("StringEdgeSuite")
+def _string_edge():
+    return [
+        Case("locate and position are 1-based with 0 for missing",
+             pa.table({"s": pa.array(["abcb", "xyz"])}),
+             [_fn("locate", _lit("b", "utf8"), _col(0), rt="int32"),
+              _fn("position", _lit("b", "utf8"), _col(0), rt="int32")],
+             [(2, 2), (0, 0)]),
+        Case("split on literal delimiter",
+             pa.table({"s": pa.array(["aXbXc"])}),
+             [_fn("split", _col(0), _lit("X", "utf8"))],
+             [((["a", "b", "c"]),)]),
+        Case("space builds and clamps at zero",
+             pa.table({"n": pa.array([3, 0, -2])}),
+             [_fn("space", _col(0), rt="utf8")],
+             [("   ",), ("",), ("",)]),
+        Case("octet_length counts bytes, char_length characters",
+             pa.table({"s": pa.array(["h\u00e9llo"])}),
+             [_fn("octet_length", _col(0), rt="int32"),
+              _fn("char_length", _col(0), rt="int32")],
+             [(6, 5)]),
+        Case("replace replaces every occurrence",
+             pa.table({"s": pa.array(["ababa"])}),
+             [_fn("replace", _col(0), _lit("b", "utf8"),
+                  _lit("z", "utf8"), rt="utf8")],
+             [("azaza",)]),
+        Case("substring zero position behaves as one",
+             pa.table({"s": pa.array(["Spark SQL"])}),
+             [_fn("substring", _col(0), _lit(0), _lit(3), rt="utf8")],
+             [("Spa",)]),
+    ]
+
+
 @_suite("ConditionalSuite")
 def _cond():
     return [
@@ -542,3 +663,27 @@ def default_settings() -> CorpusSettings:
     declared divergences (the SparkTestSettings exclusion-ledger analog).
     An empty ledger means full conformance on the vendored corpus."""
     return CorpusSettings().enable_all()
+
+
+def _late_vectors():
+    """Appended vectors (registered into existing suites)."""
+    SUITES["MathEdgeSuite"].append(
+        Case("log of NaN stays NaN, not null",
+             pa.table({"a": pa.array([float("nan")])}),
+             [_fn("ln", _col(0), rt="float64")],
+             [(float("nan"),)]))
+    SUITES["StringEdgeSuite"].append(
+        Case("locate with start offset",
+             pa.table({"s": pa.array(["abcb", "abcb", "abcb"]),
+                       "p": pa.array([3, 0, None])}),
+             [_fn("locate", _lit("b", "utf8"), _col(0), _col(1),
+                  rt="int32")],
+             [(4,), (0,), (None,)]))
+    SUITES["StringEdgeSuite"].append(
+        Case("strpos uses datafusion (str, substr) order",
+             pa.table({"s": pa.array(["abcb"])}),
+             [_fn("strpos", _col(0), _lit("b", "utf8"), rt="int32")],
+             [(2,)]))
+
+
+_late_vectors()

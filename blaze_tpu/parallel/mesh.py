@@ -80,3 +80,39 @@ def distributed_grouped_agg(mesh: Mesh, key_specs, agg_specs,
         out_specs=P(DP_AXIS),
         check_vma=False)
     return jax.jit(sharded)
+
+
+def distributed_broadcast_join_agg(mesh: Mesh, build_capacity: int):
+    """Broadcast-hash-join + grouped aggregation as ONE SPMD program.
+
+    The build side REPLICATES to every device (broadcast = replication,
+    SURVEY §2.7; the NativeBroadcastExchangeBase analog) pre-sorted by
+    key; probe rows shard across the dp axis.  Each device matches its
+    probe shard with a vectorized binary search (the same sorted-build
+    discipline as kernels/join), scatter-accumulates sum/count per build
+    slot into a local dense table, and a `psum` over ICI merges the
+    partials — every device ends with the complete per-build-key
+    aggregates, one dispatch, zero host round trips.
+
+    Returns fn(build_keys_sorted, probe_keys, probe_valid, probe_vals)
+    -> (sums[build_capacity], counts[build_capacity]), replicated.
+    """
+    def stage(build_keys, probe_keys, probe_valid, probe_vals):
+        idx = jnp.searchsorted(build_keys, probe_keys)
+        idx = jnp.clip(idx, 0, build_capacity - 1)
+        matched = probe_valid & (build_keys[idx] == probe_keys)
+        slot = jnp.where(matched, idx, build_capacity)
+        sums = jnp.zeros(build_capacity, jnp.float64) \
+            .at[slot].add(jnp.where(matched, probe_vals, 0.0),
+                          mode="drop")
+        counts = jnp.zeros(build_capacity, jnp.int64) \
+            .at[slot].add(matched.astype(jnp.int64), mode="drop")
+        return (jax.lax.psum(sums, DP_AXIS),
+                jax.lax.psum(counts, DP_AXIS))
+
+    sharded = jax.shard_map(
+        stage, mesh=mesh,
+        in_specs=(P(), P(DP_AXIS), P(DP_AXIS), P(DP_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return jax.jit(sharded)

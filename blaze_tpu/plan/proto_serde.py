@@ -182,7 +182,7 @@ _SCALAR_FN_DECODE = {
     "Lpad": "lpad", "Lower": "lower", "Ltrim": "ltrim",
     "OctetLength": "octet_length", "RegexpReplace": "regexp_replace",
     "Repeat": "repeat", "Replace": "replace", "Reverse": "reverse",
-    "Rpad": "rpad", "Rtrim": "rtrim", "Strpos": "position",
+    "Rpad": "rpad", "Rtrim": "rtrim", "Strpos": "strpos",
     "Substr": "substring", "Translate": "translate", "Trim": "trim",
     "Upper": "upper", "Expm1": "expm1", "Power": "pow", "IsNaN": "isnan",
     "Least": "least", "Greatest": "greatest",

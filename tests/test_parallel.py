@@ -180,3 +180,38 @@ def test_dense_partial_agg_matches_sorted_path():
         assert sorted_by_key[k] == (pytest.approx(s), c, pytest.approx(mn),
                                     pytest.approx(mx))
     assert occ_np.sum() == len(sorted_by_key)
+
+
+@pytest.mark.dist
+def test_distributed_broadcast_join_agg_eight_devices():
+    """Broadcast join + agg in one SPMD program over the 8-device mesh:
+    replicated build, sharded probe, psum-merged per-key aggregates."""
+    import numpy as np
+    import jax.numpy as jnp
+    from blaze_tpu.parallel import (distributed_broadcast_join_agg,
+                                    make_mesh, shard_rows)
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(4)
+    build = np.unique(rng.integers(0, 1000, 64))
+    cap = len(build)
+    n = 8 * 128
+    probe = rng.integers(0, 1000, n)
+    valid = rng.random(n) < 0.9
+    vals = np.round(rng.random(n), 3)
+
+    fn = distributed_broadcast_join_agg(mesh, cap)
+    pk, pv, pw = shard_rows(mesh, jnp.asarray(probe),
+                            jnp.asarray(valid), jnp.asarray(vals))
+    sums, counts = fn(jnp.asarray(build), pk, pv, pw)
+    sums, counts = np.asarray(sums), np.asarray(counts)
+
+    # numpy oracle
+    want_s = np.zeros(cap)
+    want_c = np.zeros(cap, dtype=np.int64)
+    pos = {k: i for i, k in enumerate(build)}
+    for k, ok, v in zip(probe, valid, vals):
+        if ok and k in pos:
+            want_s[pos[k]] += v
+            want_c[pos[k]] += 1
+    assert np.array_equal(counts, want_c)
+    np.testing.assert_allclose(sums, want_s, rtol=1e-12)
