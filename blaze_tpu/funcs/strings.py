@@ -155,17 +155,20 @@ def _locate(args, batch, out_type):
     Catalyst-order call sites reach this swap.  An optional 1-based
     `start` offsets the search; Spark returns 0 when start < 1 and NULL
     when start is NULL."""
-    import pyarrow.compute as pc
     n = batch.num_rows
-    base = _instr([args[1], args[0]], batch, out_type)
     if len(args) <= 2:
-        return base
+        return _instr([args[1], args[0]], batch, out_type)
     starts = args[2].to_host(n).to_pylist()
     hays = args[1].to_host(n).to_pylist()
     needles = args[0].to_host(n).to_pylist()
     out = []
     for st, h, nd in zip(starts, hays, needles):
-        if st is None or h is None or nd is None:
+        if st is None:
+            # Spark's StringLocate: a NULL start yields 0, not NULL
+            # (the explicit Hive/MySQL-conformance branch in
+            # stringExpressions.scala)
+            out.append(0)
+        elif h is None or nd is None:
             out.append(None)
         elif st < 1:
             out.append(0)

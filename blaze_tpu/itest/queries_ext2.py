@@ -1277,7 +1277,8 @@ def q61(paths, tables, partitions: int = 2):
         exchange(project(base, [c("ss_ext_sales_price")], ["t"]),
                  [], 1),
         [("sum", "total", [ci(0)])])
-    j = join("broadcast_join", promo_sum, total_sum, [], [], jt="inner")
+    j = {"kind": "broadcast_nested_loop_join", "join_type": "inner",
+         "left": promo_sum, "right": total_sum, "build_side": "right"}
     plan = project(j, [ci(0), ci(1),
                        binop("/", binop("*", ci(0),
                                         lit(100.0, "float64")), ci(1))],

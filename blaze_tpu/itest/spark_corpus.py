@@ -291,6 +291,10 @@ def _math_edge():
                        "x": pa.array([1.0, -1.0])}),
              [_fn("atan2", _col(0), _col(1), rt="float64")],
              [(_m.pi / 4,), (-3 * _m.pi / 4,)], rtol=1e-12),
+        Case("log of NaN stays NaN, not null",
+             pa.table({"a": pa.array([float("nan")])}),
+             [_fn("ln", _col(0), rt="float64")],
+             [(float("nan"),)]),
     ]
 
 
@@ -376,6 +380,16 @@ def _string_edge():
              pa.table({"s": pa.array(["Spark SQL"])}),
              [_fn("substring", _col(0), _lit(0), _lit(3), rt="utf8")],
              [("Spa",)]),
+        Case("locate with start offset (NULL start yields 0, not NULL)",
+             pa.table({"s": pa.array(["abcb", "abcb", "abcb"]),
+                       "p": pa.array([3, 0, None])}),
+             [_fn("locate", _lit("b", "utf8"), _col(0), _col(1),
+                  rt="int32")],
+             [(4,), (0,), (0,)]),
+        Case("strpos uses datafusion (str, substr) order",
+             pa.table({"s": pa.array(["abcb"])}),
+             [_fn("strpos", _col(0), _lit("b", "utf8"), rt="int32")],
+             [(2,)]),
     ]
 
 
@@ -664,26 +678,3 @@ def default_settings() -> CorpusSettings:
     An empty ledger means full conformance on the vendored corpus."""
     return CorpusSettings().enable_all()
 
-
-def _late_vectors():
-    """Appended vectors (registered into existing suites)."""
-    SUITES["MathEdgeSuite"].append(
-        Case("log of NaN stays NaN, not null",
-             pa.table({"a": pa.array([float("nan")])}),
-             [_fn("ln", _col(0), rt="float64")],
-             [(float("nan"),)]))
-    SUITES["StringEdgeSuite"].append(
-        Case("locate with start offset",
-             pa.table({"s": pa.array(["abcb", "abcb", "abcb"]),
-                       "p": pa.array([3, 0, None])}),
-             [_fn("locate", _lit("b", "utf8"), _col(0), _col(1),
-                  rt="int32")],
-             [(4,), (0,), (None,)]))
-    SUITES["StringEdgeSuite"].append(
-        Case("strpos uses datafusion (str, substr) order",
-             pa.table({"s": pa.array(["abcb"])}),
-             [_fn("strpos", _col(0), _lit("b", "utf8"), rt="int32")],
-             [(2,)]))
-
-
-_late_vectors()

@@ -96,6 +96,10 @@ def distributed_broadcast_join_agg(mesh: Mesh, build_capacity: int):
 
     Returns fn(build_keys_sorted, probe_keys, probe_valid, probe_vals)
     -> (sums[build_capacity], counts[build_capacity]), replicated.
+
+    PRECONDITION: build_keys_sorted must be sorted AND unique — the
+    binary search credits one slot per key, so duplicate build keys
+    would silently undercount (callers dedup with np.unique).
     """
     def stage(build_keys, probe_keys, probe_valid, probe_vals):
         idx = jnp.searchsorted(build_keys, probe_keys)

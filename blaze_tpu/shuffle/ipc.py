@@ -183,6 +183,37 @@ class IpcCompressionReader:
                 yield from r
 
 
+def read_frames_from_buffer(buf: "pa.Buffer") -> Iterator[pa.RecordBatch]:
+    """Decode frames straight out of a zero-copy buffer (mmap-backed
+    file segment): raw frames hand Arrow IPC a BufferReader over the
+    original pages — no payload copy at all; compressed frames fall
+    back to a bytes round trip for the decompressor."""
+    mv = memoryview(buf)
+    pos = 0
+    end = len(buf)
+    while pos < end:
+        codec, length = _HEADER.unpack_from(mv, pos)
+        pos += _HEADER.size
+        if codec == CODEC_RAW:
+            payload = buf.slice(pos, length)
+            if payload.address % 64:
+                # frames sit behind a 5-byte header, so mmap slices are
+                # essentially never 64-byte aligned; Acero warns on (and
+                # some hardware penalizes) unaligned columnar buffers —
+                # one aligned copy is cheaper than per-frame syscall +
+                # BytesIO chains and keeps everything downstream safe
+                aligned = pa.allocate_buffer(length)
+                memoryview(aligned)[:] = memoryview(payload)
+                payload = aligned
+            with pa.ipc.open_stream(pa.BufferReader(payload)) as r:
+                yield from r
+        else:
+            raw = _decompress(codec, bytes(mv[pos:pos + length]))
+            with pa.ipc.open_stream(io.BytesIO(raw)) as r:
+                yield from r
+        pos += length
+
+
 def write_batches_to_bytes(batches) -> bytes:
     """One-shot helper (broadcast data, ref NativeBroadcastExchangeBase)."""
     sink = io.BytesIO()

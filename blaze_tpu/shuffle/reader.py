@@ -39,6 +39,25 @@ def read_block(block: Block) -> Iterator[pa.RecordBatch]:
     if isinstance(block, FileSegmentBlock):
         if block.length == 0:
             return
+        # mmap fast path: raw frames decode zero-copy against the page
+        # cache (the FileSegment mmap read of ipc_reader_exec.rs:277);
+        # the pa.py_buffer keeps the mapping alive as long as any batch
+        # references it
+        buf = None
+        try:
+            import mmap
+            with open(block.path, "rb") as f:
+                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            buf = pa.py_buffer(mm).slice(block.offset, block.length)
+        except (OSError, ValueError):
+            buf = None  # exotic FS / zero-length mapping: buffered path
+        if buf is not None:
+            # decode OUTSIDE the fallback guard: a mid-stream decode
+            # error must propagate, not restart the block and hand
+            # duplicate batches downstream
+            from blaze_tpu.shuffle.ipc import read_frames_from_buffer
+            yield from read_frames_from_buffer(buf)
+            return
         with open(block.path, "rb") as f:
             f.seek(block.offset)
             yield from IpcCompressionReader(f, limit=block.length).read_batches()
