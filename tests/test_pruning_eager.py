@@ -186,3 +186,63 @@ def _find_metric(plan, name):
             return v
         stack.extend(getattr(node, "children", []) or [])
     return None
+
+
+@pytest.mark.slow
+def test_eager_prune_fuzz(tmp_path):
+    """Random row-group layouts x random range/equality predicates vs a
+    pyarrow oracle: pruning + mask elision must never change results
+    (clustered, reversed, constant, and null-heavy key layouts)."""
+    rng = np.random.default_rng(11)
+    for trial in range(25):
+        rows = int(rng.integers(1, 30_000))
+        layout = rng.choice(["sorted", "reversed", "random", "constant"])
+        dt = rng.integers(0, 500, rows)
+        if layout == "sorted":
+            dt = np.sort(dt)
+        elif layout == "reversed":
+            dt = np.sort(dt)[::-1]
+        elif layout == "constant":
+            dt[:] = int(dt[0]) if rows else 0
+        cols = {"dt": pa.array(dt.copy()),
+                "k": pa.array(rng.integers(0, 20, rows)),
+                "v": pa.array(np.round(rng.random(rows), 3))}
+        if rng.random() < 0.5 and rows:
+            m = rng.random(rows) < 0.05
+            cols["dt"] = pa.array(
+                np.where(m, None, dt).tolist(), type=pa.int64())
+        t = pa.table(cols)
+        p = os.path.join(str(tmp_path), f"f{trial}.parquet")
+        pq.write_table(t, p,
+                       row_group_size=int(rng.integers(100, 5000)))
+        lo = int(rng.integers(-50, 520))
+        hi = lo + int(rng.integers(0, 300))
+        preds = [{"kind": "binary", "op": ">=", "l": _col("dt"),
+                  "r": _lit(lo)},
+                 {"kind": "binary", "op": "<=", "l": _col("dt"),
+                  "r": _lit(hi)}]
+        if rng.random() < 0.3:
+            preds = [{"kind": "binary", "op": "==", "l": _col("dt"),
+                      "r": _lit(lo)}]
+        plan_dict = {
+            "kind": "hash_agg",
+            "groupings": [{"expr": _col("k"), "name": "k"}],
+            "aggs": [{"fn": "sum", "mode": "partial", "name": "s",
+                      "args": [_col("v")]}],
+            "input": {"kind": "filter", "predicates": preds,
+                      "input": {"kind": "parquet_scan", "schema": SCHEMA,
+                                "file_groups": [[p]]}}}
+        _plan, got = _run_sum(plan_dict)
+        mask = None
+        for pr in preds:
+            op = pr["op"]
+            val = pr["r"]["value"]
+            m = {"==": pc.equal, ">=": pc.greater_equal,
+                 "<=": pc.less_equal}[op](t["dt"], val)
+            mask = m if mask is None else pc.and_(mask, m)
+        f = t.filter(mask)
+        agg = f.group_by(["k"]).aggregate([("v", "sum")])
+        want = dict(zip(agg["k"].to_pylist(), agg["v_sum"].to_pylist()))
+        assert set(got) == set(want), (trial, layout, lo, hi)
+        for kk in want:
+            assert abs(got[kk] - (want[kk] or 0.0)) < 1e-9, (trial, kk)
