@@ -75,3 +75,43 @@ def test_q01_spills_under_pressure(tmp_path):
             "expected at least one spill under a 256KiB budget"
     finally:
         MemManager.init(4 << 30)
+
+
+@pytest.mark.slow
+def test_wire_query_on_real_accelerator():
+    """Device-placement wire path on REAL accelerator hardware: q52
+    through DagScheduler with auron.tpu.placement=device.  Skips on
+    CPU-only environments (the itest/CI tier pins jax to cpu); run
+    without JAX_PLATFORMS to exercise the actual chip (see
+    DEVICE_WIRE_r04.json for a recorded run)."""
+    import jax
+
+    from blaze_tpu import config
+    if jax.default_backend() == "cpu":
+        pytest.skip("no accelerator backend in this environment")
+    import tempfile
+
+    import pandas as pd
+
+    from blaze_tpu.bridge import placement as P
+    from blaze_tpu.itest import generate
+    from blaze_tpu.itest.queries import QUERIES
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.itest.tpcds_data import write_parquet_splits
+    from blaze_tpu.plan.stages import DagScheduler
+    config.conf.set(config.PLACEMENT.key, "device")
+    P._info = None  # re-decide placement under the forced policy
+    try:
+        builder, tn = QUERIES["q52"]
+        tables = generate(tn, scale=0.05)
+        with tempfile.TemporaryDirectory() as tmp:
+            paths = write_parquet_splits(tables, tmp, 2)
+            plan_dict, oracle = builder(paths, tables, 2)
+            got = DagScheduler(work_dir=tmp + "/dag").run_collect(
+                plan_dict)
+            g = got.to_pandas() if got.num_rows else pd.DataFrame(
+                {n: [] for n in got.schema.names})
+            assert compare_frames(g, oracle()) is None
+    finally:
+        config.conf.unset(config.PLACEMENT.key)
+        P._info = None
