@@ -239,6 +239,24 @@ def partial_final(groups: List[A],
                              for fn, _arg, res in fns], mid)
 
 
+def window_rank(rk: A, part_by: List[A],
+                order: List[Tuple[A, bool]], child: List[dict]
+                ) -> List[dict]:
+    """window.WindowExec with one Alias(WindowExpression(Rank,
+    WindowSpecDefinition)) — the rank-family shape Spark 3.5 serializes
+    (AuronConverters window path)."""
+    rank_fn = [{"class": CAT + "Rank", "num-children": 0}]
+    spec = [{"class": CAT + "WindowSpecDefinition", "num-children": 0}]
+    wex = [{"class": CAT + "WindowExpression",
+            "num-children": 2}] + rank_fn + spec
+    return node("window.WindowExec",
+                {"windowExpression": [alias(wex, rk)],
+                 "partitionSpec": [[a.ref()[0]] for a in part_by],
+                 "orderSpec": [sort_order(a.ref(), desc)
+                               for a, desc in order]},
+                [child])
+
+
 def take_ordered(limit: int, keys: List[A], proj: List[A],
                  child: List[dict]) -> List[dict]:
     return node("TakeOrderedAndProjectExec",
@@ -536,6 +554,59 @@ def q95(paths, tables, partitions: int = 4):
     return plan, oracle
 
 
+def q67(paths, tables, partitions: int = 4):
+    """Expand rollup + window rank over category revenue (the window-
+    bearing converter path: WindowExec + Rank through toJSON)."""
+    _reset_ids()
+    ss = Table("store_sales", tables["store_sales"],
+               paths["store_sales"])
+    it = Table("item", tables["item"], paths["item"])
+    dd = Table("date_dim", tables["date_dim"], paths["date_dim"])
+
+    dd_f = filter_(e2("EqualTo", dd.a("d_year").ref(),
+                      lit(1999, "integer")), dd.scan())
+    j_dd = bhj([ss.a("ss_sold_date_sk")], [dd.a("d_date_sk")],
+               ss.scan(), dd_f)
+    j_it = bhj([ss.a("ss_item_sk")], [it.a("i_item_sk")], j_dd,
+               it.scan())
+
+    out_attrs = [A("i_category", "string"), A("i_class", "string"),
+                 A("g_id", "long"), A("ss_ext_sales_price", "double")]
+    projections = []
+    for kept, gid in ((2, 0), (1, 1), (0, 3)):
+        row = [it.a("i_category").ref() if kept >= 1
+               else lit(None, "string"),
+               it.a("i_class").ref() if kept >= 2
+               else lit(None, "string"),
+               lit(gid, "long"),
+               ss.a("ss_ext_sales_price").ref()]
+        projections.append(row)
+    expanded = node("ExpandExec",
+                    {"projections": projections,
+                     "output": [a.ref() for a in out_attrs]}, [j_it])
+
+    sumsales = A("sumsales", "double")
+    rev = partial_final(
+        out_attrs[:3],
+        [("Sum", out_attrs[3].ref(), sumsales)],
+        partitions, expanded)
+    # category asc then revenue desc — the converter consumes
+    # sortOrder as given
+    srt = node("SortExec",
+               {"sortOrder": [sort_order(out_attrs[0].ref()),
+                              sort_order(sumsales.ref(), desc=True)]},
+               [single_exchange(rev)])
+    rk = A("rk", "integer")
+    win = window_rank(rk, [out_attrs[0]], [(sumsales, True)], srt)
+    flt = filter_(e2("LessThanOrEqual", rk.ref(), lit(10, "integer")),
+                  win)
+    plan = take_ordered(100, [out_attrs[0], rk],
+                        out_attrs[:3] + [sumsales, rk], flt)
+
+    _plan, oracle = Q.q67(paths, tables, partitions)
+    return plan, oracle
+
+
 SPARK_QUERIES = {
     "q01": (q01, ["store_returns", "date_dim", "store", "customer"]),
     "q06": (q06, ["store_sales", "item"]),
@@ -544,4 +615,5 @@ SPARK_QUERIES = {
     "q18": (q18, ["catalog_sales", "customer_demographics", "customer",
                   "customer_address", "item"]),
     "q95": (q95, ["web_sales", "web_returns", "customer_address"]),
+    "q67": (q67, ["store_sales", "item", "date_dim"]),
 }
