@@ -70,8 +70,10 @@ def _cell_equal(a, b) -> bool:
         fa, fb = float(a), float(b)
         if math.isnan(fa) or math.isnan(fb):
             return math.isnan(fa) and math.isnan(fb)
-        if fa == fb:
-            return True  # covers equal infinities (inf - inf is nan)
+        if math.isinf(fa) or math.isinf(fb):
+            # exact match only: inf <= tol*inf would otherwise pass ANY
+            # value against an infinity
+            return fa == fb
         return abs(fa - fb) <= DOUBLE_TOL * max(1.0, abs(fa), abs(fb))
     return a == b
 

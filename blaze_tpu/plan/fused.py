@@ -51,7 +51,7 @@ from blaze_tpu.parallel.stage import (hash_agg_step, init_accumulators,
                                       init_hash_carry, pack_dense_keys,
                                       rehash_carry, scatter_accumulate,
                                       unpack_dense_keys)
-from blaze_tpu.schema import Field, Schema
+from blaze_tpu.schema import Field, Schema, TypeId
 
 
 def fuse_plan(plan: ExecutionPlan) -> ExecutionPlan:
@@ -118,12 +118,27 @@ def _try_fuse_agg(node: ExecutionPlan) -> Optional["FusedPartialAggExec"]:
         specs.append((reduce_kind, out_kind, arg))
 
     key_types = [e.data_type(in_schema) for e, _ in groups]
-    if not all(t.is_fixed_width for t in key_types):
-        return None
+    fixed_keys = all(t.is_fixed_width for t in key_types)
+    if not fixed_keys:
+        # utf8 group keys can't reach the device strategies, but Arrow's
+        # hash aggregation handles them natively — admit them when the
+        # host-vectorized path will actually run (placement is decided
+        # before plans build, so this is stable for the task).  The
+        # eager fallback re-lexsorts buffered partials per combine,
+        # which dominated string-keyed queries (q79 at SF1: 10.5s -> the
+        # acero path).
+        from blaze_tpu.bridge.placement import host_resident
+        if not all(t.is_fixed_width or t.id == TypeId.UTF8
+                   for t in key_types):
+            return None
+        if not (host_resident()
+                and config.FUSED_HOST_VECTORIZED_ENABLE.get()
+                and _host_vectorized_eligible(groups, specs, in_schema)):
+            return None  # var-width keys only ride the host path
 
     # dense needs integer keys with discoverable bounds
     ranges = None
-    if all(t.is_integer for t in key_types):
+    if fixed_keys and all(t.is_integer for t in key_types):
         ranges = _discover_ranges(child, groups)
         if ranges is not None:
             total = 1
@@ -147,7 +162,29 @@ def _try_fuse_agg(node: ExecutionPlan) -> Optional["FusedPartialAggExec"]:
     # whole-chain-in-one-task)
     source, chain = _absorbable_chain(child)
     return FusedPartialAggExec(child, groups, aggs, specs, ranges,
-                               complete, grow, source=source, chain=chain)
+                                complete, grow, source=source, chain=chain)
+
+
+def _host_vectorized_eligible(group_exprs, specs, in_schema) -> bool:
+    """Restrict the Arrow group_by path to where its semantics are
+    bit-identical to the device kernels: integer-family (or utf8) keys
+    (float keys need NaN/-0.0 normalization, decimals the unscaled-int
+    representation) and sum/count on non-decimal args; min/max only on
+    non-float args (Spark orders NaN largest; Arrow min_max skips
+    NaN)."""
+    for e, _n in group_exprs:
+        t = e.data_type(in_schema)
+        if t.is_floating or t.id == TypeId.DECIMAL:
+            return False
+    for rk, _ok, arg in specs:
+        if arg is None:
+            continue
+        t = arg.data_type(in_schema)
+        if t.id == TypeId.DECIMAL:
+            return False
+        if rk in ("min", "max") and t.is_floating:
+            return False
+    return True
 
 
 def _absorbable_chain(child: ExecutionPlan):
@@ -363,7 +400,16 @@ class FusedPartialAggExec(ExecutionPlan):
         return (config.FUSED_HOST_VECTORIZED_ENABLE.get() and
                 host_resident() and self._host_vectorized_eligible())
 
+    @property
+    def _has_var_keys(self) -> bool:
+        return any(not e.data_type(self._in_schema).is_fixed_width
+                   for e, _n in self._group_exprs)
+
     def execute(self, partition: int) -> BatchIterator:
+        if self._has_var_keys and not self._use_host_vectorized():
+            raise RuntimeError(
+                "fused utf8-key aggregation requires host placement "
+                "(placement changed after plan fusion?)")
         if self._use_host_vectorized():
             # host placement: Arrow's multithreaded C++ hash aggregation
             # (GIL-releasing) is the host-engine analog of the reference's
@@ -388,26 +434,8 @@ class FusedPartialAggExec(ExecutionPlan):
 
     # -- host placement: Arrow C++ hash aggregation ------------------------
     def _host_vectorized_eligible(self) -> bool:
-        """Restrict the Arrow group_by path to where its semantics are
-        bit-identical to the device kernels: integer-family keys (float
-        keys need NaN/-0.0 normalization, decimals the unscaled-int
-        representation) and sum/count on non-decimal args; min/max only on
-        non-float args (Spark orders NaN largest; Arrow min_max skips
-        NaN)."""
-        from blaze_tpu.schema import TypeId
-        for e, _n in self._group_exprs:
-            t = e.data_type(self._in_schema)
-            if t.is_floating or t.id == TypeId.DECIMAL:
-                return False
-        for rk, _ok, arg in self._specs:
-            if arg is None:
-                continue
-            t = arg.data_type(self._in_schema)
-            if t.id == TypeId.DECIMAL:
-                return False
-            if rk in ("min", "max") and t.is_floating:
-                return False
-        return True
+        return _host_vectorized_eligible(self._group_exprs, self._specs,
+                                         self._in_schema)
 
     def _execute_host_vectorized(self, partition: int) -> BatchIterator:
         import pyarrow as pa
