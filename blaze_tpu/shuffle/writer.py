@@ -248,6 +248,25 @@ class ShuffleRepartitioner(MemConsumer):
                 idx.write(struct.pack("<q", 0))
                 idx.write(struct.pack("<q", end))
             return [end]
+        if not self._spills:
+            # no spills: partition-major frames stream straight into the
+            # .data file — the BytesIO staging pass existed only to merge
+            # with spill segments, and doubled every shuffle byte
+            with open(data_file, "wb") as out:
+                if self._staged:
+                    offsets = self._write_partitioned(
+                        out, codec_name=config.SHUFFLE_FILE_CODEC.get())
+                else:  # empty input: all-zero offsets, empty .data
+                    offsets = [0] * (
+                        self.partitioning.num_partitions + 1)
+            self._staged = []
+            self._staged_bytes = 0
+            self.update_mem_used(0)
+            with open(index_file, "wb") as idx:
+                for off in offsets:
+                    idx.write(struct.pack("<q", off))
+            return [offsets[i + 1] - offsets[i]
+                    for i in range(len(offsets) - 1)]
         mem_offsets: List[int] = []
         mem_buf = io.BytesIO()
         if self._staged:
