@@ -232,10 +232,15 @@ class ShuffleRepartitioner(MemConsumer):
 
     # -- final write (ref shuffle_write, shuffle/mod.rs:58) ----------------
     def write(self, data_file: str, index_file: str) -> List[int]:
-        """Merge spills + staged rows into .data/.index; returns lengths."""
+        """Merge spills + staged rows into .data/.index; returns lengths.
+
+        Every mode serializes into a task-private temp file and commits
+        with os.replace — a failure mid-write can never leave a
+        truncated .data at the final path (the AuronShuffleWriterBase
+        tmp-file discipline); the .index is written only after the
+        commit, from one shared tail."""
         if self._stream_sink is not None:
-            # streaming mode: frames are already on disk; finish, commit
-            # via atomic rename, then index
+            # streaming mode: frames are already on the temp file
             assert data_file == self._stream_file
             if self._stream_writer is not None:
                 self._stream_writer.finish()
@@ -243,63 +248,70 @@ class ShuffleRepartitioner(MemConsumer):
             self._stream_sink.close()
             self._stream_sink = None
             self._stream_writer = None
+            offsets = [0, end]
             os.replace(self._stream_tmp, data_file)
-            with open(index_file, "wb") as idx:
-                idx.write(struct.pack("<q", 0))
-                idx.write(struct.pack("<q", end))
-            return [end]
-        if not self._spills:
-            # no spills: partition-major frames stream straight into the
-            # .data file — the BytesIO staging pass existed only to merge
-            # with spill segments, and doubled every shuffle byte
-            with open(data_file, "wb") as out:
-                if self._staged:
-                    offsets = self._write_partitioned(
-                        out, codec_name=config.SHUFFLE_FILE_CODEC.get())
-                else:  # empty input: all-zero offsets, empty .data
-                    offsets = [0] * (
-                        self.partitioning.num_partitions + 1)
-            self._staged = []
-            self._staged_bytes = 0
-            self.update_mem_used(0)
-            with open(index_file, "wb") as idx:
-                for off in offsets:
-                    idx.write(struct.pack("<q", off))
-            return [offsets[i + 1] - offsets[i]
-                    for i in range(len(offsets) - 1)]
+        else:
+            tmp = f"{data_file}.inprogress.{os.getpid()}.{id(self):x}"
+            try:
+                with open(tmp, "wb") as out:
+                    if not self._spills:
+                        # no spills: partition-major frames stream
+                        # straight out — BytesIO staging existed only to
+                        # merge with spill segments, and doubled every
+                        # shuffle byte
+                        if self._staged:
+                            offsets = self._write_partitioned(
+                                out,
+                                codec_name=config.SHUFFLE_FILE_CODEC.get())
+                        else:  # empty input: empty .data, zero offsets
+                            offsets = [0] * (
+                                self.partitioning.num_partitions + 1)
+                    else:
+                        offsets = self._merge_spills_into(out)
+                self._staged = []
+                self._staged_bytes = 0
+                self.update_mem_used(0)
+                os.replace(tmp, data_file)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        with open(index_file, "wb") as idx:
+            for off in offsets:
+                idx.write(struct.pack("<q", off))
+        return [offsets[i + 1] - offsets[i]
+                for i in range(len(offsets) - 1)]
+
+    def _merge_spills_into(self, out: BinaryIO) -> List[int]:
+        """Staged rows + spill segments, partition-major, into `out`."""
         mem_offsets: List[int] = []
         mem_buf = io.BytesIO()
         if self._staged:
             mem_offsets = self._write_partitioned(
                 mem_buf, codec_name=config.SHUFFLE_FILE_CODEC.get())
-            self._staged = []
-            self._staged_bytes = 0
-            self.update_mem_used(0)
         n_parts = self.partitioning.num_partitions
         offsets = [0]
         spill_files = [open(s.path, "rb") for s in self._spills]
         try:
             mem_view = mem_buf.getbuffer()
-            with open(data_file, "wb") as out:
-                for p in range(n_parts):
-                    if mem_offsets:
-                        out.write(mem_view[mem_offsets[p]:mem_offsets[p + 1]])
-                    for s, f in zip(self._spills, spill_files):
-                        seg_len = s.offsets[p + 1] - s.offsets[p]
-                        if seg_len:
-                            f.seek(s.offsets[p])
-                            out.write(f.read(seg_len))
-                    offsets.append(out.tell())
+            for p in range(n_parts):
+                if mem_offsets:
+                    out.write(mem_view[mem_offsets[p]:mem_offsets[p + 1]])
+                for s, f in zip(self._spills, spill_files):
+                    seg_len = s.offsets[p + 1] - s.offsets[p]
+                    if seg_len:
+                        f.seek(s.offsets[p])
+                        out.write(f.read(seg_len))
+                offsets.append(out.tell())
         finally:
             for f in spill_files:
                 f.close()
             for s in self._spills:
                 s.release()
             self._spills = []
-        with open(index_file, "wb") as idx:
-            for off in offsets:
-                idx.write(struct.pack("<q", off))
-        return [offsets[i + 1] - offsets[i] for i in range(n_parts)]
+        return offsets
 
     def write_rss(self, rss_write: Callable[[int, bytes], None]) -> None:
         """Push per-partition bytes through a host callback
