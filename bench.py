@@ -587,7 +587,7 @@ def child_main():
     cpu_times = []
     times = []
     pd_times = []
-    for _ in range(max(7, ITERS)):
+    for _ in range(max(9, ITERS)):
         t0 = time.perf_counter()
         want_groups, want_total = run_baseline(sr_paths, dd_path)
         cpu_times.append(time.perf_counter() - t0)
