@@ -220,7 +220,62 @@ _SCALAR_FNS = {
     "Hour": "hour", "Minute": "minute", "Second": "second",
     "Substring": "substring", "Trim": "trim", "StringTrim": "trim",
     "Md5": "md5", "Signum": "signum",
+    # string family (Catalyst child order == engine arg order for all
+    # entries below; order-mismatched classes like TruncTimestamp stay
+    # on the UDF-wrap fallback)
+    "InitCap": "initcap", "StringLPad": "lpad", "StringRPad": "rpad",
+    "StringTrimLeft": "ltrim", "StringTrimRight": "rtrim",
+    "StringRepeat": "repeat",
+    "StringSpace": "space", "Chr": "chr", "Ascii": "ascii",
+    "StringReplace": "replace",
+    "StringTranslate": "translate", "SubstringIndex": "substring_index",
+    "StringLocate": "locate", "StringInstr": "instr",
+    "GetJsonObject": "get_json_object",
+    # NOT mapped (the UDF-wrap fallback keeps Spark semantics the engine
+    # kernels narrow): RegExpReplace (Java $1 group refs + pos arg),
+    # Reverse/ConcatWs (array inputs), Greatest/Least (non-fixed-width
+    # types reach device-only kernels), TruncTimestamp (reversed args)
+    # math family
+    "Log": "ln", "Log10": "log10", "Log2": "log2", "Log1p": "log1p",
+    "Expm1": "expm1", "Pow": "pow", "Cbrt": "cbrt",
+    "Sin": "sin", "Cos": "cos", "Tan": "tan", "Asin": "asin",
+    "Acos": "acos", "Atan": "atan", "Atan2": "atan2", "Sinh": "sinh",
+    "Cosh": "cosh", "Tanh": "tanh", "ToDegrees": "degrees",
+    "ToRadians": "radians", "IsNaN": "isnan", "NaNvl": "nanvl",
+    # date family
+    "DateAdd": "date_add", "DateSub": "date_sub",
+    "DateDiff": "datediff", "LastDay": "last_day",
+    "NextDay": "next_day", "AddMonths": "add_months",
+    "Quarter": "quarter", "WeekOfYear": "weekofyear",
+    "DayOfWeek": "dayofweek", "WeekDay": "weekday",
+    "DayOfYear": "dayofyear", "TruncDate": "trunc",
+    # crypto
+    "Sha1": "sha1", "Sha2": "sha2", "Crc32": "crc32",
+    # collections
+    "ArrayContains": "array_contains", "ArrayDistinct": "array_distinct",
+    "ArrayMax": "array_max", "ArrayMin": "array_min",
+    "ArrayJoin": "array_join", "ArrayUnion": "array_union",
+    "Size": "size", "ElementAt": "element_at",
+    "MapKeys": "map_keys", "MapValues": "map_values",
 }
+
+
+# engine kernels that require CONSTANT trailing arguments (const_arg
+# raises at evaluate time otherwise): a non-literal child must fall back
+# to the UDF wrapper at CONVERT time, where the fallback still exists
+_LITERAL_ONLY_TAIL = {
+    "StringTranslate": (1, 2), "StringReplace": (1, 2),
+    "StringTrim": (1,), "StringTrimLeft": (1,), "StringTrimRight": (1,),
+    "SubstringIndex": (1,), "GetJsonObject": (1,),
+}
+
+
+def _require_literal_args(cls_name: str, children) -> None:
+    for i in _LITERAL_ONLY_TAIL.get(cls_name, ()):
+        if i < len(children) and _cls(children[i]) != "Literal":
+            raise ConversionError(
+                cls_name, f"argument {i} must be a literal for the "
+                          f"native kernel (UDF fallback handles the rest)")
 
 
 def convert_expr(node: dict, scope: Scope) -> Dict[str, Any]:
@@ -303,6 +358,7 @@ def convert_expr(node: dict, scope: Scope) -> Dict[str, Any]:
                 "child": convert_expr(ch[0], scope),
                 "pattern": ch[1].get("value")}
     if c in _SCALAR_FNS:
+        _require_literal_args(c, ch)
         return {"kind": "scalar_function", "name": _SCALAR_FNS[c],
                 "args": [convert_expr(a, scope) for a in ch]}
     raise ConversionError(c, "unsupported expression "

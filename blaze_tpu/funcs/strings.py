@@ -398,8 +398,14 @@ def _chr(args, batch, out_type):
         if not x.is_valid:
             py.append(None)
         else:
-            code = int(x.as_py()) % 256
-            py.append("" if code == 0 else chr(code))
+            n = int(x.as_py())
+            # Spark Chr: negative -> empty string; multiples of 256 ->
+            # the NUL character, NOT empty (ref stringExpressions.Chr)
+            if n < 0:
+                py.append("")
+            else:
+                code = n & 255
+                py.append("\u0000" if code == 0 else chr(code))
     return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
 
 

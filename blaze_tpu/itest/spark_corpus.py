@@ -219,6 +219,10 @@ def _strings():
              [_fn("ascii", _col(0), rt="int32"),
               _fn("chr", _col(1), rt="utf8")],
              [(65, "B")]),
+        Case("chr edge codes: negative empty, 256 is NUL",
+             pa.table({"n": pa.array([-1, 0, 256, 321])}),
+             [_fn("chr", _col(0), rt="utf8")],
+             [("",), ("\x00",), ("\x00",), ("A",)]),
     ]
 
 
