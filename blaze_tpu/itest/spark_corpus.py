@@ -523,6 +523,53 @@ def _coll():
     ]
 
 
+@_suite("CollectionEdgeSuite")
+def _collection_edge():
+    arr = pa.table({"a": pa.array([[1, 2, 3], [5], None])})
+    return [
+        Case("element_at is 1-based, negative from end, OOB null",
+             arr,
+             [_fn("element_at", _col(0), _lit(2), rt="int64"),
+              _fn("element_at", _col(0), _lit(-1), rt="int64"),
+              _fn("element_at", _col(0), _lit(4), rt="int64")],
+             [(2, 3, None), (None, 5, None), (None, None, None)]),
+        Case("array_join skips null elements",
+             pa.table({"a": pa.array([["x", None, "y"]])}),
+             [_fn("array_join", _col(0), _lit(",", "utf8"), rt="utf8")],
+             [("x,y",)]),
+        Case("split delimiter is a regex",
+             pa.table({"s": pa.array(["a.b.c"])}),
+             [_fn("split", _col(0), _lit("\\.", "utf8"))],
+             [((["a", "b", "c"]),)]),
+    ]
+
+
+@_suite("RegexpEdgeSuite")
+def _regexp_edge():
+    return [
+        Case("regexp_extract returns empty string on no match",
+             pa.table({"s": pa.array(["a123b", "zzz"])}),
+             [_fn("regexp_extract", _col(0),
+                  _lit("([0-9]+)", "utf8"), _lit(1), rt="utf8")],
+             [("123",), ("",)]),
+    ]
+
+
+@_suite("MathSignSuite")
+def _math_sign():
+    return [
+        Case("round HALF_UP is away from zero on negatives",
+             pa.table({"a": pa.array([-2.5, 2.5, -0.45])}),
+             [_fn("round", _col(0), rt="float64"),
+              _fn("round", _col(0), _lit(1), rt="float64")],
+             [(-3.0, -2.5), (3.0, 2.5), (-0.0, -0.5)], rtol=1e-12),
+        Case("signum preserves negative zero",
+             pa.table({"a": pa.array([-0.0, 0.0])}),
+             [_fn("signum", _col(0), rt="float64")],
+             [(-0.0,), (0.0,)]),
+    ]
+
+
 @_suite("RegexpLikeSuite")
 def _regexp():
     s = pa.table({"s": pa.array(["Spark", "park", None, "SPARK"])})
