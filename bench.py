@@ -85,17 +85,30 @@ SCALE = float(os.environ.get("BLAZE_BENCH_SCALE", "1.0"))
 N_FILES = int(os.environ.get("BLAZE_BENCH_FILES", "4"))
 
 # Partition counts follow what Spark would actually schedule for this
-# input: one map per spark.sql.files.maxPartitionBytes (128MB) of input
-# (FilePartition packing), and AQE advisory coalescing of reduce
-# partitions toward 64MB (spark.sql.adaptive.advisoryPartitionSizeInBytes)
-# — the reference runs under exactly these defaults in its TPC-DS CI
-# (dev/auron-it/local-run-tpcds.sh).  Overridable for scaling studies.
+# input.  Maps: FilePartition packing under maxSplitBytes =
+# min(maxPartitionBytes=128MB, max(openCostInBytes=4MB, bytesPerCore))
+# with bytesPerCore = (totalBytes + #files*openCost) / defaultParallelism
+# — the exact formula the reference re-implements engine-side
+# (NativeIcebergTableScanExec.scala:318-325, NativePaimonTableScanExec
+# .scala:237-241); on a small input it is bytesPerCore, not 128MB, that
+# governs, so spark-local[N] fans maps out to the cores.  Reduces: AQE
+# coalescing toward advisoryPartitionSizeInBytes=64MB, but
+# coalescePartitions.parallelismFirst=true (the Spark default) keeps at
+# least defaultParallelism partitions as long as each clears
+# minPartitionSize=1MB.  Overridable for scaling studies.
 _SF1_BYTES = 6_100_000  # measured SF1 store_returns footprint
+_OPEN_COST = 4 << 20    # spark.sql.files.openCostInBytes default
+_CORES = os.cpu_count() or 2  # local[*] defaultParallelism
 
 def _spark_partitions(scale: float):
-    est_bytes = _SF1_BYTES * scale
-    maps = max(1, -(-int(est_bytes) // (128 << 20)))
-    reduces = max(1, -(-int(est_bytes // 3) // (64 << 20)))
+    est_bytes = int(_SF1_BYTES * scale)
+    total = est_bytes + N_FILES * _OPEN_COST
+    max_split = min(128 << 20, max(_OPEN_COST, total // _CORES))
+    # whole-file granularity: our FileScanExecConf groups whole files
+    maps = min(N_FILES, max(1, -(-total // max_split)))
+    shuffle_est = est_bytes // 3
+    reduces = max(1, -(-shuffle_est // (64 << 20)))
+    reduces = max(reduces, min(_CORES, max(1, shuffle_est >> 20)))
     return maps, reduces
 
 _DEF_MAPS, _DEF_REDUCES = _spark_partitions(SCALE)

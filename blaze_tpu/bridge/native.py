@@ -86,6 +86,35 @@ def get_codec() -> Optional[_Codec]:
     return _codec
 
 
+_agg: Optional[ctypes.CDLL] = None
+_agg_checked = False
+
+
+def get_agg_kernel() -> Optional[ctypes.CDLL]:
+    """Specialized i64-key hash group-aggregation (agg_kernel.cpp);
+    None (pure-Arrow fallback) when unbuilt."""
+    global _agg, _agg_checked
+    if not _agg_checked:
+        _agg_checked = True
+        path = _find("libblaze_agg_kernel.so")
+        if path:
+            try:
+                lib = ctypes.CDLL(path)
+                lib.blaze_group_agg_i64.restype = ctypes.c_int64
+                lib.blaze_group_agg_i64.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                    ctypes.POINTER(ctypes.c_int32),
+                    ctypes.POINTER(ctypes.c_void_p),
+                    ctypes.POINTER(ctypes.c_void_p),
+                    ctypes.c_void_p,
+                    ctypes.POINTER(ctypes.c_void_p),
+                    ctypes.POINTER(ctypes.c_void_p)]
+                _agg = lib
+            except OSError:
+                _agg = None
+    return _agg
+
+
 def get_host_bridge() -> Optional[ctypes.CDLL]:
     """The C-ABI entry-point library (tests exercise it in-process)."""
     path = _find("libblaze_host_bridge.so")

@@ -901,6 +901,229 @@ class FusedPartialAggExec(ExecutionPlan):
             names.append(f"__arg{i}")
         return pa.table(arrays, names=names)
 
+    @staticmethod
+    def _pack_keys_info(tbl, key_names):
+        """Integer group keys pack losslessly into ONE non-negative
+        int64: per key k -> k - min + 1 (null -> 0, its own Spark group),
+        mixed-radix combined across keys.  Returns (packed int64 column
+        with no nulls, spans, mins), or None when any key is non-integer
+        or the radix product would overflow int64."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        cols = []
+        spans = []
+        mins = []
+        total = 1
+        for n in key_names:
+            col = tbl.column(n)
+            if not pa.types.is_integer(col.type):
+                return None
+            mm = pc.min_max(col)
+            if not mm["min"].is_valid:  # all-null key: span = {null}
+                lo, span = 0, 1
+            else:
+                lo = mm["min"].as_py()
+                span = mm["max"].as_py() - lo + 2  # +1 for the null slot
+            total *= span
+            if total > (1 << 62):
+                return None
+            cols.append(col)
+            spans.append(span)
+            mins.append(lo)
+        packed = None
+        for col, span, lo in zip(cols, spans, mins):
+            enc = pc.fill_null(
+                pc.add(pc.cast(col, pa.int64(), safe=False), 1 - lo), 0)
+            packed = enc if packed is None else \
+                pc.add(pc.multiply(packed, span), enc)
+        return packed, spans, mins
+
+    @staticmethod
+    def _unpack_np_keys(out_k, key_types, spans, mins):
+        """Decode packed keys (numpy int64) back to per-key pa arrays,
+        restoring nulls."""
+        import numpy as np
+        import pyarrow as pa
+        parts = []
+        k = out_k
+        for span in reversed(spans):
+            parts.append(k % span)
+            k = k // span
+        parts.reverse()
+        out = []
+        for enc, lo, t in zip(parts, mins, key_types):
+            arr = pa.array(enc + (lo - 1), mask=(enc == 0))
+            if not arr.type.equals(t):
+                arr = arr.cast(t, safe=False)
+            out.append(arr)
+        return out
+
+    _KERNEL_MIN_ROWS = 4096
+
+    def _native_group_by(self, tbl, key_names, kinds):
+        """Hash group-aggregation through the native agg kernel
+        (agg_kernel.cpp blaze_group_agg_i64): packed int64 key + flat
+        accumulator arrays, ~4x Arrow's group_by on high-cardinality
+        integer keys.  `kinds` = [(op, col_name_or_None)] in __acc
+        output order; op in sum/count/min/max.  Returns the full output
+        column list [keys..., accs...] or None -> Arrow fallback."""
+        import ctypes
+
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        from blaze_tpu.bridge.native import get_agg_kernel
+        lib = get_agg_kernel()
+        n = tbl.num_rows
+        if (lib is None or not key_names or n < self._KERNEL_MIN_ROWS
+                or n >= (1 << 31)):
+            return None
+        # op eligibility first — packing is two full passes over the
+        # table, pointless if any agg can't ride the kernel anyway
+        for op_name, colname in kinds:
+            if colname is None or op_name == "count":
+                continue
+            t = tbl.column(colname).type
+            if op_name == "sum":
+                if not (pa.types.is_floating(t) or pa.types.is_integer(t)):
+                    return None
+            elif op_name in ("min", "max"):
+                if not pa.types.is_integer(t):
+                    return None
+            else:
+                return None
+        info = self._pack_keys_info(tbl, key_names)
+        if info is None:
+            return None
+        packed, spans, mins = info
+        ops = []
+        val_nps = []       # keeps numpy operands alive across the call
+        valid_nps = []
+        out_nps = []
+        out_valid_nps = []
+        post = []          # (arrow_type_or_None, is_count)
+        for op_name, colname in kinds:
+            if op_name == "count" and colname is None:
+                ops.append(2)
+                val_nps.append(None)
+                valid_nps.append(None)
+                out_nps.append(np.empty(n, np.int64))
+                out_valid_nps.append(np.empty(n, np.uint8))
+                post.append((None, True))
+                continue
+            col = tbl.column(colname)
+            t = col.type
+            if op_name == "count":
+                # only the operand's validity matters; never cast values
+                ops.append(2)
+                val_nps.append(None)
+                valid_nps.append(np.ascontiguousarray(
+                    col.combine_chunks().is_valid().to_numpy(
+                        zero_copy_only=False), dtype=np.uint8)
+                    if col.null_count else None)
+                out_nps.append(np.empty(n, np.int64))
+                out_valid_nps.append(np.empty(n, np.uint8))
+                post.append((None, True))
+                continue
+            if op_name == "sum" and pa.types.is_floating(t):
+                op, target, out_t = 0, pa.float64(), None
+            elif op_name == "sum" and pa.types.is_integer(t):
+                op, target, out_t = 1, pa.int64(), None
+            elif op_name in ("min", "max") and pa.types.is_integer(t):
+                op = 3 if op_name == "min" else 4
+                target, out_t = pa.int64(), t
+            else:
+                return None
+            ops.append(op)
+            cc = col.combine_chunks()
+            if col.null_count:
+                vals = pc.fill_null(pc.cast(cc, target, safe=False), 0)
+                valid_nps.append(np.ascontiguousarray(
+                    cc.is_valid().to_numpy(zero_copy_only=False),
+                    dtype=np.uint8))
+            else:
+                vals = pc.cast(cc, target, safe=False)
+                valid_nps.append(None)
+            val_nps.append(np.ascontiguousarray(
+                vals.to_numpy(zero_copy_only=False)))
+            out_nps.append(np.empty(
+                n, np.float64 if op == 0 else np.int64))
+            out_valid_nps.append(np.empty(n, np.uint8))
+            post.append((out_t, op == 2))
+        key_np = np.ascontiguousarray(
+            packed.combine_chunks().to_numpy(zero_copy_only=False)
+            if isinstance(packed, pa.ChunkedArray)
+            else packed.to_numpy(zero_copy_only=False), dtype=np.int64)
+        out_keys = np.empty(n, np.int64)
+
+        def ptr(a):
+            return ctypes.c_void_p(a.ctypes.data) if a is not None else None
+
+        n_aggs = len(ops)
+        ng = lib.blaze_group_agg_i64(
+            ptr(key_np), n, n_aggs,
+            (ctypes.c_int32 * n_aggs)(*ops),
+            (ctypes.c_void_p * n_aggs)(*[ptr(a) for a in val_nps]),
+            (ctypes.c_void_p * n_aggs)(*[ptr(a) for a in valid_nps]),
+            ptr(out_keys),
+            (ctypes.c_void_p * n_aggs)(*[ptr(a) for a in out_nps]),
+            (ctypes.c_void_p * n_aggs)(*[ptr(a) for a in out_valid_nps]))
+        if ng < 0:
+            return None
+        key_types = [tbl.column(kn).type for kn in key_names]
+        out = self._unpack_np_keys(out_keys[:ng], key_types, spans, mins)
+        for (out_t, is_count), vals, valid in zip(post, out_nps,
+                                                  out_valid_nps):
+            mask = None if is_count else (valid[:ng] == 0)
+            arr = pa.array(vals[:ng], mask=mask)
+            if out_t is not None and not arr.type.equals(out_t):
+                arr = arr.cast(out_t, safe=False)
+            out.append(arr)
+        self.metrics.add("native_agg_rows", n)
+        return out
+
+    def _grouped(self, tbl, key_names, aggspec):
+        """tbl.group_by with multi-integer-key PACKING: Arrow's hash
+        aggregation hashes/compares every key column per row, so N
+        integer keys pack into ONE computed int64 key (_pack_keys_info),
+        cutting per-row hash work on multi-key aggregations.  The
+        packed column is decoded back to the original key columns —
+        including nulls, which Spark groups as their own key — after
+        aggregation.  Falls back to the plain multi-column group_by
+        whenever packing is inapplicable."""
+        import pyarrow as pa
+        if len(key_names) < 2 or tbl.num_rows < self._KERNEL_MIN_ROWS:
+            return tbl.group_by(key_names, use_threads=True) \
+                      .aggregate(aggspec), tbl, None
+        info = self._pack_keys_info(tbl, key_names)
+        if info is None:
+            return tbl.group_by(key_names, use_threads=True) \
+                      .aggregate(aggspec), tbl, None
+        packed, spans, mins = info
+        ptbl = tbl.drop_columns(key_names).append_column("__gk", packed)
+        g = ptbl.group_by(["__gk"], use_threads=True).aggregate(aggspec)
+        return g, tbl, (spans, mins)
+
+    @classmethod
+    def _unpack_keys(cls, g, tbl, key_names, packing):
+        """Decode the packed __gk column of an aggregate result back to
+        the original key columns (None packing: keys are already
+        present).  Delegates to the single mixed-radix decoder."""
+        import numpy as np
+        import pyarrow as pa
+        if packing is None:
+            return [g.column(n) for n in key_names]
+        spans, mins = packing
+        k = g.column("__gk")
+        if isinstance(k, pa.ChunkedArray):
+            k = k.combine_chunks()
+        key_types = [tbl.column(n).type for n in key_names]
+        return cls._unpack_np_keys(
+            np.ascontiguousarray(k.to_numpy(zero_copy_only=False),
+                                 dtype=np.int64),
+            key_types, spans, mins)
+
     def _host_group_by(self, chunks, merged, key_names):
         """group_by over buffered raw chunks, then merge with the running
         acc table (merge fns: sum->sum, count->sum, min/max idempotent).
@@ -910,43 +1133,54 @@ class FusedPartialAggExec(ExecutionPlan):
         first or last in aggregate output."""
         import pyarrow as pa
         import pyarrow.compute as pc
+        acc_names = [f"__acc{i}" for i in range(len(self._specs))]
         out = None
         if chunks:
-            aggspec = []
-            out_names = []
-            for i, (rk, _ok, arg) in enumerate(self._specs):
-                if rk == "count":
-                    mode = "all" if arg is None else "only_valid"
-                    aggspec.append((f"__arg{i}", "count",
-                                    pc.CountOptions(mode=mode)))
-                else:
-                    aggspec.append((f"__arg{i}", rk))
-                out_names.append(f"__arg{i}_{rk}")
             tbl = pa.concat_tables(chunks)
-            g = tbl.group_by(key_names, use_threads=True).aggregate(aggspec)
-            out = pa.table(
-                [g.column(n) for n in key_names] +
-                [g.column(n) for n in out_names],
-                names=key_names + [f"__acc{i}"
-                                   for i in range(len(self._specs))])
+            kinds = [(rk, None if (rk == "count" and arg is None)
+                      else f"__arg{i}")
+                     for i, (rk, _ok, arg) in enumerate(self._specs)]
+            cols = self._native_group_by(tbl, key_names, kinds)
+            if cols is not None:
+                out = pa.table(cols, names=key_names + acc_names)
+            else:
+                aggspec = []
+                out_names = []
+                for i, (rk, _ok, arg) in enumerate(self._specs):
+                    if rk == "count":
+                        mode = "all" if arg is None else "only_valid"
+                        aggspec.append((f"__arg{i}", "count",
+                                        pc.CountOptions(mode=mode)))
+                    else:
+                        aggspec.append((f"__arg{i}", rk))
+                    out_names.append(f"__arg{i}_{rk}")
+                g, tbl, packing = self._grouped(tbl, key_names, aggspec)
+                out = pa.table(
+                    self._unpack_keys(g, tbl, key_names, packing) +
+                    [g.column(n) for n in out_names],
+                    names=key_names + acc_names)
         if merged is None:
             return out
         if out is None:
             return merged
         # merge two acc tables: counts sum, sums sum, min/max re-reduce
         both = pa.concat_tables([merged, out])
+        merge_fns = [("sum" if rk in ("sum", "count") else rk,
+                      f"__acc{i}")
+                     for i, (rk, _ok, _a) in enumerate(self._specs)]
+        cols = self._native_group_by(both, key_names, merge_fns)
+        if cols is not None:
+            return pa.table(cols, names=key_names + acc_names)
         merge_spec = []
         merge_names = []
-        for i, (rk, _ok, _a) in enumerate(self._specs):
-            f = "sum" if rk in ("sum", "count") else rk
-            merge_spec.append((f"__acc{i}", f))
-            merge_names.append(f"__acc{i}_{f}")
-        m = both.group_by(key_names, use_threads=True).aggregate(merge_spec)
+        for f, cn in merge_fns:
+            merge_spec.append((cn, f))
+            merge_names.append(f"{cn}_{f}")
+        m, both, packing = self._grouped(both, key_names, merge_spec)
         return pa.table(
-            [m.column(n) for n in key_names] +
+            self._unpack_keys(m, both, key_names, packing) +
             [m.column(n) for n in merge_names],
-            names=key_names + [f"__acc{i}"
-                               for i in range(len(self._specs))])
+            names=key_names + acc_names)
 
     def _host_finalize(self, merged, key_names):
         """Acc table -> output RecordBatch in self._out_schema order/types.
