@@ -109,6 +109,13 @@ def get_agg_kernel() -> Optional[ctypes.CDLL]:
                     ctypes.c_void_p,
                     ctypes.POINTER(ctypes.c_void_p),
                     ctypes.POINTER(ctypes.c_void_p)]
+                # first-row-index variant (newer builds); callers probe
+                # with hasattr
+                if hasattr(lib, "blaze_group_agg_i64_rows"):
+                    lib.blaze_group_agg_i64_rows.restype = ctypes.c_int64
+                    lib.blaze_group_agg_i64_rows.argtypes = (
+                        lib.blaze_group_agg_i64.argtypes
+                        + [ctypes.c_void_p])
                 _agg = lib
             except OSError:
                 _agg = None

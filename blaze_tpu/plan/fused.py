@@ -930,6 +930,21 @@ class FusedPartialAggExec(ExecutionPlan):
             cols.append(col)
             spans.append(span)
             mins.append(lo)
+        # null-free keys pack in ONE fused numpy expression (zero-copy
+        # views in, one output buffer) instead of a chain of pa.compute
+        # dispatches; any null key falls back to the Arrow kernels,
+        # whose fill_null provides the null->slot-0 encoding
+        if all(c.null_count == 0 for c in cols):
+            import numpy as np
+            packed_np = None
+            for col, span, lo in zip(cols, spans, mins):
+                cc = (col.combine_chunks()
+                      if isinstance(col, pa.ChunkedArray) else col)
+                enc = cc.to_numpy(zero_copy_only=False).astype(
+                    np.int64, copy=False) + (1 - lo)
+                packed_np = enc if packed_np is None else \
+                    packed_np * span + enc
+            return pa.array(packed_np), spans, mins
         packed = None
         for col, span, lo in zip(cols, spans, mins):
             enc = pc.fill_null(
@@ -941,7 +956,10 @@ class FusedPartialAggExec(ExecutionPlan):
     @staticmethod
     def _unpack_np_keys(out_k, key_types, spans, mins):
         """Decode packed keys (numpy int64) back to per-key pa arrays,
-        restoring nulls."""
+        restoring nulls.  Null-free keys (the overwhelmingly common
+        case: fact-table join/group keys) skip the mask pass entirely,
+        letting pa.array zero-copy the decoded buffer instead of
+        re-copying it next to a validity bitmap."""
         import numpy as np
         import pyarrow as pa
         parts = []
@@ -952,7 +970,9 @@ class FusedPartialAggExec(ExecutionPlan):
         parts.reverse()
         out = []
         for enc, lo, t in zip(parts, mins, key_types):
-            arr = pa.array(enc + (lo - 1), mask=(enc == 0))
+            nulls = enc == 0
+            mask = nulls if nulls.any() else None
+            arr = pa.array(enc + (lo - 1), mask=mask)
             if not arr.type.equals(t):
                 arr = arr.cast(t, safe=False)
             out.append(arr)
@@ -1043,7 +1063,9 @@ class FusedPartialAggExec(ExecutionPlan):
                     cc.is_valid().to_numpy(zero_copy_only=False),
                     dtype=np.uint8))
             else:
-                vals = pc.cast(cc, target, safe=False)
+                # identity casts still copy; hand the buffer over as-is
+                vals = cc if cc.type.equals(target) else \
+                    pc.cast(cc, target, safe=False)
                 valid_nps.append(None)
             val_nps.append(np.ascontiguousarray(
                 vals.to_numpy(zero_copy_only=False)))
@@ -1061,18 +1083,33 @@ class FusedPartialAggExec(ExecutionPlan):
             return ctypes.c_void_p(a.ctypes.data) if a is not None else None
 
         n_aggs = len(ops)
-        ng = lib.blaze_group_agg_i64(
+        has_rows = hasattr(lib, "blaze_group_agg_i64_rows")
+        first_rows = np.empty(n, np.int32) if has_rows else None
+        call_args = [
             ptr(key_np), n, n_aggs,
             (ctypes.c_int32 * n_aggs)(*ops),
             (ctypes.c_void_p * n_aggs)(*[ptr(a) for a in val_nps]),
             (ctypes.c_void_p * n_aggs)(*[ptr(a) for a in valid_nps]),
             ptr(out_keys),
             (ctypes.c_void_p * n_aggs)(*[ptr(a) for a in out_nps]),
-            (ctypes.c_void_p * n_aggs)(*[ptr(a) for a in out_valid_nps]))
+            (ctypes.c_void_p * n_aggs)(*[ptr(a) for a in out_valid_nps])]
+        if has_rows:
+            ng = lib.blaze_group_agg_i64_rows(*call_args,
+                                              ptr(first_rows))
+        else:
+            ng = lib.blaze_group_agg_i64(*call_args)
         if ng < 0:
             return None
-        key_types = [tbl.column(kn).type for kn in key_names]
-        out = self._unpack_np_keys(out_keys[:ng], key_types, spans, mins)
+        if has_rows:
+            # materialize keys with one gather per original column —
+            # nulls ride along for free; the mixed-radix int64 division
+            # decode is the slowest scalar path numpy has
+            idx = pa.array(first_rows[:ng])
+            out = [pc.take(tbl.column(kn), idx) for kn in key_names]
+        else:
+            key_types = [tbl.column(kn).type for kn in key_names]
+            out = self._unpack_np_keys(out_keys[:ng], key_types, spans,
+                                       mins)
         for (out_t, is_count), vals, valid in zip(post, out_nps,
                                                   out_valid_nps):
             mask = None if is_count else (valid[:ng] == 0)

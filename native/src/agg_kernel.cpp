@@ -47,13 +47,14 @@ inline uint64_t mix(uint64_t k) {
 
 }  // namespace
 
-extern "C" int64_t blaze_group_agg_i64(
+static int64_t group_agg_impl(
     const int64_t* keys, int64_t n, int32_t n_aggs, const int32_t* ops,
     const void* const* vals,      // per agg: double*/int64_t* (COUNT: 0)
     const uint8_t* const* valids, // per agg: byte validity, NULL=all set
     int64_t* out_keys,            // [n]
     void* const* out_vals,        // per agg: double*/int64_t* [n]
-    uint8_t* const* out_valid) {  // per agg: has-value bytes [n]
+    uint8_t* const* out_valid,    // per agg: has-value bytes [n]
+    int32_t* out_first_row) {     // [n] first-seen row per group, or NULL
   if (n < 0 || n > (1LL << 31) || n_aggs < 0) return -1;
   if (n == 0) return 0;
   uint64_t slots = 16;
@@ -74,6 +75,7 @@ extern "C" int64_t blaze_group_agg_i64(
         g = static_cast<uint32_t>(n_groups++);
         gids[s] = g + 1;
         out_keys[g] = k;
+        if (out_first_row) out_first_row[g] = static_cast<int32_t>(i);
         for (int32_t a = 0; a < n_aggs; ++a) {
           out_valid[a][g] = 0;
           switch (ops[a]) {
@@ -142,4 +144,25 @@ extern "C" int64_t blaze_group_agg_i64(
   }
   free(gids);
   return n_groups;
+}
+
+extern "C" int64_t blaze_group_agg_i64(
+    const int64_t* keys, int64_t n, int32_t n_aggs, const int32_t* ops,
+    const void* const* vals, const uint8_t* const* valids,
+    int64_t* out_keys, void* const* out_vals, uint8_t* const* out_valid) {
+  return group_agg_impl(keys, n, n_aggs, ops, vals, valids, out_keys,
+                        out_vals, out_valid, nullptr);
+}
+
+// Variant that also records the first-seen ROW INDEX of every group, so
+// the caller can materialize original key columns with one gather
+// (take) per column instead of mixed-radix-decoding the packed key —
+// int64 division is the slowest scalar op this pipeline otherwise runs.
+extern "C" int64_t blaze_group_agg_i64_rows(
+    const int64_t* keys, int64_t n, int32_t n_aggs, const int32_t* ops,
+    const void* const* vals, const uint8_t* const* valids,
+    int64_t* out_keys, void* const* out_vals, uint8_t* const* out_valid,
+    int32_t* out_first_row) {
+  return group_agg_impl(keys, n, n_aggs, ops, vals, valids, out_keys,
+                        out_vals, out_valid, out_first_row);
 }
