@@ -637,6 +637,133 @@ class BaseJoinExec(ExecutionPlan):
                 break
         return probe_tbl
 
+    # span cap for the direct-address build table (slots are int64:
+    # 32 MB at the cap) and a density floor so sparse key sets still
+    # take the hash join
+    _DIRECT_SPAN_MAX = 1 << 22
+    _DIRECT_BUILD_MAX = 1 << 20
+
+    def _direct_join_once(self, build_tbl, probe_tbl, probe_is_left):
+        """Single-integer-key join via a DIRECT-ADDRESS table.
+
+        Dimension keys in star schemas (date_sk, item_sk, store_sk...)
+        are dense contiguous ranges; Acero re-hashes the build side on
+        every Table.join call, while a slot array indexed by `key - min`
+        resolves each probe row with one subtract + one gather — the
+        same dense-key strategy the fused aggregation uses
+        (plan/fused.py dense group ids).  Applies to probe-driven join
+        types with a UNIQUE build key (each probe row matches at most
+        one build row, so output needs no pair expansion).  Returns a
+        joined table shaped exactly like the Acero result (l{i}/r{i}
+        columns), or None -> Acero fallback.
+        """
+        jt = self.join_type
+        eligible = {JoinType.INNER}
+        if probe_is_left:
+            eligible |= {JoinType.LEFT, JoinType.LEFT_SEMI,
+                         JoinType.LEFT_ANTI}
+        else:
+            eligible |= {JoinType.RIGHT, JoinType.RIGHT_SEMI,
+                         JoinType.RIGHT_ANTI}
+        if jt not in eligible or len(self.left_keys) != 1:
+            return None
+        pprefix = "l" if probe_is_left else "r"
+        bprefix = "r" if probe_is_left else "l"
+        bk = build_tbl.column(f"__{bprefix}k0")
+        pk = probe_tbl.column(f"__{pprefix}k0")
+        if not (pa.types.is_integer(bk.type) and
+                pa.types.is_integer(pk.type)):
+            return None
+        if pa.types.is_unsigned_integer(bk.type) and bk.type.bit_width \
+                == 64:
+            return None  # uint64 beyond int64 range would wrap
+        if build_tbl.num_rows > self._DIRECT_BUILD_MAX:
+            return None
+        bk = bk.combine_chunks() if isinstance(bk, pa.ChunkedArray) else bk
+        pk = pk.combine_chunks() if isinstance(pk, pa.ChunkedArray) else pk
+        bnp = bk.drop_null().to_numpy(zero_copy_only=False).astype(
+            np.int64, copy=False)
+        b_rows = (np.flatnonzero(bk.is_valid().to_numpy(
+            zero_copy_only=False)) if bk.null_count
+            else np.arange(len(bnp)))
+        n_probe_cols = probe_tbl.num_columns - 1
+        n_build_cols = build_tbl.num_columns - 1
+        probe_cols = probe_tbl.columns[:n_probe_cols]
+        probe_names = probe_tbl.column_names[:n_probe_cols]
+        build_cols = build_tbl.columns[:n_build_cols]
+        build_names = build_tbl.column_names[:n_build_cols]
+        semi_anti = jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
+                           JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI)
+        anti = jt in (JoinType.LEFT_ANTI, JoinType.RIGHT_ANTI)
+        outer = jt in (JoinType.LEFT, JoinType.RIGHT)
+        if bnp.size == 0:
+            if anti or outer:
+                b = np.full(probe_tbl.num_rows, -1, np.int64)
+                match = np.zeros(probe_tbl.num_rows, bool)
+            else:
+                return pa.table(
+                    [c.slice(0, 0) for c in probe_cols] +
+                    ([] if semi_anti else
+                     [c.slice(0, 0) for c in build_cols]),
+                    names=probe_names +
+                    ([] if semi_anti else build_names))
+        else:
+            mn = int(bnp.min())
+            mx = int(bnp.max())
+            span = mx - mn + 1
+            if span > self._DIRECT_SPAN_MAX or (
+                    span > 65536 and span > 64 * bnp.size):
+                # span cap + density floor: a sparse key set would pay
+                # an O(span) slot array to serve few build rows
+                return None
+            slot = np.full(span, -1, np.int64)
+            slot[bnp - mn] = b_rows
+            # uniqueness: a duplicate key overwrites its first slot, so
+            # the number of occupied slots betrays duplicates in O(span)
+            if int((slot >= 0).sum()) != bnp.size:
+                return None
+            if pk.null_count:
+                pvalid = pk.is_valid().to_numpy(zero_copy_only=False)
+                pnp = pk.fill_null(0).to_numpy(
+                    zero_copy_only=False).astype(np.int64, copy=False)
+            else:
+                pvalid = None
+                pnp = pk.to_numpy(zero_copy_only=False).astype(
+                    np.int64, copy=False)
+            # range-test BEFORE subtracting: comparisons are exact while
+            # pnp - mn can wrap int64 for extreme key ranges (a wrapped
+            # index landing in [0, span) would be a silent false match);
+            # clipping first keeps the subtraction in-bounds, and filled
+            # nulls (0) are masked by pvalid regardless of range
+            inr = (pnp >= mn) & (pnp <= mx)
+            if pvalid is not None:
+                inr &= pvalid
+            idx = np.clip(pnp, mn, mx) - mn
+            b = np.where(inr, slot[idx], np.int64(-1))
+            match = b >= 0
+        if semi_anti:
+            sel = np.flatnonzero(~match if anti else match)
+            tbl = pa.table(probe_cols, names=probe_names)
+            self.metrics.add("direct_join_rows", len(sel))
+            return tbl.take(pa.array(sel))
+        if outer:
+            p_sel = None  # every probe row survives
+            b_idx = pa.array(b, mask=~match)
+        else:  # inner
+            p_sel = np.flatnonzero(match)
+            b_idx = pa.array(b[match])
+        ptbl = pa.table(probe_cols, names=probe_names)
+        if p_sel is not None:
+            ptbl = ptbl.take(pa.array(p_sel))
+        taken = [pc.take(c, b_idx) for c in build_cols]
+        arrays = list(ptbl.columns) + taken
+        names = list(probe_names) + list(build_names)
+        if not probe_is_left:
+            arrays = taken + list(ptbl.columns)
+            names = list(build_names) + list(probe_names)
+        self.metrics.add("direct_join_rows", len(b_idx))
+        return pa.table(arrays, names=names)
+
     def _pa_join_once(self, build_tbl, probe_chunks, probe_keys,
                       probe_is_left: bool,
                       skip_filter_keys: frozenset = frozenset()
@@ -653,13 +780,17 @@ class BaseJoinExec(ExecutionPlan):
         probe_tbl = self._runtime_filter_probe(build_tbl, probe_tbl,
                                                pprefix, probe_is_left,
                                                skip_keys=skip_filter_keys)
-        left_tbl = probe_tbl if probe_is_left else build_tbl
-        right_tbl = build_tbl if probe_is_left else probe_tbl
-        lk = [f"__lk{i}" for i in range(len(self.left_keys))]
-        rk = [f"__rk{i}" for i in range(len(self.right_keys))]
-        joined = left_tbl.join(right_tbl, keys=lk, right_keys=rk,
-                               join_type=self._PA_JOIN_TYPES[self.join_type],
-                               use_threads=True)
+        joined = self._direct_join_once(build_tbl, probe_tbl,
+                                        probe_is_left)
+        if joined is None:
+            left_tbl = probe_tbl if probe_is_left else build_tbl
+            right_tbl = build_tbl if probe_is_left else probe_tbl
+            lk = [f"__lk{i}" for i in range(len(self.left_keys))]
+            rk = [f"__rk{i}" for i in range(len(self.right_keys))]
+            joined = left_tbl.join(
+                right_tbl, keys=lk, right_keys=rk,
+                join_type=self._PA_JOIN_TYPES[self.join_type],
+                use_threads=True)
         out_arrow = self.schema.to_arrow()
         jt = self.join_type
         if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):

@@ -153,3 +153,79 @@ def test_empty_sides():
     empty_l = LEFT.slice(0, 0)
     got3 = join(JoinType.FULL, left=empty_l)
     assert got3.num_rows == 5
+
+
+def test_direct_address_join_vs_pandas():
+    """The single-int-key direct-address fast path (_direct_join_once:
+    unique dense build keys -> slot-array lookup instead of Acero).
+    Dense unique build keys with probe nulls + out-of-range keys, all
+    probe-driven join types, against a pandas oracle."""
+    rng = np.random.default_rng(11)
+    n = 5000
+    build_keys = np.arange(100, 400)  # dense, unique: direct-eligible
+    rng.shuffle(build_keys)
+    build = pa.table({"bk": pa.array(build_keys, type=pa.int64()),
+                      "bv": pa.array(rng.random(len(build_keys)))})
+    pk = rng.integers(0, 500, n)  # ~60% in range
+    probe = pa.table({
+        "pk": pa.array([None if i % 37 == 0 else int(pk[i])
+                        for i in range(n)], type=pa.int64()),
+        "pv": pa.array(rng.random(n))})
+    pdp, pdb = probe.to_pandas(), build.to_pandas()
+
+    def mk(how, build_side):
+        left, right = (probe, build) if build_side == "right" \
+            else (build, probe)
+        lk, rk = (("pk", "bk") if build_side == "right" else ("bk", "pk"))
+        plan = BroadcastJoinExec(
+            MemoryScanExec.from_arrow(left),
+            MemoryScanExec.from_arrow(right),
+            [col(0, lk)], [col(0, rk)], how, build_side=build_side)
+        got = plan.execute_collect().to_arrow()
+        assert plan.metrics.get("direct_join_rows") > 0 or \
+            got.num_rows == 0, "direct path must engage"
+        return got
+
+    got = mk(JoinType.INNER, "right")
+    want = pdp.merge(pdb, left_on="pk", right_on="bk", how="inner")
+    assert got.num_rows == len(want)
+    assert abs(sum(x or 0 for x in got.column("bv").to_pylist())
+               - want.bv.sum()) < 1e-6
+
+    got = mk(JoinType.LEFT, "right")
+    want = pdp.merge(pdb, left_on="pk", right_on="bk", how="left")
+    assert got.num_rows == len(want)
+    assert got.column("bv").null_count == int(want.bv.isna().sum())
+
+    got = mk(JoinType.LEFT_SEMI, "right")
+    matched = pdp[pdp.pk.isin(pdb.bk)]
+    assert got.num_rows == len(matched)
+
+    got = mk(JoinType.LEFT_ANTI, "right")
+    assert got.num_rows == n - len(matched)  # nulls kept by anti
+
+    # probe on the right (build_side=left): RIGHT outer + semi/anti
+    got = mk(JoinType.RIGHT, "left")
+    want = pdb.merge(pdp, left_on="bk", right_on="pk", how="right")
+    assert got.num_rows == len(want)
+    got = mk(JoinType.RIGHT_SEMI, "left")
+    assert got.num_rows == len(matched)
+    got = mk(JoinType.RIGHT_ANTI, "left")
+    assert got.num_rows == n - len(matched)
+
+
+def test_direct_join_falls_back_on_duplicates():
+    """Duplicate build keys require pair expansion -> Acero fallback;
+    results must stay identical to the oracle."""
+    build = pa.table({"bk": pa.array([1, 2, 2, 3], type=pa.int64()),
+                      "bv": pa.array([10, 20, 21, 30], type=pa.int64())})
+    probe = pa.table({"pk": pa.array([2, 3, 4], type=pa.int64()),
+                      "pv": pa.array(["x", "y", "z"])})
+    plan = BroadcastJoinExec(
+        MemoryScanExec.from_arrow(probe),
+        MemoryScanExec.from_arrow(build),
+        [col(0, "pk")], [col(0, "bk")], JoinType.INNER,
+        build_side="right")
+    got = plan.execute_collect().to_arrow()
+    assert plan.metrics.get("direct_join_rows") == 0
+    assert got.num_rows == 3  # (2,20) (2,21) (3,30)
