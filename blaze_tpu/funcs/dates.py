@@ -324,13 +324,27 @@ def _to_date(args, batch, out_type):
 
 @register("unix_timestamp", lambda ts: INT64)
 def _unix_timestamp(args, batch, out_type):
-    v = args[0].to_device(batch.capacity) if args else None
-    if v is None:
+    if not args:
         import time
         now = int(time.time())
         n = batch.capacity
         return ColVal(INT64, data=jnp.full(n, now, dtype=jnp.int64),
                       validity=jnp.ones(n, dtype=bool))
+    if args[0].dtype.id == TypeId.UTF8:
+        # string input parses with Spark's lenient default-pattern
+        # parser (DateTimeUtils.stringToTimestamp: optional time,
+        # fraction, 'T' separator, surrounding whitespace), null on
+        # failure — the same host parser the cast matrix uses
+        from blaze_tpu.exprs.cast import _try_parse_timestamp
+        arr = args[0].to_host(batch.num_rows)
+        ts = _try_parse_timestamp(arr)
+        micros = ts.cast(pa.int64())
+        valid = ts.is_valid().to_numpy(zero_copy_only=False)
+        secs = np.floor_divide(
+            micros.fill_null(0).to_numpy(zero_copy_only=False),
+            1_000_000)
+        return ColVal.host(INT64, pa.array(secs, mask=~valid))
+    v = args[0].to_device(batch.capacity)
     if v.dtype.id == TypeId.DATE32:
         secs = v.data.astype(jnp.int64) * 86400
     else:

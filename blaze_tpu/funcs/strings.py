@@ -78,7 +78,9 @@ def _lower(args, batch, out_type):
 def _trim(args, batch, out_type):
     arrs = [args[0].to_host(batch.num_rows)]
     if len(args) == 1:
-        return ColVal.host(UTF8, pc.utf8_trim_whitespace(arrs[0]))
+        # Spark's UTF8String.trim strips ONLY the space character
+        # (0x20), not tabs/newlines (ref spark TrimFunctionsSuite)
+        return ColVal.host(UTF8, pc.utf8_trim(arrs[0], characters=" "))
     chars = const_arg(args[1], batch, "trim")
     if chars is None:
         return _null_utf8(batch.num_rows)
@@ -89,7 +91,9 @@ def _trim(args, batch, out_type):
 def _ltrim(args, batch, out_type):
     arrs = [args[0].to_host(batch.num_rows)]
     if len(args) == 1:
-        return ColVal.host(UTF8, pc.utf8_ltrim_whitespace(arrs[0]))
+        # Spark's UTF8String.trim strips ONLY the space character
+        # (0x20), not tabs/newlines (ref spark TrimFunctionsSuite)
+        return ColVal.host(UTF8, pc.utf8_ltrim(arrs[0], characters=" "))
     chars = const_arg(args[1], batch, "ltrim")
     if chars is None:
         return _null_utf8(batch.num_rows)
@@ -100,7 +104,9 @@ def _ltrim(args, batch, out_type):
 def _rtrim(args, batch, out_type):
     arrs = [args[0].to_host(batch.num_rows)]
     if len(args) == 1:
-        return ColVal.host(UTF8, pc.utf8_rtrim_whitespace(arrs[0]))
+        # Spark's UTF8String.trim strips ONLY the space character
+        # (0x20), not tabs/newlines (ref spark TrimFunctionsSuite)
+        return ColVal.host(UTF8, pc.utf8_rtrim(arrs[0], characters=" "))
     chars = const_arg(args[1], batch, "rtrim")
     if chars is None:
         return _null_utf8(batch.num_rows)

@@ -647,6 +647,212 @@ def _decimal():
     ]
 
 
+@_suite("TrigMathSuite")
+def _trig():
+    import math
+    x = pa.table({"x": pa.array([0.0, 0.5, None])})
+    return [
+        Case("sin/cos/tan at zero",
+             pa.table({"x": pa.array([0.0])}),
+             [_fn("sin", _col(0), rt="float64"),
+              _fn("cos", _col(0), rt="float64"),
+              _fn("tan", _col(0), rt="float64")],
+             [(0.0, 1.0, 0.0)], rtol=1e-12),
+        Case("asin/acos outside [-1,1] give NaN, not error",
+             pa.table({"x": pa.array([2.0, -2.0])}),
+             [_fn("asin", _col(0), rt="float64"),
+              _fn("acos", _col(0), rt="float64")],
+             [(float("nan"), float("nan")),
+              (float("nan"), float("nan"))]),
+        Case("asin/atan principal values",
+             x,
+             [_fn("asin", _col(0), rt="float64"),
+              _fn("atan", _col(0), rt="float64")],
+             [(0.0, 0.0), (math.asin(0.5), math.atan(0.5)),
+              (None, None)], rtol=1e-12),
+        Case("hyperbolics and exp",
+             pa.table({"x": pa.array([1.0])}),
+             [_fn("sinh", _col(0), rt="float64"),
+              _fn("cosh", _col(0), rt="float64"),
+              _fn("tanh", _col(0), rt="float64"),
+              _fn("exp", _col(0), rt="float64")],
+             [(math.sinh(1.0), math.cosh(1.0), math.tanh(1.0),
+               math.e)], rtol=1e-12),
+        Case("degrees/radians round trip",
+             pa.table({"x": pa.array([math.pi, 0.0])}),
+             [_fn("degrees", _col(0), rt="float64")],
+             [(180.0,), (0.0,)], rtol=1e-12),
+        Case("radians of 180",
+             pa.table({"x": pa.array([180.0])}),
+             [_fn("radians", _col(0), rt="float64")],
+             [(math.pi,)], rtol=1e-12),
+        Case("negative flips sign, passes null",
+             pa.table({"x": pa.array([5, -3, None])}),
+             [_fn("negative", _col(0), rt="int64")],
+             [(-5,), (3,), (None,)]),
+        Case("isnan: null input is false, not null",
+             pa.table({"x": pa.array([float("nan"), 1.0, None])}),
+             [_fn("isnan", _col(0), rt="bool")],
+             [(True,), (False,), (False,)]),
+        Case("ceil/floor return LONG for double input",
+             pa.table({"x": pa.array([2.5, -0.1, -2.5, None])}),
+             [_fn("ceil", _col(0), rt="int64"),
+              _fn("floor", _col(0), rt="int64")],
+             [(3, 2), (0, -1), (-2, -3), (None, None)]),
+    ]
+
+
+@_suite("DateFieldsSuite")
+def _date_fields():
+    import datetime as _dt
+    d = pa.table({"d": pa.array([_dt.date(2016, 4, 9),
+                                 _dt.date(2008, 2, 20), None],
+                                pa.date32())})
+    ts = pa.table({"t": pa.array([_dt.datetime(2015, 3, 5, 9, 32, 5)],
+                                 pa.timestamp("us"))})
+    return [
+        Case("day/dayofmonth agree",
+             d, [_fn("day", _col(0), rt="int32"),
+                 _fn("dayofmonth", _col(0), rt="int32")],
+             [(9, 9), (20, 20), (None, None)]),
+        Case("dayofyear",
+             d, [_fn("dayofyear", _col(0), rt="int32")],
+             [(100,), (51,), (None,)]),
+        Case("weekofyear is ISO-8601",
+             d, [_fn("weekofyear", _col(0), rt="int32")],
+             [(14,), (8,), (None,)]),
+        Case("quarter",
+             d, [_fn("quarter", _col(0), rt="int32")],
+             [(2,), (1,), (None,)]),
+        Case("hour/minute/second from timestamp",
+             ts, [_fn("hour", _col(0), rt="int32"),
+                  _fn("minute", _col(0), rt="int32"),
+                  _fn("second", _col(0), rt="int32")],
+             [(9, 32, 5)]),
+    ]
+
+
+@_suite("DateNavSuite")
+def _date_nav():
+    import datetime as _dt
+    d = pa.table({"d": pa.array([_dt.date(2016, 4, 9),   # a Saturday
+                                 _dt.date(2019, 8, 4)],  # a Sunday
+                                pa.date32())})
+    return [
+        Case("next_day by abbreviated day name",
+             d, [_fn("next_day", _col(0), _lit("TU", "utf8"),
+                     rt="date32")],
+             [(_dt.date(2016, 4, 12),), (_dt.date(2019, 8, 6),)]),
+        Case("next_day invalid day name yields null (non-ANSI)",
+             d, [_fn("next_day", _col(0), _lit("XX", "utf8"),
+                     rt="date32")],
+             [(None,), (None,)]),
+        Case("trunc to month and ISO week (Monday)",
+             d, [_fn("trunc", _col(0), _lit("MM", "utf8"), rt="date32"),
+                 _fn("trunc", _col(0), _lit("week", "utf8"),
+                     rt="date32")],
+             [(_dt.date(2016, 4, 1), _dt.date(2016, 4, 4)),
+              (_dt.date(2019, 8, 1), _dt.date(2019, 7, 29))]),
+        Case("date_trunc HOUR on timestamp",
+             pa.table({"t": pa.array(
+                 [_dt.datetime(2015, 3, 5, 9, 32, 5, 359000)],
+                 pa.timestamp("us"))}),
+             [_fn("date_trunc", _lit("HOUR", "utf8"), _col(0))],
+             [(_dt.datetime(2015, 3, 5, 9, 0),)]),
+        Case("to_date parses date and timestamp strings, null on junk",
+             pa.table({"s": pa.array(["2009-07-30 04:17:52",
+                                      "2016-12-31", "bad"])}),
+             [_fn("to_date", _col(0), rt="date32")],
+             [(_dt.date(2009, 7, 30),), (_dt.date(2016, 12, 31),),
+              (None,)]),
+        Case("from_unixtime default pattern, UTC session tz",
+             pa.table({"u": pa.array([0, 86400])}),
+             [_fn("from_unixtime", _col(0), rt="utf8")],
+             [("1970-01-01 00:00:00",), ("1970-01-02 00:00:00",)]),
+        Case("unix_timestamp parses default pattern, null on junk",
+             pa.table({"s": pa.array(["1970-01-02 00:00:00",
+                                      "2016-04-09", "junk", None])}),
+             [_fn("unix_timestamp", _col(0))],
+             [(86400,), (1460160000,), (None,), (None,)]),
+    ]
+
+
+@_suite("ArrayExtraSuite")
+def _array_extra():
+    lt = pa.list_(pa.int64())
+    a = pa.table({"a": pa.array([[2, 1, None], [5], None], lt)})
+    return [
+        Case("array_min/max skip nulls inside the array",
+             a, [_fn("array_min", _col(0), rt="int64"),
+                 _fn("array_max", _col(0), rt="int64")],
+             [(1, 2), (5, 5), (None, None)]),
+        Case("cardinality counts elements; null input is -1 "
+             "(legacy sizeOfNull, the Spark default)",
+             a, [_fn("cardinality", _col(0), rt="int32")],
+             [(3,), (1,), (-1,)]),
+        Case("array_union dedups keeping first-seen order",
+             pa.table({"a": pa.array([[1, 2, 2]], lt),
+                       "b": pa.array([[2, 3, 1]], lt)}),
+             [_fn("array_union", _col(0), _col(1))],
+             [([1, 2, 3],)]),
+        Case("array builder from columns",
+             pa.table({"x": pa.array([1, 4]), "y": pa.array([2, 5])}),
+             [_fn("make_array", _col(0), _col(1))],
+             [([1, 2],), ([4, 5],)]),
+        Case("map_values",
+             pa.table({"m": pa.array([[("a", 1), ("b", 2)]],
+                                     pa.map_(pa.utf8(), pa.int64()))}),
+             [_fn("map_values", _col(0))],
+             [([1, 2],)]),
+    ]
+
+
+@_suite("CaseTrimSuite")
+def _case_trim():
+    return [
+        Case("upper/lower",
+             pa.table({"s": pa.array(["Spark", None])}),
+             [_fn("upper", _col(0), rt="utf8"),
+              _fn("lower", _col(0), rt="utf8")],
+             [("SPARK", "spark"), (None, None)]),
+        Case("trim strips ONLY spaces, not tabs "
+             "(UTF8String.trim semantics)",
+             pa.table({"s": pa.array(["  \tabc \t ", " x "])}),
+             [_fn("trim", _col(0), rt="utf8")],
+             [("\tabc \t",), ("x",)]),
+        Case("ltrim/rtrim one-sided space strip",
+             pa.table({"s": pa.array([" \ta "])}),
+             [_fn("ltrim", _col(0), rt="utf8"),
+              _fn("rtrim", _col(0), rt="utf8")],
+             [("\ta ", " \ta")]),
+        Case("rpad truncates when target is shorter",
+             pa.table({"s": pa.array(["abcd", "ab"])}),
+             [_fn("rpad", _col(0), _lit(3), _lit("x", "utf8"),
+                  rt="utf8")],
+             [("abc",), ("abx",)]),
+        Case("substr position 0 behaves as 1; negative counts "
+             "from the end",
+             pa.table({"s": pa.array(["Spark"])}),
+             [_fn("substr", _col(0), _lit(0), _lit(3), rt="utf8"),
+              _fn("substr", _col(0), _lit(-3), _lit(2), rt="utf8")],
+             [("Spa", "ar")]),
+    ]
+
+
+@_suite("HashExprSuite")
+def _hash_expr():
+    return [
+        Case("hash() is Spark murmur3 seed 42, bit-exact",
+             pa.table({"x": pa.array([1, 2], pa.int32())}),
+             [_fn("hash", _col(0), rt="int32")],
+             [(-559580957,), (1765031574,)]),
+        Case("xxhash64 seed 42, bit-exact",
+             pa.table({"x": pa.array([1], pa.int64())}),
+             [_fn("xxhash64", _col(0), rt="int64")],
+             [(-7001672635703045582,)]),
+    ]
+
+
 # ---------------------------------------------------------------------------
 # runner (ref SparkQueryTestsBase: run case, compare, report)
 # ---------------------------------------------------------------------------
