@@ -86,40 +86,60 @@ def get_codec() -> Optional[_Codec]:
     return _codec
 
 
-_agg: Optional[ctypes.CDLL] = None
-_agg_checked = False
+_kernels: dict = {}  # so_name -> CDLL | None, cached incl. misses
+
+
+def _load_kernel(so_name: str, configure) -> Optional[ctypes.CDLL]:
+    """Shared cached loader: find the .so, CDLL it, apply `configure`
+    (restype/argtypes setup); None — and remembered as None — when the
+    library is absent or unloadable (the pure-Python fallback path)."""
+    if so_name not in _kernels:
+        lib = None
+        path = _find(so_name)
+        if path:
+            try:
+                lib = ctypes.CDLL(path)
+                configure(lib)
+            except OSError:
+                lib = None
+        _kernels[so_name] = lib
+    return _kernels[so_name]
+
+
+def get_partition_kernel() -> Optional[ctypes.CDLL]:
+    """Fused Spark-murmur3 + pmod partition-id kernel
+    (partition_kernel.cpp); None (numpy fallback) when unbuilt."""
+    def configure(lib):
+        lib.blaze_murmur3_pmod.restype = ctypes.c_int64
+        lib.blaze_murmur3_pmod.argtypes = [
+            ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_int32, ctypes.c_void_p]
+    return _load_kernel("libblaze_partition_kernel.so", configure)
 
 
 def get_agg_kernel() -> Optional[ctypes.CDLL]:
     """Specialized i64-key hash group-aggregation (agg_kernel.cpp);
     None (pure-Arrow fallback) when unbuilt."""
-    global _agg, _agg_checked
-    if not _agg_checked:
-        _agg_checked = True
-        path = _find("libblaze_agg_kernel.so")
-        if path:
-            try:
-                lib = ctypes.CDLL(path)
-                lib.blaze_group_agg_i64.restype = ctypes.c_int64
-                lib.blaze_group_agg_i64.argtypes = [
-                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
-                    ctypes.POINTER(ctypes.c_int32),
-                    ctypes.POINTER(ctypes.c_void_p),
-                    ctypes.POINTER(ctypes.c_void_p),
-                    ctypes.c_void_p,
-                    ctypes.POINTER(ctypes.c_void_p),
-                    ctypes.POINTER(ctypes.c_void_p)]
-                # first-row-index variant (newer builds); callers probe
-                # with hasattr
-                if hasattr(lib, "blaze_group_agg_i64_rows"):
-                    lib.blaze_group_agg_i64_rows.restype = ctypes.c_int64
-                    lib.blaze_group_agg_i64_rows.argtypes = (
-                        lib.blaze_group_agg_i64.argtypes
-                        + [ctypes.c_void_p])
-                _agg = lib
-            except OSError:
-                _agg = None
-    return _agg
+    def configure(lib):
+        lib.blaze_group_agg_i64.restype = ctypes.c_int64
+        lib.blaze_group_agg_i64.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p)]
+        # first-row-index variant (newer builds); callers probe with
+        # hasattr
+        if hasattr(lib, "blaze_group_agg_i64_rows"):
+            lib.blaze_group_agg_i64_rows.restype = ctypes.c_int64
+            lib.blaze_group_agg_i64_rows.argtypes = (
+                lib.blaze_group_agg_i64.argtypes + [ctypes.c_void_p])
+    return _load_kernel("libblaze_agg_kernel.so", configure)
 
 
 def get_host_bridge() -> Optional[ctypes.CDLL]:

@@ -60,6 +60,59 @@ def _hash_pmod_jit(tids: Tuple[str, ...], n_parts: int):
     return jax.jit(f)
 
 
+def _native_pmod(flat_cols, tids, n_parts):
+    """Fused murmur3+pmod through the native partition kernel
+    (partition_kernel.cpp) for all-fixed-width keys; None -> numpy
+    chain (strings, unbuilt lib).  Caller has already normalized float
+    keys, so every NaN carries the canonical bit pattern the bits-view
+    below hashes."""
+    import ctypes
+
+    from blaze_tpu.bridge.native import get_partition_kernel
+    lib = get_partition_kernel()
+    if lib is None:
+        return None
+    modes = []
+    datas = []      # keeps converted arrays alive across the call
+    valid_nps = []
+    n = None
+    for (v, val), tid in zip(flat_cols, tids):
+        if tid in ("bool", "int8", "int16", "int32", "date32"):
+            modes.append(0)
+            datas.append(np.ascontiguousarray(v, dtype=np.int32))
+        elif tid in ("int64", "timestamp_us", "decimal"):
+            modes.append(1)
+            datas.append(np.ascontiguousarray(v, dtype=np.int64))
+        elif tid == "float32":
+            modes.append(0)
+            datas.append(np.ascontiguousarray(
+                v, dtype=np.float32).view(np.int32))
+        elif tid == "float64":
+            modes.append(1)
+            datas.append(np.ascontiguousarray(
+                v, dtype=np.float64).view(np.int64))
+        else:
+            return None  # utf8/binary: numpy byte-matrix path
+        n = len(datas[-1]) if n is None else n
+        valid_nps.append(
+            None if val is None or bool(np.all(val))
+            else np.ascontiguousarray(val, dtype=np.uint8))
+    if n is None:
+        return None
+    out = np.empty(n, dtype=np.int32)
+
+    def ptr(a):
+        return ctypes.c_void_p(a.ctypes.data) if a is not None else None
+
+    nc = len(modes)
+    rc = lib.blaze_murmur3_pmod(
+        n, nc, (ctypes.c_int32 * nc)(*modes),
+        (ctypes.c_void_p * nc)(*[ptr(a) for a in datas]),
+        (ctypes.c_void_p * nc)(*[ptr(a) for a in valid_nps]),
+        n_parts, ptr(out))
+    return out if rc == 0 else None
+
+
 class HashPartitioning(Partitioning):
     def __init__(self, exprs: Sequence[PhysicalExpr], num_partitions: int):
         self.exprs = list(exprs)
@@ -111,6 +164,9 @@ class HashPartitioning(Partitioning):
                 tids.append("utf8")
         if on_host:
             flat_cols = H.norm_float_keys(flat_cols, tids, np)
+            pids = _native_pmod(flat_cols, tids, self.num_partitions)
+            if pids is not None:
+                return pids[:n]
             cols = [(v, val, tid)
                     for (v, val), tid in zip(flat_cols, tids)]
             h = H.hash_columns(cols, seed=42, xp=np, algo="murmur3")

@@ -1,0 +1,85 @@
+// Spark-compatible murmur3 hash-partitioning kernel.
+//
+// The host shuffle path computes pmod(murmur3(keys, seed=42), n) per row
+// (ref shuffle/mod.rs:164-189).  The numpy implementation walks the
+// ~100-primitive hash chain one whole-column op at a time (~25ns/row,
+// memory-bound on intermediates); this kernel fuses the chain per row in
+// registers (~3ns/row).  Strings stay on the numpy path — only
+// fixed-width columns reach here, pre-canonicalized by the caller
+// (float bits with one NaN pattern, -0.0 normalized upstream, narrow
+// ints widened to the 4-byte word Spark hashes).
+//
+// Bit-exactness contract: Murmur3_x86_32.hashInt / hashLong exactly as
+// Spark runs them (validated against the Spark-generated vectors in
+// tests/test_hashing.py through the Python caller).
+
+#include <cstdint>
+
+namespace {
+
+inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+inline uint32_t mix_k1(uint32_t k1) {
+  k1 *= 0xcc9e2d51u;
+  k1 = rotl32(k1, 15);
+  k1 *= 0x1b873593u;
+  return k1;
+}
+
+inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  return h1 * 5 + 0xe6546b64u;
+}
+
+inline uint32_t fmix(uint32_t h1, uint32_t len) {
+  h1 ^= len;
+  h1 ^= h1 >> 16;
+  h1 *= 0x85ebca6bu;
+  h1 ^= h1 >> 13;
+  h1 *= 0xc2b2ae35u;
+  h1 ^= h1 >> 16;
+  return h1;
+}
+
+inline uint32_t mm3_int(uint32_t v, uint32_t seed) {
+  return fmix(mix_h1(seed, mix_k1(v)), 4);
+}
+
+inline uint32_t mm3_long(uint64_t v, uint32_t seed) {
+  uint32_t h = mix_h1(seed, mix_k1(static_cast<uint32_t>(v)));
+  h = mix_h1(h, mix_k1(static_cast<uint32_t>(v >> 32)));
+  return fmix(h, 8);
+}
+
+}  // namespace
+
+// modes[c]: 0 = 4-byte word column (int32_t* data), 1 = 8-byte
+// (int64_t* data).  valids[c]: byte validity or NULL (all valid); null
+// rows pass the running seed through unchanged (Spark skips nulls).
+// out_pids: pmod(hash, n_parts).  Returns 0, or -1 on bad arguments.
+extern "C" int64_t blaze_murmur3_pmod(
+    int64_t n, int32_t n_cols, const int32_t* modes,
+    const void* const* vals, const uint8_t* const* valids,
+    int32_t n_parts, int32_t* out_pids) {
+  if (n < 0 || n_cols <= 0 || n_parts <= 0) return -1;
+  for (int32_t c = 0; c < n_cols; ++c) {
+    if (modes[c] != 0 && modes[c] != 1) return -1;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t h = 42;
+    for (int32_t c = 0; c < n_cols; ++c) {
+      if (valids[c] && !valids[c][i]) continue;
+      if (modes[c] == 0) {
+        h = mm3_int(static_cast<const uint32_t*>(vals[c])[i], h);
+      } else {
+        h = mm3_long(static_cast<const uint64_t*>(vals[c])[i], h);
+      }
+    }
+    int32_t r = static_cast<int32_t>(h) % n_parts;
+    out_pids[i] = r < 0 ? r + n_parts : r;
+  }
+  return 0;
+}

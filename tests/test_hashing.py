@@ -131,3 +131,40 @@ def test_float_hash_negzero_and_nan():
     f = np.array([np.nan, np.float32(np.nan)], dtype=np.float32)
     out = H.hash_columns([(f, None, "float32")], xp=np)
     assert out[0] == out[1]
+
+
+def test_native_partition_kernel_bit_exact():
+    """partition_kernel.cpp vs the numpy murmur3+pmod chain: identical
+    pids across every fixed-width type, nulls, negatives, canonical
+    NaN/-0.0 (pre-normalized, as the caller does)."""
+    import pytest
+    from blaze_tpu.bridge.native import get_partition_kernel
+    from blaze_tpu.shuffle.partitioning import _native_pmod
+    if get_partition_kernel() is None:
+        pytest.skip("partition kernel not built")
+    rng = np.random.default_rng(3)
+    n = 10007
+    cols = [
+        (rng.integers(-(1 << 62), 1 << 62, n), None, "int64"),
+        (rng.integers(-(1 << 30), 1 << 30, n).astype(np.int32),
+         rng.random(n) > 0.1, "int32"),
+        (rng.integers(-128, 127, n).astype(np.int8),
+         rng.random(n) > 0.5, "int8"),
+        (rng.random(n) * 1e6 - 5e5, rng.random(n) > 0.05, "float64"),
+        ((rng.random(n).astype(np.float32)), None, "float32"),
+        (rng.integers(0, 2, n).astype(bool), None, "bool"),
+        (rng.integers(0, 40000, n).astype(np.int32), None, "date32"),
+    ]
+    from blaze_tpu.kernels import hashing as H
+    for n_parts in (2, 7, 200):
+        for subset in ([0], [1, 3], [0, 1, 2, 3, 4, 5, 6]):
+            flat = [(cols[i][0], cols[i][1]) for i in subset]
+            tids = [cols[i][2] for i in subset]
+            flat = H.norm_float_keys(flat, tids, np)
+            got = _native_pmod(flat, tids, n_parts)
+            assert got is not None
+            h = H.hash_columns(
+                [(v, val, t) for (v, val), t in zip(flat, tids)],
+                seed=42, xp=np, algo="murmur3")
+            want = np.asarray(H.pmod(h, n_parts, xp=np)).astype(np.int32)
+            np.testing.assert_array_equal(got, want)
