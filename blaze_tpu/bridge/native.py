@@ -100,7 +100,10 @@ def _load_kernel(so_name: str, configure) -> Optional[ctypes.CDLL]:
             try:
                 lib = ctypes.CDLL(path)
                 configure(lib)
-            except OSError:
+            except (OSError, AttributeError):
+                # AttributeError: stale .so missing a symbol configure
+                # binds — a miss to cache, not an error to re-raise on
+                # every hot-path call
                 lib = None
         _kernels[so_name] = lib
     return _kernels[so_name]

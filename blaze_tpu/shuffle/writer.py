@@ -211,12 +211,24 @@ class ShuffleRepartitioner(MemConsumer):
         tbl = pa.Table.from_batches(self._staged).combine_chunks()
         rb = tbl.to_batches()[0]
         pids = np.asarray(rb.column(0))
-        order = np.argsort(pids, kind="stable")
+        if n_parts <= 32:
+            # counting sort: one flatnonzero sweep per partition beats a
+            # generic argsort ~5x at small reducer counts (pids are a
+            # handful of distinct values, the classic radix-1 case);
+            # each sweep is a full pass over pids, so high reducer
+            # counts stay on the single argsort below
+            groups = [np.flatnonzero(pids == p) for p in range(n_parts)]
+            order = np.concatenate(groups)
+            ends = np.cumsum([len(g) for g in groups])
+            starts = ends - [len(g) for g in groups]
+        else:
+            order = np.argsort(pids, kind="stable")
+            sorted_pids = pids[order]
+            starts = np.searchsorted(sorted_pids, np.arange(n_parts),
+                                     "left")
+            ends = np.searchsorted(sorted_pids, np.arange(n_parts),
+                                   "right")
         sorted_rb = rb.take(pa.array(order, type=pa.int64()))
-        sorted_pids = pids[order]
-        # per-partition row ranges
-        starts = np.searchsorted(sorted_pids, np.arange(n_parts), "left")
-        ends = np.searchsorted(sorted_pids, np.arange(n_parts), "right")
         payload = sorted_rb.select(range(1, sorted_rb.num_columns))
         offsets = [0]
         bs = config.BATCH_SIZE.get()
