@@ -841,28 +841,34 @@ class FusedPartialAggExec(ExecutionPlan):
 
         def read_one(f, covered, boundary):
             """One file's kept rows: covered groups pass unmasked,
-            boundary groups get the vectorized filter.  Decode errors
-            past the (already-validated) metadata follow the scan
-            operator's corrupted-file policy — these reads run lazily,
-            outside the caller's fallback window."""
+            boundary groups get the vectorized filter.  All kept groups
+            decode in ONE read_row_groups call (one reader setup, one
+            thread fan-out) — covered groups come first, so the
+            unmasked region is a head slice and only the boundary tail
+            pays the filter.  Decode errors past the (already-validated)
+            metadata follow the scan operator's corrupted-file policy —
+            these reads run lazily, outside the caller's fallback
+            window."""
             try:
-                parts = []
-                if covered:
-                    parts.append(f.read_row_groups(covered,
-                                                   columns=columns,
-                                                   use_threads=True))
-                if boundary:
-                    btbl = f.read_row_groups(boundary, columns=columns,
-                                             use_threads=True)
-                    parts.append(self._mask_filter(btbl, plain_preds,
-                                                   src.schema, filt))
+                kept_groups = list(covered) + list(boundary)
+                if not kept_groups:
+                    return None
+                tbl = f.read_row_groups(kept_groups, columns=columns,
+                                        use_threads=True)
+                if not boundary:
+                    return tbl
+                md = f.metadata
+                head_rows = sum(md.row_group(g).num_rows
+                                for g in covered)
+                btbl = self._mask_filter(tbl.slice(head_rows),
+                                         plain_preds, src.schema, filt)
+                if not covered:
+                    return btbl
+                return pa.concat_tables([tbl.slice(0, head_rows), btbl])
             except Exception:
                 if config.IGNORE_CORRUPTED_FILES.get():
                     return None
                 raise
-            if not parts:
-                return None
-            return parts[0] if len(parts) == 1 else pa.concat_tables(parts)
 
         def gen():
             # double-buffer: file i+1 decodes on a worker thread (Arrow

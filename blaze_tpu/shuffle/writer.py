@@ -219,8 +219,9 @@ class ShuffleRepartitioner(MemConsumer):
             # counts stay on the single argsort below
             groups = [np.flatnonzero(pids == p) for p in range(n_parts)]
             order = np.concatenate(groups)
-            ends = np.cumsum([len(g) for g in groups])
-            starts = ends - [len(g) for g in groups]
+            counts = np.array([len(g) for g in groups])
+            ends = counts.cumsum()
+            starts = ends - counts
         else:
             order = np.argsort(pids, kind="stable")
             sorted_pids = pids[order]
