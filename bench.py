@@ -760,134 +760,297 @@ def run_scaled_leg(scale: float):
     }
 
 
-def device_compute_loop(sr_paths, dd_path, iters: int = 128):
-    """Fused-stage compute RESIDENT on the accelerator: ship ONE q01
-    batch to the device, fold it through the jit'd filter+hash-agg step
-    `iters` times inside a single XLA program (lax.fori_loop), one sync
-    at the end.  This measures what the chip does once data is in HBM —
-    the number no prior round ever recorded (VERDICT r3 #3) — and is
-    immune to the tunnel RTT by construction (exactly 1 dispatch).
+def _diff_time(make_loop, fresh, *args, iters, read):
+    """Differential timing: run the fold loop at `iters` and `4*iters`
+    inside one program each; throughput comes from the EXTRA work over
+    the EXTRA wall, so dispatch RTT, readback and per-call fixed costs
+    cancel (the tunneled device adds ~100ms per round trip).  Each leg
+    is min-of-3 after a forced-readback warm (block_until_ready is
+    unreliable here).  Returns (wall_for_iters_equiv, last_output)."""
+    walls = {}
+    out = None
+    for k in (iters, 4 * iters):
+        loop = make_loop(k)
+        o = loop(fresh(), *args)
+        read(o)
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            o = loop(fresh(), *args)
+            read(o)
+            w = time.perf_counter() - t0
+            best = w if best is None else min(best, w)
+        walls[k] = best
+        out = o
+    extra = max(walls[4 * iters] - walls[iters], 1e-9)
+    # `out` holds the 4*iters accumulation — callers decoding it must
+    # divide by (4 * iters)
+    return extra / 3.0, out
 
-    Runs on the default accelerator backend even when stage placement
-    pinned compute to host."""
+
+def device_compute_loop(sr_paths, dd_path, iters: int = 32):
+    """Fused-stage compute RESIDENT on the accelerator, through the
+    PRODUCTION fold (plan/fused.py): ship ONE ~1M-row window to the
+    device and fold it `iters` times inside a single XLA program — one
+    dispatch, tunnel-RTT-immune.  Measures what the chip does once data
+    is in HBM (VERDICT r3 #3 / r4 #1).
+
+    The workload is the q01 partial-agg shape grouped by
+    (store_sk, returned_date_sk) — the compact rollup domain where the
+    planner's stats pick the MXU strategy (kernels/mxu_agg.py: grouped
+    agg as one-hot matmuls in the exact i32 limb tier).  Reported
+    alongside: the production SCATTER strategy on the same plan, the
+    open-addressing hash strategy on the sparse (cust, store) keys, and
+    host-XLA twins of each — the same fold compiled for the host
+    backend (the honest chip-vs-host comparison; the MXU fold's host
+    twin runs the scatter reference formulation of identical
+    semantics).  Result correctness is asserted against pyarrow every
+    run."""
     import jax
     import jax.numpy as jnp
     import numpy as np
+    import pyarrow as pa
     import pyarrow.parquet as pq
-    from functools import partial
 
-    from blaze_tpu.kernels import hashing as H
-    from blaze_tpu.parallel.stage import (hash_agg_step, init_hash_carry,
-                                          pack_dense_keys)
+    from blaze_tpu.exprs import BinaryExpr, col, lit
+    from blaze_tpu.kernels import mxu_agg
+    from blaze_tpu.ops import (AggExec, AggMode, FilterExec,
+                               MemoryScanExec, make_agg)
+    from blaze_tpu.plan import fused as F
+    from blaze_tpu.parallel.stage import hash_agg_step, init_hash_carry
 
     dev = jax.devices()[0]  # the accelerator, regardless of placement
     lo, hi = date_sk_range(dd_path)
-    t = pq.read_table(sr_paths[0],
+    t = pq.read_table(sr_paths,
                       columns=["sr_returned_date_sk", "sr_customer_sk",
                                "sr_store_sk", "sr_return_amt"])
-    n = min(t.num_rows, 1 << 16)
-    t = t.slice(0, n)
+    # tile the real table up to a >=1M-row window (VERDICT r4: 65K-row
+    # dispatches amortize nothing; production folds windows this size)
+    reps = max(1, -(-(1 << 20) // t.num_rows))
+    if reps > 1:
+        t = pa.concat_tables([t] * reps)
+    t = t.slice(0, 1 << 20) if t.num_rows >= (1 << 20) else t
+    n = t.num_rows
 
-    def col_np(i, dt):
-        c = t.column(i).combine_chunks()
-        return (np.ascontiguousarray(
-            c.fill_null(0).to_numpy(zero_copy_only=False)).astype(dt),
-            np.asarray(c.is_valid()))
+    rollup = pa.table({
+        "date": t.column("sr_returned_date_sk"),
+        "store": t.column("sr_store_sk"),
+        "amt": t.column("sr_return_amt"),
+    }).combine_chunks()  # one chunk -> ONE window batch (to_batches
+    # never merges chunks, and the parquet row groups are 64K)
 
-    date_sk, dval = col_np(0, np.int64)
-    cust, cval = col_np(1, np.int64)
-    store, sval = col_np(2, np.int64)
-    amt, aval = col_np(3, np.float64)
-    valid = dval & cval & sval
+    def build_fused():
+        scan = MemoryScanExec.from_arrow(rollup, batch_rows=n)
+        flt = FilterExec(scan, [
+            BinaryExpr(">=", col(0, "date"), lit(lo)),
+            BinaryExpr("<=", col(0, "date"), lit(hi))])
+        agg = AggExec(flt,
+                      [(col(1, "store"), "store"), (col(0, "date"), "d")],
+                      [(make_agg("sum", [col(2)]), AggMode.PARTIAL, "amt"),
+                       (make_agg("count", [col(2)]), AggMode.PARTIAL,
+                        "cnt")])
+        node = F.fuse_plan(agg)
+        assert isinstance(node, F.FusedPartialAggExec), "fusion regressed"
+        return node
 
-    from blaze_tpu.parallel.stage import (init_accumulators,
-                                          scatter_accumulate)
+    node = build_fused()
+    assert node._mxu_meta is not None, "rollup must be MXU-eligible"
+    meta = node._mxu_meta
+    ranges = tuple(node._ranges)
+    kinds = tuple(rk for rk, _ok, _a in node._specs)
+    num_slots = 1
+    for rlo, rhi in ranges:
+        num_slots *= (rhi - rlo + 2)
 
-    slots = 1 << 17
+    window = next(F._batch_windows(node._source.execute(0), 1))
+    cols_stacked, masks, _cnt = window
+    # true per-iteration HBM operand traffic: column data + validity
+    # bytes + the row mask (one-hot operands never leave VMEM)
+    bpr = sum(c[0].dtype.itemsize + 1 for c in cols_stacked if c is not
+              None) + 1
 
-    # the DENSE fused strategy (plan/fused.py _execute_dense): group ids
-    # by arithmetic over known key bounds, ONE scatter-accumulate per
-    # batch — the TPU-appropriate kernel (scatters with probe loops, the
-    # hash strategy below, serialize badly on TPU)
-    smin, smax = int(store.min()), int(store.max())
-    cmin, cmax = int(cust.min()), int(cust.max())
-    s_span = smax - smin + 2
-    dense_slots = s_span * (cmax - cmin + 2)
+    # pyarrow oracle for the asserted result
+    mask_pd = pa.compute.and_(
+        pa.compute.greater_equal(rollup["date"], lo),
+        pa.compute.less_equal(rollup["date"], hi))
+    want = (rollup.filter(mask_pd).group_by(["store", "date"])
+            .aggregate([("amt", "sum"), ("amt", "count")]))
+    want_sum = pa.compute.sum(want["amt_sum"]).as_py()
+    want_cnt = pa.compute.sum(want["amt_count"]).as_py()
+    want_groups = want.num_rows
 
-    @jax.jit
-    def dense_fold(date_sk, cust, store, amt, valid, aval, carry):
-        def body(_i, c):
-            accs, avalid, occupied = c
-            mask = valid & (date_sk >= lo) & (date_sk <= hi)
-            gid = (cust - cmin) * s_span + (store - smin)
-            g = jnp.where(mask, gid, dense_slots)
-            occupied = occupied.at[g].max(mask, mode="drop")
-            na, nv = scatter_accumulate(g, [("sum", amt, aval)], mask,
-                                        accs, avalid)
-            return (tuple(na), tuple(nv), occupied)
-        return jax.lax.fori_loop(0, iters, body, carry)
+    def put_window(device):
+        cs = tuple(None if c is None else
+                   (jax.device_put(c[0], device),
+                    jax.device_put(c[1], device))
+                   for c in cols_stacked)
+        return cs, jax.device_put(masks, device)
 
-    @jax.jit
-    def hash_fold(date_sk, cust, store, amt, valid, aval, carry):
-        def body(_i, c):
-            mask = valid & (date_sk >= lo) & (date_sk <= hi)
-            return hash_agg_step(
-                c, [(cust, valid), (store, valid)],
-                [("sum", amt, aval)], mask)[0]
-        return jax.lax.fori_loop(0, iters, body, carry)
+    def run_fold(device, use_pallas):
+        """Production MXU fold, `iters` round trips over the resident
+        window in ONE program; returns (wall_s, table)."""
+        fold = F._mxu_fold_factory(node._prepare_key, node._prepare,
+                                   ranges, meta, use_pallas)
+        nb = meta.layout.n_blocks
 
-    def run_on(device):
+        def fresh():
+            return (jnp.zeros((meta.layout.sh, meta.layout.sl * nb),
+                              jnp.int32), (), jnp.asarray(True))
+
+        def make_loop(k):
+            @jax.jit
+            def loop(carry, cs, mk):
+                def body(_i, c):
+                    # carry-dependent always-true bit keeps every
+                    # iteration live: without it XLA hoists the whole
+                    # loop-invariant fold out of the fori_loop and the
+                    # "throughput" becomes fiction (values >= 0 by
+                    # construction, so the predicate never flips)
+                    p = c[0].reshape(-1)[0] > jnp.int32(-(2**30))
+                    return fold.raw(c, cs, mk & p)
+                return jax.lax.fori_loop(0, k, body, carry)
+            return loop
+
+        with jax.default_device(device):
+            cs, mk = put_window(device)
+            wall, out = _diff_time(make_loop, fresh, cs, mk,
+                                   iters=iters,
+                                   read=lambda o: float(jnp.sum(
+                                       o[0].astype(jnp.float32))))
+            table, _mm, ok = jax.device_get(out)
+            assert bool(ok), "fixed-point verify failed on bench data"
+        return wall, table
+
+    def run_scatter(device):
+        """Production dense SCATTER fold on the same plan (the strategy
+        the planner would pick past the MXU slot cap)."""
+        fold = F._dense_fold_factory(node._prepare_key, node._prepare,
+                                     ranges, kinds, num_slots)
+
+        def make_loop(k):
+            @jax.jit
+            def loop(carry, cs, mk):
+                def body(_i, c):
+                    # same hoist-proofing as the MXU loop (counts >= 0)
+                    p = c[0][1].reshape(-1)[0] > jnp.asarray(-(2**62))
+                    return fold.raw(c, cs, mk & p)
+                return jax.lax.fori_loop(0, k, body, carry)
+            return loop
+
+        with jax.default_device(device):
+            cs, mk = put_window(device)
+            wall, _out = _diff_time(
+                make_loop,
+                lambda: F._init_carry(kinds, node._acc_dtypes(),
+                                      num_slots),
+                cs, mk, iters=iters,
+                read=lambda o: float(jnp.sum(o[0][0])))
+        return wall
+
+    def run_hash(device, hrows=1 << 16):
+        """Open-addressing hash strategy on the sparse (cust, store)
+        keys — the q01 shape whose domain outgrows dense tables.  Kept
+        at its historical 64K-row shape: the probe-round kernel is the
+        known-slow TPU path (the MXU strategy exists to avoid it) and
+        larger resident folds of it fault the device."""
+        th = t.slice(0, hrows)
+        cust = np.ascontiguousarray(th.column("sr_customer_sk")
+                                    .combine_chunks().fill_null(0)
+                                    .to_numpy(zero_copy_only=False))
+        store = np.ascontiguousarray(th.column("sr_store_sk")
+                                     .combine_chunks().fill_null(0)
+                                     .to_numpy(zero_copy_only=False))
+        date = np.ascontiguousarray(th.column("sr_returned_date_sk")
+                                    .combine_chunks().fill_null(0)
+                                    .to_numpy(zero_copy_only=False))
+        amt = np.ascontiguousarray(th.column("sr_return_amt")
+                                   .combine_chunks().fill_null(0)
+                                   .to_numpy(zero_copy_only=False))
+        valid = (np.asarray(th.column("sr_returned_date_sk")
+                            .combine_chunks().is_valid()) &
+                 np.asarray(th.column("sr_customer_sk")
+                            .combine_chunks().is_valid()) &
+                 np.asarray(th.column("sr_store_sk")
+                            .combine_chunks().is_valid()))
+        aval = np.asarray(th.column("sr_return_amt")
+                          .combine_chunks().is_valid())
+        slots = 1 << 17
+
+        def make_loop(k):
+            @jax.jit
+            def hash_fold(carry, date, cust, store, amt, valid, aval):
+                def body(_i, c):
+                    # hoist-proof: sum accs stay finite-and-bounded
+                    p = c.accs[0].reshape(-1)[0] > -1e300
+                    mask = valid & (date >= lo) & (date <= hi) & p
+                    return hash_agg_step(
+                        c, [(cust, valid), (store, valid)],
+                        [("sum", amt, aval)], mask)[0]
+                return jax.lax.fori_loop(0, k, body, carry)
+            return hash_fold
+
         with jax.default_device(device):
             args = [jax.device_put(x, device) for x in
-                    (date_sk, cust, store, amt, valid, aval)]
-            accs, avalid = init_accumulators(["sum"], (jnp.float64,),
-                                             dense_slots)
-            occ = jnp.zeros(dense_slots, dtype=bool)
-            out = dense_fold(*args, (accs, avalid, occ))  # compile+warm
-            jax.block_until_ready(out)
-            t0 = time.perf_counter()
-            accs, avalid = init_accumulators(["sum"], (jnp.float64,),
-                                             dense_slots)
-            occ = jnp.zeros(dense_slots, dtype=bool)
-            out = dense_fold(*args, (accs, avalid, occ))
-            # forced readback — block_until_ready is unreliable on the
-            # tunneled device (see .claude/skills/verify)
-            float(jnp.sum(out[0][0]))
-            dense_wall = time.perf_counter() - t0
+                    (date, cust, store, amt, valid, aval)]
+            wall, _out = _diff_time(
+                make_loop,
+                lambda: init_hash_carry([jnp.int64, jnp.int64], ["sum"],
+                                        (jnp.float64,), slots),
+                *args, iters=iters,
+                read=lambda o: float(jnp.sum(o.accs[0])))
+        return wall
 
-            carry = init_hash_carry([jnp.int64, jnp.int64], ["sum"],
-                                    (jnp.float64,), slots)
-            out = hash_fold(*args, carry)
-            jax.block_until_ready(out)
-            t0 = time.perf_counter()
-            carry = init_hash_carry([jnp.int64, jnp.int64], ["sum"],
-                                    (jnp.float64,), slots)
-            out = hash_fold(*args, carry)
-            float(jnp.sum(out.accs[0]))
-            hash_wall = time.perf_counter() - t0
-        return dense_wall, hash_wall
+    use_pallas = dev.platform == "tpu"
+    mxu_wall, table = run_fold(dev, use_pallas)
 
-    dense_wall, hash_wall = run_on(dev)
+    # ---- correctness: decode the device table against pyarrow ----------
+    presence, vals = mxu_agg.split_blocks(np.asarray(table), meta.layout)
+    occ = np.nonzero(presence)[0]
+    sp = meta.specs[0]
+    vcnt = vals[sp.arr_valid][occ]
+    cents = vals[sp.arr_cents][occ] + vcnt * sp.off
+    got_sum = float(cents.sum()) / sp.scale / (4 * iters)
+    got_cnt = int(vals[meta.specs[1].arr_valid][occ].sum()) // (4 * iters)
+    assert got_cnt == want_cnt, (got_cnt, want_cnt)
+    assert len(occ) == want_groups, (len(occ), want_groups)
+    assert abs(got_sum - want_sum) / max(abs(want_sum), 1) < 1e-9, \
+        (got_sum, want_sum)
+
+    scatter_wall = run_scatter(dev)
+    hrows = 1 << 16
+    try:
+        hash_wall = run_hash(dev, hrows)
+        hash_fields = {"device_hash_rows_per_sec":
+                       round(hrows * iters / hash_wall)}
+    except Exception as e:  # the probe kernel is fragile on device
+        hash_fields = {"device_hash_error": repr(e)[-200:]}
+
     host_fields = {}
     try:
         cpu = jax.local_devices(backend="cpu")[0]
-        h_dense, h_hash = run_on(cpu)
+        h_wall, _ht = run_fold(cpu, use_pallas=False)
+        h_scatter = run_scatter(cpu)
+        h_hash = run_hash(cpu, hrows)
         host_fields = {
-            "host_xla_dense_rows_per_sec": round(n * iters / h_dense),
-            "host_xla_hash_rows_per_sec": round(n * iters / h_hash),
+            "host_xla_dense_rows_per_sec": round(n * iters / h_wall),
+            "host_xla_scatter_rows_per_sec": round(n * iters / h_scatter),
+            "host_xla_hash_rows_per_sec": round(hrows * iters / h_hash),
         }
     except Exception:
         pass
     rows = n * iters
-    touched = rows * 4 * 8  # four 8-byte operand streams per iteration
     return {
-        "device_rows_per_sec": round(rows / dense_wall),
-        "device_hash_rows_per_sec": round(rows / hash_wall),
+        "device_rows_per_sec": round(rows / mxu_wall),
+        "device_strategy": "mxu" if use_pallas else "mxu-ref",
+        "device_scatter_rows_per_sec": round(rows / scatter_wall),
+        **hash_fields,
         "device_loop_iters": iters,
-        "device_loop_wall_s": round(dense_wall, 4),
+        "device_loop_wall_s": round(mxu_wall, 4),
         "device_loop_batch_rows": n,
-        "device_hbm_frac": round((touched / dense_wall) / HBM_PEAK_BYTES_S,
-                                 4),
+        "device_loop_groups": int(want_groups),
+        "device_bytes_per_row": bpr,
+        "device_hbm_frac": round((rows * bpr / mxu_wall)
+                                 / HBM_PEAK_BYTES_S, 4),
         "device_backend": dev.platform,
         **host_fields,
     }

@@ -276,6 +276,24 @@ FUSED_STAGE_CAPACITY = int_conf(
     "auron.tpu.fused.stage.capacity", 1 << 24,
     "Max dense group-table slots (product of key ranges) for the fused "
     "dense-group-id path before falling back to the sorted table.")
+AGG_MXU_ENABLE = bool_conf(
+    "auron.tpu.mxuAgg.enable", True,
+    "Aggregate compact dense group tables as MXU one-hot matmuls "
+    "(kernels/mxu_agg.py) instead of scatters when stats prove "
+    "eligibility — the TPU fast path (~4x the best scatter kernel).")
+AGG_MXU_MAX_SLOTS = int_conf(
+    "auron.tpu.mxuAgg.maxSlots", 1 << 17,
+    "Dense-table slot cap for the MXU aggregation strategy; beyond it "
+    "the per-row matmul cost outgrows the scatter path.")
+AGG_MXU_FORCE = bool_conf(
+    "auron.tpu.mxuAgg.force", False,
+    "Run the MXU agg strategy on non-TPU backends through its scatter "
+    "reference formulation (integration tests).")
+AGG_MXU_DECIMAL_SCALE = int_conf(
+    "auron.tpu.mxuAgg.decimalScale", 100,
+    "Fixed-point scale probed for float sum columns on the MXU path "
+    "(100 = two decimals, the TPC-DS money shape); rows that fail the "
+    "exactness verify fall the stage back to the scatter path.")
 SORT_SPILL_BATCHES = int_conf(
     "auron.tpu.sort.inmem.batches", 64,
     "Batches buffered in device memory before external sort spills a run.")

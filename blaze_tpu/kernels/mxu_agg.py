@@ -98,7 +98,20 @@ def max_rows_per_table(layout: MxuAggLayout) -> int:
 # kernel
 # ---------------------------------------------------------------------------
 
-def _make_kernel(layout: MxuAggLayout):
+def _chunk_for(layout: "MxuAggLayout") -> int:
+    """Largest row-chunk whose working set fits a conservative VMEM
+    budget: oh_hi + oh_lo + one weighted lo + the f32 accumulator and
+    i32 output (both sh x sl*nb)."""
+    budget = 10 << 20
+    table = layout.sh * layout.sl * layout.n_blocks * 8
+    for chunk in (8192, 4096, 2048):
+        per_row = (layout.sh + 2 * layout.sl) * 2  # bf16 one-hots
+        if table + chunk * per_row <= budget:
+            return chunk
+    return 1024
+
+
+def _make_kernel(layout: MxuAggLayout, chunk: int):
     sh, sl, limbs, presence = (layout.sh, layout.sl, layout.limbs,
                                layout.presence)
     lo_bits = sl.bit_length() - 1
@@ -115,8 +128,8 @@ def _make_kernel(layout: MxuAggLayout):
         def _init():
             out_ref[:] = jnp.zeros_like(out_ref)
 
-        ih = jax.lax.broadcasted_iota(jnp.int32, (_CHUNK, sh), 1)
-        il = jax.lax.broadcasted_iota(jnp.int32, (_CHUNK, sl), 1)
+        ih = jax.lax.broadcasted_iota(jnp.int32, (chunk, sh), 1)
+        il = jax.lax.broadcasted_iota(jnp.int32, (chunk, sl), 1)
 
         def row(r, acc):
             gid = gid_ref[0, r, :]
@@ -124,23 +137,32 @@ def _make_kernel(layout: MxuAggLayout):
             lo = jax.lax.bitwise_and(gid, sl - 1)
             # sentinel rows (gid >= sh*sl) yield hi >= sh: all-zero one-hot
             oh_hi = (hi[:, None] == ih).astype(jnp.bfloat16)
-            lo_eq = lo[:, None] == il
-            ws = []
+            oh_lo = (lo[:, None] == il).astype(jnp.bfloat16)
+            # one dot per block, sharing both one-hots: keeps live VMEM
+            # to one weighted operand at a time (bigger chunks -> better
+            # MXU utilization than a single wide concatenated dot)
+            parts = []
             if presence:
-                ws.append(jnp.where(lo_eq, 1, 0).astype(jnp.bfloat16))
+                parts.append(jax.lax.dot_general(
+                    oh_hi, oh_lo, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32))
             for a_ref, nl in zip(arr_refs, limbs):
                 v = a_ref[0, r, :]
                 for li in range(nl):
                     w = jax.lax.bitwise_and(
                         jax.lax.shift_right_logical(v, _LIMB_BITS * li),
                         _LIMB_MASK)
-                    ws.append(jnp.where(lo_eq, w[:, None], 0)
-                              .astype(jnp.bfloat16))
-            wlo = ws[0] if nb == 1 else jnp.concatenate(ws, axis=1)
-            # f32 accumulation is exact: chunk partial <= 255 * 16384 < 2^24
-            return acc + jax.lax.dot_general(
-                oh_hi, wlo, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+                    # minor-dim insertion must happen at 32 bits
+                    # (Mosaic restriction), then cast: limb <= 255 is
+                    # exact in bf16 and the product stays exact
+                    wcol = w.astype(jnp.float32)[:, None] \
+                        .astype(jnp.bfloat16)
+                    wlo = oh_lo * wcol
+                    parts.append(jax.lax.dot_general(
+                        oh_hi, wlo, (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32))
+            # f32 accumulation is exact: chunk partial <= 255 * 65536 < 2^24
+            return acc + jnp.concatenate(parts, axis=1)
 
         acc = jax.lax.fori_loop(0, 8, row,
                                 jnp.zeros((sh, sl * nb), jnp.float32))
@@ -158,18 +180,18 @@ def _pallas_window_table(gid, arrays, layout: MxuAggLayout,
         import contextlib
         _x64_scope = lambda _v: contextlib.nullcontext()  # noqa: E731
 
+    chunk = _chunk_for(layout)
+    rows_per_step = 8 * chunk
     n = gid.shape[0]
-    pad = (-n) % _ROWS_PER_STEP
-    sentinel = jnp.int32(layout.num_slots)
+    pad = (-n) % rows_per_step
     gid = jnp.pad(gid.astype(jnp.int32), (0, pad),
                   constant_values=layout.num_slots)
     arrays = [jnp.pad(a.astype(jnp.int32), (0, pad)) for a in arrays]
-    nblk = (n + pad) // _ROWS_PER_STEP
-    gid3 = gid.reshape(nblk, 8, _CHUNK)
-    arrs3 = [a.reshape(nblk, 8, _CHUNK) for a in arrays]
-    del sentinel
+    nblk = (n + pad) // rows_per_step
+    gid3 = gid.reshape(nblk, 8, chunk)
+    arrs3 = [a.reshape(nblk, 8, chunk) for a in arrays]
 
-    kernel = _make_kernel(layout)
+    kernel = _make_kernel(layout, chunk)
     nb = layout.n_blocks
     # Mosaic lowering rejects i64-typed scalars; the kernel is pure
     # i32/bf16/f32, so trace it with x64 semantics scoped off (the global
@@ -178,7 +200,7 @@ def _pallas_window_table(gid, arrays, layout: MxuAggLayout,
         return pl.pallas_call(
             kernel,
             grid=(nblk,),
-            in_specs=[pl.BlockSpec((1, 8, _CHUNK), lambda i: (i, 0, 0))
+            in_specs=[pl.BlockSpec((1, 8, chunk), lambda i: (i, 0, 0))
                       for _ in range(1 + len(arrs3))],
             out_specs=pl.BlockSpec((layout.sh, layout.sl * nb),
                                    lambda i: (0, 0)),

@@ -155,6 +155,31 @@ def pack_dense_keys(key_cols: Sequence[Tuple[jax.Array, jax.Array]],
     return gid, total
 
 
+def pack_dense_keys_i32(key_cols: Sequence[Tuple[jax.Array, jax.Array]],
+                        ranges: Sequence[Tuple[int, int]]
+                        ) -> Tuple[jax.Array, int]:
+    """pack_dense_keys in the 32-bit compute tier: same stride layout,
+    all arithmetic in int32 (TPU v5e emulates every 64-bit op as a
+    multi-instruction sequence; dense tables are capped far below 2^31
+    so the id math never needs the width).  Only the initial `data - lo`
+    shift touches the stored key dtype."""
+    total = 1
+    strides = []
+    for lo, hi in ranges:
+        strides.append(total)
+        total *= (hi - lo + 2)
+    assert total < (1 << 31), "dense table exceeds the i32 tier"
+    gid = None
+    for (data, valid), (lo, hi), stride in zip(key_cols, ranges, strides):
+        span = hi - lo
+        k = jnp.clip(data - jnp.asarray(lo, dtype=data.dtype),
+                     0, span).astype(jnp.int32)
+        k = jnp.where(valid, k, jnp.int32(span + 1))
+        contrib = k * jnp.int32(stride)
+        gid = contrib if gid is None else gid + contrib
+    return gid, total
+
+
 def unpack_dense_keys(slots, ranges: Sequence[Tuple[int, int]], xp=jnp
                       ) -> List[Tuple[jax.Array, jax.Array]]:
     """Inverse of pack_dense_keys for slot indices -> (key, validity).

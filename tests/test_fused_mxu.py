@@ -1,0 +1,176 @@
+"""MXU fused-agg strategy (plan/fused.py _execute_mxu + kernels/mxu_agg):
+planning eligibility, result parity with the eager path through the
+scatter reference formulation, drain bookkeeping and the fixed-point
+verify fallback."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import config
+from blaze_tpu.exprs import BinaryExpr, col, lit
+from blaze_tpu.ops import (AggExec, AggMode, FilterExec, MemoryScanExec,
+                           make_agg)
+from blaze_tpu.plan.fused import FusedPartialAggExec, fuse_plan
+
+
+def _table(n=5000, seed=0, nulls=True, dirty_amt=False):
+    rng = np.random.default_rng(seed)
+    amt = np.round(rng.random(n) * 500 - 100, 2)
+    if dirty_amt:
+        amt[::97] = 1.234567891  # not 2-decimal fixed point
+    cust = rng.integers(1, 200, n)
+    cust_arr = pa.array(cust)
+    if nulls:
+        mask = rng.random(n) < 0.05
+        cust_arr = pa.array(np.where(mask, None, cust).tolist(),
+                            type=pa.int64())
+    amask = rng.random(n) < 0.03
+    return pa.table({
+        "date": pa.array(rng.integers(100, 200, n)),
+        "cust": cust_arr,
+        "store": pa.array(rng.integers(1, 13, n)),
+        "amt": pa.array(np.where(amask, None, amt).tolist(),
+                        type=pa.float64()),
+        "qty": pa.array(rng.integers(-50, 1000, n)),
+    })
+
+
+def _plan(t, aggs=None):
+    scan = MemoryScanExec.from_arrow(t)
+    flt = FilterExec(scan, [BinaryExpr(">", col(0, "date"), lit(150))])
+    aggs = aggs or [
+        (make_agg("sum", [col(3)]), AggMode.PARTIAL, "amt_sum"),
+        (make_agg("sum", [col(4)]), AggMode.PARTIAL, "qty_sum"),
+        (make_agg("count", [col(3)]), AggMode.PARTIAL, "cnt"),
+        (make_agg("count", []), AggMode.PARTIAL, "cnt_star"),
+        (make_agg("min", [col(4)]), AggMode.PARTIAL, "qty_min"),
+        (make_agg("max", [col(3)]), AggMode.PARTIAL, "amt_max"),
+    ]
+    return AggExec(flt,
+                   [(col(1, "cust"), "cust"), (col(2, "store"), "store")],
+                   aggs)
+
+
+def _collect(plan):
+    out = [b.compact().to_arrow() for b in plan.execute(0)]
+    out = [b for b in out if b.num_rows]
+    t = pa.Table.from_batches(out, schema=plan.schema.to_arrow())
+    return t.to_pandas().sort_values(["cust", "store"]).reset_index(
+        drop=True)
+
+
+@pytest.fixture
+def mxu_forced():
+    config.conf.set(config.AGG_MXU_FORCE.key, True)
+    # keep the host-vectorized path out of the way so the MXU branch runs
+    config.conf.set(config.FUSED_HOST_VECTORIZED_ENABLE.key, False)
+    try:
+        yield
+    finally:
+        config.conf.unset(config.AGG_MXU_FORCE.key)
+        config.conf.unset(config.FUSED_HOST_VECTORIZED_ENABLE.key)
+
+
+class TestPlanning:
+    def test_meta_planned_for_bounded_specs(self):
+        fused = fuse_plan(_plan(_table()))
+        assert isinstance(fused, FusedPartialAggExec)
+        assert fused.fused_mode == "dense"
+        assert fused._mxu_meta is not None
+        kinds = [s.kind for s in fused._mxu_meta.specs]
+        assert kinds == ["sum", "sum", "count", "count_star", "min", "max"]
+        # float sum rides the fixed-point tier
+        amt = fused._mxu_meta.specs[0]
+        assert amt.is_float and amt.scale == 100
+        qty = fused._mxu_meta.specs[1]
+        assert not qty.is_float and qty.scale == 1 and qty.off == -50
+
+    def test_meta_absent_when_slots_exceed_cap(self):
+        config.conf.set(config.AGG_MXU_MAX_SLOTS.key, 64)
+        try:
+            fused = fuse_plan(_plan(_table()))
+            assert fused._mxu_meta is None
+        finally:
+            config.conf.unset(config.AGG_MXU_MAX_SLOTS.key)
+
+    def test_meta_absent_without_value_stats(self):
+        # avg is never fused; a sum over a projected computed column has
+        # no source stats -> no meta, scatter path still available
+        t = _table()
+        scan = MemoryScanExec.from_arrow(t)
+        flt = FilterExec(scan, [BinaryExpr(">", col(0, "date"), lit(150))])
+        agg = AggExec(flt, [(col(2, "store"), "store")],
+                      [(make_agg("sum",
+                                 [BinaryExpr("+", col(3), col(3))]),
+                        AggMode.PARTIAL, "s")])
+        fused = fuse_plan(agg)
+        assert isinstance(fused, FusedPartialAggExec)
+        assert fused._mxu_meta is None
+
+
+class TestExecutionParity:
+    def test_matches_eager(self, mxu_forced):
+        t = _table()
+        eager = _plan(t)
+        fused = fuse_plan(_plan(t))
+        assert fused._mxu_meta is not None
+        a, b = _collect(eager), _collect(fused)
+        assert int(fused.metrics.get("mxu_rows")) > 0
+        assert len(a) == len(b)
+        for c in a.columns:
+            np.testing.assert_allclose(
+                a[c].to_numpy(dtype=float), b[c].to_numpy(dtype=float),
+                rtol=1e-12, err_msg=c)
+
+    def test_exact_float_sums(self, mxu_forced):
+        # the limb path must reproduce the exact decimal sum, which is
+        # within 1e-12 of any f64 accumulation order
+        t = _table(n=20000, nulls=False)
+        fused = fuse_plan(_plan(t))
+        got = _collect(fused)
+        df = t.to_pandas()
+        df = df[df["date"] > 150]
+        want = df.groupby(["cust", "store"])["amt"].sum(min_count=1)
+        got_idx = got.set_index(["cust", "store"])["amt_sum.sum"]
+        for k, v in want.items():
+            if np.isnan(v):
+                assert np.isnan(got_idx[k])  # all-null group sums null
+            else:
+                assert abs(got_idx[k] - v) <= 1e-9 * max(1.0, abs(v))
+
+    def test_drain_boundary(self, mxu_forced, monkeypatch):
+        # force a drain every window: multi-window accumulation must add
+        # tables, not overwrite them
+        from blaze_tpu.kernels import mxu_agg
+        monkeypatch.setattr(mxu_agg, "MAX_ROWS_PER_TABLE", 1)
+        t = _table(n=4000)
+        eager = _plan(t)
+        fused = fuse_plan(_plan(t))
+        a, b = _collect(eager), _collect(fused)
+        for c in a.columns:
+            np.testing.assert_allclose(
+                a[c].to_numpy(dtype=float), b[c].to_numpy(dtype=float),
+                rtol=1e-12, err_msg=c)
+
+    def test_verify_failure_falls_back_to_scatter(self, mxu_forced):
+        t = _table(n=3000, dirty_amt=True)
+        eager = _plan(t)
+        fused = fuse_plan(_plan(t))
+        assert fused._mxu_meta is not None
+        a, b = _collect(eager), _collect(fused)
+        assert int(fused.metrics.get("mxu_verify_fallback")) == 1
+        for c in a.columns:
+            np.testing.assert_allclose(
+                a[c].to_numpy(dtype=float), b[c].to_numpy(dtype=float),
+                rtol=1e-9, err_msg=c)
+
+    def test_all_rows_filtered(self, mxu_forced):
+        t = _table(n=200)
+        scan = MemoryScanExec.from_arrow(t)
+        flt = FilterExec(scan, [BinaryExpr(">", col(0, "date"), lit(999))])
+        agg = AggExec(flt, [(col(2, "store"), "store")],
+                      [(make_agg("sum", [col(3)]), AggMode.PARTIAL, "s")])
+        fused = fuse_plan(agg)
+        rows = [b for b in fused.execute(0) if b.num_rows]
+        assert rows == []
