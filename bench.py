@@ -267,6 +267,114 @@ def _tasks(fn, n, what):
     return run_tasks(fn, n, STAGE_TIMEOUT_S, what)
 
 
+# ---- process-pool execution for host-placed stages ------------------------
+# Spark's executors are separate JVMs with true thread parallelism; the
+# analogous host deployment here is a pool of worker PROCESSES (each its
+# own GIL) that persist across queries like executors persist across
+# stages.  Tasks arrive as plan/file descriptors (picklable), exactly the
+# TaskDefinition contract; the pool is only used when stage compute is
+# host-placed (a tunneled accelerator keeps the in-process thread path).
+
+_PROC_POOL = None
+
+
+def _worker_init(batch_size):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from blaze_tpu import config as C
+    C.conf.set(C.BATCH_SIZE.key, batch_size)
+    C.conf.set(C.PLACEMENT.key, "host")
+
+
+def _get_pool():
+    global _PROC_POOL
+    if _PROC_POOL is None:
+        import multiprocessing as mp
+        ctx = mp.get_context("spawn")
+        _PROC_POOL = ctx.Pool(
+            _CORES, initializer=_worker_init,
+            initargs=(int(os.environ.get("BLAZE_BENCH_BATCH", 65536)),))
+    return _PROC_POOL
+
+
+def _shutdown_pool():
+    """MUST run before the child's os._exit: workers inherit the
+    supervisor's stdout pipe, and orphaned workers holding its write
+    end would turn every successful run into a reported hang."""
+    global _PROC_POOL
+    if _PROC_POOL is not None:
+        _PROC_POOL.terminate()
+        _PROC_POOL.join()
+        _PROC_POOL = None
+
+
+def _use_proc_pool() -> bool:
+    if os.environ.get("BLAZE_BENCH_PROC_POOL", "1") != "1":
+        return False
+    from blaze_tpu.bridge.placement import placement_info
+    pi = placement_info()
+    return pi is not None and pi.device_kind == "cpu"
+
+
+def _proc_tasks(fn, args_list, what):
+    pool = _get_pool()
+    results = [pool.apply_async(fn, (a,)) for a in args_list]
+    deadline = time.monotonic() + STAGE_TIMEOUT_S  # ONE shared budget
+    out = []
+    errors = []
+    for i, r in enumerate(results):
+        try:
+            out.append(r.get(timeout=max(0.1, deadline - time.monotonic())))
+        except Exception as e:  # surface the first REAL failure last
+            errors.append((i, e))
+    if errors:
+        i, e = errors[0]
+        raise RuntimeError(f"{what}: task {i} failed: {e!r}") from e
+    return out
+
+
+def _proc_map_task(args):
+    sr_paths, lo, hi, m, tmpdir, n_maps, n_reduces = args
+    from blaze_tpu.bridge.runtime import NativeExecutionRuntime
+    from blaze_tpu.plan.proto_serde import task_definition_to_bytes
+    td = task_definition_to_bytes(
+        stage1_td(sr_paths, lo, hi, m, tmpdir, n_maps, n_reduces))
+    rt = NativeExecutionRuntime(td).start()
+    try:
+        for _ in rt.batches():
+            pass
+    finally:
+        rt.finalize()
+    return None
+
+
+def _proc_reduce_task(args):
+    blocks, r, n_reduces = args  # blocks: [(path, offset, length), ...]
+    import pyarrow as pa
+    from blaze_tpu.bridge.resource import put_resource
+    from blaze_tpu.bridge.runtime import NativeExecutionRuntime
+    from blaze_tpu.plan.proto_serde import task_definition_to_bytes
+    from blaze_tpu.shuffle.reader import FileSegmentBlock
+
+    def blocks_for(_partition):
+        return [FileSegmentBlock(p, off, length)
+                for p, off, length in blocks]
+
+    put_resource("bench_q01_shuffle", blocks_for)
+    td = task_definition_to_bytes(stage2_td(r, n_reduces))
+    rt = NativeExecutionRuntime(td).start()
+    groups = 0
+    total = 0.0
+    try:
+        for rb in rt.batches():
+            groups += rb.num_rows
+            s = pa.compute.sum(rb.column(2)).as_py()
+            total += s if s is not None else 0.0
+    finally:
+        rt.finalize()
+    return groups, total
+
+
 def ensure_dataset(scale: float = SCALE):
     """Generate + cache the SF-scaled q01 tables as parquet."""
     import pyarrow.parquet as pq
@@ -293,20 +401,11 @@ def ensure_dataset(scale: float = SCALE):
 
 
 def _scratch_dir(prefix):
-    """Shuffle scratch on the RAM disk when available — the standard
-    spark.local.dir-on-tmpfs deployment (shuffle files are transient;
-    ext4 journaling is pure overhead for them).  Containers often mount
-    a tiny /dev/shm (docker default 64 MB), so require real headroom or
-    fall back to /tmp."""
+    """Shuffle scratch on the RAM disk when available (one shared
+    heuristic with the production scheduler: stages.py)."""
     import tempfile
-    base = None
-    try:
-        sv = os.statvfs("/dev/shm")
-        if sv.f_bavail * sv.f_frsize >= (2 << 30):
-            base = "/dev/shm"
-    except OSError:
-        pass
-    return tempfile.mkdtemp(prefix=prefix, dir=base)
+    from blaze_tpu.plan.stages import _shuffle_scratch_base
+    return tempfile.mkdtemp(prefix=prefix, dir=_shuffle_scratch_base())
 
 
 def _file_groups(paths, n_groups):
@@ -413,32 +512,50 @@ def run_engine(sr_paths, dd_path, tmpdir, n_maps=None, n_reduces=None):
     n_maps = n_maps or N_MAPS
     n_reduces = n_reduces or N_REDUCES
 
-    def run_map(m):
-        td = task_definition_to_bytes(
-            stage1_td(sr_paths, lo, hi, m, tmpdir, n_maps, n_reduces))
-        rt = NativeExecutionRuntime(td).start()
-        try:
-            for _ in rt.batches():
-                pass
-        finally:
-            rt.finalize()
+    # the pool pays per-task IPC; single-task STAGES keep the
+    # zero-overhead in-process path (gated per stage)
+    pool_ok = _use_proc_pool()
+    if pool_ok and n_maps >= 2:
+        _proc_tasks(_proc_map_task,
+                    [(sr_paths, lo, hi, m, tmpdir, n_maps, n_reduces)
+                     for m in range(n_maps)], "q01 map stage")
+    else:
+        def run_map(m):
+            td = task_definition_to_bytes(
+                stage1_td(sr_paths, lo, hi, m, tmpdir, n_maps, n_reduces))
+            rt = NativeExecutionRuntime(td).start()
+            try:
+                for _ in rt.batches():
+                    pass
+            finally:
+                rt.finalize()
 
-    _tasks(run_map, n_maps, "q01 map stage")
+        _tasks(run_map, n_maps, "q01 map stage")
 
     # ---- register reduce-side block map (the MapOutputTracker analog) ----
     offsets = [read_index_file(os.path.join(tmpdir, f"shuffle_{m}.index"))
                for m in range(n_maps)]
 
-    def blocks_for(partition):
+    def seg_list(partition):
         out = []
         for m in range(n_maps):
             off = offsets[m]
             length = off[partition + 1] - off[partition]
             if length > 0:
-                out.append(FileSegmentBlock(
-                    os.path.join(tmpdir, f"shuffle_{m}.data"),
-                    off[partition], length))
+                out.append((os.path.join(tmpdir, f"shuffle_{m}.data"),
+                            off[partition], length))
         return out
+
+    if pool_ok and n_reduces >= 2:
+        results = _proc_tasks(
+            _proc_reduce_task,
+            [(seg_list(r), r, n_reduces) for r in range(n_reduces)],
+            "q01 reduce stage")
+        return sum(g for g, _ in results), sum(t for _, t in results)
+
+    def blocks_for(partition):
+        return [FileSegmentBlock(p, off, length)
+                for p, off, length in seg_list(partition)]
 
     put_resource("bench_q01_shuffle", blocks_for)
 
@@ -525,12 +642,36 @@ def join_td(sr_paths, dd_path, map_id, n_maps=None):
             "num_partitions": n_maps, "plan": plan}
 
 
+def _proc_join_task(args):
+    sr_paths, dd_path, m, n_maps = args
+    import pyarrow as pa
+    from blaze_tpu.bridge.runtime import NativeExecutionRuntime
+    from blaze_tpu.plan.proto_serde import task_definition_to_bytes
+    td = task_definition_to_bytes(join_td(sr_paths, dd_path, m, n_maps))
+    rt = NativeExecutionRuntime(td).start()
+    cnt, amt = 0, 0.0
+    try:
+        for rb in rt.batches():
+            cnt += pa.compute.sum(rb.column(0)).as_py() or 0
+            amt += pa.compute.sum(rb.column(1)).as_py() or 0.0
+    finally:
+        rt.finalize()
+    return cnt, amt
+
+
 def run_join_engine(sr_paths, dd_path, n_maps=None):
     import pyarrow as pa
     from blaze_tpu.bridge.runtime import NativeExecutionRuntime
     from blaze_tpu.plan.proto_serde import task_definition_to_bytes
 
     n_maps = n_maps or N_MAPS
+
+    if _use_proc_pool() and n_maps >= 2:
+        results = _proc_tasks(
+            _proc_join_task,
+            [(sr_paths, dd_path, m, n_maps) for m in range(n_maps)],
+            "q06-shaped join stage")
+        return (sum(c for c, _ in results), sum(a for _, a in results))
 
     def run_map(m):
         td = task_definition_to_bytes(join_td(sr_paths, dd_path, m, n_maps))
@@ -1068,7 +1209,15 @@ def main():
         except BaseException:
             import traceback
             _error_line(traceback.format_exc())
+            try:
+                _shutdown_pool()
+            except Exception:
+                pass
             os._exit(2)  # bypass stuck non-daemon threads
+        try:
+            _shutdown_pool()
+        except Exception:
+            pass
         os._exit(0)
     sys.exit(supervise())
 

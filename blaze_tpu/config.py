@@ -338,6 +338,12 @@ SHUFFLE_FILE_CODEC = str_conf(
     "any mix).  Set to lz4 when .data segments are mostly fetched "
     "across the network.  Spill frames and RSS pushes always use "
     "io.compression.codec.")
+DAG_SINGLE_TASK_BYTES = int_conf(
+    "auron.tpu.dag.singleTaskBytes", 64 << 20,
+    "Queries whose total file-scan input is at or below this run as ONE "
+    "wire task with in-process exchanges (the Spark-AQE coalesce-to-one-"
+    "partition analog); per-task fixed costs dominate below it.  0 "
+    "disables the fast path.")
 JOIN_RUNTIME_FILTER_ENABLE = bool_conf(
     "auron.tpu.join.runtimeFilter", True,
     "Drop probe rows outside the build side's join-key [min, max] before "

@@ -255,3 +255,4 @@ def _prune_scan(scan, required: Optional[Set[int]]):
                           batch_rows=scan._batch_rows)
     mapping = {old: new_i for new_i, old in enumerate(req)}
     return new, mapping
+

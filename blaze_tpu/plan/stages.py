@@ -40,6 +40,20 @@ from blaze_tpu.bridge.resource import put_resource, remove_resource
 _SCAN_KINDS = ("parquet_scan", "orc_scan")
 
 
+def _shuffle_scratch_base() -> Optional[str]:
+    """Shuffle files are transient: prefer the RAM disk (the standard
+    spark.local.dir-on-tmpfs deployment) when it has real headroom —
+    ext4 journaling is pure critical-path overhead for data read back
+    milliseconds later.  None -> tempfile's default."""
+    try:
+        sv = os.statvfs("/dev/shm")
+        if sv.f_bavail * sv.f_frsize >= (2 << 30):
+            return "/dev/shm"
+    except OSError:
+        pass
+    return None
+
+
 @dataclass
 class Stage:
     sid: int
@@ -58,7 +72,8 @@ class DagScheduler:
                  max_task_parallelism: Optional[int] = None,
                  task_timeout_s: float = 600.0):
         self._owns_dir = work_dir is None
-        self._dir = work_dir or tempfile.mkdtemp(prefix="blaze-dag-")
+        self._dir = work_dir or tempfile.mkdtemp(
+            prefix="blaze-dag-", dir=_shuffle_scratch_base())
         os.makedirs(self._dir, exist_ok=True)
         self._files: List[str] = []
         if max_task_parallelism is None:
@@ -73,6 +88,7 @@ class DagScheduler:
         self._run_id = uuid.uuid4().hex[:10]
         self.stages: List[Stage] = []
         self._resources: List[str] = []
+        self.exec_mode: Optional[str] = None  # "local" | "staged"
 
     # -- splitting ---------------------------------------------------------
 
@@ -243,12 +259,67 @@ class DagScheduler:
         put_resource(stage.resource_id, blocks_for)
         self._resources.append(stage.resource_id)
 
+    # -- AQE small-query fast path -----------------------------------------
+
+    @staticmethod
+    def _scan_input_bytes(plan: Dict[str, Any]) -> int:
+        """Total bytes behind every file scan in the plan; local files
+        only — any non-stat-able input (remote FS, mem tables count 0)
+        disables the estimate with a sentinel."""
+        total = 0
+        stack = [plan]
+        while stack:
+            d = stack.pop()
+            if not isinstance(d, dict):
+                continue
+            if d.get("kind") in _SCAN_KINDS:
+                for group in d.get("file_groups", []):
+                    for p in group:
+                        try:
+                            total += os.path.getsize(p)
+                        except (OSError, TypeError):
+                            return 1 << 62
+            for v in d.values():
+                if isinstance(v, dict):
+                    stack.append(v)
+                elif isinstance(v, list):
+                    stack.extend(x for x in v if isinstance(x, dict))
+        return total
+
+    def _run_single_task(self, plan: Dict[str, Any]) -> pa.Table:
+        """Local execution mode: the whole query runs in-process with
+        exchanges as LocalShuffleExchange — the analog of Spark AQE's
+        local shuffle reader / coalesce-to-one-partition on small
+        queries, where per-stage fixed costs (task spin-up, plan
+        round-trips, shuffle files) dominate the actual work several
+        times over.  Exchanges never leave the process, so nothing
+        needs a wire encoding."""
+        from blaze_tpu.plan import create_plan
+        from blaze_tpu.plan.column_pruning import prune_columns
+        from blaze_tpu.plan.fused import fuse_plan
+
+        node = fuse_plan(prune_columns(create_plan(plan)))
+        out = node.execute_collect().to_arrow()
+        if isinstance(out, pa.RecordBatch):
+            return pa.Table.from_batches([out])
+        return out
+
     def run_collect(self, plan: Dict[str, Any]) -> pa.Table:
         """Execute the whole DAG; returns the result stage's output."""
         from blaze_tpu.bridge.runtime import NativeExecutionRuntime
         from blaze_tpu.plan.proto_serde import task_definition_to_bytes
         from blaze_tpu.plan.types import schema_from_dict
 
+        from blaze_tpu import config
+        threshold = config.DAG_SINGLE_TASK_BYTES.get()
+        if threshold > 0 and self._scan_input_bytes(plan) <= threshold:
+            self.exec_mode = "local"
+            try:
+                return self._run_single_task(plan)
+            finally:
+                self.cleanup()  # the owned scratch dir lives on tmpfs
+
+        self.exec_mode = "staged"
         stages = self.split(plan)
         try:
             for st in stages[:-1]:

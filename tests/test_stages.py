@@ -22,6 +22,18 @@ def budget():
     MemManager.init(4 << 30)
 
 
+@pytest.fixture(autouse=True)
+def staged_path():
+    """These tests assert the STAGED wire machinery; disable the AQE
+    small-query local mode so tiny fixtures still split into stages."""
+    from blaze_tpu import config
+    config.conf.set(config.DAG_SINGLE_TASK_BYTES.key, 0)
+    try:
+        yield
+    finally:
+        config.conf.unset(config.DAG_SINGLE_TASK_BYTES.key)
+
+
 def _table_from(got: pa.Table) -> pd.DataFrame:
     return got.to_pandas() if got.num_rows else pd.DataFrame(
         {n: [] for n in got.schema.names})
