@@ -163,4 +163,20 @@ def get_host_bridge() -> Optional[ctypes.CDLL]:
         ctypes.c_int64, ctypes.POINTER(ctypes.c_char_p),
         ctypes.POINTER(ctypes.c_char_p)]
     lib.blaze_free_buffer.argtypes = [ctypes.c_void_p]
+    # Arrow C-Data zero-copy surface (include/arrow_abi.h); a stale .so
+    # from before the FFI symbols must degrade to the IPC path, not
+    # crash the loader (same policy as _load_kernel's AttributeError
+    # handling)
+    try:
+        lib.blaze_next_batch_ffi.restype = ctypes.c_int64
+        lib.blaze_next_batch_ffi.argtypes = [
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_char_p)]
+        lib.blaze_ffi_import_batch.restype = ctypes.c_int64
+        lib.blaze_ffi_import_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_char_p)]
+        lib.has_cdata_ffi = True
+    except AttributeError:
+        lib.has_cdata_ffi = False
     return lib

@@ -57,6 +57,41 @@ def next_batch(handle: int) -> Optional[bytes]:
     return sink.getvalue()
 
 
+def next_batch_ffi(handle: int, array_addr: int, schema_addr: int) -> int:
+    """Zero-copy batch handoff over the Arrow C-Data interface — the
+    importBatch path of the reference (AuronCallNativeWrapper.java:145
+    imports the FFI array the native side exported, exec.rs:122).  The
+    caller provides addresses of an ArrowArray and ArrowSchema struct;
+    the batch's buffers are exported WITHOUT serialization and stay
+    alive until the consumer invokes the structs' release callbacks.
+    Returns 1 when a batch was exported, 0 at end of stream."""
+    with _lock:
+        rt = _handles.get(handle)
+    if rt is None:
+        raise KeyError(f"invalid native handle {handle}")
+    rb = rt.next_batch()
+    if rb is None:
+        return 0
+    rb._export_to_c(array_addr, schema_addr)
+    return 1
+
+
+def ffi_import_batch(resource_id: str, array_addr: int,
+                     schema_addr: int) -> int:
+    """Host -> engine zero-copy: import one C-Data batch and append it
+    to the named resource consumed by `ffi_reader` plans (the
+    ConvertToNative / ArrowFFIExporter direction,
+    spark-extension ArrowFFIExporter.scala).  Returns rows imported."""
+    from blaze_tpu.bridge.resource import get_resource, put_resource
+    rb = pa.RecordBatch._import_from_c(array_addr, schema_addr)
+    existing = get_resource(resource_id)
+    if existing is None:
+        existing = []
+        put_resource(resource_id, existing)
+    existing.append(rb)
+    return rb.num_rows
+
+
 def finalize_native(handle: int) -> str:
     """Tear down; returns the metric tree as JSON (ref exec.rs:133 +
     metrics.rs:22)."""

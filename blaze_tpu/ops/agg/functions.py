@@ -511,6 +511,10 @@ def make_agg(name: str, children: Sequence[PhysicalExpr], **kw) -> AggFunction:
         return CollectAgg(children, distinct=False)
     if name == "collect_set":
         return CollectAgg(children, distinct=True)
+    if name == "brickhouse.collect":
+        # ref agg/brickhouse/collect.rs: delegates to AggCollectSet —
+        # the Hive brickhouse collect UDAF materialized as a set
+        return CollectAgg(children, distinct=True)
     if name in ("combine_unique", "brickhouse.combine_unique"):
         return CombineUniqueAgg(children)
     if name == "bloom_filter":

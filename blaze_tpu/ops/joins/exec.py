@@ -674,9 +674,12 @@ class BaseJoinExec(ExecutionPlan):
         if not (pa.types.is_integer(bk.type) and
                 pa.types.is_integer(pk.type)):
             return None
-        if pa.types.is_unsigned_integer(bk.type) and bk.type.bit_width \
-                == 64:
-            return None  # uint64 beyond int64 range would wrap
+        if any(pa.types.is_unsigned_integer(t) and t.bit_width == 64
+               for t in (bk.type, pk.type)):
+            # uint64 beyond int64 range wraps in the astype(int64)
+            # below; a wrapped PROBE value could silently false-match
+            # an in-range build key, so both sides are rejected
+            return None
         if build_tbl.num_rows > self._DIRECT_BUILD_MAX:
             return None
         bk = bk.combine_chunks() if isinstance(bk, pa.ChunkedArray) else bk
@@ -701,6 +704,8 @@ class BaseJoinExec(ExecutionPlan):
                 b = np.full(probe_tbl.num_rows, -1, np.int64)
                 match = np.zeros(probe_tbl.num_rows, bool)
             else:
+                # fast-path engagement stays observable on this branch
+                self.metrics.add("direct_join_rows", 0)
                 return pa.table(
                     [c.slice(0, 0) for c in probe_cols] +
                     ([] if semi_anti else

@@ -52,12 +52,40 @@ class OrcScanExec(ExecutionPlan):
                 if config.IGNORE_CORRUPTED_FILES.get():
                     continue
                 raise
-            table = f.read(columns=self._projection
-                           if not positional else None)
+            file_names = list(f.schema.names)
             if positional and self._projection is not None:
+                # hive-style positional evolution: physical names are
+                # ignored, the file's column AT THE DECLARED POSITION
+                # serves each projected column (ref orc_exec.rs
+                # force_positional_evolution).  Only the needed
+                # positions decode — column pruning survives.
                 idx = [self._file_schema.index_of(n)
                        for n in self._projection]
-                table = table.select(idx)
+                keep = [i for i in idx if i < len(file_names)]
+                table = (f.read(columns=[file_names[i] for i in keep])
+                         .rename_columns(
+                             [self._projection[k]
+                              for k, i in enumerate(idx)
+                              if i < len(file_names)])
+                         if keep else None)
+            else:
+                # by-name evolution: columns added to the table after
+                # this file was written are absent here — _align_schema
+                # below null-fills them (ref schema_adapter semantics)
+                present = (None if self._projection is None else
+                           [n for n in self._projection
+                            if n in set(file_names)])
+                table = (f.read(columns=present)
+                         if present is None or present else None)
+            if table is None:
+                # no projected column exists in this old file: the rows
+                # still exist — emit all-null rows (f.read(columns=[])
+                # would return ZERO rows and silently drop them)
+                table = pa.table(
+                    {n: pa.nulls(f.nrows,
+                                 self._schema.field(n).data_type
+                                 .to_arrow())
+                     for n in self._schema.names})
             for rb in table.to_batches(max_chunksize=self._batch_rows):
                 rb = _align_schema(rb, self._schema)
                 cb = ColumnBatch.from_arrow(rb)

@@ -197,6 +197,8 @@ _AGG_FN_DECODE = {
     pb.COLLECT_SET: "collect_set", pb.FIRST: "first",
     pb.FIRST_IGNORES_NULL: "first_ignores_null",
     pb.BLOOM_FILTER: "bloom_filter", pb.UDAF: "udaf",
+    pb.BRICKHOUSE_COLLECT: "brickhouse.collect",
+    pb.BRICKHOUSE_COMBINE_UNIQUE: "brickhouse.combine_unique",
 }
 _AGG_FN_ENCODE = {v: k for k, v in _AGG_FN_DECODE.items()}
 

@@ -72,6 +72,10 @@ def _native_pmod(flat_cols, tids, n_parts):
     lib = get_partition_kernel()
     if lib is None:
         return None
+    _SUPPORTED = ("bool", "int8", "int16", "int32", "date32", "int64",
+                  "timestamp_us", "decimal", "float32", "float64")
+    if any(tid not in _SUPPORTED for tid in tids):
+        return None  # pre-scan: don't convert columns only to bail
     modes = []
     datas = []      # keeps converted arrays alive across the call
     valid_nps = []

@@ -1,0 +1,189 @@
+"""Celeborn-shaped remote-shuffle-service backend.
+
+Parity: the reference ships concrete RSS integrations (Celeborn 0.5/0.6,
+Uniffle — /root/reference/thirdparty/auron-celeborn-0.5/, writers over
+`AuronRssPartitionWriterBase.write(partition, bytes)` pushed from
+native RssWriter, shuffle/rss.rs:21-45).  This module is the analogous
+concrete backend for this engine: a push-based shuffle client whose
+storage is any shared directory (NFS / FUSE / object-store mount),
+speaking the Celeborn protocol shape —
+
+  * map tasks PUSH partition-addressed byte frames as they are produced
+    (not a terminal .data file): `push(partition, payload)`;
+  * a push is ATOMIC and IDEMPOTENT (tmp-file + rename, addressed by
+    `(map, attempt, seq)`), so a task retry after a mid-push failure
+    re-sends frames without corrupting or duplicating data;
+  * `mapper_end` commits one attempt's manifest (per-partition frame
+    counts — Celeborn's MapperEnd/CommitFiles handshake).  Reducers
+    accept exactly ONE committed attempt per map (the FIRST to commit,
+    Celeborn's attempt-dedup) and read its frames in seq order;
+  * reducers block on the all-maps-committed barrier (MapStatus analog)
+    with a timeout, then stream each frame as an ipc_reader block.
+
+Wire integration: `client.partition_writer(map_id, attempt)` returns the
+`(partition, bytes) -> None` callable the planner's `rss_shuffle_writer`
+hook consumes (plan/planner.py `rss_resource_id`), and
+`client.reader_blocks(partition)` feeds `ipc_reader` resources — both
+ends ride the existing framed-IPC batch format unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Callable, Dict, List
+
+_FRAME = re.compile(r"^m(\d+)-a(\d+)-s(\d+)\.push$")
+
+
+class RssPushClient:
+    """One shuffle's client handle (map or reduce side)."""
+
+    def __init__(self, root: str, shuffle_id: str, num_maps: int,
+                 num_reduces: int):
+        self.root = os.path.join(root, f"rss-{shuffle_id}")
+        self.shuffle_id = shuffle_id
+        self.num_maps = num_maps
+        self.num_reduces = num_reduces
+        for p in range(num_reduces):
+            os.makedirs(os.path.join(self.root, f"part-{p}"),
+                        exist_ok=True)
+
+    # -- map side ----------------------------------------------------------
+
+    def partition_writer(self, map_id: int, attempt: int = 0
+                         ) -> "RssPartitionWriter":
+        return RssPartitionWriter(self, map_id, attempt)
+
+    def _push(self, map_id: int, attempt: int, partition: int,
+              seq: int, payload: bytes) -> None:
+        d = os.path.join(self.root, f"part-{partition}")
+        final = os.path.join(d, f"m{map_id}-a{attempt}-s{seq}.push")
+        if os.path.exists(final):
+            return  # idempotent retry of an already-landed frame
+        tmp = final + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, final)  # atomic publish
+
+    def _commit(self, map_id: int, attempt: int,
+                counts: Dict[int, int]) -> None:
+        """MapperEnd: publish the attempt manifest.  First committed
+        attempt per map wins; later attempts are ignored by readers."""
+        final = os.path.join(self.root, f"commit-m{map_id}")
+        tmp = final + f".tmp.a{attempt}.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"attempt": attempt,
+                       "counts": {str(k): v for k, v in counts.items()}},
+                      f)
+        if os.path.exists(final):
+            os.unlink(tmp)
+            return  # another attempt already committed: lose the race
+        try:
+            os.link(tmp, final)  # atomic first-wins where supported
+        except FileExistsError:
+            pass
+        except OSError:
+            # FUSE / object-store mounts often lack hard links: fall
+            # back to rename, which is atomic but LAST-wins — the
+            # exists() pre-check shrinks the race to concurrent commits
+            # of the same map's attempts, where either manifest is a
+            # complete, self-consistent attempt
+            os.replace(tmp, final)
+            return
+        os.unlink(tmp)
+
+    # -- reduce side -------------------------------------------------------
+
+    def wait_for_maps(self, timeout_s: float = 60.0,
+                      poll_s: float = 0.02) -> List[dict]:
+        """All-maps-committed barrier; returns each map's winning
+        manifest.  Raises TimeoutError naming the stragglers."""
+        deadline = time.monotonic() + timeout_s
+        manifests: List[dict] = [None] * self.num_maps  # type: ignore
+        while True:
+            missing = []
+            for m in range(self.num_maps):
+                if manifests[m] is not None:
+                    continue
+                path = os.path.join(self.root, f"commit-m{m}")
+                if os.path.exists(path):
+                    with open(path) as f:
+                        manifests[m] = json.load(f)
+                else:
+                    missing.append(m)
+            if not missing:
+                return manifests  # type: ignore[return-value]
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rss shuffle {self.shuffle_id}: maps {missing} "
+                    f"never committed within {timeout_s:g}s")
+            time.sleep(poll_s)
+
+    def reader_blocks(self, partition: int,
+                      timeout_s: float = 60.0) -> List[bytes]:
+        """One reduce partition's frames: only the committed attempt of
+        each map contributes, frames in push order, duplicates (from
+        re-pushed idempotent frames) collapse by seq."""
+        manifests = self.wait_for_maps(timeout_s)
+        d = os.path.join(self.root, f"part-{partition}")
+        by_map: Dict[int, Dict[int, str]] = {}
+        for name in os.listdir(d):
+            m = _FRAME.match(name)
+            if not m:
+                continue
+            map_id, attempt, seq = (int(m.group(1)), int(m.group(2)),
+                                    int(m.group(3)))
+            if attempt != manifests[map_id]["attempt"]:
+                continue  # uncommitted attempt's leftovers
+            by_map.setdefault(map_id, {})[seq] = os.path.join(d, name)
+        blocks: List[bytes] = []
+        for map_id in range(self.num_maps):
+            want = int(manifests[map_id]["counts"].get(str(partition), 0))
+            frames = by_map.get(map_id, {})
+            if len(frames) != want:
+                raise IOError(
+                    f"rss shuffle {self.shuffle_id} part {partition}: "
+                    f"map {map_id} committed {want} frames, found "
+                    f"{len(frames)} (lost pushes)")
+            for seq in sorted(frames):
+                with open(frames[seq], "rb") as f:
+                    blocks.append(f.read())
+        return blocks
+
+    def cleanup(self) -> None:
+        import shutil
+        shutil.rmtree(self.root, ignore_errors=True)
+
+
+class RssPartitionWriter:
+    """Per-task push handle: the `AuronRssPartitionWriterBase` analog.
+    Callable with `(partition, payload)` so it plugs straight into the
+    planner's `rss_shuffle_writer` resource hook."""
+
+    def __init__(self, client: RssPushClient, map_id: int, attempt: int):
+        self._client = client
+        self.map_id = map_id
+        self.attempt = attempt
+        self._seq: Dict[int, int] = {}
+        self._closed = False
+
+    def __call__(self, partition: int, payload: bytes) -> None:
+        self.write(partition, payload)
+
+    def write(self, partition: int, payload: bytes) -> None:
+        if self._closed:
+            raise RuntimeError("writer already committed")
+        if not payload:
+            return
+        seq = self._seq.get(partition, 0)
+        self._client._push(self.map_id, self.attempt, partition, seq,
+                           payload)
+        self._seq[partition] = seq + 1
+
+    def commit(self) -> None:
+        """MapperEnd: publishes this attempt's manifest."""
+        self._closed = True
+        self._client._commit(self.map_id, self.attempt, dict(self._seq))

@@ -1,0 +1,61 @@
+// Arrow C data interface ABI structs.
+//
+// These definitions are specified (and intended to be copied verbatim
+// into consumers) by the Arrow C data interface specification:
+// https://arrow.apache.org/docs/format/CDataInterface.html
+// The ABI is frozen; any Arrow implementation produces/consumes these
+// layouts, which is what makes the cross-runtime zero-copy handoff
+// possible (ref AuronCallNativeWrapper.java:145 importBatch /
+// native-engine/auron/src/rt.rs:253-286 export side).
+
+#ifndef BLAZE_ARROW_ABI_H
+#define BLAZE_ARROW_ABI_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define ARROW_FLAG_DICTIONARY_ORDERED 1
+#define ARROW_FLAG_NULLABLE 2
+#define ARROW_FLAG_MAP_KEYS_SORTED 4
+
+struct ArrowSchema {
+  // Array type description
+  const char* format;
+  const char* name;
+  const char* metadata;
+  int64_t flags;
+  int64_t n_children;
+  struct ArrowSchema** children;
+  struct ArrowSchema* dictionary;
+
+  // Release callback
+  void (*release)(struct ArrowSchema*);
+  // Opaque producer-specific data
+  void* private_data;
+};
+
+struct ArrowArray {
+  // Array data description
+  int64_t length;
+  int64_t null_count;
+  int64_t offset;
+  int64_t n_buffers;
+  int64_t n_children;
+  const void** buffers;
+  struct ArrowArray** children;
+  struct ArrowArray* dictionary;
+
+  // Release callback
+  void (*release)(struct ArrowArray*);
+  // Opaque producer-specific data
+  void* private_data;
+};
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // BLAZE_ARROW_ABI_H

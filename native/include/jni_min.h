@@ -1,0 +1,310 @@
+// Minimal JNI declarations for compiling the JniBridge shim without a JDK.
+//
+// The JNI Invocation API's C ABI is specified by the Java Native
+// Interface Specification (JNI 1.6+): JNIEnv is a pointer to a
+// JNINativeInterface function table whose entry ORDER is frozen.  This
+// header reproduces that table layout exactly — every slot present, in
+// specification order — giving real signatures only to the entries the
+// shim calls (the rest stay `void*`, which preserves layout because all
+// members are pointers).  Compiling against a real <jni.h> instead is a
+// drop-in switch: the declarations are ABI-identical.
+//
+// This is NOT a JVM implementation; it exists so the shim in
+// src/JniBridge.c is built and symbol-checked in CI on a JDK-less image.
+
+#ifndef BLAZE_JNI_MIN_H
+#define BLAZE_JNI_MIN_H
+
+#include <stdarg.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef uint8_t jboolean;
+typedef int8_t jbyte;
+typedef uint16_t jchar;
+typedef int16_t jshort;
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef float jfloat;
+typedef double jdouble;
+typedef jint jsize;
+
+typedef void* jobject;
+typedef jobject jclass;
+typedef jobject jstring;
+typedef jobject jarray;
+typedef jarray jbyteArray;
+typedef jobject jthrowable;
+typedef jobject jweak;
+typedef void* jmethodID;
+typedef void* jfieldID;
+
+typedef union jvalue {
+  jboolean z;
+  jbyte b;
+  jchar c;
+  jshort s;
+  jint i;
+  jlong j;
+  jfloat f;
+  jdouble d;
+  jobject l;
+} jvalue;
+
+#define JNI_FALSE 0
+#define JNI_TRUE 1
+#define JNI_OK 0
+#define JNI_ERR (-1)
+#define JNI_VERSION_1_6 0x00010006
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNICALL
+
+struct JNINativeInterface_;
+typedef const struct JNINativeInterface_* JNIEnv;
+
+// Entry order is the JNI specification's; do not reorder.
+struct JNINativeInterface_ {
+  void* reserved0;
+  void* reserved1;
+  void* reserved2;
+  void* reserved3;
+  jint (*GetVersion)(JNIEnv*);
+  void* DefineClass;
+  jclass (*FindClass)(JNIEnv*, const char*);
+  void* FromReflectedMethod;
+  void* FromReflectedField;
+  void* ToReflectedMethod;
+  void* GetSuperclass;
+  void* IsAssignableFrom;
+  void* ToReflectedField;
+  void* Throw;
+  jint (*ThrowNew)(JNIEnv*, jclass, const char*);
+  jthrowable (*ExceptionOccurred)(JNIEnv*);
+  void* ExceptionDescribe;
+  void (*ExceptionClear)(JNIEnv*);
+  void* FatalError;
+  void* PushLocalFrame;
+  void* PopLocalFrame;
+  jobject (*NewGlobalRef)(JNIEnv*, jobject);
+  void (*DeleteGlobalRef)(JNIEnv*, jobject);
+  void (*DeleteLocalRef)(JNIEnv*, jobject);
+  void* IsSameObject;
+  void* NewLocalRef;
+  void* EnsureLocalCapacity;
+  void* AllocObject;
+  void* NewObject;
+  void* NewObjectV;
+  void* NewObjectA;
+  jclass (*GetObjectClass)(JNIEnv*, jobject);
+  void* IsInstanceOf;
+  jmethodID (*GetMethodID)(JNIEnv*, jclass, const char*, const char*);
+  jobject (*CallObjectMethod)(JNIEnv*, jobject, jmethodID, ...);
+  void* CallObjectMethodV;
+  void* CallObjectMethodA;
+  jboolean (*CallBooleanMethod)(JNIEnv*, jobject, jmethodID, ...);
+  void* CallBooleanMethodV;
+  void* CallBooleanMethodA;
+  void* CallByteMethod;
+  void* CallByteMethodV;
+  void* CallByteMethodA;
+  void* CallCharMethod;
+  void* CallCharMethodV;
+  void* CallCharMethodA;
+  void* CallShortMethod;
+  void* CallShortMethodV;
+  void* CallShortMethodA;
+  void* CallIntMethod;
+  void* CallIntMethodV;
+  void* CallIntMethodA;
+  jlong (*CallLongMethod)(JNIEnv*, jobject, jmethodID, ...);
+  void* CallLongMethodV;
+  void* CallLongMethodA;
+  void* CallFloatMethod;
+  void* CallFloatMethodV;
+  void* CallFloatMethodA;
+  void* CallDoubleMethod;
+  void* CallDoubleMethodV;
+  void* CallDoubleMethodA;
+  void (*CallVoidMethod)(JNIEnv*, jobject, jmethodID, ...);
+  void* CallVoidMethodV;
+  void* CallVoidMethodA;
+  void* CallNonvirtualObjectMethod;
+  void* CallNonvirtualObjectMethodV;
+  void* CallNonvirtualObjectMethodA;
+  void* CallNonvirtualBooleanMethod;
+  void* CallNonvirtualBooleanMethodV;
+  void* CallNonvirtualBooleanMethodA;
+  void* CallNonvirtualByteMethod;
+  void* CallNonvirtualByteMethodV;
+  void* CallNonvirtualByteMethodA;
+  void* CallNonvirtualCharMethod;
+  void* CallNonvirtualCharMethodV;
+  void* CallNonvirtualCharMethodA;
+  void* CallNonvirtualShortMethod;
+  void* CallNonvirtualShortMethodV;
+  void* CallNonvirtualShortMethodA;
+  void* CallNonvirtualIntMethod;
+  void* CallNonvirtualIntMethodV;
+  void* CallNonvirtualIntMethodA;
+  void* CallNonvirtualLongMethod;
+  void* CallNonvirtualLongMethodV;
+  void* CallNonvirtualLongMethodA;
+  void* CallNonvirtualFloatMethod;
+  void* CallNonvirtualFloatMethodV;
+  void* CallNonvirtualFloatMethodA;
+  void* CallNonvirtualDoubleMethod;
+  void* CallNonvirtualDoubleMethodV;
+  void* CallNonvirtualDoubleMethodA;
+  void* CallNonvirtualVoidMethod;
+  void* CallNonvirtualVoidMethodV;
+  void* CallNonvirtualVoidMethodA;
+  void* GetFieldID;
+  void* GetObjectField;
+  void* GetBooleanField;
+  void* GetByteField;
+  void* GetCharField;
+  void* GetShortField;
+  void* GetIntField;
+  void* GetLongField;
+  void* GetFloatField;
+  void* GetDoubleField;
+  void* SetObjectField;
+  void* SetBooleanField;
+  void* SetByteField;
+  void* SetCharField;
+  void* SetShortField;
+  void* SetIntField;
+  void* SetLongField;
+  void* SetFloatField;
+  void* SetDoubleField;
+  void* GetStaticMethodID;
+  void* CallStaticObjectMethod;
+  void* CallStaticObjectMethodV;
+  void* CallStaticObjectMethodA;
+  void* CallStaticBooleanMethod;
+  void* CallStaticBooleanMethodV;
+  void* CallStaticBooleanMethodA;
+  void* CallStaticByteMethod;
+  void* CallStaticByteMethodV;
+  void* CallStaticByteMethodA;
+  void* CallStaticCharMethod;
+  void* CallStaticCharMethodV;
+  void* CallStaticCharMethodA;
+  void* CallStaticShortMethod;
+  void* CallStaticShortMethodV;
+  void* CallStaticShortMethodA;
+  void* CallStaticIntMethod;
+  void* CallStaticIntMethodV;
+  void* CallStaticIntMethodA;
+  void* CallStaticLongMethod;
+  void* CallStaticLongMethodV;
+  void* CallStaticLongMethodA;
+  void* CallStaticFloatMethod;
+  void* CallStaticFloatMethodV;
+  void* CallStaticFloatMethodA;
+  void* CallStaticDoubleMethod;
+  void* CallStaticDoubleMethodV;
+  void* CallStaticDoubleMethodA;
+  void* CallStaticVoidMethod;
+  void* CallStaticVoidMethodV;
+  void* CallStaticVoidMethodA;
+  void* GetStaticFieldID;
+  void* GetStaticObjectField;
+  void* GetStaticBooleanField;
+  void* GetStaticByteField;
+  void* GetStaticCharField;
+  void* GetStaticShortField;
+  void* GetStaticIntField;
+  void* GetStaticLongField;
+  void* GetStaticFloatField;
+  void* GetStaticDoubleField;
+  void* SetStaticObjectField;
+  void* SetStaticBooleanField;
+  void* SetStaticByteField;
+  void* SetStaticCharField;
+  void* SetStaticShortField;
+  void* SetStaticIntField;
+  void* SetStaticLongField;
+  void* SetStaticFloatField;
+  void* SetStaticDoubleField;
+  void* NewString;
+  void* GetStringLength;
+  void* GetStringChars;
+  void* ReleaseStringChars;
+  jstring (*NewStringUTF)(JNIEnv*, const char*);
+  void* GetStringUTFLength;
+  const char* (*GetStringUTFChars)(JNIEnv*, jstring, jboolean*);
+  void (*ReleaseStringUTFChars)(JNIEnv*, jstring, const char*);
+  jsize (*GetArrayLength)(JNIEnv*, jarray);
+  void* NewObjectArray;
+  void* GetObjectArrayElement;
+  void* SetObjectArrayElement;
+  void* NewBooleanArray;
+  jbyteArray (*NewByteArray)(JNIEnv*, jsize);
+  void* NewCharArray;
+  void* NewShortArray;
+  void* NewIntArray;
+  void* NewLongArray;
+  void* NewFloatArray;
+  void* NewDoubleArray;
+  void* GetBooleanArrayElements;
+  jbyte* (*GetByteArrayElements)(JNIEnv*, jbyteArray, jboolean*);
+  void* GetCharArrayElements;
+  void* GetShortArrayElements;
+  void* GetIntArrayElements;
+  void* GetLongArrayElements;
+  void* GetFloatArrayElements;
+  void* GetDoubleArrayElements;
+  void* ReleaseBooleanArrayElements;
+  void (*ReleaseByteArrayElements)(JNIEnv*, jbyteArray, jbyte*, jint);
+  void* ReleaseCharArrayElements;
+  void* ReleaseShortArrayElements;
+  void* ReleaseIntArrayElements;
+  void* ReleaseLongArrayElements;
+  void* ReleaseFloatArrayElements;
+  void* ReleaseDoubleArrayElements;
+  void* GetBooleanArrayRegion;
+  void (*GetByteArrayRegion)(JNIEnv*, jbyteArray, jsize, jsize, jbyte*);
+  void* GetCharArrayRegion;
+  void* GetShortArrayRegion;
+  void* GetIntArrayRegion;
+  void* GetLongArrayRegion;
+  void* GetFloatArrayRegion;
+  void* GetDoubleArrayRegion;
+  void* SetBooleanArrayRegion;
+  void (*SetByteArrayRegion)(JNIEnv*, jbyteArray, jsize, jsize,
+                             const jbyte*);
+  void* SetCharArrayRegion;
+  void* SetShortArrayRegion;
+  void* SetIntArrayRegion;
+  void* SetLongArrayRegion;
+  void* SetFloatArrayRegion;
+  void* SetDoubleArrayRegion;
+  void* RegisterNatives;
+  void* UnregisterNatives;
+  void* MonitorEnter;
+  void* MonitorExit;
+  void* GetJavaVM;
+  void* GetStringRegion;
+  void* GetStringUTFRegion;
+  void* GetPrimitiveArrayCritical;
+  void* ReleasePrimitiveArrayCritical;
+  void* GetStringCritical;
+  void* ReleaseStringCritical;
+  void* NewWeakGlobalRef;
+  void* DeleteWeakGlobalRef;
+  jboolean (*ExceptionCheck)(JNIEnv*);
+  void* NewDirectByteBuffer;
+  void* GetDirectBufferAddress;
+  void* GetDirectBufferCapacity;
+  void* GetObjectRefType;
+};
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // BLAZE_JNI_MIN_H

@@ -1,0 +1,220 @@
+// JNI shim: org.apache.auron.jni.JniBridge natives over the C ABI.
+//
+// Maps the reference's four JNI entry points
+// (auron-core/.../jni/JniBridge.java:49-55)
+//
+//   long    callNative(long initNativeMemory, String logLevel,
+//                      AuronCallNativeWrapper wrapper)
+//   boolean nextBatch(long ptr)
+//   void    finalizeNative(long ptr)
+//   void    onExit()
+//
+// onto host_bridge.cpp's C ABI (blaze_call_native_proto /
+// blaze_next_batch_ffi / blaze_finalize_native / blaze_on_exit), with
+// the same callback choreography the reference's exec.rs performs
+// against AuronCallNativeWrapper: the task definition is pulled from
+// wrapper.getRawTaskDefinition() (byte[] protobuf TaskDefinition,
+// AuronCallNativeWrapper.java:170), batches flow back zero-copy over
+// the Arrow C-Data interface through wrapper.importSchema(long) once
+// and wrapper.importBatch(long) per batch
+// (AuronCallNativeWrapper.java:135-157).
+//
+// Built against include/jni_min.h (ABI-identical declarations) so the
+// shim compiles and links on a JDK-less image; swap in a real <jni.h>
+// to build inside a JDK toolchain unchanged.
+
+#include <pthread.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../include/arrow_abi.h"
+#include "../include/jni_min.h"
+
+// ---- host_bridge.cpp C ABI -------------------------------------------------
+extern int64_t blaze_call_native_proto(const uint8_t* td, int64_t len,
+                                       char** err);
+extern int64_t blaze_next_batch_ffi(int64_t handle, void* out_array,
+                                    void* out_schema, char** err);
+extern int64_t blaze_finalize_native(int64_t handle, char** metrics_json,
+                                     char** err);
+extern void blaze_free_buffer(void* p);
+extern void blaze_on_exit(void);
+
+// ---- per-task state --------------------------------------------------------
+
+typedef struct TaskState {
+  int64_t engine_handle;
+  jobject wrapper;        // global ref to the AuronCallNativeWrapper
+  int schema_imported;
+  struct TaskState* next;
+} TaskState;
+
+static TaskState* g_tasks = NULL;
+// JNI natives run concurrently on executor task threads
+static pthread_mutex_t g_tasks_mu = PTHREAD_MUTEX_INITIALIZER;
+
+static void throw_runtime(JNIEnv* env, const char* msg) {
+  jclass cls = (*env)->FindClass(env, "java/lang/RuntimeException");
+  if (cls != NULL) {
+    (*env)->ThrowNew(env, cls, msg != NULL ? msg : "native error");
+  }
+}
+
+static void throw_and_free(JNIEnv* env, char* err) {
+  throw_runtime(env, err);
+  if (err != NULL) {
+    blaze_free_buffer(err);
+  }
+}
+
+JNIEXPORT jlong JNICALL Java_org_apache_auron_jni_JniBridge_callNative(
+    JNIEnv* env, jclass clazz, jlong init_native_memory, jstring log_level,
+    jobject wrapper) {
+  (void)clazz;
+  (void)init_native_memory;  // the engine sizes memory via conf callbacks
+  (void)log_level;
+  jclass wcls = (*env)->GetObjectClass(env, wrapper);
+  jmethodID get_td = (*env)->GetMethodID(env, wcls,
+                                         "getRawTaskDefinition", "()[B");
+  if (get_td == NULL) {
+    return 0;  // pending NoSuchMethodError
+  }
+  jbyteArray td = (jbyteArray)(*env)->CallObjectMethod(env, wrapper,
+                                                       get_td);
+  if ((*env)->ExceptionCheck(env) || td == NULL) {
+    return 0;
+  }
+  jsize len = (*env)->GetArrayLength(env, td);
+  jbyte* bytes = (*env)->GetByteArrayElements(env, td, NULL);
+  char* err = NULL;
+  int64_t handle = blaze_call_native_proto((const uint8_t*)bytes,
+                                           (int64_t)len, &err);
+  (*env)->ReleaseByteArrayElements(env, td, bytes, 0);
+  if (handle == 0) {
+    throw_and_free(env, err);
+    return 0;
+  }
+  TaskState* st = (TaskState*)malloc(sizeof(TaskState));
+  st->engine_handle = handle;
+  st->wrapper = (*env)->NewGlobalRef(env, wrapper);
+  st->schema_imported = 0;
+  pthread_mutex_lock(&g_tasks_mu);
+  st->next = g_tasks;
+  g_tasks = st;
+  pthread_mutex_unlock(&g_tasks_mu);
+  return (jlong)(intptr_t)st;
+}
+
+JNIEXPORT jboolean JNICALL Java_org_apache_auron_jni_JniBridge_nextBatch(
+    JNIEnv* env, jclass clazz, jlong ptr) {
+  (void)clazz;
+  TaskState* st = (TaskState*)(intptr_t)ptr;
+  if (st == NULL) {
+    return JNI_FALSE;
+  }
+  // heap-allocated: the wrapper's ArrowArray.wrap(ptr)/close() owns and
+  // releases the structs' CONTENTS; the shells are freed here
+  struct ArrowArray* arr =
+      (struct ArrowArray*)calloc(1, sizeof(struct ArrowArray));
+  struct ArrowSchema* sch =
+      (struct ArrowSchema*)calloc(1, sizeof(struct ArrowSchema));
+  char* err = NULL;
+  int64_t got = blaze_next_batch_ffi(st->engine_handle, arr, sch, &err);
+  if (got < 0) {
+    free(arr);
+    free(sch);
+    throw_and_free(env, err);
+    return JNI_FALSE;
+  }
+  if (got == 0) {
+    free(arr);
+    free(sch);
+    return JNI_FALSE;
+  }
+  jclass wcls = (*env)->GetObjectClass(env, st->wrapper);
+  if (!st->schema_imported) {
+    jmethodID import_schema = (*env)->GetMethodID(env, wcls,
+                                                  "importSchema", "(J)V");
+    if (import_schema == NULL) {
+      goto fail;
+    }
+    // ownership of the schema contents transfers to the wrapper
+    (*env)->CallVoidMethod(env, st->wrapper, import_schema,
+                           (jlong)(intptr_t)sch);
+    if ((*env)->ExceptionCheck(env)) {
+      // JNI forbids further calls with an exception pending; the
+      // wrapper took the schema CONTENTS, the shell is still ours
+      free(sch);
+      sch = NULL;
+      goto fail;
+    }
+    st->schema_imported = 1;
+  } else if (sch->release != NULL) {
+    sch->release(sch);  // per-batch re-export of an already-known schema
+  }
+  free(sch);
+  sch = NULL;
+  {
+    jmethodID import_batch = (*env)->GetMethodID(env, wcls,
+                                                 "importBatch", "(J)V");
+    if (import_batch == NULL) {
+      goto fail;
+    }
+    (*env)->CallVoidMethod(env, st->wrapper, import_batch,
+                           (jlong)(intptr_t)arr);
+    if ((*env)->ExceptionCheck(env)) {
+      free(arr);  // contents released by the wrapper's wrap/close
+      arr = NULL;
+      goto fail;
+    }
+  }
+  free(arr);
+  return JNI_TRUE;
+
+fail:
+  if (arr != NULL && arr->release != NULL) {
+    arr->release(arr);
+  }
+  if (sch != NULL && sch->release != NULL) {
+    sch->release(sch);
+  }
+  free(arr);
+  free(sch);
+  return JNI_FALSE;
+}
+
+JNIEXPORT void JNICALL Java_org_apache_auron_jni_JniBridge_finalizeNative(
+    JNIEnv* env, jclass clazz, jlong ptr) {
+  (void)clazz;
+  TaskState* st = (TaskState*)(intptr_t)ptr;
+  if (st == NULL) {
+    return;
+  }
+  char* metrics = NULL;
+  char* err = NULL;
+  if (blaze_finalize_native(st->engine_handle, &metrics, &err) != 0) {
+    throw_and_free(env, err);
+  }
+  if (metrics != NULL) {
+    blaze_free_buffer(metrics);  // the wrapper pulls metrics host-side
+  }
+  // unlink
+  pthread_mutex_lock(&g_tasks_mu);
+  TaskState** cur = &g_tasks;
+  while (*cur != NULL && *cur != st) {
+    cur = &(*cur)->next;
+  }
+  if (*cur == st) {
+    *cur = st->next;
+  }
+  pthread_mutex_unlock(&g_tasks_mu);
+  (*env)->DeleteGlobalRef(env, st->wrapper);
+  free(st);
+}
+
+JNIEXPORT void JNICALL Java_org_apache_auron_jni_JniBridge_onExit(
+    JNIEnv* env, jclass clazz) {
+  (void)env;
+  (void)clazz;
+  blaze_on_exit();
+}

@@ -263,6 +263,65 @@ int64_t blaze_next_batch(int64_t handle, uint8_t** data, char** err) {
   return (int64_t)len;
 }
 
+// Next batch over the Arrow C-Data interface: ZERO-COPY — the engine
+// exports the batch's live buffers into caller-provided ArrowArray /
+// ArrowSchema structs (include/arrow_abi.h); no IPC serialization.
+// The caller owns the structs' memory and MUST invoke their release
+// callbacks when done (standard C-Data contract).  This is the
+// importBatch handoff of the reference (AuronCallNativeWrapper.java:145,
+// rt.rs:253-286).  Returns 1 = batch exported, 0 = end-of-stream,
+// -1 = error (*err set).
+int64_t blaze_next_batch_ffi(int64_t handle, void* out_array,
+                             void* out_schema, char** err) {
+  Gil gil;
+  PyObject* mod = bridge_module();
+  if (!mod) {
+    *err = dup_cstr(fetch_error());
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(mod, "next_batch_ffi", "LLL",
+                                    (long long)handle,
+                                    (long long)(intptr_t)out_array,
+                                    (long long)(intptr_t)out_schema);
+  Py_DECREF(mod);
+  if (!r) {
+    *err = dup_cstr(fetch_error());
+    return -1;
+  }
+  int64_t got = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return got;
+}
+
+// Host -> engine zero-copy: import one C-Data batch into the named
+// engine resource (consumed by ffi_reader plans — the row-to-columnar
+// ConvertToNative / ArrowFFIExporter direction).  The engine takes
+// ownership of the structs' contents (their release callbacks fire when
+// the imported batch is garbage-collected).  Returns rows imported,
+// -1 on error.
+int64_t blaze_ffi_import_batch(const char* resource_id, void* array,
+                               void* schema, char** err) {
+  ensure_python();
+  Gil gil;
+  PyObject* mod = bridge_module();
+  if (!mod) {
+    *err = dup_cstr(fetch_error());
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(mod, "ffi_import_batch", "sLL",
+                                    resource_id,
+                                    (long long)(intptr_t)array,
+                                    (long long)(intptr_t)schema);
+  Py_DECREF(mod);
+  if (!r) {
+    *err = dup_cstr(fetch_error());
+    return -1;
+  }
+  int64_t rows = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return rows;
+}
+
 // Tear down the task runtime; returns 0 and sets *metrics_json to the
 // metric tree (ref metrics.rs:22 update_metric_node push-on-finalize).
 int64_t blaze_finalize_native(int64_t handle, char** metrics_json,

@@ -287,3 +287,14 @@ def test_combine_unique_flattens_arrays():
         [b.compact().to_arrow() for b in plan.execute(0)]).to_pandas()
     got = {int(r.g): sorted(r.u) for r in out.itertuples()}
     assert got == {1: [1, 2, 3], 2: [5]}
+
+
+def test_brickhouse_collect_maps_to_collect_set():
+    """ref agg/brickhouse/collect.rs delegates to AggCollectSet; enum
+    1000 decodes through the wire (proto AggFunction.BRICKHOUSE_COLLECT)."""
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops.agg.functions import CollectAgg, make_agg
+    fn = make_agg("brickhouse.collect", [col(0)])
+    assert isinstance(fn, CollectAgg) and fn.name == "collect_set"
+    from blaze_tpu.plan.proto_serde import _AGG_FN_DECODE, pb
+    assert _AGG_FN_DECODE[pb.BRICKHOUSE_COLLECT] == "brickhouse.collect"

@@ -275,3 +275,91 @@ def test_sort_multibatch_string_keys_merge(tmp_path):
         MemManager.init(4 << 30)
     out = got["s"].to_pylist()
     assert out == sorted(vals, key=lambda s: s.encode())
+
+
+class TestOrcSchemaEvolution:
+    """ORC schema-evolution vectors (ref orc_exec.rs evolution confs:
+    `auron.orc.force.positional.evolution` + by-name matching against
+    files whose physical schema drifted from the table schema)."""
+
+    def _write(self, tmp_path, name, tbl):
+        from pyarrow import orc
+        path = str(tmp_path / name)
+        orc.write_table(tbl, path)
+        return path
+
+    def test_by_name_ignores_column_order(self, tmp_path):
+        import pyarrow as pa
+        from blaze_tpu.ops.orc import OrcScanExec
+        # file columns physically reordered vs the declared schema
+        declared = pa.table({"a": pa.array([1, 2, 3]),
+                             "b": pa.array([1.5, 2.5, 3.5])})
+        drifted = pa.table({"b": pa.array([1.5, 2.5, 3.5]),
+                            "a": pa.array([1, 2, 3])})
+        path = self._write(tmp_path, "drift.orc", drifted)
+        scan = OrcScanExec(S.Schema.from_arrow(declared.schema), [[path]],
+                           projection=["a", "b"])
+        got = scan.execute_collect().to_arrow()
+        assert got.column("a").to_pylist() == [1, 2, 3]
+        assert got.column("b").to_pylist() == [1.5, 2.5, 3.5]
+
+    def test_positional_evolution_matches_by_index(self, tmp_path):
+        import pyarrow as pa
+        from blaze_tpu import config
+        from blaze_tpu.ops.orc import OrcScanExec
+        # hive-style rename: physical names differ, positions agree
+        declared = pa.table({"a": pa.array([7, 8]),
+                             "b": pa.array([0.5, 1.5])})
+        renamed = pa.table({"_col0": pa.array([7, 8]),
+                            "_col1": pa.array([0.5, 1.5])})
+        path = self._write(tmp_path, "renamed.orc", renamed)
+        config.conf.set(config.ORC_FORCE_POSITIONAL_EVOLUTION.key, True)
+        try:
+            scan = OrcScanExec(S.Schema.from_arrow(declared.schema),
+                               [[path]], projection=["a", "b"])
+            got = scan.execute_collect().to_arrow()
+        finally:
+            config.conf.unset(config.ORC_FORCE_POSITIONAL_EVOLUTION.key)
+        assert got.schema.names == ["a", "b"]
+        assert got.column("a").to_pylist() == [7, 8]
+        assert got.column("b").to_pylist() == [0.5, 1.5]
+
+    def test_added_column_missing_in_old_file(self, tmp_path):
+        import pyarrow as pa
+        from blaze_tpu.ops.orc import OrcScanExec
+        # table evolved: column c added after the file was written
+        old = pa.table({"a": pa.array([1, 2])})
+        declared = pa.table({"a": pa.array([1, 2]),
+                             "c": pa.array([None, None],
+                                           type=pa.int64())})
+        path = self._write(tmp_path, "old.orc", old)
+        scan = OrcScanExec(S.Schema.from_arrow(declared.schema), [[path]],
+                           projection=["a", "c"])
+        got = scan.execute_collect().to_arrow()
+        assert got.column("a").to_pylist() == [1, 2]
+        assert got.column("c").null_count == 2
+
+    def test_widened_int_type(self, tmp_path):
+        import pyarrow as pa
+        from blaze_tpu.ops.orc import OrcScanExec
+        # int32 file column read under an int64 table schema
+        old = pa.table({"a": pa.array([5, 6], type=pa.int32())})
+        declared = pa.schema([("a", pa.int64())])
+        path = self._write(tmp_path, "narrow.orc", old)
+        scan = OrcScanExec(S.Schema.from_arrow(declared), [[path]],
+                           projection=["a"])
+        got = scan.execute_collect().to_arrow()
+        assert got.schema.field("a").type == pa.int64()
+        assert got.column("a").to_pylist() == [5, 6]
+
+    def test_no_projected_column_in_file_yields_null_rows(self, tmp_path):
+        import pyarrow as pa
+        from blaze_tpu.ops.orc import OrcScanExec
+        old = pa.table({"a": pa.array([1, 2, 3])})
+        declared = pa.schema([("a", pa.int64()), ("c", pa.int64())])
+        path = self._write(tmp_path, "noproj.orc", old)
+        scan = OrcScanExec(S.Schema.from_arrow(declared), [[path]],
+                           projection=["c"])
+        got = scan.execute_collect().to_arrow()
+        assert got.num_rows == 3          # rows survive
+        assert got.column("c").null_count == 3

@@ -146,3 +146,110 @@ def test_host_bridge_c_abi_end_to_end():
     assert rc == 0
     md = json.loads(metrics.value.decode())
     assert "name" in md
+
+
+def test_host_bridge_c_data_ffi_roundtrip():
+    """Zero-copy Arrow C-Data handoff through the .so (VERDICT r4 #5):
+    blaze_next_batch_ffi exports each batch into caller structs; pyarrow
+    imports them back; contents must match the IPC path bit-for-bit."""
+    from blaze_tpu.bridge.native import get_host_bridge
+    lib = get_host_bridge()
+    if lib is None:
+        pytest.skip("host bridge lib unavailable")
+    t = pa.table({"a": pa.array(range(257)),
+                  "b": pa.array([float(i) / 7 for i in range(257)])})
+    put_resource("ffi_rt", t)
+    ir = {"kind": "filter",
+          "predicates": [{"kind": "binary", "op": ">",
+                          "l": {"kind": "column", "index": 0},
+                          "r": {"kind": "literal", "value": 56,
+                                "type": {"id": "int64"}}}],
+          "input": _scan_ir("ffi_rt", t)}
+    err = ctypes.c_char_p()
+    handle = lib.blaze_call_native(
+        json.dumps(_task_def(ir)).encode(), ctypes.byref(err))
+    assert handle, err.value
+
+    class _ArrowArray(ctypes.Structure):
+        _fields_ = [("length", ctypes.c_int64),
+                    ("null_count", ctypes.c_int64),
+                    ("offset", ctypes.c_int64),
+                    ("n_buffers", ctypes.c_int64),
+                    ("n_children", ctypes.c_int64),
+                    ("buffers", ctypes.c_void_p),
+                    ("children", ctypes.c_void_p),
+                    ("dictionary", ctypes.c_void_p),
+                    ("release", ctypes.c_void_p),
+                    ("private_data", ctypes.c_void_p)]
+
+    class _ArrowSchema(ctypes.Structure):
+        _fields_ = [("format", ctypes.c_char_p),
+                    ("name", ctypes.c_char_p),
+                    ("metadata", ctypes.c_void_p),
+                    ("flags", ctypes.c_int64),
+                    ("n_children", ctypes.c_int64),
+                    ("children", ctypes.c_void_p),
+                    ("dictionary", ctypes.c_void_p),
+                    ("release", ctypes.c_void_p),
+                    ("private_data", ctypes.c_void_p)]
+
+    got = []
+    while True:
+        arr = _ArrowArray()
+        sch = _ArrowSchema()
+        r = lib.blaze_next_batch_ffi(handle, ctypes.byref(arr),
+                                     ctypes.byref(sch), ctypes.byref(err))
+        assert r >= 0, err.value
+        if r == 0:
+            break
+        rb = pa.RecordBatch._import_from_c(ctypes.addressof(arr),
+                                           ctypes.addressof(sch))
+        got.append(rb)
+    metrics = ctypes.c_char_p()
+    assert lib.blaze_finalize_native(handle, ctypes.byref(metrics),
+                                     ctypes.byref(err)) == 0
+    out = pa.Table.from_batches(got)
+    want = t.filter(pa.compute.greater(t["a"], 56))
+    assert out.num_rows == want.num_rows == 200
+    assert out.column("a").to_pylist() == want.column("a").to_pylist()
+    assert out.column("b").to_pylist() == want.column("b").to_pylist()
+
+
+def test_host_bridge_ffi_import_batch():
+    """Host -> engine C-Data import feeding an ffi_reader plan."""
+    from blaze_tpu.bridge.native import get_host_bridge
+    lib = get_host_bridge()
+    if lib is None:
+        pytest.skip("host bridge lib unavailable")
+    rb = pa.record_batch({"x": pa.array([1, 2, 3], pa.int64())})
+    # export from pyarrow, hand the struct addresses through the C ABI
+    from pyarrow.cffi import ffi as _f  # structs via pyarrow's own cffi
+    arr = _f.new("struct ArrowArray*")
+    sch = _f.new("struct ArrowSchema*")
+    rb._export_to_c(int(_f.cast("uintptr_t", arr)),
+                    int(_f.cast("uintptr_t", sch)))
+    err = ctypes.c_char_p()
+    rows = lib.blaze_ffi_import_batch(
+        b"ffi-import-test", ctypes.c_void_p(int(_f.cast("uintptr_t", arr))),
+        ctypes.c_void_p(int(_f.cast("uintptr_t", sch))), ctypes.byref(err))
+    assert rows == 3, err.value
+    from blaze_tpu.bridge.resource import get_resource
+    batches = get_resource("ffi-import-test")
+    assert batches and batches[0].column(0).to_pylist() == [1, 2, 3]
+
+
+def test_jni_bridge_symbols_and_layout():
+    """The JNI shim must export the reference's four natives
+    (JniBridge.java:49-55) and link against the host bridge."""
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    so = os.path.join(root, "native", "build", "libblaze_jni_bridge.so")
+    if not os.path.exists(so):
+        pytest.skip("jni shim not built")
+    out = subprocess.run(["nm", "-D", so], capture_output=True,
+                         text=True).stdout
+    for sym in ("Java_org_apache_auron_jni_JniBridge_callNative",
+                "Java_org_apache_auron_jni_JniBridge_nextBatch",
+                "Java_org_apache_auron_jni_JniBridge_finalizeNative",
+                "Java_org_apache_auron_jni_JniBridge_onExit"):
+        assert sym in out, sym
