@@ -180,3 +180,61 @@ def get_host_bridge() -> Optional[ctypes.CDLL]:
     except AttributeError:
         lib.has_cdata_ffi = False
     return lib
+
+
+class ArrowArrayStruct(ctypes.Structure):
+    """Arrow C-Data ArrowArray (arrow_abi.h), for in-process FFI pulls."""
+    _fields_ = [("length", ctypes.c_int64), ("null_count", ctypes.c_int64),
+                ("offset", ctypes.c_int64), ("n_buffers", ctypes.c_int64),
+                ("n_children", ctypes.c_int64), ("buffers", ctypes.c_void_p),
+                ("children", ctypes.c_void_p),
+                ("dictionary", ctypes.c_void_p),
+                ("release", ctypes.c_void_p),
+                ("private_data", ctypes.c_void_p)]
+
+
+class ArrowSchemaStruct(ctypes.Structure):
+    _fields_ = [("format", ctypes.c_char_p), ("name", ctypes.c_char_p),
+                ("metadata", ctypes.c_void_p), ("flags", ctypes.c_int64),
+                ("n_children", ctypes.c_int64),
+                ("children", ctypes.c_void_p),
+                ("dictionary", ctypes.c_void_p),
+                ("release", ctypes.c_void_p),
+                ("private_data", ctypes.c_void_p)]
+
+
+def bridge_pull_batch(lib: ctypes.CDLL, handle: int):
+    """Pull one batch from a host-bridge task handle as a pyarrow
+    RecordBatch (None = end of stream).
+
+    Prefers the zero-copy Arrow C-Data path; a stale .so without the FFI
+    symbols (has_cdata_ffi False) degrades to the IPC-bytes path — the
+    documented fallback policy, enforced here rather than at every call
+    site."""
+    import pyarrow as pa
+    err = ctypes.c_char_p()
+    if getattr(lib, "has_cdata_ffi", False):
+        arr = ArrowArrayStruct()
+        schema = ArrowSchemaStruct()
+        r = lib.blaze_next_batch_ffi(handle, ctypes.byref(arr),
+                                     ctypes.byref(schema),
+                                     ctypes.byref(err))
+        if r < 0:
+            raise RuntimeError((err.value or b"ffi pull failed").decode())
+        if r == 0:
+            return None
+        return pa.RecordBatch._import_from_c(ctypes.addressof(arr),
+                                             ctypes.addressof(schema))
+    buf = ctypes.POINTER(ctypes.c_uint8)()
+    n = lib.blaze_next_batch(handle, ctypes.byref(buf), ctypes.byref(err))
+    if n < 0:
+        raise RuntimeError((err.value or b"pull failed").decode())
+    if n == 0:
+        return None
+    try:
+        data = ctypes.string_at(buf, n)
+    finally:
+        lib.blaze_free_buffer(buf)
+    with pa.ipc.open_stream(data) as rd:
+        batches = list(rd)
+    return batches[0] if batches else None

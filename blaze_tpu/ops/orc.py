@@ -61,13 +61,18 @@ class OrcScanExec(ExecutionPlan):
                 # positions decode — column pruning survives.
                 idx = [self._file_schema.index_of(n)
                        for n in self._projection]
-                keep = [i for i in idx if i < len(file_names)]
-                table = (f.read(columns=[file_names[i] for i in keep])
-                         .rename_columns(
-                             [self._projection[k]
-                              for k, i in enumerate(idx)
-                              if i < len(file_names)])
-                         if keep else None)
+                keep = sorted({i for i in idx if i < len(file_names)})
+                if keep:
+                    # pyarrow returns requested columns in FILE order and
+                    # collapses duplicates — select per projected position
+                    # from the result instead of trusting request order
+                    read = f.read(columns=[file_names[i] for i in keep])
+                    table = pa.table(
+                        {self._projection[k]: read.column(file_names[i])
+                         for k, i in enumerate(idx)
+                         if i < len(file_names)})
+                else:
+                    table = None
             else:
                 # by-name evolution: columns added to the table after
                 # this file was written are absent here — _align_schema

@@ -1,17 +1,22 @@
 """Mesh / collective exchange (TPU-native: ICI all-to-all, psum)."""
 
 from blaze_tpu.parallel.collective import (all_to_all_regroup,
+                                           all_to_all_rows,
                                            partition_ids_for_keys,
                                            psum_table_accs)
 from blaze_tpu.parallel.mesh import (DP_AXIS,
                                      distributed_broadcast_join_agg,
                                      distributed_grouped_agg,
+                                     distributed_hash_join,
+                                     distributed_sort,
                                      make_mesh, shard_rows)
 from blaze_tpu.parallel.stage import (AggTable, merge_agg_tables,
                                       partial_agg_table)
 
-__all__ = ["all_to_all_regroup", "partition_ids_for_keys",
+__all__ = ["all_to_all_regroup", "all_to_all_rows",
+           "partition_ids_for_keys",
            "psum_table_accs", "DP_AXIS", "distributed_grouped_agg",
-           "distributed_broadcast_join_agg",
+           "distributed_broadcast_join_agg", "distributed_hash_join",
+           "distributed_sort",
            "make_mesh", "shard_rows", "AggTable", "merge_agg_tables",
            "partial_agg_table"]

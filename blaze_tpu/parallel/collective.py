@@ -35,6 +35,32 @@ def partition_ids_for_keys(keys: Sequence[Tuple[jax.Array, jax.Array]],
     return H.pmod(h, num_partitions, xp=jnp)
 
 
+def _dest_slots(pid: jax.Array, num_partitions: int, capacity: int):
+    """Dense within-destination slot assignment for per-destination
+    buffers of `capacity` rows.
+
+    Returns (order, dest, overflow): `order` sorts rows by destination;
+    `dest` = (partition, slot) per sorted row, routed OUT of bounds for
+    rows with pid >= num_partitions or past capacity, so scatters with
+    mode="drop" discard them instead of clobbering a live slot;
+    `overflow` counts in-range rows dropped by the capacity limit."""
+    R = pid.shape[0]
+    order = jnp.argsort(pid, stable=True)
+    sorted_pid = jnp.take(pid, order)
+    counts = jnp.bincount(jnp.clip(pid, 0, num_partitions),
+                          length=num_partitions + 1)[:num_partitions]
+    starts = jnp.cumsum(counts) - counts
+    idx_within = jnp.arange(R) - jnp.take(
+        jnp.concatenate([starts, jnp.zeros(1, starts.dtype)]),
+        jnp.clip(sorted_pid, 0, num_partitions))
+    sendable = sorted_pid < num_partitions
+    in_range = sendable & (idx_within < capacity)
+    overflow = jnp.sum((sendable & ~in_range).astype(jnp.int32))
+    dest = (jnp.where(in_range, sorted_pid, num_partitions),
+            jnp.where(in_range, idx_within, capacity))
+    return order, dest, overflow
+
+
 def all_to_all_regroup(table: AggTable, axis_name: str,
                        num_partitions: int, out_slots: int) -> AggTable:
     """Exchange group-table slots so equal keys land on one device, then
@@ -45,27 +71,16 @@ def all_to_all_regroup(table: AggTable, axis_name: str,
         list(zip(table.keys, table.key_valid)), num_partitions)
     pid = jnp.where(table.slot_valid, pid, num_partitions)  # park empties
 
-    # stable order by destination; within-destination dense index
-    order = jnp.argsort(pid, stable=True)
-    sorted_pid = jnp.take(pid, order)
-    counts = jnp.bincount(jnp.clip(pid, 0, num_partitions),
-                          length=num_partitions + 1)[:num_partitions]
-    starts = jnp.cumsum(counts) - counts
-    idx_within = jnp.arange(G) - jnp.take(
-        jnp.concatenate([starts, jnp.zeros(1, starts.dtype)]),
-        jnp.clip(sorted_pid, 0, num_partitions))
-
-    dest = (jnp.clip(sorted_pid, 0, num_partitions - 1), idx_within)
-    in_range = sorted_pid < num_partitions
+    # per-destination capacity G: a device's slots can never overflow it
+    order, dest, _overflow = _dest_slots(pid, num_partitions, G)
 
     def scatter(col):
         sc = jnp.take(col, order)
         buf = jnp.zeros((num_partitions, G), dtype=col.dtype)
-        return buf.at[dest].set(jnp.where(in_range, sc,
-                                          jnp.zeros_like(sc)), mode="drop")
+        return buf.at[dest].set(sc, mode="drop")
 
     def scatter_valid(col):
-        sc = jnp.take(col, order) & in_range
+        sc = jnp.take(col, order)
         buf = jnp.zeros((num_partitions, G), dtype=bool)
         return buf.at[dest].set(sc, mode="drop")
 
@@ -90,6 +105,47 @@ def all_to_all_regroup(table: AggTable, axis_name: str,
                         jnp.sum(slot_r.astype(jnp.int32)))
     # kinds: sum-merge semantics chosen by caller via merge_agg_tables
     return received
+
+
+def all_to_all_rows(columns: Sequence[jax.Array], valid: jax.Array,
+                    pid: jax.Array, axis_name: str, num_partitions: int,
+                    capacity: int):
+    """Operator-agnostic raw-row exchange over ICI.
+
+    The reference's repartitioner moves arbitrary operator output rows
+    (shuffle/mod.rs:55-123) — not just agg tables.  This is the on-mesh
+    analog: every device routes each of its local rows to the device
+    `pid[r]` names, staging them into per-destination buffers of static
+    `capacity`, and ONE `lax.all_to_all` moves every partition
+    simultaneously.  Callable only inside shard_map over `axis_name`.
+
+    columns: per-row data arrays, each shape (rows,).
+    valid:   (rows,) bool — invalid rows are not sent.
+    pid:     (rows,) int destination in [0, num_partitions).
+
+    Returns (columns', valid', overflow):
+      columns' each (num_partitions * capacity,) — received rows, padded;
+      valid' marks the real ones; overflow counts LOCAL rows dropped
+      because a destination bucket exceeded `capacity` (callers re-run
+      with a bigger bucket when nonzero — the same bounded-overflow
+      discipline as the fused agg table)."""
+    pid = jnp.where(valid, pid, num_partitions)  # park unsent rows
+    order, dest, overflow = _dest_slots(pid, num_partitions, capacity)
+
+    def exchange(buf):
+        return jax.lax.all_to_all(buf, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)
+
+    out_cols = []
+    for col in columns:
+        sc = jnp.take(col, order)
+        buf = jnp.zeros((num_partitions, capacity), dtype=col.dtype)
+        buf = buf.at[dest].set(sc, mode="drop")
+        out_cols.append(exchange(buf).reshape(num_partitions * capacity))
+    vbuf = jnp.zeros((num_partitions, capacity), dtype=bool)
+    vbuf = vbuf.at[dest].set(True, mode="drop")
+    out_valid = exchange(vbuf).reshape(num_partitions * capacity)
+    return out_cols, out_valid, overflow
 
 
 def psum_table_accs(table: AggTable, axis_name: str) -> AggTable:

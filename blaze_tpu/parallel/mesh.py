@@ -82,6 +82,189 @@ def distributed_grouped_agg(mesh: Mesh, key_specs, agg_specs,
     return jax.jit(sharded)
 
 
+def distributed_sort(mesh: Mesh, num_payloads: int, capacity: int,
+                     samples_per_device: int = 64, descending: bool = False):
+    """Globally range-partitioned sort as ONE SPMD program.
+
+    The reference's global sort is range-repartition (driver-sampled
+    bounds, NativeShuffleExchangeBase.scala:313) + per-partition external
+    sort.  The on-mesh form does all of it inside one jit: each device
+    samples its local keys, an `all_gather` shares the samples, every
+    device derives identical quantile bounds, rows ride the raw-row
+    all-to-all to their range partition, and a local sort finishes.
+    After the step, device i's valid rows are all <= device i+1's
+    (reversed when `descending`) and each device is locally sorted.
+
+    Returns fn(keys, valid, *payloads) -> (keys', valid', *payloads',
+    overflow) with per-device length `num_devices * capacity`.  Keys must
+    be a numeric dtype; nulls (valid=False) are not emitted.
+    """
+    from blaze_tpu.parallel.collective import all_to_all_rows
+
+    P_ = mesh.shape[DP_AXIS]
+    S = samples_per_device
+
+    def _encode(keys):
+        """(sort_key, nan_rank, is_nan): sort_key ascends in the requested
+        order.  Integers/bool invert via bitwise NOT (negation wraps
+        INT64_MIN and unsigned dtypes); float NaN zeroes out of the value
+        key and rides a separate rank — Spark treats NaN as the LARGEST
+        value (last on ASC, first on DESC)."""
+        if jnp.issubdtype(keys.dtype, jnp.floating):
+            nan = jnp.isnan(keys)
+            base = jnp.where(nan, jnp.zeros_like(keys), keys)
+            skey = -base if descending else base
+            rank_nan = 0 if descending else 1
+            nan_rank = jnp.where(nan, rank_nan, 1 - rank_nan) \
+                .astype(jnp.int32)
+            return skey, nan_rank, nan
+        skey = ~keys if descending else keys
+        return skey, jnp.zeros(keys.shape, jnp.int32), \
+            jnp.zeros(keys.shape, bool)
+
+    def stage(keys, valid, *payloads):
+        if len(payloads) != num_payloads:
+            raise ValueError(
+                f"distributed_sort built for {num_payloads} payload "
+                f"columns, got {len(payloads)}")
+        R = keys.shape[0]
+        sort_key, nan_rank, is_nan = _encode(keys)
+        # sample only finite valid keys (NaN routes to a fixed partition
+        # below; nulls are never emitted)
+        finite = valid & ~is_nan
+        not_finite = (~finite).astype(jnp.int32)
+        _, key_s = jax.lax.sort((not_finite, sort_key), num_keys=2)
+        n_fin = jnp.sum(finite.astype(jnp.int32))
+        pos = (jnp.arange(S) * jnp.maximum(n_fin, 1)) // S
+        pos = jnp.clip(pos, 0, R - 1)
+        samp = jnp.take(key_s, pos)
+        samp_valid = jnp.arange(S) < jnp.minimum(n_fin, S)
+
+        all_samp = jax.lax.all_gather(samp, DP_AXIS).reshape(P_ * S)
+        all_sv = jax.lax.all_gather(samp_valid, DP_AXIS).reshape(P_ * S)
+        sinv, ssort = jax.lax.sort(((~all_sv).astype(jnp.int32), all_samp),
+                                   num_keys=2)
+        m = jnp.sum(all_sv.astype(jnp.int32))
+        bpos = (jnp.arange(1, P_) * jnp.maximum(m, 1)) // P_
+        bounds = jnp.take(ssort, jnp.clip(bpos, 0, P_ * S - 1))
+
+        pid = jnp.searchsorted(bounds, sort_key, side="right")
+        # NaN = largest: last device on ASC order, first on DESC
+        pid = jnp.where(is_nan, 0 if descending else P_ - 1, pid)
+        cols, valid_r, overflow = all_to_all_rows(
+            [keys] + list(payloads), valid,
+            pid.astype(jnp.int32), DP_AXIS, P_, capacity)
+        keys_r, payloads_r = cols[0], cols[1:]
+        skey_r, nan_rank_r, _ = _encode(keys_r)
+        # total order: (invalid-last, NaN rank, value key), carried perm
+        _, _, _, perm = jax.lax.sort(
+            ((~valid_r).astype(jnp.int32), nan_rank_r, skey_r,
+             jnp.arange(valid_r.shape[0], dtype=jnp.int32)), num_keys=3)
+        out_keys = jnp.take(keys_r, perm)
+        out_valid = jnp.take(valid_r, perm)
+        out_payloads = [jnp.take(p, perm) for p in payloads_r]
+        return tuple([out_keys, out_valid] + out_payloads +
+                     [overflow.reshape(1)])
+
+    sharded = jax.shard_map(
+        stage, mesh=mesh,
+        in_specs=P(DP_AXIS),
+        out_specs=P(DP_AXIS),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+def distributed_hash_join(mesh: Mesh, num_build_payloads: int,
+                          num_probe_payloads: int, capacity: int,
+                          pair_cap: int):
+    """Shuffled hash join (inner equi-join) as ONE SPMD program.
+
+    Both sides hash-partition by Spark-compatible pmod(murmur3(key, 42))
+    on device, ride the raw-row all-to-all so equal keys co-locate, and
+    each device runs a local sorted-probe join (sort build side, binary
+    search per probe row, bounded pair expansion — the same discipline as
+    kernels/join.py, kept inside the SPMD program).
+
+    Returns fn(bkeys, bvalid, *bpayloads, pkeys, pvalid, *ppayloads) ->
+    (jkeys, jvalid, *bpayloads', *ppayloads', counts) per device, where
+    `counts` = [local pair total, build overflow, probe overflow] lets
+    the host detect capacity misses (re-run bigger, never silent).
+    """
+    from blaze_tpu.kernels.join import expand_pairs
+    from blaze_tpu.parallel.collective import (all_to_all_rows,
+                                               partition_ids_for_keys)
+
+    P_ = mesh.shape[DP_AXIS]
+    NB, NP = num_build_payloads, num_probe_payloads
+
+    def stage(*args):
+        bkeys, bvalid = args[0], args[1]
+        bpay = list(args[2:2 + NB])
+        pkeys, pvalid = args[2 + NB], args[3 + NB]
+        ppay = list(args[4 + NB:4 + NB + NP])
+
+        # float NaN keys are treated as null HERE: NaN sorts after the
+        # +inf padding sentinel and would break the valid-prefix
+        # invariant below.  Spark's NaN == NaN join semantics belong to
+        # the caller: canonicalize NaN keys to one bit pattern (the
+        # planner's key normalization) before the exchange.
+        if jnp.issubdtype(bkeys.dtype, jnp.floating):
+            bvalid = bvalid & ~jnp.isnan(bkeys)
+        if jnp.issubdtype(pkeys.dtype, jnp.floating):
+            pvalid = pvalid & ~jnp.isnan(pkeys)
+
+        bpid = partition_ids_for_keys([(bkeys, bvalid)], P_)
+        ppid = partition_ids_for_keys([(pkeys, pvalid)], P_)
+        bcols, bval_r, bovf = all_to_all_rows(
+            [bkeys] + bpay, bvalid, bpid, DP_AXIS, P_, capacity)
+        pcols, pval_r, povf = all_to_all_rows(
+            [pkeys] + ppay, pvalid, ppid, DP_AXIS, P_, capacity)
+        bk, bp = bcols[0], bcols[1:]
+        pk, pp = pcols[0], pcols[1:]
+
+        # local sorted-probe join: invalid build keys become a +max
+        # sentinel so the sorted array is GLOBALLY ascending (searchsorted
+        # needs monotonicity; merely parking invalids last would restart
+        # the key order mid-array)
+        n = bk.shape[0]
+        sentinel = (jnp.inf if jnp.issubdtype(bk.dtype, jnp.floating)
+                    else jnp.iinfo(bk.dtype).max)
+        bk_masked = jnp.where(bval_r, bk, sentinel)
+        # secondary key: invalid-last, so a VALID row whose real key
+        # equals the sentinel still sorts before the masked padding and
+        # the [0, n_build) prefix is exactly the valid rows
+        bk_s, _, bperm = jax.lax.sort(
+            (bk_masked, (~bval_r).astype(jnp.int32),
+             jnp.arange(n, dtype=jnp.int32)), num_keys=2)
+        n_build = jnp.sum(bval_r.astype(jnp.int32))
+        lo = jnp.searchsorted(bk_s, pk, side="left")
+        hi = jnp.searchsorted(bk_s, pk, side="right")
+        # matches beyond the valid prefix are parked invalid rows
+        hi = jnp.minimum(hi, n_build)
+        count = jnp.where(pval_r, jnp.maximum(hi - lo, 0), 0)
+        p_idx, b_sorted_pos, pair_valid, total = expand_pairs(
+            lo.astype(jnp.int64), count.astype(jnp.int64), pair_cap)
+        b_idx = jnp.take(bperm, jnp.clip(b_sorted_pos, 0, n - 1))
+
+        jkeys = jnp.take(pk, p_idx)
+        out_b = [jnp.take(col, b_idx) for col in bp]
+        out_p = [jnp.take(col, p_idx) for col in pp]
+        # raw total (NOT clamped): total > pair_cap tells the host pairs
+        # were dropped — capacity misses must never look like exact fits
+        counts = jnp.stack([total.astype(jnp.int64),
+                            bovf.astype(jnp.int64),
+                            povf.astype(jnp.int64)])
+        return tuple([jkeys, pair_valid] + out_b + out_p +
+                     [counts.reshape(3)])
+
+    sharded = jax.shard_map(
+        stage, mesh=mesh,
+        in_specs=P(DP_AXIS),
+        out_specs=P(DP_AXIS),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
 def distributed_broadcast_join_agg(mesh: Mesh, build_capacity: int):
     """Broadcast-hash-join + grouped aggregation as ONE SPMD program.
 

@@ -143,13 +143,18 @@ class RssPushClient:
         for map_id in range(self.num_maps):
             want = int(manifests[map_id]["counts"].get(str(partition), 0))
             frames = by_map.get(map_id, {})
-            if len(frames) != want:
+            # only seqs below the committed count matter: a crashed run of
+            # the SAME attempt may have left higher-seq frames behind that
+            # the committed retry never re-pushed — those are garbage, not
+            # lost pushes
+            committed = {s: p for s, p in frames.items() if s < want}
+            if len(committed) != want:
                 raise IOError(
                     f"rss shuffle {self.shuffle_id} part {partition}: "
                     f"map {map_id} committed {want} frames, found "
-                    f"{len(frames)} (lost pushes)")
-            for seq in sorted(frames):
-                with open(frames[seq], "rb") as f:
+                    f"{sorted(committed)} (lost pushes)")
+            for seq in sorted(committed):
+                with open(committed[seq], "rb") as f:
                     blocks.append(f.read())
         return blocks
 

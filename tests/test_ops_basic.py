@@ -324,6 +324,28 @@ class TestOrcSchemaEvolution:
         assert got.column("a").to_pylist() == [7, 8]
         assert got.column("b").to_pylist() == [0.5, 1.5]
 
+    def test_positional_evolution_reordered_projection(self, tmp_path):
+        import pyarrow as pa
+        from blaze_tpu import config
+        from blaze_tpu.ops.orc import OrcScanExec
+        # projection order differs from file order: pyarrow returns
+        # requested columns in FILE order, so naive rename mislabels
+        declared = pa.table({"a": pa.array([7, 8]),
+                             "b": pa.array([0.5, 1.5])})
+        renamed = pa.table({"_col0": pa.array([7, 8]),
+                            "_col1": pa.array([0.5, 1.5])})
+        path = self._write(tmp_path, "reord.orc", renamed)
+        config.conf.set(config.ORC_FORCE_POSITIONAL_EVOLUTION.key, True)
+        try:
+            scan = OrcScanExec(S.Schema.from_arrow(declared.schema),
+                               [[path]], projection=["b", "a"])
+            got = scan.execute_collect().to_arrow()
+        finally:
+            config.conf.unset(config.ORC_FORCE_POSITIONAL_EVOLUTION.key)
+        assert got.schema.names == ["b", "a"]
+        assert got.column("a").to_pylist() == [7, 8]
+        assert got.column("b").to_pylist() == [0.5, 1.5]
+
     def test_added_column_missing_in_old_file(self, tmp_path):
         import pyarrow as pa
         from blaze_tpu.ops.orc import OrcScanExec

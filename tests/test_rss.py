@@ -159,3 +159,17 @@ def test_lost_push_detected(tmp_path):
     os.unlink(victims[0])
     with pytest.raises(IOError, match="lost pushes"):
         client.reader_blocks(0, timeout_s=1.0)
+
+
+def test_crashed_run_leftover_frames_tolerated(tmp_path):
+    """A crashed run of the SAME attempt left higher-seq frames the
+    committed retry never re-pushed; those are garbage, not lost pushes
+    — the committed prefix must read cleanly."""
+    client = RssPushClient(str(tmp_path), "s6", num_maps=1, num_reduces=1)
+    # crashed run pushed 3 frames, no commit
+    for seq in range(3):
+        client._push(0, 0, 0, seq, b"frame%d" % seq)
+    # retry (same attempt) re-pushes only 2 frames and commits 2
+    client._commit(0, 0, {0: 2})
+    blocks = client.reader_blocks(0, timeout_s=1.0)
+    assert blocks == [b"frame0", b"frame1"]

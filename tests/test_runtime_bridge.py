@@ -253,3 +253,39 @@ def test_jni_bridge_symbols_and_layout():
                 "Java_org_apache_auron_jni_JniBridge_finalizeNative",
                 "Java_org_apache_auron_jni_JniBridge_onExit"):
         assert sym in out, sym
+
+
+@pytest.mark.parametrize("force_ipc", [False, True])
+def test_bridge_pull_batch_prefers_ffi_falls_back_to_ipc(force_ipc):
+    """bridge_pull_batch is the has_cdata_ffi consumer: C-Data when the
+    .so exports it, IPC bytes otherwise — same batches either way."""
+    from blaze_tpu.bridge.native import bridge_pull_batch, get_host_bridge
+    lib = get_host_bridge()
+    if lib is None:
+        pytest.skip("host bridge lib unavailable")
+    t = pa.table({"a": pa.array(range(100)),
+                  "s": pa.array([f"r{i}" for i in range(100)])})
+    put_resource("pull1", t)
+    ir = _scan_ir("pull1", t)
+    err = ctypes.c_char_p()
+    handle = lib.blaze_call_native(
+        json.dumps(_task_def(ir)).encode(), ctypes.byref(err))
+    assert handle, err.value
+    saved = lib.has_cdata_ffi
+    if force_ipc:
+        lib.has_cdata_ffi = False  # stale-.so policy
+    try:
+        got = []
+        while True:
+            rb = bridge_pull_batch(lib, handle)
+            if rb is None:
+                break
+            got.append(rb)
+    finally:
+        lib.has_cdata_ffi = saved
+        metrics = ctypes.c_char_p()
+        lib.blaze_finalize_native(handle, ctypes.byref(metrics),
+                                  ctypes.byref(err))
+    out = pa.Table.from_batches(got)
+    assert out.column("a").to_pylist() == list(range(100))
+    assert out.column("s").to_pylist() == [f"r{i}" for i in range(100)]
