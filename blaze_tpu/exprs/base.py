@@ -105,10 +105,28 @@ class PhysicalExpr:
         raise NotImplementedError
 
     # cache key for the common-subexpression evaluator
-    # (ref common/cached_exprs_evaluator.rs:522)
+    # (ref common/cached_exprs_evaluator.rs:522).  Derived from ALL
+    # dataclass fields, not just children: two same-class exprs that
+    # differ only in a scalar parameter (ordinal, pattern, function
+    # name...) must never share a cache slot.
     def cache_key(self) -> Any:
-        return (type(self).__name__,
-                tuple(c.cache_key() for c in self.children()))
+        import dataclasses
+        if dataclasses.is_dataclass(self):
+            parts = []
+            for f in dataclasses.fields(self):
+                v = getattr(self, f.name)
+                if isinstance(v, PhysicalExpr):
+                    parts.append(v.cache_key())
+                elif isinstance(v, (tuple, list)):
+                    parts.append(tuple(
+                        x.cache_key() if isinstance(x, PhysicalExpr)
+                        else repr(x) for x in v))
+                else:
+                    parts.append(repr(v))
+            return (type(self).__name__, *parts)
+        # non-dataclass without an explicit override: disable sharing
+        # rather than risk a collision
+        return (type(self).__name__, id(self))
 
     def __repr__(self):
         cs = ", ".join(repr(c) for c in self.children())

@@ -370,12 +370,29 @@ def _format_string(arr: pa.Array, src: DataType) -> pa.Array:
             py.append(None if not x.is_valid
                       else _spark_str(x.as_py(), src))
         return pa.array(py, type=pa.utf8())
+    if src.id == TypeId.TIMESTAMP_MICROS:
+        # Spark timestampToString: fraction trimmed of trailing zeros,
+        # omitted entirely at .000000 (arrow's cast always prints it)
+        py = []
+        for x in arr:
+            if not x.is_valid:
+                py.append(None)
+                continue
+            v = x.as_py()
+            # %Y does not zero-pad years < 1000 on Linux; Spark does
+            s = f"{v.year:04d}" + v.strftime("-%m-%d %H:%M:%S")
+            if v.microsecond:
+                s += ("." + f"{v.microsecond:06d}".rstrip("0"))
+            py.append(s)
+        return pa.array(py, type=pa.utf8())
     return arr.cast(pa.utf8())
 
 
 def _format_decimal(d: pydec.Decimal, scale: int) -> str:
-    q = d.quantize(pydec.Decimal(1).scaleb(-scale)) if scale else \
-        d.to_integral_value()
+    with pydec.localcontext() as ctx:
+        ctx.prec = 76  # decimal(38,_) values overflow the default 28
+        q = (d.quantize(pydec.Decimal(1).scaleb(-scale)) if scale
+             else d.to_integral_value())
     return format(q, "f")
 
 

@@ -147,10 +147,37 @@ class GetIndexedField(PhysicalExpr):
 
     def evaluate(self, batch: ColumnBatch) -> ColVal:
         arr = self.child.evaluate(batch).to_host(batch.num_rows)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
         if pa.types.is_struct(arr.type):
             out = arr.field(self.index)
+            if arr.null_count:
+                # a null parent struct yields a null field (Spark
+                # GetStructField null propagation), which .field() alone
+                # does not encode — the child buffer keeps stale values
+                out = pc.if_else(arr.is_valid(), out,
+                                 pa.nulls(len(arr), out.type))
         else:
-            out = pc.list_element(arr, self.index)
+            # Spark GetArrayItem: out-of-bounds -> null (non-ANSI) or
+            # raise (ANSI); pc.list_element would raise unconditionally
+            import numpy as np
+
+            from blaze_tpu import config
+            off = np.asarray(arr.offsets)
+            starts, ends = off[:-1], off[1:]
+            idx = starts + self.index
+            present = (arr.is_valid().to_numpy(zero_copy_only=False)
+                       if arr.null_count else np.ones(len(arr), bool))
+            in_bounds = (self.index >= 0) & (idx < ends)
+            if config.ANSI_ENABLED.get() and bool(
+                    (present & ~in_bounds).any()):
+                raise ValueError(
+                    f"[INVALID_ARRAY_INDEX] index {self.index} out of "
+                    f"bounds (ANSI mode)")
+            valid = present & in_bounds
+            take = pa.array(np.where(valid, idx, 0), pa.int64(),
+                            mask=~valid)  # null index -> null output
+            out = arr.values.take(take)
         cv = ColVal.host(self.out_type, out)
         if self.out_type.is_fixed_width:
             return cv.to_device(batch.capacity)

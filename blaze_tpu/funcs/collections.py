@@ -204,8 +204,13 @@ def _element_at(args, batch, out_type):
             continue
         lst = x.as_py() or []
         i = int(idx.as_py())
-        # Spark element_at is 1-based; negative indexes from the end
-        if i == 0 or abs(i) > len(lst):
+        # Spark element_at is 1-based; negative indexes from the end;
+        # index 0 is an error in every mode (ElementAt.nullSafeEval)
+        if i == 0:
+            raise ValueError(
+                "[INVALID_INDEX_OF_ZERO] element_at: SQL array indices "
+                "start at 1")
+        if abs(i) > len(lst):
             py.append(None)
         else:
             py.append(lst[i - 1] if i > 0 else lst[i])

@@ -91,13 +91,30 @@ class CorpusSettings:
 
 @dataclass
 class Case:
-    """One conformance vector: expression(s) over an input column set."""
+    """One conformance vector: expression(s) over an input column set.
+
+    Two shapes:
+      * projection vectors — `exprs` are projected over `input`;
+      * plan vectors — `plan(scan_ir[, scan2_ir])` builds an arbitrary
+        root plan (sort / agg / join...) over the memory scan(s), the
+        analog of the reference's full-suite re-runs that exercise
+        operators, not just expressions.
+    `confs` scopes engine config keys around the run (the ANSI-toggle
+    analog of SparkTestSettings' per-suite conf overrides).
+    `unordered` compares results as multisets (agg/join output order is
+    not contractual, like Spark's checkAnswer).
+    """
 
     name: str
     input: pa.Table                      # input columns c0..cn
     exprs: List[dict]                    # IR expression dicts
     expected: List[tuple]                # rows of expected output
     rtol: float = 0.0                    # float tolerance (0 = exact)
+    confs: Optional[Dict[str, Any]] = None
+    plan: Optional[Callable[..., dict]] = None
+    input2: Optional[pa.Table] = None    # second scan for join vectors
+    unordered: bool = False
+    raises: Optional[str] = None         # expect failure containing this
 
 
 def _col(i: int) -> dict:
@@ -877,38 +894,63 @@ def _values_equal(got, want, rtol: float) -> bool:
     return got == want
 
 
-def run_case(suite: str, case: Case) -> CaseResult:
-    from blaze_tpu.bridge.resource import put_resource
-    from blaze_tpu.plan import create_plan
+def _scan_ir(rid: str, table: pa.Table) -> dict:
     from blaze_tpu.plan.types import schema_to_dict
     from blaze_tpu.schema import Schema
+    return {"kind": "memory_scan", "resource_id": rid,
+            "schema": schema_to_dict(Schema.from_arrow(table.schema)),
+            "num_partitions": 1}
+
+
+def run_case(suite: str, case: Case) -> CaseResult:
+    from blaze_tpu import config
+    from blaze_tpu.bridge.resource import put_resource
+    from blaze_tpu.plan import create_plan
 
     rid = f"corpus://{suite}/{case.name}"
     put_resource(rid, case.input)
-    ir = {"kind": "project",
-          "exprs": case.exprs,
-          "names": [f"o{i}" for i in range(len(case.exprs))],
-          "input": {"kind": "memory_scan", "resource_id": rid,
-                    "schema": schema_to_dict(
-                        Schema.from_arrow(case.input.schema)),
-                    "num_partitions": 1}}
+    scan = _scan_ir(rid, case.input)
+    if case.plan is not None:
+        if case.input2 is not None:
+            rid2 = rid + "/2"
+            put_resource(rid2, case.input2)
+            ir = case.plan(scan, _scan_ir(rid2, case.input2))
+        else:
+            ir = case.plan(scan)
+    else:
+        ir = {"kind": "project",
+              "exprs": case.exprs,
+              "names": [f"o{i}" for i in range(len(case.exprs))],
+              "input": scan}
     try:
-        plan = create_plan(ir)
-        batches = [b.compact().to_arrow() for b in plan.execute(0)]
+        with config.scoped(**(case.confs or {})):
+            plan = create_plan(ir)
+            batches = [b.compact().to_arrow() for b in plan.execute(0)]
+        ncols = (len(case.exprs) if case.plan is None
+                 else (len(case.expected[0]) if case.expected else 1))
         tbl = (pa.Table.from_batches(batches) if batches
                else pa.Table.from_batches(
                    [], schema=pa.schema(
-                       [(f"o{i}", pa.null())
-                        for i in range(len(case.exprs))])))
+                       [(f"o{i}", pa.null()) for i in range(ncols)])))
         got = [tuple(r) for r in zip(*[c.to_pylist()
                                        for c in tbl.columns])] \
             if tbl.num_rows else []
     except Exception as e:  # noqa: BLE001 — recorded, like a test failure
+        if case.raises is not None and case.raises in repr(e):
+            return CaseResult(suite, case.name, True)
         return CaseResult(suite, case.name, False, f"raised {e!r}")
+    if case.raises is not None:
+        return CaseResult(suite, case.name, False,
+                          f"expected raise {case.raises!r}, got rows")
     if len(got) != len(case.expected):
         return CaseResult(suite, case.name, False,
                           f"rows {len(got)} != {len(case.expected)}")
-    for i, (g, w) in enumerate(zip(got, case.expected)):
+    want_rows = case.expected
+    if case.unordered:
+        key = repr
+        got = sorted(got, key=key)
+        want_rows = sorted(want_rows, key=key)
+    for i, (g, w) in enumerate(zip(got, want_rows)):
         if len(g) != len(w):
             return CaseResult(suite, case.name, False,
                               f"row {i}: arity {len(g)} != {len(w)}")
@@ -927,6 +969,12 @@ def run_corpus(settings: CorpusSettings) -> List[CaseResult]:
             if ss.selects(case.name):
                 out.append(run_case(sname, case))
     return out
+
+
+# The extended tier (round-5 expansion: cast edges, decimal38, ANSI,
+# nested types, NaN/-0.0 ordering, agg/join/window semantics) registers
+# its suites into SUITES on import.
+from blaze_tpu.itest import spark_corpus_ext  # noqa: E402,F401
 
 
 def default_settings() -> CorpusSettings:

@@ -213,9 +213,21 @@ class MinMaxAgg(AggFunction):
         return self.children[0].data_type(s)
 
     def _reduce(self, data, valid, gids, n):
+        xp = xp_of(data, valid)
+        vals, nan_mask = data, None
+        if self.minimum and xp.issubdtype(
+                xp.asarray(data).dtype, xp.floating):
+            # Spark total order puts NaN LARGEST: min skips NaN (the
+            # NaN-propagating segment_min would return NaN for any
+            # group containing one) — unless the group is all-NaN
+            nan_mask = xp.isnan(data)
+            vals = xp.where(nan_mask, xp.inf, data)
         fn = K.segment_min if self.minimum else K.segment_max
-        out = fn(data, gids, n, valid)
+        out = fn(vals, gids, n, valid)
         has = K.segment_count(valid, gids, n) > 0
+        if nan_mask is not None:
+            has_real = K.segment_count(valid & ~nan_mask, gids, n) > 0
+            out = xp.where(has & ~has_real, xp.nan, out)
         xp = xp_of(out, has)
         out = xp.where(has, out, xp.zeros_like(out))
         return ((out, has),)
