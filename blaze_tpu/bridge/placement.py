@@ -86,7 +86,16 @@ def ensure_placement() -> PlacementInfo:
         threshold = config.PLACEMENT_RTT_THRESHOLD_MS.get()
         use_host = policy == "auto" and rtt > threshold
         if use_host:
-            cpu = jax.local_devices(backend="cpu")[0]
+            try:
+                cpu = jax.local_devices(backend="cpu")[0]
+            except RuntimeError:
+                # some plugin runtimes expose only the accelerator
+                # backend; auto placement then stays on device rather
+                # than crashing the engine at startup
+                log.warning("host placement unavailable (no cpu "
+                            "backend); staying on %s", platform)
+                _info = PlacementInfo(platform, platform, rtt, policy)
+                return _info
             jax.config.update("jax_default_device", cpu)
             log.warning(
                 "placing stage compute on host XLA backend: measured "

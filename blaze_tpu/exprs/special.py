@@ -169,11 +169,15 @@ class GetIndexedField(PhysicalExpr):
             present = (arr.is_valid().to_numpy(zero_copy_only=False)
                        if arr.null_count else np.ones(len(arr), bool))
             in_bounds = (self.index >= 0) & (idx < ends)
-            if config.ANSI_ENABLED.get() and bool(
-                    (present & ~in_bounds).any()):
-                raise ValueError(
-                    f"[INVALID_ARRAY_INDEX] index {self.index} out of "
-                    f"bounds (ANSI mode)")
+            if config.ANSI_ENABLED.get():
+                # filtered-out rows must not raise: filters only set the
+                # selection mask without compacting (see batch.py and
+                # Cast._ansi_check_device, which ANDs the same mask)
+                sel = np.asarray(batch.row_mask())[:len(arr)]
+                if bool((present & ~in_bounds & sel).any()):
+                    raise ValueError(
+                        f"[INVALID_ARRAY_INDEX] index {self.index} out "
+                        f"of bounds (ANSI mode)")
             valid = present & in_bounds
             take = pa.array(np.where(valid, idx, 0), pa.int64(),
                             mask=~valid)  # null index -> null output

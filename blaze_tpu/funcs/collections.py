@@ -54,11 +54,24 @@ def _array_contains(args, batch, out_type):
     arrs = _host(args, batch)
     needles = _per_row(arrs[1])
     py = []
+
+    def _eq(a, b):
+        # Spark ArrayContains compares via ordering.equiv: NaN == NaN
+        if isinstance(a, float) and isinstance(b, float) \
+                and a != a and b != b:
+            return True
+        return a == b
+
     for x, needle in zip(arrs[0], needles):
         if not x.is_valid or needle is None:
             py.append(None)
+            continue
+        vals = x.as_py() or []
+        if any(_eq(v, needle) for v in vals if v is not None):
+            py.append(True)
         else:
-            py.append(needle in (x.as_py() or []))
+            # no match + a null element -> NULL (ArrayContains 3VL)
+            py.append(None if any(v is None for v in vals) else False)
     return ColVal.host(BOOL, pa.array(py, type=pa.bool_()))
 
 
@@ -97,7 +110,12 @@ def _array_max(args, batch, out_type):
     for x in a:
         vals = [v for v in (x.as_py() or []) if v is not None] \
             if x.is_valid else None
-        py.append(max(vals) if vals else None)
+        if not vals:
+            py.append(None)
+        elif any(isinstance(v, float) and v != v for v in vals):
+            py.append(float("nan"))  # Spark total order: NaN is largest
+        else:
+            py.append(max(vals))
     return ColVal.host(out_type, pa.array(py, type=a.type.value_type))
 
 
@@ -108,7 +126,14 @@ def _array_min(args, batch, out_type):
     for x in a:
         vals = [v for v in (x.as_py() or []) if v is not None] \
             if x.is_valid else None
-        py.append(min(vals) if vals else None)
+        if not vals:
+            py.append(None)
+        else:
+            real = [v for v in vals
+                    if not (isinstance(v, float) and v != v)]
+            # NaN is LARGEST in Spark's total order: min skips it
+            # unless the array is all-NaN
+            py.append(min(real) if real else float("nan"))
     return ColVal.host(out_type, pa.array(py, type=a.type.value_type))
 
 
@@ -185,7 +210,14 @@ def _map_values(args, batch, out_type):
 
 @register("element_at")
 def _element_at(args, batch, out_type):
+    import numpy as _np
+
+    from blaze_tpu import config
     a, k = _host(args, batch)
+    # raises below must only fire for SELECTED rows: filters set the
+    # selection mask without compacting (batch.py row_mask contract)
+    sel = _np.asarray(batch.row_mask())[:batch.num_rows]
+    ansi = config.ANSI_ENABLED.get()
     py = []
     if pa.types.is_map(a.type):
         for x, key in zip(a, k):
@@ -198,19 +230,24 @@ def _element_at(args, batch, out_type):
                     val = vv
             py.append(val)
         return ColVal.host(out_type, pa.array(py, type=a.type.item_type))
-    for x, idx in zip(a, k):
+    for row, (x, idx) in enumerate(zip(a, k)):
         if not x.is_valid or not idx.is_valid:
             py.append(None)
             continue
+        selected = row >= len(sel) or bool(sel[row])
         lst = x.as_py() or []
         i = int(idx.as_py())
         # Spark element_at is 1-based; negative indexes from the end;
         # index 0 is an error in every mode (ElementAt.nullSafeEval)
-        if i == 0:
+        if i == 0 and selected:
             raise ValueError(
                 "[INVALID_INDEX_OF_ZERO] element_at: SQL array indices "
                 "start at 1")
-        if abs(i) > len(lst):
+        if i == 0 or abs(i) > len(lst):
+            if ansi and i != 0 and selected:
+                raise ValueError(
+                    f"[INVALID_ARRAY_INDEX_IN_ELEMENT_AT] index {i} "
+                    f"out of bounds for array of {len(lst)} elements")
             py.append(None)
         else:
             py.append(lst[i - 1] if i > 0 else lst[i])

@@ -211,20 +211,40 @@ def _add_months(args, batch, out_type):
                   validity=valid & n.validity)
 
 
+def _secs_of_day(arg, batch):
+    """Seconds past midnight (0 for date inputs) as float64 device."""
+    dv = arg.to_device(batch.capacity)
+    if dv.dtype.id == TypeId.TIMESTAMP_MICROS:
+        us = dv.data - jnp.floor_divide(
+            dv.data, jnp.int64(_US_PER_DAY)) * jnp.int64(_US_PER_DAY)
+        return us.astype(jnp.float64) / 1e6
+    return jnp.zeros(batch.capacity, jnp.float64)
+
+
 @register("months_between", lambda ts: FLOAT64)
 def _months_between(args, batch, out_type):
+    """DateTimeUtils.monthsBetween: same day-of-month or both
+    month-ends -> integral; else day AND time-of-day difference over a
+    31-day month; roundOff (the SQL default) rounds to 8 decimals."""
     d1, v1 = _to_days(args[0], batch)
     d2, v2 = _to_days(args[1], batch)
     y1, m1, dd1 = _civil_from_days(d1)
     y2, m2, dd2 = _civil_from_days(d2)
     months = (y1 - y2) * 12 + (m1 - m2)
-    # Spark: if both are last day of month or same day -> integral result
-    frac = (dd1 - dd2).astype(jnp.float64) / 31.0
-    out = months.astype(jnp.float64) + frac
+    secs_diff = ((dd1 - dd2).astype(jnp.float64) * 86400.0 +
+                 _secs_of_day(args[0], batch) -
+                 _secs_of_day(args[1], batch))
+    out = months.astype(jnp.float64) + secs_diff / (31.0 * 86400.0)
     last1 = _is_last_day(d1)
     last2 = _is_last_day(d2)
     out = jnp.where((dd1 == dd2) | (last1 & last2),
                     months.astype(jnp.float64), out)
+    round_off = True
+    if len(args) > 2:
+        from blaze_tpu.funcs.common import const_arg
+        round_off = bool(const_arg(args[2], batch, "months_between"))
+    if round_off:
+        out = jnp.round(out * 1e8) / 1e8
     return ColVal(FLOAT64, data=out, validity=v1 & v2)
 
 

@@ -46,7 +46,10 @@ def _concat(args, batch, out_type):
 def _concat_ws(args, batch, out_type):
     arrs = _host(args, batch)
     seps = _per_row(arrs[0])
-    parts = [a.cast(pa.utf8()) for a in arrs[1:]]
+    # Spark concat_ws accepts both strings and ARRAY<STRING> arguments
+    # (ConcatWs flattens arrays in place, skipping null elements)
+    parts = [a if pa.types.is_list(a.type) else a.cast(pa.utf8())
+             for a in arrs[1:]]
     if not parts:
         # Spark: NULL separator -> NULL result
         return ColVal.host(UTF8, pa.array(
@@ -57,7 +60,15 @@ def _concat_ws(args, batch, out_type):
         if seps[i] is None:
             py.append(None)
             continue
-        vals = [p[i].as_py() for p in parts if p[i].is_valid]
+        vals = []
+        for p in parts:
+            if not p[i].is_valid:
+                continue
+            v = p[i].as_py()
+            if isinstance(v, list):
+                vals.extend(str(x) for x in v if x is not None)
+            else:
+                vals.append(v)
         py.append(seps[i].join(vals))
     return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))
 
@@ -312,6 +323,12 @@ def _regexp_replace(args, batch, out_type):
     repl = const_arg(args[2], batch, "regexp_replace") if len(args) > 2 else ""
     if pattern is None or repl is None:
         return _null_utf8(batch.num_rows)
+    # Spark uses Java-style $1 group references; RE2 (and Python re)
+    # spell them \1 — translate unescaped $N, keep \$ literal
+    import re as _re
+    repl = _re.sub(r"\\\$", "\x00", repl)
+    repl = _re.sub(r"\$(\d+)", r"\\\1", repl)
+    repl = repl.replace("\x00", "$")
     return ColVal.host(UTF8, pc.replace_substring_regex(
         arrs[0], pattern=pattern, replacement=repl))
 

@@ -321,6 +321,33 @@ def _ansi():
              pa.table({"s": pa.array(["abc"])}),
              [_cast(_col(0), "int32")],
              [(None,)]),
+        Case("ANSI: element_at out of bounds raises",
+             pa.table({"a": pa.array([[1, 2]])}),
+             [_fn("element_at", _col(0), _lit(5), rt="int64")],
+             [], confs=_ANSI_ON,
+             raises="INVALID_ARRAY_INDEX_IN_ELEMENT_AT"),
+        Case("months_between roundOff=false keeps full precision",
+             pa.table({"a": pa.array([_dt.date(2020, 1, 14)],
+                                     pa.date32()),
+                       "b": pa.array([_dt.date(2020, 1, 10)],
+                                     pa.date32())}),
+             [_fn("months_between", _col(0), _col(1),
+                  _lit(False, "bool"), rt="float64")],
+             [(4.0 / 31.0,)]),
+        Case("raises honor the filter selection mask",
+             # row 2 has i=0, which would raise INVALID_INDEX_OF_ZERO —
+             # but the filter deselects it, so the query must succeed
+             pa.table({"a": pa.array([[1, 2], [3]]),
+                       "i": pa.array([2, 0])}),
+             [], [(2,)],
+             plan=lambda scan: {
+                 "kind": "project",
+                 "exprs": [_fn("element_at", _col(0), _col(1),
+                               rt="int64")],
+                 "names": ["v"],
+                 "input": {"kind": "filter",
+                           "predicates": [_bin("!=", _col(1), _lit(0))],
+                           "input": scan}}),
     ]
 
 
@@ -835,4 +862,461 @@ def _hash_multi():
              pa.table({"s": pa.array(["Spark"])}),
              [_fn("xxhash64", _col(0), rt="int64")],
              [(-4294468057691064905,)]),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# wave 2: collections with NaN, regexp backrefs, months_between time
+# fraction, generate / expand / limit operators, string + math edges
+# ---------------------------------------------------------------------------
+
+@_suite("CollectionNaNSuite")
+def _collection_nan():
+    return [
+        Case("array_contains matches NaN (ordering.equiv)",
+             pa.table({"a": pa.array([[1.0, NAN]])}),
+             [_fn("array_contains", _col(0), _lit(NAN, "float64"),
+                  rt="bool")],
+             [(True,)]),
+        Case("array_contains: no match + null element is null",
+             pa.table({"a": pa.array([[1, None], [1, 2]],
+                                     pa.list_(pa.int64()))}),
+             [_fn("array_contains", _col(0), _lit(9), rt="bool")],
+             [(None,), (False,)]),
+        Case("array_max treats NaN as largest",
+             pa.table({"a": pa.array([[1.0, NAN, 2.0]])}),
+             [_fn("array_max", _col(0), rt="float64")],
+             [(NAN,)]),
+        Case("array_min skips NaN",
+             pa.table({"a": pa.array([[1.0, NAN, 2.0]])}),
+             [_fn("array_min", _col(0), rt="float64")],
+             [(1.0,)]),
+        Case("array_min of all-NaN array is NaN",
+             pa.table({"a": pa.array([[NAN, NAN]])}),
+             [_fn("array_min", _col(0), rt="float64")],
+             [(NAN,)]),
+        Case("concat_ws flattens array arguments",
+             pa.table({"a": pa.array([["a", "b"]]),
+                       "s": pa.array(["z"])}),
+             [_fn("concat_ws", _lit(",", "utf8"), _col(0), _col(1),
+                  rt="utf8")],
+             [("a,b,z",)]),
+        Case("concat_ws skips null elements inside arrays",
+             pa.table({"a": pa.array([["a", None, "c"]])}),
+             [_fn("concat_ws", _lit("-", "utf8"), _col(0), rt="utf8")],
+             [("a-c",)]),
+    ]
+
+
+@_suite("RegexpBackrefSuite")
+def _regexp_backref():
+    return [
+        Case("regexp_replace substitutes $1 group references",
+             pa.table({"s": pa.array(["a1b2"])}),
+             [_fn("regexp_replace", _col(0), _lit("(\\d)", "utf8"),
+                  _lit("[$1]", "utf8"), rt="utf8")],
+             [("a[1]b[2]",)]),
+        Case("regexp_replace swaps two groups",
+             pa.table({"s": pa.array(["john smith"])}),
+             [_fn("regexp_replace", _col(0),
+                  _lit("(\\w+) (\\w+)", "utf8"),
+                  _lit("$2 $1", "utf8"), rt="utf8")],
+             [("smith john",)]),
+        Case("escaped dollar stays literal",
+             pa.table({"s": pa.array(["x"])}),
+             [_fn("regexp_replace", _col(0), _lit("x", "utf8"),
+                  _lit("\\$9", "utf8"), rt="utf8")],
+             [("$9",)]),
+        Case("regexp_extract group 0 is the whole match",
+             pa.table({"s": pa.array(["a1", "zzz"])}),
+             [_fn("regexp_extract", _col(0), _lit("([a-z])(\\d)", "utf8"),
+                  _lit(0), rt="utf8")],
+             [("a1",), ("",)]),
+        Case("unmatched optional group extracts empty string",
+             pa.table({"s": pa.array(["a1", "b"])}),
+             [_fn("regexp_extract", _col(0),
+                  _lit("([a-z])(\\d)?", "utf8"), _lit(2), rt="utf8")],
+             [("1",), ("",)]),
+    ]
+
+
+@_suite("MonthsBetweenSuite")
+def _months_between_suite():
+    import numpy as _np
+
+    def ts(s):
+        return pa.array([_np.datetime64(s, "us")], pa.timestamp("us"))
+    return [
+        Case("doc example includes the time-of-day fraction",
+             pa.table({"a": ts("1997-02-28T10:30:00"),
+                       "b": ts("1996-10-30T00:00:00")}),
+             [_fn("months_between", _col(0), _col(1), rt="float64")],
+             [(3.94959677,)]),
+        Case("same day-of-month ignores time of day",
+             pa.table({"a": ts("2020-03-15T23:00:00"),
+                       "b": ts("2020-01-15T01:00:00")}),
+             [_fn("months_between", _col(0), _col(1), rt="float64")],
+             [(2.0,)]),
+        Case("both month-ends are integral",
+             pa.table({"a": ts("2020-02-29T12:00:00"),
+                       "b": ts("2019-11-30T00:00:00")}),
+             [_fn("months_between", _col(0), _col(1), rt="float64")],
+             [(3.0,)]),
+        Case("negative when first is earlier",
+             pa.table({"a": ts("2020-01-10T00:00:00"),
+                       "b": ts("2020-02-10T00:00:00")}),
+             [_fn("months_between", _col(0), _col(1), rt="float64")],
+             [(-1.0,)]),
+    ]
+
+
+@_suite("GenerateOperatorSuite")
+def _generate_operator():
+    t = pa.table({"id": pa.array([1, 2, 3]),
+                  "a": pa.array([[10, 20], [], None])})
+
+    def gen_plan(kind, outer):
+        def mk(scan):
+            return {"kind": "generate",
+                    "generator": {"kind": kind, "child": _col(1),
+                                  "outer": outer},
+                    "required_cols": [0], "input": scan}
+        return mk
+    jt = pa.table({"j": pa.array(['{"a": 1, "b": "x"}', "bad", None])})
+
+    def json_tuple_plan(scan):
+        return {"kind": "generate",
+                "generator": {"kind": "json_tuple", "child": _col(0),
+                              "fields": ["a", "b"]},
+                "required_cols": [], "input": scan}
+    return [
+        Case("explode drops empty and null arrays",
+             t, [], [(1, 10), (1, 20)],
+             plan=gen_plan("explode", False)),
+        Case("explode_outer keeps them as null rows",
+             t, [], [(1, 10), (1, 20), (2, None), (3, None)],
+             plan=gen_plan("explode", True)),
+        Case("posexplode emits 0-based positions",
+             t, [], [(1, 0, 10), (1, 1, 20)],
+             plan=gen_plan("posexplode", False)),
+        Case("posexplode_outer null position on empty",
+             t, [], [(1, 0, 10), (1, 1, 20), (2, None, None),
+                     (3, None, None)],
+             plan=gen_plan("posexplode", True)),
+        Case("json_tuple extracts fields, null row on bad json",
+             jt, [], [("1", "x"), (None, None), (None, None)],
+             plan=json_tuple_plan),
+    ]
+
+
+@_suite("ExpandUnionLimitSuite")
+def _expand_union_limit():
+    t = pa.table({"a": pa.array([1, 2]), "b": pa.array([10, 20])})
+
+    def expand_plan(scan):
+        return {"kind": "expand",
+                "projections": [[_col(0), _lit(None, "int64")],
+                                [_lit(None, "int64"), _col(1)]],
+                "names": ["a", "b"], "input": scan}
+
+    def union_plan(scan, scan2):
+        return {"kind": "union", "inputs": [scan, scan2]}
+
+    def limit_plan(limit, offset):
+        def mk(scan):
+            return {"kind": "limit", "limit": limit, "offset": offset,
+                    "input": scan}
+        return mk
+    five = pa.table({"x": pa.array([1, 2, 3, 4, 5])})
+    return [
+        Case("expand replicates each row per projection (rollup shape)",
+             t, [], [(1, None), (2, None), (None, 10), (None, 20)],
+             unordered=True, plan=expand_plan),
+        Case("union concatenates without dedup",
+             pa.table({"x": pa.array([1, 2])}), [],
+             [(1,), (2,), (2,), (3,)], unordered=True,
+             input2=pa.table({"x": pa.array([2, 3])}),
+             plan=union_plan),
+        Case("limit with offset skips then takes",
+             five, [], [(2,), (3,)], plan=limit_plan(2, 1)),
+        Case("limit beyond input is the whole input",
+             five, [], [(1,), (2,), (3,), (4,), (5,)],
+             plan=limit_plan(99, 0)),
+        Case("offset beyond input is empty",
+             five, [], [], plan=limit_plan(5, 99)),
+    ]
+
+
+@_suite("MathIntegerEdgeSuite")
+def _math_integer_edge():
+    return [
+        Case("abs of int64 min wraps to itself (non-ANSI)",
+             pa.table({"x": pa.array([I64MIN, -7])}),
+             [_fn("abs", _col(0), rt="int64")],
+             [(I64MIN,), (7,)]),
+        Case("int32 addition wraps at int32 width",
+             pa.table({"a": pa.array([I32MAX], pa.int32()),
+                       "b": pa.array([1], pa.int32())}),
+             [_bin("+", _col(0), _col(1))],
+             [(I32MIN,)]),
+        Case("int32 multiplication wraps at int32 width",
+             pa.table({"a": pa.array([1 << 30], pa.int32())}),
+             [_bin("*", _col(0), _lit(4, "int32"))],
+             [(0,)]),
+        Case("float modulo sign follows dividend",
+             pa.table({"a": pa.array([7.5, -7.5])}),
+             [_bin("%", _col(0), _lit(3.0, "float64"))],
+             [(1.5,), (-1.5,)]),
+        Case("pmod of float is non-negative",
+             pa.table({"a": pa.array([-7.0])}),
+             [_bin("pmod", _col(0), _lit(3.0, "float64"))],
+             [(2.0,)]),
+        Case("round with negative digits",
+             pa.table({"x": pa.array([1254.0, 1249.0])}),
+             [_fn("round", _col(0), _lit(-2), rt="float64")],
+             [(1300.0,), (1200.0,)]),
+        Case("sqrt of negative zero is negative zero (IEEE)",
+             pa.table({"x": pa.array([-0.0])}),
+             [_fn("sqrt", _col(0), rt="float64")],
+             [(-0.0,)]),
+        Case("signum of NaN is NaN",
+             pa.table({"x": pa.array([NAN, -0.0])}),
+             [_fn("signum", _col(0), rt="float64")],
+             [(NAN,), (-0.0,)]),
+    ]
+
+
+@_suite("StringFnEdgeSuite")
+def _string_fn_edge():
+    return [
+        Case("lpad cycles a multi-char pad",
+             pa.table({"s": pa.array(["7"])}),
+             [_fn("lpad", _col(0), _lit(5), _lit("xy", "utf8"),
+                  rt="utf8")],
+             [("xyxy7",)]),
+        Case("repeat of zero or negative count is empty",
+             pa.table({"s": pa.array(["ab"])}),
+             [_fn("repeat", _col(0), _lit(0), rt="utf8"),
+              _fn("repeat", _col(0), _lit(-1), rt="utf8")],
+             [("", "")]),
+        Case("ascii returns the first code point, 0 for empty",
+             pa.table({"s": pa.array(["€x", "", "A"])}),
+             [_fn("ascii", _col(0), rt="int32")],
+             [(8364,), (0,), (65,)]),
+        Case("reverse is character-wise, not byte-wise",
+             pa.table({"s": pa.array(["ab€"])}),
+             [_fn("reverse", _col(0), rt="utf8")],
+             [("€ba",)]),
+        Case("substring_index with negative count takes from the right",
+             pa.table({"s": pa.array(["a.b.c.d"])}),
+             [_fn("substring_index", _col(0), _lit(".", "utf8"),
+                  _lit(-2), rt="utf8")],
+             [("c.d",)]),
+        Case("locate start beyond length is 0",
+             pa.table({"s": pa.array(["hello"])}),
+             [_fn("locate", _lit("l", "utf8"), _col(0), _lit(99),
+                  rt="int32")],
+             [(0,)]),
+        Case("trim of only-space strings is empty not null",
+             pa.table({"s": pa.array(["   ", ""])}),
+             [_fn("trim", _col(0), rt="utf8")],
+             [("",), ("",)]),
+    ]
+
+
+@_suite("DateTruncExtSuite")
+def _date_trunc_ext():
+    import numpy as _np
+    t = pa.table({"t": pa.array([_np.datetime64("2015-03-05T09:32:05.359",
+                                                "us")],
+                                pa.timestamp("us"))})
+
+    def dt(*a):
+        return _dt.datetime(*a)
+    return [
+        Case("date_trunc across the unit ladder",
+             t, [_fn("date_trunc", _lit("MINUTE", "utf8"), _col(0),
+                     rt="timestamp_us"),
+                 _fn("date_trunc", _lit("DAY", "utf8"), _col(0),
+                     rt="timestamp_us"),
+                 _fn("date_trunc", _lit("WEEK", "utf8"), _col(0),
+                     rt="timestamp_us"),
+                 _fn("date_trunc", _lit("QUARTER", "utf8"), _col(0),
+                     rt="timestamp_us"),
+                 _fn("date_trunc", _lit("YEAR", "utf8"), _col(0),
+                     rt="timestamp_us")],
+             [(dt(2015, 3, 5, 9, 32), dt(2015, 3, 5),
+               dt(2015, 3, 2), dt(2015, 1, 1), dt(2015, 1, 1))]),
+        Case("date_trunc SECOND drops fractional seconds",
+             t, [_fn("date_trunc", _lit("SECOND", "utf8"), _col(0),
+                     rt="timestamp_us")],
+             [(dt(2015, 3, 5, 9, 32, 5),)]),
+        Case("date_trunc HOUR",
+             t, [_fn("date_trunc", _lit("HOUR", "utf8"), _col(0),
+                     rt="timestamp_us")],
+             [(dt(2015, 3, 5, 9),)]),
+    ]
+
+
+@_suite("ConditionalExtSuite")
+def _conditional_ext():
+    return [
+        Case("case takes the FIRST matching branch",
+             pa.table({"x": pa.array([5])}),
+             [{"kind": "case",
+               "branches": [[_bin(">", _col(0), _lit(1)), _lit(10)],
+                            [_bin(">", _col(0), _lit(2)), _lit(20)]],
+               "else": _lit(0)}],
+             [(10,)]),
+        Case("case with null condition falls through",
+             pa.table({"x": pa.array([None], pa.int64())}),
+             [{"kind": "case",
+               "branches": [[_bin(">", _col(0), _lit(1)), _lit(10)]],
+               "else": _lit(99)}],
+             [(99,)]),
+        Case("nested coalesce picks leftmost non-null",
+             pa.table({"a": pa.array([None, 1], pa.int64()),
+                       "b": pa.array([None, 9], pa.int64())}),
+             [{"kind": "coalesce",
+               "args": [_col(0), _col(1), _lit(7)]}],
+             [(7,), (1,)]),
+        Case("if propagates the chosen branch's null",
+             pa.table({"c": pa.array([True, False]),
+                       "x": pa.array([None, None], pa.int64())}),
+             [{"kind": "if", "cond": _col(0), "then": _col(1),
+               "else": _lit(3)}],
+             [(None,), (3,)]),
+    ]
+
+
+@_suite("SortTypesSuite")
+def _sort_types():
+    import numpy as _np
+    return [
+        Case("date32 sorts chronologically",
+             pa.table({"d": pa.array([_dt.date(2020, 5, 1),
+                                      _dt.date(2019, 1, 1), None],
+                                     pa.date32())}),
+             [], [(None,), (_dt.date(2019, 1, 1),),
+                  (_dt.date(2020, 5, 1),)],
+             plan=_sort_plan((0, False, True))),
+        Case("bool sorts false before true",
+             pa.table({"b": pa.array([True, False, None])}),
+             [], [(None,), (False,), (True,)],
+             plan=_sort_plan((0, False, True))),
+        Case("negative zero and zero are equal sort keys",
+             pa.table({"x": pa.array([0.0, -0.0, -1.0])}),
+             [], [(-1.0,), (-0.0,), (0.0,)], unordered=True,
+             plan=_sort_plan((0, False, True))),
+        Case("timestamp sorts by instant",
+             pa.table({"t": pa.array([_np.datetime64("2020-01-02", "us"),
+                                      _np.datetime64("2020-01-01", "us")],
+                                     pa.timestamp("us"))}),
+             [], [(_dt.datetime(2020, 1, 1),),
+                  (_dt.datetime(2020, 1, 2),)],
+             plan=_sort_plan((0, False, True))),
+    ]
+
+
+@_suite("AggTypedMinMaxSuite")
+def _agg_typed_minmax():
+    t = pa.table({"k": pa.array(["g1", "g1", "g2"]),
+                  "s": pa.array(["b", "a", None]),
+                  "b": pa.array([True, False, None]),
+                  "d": pa.array([_dt.date(2020, 1, 1), None,
+                                 _dt.date(2019, 1, 1)], pa.date32())})
+    return [
+        Case("min/max over utf8 is lexicographic, host-accumulated",
+             t, [], [("g1", "a", "b"), ("g2", None, None)],
+             unordered=True,
+             plan=_agg_plan((0,), [("min", _col(1), "mn"),
+                                   ("max", _col(1), "mx")])),
+        Case("min/max over bool orders false < true",
+             t, [], [("g1", False, True), ("g2", None, None)],
+             unordered=True,
+             plan=_agg_plan((0,), [("min", _col(2), "mn"),
+                                   ("max", _col(2), "mx")])),
+        Case("min over date32 is chronological",
+             t, [], [("g1", _dt.date(2020, 1, 1)),
+                     ("g2", _dt.date(2019, 1, 1))],
+             unordered=True,
+             plan=_agg_plan((0,), [("min", _col(3), "mn")])),
+        Case("global min/max over utf8 without grouping",
+             pa.table({"s": pa.array(["m", "z", "a"])}),
+             [], [("a", "z")],
+             plan=_agg_plan((), [("min", _col(0), "mn"),
+                                 ("max", _col(0), "mx")])),
+        Case("sum of float64 propagates NaN",
+             pa.table({"x": pa.array([1.0, NAN])}),
+             [], [(NAN,)],
+             plan=_agg_plan((), [("sum", _col(0), "s")])),
+    ]
+
+
+@_suite("BroadcastJoinSuite")
+def _broadcast_join():
+    l = pa.table({"a": pa.array([1, 2, 3]), "lv": pa.array([10, 20, 30])})
+    r = pa.table({"b": pa.array([2, 3, 4]), "rv": pa.array([200, 300,
+                                                            400])})
+    return [
+        Case("broadcast inner matches shuffled-hash results",
+             l, [], [(2, 20, 2, 200), (3, 30, 3, 300)], unordered=True,
+             input2=r,
+             plan=_join_plan("broadcast_join", "inner",
+                             build_side="right")),
+        Case("broadcast left outer null-extends",
+             l, [], [(1, 10, None, None), (2, 20, 2, 200),
+                     (3, 30, 3, 300)], unordered=True, input2=r,
+             plan=_join_plan("broadcast_join", "left",
+                             build_side="right")),
+        Case("nested-loop join applies a non-equi filter",
+             l, [],
+             [(1, 10, 2, 200), (1, 10, 3, 300), (1, 10, 4, 400),
+              (2, 20, 3, 300), (2, 20, 4, 400), (3, 30, 4, 400)],
+             unordered=True, input2=r,
+             plan=lambda scan, scan2: {
+                 "kind": "broadcast_nested_loop_join",
+                 "left": scan, "right": scan2, "join_type": "inner",
+                 "build_side": "right",
+                 "join_filter": _bin("<", _col(0), _col(2))}),
+        Case("join filter references the joined row",
+             l, [], [(3, 30, 3, 300)], unordered=True, input2=r,
+             plan=lambda scan, scan2: dict(
+                 _join_plan("hash_join", "inner")(scan, scan2),
+                 join_filter=_bin(">", _col(3), _lit(200)))),
+        Case("right outer keeps dangling build rows",
+             l, [], [(2, 20, 2, 200), (3, 30, 3, 300),
+                     (None, None, 4, 400)], unordered=True, input2=r,
+             plan=_join_plan("sort_merge_join", "right")),
+    ]
+
+
+@_suite("TimestampFieldsExtSuite")
+def _timestamp_fields_ext():
+    import numpy as _np
+    t = pa.table({"t": pa.array([_np.datetime64("1970-01-01T00:00:00",
+                                                "us"),
+                                 _np.datetime64("2015-03-05T23:59:59",
+                                                "us")],
+                                pa.timestamp("us"))})
+    return [
+        Case("hour/minute/second at the epoch and day end",
+             t, [_fn("hour", _col(0), rt="int32"),
+                 _fn("minute", _col(0), rt="int32"),
+                 _fn("second", _col(0), rt="int32")],
+             [(0, 0, 0), (23, 59, 59)]),
+        Case("from_unixtime of zero is the epoch (UTC session)",
+             pa.table({"x": pa.array([0])}),
+             [_fn("from_unixtime", _col(0), rt="utf8")],
+             [("1970-01-01 00:00:00",)]),
+        Case("unix_timestamp round trips from_unixtime",
+             pa.table({"x": pa.array([1425547925])}),
+             [_fn("unix_timestamp",
+                  _fn("from_unixtime", _col(0), rt="utf8"),
+                  rt="int64")],
+             [(1425547925,)]),
+        Case("to_date truncates a timestamp string",
+             pa.table({"s": pa.array(["2015-03-05 09:32:05"])}),
+             [_fn("to_date", _col(0), rt="date32")],
+             [(_dt.date(2015, 3, 5),)]),
     ]

@@ -212,6 +212,34 @@ class MinMaxAgg(AggFunction):
     def output_type(self, s):
         return self.children[0].data_type(s)
 
+    @property
+    def is_host(self) -> bool:
+        # min/max over utf8/binary accumulates host-side — there is no
+        # device dtype for var-width values (Spark Min/Max on strings)
+        return (self.input_type is not None
+                and not self.input_type.is_fixed_width)
+
+    def host_update(self, args: List[pa.Array], gids: np.ndarray,
+                    num_segments: int) -> List[pa.Array]:
+        vals = args[0]
+        out: List = [None] * num_segments
+        for v, g in zip(vals, gids):
+            if g < num_segments and v.is_valid:
+                pv = v.as_py()
+                cur = out[g]
+                if cur is None or (pv < cur if self.minimum
+                                   else pv > cur):
+                    out[g] = pv
+        return [pa.array(out, type=vals.type)]
+
+    def host_merge(self, accs: List[pa.Array], gids: np.ndarray,
+                   num_segments: int) -> List[pa.Array]:
+        # min of mins / max of maxes: identical fold over the acc column
+        return self.host_update(accs, gids, num_segments)
+
+    def host_eval(self, accs: List[pa.Array]) -> pa.Array:
+        return accs[0]
+
     def _reduce(self, data, valid, gids, n):
         xp = xp_of(data, valid)
         vals, nan_mask = data, None
