@@ -270,6 +270,17 @@ class ColumnBatch:
             base = base & self.selection
         return base
 
+    def selected_mask(self, n: Optional[int] = None):
+        """HOST bool mask over the first `n` (default num_rows) rows:
+        True where the row survives the selection.  The one sanctioned
+        way for row-level raise paths (ANSI casts, element_at(…, 0)) to
+        skip rows a filter already deselected — filters only set
+        `selection` without compacting, so expression evaluators still
+        see deselected rows' values (see Cast._ansi_check_device)."""
+        import numpy as _np
+        n = self.num_rows if n is None else n
+        return _np.asarray(self.row_mask())[:n]
+
     def selected_count(self) -> int:
         """Host-synced surviving row count (one scalar D2H, cached — on a
         tunneled device every sync costs a full round trip)."""

@@ -55,6 +55,29 @@ def _measure_rtt_ms() -> float:
     return samples[1] * 1000.0
 
 
+def _enable_compile_cache(jax) -> None:
+    """Persistent XLA compilation cache (config COMPILE_CACHE_DIR).
+    Device-placement cold starts are COMPILE-bound: a tiny wire query
+    measured 319.9s cold vs 25.2s with a warm on-disk cache through the
+    tunneled backend.  Honors a user-set jax_compilation_cache_dir."""
+    import os
+
+    from blaze_tpu import config
+    path = config.COMPILE_CACHE_DIR.get()
+    if not path:
+        return
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            return  # caller already configured one
+        path = os.path.expanduser(path)
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.1)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        log.warning("persistent compile cache unavailable", exc_info=True)
+
+
 def ensure_placement() -> PlacementInfo:
     """Idempotent; called at runtime startup (NativeExecutionRuntime /
     DagScheduler).  May switch jax's default device to the CPU backend."""
@@ -65,6 +88,7 @@ def ensure_placement() -> PlacementInfo:
         import jax
 
         from blaze_tpu import config
+        _enable_compile_cache(jax)
         policy = config.PLACEMENT.get()
         if policy == "host":
             # forced host must NOT touch the accelerator at all — the

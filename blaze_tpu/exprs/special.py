@@ -170,10 +170,8 @@ class GetIndexedField(PhysicalExpr):
                        if arr.null_count else np.ones(len(arr), bool))
             in_bounds = (self.index >= 0) & (idx < ends)
             if config.ANSI_ENABLED.get():
-                # filtered-out rows must not raise: filters only set the
-                # selection mask without compacting (see batch.py and
-                # Cast._ansi_check_device, which ANDs the same mask)
-                sel = np.asarray(batch.row_mask())[:len(arr)]
+                # filtered-out rows must not raise (selected_mask docs)
+                sel = batch.selected_mask(len(arr))
                 if bool((present & ~in_bounds & sel).any()):
                     raise ValueError(
                         f"[INVALID_ARRAY_INDEX] index {self.index} out "

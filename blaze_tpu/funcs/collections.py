@@ -210,41 +210,49 @@ def _map_values(args, batch, out_type):
 
 @register("element_at")
 def _element_at(args, batch, out_type):
-    import numpy as _np
-
     from blaze_tpu import config
     a, k = _host(args, batch)
-    # raises below must only fire for SELECTED rows: filters set the
-    # selection mask without compacting (batch.py row_mask contract)
-    sel = _np.asarray(batch.row_mask())[:batch.num_rows]
     ansi = config.ANSI_ENABLED.get()
+    # raises must only fire for SELECTED rows (batch.selected_mask);
+    # the mask costs a device sync, so fetch it lazily at first need
+    sel = None
+
+    def _selected(row: int) -> bool:
+        nonlocal sel
+        if sel is None:
+            sel = batch.selected_mask()
+        return row >= len(sel) or bool(sel[row])
+
     py = []
     if pa.types.is_map(a.type):
-        for x, key in zip(a, k):
+        for row, (x, key) in enumerate(zip(a, k)):
             if not x.is_valid or not key.is_valid:
                 py.append(None)
                 continue
-            val = None
+            val, hit = None, False
             for kk, vv in x.as_py() or []:
                 if kk == key.as_py():
-                    val = vv
+                    val, hit = vv, True
+            if not hit and ansi and _selected(row):
+                raise ValueError(
+                    f"[MAP_KEY_DOES_NOT_EXIST] key {key.as_py()!r} "
+                    f"not found (ANSI mode)")
             py.append(val)
         return ColVal.host(out_type, pa.array(py, type=a.type.item_type))
     for row, (x, idx) in enumerate(zip(a, k)):
         if not x.is_valid or not idx.is_valid:
             py.append(None)
             continue
-        selected = row >= len(sel) or bool(sel[row])
         lst = x.as_py() or []
         i = int(idx.as_py())
         # Spark element_at is 1-based; negative indexes from the end;
         # index 0 is an error in every mode (ElementAt.nullSafeEval)
-        if i == 0 and selected:
+        if i == 0 and _selected(row):
             raise ValueError(
                 "[INVALID_INDEX_OF_ZERO] element_at: SQL array indices "
                 "start at 1")
         if i == 0 or abs(i) > len(lst):
-            if ansi and i != 0 and selected:
+            if ansi and i != 0 and _selected(row):
                 raise ValueError(
                     f"[INVALID_ARRAY_INDEX_IN_ELEMENT_AT] index {i} "
                     f"out of bounds for array of {len(lst)} elements")

@@ -323,11 +323,17 @@ def _regexp_replace(args, batch, out_type):
     repl = const_arg(args[2], batch, "regexp_replace") if len(args) > 2 else ""
     if pattern is None or repl is None:
         return _null_utf8(batch.num_rows)
-    # Spark uses Java-style $1 group references; RE2 (and Python re)
-    # spell them \1 — translate unescaped $N, keep \$ literal
+    # Spark uses Java Matcher replacement semantics: $N is a group
+    # reference, \$ a literal dollar, \X a literal X.  RE2 spells group
+    # refs \N — protect escapes first (a literal \1 must NOT become a
+    # group ref, an escaped \$ must survive the $N translation), then
+    # translate unescaped $N (single digit: RE2 rewrites know \0-\9).
     import re as _re
-    repl = _re.sub(r"\\\$", "\x00", repl)
-    repl = _re.sub(r"\$(\d+)", r"\\\1", repl)
+    repl = _re.sub(r"\\(.)",
+                   lambda m: "\x00" if m.group(1) == "$"
+                   else ("\\\\" if m.group(1) == "\\" else m.group(1)),
+                   repl)
+    repl = _re.sub(r"\$(\d)", r"\\\1", repl)
     repl = repl.replace("\x00", "$")
     return ColVal.host(UTF8, pc.replace_substring_regex(
         arrs[0], pattern=pattern, replacement=repl))

@@ -326,6 +326,12 @@ def _ansi():
              [_fn("element_at", _col(0), _lit(5), rt="int64")],
              [], confs=_ANSI_ON,
              raises="INVALID_ARRAY_INDEX_IN_ELEMENT_AT"),
+        Case("ANSI: element_at on a missing map key raises",
+             pa.table({"m": pa.array([[("a", 1)]],
+                                     pa.map_(pa.utf8(), pa.int64()))}),
+             [_fn("element_at", _col(0), _lit("zz", "utf8"),
+                  rt="int64")],
+             [], confs=_ANSI_ON, raises="MAP_KEY_DOES_NOT_EXIST"),
         Case("months_between roundOff=false keeps full precision",
              pa.table({"a": pa.array([_dt.date(2020, 1, 14)],
                                      pa.date32()),
@@ -927,6 +933,11 @@ def _regexp_backref():
              [_fn("regexp_replace", _col(0), _lit("x", "utf8"),
                   _lit("\\$9", "utf8"), rt="utf8")],
              [("$9",)]),
+        Case("backslash-digit is a literal, not a group ref (Java)",
+             pa.table({"s": pa.array(["ab"])}),
+             [_fn("regexp_replace", _col(0), _lit("(a)b", "utf8"),
+                  _lit("\\1", "utf8"), rt="utf8")],
+             [("1",)]),
         Case("regexp_extract group 0 is the whole match",
              pa.table({"s": pa.array(["a1", "zzz"])}),
              [_fn("regexp_extract", _col(0), _lit("([a-z])(\\d)", "utf8"),
