@@ -977,6 +977,7 @@ def device_compute_loop(sr_paths, dd_path, iters: int = 32):
         reps = max(1, -(-(1 << 20) // t.num_rows))
         if reps > 1:
             t = pa.concat_tables([t] * reps)
+        t = t.slice(0, 1 << 20) if t.num_rows >= (1 << 20) else t
     n = t.num_rows
 
     rollup = pa.table({

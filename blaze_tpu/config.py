@@ -313,6 +313,18 @@ PLACEMENT_RTT_THRESHOLD_MS = float_conf(
     "auron.tpu.placement.rtt.threshold.ms", 5.0,
     "Auto-placement cutoff: measured per-dispatch round trip above this "
     "means the accelerator is remote/tunneled and stages run on host XLA.")
+FUSED_DICT_DEVICE_ENABLE = bool_conf(
+    "auron.tpu.fused.dictDevice", True,
+    "Device path for var-width (utf8/binary) group keys in fused "
+    "stages: every key column dictionary-encodes to dense i32 codes "
+    "against an accumulated per-key dictionary, the device groups by "
+    "the packed code id with the sort-free dense kernel, and keys "
+    "decode back through the dictionaries at emit (SURVEY §7 "
+    "hard-part #1; parquet dictionary-code strategy).")
+FUSED_DICT_DEVICE_MAX_SLOTS = int_conf(
+    "auron.tpu.fused.dictDevice.maxSlots", 1 << 22,
+    "Dense code-table ceiling for the dict-device strategy; growth "
+    "past it falls back to the host-vectorized aggregation.")
 COMPILE_CACHE_DIR = str_conf(
     "auron.tpu.compile.cache.dir", "~/.cache/blaze_tpu/xla",
     "Persistent XLA compilation cache directory (jax_compilation_cache_"

@@ -96,6 +96,52 @@ class AggExec(ExecutionPlan):
             state.unregister()
 
 
+def incremental_dict_codes(arr: pa.Array, global_arr: Optional[pa.Array],
+                           cap: int):
+    """Dictionary-encode one batch column against an ACCUMULATED global
+    dictionary (first-seen order, stable across batches).  Shared by the
+    sorted agg engine (_AggState._dict_encode) and the fused dict-device
+    strategy (plan/fused.py _execute_dict_device) — the incremental
+    index_in / rank-among-new construction must never diverge between
+    them.  Floating keys normalize (-0.0 -> 0.0, NaN -> one canonical
+    bit pattern) BEFORE encoding, like Spark's NormalizeFloatingNumbers
+    upstream of grouping.  Returns (codes int64 np[cap], valid np[cap],
+    new_global_dict, grew)."""
+    import pyarrow.compute as pc
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if pa.types.is_floating(arr.type):
+        arr = pc.add(arr, 0.0)  # -0.0 + 0.0 == +0.0
+        nan = pa.scalar(float("nan"), type=arr.type)
+        arr = pc.if_else(pc.is_nan(arr), nan, arr)
+    enc = arr.dictionary_encode()
+    if global_arr is None:
+        global_arr = pa.array([], type=enc.dictionary.type)
+    local = enc.dictionary.cast(global_arr.type)
+    base = len(global_arr)
+    if base:
+        found = pc.index_in(local, value_set=global_arr)
+    else:
+        found = pa.nulls(len(local), type=pa.int32())
+    new_mask = np.asarray(pc.is_null(found))
+    grew = bool(new_mask.any())
+    if grew:
+        new_vals = local.filter(pa.array(new_mask))
+        global_arr = pa.concat_arrays(
+            [global_arr, new_vals]) if base else new_vals
+    # code per local value: existing position, or base + rank-among-new
+    new_rank = np.cumsum(new_mask) - 1
+    found_np = np.asarray(found.fill_null(0), dtype=np.int64)
+    mapping = np.where(new_mask, base + new_rank, found_np)
+    idx = enc.indices
+    valid = np.zeros(cap, dtype=bool)
+    valid[:len(arr)] = np.asarray(idx.is_valid())
+    codes = np.zeros(cap, dtype=np.int64)
+    codes[:len(arr)][valid[:len(arr)]] = mapping[
+        np.asarray(idx.fill_null(0), dtype=np.int64)[valid[:len(arr)]]]
+    return codes, valid, global_arr, grew
+
+
 class _AggState(MemConsumer):
     """Per-partition aggregation state (the AggTable analog)."""
 
@@ -300,37 +346,13 @@ class _AggState(MemConsumer):
 
     def _dict_encode(self, i: int, arr: pa.Array, cap: int
                      ) -> Tuple[jax.Array, jax.Array]:
-        import pyarrow.compute as pc
-        if isinstance(arr, pa.ChunkedArray):
-            arr = arr.combine_chunks()
-        enc = arr.dictionary_encode()
-        global_arr = self.dict_arrays[i]
-        local = enc.dictionary.cast(global_arr.type)
-        base = len(global_arr)
-        if base:
-            found = pc.index_in(local, value_set=global_arr)
-        else:
-            found = pa.nulls(len(local), type=pa.int32())
-        new_mask = np.asarray(pc.is_null(found))
-        n_new = int(new_mask.sum())
-        if n_new:
-            new_vals = local.filter(pa.array(new_mask))
-            global_arr = pa.concat_arrays(
-                [global_arr, new_vals]) if base else new_vals
+        codes, valid, global_arr, grew = incremental_dict_codes(
+            arr, self.dict_arrays[i], cap)
+        if grew:
             self.dict_arrays[i] = global_arr
             # dictionary growth counts against the budget (spill pressure
             # comes from the same MemManager the partials use)
             self.update_mem_used(self.buffered_bytes + self._dict_bytes())
-        # code per local value: existing position, or base + rank-among-new
-        new_rank = np.cumsum(new_mask) - 1
-        found_np = np.asarray(found.fill_null(0), dtype=np.int64)
-        mapping = np.where(new_mask, base + new_rank, found_np)
-        idx = enc.indices
-        valid = np.zeros(cap, dtype=bool)
-        valid[:len(arr)] = np.asarray(idx.is_valid())
-        codes = np.zeros(cap, dtype=np.int64)
-        codes[:len(arr)][valid[:len(arr)]] = mapping[
-            np.asarray(idx.fill_null(0), dtype=np.int64)[valid[:len(arr)]]]
         from blaze_tpu.bridge.placement import host_resident
         if host_resident():
             return codes, valid

@@ -131,10 +131,21 @@ def _try_fuse_agg(node: ExecutionPlan) -> Optional["FusedPartialAggExec"]:
         if not all(t.is_fixed_width or t.id == TypeId.UTF8
                    for t in key_types):
             return None
-        if not (host_resident()
-                and config.FUSED_HOST_VECTORIZED_ENABLE.get()
-                and _host_vectorized_eligible(groups, specs, in_schema)):
-            return None  # var-width keys only ride the host path
+        host_ok = (host_resident()
+                   and config.FUSED_HOST_VECTORIZED_ENABLE.get()
+                   and _host_vectorized_eligible(groups, specs, in_schema))
+        # device placement: utf8 keys ride the dict-code strategy —
+        # dictionary-encode to dense i32 codes, group on device
+        # (_execute_dict_device), decode at emit.  min/max over float
+        # args are excluded: the step's jnp.minimum folding propagates
+        # NaN where Spark's total order skips it (AggExec handles that;
+        # see MinMaxAgg._reduce)
+        dict_ok = (config.FUSED_DICT_DEVICE_ENABLE.get() and
+                   not any(rk in ("min", "max") and arg is not None
+                           and arg.data_type(in_schema).is_floating
+                           for rk, _ok, arg in specs))
+        if not host_ok and not dict_ok:
+            return None
 
     # dense needs integer keys with discoverable bounds
     ranges = None
@@ -546,6 +557,26 @@ class FusedPartialAggExec(ExecutionPlan):
 
     def execute(self, partition: int) -> BatchIterator:
         if self._has_var_keys and not self._use_host_vectorized():
+            if config.FUSED_DICT_DEVICE_ENABLE.get():
+                try:
+                    yield from self._execute_dict_device(partition)
+                    return
+                except _DictCapExceeded:
+                    # nothing emitted yet (dict path emits only at the
+                    # final drain).  Arrow's host agg is only a valid
+                    # stand-in where its semantics match; otherwise
+                    # re-run through the generic AggExec engine (exact
+                    # Spark semantics incl. float-key normalization)
+                    self.metrics.add("dict_device_fallback", 1)
+                    if self._host_vectorized_eligible():
+                        for rb in self._execute_host_vectorized(
+                                partition):
+                            yield ColumnBatch.from_arrow(rb)
+                    else:
+                        agg = AggExec(self.children[0],
+                                      self._group_exprs, self._aggs)
+                        yield from agg.execute(partition)
+                    return
             raise RuntimeError(
                 "fused utf8-key aggregation requires host placement "
                 "(placement changed after plan fusion?)")
@@ -1581,6 +1612,122 @@ class FusedPartialAggExec(ExecutionPlan):
             host_keys, [a[:count] for a in host_accs],
             [v[:count] for v in host_avalid])
 
+    # -- var-width keys on device: dictionary-code dense strategy ----------
+    # (VERDICT r4 #8 / SURVEY §7 hard-part #1: keep string group keys as
+    # dense integer codes so the device never touches bytes — the
+    # parquet-dictionary-code idea applied at the stage boundary)
+    def _execute_dict_device(self, partition: int) -> BatchIterator:
+        """Group by var-width keys ON DEVICE: every key column
+        dictionary-encodes (host, vectorized pyarrow) against an
+        accumulated per-key dictionary; the dense i32 codes pack into
+        one group id and aggregate through the same sort-free
+        scatter-reduce kernel as bounded int keys.  Dictionary growth
+        past a key's power-of-two capacity re-lays the table out host-
+        side (pure stride arithmetic) and recompiles once per doubling.
+        Keys decode back through the dictionaries only at emit."""
+        nkeys = len(self._group_exprs)
+        kinds = tuple(rk for rk, _ok, _a in self._specs)
+        dicts: List[Optional[pa.Array]] = [None] * nkeys
+        caps = [16] * nkeys
+        limit = config.FUSED_DICT_DEVICE_MAX_SLOTS.get()
+        carry = None  # (accs, avalid, occupied) device arrays
+        n_batches = 0
+
+        def total_slots(cs):
+            t = 1
+            for c in cs:
+                t *= (c + 1)  # +1: null slot per key (range 0..c-1)
+            return t
+
+        for batch in self.children[0].execute(partition):
+            cap = batch.capacity
+            sel = (batch.selected_mask() if batch.selection is not None
+                   else None)
+            code_cols = []
+            grew = False
+            for i, (e, _n) in enumerate(self._group_exprs):
+                arr = e.evaluate(batch).to_host(batch.num_rows)
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = arr.combine_chunks()
+                codes, valid, dicts[i] = _global_dict_codes(
+                    arr, dicts[i], cap, sel)
+                while len(dicts[i]) > caps[i]:
+                    caps[i] *= 2
+                    grew = True
+                code_cols.append((codes, valid))
+            if total_slots(caps) > limit:
+                raise _DictCapExceeded
+            if grew and carry is not None:
+                carry = _relayout_dict_table(carry, kinds,
+                                             self._acc_dtypes(),
+                                             old_caps, caps)
+            old_caps = list(caps)
+            ad, av = [], []
+            for _rk, _ok, arg in self._specs:
+                if arg is None:
+                    ad.append(None)
+                    av.append(None)
+                else:
+                    dv = arg.evaluate(batch).to_device(cap)
+                    ad.append(_pad_lane(dv.data))
+                    av.append(_pad_lane(dv.validity))
+            mask = _pad_lane(batch.row_mask())
+            pcap = mask.shape[0]
+            if carry is None:
+                carry = _init_carry(kinds, self._acc_dtypes(),
+                                    total_slots(caps))
+            step = _dict_dense_step(tuple(caps), kinds, pcap)
+            kd = tuple(_pad_lane(c) for c, _v in code_cols)
+            kv = tuple(_pad_lane(v) for _c, v in code_cols)
+            carry = step(carry, kd, kv, tuple(ad), tuple(av), mask)
+            n_batches += 1
+        self.metrics.add("fused_batches", n_batches)
+        self.metrics.add("dict_device_batches", n_batches)
+        if carry is None:
+            return
+        yield from self._emit_dict(carry, caps, dicts)
+
+    def _emit_dict(self, carry, caps, dicts) -> BatchIterator:
+        accs, avalid, occupied = carry
+        count = int(jnp.sum(occupied))
+        if count == 0:
+            return
+        num_slots = 1
+        for c in caps:
+            num_slots *= (c + 1)
+        padded = _bucket(count, num_slots)
+        slots_dev = jnp.nonzero(occupied, size=padded, fill_value=0)[0]
+        fetch = ([jnp.take(a, slots_dev) for a in accs],
+                 [jnp.take(v, slots_dev) for v in avalid],
+                 slots_dev)
+        host_accs, host_avalid, slots = jax.device_get(fetch)
+        slots = slots[:count]
+        ranges = [(0, c - 1) for c in caps]
+        decoded = unpack_dense_keys(slots, ranges, xp=np)
+        out_arrow = self._out_schema.to_arrow()
+        key_fields = [out_arrow.field(i) for i in range(len(dicts))]
+        arrays: List[pa.Array] = []
+        for (code, kvalid), d, f in zip(decoded, dicts, key_fields):
+            idx = pa.array(np.where(kvalid, code, 0), pa.int64(),
+                           mask=~kvalid)  # null code -> null key
+            arrays.append(d.take(idx).cast(f.type))
+        i = len(dicts)
+        for (_rk, out_kind, _arg), a, v in zip(self._specs, host_accs,
+                                               host_avalid):
+            f = out_arrow.field(i)
+            if out_kind == "count":
+                arrays.append(_to_arrow(a[:count],
+                                        np.ones(count, bool), f.type))
+            else:
+                arrays.append(_to_arrow(a[:count], v[:count], f.type))
+            i += 1
+        rb = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
+        bs = config.BATCH_SIZE.get()
+        for off in range(0, rb.num_rows, bs):
+            chunk = rb.slice(off, min(bs, rb.num_rows - off))
+            self.metrics.add("output_rows", chunk.num_rows)
+            yield ColumnBatch.from_arrow(chunk)
+
     # -- unbounded keys: device open-addressing hash table -----------------
     # (ref agg_hash_map.rs; replaces the earlier sort-based table — a
     # multi-operand lax.sort program takes minutes to COMPILE on TPU and
@@ -1997,6 +2144,99 @@ def _init_carry(kinds, acc_dtypes, num_slots: int):
     accs, avalid = init_accumulators(kinds, acc_dtypes, num_slots)
     occupied = jnp.zeros(num_slots, dtype=bool)
     return (accs, avalid, occupied)
+
+
+class _DictCapExceeded(Exception):
+    """Dict-device code table would exceed maxSlots; caller falls back."""
+
+
+def _global_dict_codes(arr: pa.Array, global_arr: Optional[pa.Array],
+                       cap: int, sel: Optional[np.ndarray] = None):
+    """Fused-stage wrapper over the SHARED incremental encoder
+    (ops/agg/exec.py incremental_dict_codes): i32 codes for the
+    pack_dense_keys_i32 tier, and filter-DESELECTED rows nulled out
+    BEFORE encoding so they can neither grow the dictionary (spurious
+    _DictCapExceeded on selective filters) nor inflate the code table
+    capacity — the agg mask drops them from the reduction anyway."""
+    from blaze_tpu.ops.agg.exec import incremental_dict_codes
+    if sel is not None and not sel.all():
+        import pyarrow.compute as pc
+        arr = pc.if_else(pa.array(sel[:len(arr)]), arr,
+                         pa.nulls(len(arr), arr.type))
+    codes, valid, global_arr, _grew = incremental_dict_codes(
+        arr, global_arr, cap)
+    return codes.astype(np.int32), valid, global_arr
+
+
+def _relayout_dict_table(carry, kinds, acc_dtypes, old_caps, new_caps):
+    """Move a dict-code dense table to a larger layout after dictionary
+    growth: decode occupied slots to per-key codes (pure stride math,
+    host-side), recompute slot ids under the new strides, scatter accs
+    1:1 (codes are unique per slot, no merging)."""
+    accs, avalid, occupied = jax.device_get(carry)
+    occ = np.nonzero(occupied)[0]
+    old_ranges = [(0, c - 1) for c in old_caps]
+    decoded = unpack_dense_keys(occ, old_ranges, xp=np)
+    new_total = 1
+    strides = []
+    for c in new_caps:
+        strides.append(new_total)
+        new_total *= (c + 1)
+    new_slot = np.zeros(len(occ), dtype=np.int64)
+    for (code, kvalid), c, stride in zip(decoded, new_caps, strides):
+        k = np.where(kvalid, code, c)  # null slot is code==cap
+        new_slot += k * stride
+    n_accs, n_avalid = [], []
+    from blaze_tpu.parallel.stage import init_accumulators
+    fresh_accs, fresh_avalid = init_accumulators(kinds, acc_dtypes,
+                                                 new_total)
+    for fa, a in zip(fresh_accs, accs):
+        na = np.asarray(fa).copy()
+        na[new_slot] = a[occ]
+        n_accs.append(jnp.asarray(na))
+    for fv, v in zip(fresh_avalid, avalid):
+        nv = np.asarray(fv).copy()
+        nv[new_slot] = v[occ]
+        n_avalid.append(jnp.asarray(nv))
+    n_occ = np.zeros(new_total, dtype=bool)
+    n_occ[new_slot] = True
+    return (tuple(n_accs), tuple(n_avalid), jnp.asarray(n_occ))
+
+
+@functools.lru_cache(maxsize=64)
+def _dict_dense_step(caps: tuple, kinds: tuple, capacity: int):
+    """One jit program per (caps, kinds, capacity): pack the per-key
+    codes into a dense group id and fold the batch into the carry —
+    combine is elementwise (slots are stable), so the carry never
+    round-trips to host between batches."""
+    from blaze_tpu.parallel.stage import (_identity, dense_partial_agg,
+                                          pack_dense_keys_i32)
+    ranges = tuple((0, c - 1) for c in caps)
+
+    @jax.jit
+    def step(carry, kd, kv, ad, av, mask):
+        accs, avalid, occupied = carry
+        gid, total = pack_dense_keys_i32(list(zip(kd, kv)), ranges)
+        specs = [(k, a, v) for k, a, v in zip(kinds, ad, av)]
+        b_accs, b_avalid, b_occ = dense_partial_agg(
+            gid.astype(jnp.int64), total, specs, mask)
+        out_accs, out_avalid = [], []
+        for kind, ca, cv, ba, bv in zip(kinds, accs, avalid,
+                                        b_accs, b_avalid):
+            if kind in ("sum", "count"):
+                out_accs.append(ca + ba)  # empty batch slots are 0
+            elif kind == "min":
+                # dense_partial_agg ZEROES empty slots — re-identity
+                # them or a later batch drags every min toward 0
+                ba = jnp.where(bv, ba, _identity(ba.dtype, False))
+                out_accs.append(jnp.minimum(ca, ba))
+            else:  # max
+                ba = jnp.where(bv, ba, _identity(ba.dtype, True))
+                out_accs.append(jnp.maximum(ca, ba))
+            out_avalid.append(cv | bv)
+        return (tuple(out_accs), tuple(out_avalid), occupied | b_occ)
+
+    return step
 
 
 def _bucket(count: int, cap: int) -> int:
