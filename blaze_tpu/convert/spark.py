@@ -361,10 +361,46 @@ def convert_expr(node: dict, scope: Scope) -> Dict[str, Any]:
         _require_literal_args(c, ch)
         return {"kind": "scalar_function", "name": _SCALAR_FNS[c],
                 "args": [convert_expr(a, scope) for a in ch]}
+    if c in ("HiveSimpleUDF", "HiveGenericUDF"):
+        # HiveUDFUtil.getFunctionClassName analog: map the well-known
+        # Hive UDF classes to native kernels (NativeConverters.scala:
+        # 1212-1237 udfJson / brickhouse cases); anything else raises so
+        # convert_expr_with_fallback wraps it as a host-evaluated UDF —
+        # exactly the reference's fallback(e) tail
+        fcls = _hive_function_class(node)
+        if (config.UDF_JSON_ENABLED.get() and fcls
+                and "hive.ql.udf.UDFJson" in fcls and len(ch) == 2
+                and _cls(ch[1]) == "Literal"):
+            return {"kind": "scalar_function", "name": "get_json_object",
+                    "args": [convert_expr(a, scope) for a in ch],
+                    "return_type": {"id": "utf8"}}
+        if (config.UDF_BRICKHOUSE_ENABLED.get() and fcls
+                and "brickhouse.udf.collect.ArrayUnionUDF" in fcls):
+            return {"kind": "scalar_function", "name": "array_union",
+                    "args": [convert_expr(a, scope) for a in ch]}
+        raise ConversionError(
+            c, f"hive UDF {fcls or node.get('name')!r} has no native "
+               f"kernel (the UDF-wrap fallback hosts it)")
     raise ConversionError(c, "unsupported expression "
                              "(the reference wraps these in "
                              "SparkUDFWrapper; register a udf:// "
                              "resource and use kind=udf)")
+
+
+def _hive_function_class(node: dict) -> Optional[str]:
+    """Extract functionClassName from a serialized Hive UDF expression.
+    Catalyst's toJSON renders funcWrapper either as a nested object or
+    as its string form depending on Spark version — accept both
+    (HiveUDFUtil.scala:37-44)."""
+    fw = node.get("funcWrapper")
+    if isinstance(fw, dict):
+        return fw.get("functionClassName")
+    if isinstance(fw, str):
+        m = re.search(r"functionClassName[=:]\s*([\w.$]+)", fw)
+        if m:
+            return m.group(1)
+    name = node.get("name")
+    return name if isinstance(name, str) and "." in name else None
 
 
 def _unparse(node: dict) -> dict:
@@ -526,6 +562,48 @@ def _convert_node(node: dict, parts: int, log: List[str]
                  "schema": {"fields": fields},
                  "file_groups": files},
                 Scope(ids, names))
+
+    if c == "HiveTableScanExec":
+        # NativeHiveTableScanBase analog (spark-extension hive/...
+        # NativeHiveTableScanBase.scala:23-105): the Hive relation's
+        # storage descriptor does not serialize, so the shim attaches the
+        # resolved file groups, storage format, and the partition
+        # schema + per-file partition values; the scan converts to the
+        # same native parquet/orc scan as FileSourceScanExec with the
+        # partition columns appended as per-file constants
+        _gate("scan", c)
+        fmt = (node.get("format") or "parquet").lower()
+        _gate(f"scan.{fmt}", c)
+        out_attrs = _expr_list(node.get("requestedAttributes")
+                               or node.get("output"))
+        ids, names = _attrs_of(out_attrs)
+        part_fields = node.get("partition_schema") or []
+        part_names = {f["name"] for f in part_fields}
+        fields = []
+        for a in out_attrs:
+            if a.get("name") in part_names:
+                continue  # partition columns are not file columns
+            fields.append({"name": a.get("name"),
+                           "type": _type_from_catalyst(a.get("dataType")),
+                           "nullable": True})
+        files = node.get("files")
+        if not files:
+            raise ConversionError(
+                c, "HiveTableRelation does not serialize; the shim must "
+                   "attach the selected file groups as a 'files' field")
+        d = {"kind": "orc_scan" if fmt == "orc" else "parquet_scan",
+             "schema": {"fields": fields},
+             "file_groups": files,
+             "projection": [a.get("name") for a in out_attrs]}
+        if part_fields:
+            if fmt == "orc":
+                raise ConversionError(
+                    c, "partitioned Hive ORC tables need the parquet "
+                       "partition-constant path (orc_exec carries no "
+                       "partition columns yet)")
+            d["partition_schema"] = {"fields": part_fields}
+            d["partition_values"] = node.get("partition_values")
+        return (d, Scope(ids, names))
 
     if c == "ProjectExec":
         _gate("project", c)

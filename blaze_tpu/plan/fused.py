@@ -557,18 +557,27 @@ class FusedPartialAggExec(ExecutionPlan):
 
     def execute(self, partition: int) -> BatchIterator:
         if self._has_var_keys and not self._use_host_vectorized():
-            if config.FUSED_DICT_DEVICE_ENABLE.get():
+            # re-check the ADMISSION-time exclusion (dict_ok in
+            # _try_fuse_agg): a plan fused for the host path whose
+            # placement/config drifted must fail LOUDLY, not run the
+            # NaN-propagating fold on float min/max args
+            dict_safe = not any(
+                rk in ("min", "max") and arg is not None
+                and arg.data_type(self._in_schema).is_floating
+                for rk, _ok, arg in self._specs)
+            if config.FUSED_DICT_DEVICE_ENABLE.get() and dict_safe:
                 try:
                     yield from self._execute_dict_device(partition)
                     return
                 except _DictCapExceeded:
                     # nothing emitted yet (dict path emits only at the
                     # final drain).  Arrow's host agg is only a valid
-                    # stand-in where its semantics match; otherwise
-                    # re-run through the generic AggExec engine (exact
-                    # Spark semantics incl. float-key normalization)
+                    # stand-in where it is both ENABLED and eligible;
+                    # otherwise the generic AggExec engine (exact Spark
+                    # semantics incl. float-key normalization)
                     self.metrics.add("dict_device_fallback", 1)
-                    if self._host_vectorized_eligible():
+                    if (config.FUSED_HOST_VECTORIZED_ENABLE.get()
+                            and self._host_vectorized_eligible()):
                         for rb in self._execute_host_vectorized(
                                 partition):
                             yield ColumnBatch.from_arrow(rb)
@@ -1588,29 +1597,38 @@ class FusedPartialAggExec(ExecutionPlan):
         # cache — a fresh runtime per task must NOT recompile
         return _dense_step_factory(tuple(self._ranges), kinds, num_slots)
 
-    def _emit_dense(self, carry, num_slots: int) -> BatchIterator:
+    @staticmethod
+    def _drain_table(carry, num_slots: int):
+        """Compact ON DEVICE before reading back: the table has
+        num_slots entries (possibly millions) but only `count` occupied.
+        Ship the occupied prefix, padded to a power-of-two bucket so XLA
+        sees a handful of shapes instead of one per distinct count.
+        Returns (host_accs, host_avalid, slots) trimmed to count, or
+        None when the table is empty.  Shared by the dense and
+        dict-device emit paths."""
         accs, avalid, occupied = carry
-        # Compact ON DEVICE before reading back: the table has num_slots
-        # entries (possibly millions) but only `count` occupied.  Ship the
-        # occupied prefix, padded to a power-of-two bucket so XLA sees a
-        # handful of shapes instead of one per distinct count.
         count = int(jnp.sum(occupied))
         if count == 0:
-            return
+            return None
         padded = _bucket(count, num_slots)
-        # nonzero with a static size is an O(slots) scan (vs argsort's full
-        # sort) and keeps slot order; entries past `count` are fill
+        # nonzero with a static size is an O(slots) scan (vs argsort's
+        # full sort) and keeps slot order; entries past `count` are fill
         slots_dev = jnp.nonzero(occupied, size=padded, fill_value=0)[0]
         fetch = ([jnp.take(a, slots_dev) for a in accs],
                  [jnp.take(v, slots_dev) for v in avalid],
                  slots_dev)
         host_accs, host_avalid, slots = jax.device_get(fetch)
-        slots = slots[:count]
+        return ([a[:count] for a in host_accs],
+                [v[:count] for v in host_avalid], slots[:count])
+
+    def _emit_dense(self, carry, num_slots: int) -> BatchIterator:
+        drained = self._drain_table(carry, num_slots)
+        if drained is None:
+            return
+        host_accs, host_avalid, slots = drained
         # slot -> key decode host-side (shared stride logic, no round trip)
         host_keys = unpack_dense_keys(slots, self._ranges, xp=np)
-        yield from self._emit_rows(
-            host_keys, [a[:count] for a in host_accs],
-            [v[:count] for v in host_avalid])
+        yield from self._emit_rows(host_keys, host_accs, host_avalid)
 
     # -- var-width keys on device: dictionary-code dense strategy ----------
     # (VERDICT r4 #8 / SURVEY §7 hard-part #1: keep string group keys as
@@ -1688,20 +1706,14 @@ class FusedPartialAggExec(ExecutionPlan):
         yield from self._emit_dict(carry, caps, dicts)
 
     def _emit_dict(self, carry, caps, dicts) -> BatchIterator:
-        accs, avalid, occupied = carry
-        count = int(jnp.sum(occupied))
-        if count == 0:
-            return
         num_slots = 1
         for c in caps:
             num_slots *= (c + 1)
-        padded = _bucket(count, num_slots)
-        slots_dev = jnp.nonzero(occupied, size=padded, fill_value=0)[0]
-        fetch = ([jnp.take(a, slots_dev) for a in accs],
-                 [jnp.take(v, slots_dev) for v in avalid],
-                 slots_dev)
-        host_accs, host_avalid, slots = jax.device_get(fetch)
-        slots = slots[:count]
+        drained = self._drain_table(carry, num_slots)
+        if drained is None:
+            return
+        host_accs, host_avalid, slots = drained
+        count = len(slots)
         ranges = [(0, c - 1) for c in caps]
         decoded = unpack_dense_keys(slots, ranges, xp=np)
         out_arrow = self._out_schema.to_arrow()

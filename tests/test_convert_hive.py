@@ -1,0 +1,165 @@
+"""Hive glue in the Spark-plan converter (VERDICT r4 missing #6):
+
+  * HiveTableScanExec -> native parquet scan with partition-constant
+    columns (NativeHiveTableScanBase.scala:23-105 analog),
+  * HiveSimpleUDF/HiveGenericUDF: UDFJson maps to the native
+    get_json_object kernel, brickhouse ArrayUnionUDF to array_union
+    (NativeConverters.scala:1212-1237), anything else wraps into the
+    host-evaluated UDF fallback (HiveUDFUtil.getFunctionClassName)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu import config
+from blaze_tpu.convert.spark import convert_spark_plan
+from blaze_tpu.memory import MemManager
+from blaze_tpu.plan import create_plan
+
+HIVE_EXEC = "org.apache.spark.sql.hive.execution."
+HIVE = "org.apache.spark.sql.hive."
+CAT = "org.apache.spark.sql.catalyst.expressions."
+
+
+@pytest.fixture(autouse=True)
+def budget():
+    MemManager.init(1 << 30)
+
+
+def attr(name, dt, eid):
+    return [{"class": CAT + "AttributeReference", "num-children": 0,
+             "name": name, "dataType": dt, "nullable": True,
+             "exprId": {"id": eid, "jvmId": "u"}}]
+
+
+def lit(value, dt):
+    return [{"class": CAT + "Literal", "num-children": 0,
+             "value": value, "dataType": dt}]
+
+
+def _run(ir):
+    plan = create_plan(ir)
+    out = []
+    for p in range(plan.num_partitions):
+        out.extend(b.compact().to_arrow() for b in plan.execute(p))
+    out = [b for b in out if b.num_rows]
+    return (pa.Table.from_batches(out).to_pandas() if out
+            else pd.DataFrame())
+
+
+def _hive_scan(attrs, files, part_fields=None, part_values=None,
+               fmt="parquet"):
+    node = {"class": HIVE_EXEC + "HiveTableScanExec", "num-children": 0,
+            "requestedAttributes": [a for a in attrs],
+            "files": files, "format": fmt}
+    if part_fields:
+        node["partition_schema"] = part_fields
+        node["partition_values"] = part_values
+    return [node]
+
+
+def test_hive_table_scan_with_partition_values(tmp_path):
+    t = pa.table({"v": pa.array([1.5, 2.5, 3.5])})
+    p = str(tmp_path / "part-0.parquet")
+    pq.write_table(t, p)
+    plan = _hive_scan(
+        attr("v", "double", 1) + attr("ds", "string", 2),
+        [[p]],
+        part_fields=[{"name": "ds", "type": {"id": "utf8"},
+                      "nullable": True}],
+        part_values=[[["2024-01-01"]]])
+    res = convert_spark_plan(plan)
+    assert res.plan["kind"] == "parquet_scan"
+    assert res.plan["partition_schema"]["fields"][0]["name"] == "ds"
+    got = _run(res.plan)
+    assert list(got.columns) == ["v", "ds"]
+    assert set(got["ds"]) == {"2024-01-01"}
+    np.testing.assert_allclose(sorted(got["v"]), [1.5, 2.5, 3.5])
+
+
+def test_hive_scan_requires_shim_files():
+    from blaze_tpu.convert.spark import ConversionError
+    plan = [{"class": HIVE_EXEC + "HiveTableScanExec", "num-children": 0,
+             "requestedAttributes": [attr("v", "double", 1)[0]]}]
+    with pytest.raises(ConversionError, match="files"):
+        convert_spark_plan(plan)
+
+
+def _udfjson_plan(tmp_path, func_wrapper):
+    t = pa.table({"j": pa.array(['{"a": {"b": 7}}', "oops"])})
+    p = str(tmp_path / "j.parquet")
+    pq.write_table(t, p)
+    udf = [{"class": HIVE + "HiveSimpleUDF", "num-children": 2,
+            "name": "default.get_json_object",
+            "funcWrapper": func_wrapper,
+            "dataType": "string"}] + attr("j", "string", 1) + \
+        lit("$.a.b", "string")
+    project = [{"class": "org.apache.spark.sql.execution.ProjectExec",
+                "num-children": 1,
+                "projectList": [udf]}]
+    scan = [{"class": "org.apache.spark.sql.execution.FileSourceScanExec",
+             "num-children": 0, "output": [attr("j", "string", 1)[0]],
+             "files": [[p]]}]
+    return project + scan
+
+
+def test_hive_udfjson_maps_to_native_get_json_object(tmp_path):
+    plan = _udfjson_plan(
+        tmp_path,
+        "HiveFunctionWrapper(functionClassName="
+        "org.apache.hadoop.hive.ql.udf.UDFJson)")
+    res = convert_spark_plan(plan)
+    assert res.plan is not None
+    proj = res.plan["exprs"][0]
+    assert proj["kind"] == "scalar_function"
+    assert proj["name"] == "get_json_object"
+    got = _run(res.plan)
+    vals = got.iloc[:, 0]
+    assert vals.iloc[0] == "7" and pd.isna(vals.iloc[1])
+
+
+def test_hive_udfjson_dict_wrapper_form(tmp_path):
+    plan = _udfjson_plan(
+        tmp_path,
+        {"functionClassName": "org.apache.hadoop.hive.ql.udf.UDFJson"})
+    res = convert_spark_plan(plan)
+    assert res.plan["exprs"][0]["name"] == "get_json_object"
+
+
+def test_unknown_hive_udf_wraps_as_host_udf(tmp_path):
+    plan = _udfjson_plan(
+        tmp_path,
+        {"functionClassName": "com.example.udf.MyCustomUDF"})
+    res = convert_spark_plan(plan)
+    assert res.plan is not None
+    wrapped = res.plan["exprs"][0]
+    assert wrapped["kind"] == "udf"
+    assert res.wrapped_udfs and \
+        res.wrapped_udfs[0]["class"] == "HiveSimpleUDF"
+
+
+def test_brickhouse_array_union_behind_conf(tmp_path):
+    t = pa.table({"a": pa.array([[1, 2]]), "b": pa.array([[2, 3]])})
+    p = str(tmp_path / "ab.parquet")
+    pq.write_table(t, p)
+    udf = [{"class": HIVE + "HiveGenericUDF", "num-children": 2,
+            "name": "brickhouse.array_union",
+            "funcWrapper": {"functionClassName":
+                            "brickhouse.udf.collect.ArrayUnionUDF"},
+            "dataType": {"type": "array", "elementType": "long", "containsNull": True}}] + \
+        attr("a", {"type": "array", "elementType": "long", "containsNull": True}, 1) + attr("b", {"type": "array", "elementType": "long", "containsNull": True}, 2)
+    project = [{"class": "org.apache.spark.sql.execution.ProjectExec",
+                "num-children": 1, "projectList": [udf]}]
+    scan = [{"class": "org.apache.spark.sql.execution.FileSourceScanExec",
+             "num-children": 0,
+             "output": [attr("a", {"type": "array", "elementType": "long", "containsNull": True}, 1)[0],
+                        attr("b", {"type": "array", "elementType": "long", "containsNull": True}, 2)[0]],
+             "files": [[p]]}]
+    with config.scoped(**{"auron.udf.brickhouse.enabled": "true"}):
+        res = convert_spark_plan(project + scan)
+        assert res.plan["exprs"][0]["kind"] == "scalar_function"
+        assert res.plan["exprs"][0]["name"] == "array_union"
+        got = _run(res.plan)
+    assert list(got.iloc[0, 0]) == [1, 2, 3]

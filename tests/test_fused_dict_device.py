@@ -252,3 +252,23 @@ def test_selective_filter_does_not_grow_dictionary():
     assert got.keys() == want.keys()
     for k in want:
         assert got[k] == pytest.approx(want[k], rel=1e-12)
+
+
+def test_placement_drift_with_float_minmax_raises_loudly():
+    """A plan fused for the HOST path (min over float is Arrow-eligible
+    there) whose config drifts before execute must raise, not run the
+    NaN-propagating dict-device fold silently."""
+    t = pa.table({"k": pa.array(["a", "a"]), "g": pa.array([1, 1]),
+                  "v": pa.array([float("nan"), 3.0])})
+    c = lambda i: {"kind": "column", "index": i}  # noqa: E731
+    ir = {"kind": "hash_agg",
+          "groupings": [{"expr": c(0), "name": "k"}],
+          "aggs": [{"fn": "min", "mode": "complete", "name": "mn",
+                    "args": [c(2)]}],
+          "input": _scan("dictdev://drift", t)}
+    node = fuse_plan(create_plan(ir))  # host-vectorized eligible -> fused
+    if not isinstance(node, FusedPartialAggExec):
+        pytest.skip("not fused under this placement")
+    with config.scoped(**{"auron.tpu.fused.hostVectorized": "false"}):
+        with pytest.raises(RuntimeError, match="host placement"):
+            list(node.execute(0))
