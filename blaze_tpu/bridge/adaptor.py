@@ -1,0 +1,191 @@
+"""Pluggable engine adaptor — the AuronAdaptor SPI analog.
+
+Parity: `auron-core/src/main/java/org/apache/auron/jni/AuronAdaptor.java`
+(abstract engine surface: loadAuronLib, getJVMTotalMemoryLimited,
+isTaskRunning, getDirectWriteSpillToDiskFile, get/setThreadContext,
+getOnHeapSpillManager, getAuronConfiguration, getAuronUDFWrapperContext,
+getEngineName) and its ServiceLoader discovery
+(`AuronAdaptor.getInstance()` iterating `AuronAdaptorProvider`s).
+
+Each host engine (Spark-shim, Flink-shim, embedded tests, a future
+service front-end) implements ONE `EngineAdaptor` instead of installing
+loose module-level callbacks; `set_adaptor()` wires every existing hook
+point (conf provider, task probe, spill factory, UDF resolver, FS
+fallback) through it.  The function-address path used by the C ABI
+(`host_callbacks.install_from_addresses`) keeps working — it builds a
+`CallbackAdaptor` under the hood, so the JNI/C boundary and the Python
+SPI share one installation surface.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.Lock()
+_instance: Optional["EngineAdaptor"] = None
+_providers: Dict[str, Callable[[], "EngineAdaptor"]] = {}
+
+
+class EngineAdaptor:
+    """Engine-integration surface.  Subclass and override what the host
+    engine provides; every default is the reference's documented default
+    (AuronAdaptor.java: memory unlimited, task always running, disabled
+    on-heap spill manager)."""
+
+    #: engine name (AuronAdaptor.getEngineName: "Spark", "Flink", ...)
+    name = "host"
+
+    # -- native library ----------------------------------------------------
+    def load_native_lib(self) -> None:
+        """loadAuronLib analog: make the native kernels available.  The
+        default loads the C++ host-bridge/kernel libraries lazily."""
+        from blaze_tpu.bridge import native
+        native.get_host_bridge()
+
+    # -- memory ------------------------------------------------------------
+    def total_memory_limited(self) -> int:
+        """getJVMTotalMemoryLimited: engine memory cap in bytes."""
+        return (1 << 63) - 1
+
+    def on_heap_spill_factory(self):
+        """getOnHeapSpillManager analog: a factory producing host-memory
+        spill objects, or None for the disabled manager."""
+        return None
+
+    # -- task lifecycle ----------------------------------------------------
+    def is_task_running(self, stage_id: int, partition_id: int) -> bool:
+        """isTaskRunning: False aborts native computation cooperatively."""
+        return True
+
+    def get_thread_context(self) -> Any:
+        """getThreadContext (Spark: TaskContext of the current thread)."""
+        from blaze_tpu.bridge import context
+        return context.current_task()
+
+    def set_thread_context(self, ctx: Any) -> None:
+        """setThreadContext: propagate the engine task context into
+        worker threads the runtime spawns."""
+        from blaze_tpu.bridge import context
+        context.set_current_task(ctx)
+
+    # -- spill -------------------------------------------------------------
+    def direct_write_spill_file(self) -> str:
+        """getDirectWriteSpillToDiskFile: absolute path of a fresh temp
+        file for direct-write spills."""
+        from blaze_tpu import config
+        dirs = config.SPILL_DIRS.get() if hasattr(config, "SPILL_DIRS") \
+            else None
+        base = (dirs.split(",")[0] if isinstance(dirs, str) and dirs
+                else tempfile.gettempdir())
+        os.makedirs(base, exist_ok=True)
+        fd, path = tempfile.mkstemp(prefix="auron_spill_", dir=base)
+        os.close(fd)
+        return path
+
+    # -- configuration -----------------------------------------------------
+    def conf_get(self, key: str) -> Optional[str]:
+        """getAuronConfiguration analog: resolve one engine conf key, or
+        None when unset (lazily memoized by config.set_host_conf_provider
+        like the reference's define_conf! proxies)."""
+        return None
+
+    # -- UDFs --------------------------------------------------------------
+    def udf_wrapper_context(self, name: str) -> Optional[Callable]:
+        """getAuronUDFWrapperContext: resolve a host evaluator for a
+        wrapped UDF by name, or None when unknown."""
+        return None
+
+
+def register_provider(name: str,
+                      factory: Callable[[], EngineAdaptor]) -> None:
+    """ServiceLoader-registration analog: front-ends register a factory
+    at import time; `get_adaptor()` instantiates the one selected by
+    `BLAZE_TPU_ADAPTOR` (or the first registered)."""
+    with _lock:
+        _providers[name] = factory
+
+
+def set_adaptor(adaptor: Optional[EngineAdaptor]) -> None:
+    """Install `adaptor` as THE engine integration: wires the conf
+    provider, task probe, spill factory, and UDF resolver hook points
+    through it.  None uninstalls (tests)."""
+    global _instance
+    from blaze_tpu import config
+    from blaze_tpu.bridge import context, resource
+    from blaze_tpu.memory import spill as spill_mod
+    with _lock:
+        _instance = adaptor
+    if adaptor is None:
+        config.set_host_conf_provider(None)
+        context.set_host_task_probe(None)
+        resource.unregister_resolver("udf://")
+        spill_mod.set_host_spill_factory(None)
+        return
+    config.set_host_conf_provider(adaptor.conf_get)
+    context.set_host_task_probe(adaptor.is_task_running)
+    factory = adaptor.on_heap_spill_factory()
+    if factory is not None:
+        spill_mod.set_host_spill_factory(factory)
+
+    def _resolve_udf(key: str):
+        return adaptor.udf_wrapper_context(key[len("udf://"):])
+    resource.register_resolver("udf://", _resolve_udf)
+    adaptor.load_native_lib()
+
+
+class CallbackAdaptor(EngineAdaptor):
+    """Adaptor view over raw C-ABI callbacks installed through
+    `host_callbacks.install_from_addresses` (the JNI path): the hook
+    points are already wired ctypes-directly for per-batch hot paths;
+    this class exposes the same installation through the SPI surface so
+    `get_adaptor()` answers coherently for either route."""
+
+    name = "c-abi-host"
+
+    def __init__(self, fns: Dict[str, Any]):
+        self._fns = fns
+
+    def conf_get(self, key: str) -> Optional[str]:
+        from blaze_tpu import config
+        provider = config._host_conf_provider
+        return provider(key) if provider else None
+
+    def is_task_running(self, stage_id: int, partition_id: int) -> bool:
+        from blaze_tpu.bridge import context
+        probe = context._host_task_probe
+        return probe(stage_id, partition_id) if probe else True
+
+    def udf_wrapper_context(self, name: str) -> Optional[Callable]:
+        from blaze_tpu.bridge import resource
+        return resource.get_resource(f"udf://{name}")
+
+
+def note_installed(adaptor: EngineAdaptor) -> None:
+    """Record `adaptor` as the live instance WITHOUT rewiring hook
+    points (they were installed directly, e.g. by the ctypes path)."""
+    global _instance
+    with _lock:
+        _instance = adaptor
+
+
+def get_adaptor() -> EngineAdaptor:
+    """AuronAdaptor.getInstance analog: the installed adaptor, else the
+    provider selected by BLAZE_TPU_ADAPTOR, else a plain EngineAdaptor
+    (unlike the JVM reference, a headless default exists — embedded
+    Python use needs no engine)."""
+    global _instance
+    with _lock:
+        if _instance is not None:
+            return _instance
+        want = os.environ.get("BLAZE_TPU_ADAPTOR")
+        factory = None
+        if want and want in _providers:
+            factory = _providers[want]
+        elif _providers:
+            factory = next(iter(_providers.values()))
+        inst = factory() if factory else EngineAdaptor()
+    set_adaptor(inst)
+    return inst

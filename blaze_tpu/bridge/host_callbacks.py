@@ -76,6 +76,8 @@ def uninstall() -> None:
     resource.unregister_resolver("udf://")
     fs_provider.unregister_fallback()
     spill_mod.set_host_spill_factory(None)
+    from blaze_tpu.bridge import adaptor as adaptor_mod
+    adaptor_mod.note_installed(None)
 
 
 def install_from_addresses(version: int, addrs: Dict[str, int]) -> None:
@@ -104,6 +106,10 @@ def install(fns: Dict[str, object]) -> None:
         _install_task_probe(fns["is_task_running"])
     if "udf_eval" in fns:
         _install_udf(fns)
+    # surface this installation through the engine-adaptor SPI
+    # (AuronAdaptor.getInstance answers coherently for the C-ABI route)
+    from blaze_tpu.bridge import adaptor as adaptor_mod
+    adaptor_mod.note_installed(adaptor_mod.CallbackAdaptor(fns))
 
 
 # ---------------------------------------------------------------------------

@@ -375,7 +375,9 @@ def convert_expr(node: dict, scope: Scope) -> Dict[str, Any]:
                     "args": [convert_expr(a, scope) for a in ch],
                     "return_type": {"id": "utf8"}}
         if (config.UDF_BRICKHOUSE_ENABLED.get() and fcls
-                and "brickhouse.udf.collect.ArrayUnionUDF" in fcls):
+                and "brickhouse.udf.collect.ArrayUnionUDF" in fcls
+                and len(ch) == 2):  # the native kernel is binary;
+            # variadic brickhouse calls take the UDF-wrap fallback
             return {"kind": "scalar_function", "name": "array_union",
                     "args": [convert_expr(a, scope) for a in ch]}
         raise ConversionError(
@@ -601,8 +603,22 @@ def _convert_node(node: dict, parts: int, log: List[str]
                     c, "partitioned Hive ORC tables need the parquet "
                        "partition-constant path (orc_exec carries no "
                        "partition columns yet)")
+            pv = node.get("partition_values")
+            if not pv:
+                # silent NULL partition columns would be wrong results;
+                # symmetric with the missing-'files' check above
+                raise ConversionError(
+                    c, "partition_schema without partition_values; the "
+                       "shim must attach per-file partition values")
+            # Hive metastore partition values arrive as STRINGS; coerce
+            # against the partition schema like NativeHiveTableScanBase
+            # casts them (Literal(file.partitionValues.get(i, dataType)))
+            types = [f["type"] for f in part_fields]
+            coerced = [[[_parse_literal(v, t)
+                         for v, t in zip(fvals, types)]
+                        for fvals in group] for group in pv]
             d["partition_schema"] = {"fields": part_fields}
-            d["partition_values"] = node.get("partition_values")
+            d["partition_values"] = coerced
         return (d, Scope(ids, names))
 
     if c == "ProjectExec":

@@ -163,3 +163,38 @@ def test_brickhouse_array_union_behind_conf(tmp_path):
         assert res.plan["exprs"][0]["name"] == "array_union"
         got = _run(res.plan)
     assert list(got.iloc[0, 0]) == [1, 2, 3]
+
+
+def test_partition_schema_without_values_raises():
+    from blaze_tpu.convert.spark import ConversionError
+    plan = _hive_scan(
+        attr("v", "double", 1) + attr("ds", "string", 2),
+        [["/nonexistent.parquet"]],
+        part_fields=[{"name": "ds", "type": {"id": "utf8"},
+                      "nullable": True}],
+        part_values=None)
+    # _hive_scan drops empty part_values; build explicitly
+    plan[0]["partition_schema"] = [{"name": "ds", "type": {"id": "utf8"},
+                                    "nullable": True}]
+    plan[0].pop("partition_values", None)
+    with pytest.raises(ConversionError, match="partition_values"):
+        convert_spark_plan(plan)
+
+
+def test_partition_values_coerce_metastore_strings(tmp_path):
+    """Hive metastore partition values are strings; the converter must
+    coerce them against the partition schema (int year here) like
+    NativeHiveTableScanBase's Literal cast."""
+    t = pa.table({"v": pa.array([1.0, 2.0])})
+    p = str(tmp_path / "y.parquet")
+    pq.write_table(t, p)
+    plan = _hive_scan(
+        attr("v", "double", 1) + attr("year", "integer", 2),
+        [[p]],
+        part_fields=[{"name": "year", "type": {"id": "int32"},
+                      "nullable": True}],
+        part_values=[[["2024"]]])  # metastore string form
+    res = convert_spark_plan(plan)
+    assert res.plan["partition_values"] == [[[2024]]]
+    got = _run(res.plan)
+    assert set(got["year"]) == {2024}

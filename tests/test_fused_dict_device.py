@@ -266,9 +266,18 @@ def test_placement_drift_with_float_minmax_raises_loudly():
           "aggs": [{"fn": "min", "mode": "complete", "name": "mn",
                     "args": [c(2)]}],
           "input": _scan("dictdev://drift", t)}
-    node = fuse_plan(create_plan(ir))  # host-vectorized eligible -> fused
-    if not isinstance(node, FusedPartialAggExec):
-        pytest.skip("not fused under this placement")
+    # float min/max with var-width keys is refused by BOTH admission
+    # paths (host eligibility and dict_ok), so fuse_plan never builds
+    # this node — construct it directly to exercise the runtime
+    # defense-in-depth guard that a drifted/hand-built plan hits
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops import AggMode, make_agg
+    agg_plan = create_plan(ir)
+    mn = make_agg("min", [col(2, "v")])
+    node = FusedPartialAggExec(
+        agg_plan.children[0], [(col(0, "k"), "k")],
+        [(mn, AggMode.COMPLETE, "mn")],
+        [("min", "min", col(2, "v"))], ranges=None, complete=True)
     with config.scoped(**{"auron.tpu.fused.hostVectorized": "false"}):
         with pytest.raises(RuntimeError, match="host placement"):
             list(node.execute(0))
