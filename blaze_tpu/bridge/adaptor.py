@@ -126,9 +126,9 @@ def set_adaptor(adaptor: Optional[EngineAdaptor]) -> None:
         return
     config.set_host_conf_provider(adaptor.conf_get)
     context.set_host_task_probe(adaptor.is_task_running)
-    factory = adaptor.on_heap_spill_factory()
-    if factory is not None:
-        spill_mod.set_host_spill_factory(factory)
+    # unconditional: switching to an adaptor WITHOUT a spill factory
+    # must clear the previous adaptor's, not keep routing through it
+    spill_mod.set_host_spill_factory(adaptor.on_heap_spill_factory())
 
     def _resolve_udf(key: str):
         return adaptor.udf_wrapper_context(key[len("udf://"):])

@@ -465,6 +465,24 @@ def convert_expr_with_fallback(node: dict, scope: Scope) -> Dict[str, Any]:
                 "serialized": serialized}
 
 
+def _parse_partition_value(v, t: Dict[str, Any], node_cls: str):
+    """Metastore partition strings -> typed constants: the Hive null
+    sentinel becomes NULL, DATE partitions parse 'yyyy-MM-dd' (the
+    int() coercion in _parse_literal would throw), and anything
+    malformed raises ConversionError instead of a raw ValueError."""
+    if v is None or v == "__HIVE_DEFAULT_PARTITION__":
+        return None
+    try:
+        if t.get("id") == "date32" and isinstance(v, str):
+            import datetime as _dt
+            return _dt.date.fromisoformat(v)
+        return _parse_literal(v, t)
+    except (ValueError, TypeError) as e:
+        raise ConversionError(
+            node_cls, f"partition value {v!r} does not coerce to "
+                      f"{t.get('id')}: {e}")
+
+
 def _parse_literal(v, t: Dict[str, Any]):
     """toJSON renders literal values as strings; coerce to the type."""
     if v is None:
@@ -598,11 +616,6 @@ def _convert_node(node: dict, parts: int, log: List[str]
              "file_groups": files,
              "projection": [a.get("name") for a in out_attrs]}
         if part_fields:
-            if fmt == "orc":
-                raise ConversionError(
-                    c, "partitioned Hive ORC tables need the parquet "
-                       "partition-constant path (orc_exec carries no "
-                       "partition columns yet)")
             pv = node.get("partition_values")
             if not pv:
                 # silent NULL partition columns would be wrong results;
@@ -614,7 +627,7 @@ def _convert_node(node: dict, parts: int, log: List[str]
             # against the partition schema like NativeHiveTableScanBase
             # casts them (Literal(file.partitionValues.get(i, dataType)))
             types = [f["type"] for f in part_fields]
-            coerced = [[[_parse_literal(v, t)
+            coerced = [[[_parse_partition_value(v, t, c)
                          for v, t in zip(fvals, types)]
                         for fvals in group] for group in pv]
             d["partition_schema"] = {"fields": part_fields}

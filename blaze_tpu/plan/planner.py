@@ -93,8 +93,12 @@ def create_plan(d: Dict[str, Any]) -> ExecutionPlan:
                                    d.get("num_partitions", 1))
     if k == "orc_scan":
         from blaze_tpu.ops.orc import OrcScanExec
+        opschema = (schema_from_dict(d["partition_schema"])
+                    if d.get("partition_schema") else None)
         return OrcScanExec(schema_from_dict(d["schema"]), d["file_groups"],
-                           projection=d.get("projection"))
+                           projection=d.get("projection"),
+                           partition_schema=opschema,
+                           partition_values=d.get("partition_values"))
     if k == "kafka_scan":
         return _create_kafka_scan(d)
 

@@ -118,3 +118,18 @@ def test_c_abi_route_surfaces_as_callback_adaptor():
         assert got.name == "c-abi-host"
     finally:
         host_callbacks.uninstall()
+
+
+def test_spill_factory_clears_on_adaptor_switch():
+    """Switching to an adaptor WITHOUT a spill factory must clear the
+    previous one (stale-engine spills otherwise)."""
+    from blaze_tpu.memory import spill as spill_mod
+    sentinel = object()
+
+    class WithSpill(A.EngineAdaptor):
+        def on_heap_spill_factory(self):
+            return sentinel
+    A.set_adaptor(WithSpill())
+    assert spill_mod._host_spill_factory is sentinel
+    A.set_adaptor(A.EngineAdaptor())
+    assert spill_mod._host_spill_factory is None

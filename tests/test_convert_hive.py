@@ -198,3 +198,57 @@ def test_partition_values_coerce_metastore_strings(tmp_path):
     assert res.plan["partition_values"] == [[[2024]]]
     got = _run(res.plan)
     assert set(got["year"]) == {2024}
+
+
+def test_hive_orc_scan_with_partition_values(tmp_path):
+    from pyarrow import orc as pa_orc
+    t = pa.table({"v": pa.array([10.0, 20.0])})
+    p = str(tmp_path / "part.orc")
+    pa_orc.write_table(t, p)
+    plan = _hive_scan(
+        attr("v", "double", 1) + attr("ds", "string", 2),
+        [[p]],
+        part_fields=[{"name": "ds", "type": {"id": "utf8"},
+                      "nullable": True}],
+        part_values=[[["2024-02-02"]]], fmt="orc")
+    res = convert_spark_plan(plan)
+    assert res.plan["kind"] == "orc_scan"
+    got = _run(res.plan)
+    assert set(got["ds"]) == {"2024-02-02"}
+    np.testing.assert_allclose(sorted(got["v"]), [10.0, 20.0])
+
+
+def test_date_partition_and_hive_null_sentinel(tmp_path):
+    """DATE partitions parse 'yyyy-MM-dd' and __HIVE_DEFAULT_PARTITION__
+    coerces to NULL (the metastore's null-partition sentinel)."""
+    import datetime
+    t = pa.table({"v": pa.array([1.0])})
+    p1 = str(tmp_path / "a.parquet")
+    p2 = str(tmp_path / "b.parquet")
+    pq.write_table(t, p1)
+    pq.write_table(t, p2)
+    plan = _hive_scan(
+        attr("v", "double", 1) + attr("dt", "date", 2),
+        [[p1, p2]],
+        part_fields=[{"name": "dt", "type": {"id": "date32"},
+                      "nullable": True}],
+        part_values=[[["2024-05-05"], ["__HIVE_DEFAULT_PARTITION__"]]])
+    res = convert_spark_plan(plan)
+    assert res.plan["partition_values"] == \
+        [[[datetime.date(2024, 5, 5)], [None]]]
+    got = _run(res.plan)
+    vals = set(got["dt"].astype(object))
+    assert datetime.date(2024, 5, 5) in vals
+    assert any(pd.isna(v) for v in got["dt"])
+
+
+def test_malformed_partition_value_raises_conversion_error():
+    from blaze_tpu.convert.spark import ConversionError
+    plan = _hive_scan(
+        attr("v", "double", 1) + attr("year", "integer", 2),
+        [["/x.parquet"]],
+        part_fields=[{"name": "year", "type": {"id": "int32"},
+                      "nullable": True}],
+        part_values=[[["not-a-year"]]])
+    with pytest.raises(ConversionError, match="does not coerce"):
+        convert_spark_plan(plan)
