@@ -71,9 +71,21 @@ def _arrow_fixed_values(arr: pa.Array, dtype: DataType) -> np.ndarray:
         return bits[arr.offset:arr.offset + len(arr)].astype(bool)
     if dtype.id == TypeId.DECIMAL:
         buf = arr.buffers()[1]
-        # decimal128 little-endian; p<=18 fits in the low 8 bytes
-        pairs = np.frombuffer(buf, dtype=np.int64).reshape(-1, 2)
-        return pairs[arr.offset:arr.offset + len(arr), 0].copy()
+        if pa.types.is_decimal(arr.type):
+            if dtype.precision > 18 or arr.type.precision > 18:
+                # the low-8-bytes extraction below would silently
+                # truncate wide values; wide decimals are host-only
+                raise TypeError(
+                    f"decimal(p>{18}) cannot take the int64 device "
+                    f"representation (got {arr.type}); keep it host-"
+                    f"resident")
+            # decimal128 little-endian; p<=18 fits in the low 8 bytes
+            pairs = np.frombuffer(buf, dtype=np.int64).reshape(-1, 2)
+            return pairs[arr.offset:arr.offset + len(arr), 0].copy()
+        # unscaled-int64 storage (buffered partial acc columns keep the
+        # device representation)
+        vals = np.frombuffer(buf, dtype=np.int64)
+        return vals[arr.offset:arr.offset + len(arr)]
     np_dtype = dtype.np_dtype()
     buf = arr.buffers()[1]
     vals = np.frombuffer(buf, dtype=np_dtype)

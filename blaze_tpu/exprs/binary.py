@@ -53,15 +53,34 @@ class BinaryExpr(PhysicalExpr):
     def children(self):
         return (self.left, self.right)
 
+    def _decimal_types(self, lt: DataType, rt: DataType):
+        """(lt, rt) as decimal types when this op is decimal-valued:
+        either side DECIMAL, the other decimal-coercible (ints), and an
+        arithmetic/compare op.  Float operands promote the whole op to
+        f64 like Spark, so they never reach here.  Takes the child
+        types ALREADY computed — recomputing them here made type
+        derivation exponential in arithmetic-chain depth."""
+        if self.op in _BOOLEAN or self.op in ("&", "|", "^", "<<", ">>"):
+            return None
+        if TypeId.DECIMAL not in (lt.id, rt.id):
+            return None
+        if lt.is_floating or rt.is_floating:
+            return None
+        from blaze_tpu.exprs import decimal_arith as D
+        ldt, rdt = D.as_decimal_type(lt), D.as_decimal_type(rt)
+        if ldt is None or rdt is None:
+            return None
+        return ldt, rdt
+
     def data_type(self, schema: Schema) -> DataType:
-        if self.op in _CMP or self.op in _BOOLEAN:
-            return BOOL
         lt = self.left.data_type(schema)
         rt = self.right.data_type(schema)
-        if self.op == "/" and lt.id == TypeId.DECIMAL:
-            # Spark decimal division result scale handled upstream by
-            # check_overflow; native math happens in f64 here
-            return lt
+        if self.op in _CMP or self.op in _BOOLEAN:
+            return BOOL
+        dec = self._decimal_types(lt, rt)
+        if dec is not None:
+            from blaze_tpu.exprs import decimal_arith as D
+            return D.result_type(self.op, *dec)
         if not lt.is_fixed_width:
             return lt
         if not rt.is_fixed_width:
@@ -70,13 +89,20 @@ class BinaryExpr(PhysicalExpr):
         from blaze_tpu import schema as S
         m = {"bool": S.BOOL, "int8": S.INT8, "int16": S.INT16, "int32": S.INT32,
              "int64": S.INT64, "float32": S.FLOAT32, "float64": S.FLOAT64}
-        if lt.id == TypeId.DECIMAL and rt.id == TypeId.DECIMAL:
-            return lt
         return m[jnp.dtype(dt).name]
 
     def evaluate(self, batch: ColumnBatch) -> ColVal:
         a = self.left.evaluate(batch)
         b = self.right.evaluate(batch)
+        lt = self.left.data_type(batch.schema)
+        rt = self.right.data_type(batch.schema)
+        dec = self._decimal_types(lt, rt)
+        if dec is not None and not self._decimal_device_ok(*dec):
+            # exact Spark decimal semantics (scale alignment, result
+            # widening, overflow -> null) — the unscaled-int64 device
+            # math below is only correct for EQUAL scales within p<=18
+            from blaze_tpu.exprs import decimal_arith as D
+            return D.evaluate(self.op, a, b, dec[0], dec[1], batch)
         if not a.is_device or not b.is_device:
             return self._evaluate_host(batch, a, b)
         if self.op in _BOOLEAN:
@@ -84,6 +110,21 @@ class BinaryExpr(PhysicalExpr):
         if self.op in _CMP:
             return _compare(self.op, a, b)
         return _arith(self.op, a, b, self.data_type(batch.schema))
+
+    def _decimal_device_ok(self, ldt: DataType, rdt: DataType) -> bool:
+        """Equal-scale narrow decimals keep the vectorized device path:
+        comparisons and +/- on the unscaled int64s are exact there (the
+        +/- result precision max(p1,p2)+1 <= 18 cannot overflow int64).
+        Everything else (mixed scales, *, /, %, wide) needs the exact
+        host path."""
+        if ldt.scale != rdt.scale:
+            return False
+        if max(ldt.precision, rdt.precision) > 18:
+            return False
+        if self.op in _CMP:
+            return True
+        return self.op in ("+", "-") and \
+            max(ldt.precision, rdt.precision) + 1 <= 18
 
     def _evaluate_host(self, batch: ColumnBatch, a: ColVal, b: ColVal) -> ColVal:
         """String/binary comparisons, Kleene and/or over mixed host/device

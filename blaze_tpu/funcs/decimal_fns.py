@@ -37,8 +37,18 @@ def _check_overflow(args, batch, out_type):
     """Rescale + precision check after decimal arithmetic
     (ref spark_check_overflow.rs): overflow -> null (non-ANSI)."""
     from blaze_tpu.kernels.cast import cast_column
-    v = args[0].to_device(batch.capacity)
+    v = args[0]
     if v.dtype.id == TypeId.DECIMAL and out_type.id == TypeId.DECIMAL:
-        data, valid = cast_column(v.data, v.validity, v.dtype, out_type)
+        if v.dtype.precision > 18 or out_type.precision > 18:
+            # wide decimals live as host decimal128 columns; forcing
+            # them through to_device would keep only the LOW 8 bytes
+            # (silent corruption) — rescale host-exact instead
+            from blaze_tpu.exprs.cast import _to_decimal
+            arr = v.to_host(batch.num_rows)
+            return ColVal.host(out_type,
+                               _to_decimal(arr, v.dtype, out_type))
+        dv = v.to_device(batch.capacity)
+        data, valid = cast_column(dv.data, dv.validity, dv.dtype,
+                                  out_type)
         return ColVal(out_type, data=data, validity=valid)
-    return v
+    return v.to_device(batch.capacity)

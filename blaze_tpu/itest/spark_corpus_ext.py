@@ -1331,3 +1331,82 @@ def _timestamp_fields_ext():
              [_fn("to_date", _col(0), rt="date32")],
              [(_dt.date(2015, 3, 5),)]),
     ]
+
+
+@_suite("DecimalArithmeticSuite")
+def _decimal_arithmetic():
+    from decimal import Decimal as D
+    d102 = pa.array([D("12.34"), D("-1.50")], pa.decimal128(10, 2))
+    d103 = pa.array([D("1.234"), D("2.000")], pa.decimal128(10, 3))
+    t = pa.table({"a": d102, "b": d103})
+    return [
+        Case("add aligns scales, widens precision (12,3)",
+             t, [_bin("+", _col(0), _col(1))],
+             [(D("13.574"),), (D("0.500"),)]),
+        Case("multiply scale is s1+s2",
+             t, [_bin("*", _col(0), _col(1))],
+             [(D("15.22756"),), (D("-3.00000"),)]),
+        Case("divide scale is max(6, s1+p2+1)",
+             t, [_bin("/", _col(0), _col(1))],
+             [(D("10.0000000000000"),), (D("-0.7500000000000"),)]),
+        Case("comparison aligns scales first",
+             pa.table({"a": pa.array([D("1.00")], pa.decimal128(10, 2)),
+                       "b": pa.array([D("0.500")],
+                                     pa.decimal128(10, 3))}),
+             [_bin(">", _col(0), _col(1)),
+              _bin("==", _col(0), _col(1))],
+             [(True, False)]),
+        Case("integer operand widens to decimal",
+             pa.table({"a": pa.array([5]),
+                       "b": pa.array([D("0.25")],
+                                     pa.decimal128(10, 2))}),
+             [_bin("+", _col(0), _col(1))],
+             [(D("5.25"),)]),
+        Case("addition overflow at precision 38 is null",
+             pa.table({"a": pa.array([D("9" * 38)],
+                                     pa.decimal128(38, 0)),
+                       "b": pa.array([D("9" * 38)],
+                                     pa.decimal128(38, 0))}),
+             [_bin("+", _col(0), _col(1))],
+             [(None,)]),
+        Case("decimal division by zero is null (non-ANSI)",
+             pa.table({"a": pa.array([D("1.00")], pa.decimal128(10, 2)),
+                       "b": pa.array([D("0.00")],
+                                     pa.decimal128(10, 2))}),
+             [_bin("/", _col(0), _col(1))],
+             [(None,)]),
+        Case("modulo sign follows dividend, pmod the divisor",
+             pa.table({"a": pa.array([D("-7.0")], pa.decimal128(10, 1)),
+                       "b": pa.array([D("3.0")],
+                                     pa.decimal128(10, 1))}),
+             [_bin("%", _col(0), _col(1)),
+              _bin("pmod", _col(0), _col(1))],
+             [(D("-1.0"), D("2.0"))]),
+        Case("sum widens precision by 10, avg adds scale 4",
+             pa.table({"k": pa.array(["a", "a"]),
+                       "v": pa.array([D("12.34"), D("-1.50")],
+                                     pa.decimal128(10, 2))}),
+             [], [("a", D("10.84"), D("5.420000"))], unordered=True,
+             plan=_agg_plan((0,), [("sum", _col(1), "s"),
+                                   ("avg", _col(1), "m")])),
+        Case("check_overflow keeps wide (p>18) products exact",
+             # Spark wraps decimal arithmetic in CheckOverflow; a wide
+             # host result must NOT round-trip through int64 device
+             # storage (low-8-bytes truncation, r5 review finding)
+             pa.table({"a": pa.array([D("1" + "0" * 17)],
+                                     pa.decimal128(18, 0)),
+                       "b": pa.array([D("1" + "0" * 17)],
+                                     pa.decimal128(18, 0))}),
+             [{"kind": "scalar_function", "name": "check_overflow",
+               "args": [_bin("*", _col(0), _col(1))],
+               "return_type": {"id": "decimal", "precision": 38,
+                               "scale": 0}}],
+             [(D("1" + "0" * 34),)]),
+        Case("null decimal operand poisons the row",
+             pa.table({"a": pa.array([D("1.00"), None],
+                                     pa.decimal128(10, 2)),
+                       "b": pa.array([D("2.00"), D("2.00")],
+                                     pa.decimal128(10, 2))}),
+             [_bin("+", _col(0), _col(1))],
+             [(D("3.00"),), (None,)]),
+    ]

@@ -27,7 +27,9 @@ from blaze_tpu import config
 from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.bridge.context import current_task
 from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
-from blaze_tpu.ops.scan import _align_schema, open_source
+from blaze_tpu.ops.scan import (_align_schema,
+                                assemble_partition_constants,
+                                open_source)
 from blaze_tpu.schema import Field, Schema
 
 ORC_FORCE_POSITIONAL = config.ORC_FORCE_POSITIONAL_EVOLUTION
@@ -84,14 +86,6 @@ class OrcScanExec(ExecutionPlan):
                 if config.IGNORE_CORRUPTED_FILES.get():
                     continue
                 raise
-            pvals = None
-            if self._partition_values is not None:
-                group = (self._partition_values[partition]
-                         if partition < len(self._partition_values)
-                         else [])
-                # short value lists null-fill (ParquetScanExec's
-                # _assemble_output guard) instead of IndexError
-                pvals = group[fidx] if fidx < len(group) else []
             # stripe-granular poll: bounded memory + a cancellation
             # point per stripe (orc_exec.rs polls the stream likewise).
             # nstripes == 0 (empty writer output) emits nothing — a
@@ -102,9 +96,11 @@ class OrcScanExec(ExecutionPlan):
                 if tbl is None or tbl.num_rows == 0:
                     continue
                 self.metrics.add("bytes_scanned", tbl.nbytes)
-                if pvals is not None:
-                    tbl = self._append_partition_columns(tbl, pvals)
                 for rb in tbl.to_batches(max_chunksize=self._batch_rows):
+                    if self._partition_schema is not None:
+                        rb = assemble_partition_constants(
+                            rb, self._schema, self._partition_schema,
+                            self._partition_values, partition, fidx)
                     rb = _align_schema(rb, self._schema)
                     cb = ColumnBatch.from_arrow(rb)
                     self.metrics.add("output_rows", cb.num_rows)
@@ -143,11 +139,17 @@ class OrcScanExec(ExecutionPlan):
         """No projected column exists in this old file: the rows still
         exist — emit all-null rows instead of silently dropping them.
         Row counts must come from a real column (columns=[] reads back
-        zero rows), so decode the narrowest physical column."""
+        zero rows), so decode the cheapest one: the first FIXED-WIDTH
+        physical column when any exists (a wide string column would
+        decompress megabytes just for num_rows)."""
         file_names = list(f.schema.names)
         if file_names:
-            n_rows = f.read_stripe(stripe,
-                                   columns=[file_names[0]]).num_rows
+            pick = file_names[0]
+            for name, t in zip(file_names, f.schema.types):
+                if pa.types.is_primitive(t):
+                    pick = name
+                    break
+            n_rows = f.read_stripe(stripe, columns=[pick]).num_rows
         else:
             if stripe > 0:
                 return None
@@ -157,16 +159,3 @@ class OrcScanExec(ExecutionPlan):
                          self._file_schema.field(n).data_type.to_arrow())
              for n in (proj or self._file_schema.names)})
 
-    def _append_partition_columns(self, tbl: pa.Table,
-                                  pvals: Sequence) -> pa.Table:
-        ps = self._partition_schema
-        out = tbl
-        for i, n in enumerate(ps.names):
-            if self._projection is not None and n not in self._projection:
-                continue
-            t = ps.field(n).data_type.to_arrow()
-            v = pvals[i] if i < len(pvals) else None
-            col = (pa.nulls(tbl.num_rows, t) if v is None
-                   else pa.array([v] * tbl.num_rows, type=t))
-            out = out.append_column(n, col)
-        return out

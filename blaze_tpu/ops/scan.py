@@ -241,25 +241,9 @@ class ParquetScanExec(ExecutionPlan):
         self._schema order (projection may interleave the two)."""
         if not self._out_partition_fields:
             return rb
-        values: dict = {}
-        if self._partition_values is not None:
-            group = self._partition_values[partition]
-            if fidx < len(group):
-                values = {f.name: v for f, v in
-                          zip(self._partition_schema, group[fidx])}
-        by_name = {rb.schema.field(i).name: rb.column(i)
-                   for i in range(rb.num_columns)}
-        arrays = []
-        for fld in self._schema:
-            if fld.name in by_name:
-                arrays.append(by_name[fld.name])
-                continue
-            v = values.get(fld.name)
-            at = fld.data_type.to_arrow()
-            arrays.append(pa.nulls(rb.num_rows, type=at) if v is None
-                          else pa.array([v] * rb.num_rows, type=at))
-        return pa.RecordBatch.from_arrays(
-            arrays, schema=self._schema.to_arrow())
+        return assemble_partition_constants(
+            rb, self._schema, self._partition_schema,
+            self._partition_values, partition, fidx)
 
     def _prune_row_groups(self, f: pq.ParquetFile,
                           prune_pred=None) -> List[int]:
@@ -271,6 +255,36 @@ class ParquetScanExec(ExecutionPlan):
         from blaze_tpu.ops.pruning import prune_with_stats
         return prune_with_stats(md, self._file_schema, prune_pred,
                                 all_groups)
+
+
+def assemble_partition_constants(rb: pa.RecordBatch, out_schema: Schema,
+                                 partition_schema: Optional[Schema],
+                                 partition_values, partition: int,
+                                 fidx: int) -> pa.RecordBatch:
+    """Merge file columns with Hive partition constants into
+    `out_schema` order (FileScanConfig partition_values): missing or
+    short per-file value lists null-fill.  ONE implementation for every
+    scan format — the parquet and ORC scans must never drift on
+    partition-constant semantics (r5 review)."""
+    values: dict = {}
+    if partition_values is not None and partition < len(partition_values):
+        group = partition_values[partition]
+        if fidx < len(group):
+            values = {f.name: v for f, v in
+                      zip(partition_schema, group[fidx])}
+    by_name = {rb.schema.field(i).name: rb.column(i)
+               for i in range(rb.num_columns)}
+    arrays = []
+    for fld in out_schema:
+        if fld.name in by_name:
+            arrays.append(by_name[fld.name])
+            continue
+        v = values.get(fld.name)
+        at = fld.data_type.to_arrow()
+        arrays.append(pa.nulls(rb.num_rows, type=at) if v is None
+                      else pa.array([v] * rb.num_rows, type=at))
+    return pa.RecordBatch.from_arrays(
+        arrays, schema=out_schema.to_arrow())
 
 
 def _align_schema(rb: pa.RecordBatch, schema: Schema) -> pa.RecordBatch:
