@@ -85,8 +85,59 @@ def _op_name(call: dict) -> str:
     return (m.group(1) if m else name).upper()
 
 
+# ---------------------------------------------------------------------------
+# converter registry (FlinkNodeConverterFactory parity: pluggable Rex /
+# AggregateCall converters keyed by node kind, consulted before the
+# built-ins; registering a duplicate kind raises like the reference)
+# ---------------------------------------------------------------------------
+
+_REX_CONVERTERS: Dict[str, Any] = {}
+_AGG_CONVERTERS: Dict[str, Any] = {}
+
+
+def register_rex_converter(kind: str, fn) -> None:
+    """FlinkNodeConverterFactory.registerRexConverter analog."""
+    if kind in _REX_CONVERTERS:
+        raise ValueError(f"rex converter for {kind!r} already registered")
+    _REX_CONVERTERS[kind] = fn
+
+
+def register_agg_converter(name: str, fn) -> None:
+    """FlinkNodeConverterFactory.registerAggConverter analog."""
+    if name in _AGG_CONVERTERS:
+        raise ValueError(f"agg converter for {name!r} already registered")
+    _AGG_CONVERTERS[name] = fn
+
+
+def convert_agg_call(call: dict) -> Dict[str, Any]:
+    """Calcite AggregateCall -> engine agg spec (FlinkAggCallConverter:
+    function name + argument input refs + distinctness).  Custom
+    converters registered for the function name win."""
+    name = _op_name(call) or str(call.get("name", "")).split("(")[0]
+    custom = _AGG_CONVERTERS.get(name)
+    if custom is not None:
+        return custom(call)
+    args = [{"kind": "column", "index": int(i)}
+            for i in call.get("argList", [])]
+    distinct = bool(call.get("distinct", False))
+    fns = {"SUM": "sum", "SUM0": "sum", "COUNT": "count", "MIN": "min",
+           "MAX": "max", "AVG": "avg"}
+    if name not in fns:
+        raise ConversionError("AggregateCall",
+                              f"unsupported aggregate {name!r}")
+    if distinct:
+        raise ConversionError("AggregateCall",
+                              f"DISTINCT {name} has no native kernel")
+    if name == "COUNT" and not args:
+        args = [{"kind": "literal", "value": 1, "type": {"id": "int64"}}]
+    return {"fn": fns[name], "args": args}
+
+
 def convert_rex(node: dict) -> Dict[str, Any]:
     kind = node.get("kind")
+    custom = _REX_CONVERTERS.get(kind or "")
+    if custom is not None:
+        return custom(node)
     if kind == "INPUT_REF":
         return {"kind": "column", "index": int(node["inputIndex"])}
     if kind == "LITERAL":
@@ -188,6 +239,10 @@ def convert_flink_plan(plan_json, num_partitions: int = 1
         ntype = node["type"].split("_")[0]
         if ntype == "stream-exec-calc":
             plan = _convert_calc(node, plan)
+        elif ntype in ("stream-exec-local-group-aggregate",
+                       "stream-exec-group-aggregate",
+                       "stream-exec-global-group-aggregate"):
+            plan = _convert_group_aggregate(node, plan, ntype)
         elif ntype in ("stream-exec-sink", "stream-exec-exchange"):
             continue  # sink collects; exchange is the host's business
         else:
@@ -196,12 +251,52 @@ def convert_flink_plan(plan_json, num_partitions: int = 1
     return plan
 
 
+def _convert_group_aggregate(node: dict, child: Dict[str, Any],
+                             ntype: str) -> Dict[str, Any]:
+    """Flink group aggregate -> engine hash_agg.  The TWO_PHASE pair
+    maps onto the engine's partial/final split: the LOCAL node emits
+    accumulator columns (mode=partial), the GLOBAL node rebinds them
+    POSITIONALLY (groups first, then each agg's acc columns — two for
+    avg, one otherwise) and finalizes (mode=final).  The one-phase
+    GroupAggregate node runs COMPLETE over raw input.  AggregateCalls
+    convert through the registry (convert_agg_call)."""
+    grouping = [int(i) for i in node.get("grouping", [])]
+    calls = node.get("aggCalls", [])
+    mode = {"stream-exec-local-group-aggregate": "partial",
+            "stream-exec-global-group-aggregate": "final",
+            "stream-exec-group-aggregate": "complete"}[ntype]
+    aggs = []
+    if mode == "final":
+        pos = len(grouping)
+        for i, call in enumerate(calls):
+            spec = convert_agg_call(call)
+            nacc = 2 if spec["fn"] == "avg" else 1
+            aggs.append({"fn": spec["fn"], "mode": "final",
+                         "name": str(call.get("name") or f"agg{i}"),
+                         "args": [{"kind": "column", "index": pos + t}
+                                  for t in range(nacc)]})
+            pos += nacc
+        groupings = [{"expr": {"kind": "column", "index": i},
+                      "name": f"g{g}"}
+                     for i, g in enumerate(grouping)]
+    else:
+        for i, call in enumerate(calls):
+            spec = convert_agg_call(call)
+            aggs.append({"fn": spec["fn"], "mode": mode,
+                         "name": str(call.get("name") or f"agg{i}"),
+                         "args": spec["args"]})
+        groupings = [{"expr": {"kind": "column", "index": g},
+                      "name": f"g{g}"} for g in grouping]
+    return {"kind": "hash_agg", "groupings": groupings,
+            "aggs": aggs, "input": child}
+
+
 def _convert_source(node: dict, num_partitions: int) -> Dict[str, Any]:
     table = (node.get("scanTableSource") or {}).get("table") or {}
     resolved = table.get("resolvedTable") or table
     options = resolved.get("options") or {}
     connector = options.get("connector", "")
-    if connector != "kafka":
+    if connector not in ("kafka", "values"):
         raise ConversionError(node.get("type", "source"),
                               f"unsupported connector {connector!r} "
                               f"(the reference accelerates Kafka "
@@ -212,6 +307,17 @@ def _convert_source(node: dict, num_partitions: int) -> Dict[str, Any]:
                "nullable": "NOT NULL" not in str(c.get("dataType",
                                                        c.get("type")))}
               for c in cols]
+    if connector == "values":
+        # the `values` bounded test connector (Flink's ITCase source):
+        # rows come from a pre-registered engine resource
+        rid = options.get("resource-id")
+        if not rid:
+            raise ConversionError(node.get("type", "source"),
+                                  "values connector needs a "
+                                  "'resource-id' option")
+        return {"kind": "memory_scan", "resource_id": rid,
+                "schema": {"fields": fields},
+                "num_partitions": num_partitions}
     fmt = options.get("format", options.get("value.format", "json"))
     d: Dict[str, Any] = {
         "kind": "kafka_scan",

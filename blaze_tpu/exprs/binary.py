@@ -73,8 +73,7 @@ class BinaryExpr(PhysicalExpr):
         return ldt, rdt
 
     def data_type(self, schema: Schema) -> DataType:
-        lt = self.left.data_type(schema)
-        rt = self.right.data_type(schema)
+        lt, rt = self._child_types(schema)
         if self.op in _CMP or self.op in _BOOLEAN:
             return BOOL
         dec = self._decimal_types(lt, rt)
@@ -91,16 +90,30 @@ class BinaryExpr(PhysicalExpr):
              "int64": S.INT64, "float32": S.FLOAT32, "float64": S.FLOAT64}
         return m[jnp.dtype(dt).name]
 
+    def _child_types(self, schema: Schema):
+        """(lt, rt) memoized per schema identity: evaluate() runs per
+        BATCH, and re-deriving child types walks the whole subtree —
+        quadratic in expression depth without the cache."""
+        cached = getattr(self, "_ct_cache", None)
+        if cached is not None and cached[0] == id(schema):
+            return cached[1], cached[2]
+        lt = self.left.data_type(schema)
+        rt = self.right.data_type(schema)
+        object.__setattr__(self, "_ct_cache", (id(schema), lt, rt))
+        return lt, rt
+
     def evaluate(self, batch: ColumnBatch) -> ColVal:
         a = self.left.evaluate(batch)
         b = self.right.evaluate(batch)
-        lt = self.left.data_type(batch.schema)
-        rt = self.right.data_type(batch.schema)
+        lt, rt = self._child_types(batch.schema)
         dec = self._decimal_types(lt, rt)
-        if dec is not None and not self._decimal_device_ok(*dec):
+        if dec is not None and not (self._decimal_device_ok(*dec)
+                                    and a.is_device and b.is_device):
             # exact Spark decimal semantics (scale alignment, result
             # widening, overflow -> null) — the unscaled-int64 device
-            # math below is only correct for EQUAL scales within p<=18
+            # math below is only correct for EQUAL scales within p<=18,
+            # and HOST-form operands (wide intermediates) must not fall
+            # into _evaluate_host, which has no arithmetic
             from blaze_tpu.exprs import decimal_arith as D
             return D.evaluate(self.op, a, b, dec[0], dec[1], batch)
         if not a.is_device or not b.is_device:

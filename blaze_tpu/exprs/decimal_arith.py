@@ -29,9 +29,10 @@ from blaze_tpu.schema import BOOL, DataType, TypeId
 _MAX_PRECISION = 38
 _MIN_DIVISION_SCALE = 6
 
-#: integral operand widths as decimal (Spark DecimalType.forType)
+#: integral operand widths as decimal (Spark DecimalType.forType —
+#: which has NO DateType entry; date comparisons stay on device)
 _INT_AS_DECIMAL = {"int8": (3, 0), "int16": (5, 0), "int32": (10, 0),
-                   "int64": (20, 0), "bool": (1, 0), "date32": (10, 0)}
+                   "int64": (20, 0), "bool": (1, 0)}
 
 
 def as_decimal_type(t: DataType) -> Optional[DataType]:

@@ -1402,6 +1402,23 @@ def _decimal_arithmetic():
                "return_type": {"id": "decimal", "precision": 38,
                                "scale": 0}}],
              [(D("1" + "0" * 34),)]),
+        Case("nested arithmetic chains host intermediates correctly",
+             # (a + b) + c: the inner add returns a HOST decimal of a
+             # widened type; the outer equal-scale add must not fall
+             # into the host comparator path (r5 review finding)
+             pa.table({"a": pa.array([D("12.34")], pa.decimal128(10, 2)),
+                       "b": pa.array([D("1.234")], pa.decimal128(10, 3)),
+                       "c": pa.array([D("0.006")],
+                                     pa.decimal128(12, 3))}),
+             [_bin("+", _bin("+", _col(0), _col(1)), _col(2))],
+             [(D("13.580"),)]),
+        Case("date compared to decimal stays a device comparison",
+             pa.table({"d": pa.array([_dt.date(2020, 1, 1)],
+                                     pa.date32()),
+                       "x": pa.array([_dt.date(2019, 1, 1)],
+                                     pa.date32())}),
+             [_bin(">", _col(0), _col(1))],
+             [(True,)]),
         Case("null decimal operand poisons the row",
              pa.table({"a": pa.array([D("1.00"), None],
                                      pa.decimal128(10, 2)),

@@ -162,3 +162,96 @@ def test_micro_batch_runtime_operator():
     # restore rolls offsets back (at-least-once replay contract)
     op.restore_state(ckpt)
     assert op.offsets[0] == 2
+
+
+def test_group_aggregate_node_converts_and_runs():
+    """stream-exec-group-aggregate -> hash_agg through the AggregateCall
+    converter registry (FlinkAggCallConverter analog)."""
+    import pyarrow as pa
+    from blaze_tpu.bridge.resource import put_resource
+    from blaze_tpu.plan import create_plan
+    t = pa.table({"k": pa.array([1, 1, 2]), "v": pa.array([10.0, 5.0, 2.0])})
+    put_resource("flink://agg-src", t)
+    plan_json = {
+        "nodes": [
+            {"id": 1, "type": "stream-exec-table-source-scan_1",
+             "scanTableSource": {"table": {
+                 "identifier": "`default`.`db`.`t`",
+                 "resolvedTable": {"schema": {"columns": [
+                     {"name": "k", "dataType": "BIGINT"},
+                     {"name": "v", "dataType": "DOUBLE"}]},
+                     "options": {"connector": "values",
+                                 "resource-id": "flink://agg-src"}}}}},
+            {"id": 2, "type": "stream-exec-group-aggregate_1",
+             "grouping": [0],
+             "aggCalls": [{"name": "s", "internalName": "$SUM$1",
+                           "argList": [1]},
+                          {"name": "c", "internalName": "$COUNT$1",
+                           "argList": []}]},
+            {"id": 3, "type": "stream-exec-sink_1"}],
+        "edges": [{"source": 1, "target": 2}, {"source": 2, "target": 3}]}
+    ir = convert_flink_plan(plan_json)
+    assert ir["kind"] == "hash_agg"
+    out = pa.Table.from_batches(
+        [b.compact().to_arrow() for b in create_plan(ir).execute(0)])
+    got = {r[0]: (r[1], r[2]) for r in
+           zip(*[c.to_pylist() for c in out.columns])}
+    assert got == {1: (15.0, 2), 2: (2.0, 1)}
+
+
+def test_agg_converter_registry_rejects_duplicates():
+    from blaze_tpu.convert import flink as F
+    F.register_agg_converter("MYAGG", lambda c: {"fn": "sum", "args": []})
+    try:
+        with pytest.raises(ValueError, match="already registered"):
+            F.register_agg_converter("MYAGG", lambda c: None)
+        # custom converter wins over built-ins
+        spec = F.convert_agg_call({"internalName": "$MYAGG$1"})
+        assert spec == {"fn": "sum", "args": []}
+    finally:
+        F._AGG_CONVERTERS.pop("MYAGG", None)
+
+
+def test_distinct_aggregate_falls_back():
+    from blaze_tpu.convert import flink as F
+    from blaze_tpu.convert.flink import ConversionError
+    with pytest.raises(ConversionError, match="DISTINCT"):
+        F.convert_agg_call({"internalName": "$SUM$1", "argList": [0],
+                            "distinct": True})
+
+
+def test_two_phase_local_global_aggregate():
+    """TWO_PHASE agg: local -> partial acc columns, global -> final
+    rebinding them positionally (the engine's partial/final split)."""
+    import pyarrow as pa
+    from blaze_tpu.bridge.resource import put_resource
+    from blaze_tpu.plan import create_plan
+    t = pa.table({"k": pa.array([1, 1, 2, 2]),
+                  "v": pa.array([10.0, 5.0, 2.0, 1.0])})
+    put_resource("flink://2p-src", t)
+    src = {"id": 1, "type": "stream-exec-table-source-scan_1",
+           "scanTableSource": {"table": {
+               "identifier": "`d`.`db`.`t`",
+               "resolvedTable": {"schema": {"columns": [
+                   {"name": "k", "dataType": "BIGINT"},
+                   {"name": "v", "dataType": "DOUBLE"}]},
+                   "options": {"connector": "values",
+                               "resource-id": "flink://2p-src"}}}}}
+    calls = [{"name": "s", "internalName": "$SUM$1", "argList": [1]},
+             {"name": "a", "internalName": "$AVG$1", "argList": [1]}]
+    plan_json = {
+        "nodes": [src,
+                  {"id": 2, "type": "stream-exec-local-group-aggregate_1",
+                   "grouping": [0], "aggCalls": calls},
+                  {"id": 3, "type": "stream-exec-exchange_1"},
+                  {"id": 4, "type": "stream-exec-global-group-aggregate_1",
+                   "grouping": [0], "aggCalls": calls},
+                  {"id": 5, "type": "stream-exec-sink_1"}],
+        "edges": [{"source": 1, "target": 2}, {"source": 2, "target": 3},
+                  {"source": 3, "target": 4}, {"source": 4, "target": 5}]}
+    ir = convert_flink_plan(plan_json)
+    out = pa.Table.from_batches(
+        [b.compact().to_arrow() for b in create_plan(ir).execute(0)])
+    got = {r[0]: (r[1], r[2]) for r in
+           zip(*[c.to_pylist() for c in out.columns])}
+    assert got == {1: (15.0, 7.5), 2: (3.0, 1.5)}
