@@ -109,5 +109,9 @@ def _to_json(args, batch, out_type):
             v = x.as_py()
             if isinstance(v, list) and v and isinstance(v[0], tuple):
                 v = dict(v)  # map entries
+            if isinstance(v, dict):
+                # Spark default spark.sql.jsonGenerator.ignoreNullFields
+                # =true: null struct fields are OMITTED from the output
+                v = {k: val for k, val in v.items() if val is not None}
             py.append(json.dumps(v, separators=(",", ":")))
     return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))

@@ -1427,3 +1427,103 @@ def _decimal_arithmetic():
              [_bin("+", _col(0), _col(1))],
              [(D("3.00"),), (None,)]),
     ]
+
+
+# ---------------------------------------------------------------------------
+# wave 3: json rendering, nested-type display casts, crypto widths,
+# window group-limit, SMJ semi/anti (the final breadth push)
+# ---------------------------------------------------------------------------
+
+@_suite("ToJsonSuite")
+def _to_json_suite():
+    return [
+        Case("to_json omits null fields (ignoreNullFields default)",
+             pa.table({"a": pa.array([1, None])}),
+             [_fn("to_json", {"kind": "named_struct",
+                              "names": ["x", "y"],
+                              "args": [_col(0), _lit("s", "utf8")]},
+                  rt="utf8")],
+             [('{"x":1,"y":"s"}',), ('{"y":"s"}',)]),
+        Case("to_json over an array value",
+             pa.table({"a": pa.array([[1, 2]])}),
+             [_fn("to_json", _col(0), rt="utf8")],
+             [("[1,2]",)]),
+    ]
+
+
+@_suite("NestedDisplayCastSuite")
+def _nested_display_cast():
+    return [
+        Case("array renders Spark-style with null literal",
+             pa.table({"a": pa.array([[1, 2, None]])}),
+             [_cast(_col(0), "utf8")],
+             [("[1, 2, null]",)]),
+        Case("struct renders value tuple without field names",
+             pa.table({"s": pa.array([{"x": 1, "y": "a"}],
+                                     pa.struct([("x", pa.int64()),
+                                                ("y", pa.utf8())]))}),
+             [_cast(_col(0), "utf8")],
+             [("{1, a}",)]),
+    ]
+
+
+@_suite("CryptoWidthSuite")
+def _crypto_width():
+    return [
+        Case("sha2 bit widths select the digest family",
+             pa.table({"s": pa.array(["abc"])}),
+             [_fn("sha2", _col(0), _lit(224), rt="utf8"),
+              _fn("sha2", _col(0), _lit(384), rt="utf8")],
+             [("23097d223405d8228642a477bda255b32aadbce4bda0b3f7e36c"
+               "9da7",
+               "cb00753f45a35e8bb5a03d699ac65007272c32ab0eded1631a8b"
+               "605a43ff5bed8086072ba1e7cc2358baeca134c825a7")]),
+        Case("md5 of empty string",
+             pa.table({"s": pa.array([""])}),
+             [_fn("md5", _col(0), rt="utf8")],
+             [("d41d8cd98f00b204e9800998ecf8427e",)]),
+    ]
+
+
+@_suite("WindowGroupLimitSuite")
+def _window_group_limit():
+    t = pa.table({"g": pa.array([1, 1, 1, 2, 2]),
+                  "x": pa.array([9, 7, 5, 4, 8])})
+
+    def plan(scan):
+        return {"kind": "window",
+                "input": {"kind": "sort", "input": scan,
+                          "specs": [{"expr": _col(0),
+                                     "descending": False,
+                                     "nulls_first": True},
+                                    {"expr": _col(1),
+                                     "descending": True,
+                                     "nulls_first": False}]},
+                "functions": [{"kind": "rank", "name": "rk"}],
+                "partition_by": [_col(0)],
+                "order_by": [{"expr": _col(1), "descending": True}],
+                "group_limit": 2}
+    return [
+        Case("window-group-limit keeps top-k rows per partition",
+             t, [], [(1, 9, 1), (1, 7, 2), (2, 8, 1), (2, 4, 2)],
+             plan=plan),
+    ]
+
+
+@_suite("SortMergeJoinTypesSuite")
+def _smj_types():
+    l = pa.table({"a": pa.array([1, 2, None]),
+                  "lv": pa.array([10, 20, 30])})
+    r = pa.table({"b": pa.array([2, None, 2]),
+                  "rv": pa.array([100, 200, 300])})
+    return [
+        Case("SMJ left semi keeps each probe match once",
+             l, [], [(2, 20)], unordered=True, input2=r,
+             plan=_join_plan("sort_merge_join", "left_semi")),
+        Case("SMJ left anti keeps null-keyed probe rows",
+             l, [], [(1, 10), (None, 30)], unordered=True, input2=r,
+             plan=_join_plan("sort_merge_join", "left_anti")),
+        Case("SMJ right semi mirrors build-side membership",
+             l, [], [(2, 100), (2, 300)], unordered=True, input2=r,
+             plan=_join_plan("sort_merge_join", "right_semi")),
+    ]
