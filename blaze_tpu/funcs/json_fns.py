@@ -101,17 +101,30 @@ def _get_json_object(args, batch, out_type):
 @register("to_json", lambda ts: UTF8)
 def _to_json(args, batch, out_type):
     (a,) = [x.to_host(batch.num_rows) for x in args[:1]]
+    if isinstance(a, pa.ChunkedArray):
+        a = a.combine_chunks()
+
+    def render(v, t):
+        """Type-driven JSON shape (JacksonGenerator parity): null
+        STRUCT fields are omitted at every depth (ignoreNullFields
+        default true), null MAP values and ARRAY elements are kept,
+        an empty map is {} not []."""
+        if v is None:
+            return None
+        if pa.types.is_struct(t):
+            return {f.name: render(v.get(f.name), f.type)
+                    for f in t if v.get(f.name) is not None}
+        if pa.types.is_map(t):
+            return {k: render(val, t.item_type) for k, val in v}
+        if pa.types.is_list(t) or pa.types.is_large_list(t):
+            return [render(e, t.value_type) for e in v]
+        return v
+
     py = []
     for x in a:
         if not x.is_valid:
             py.append(None)
         else:
-            v = x.as_py()
-            if isinstance(v, list) and v and isinstance(v[0], tuple):
-                v = dict(v)  # map entries
-            if isinstance(v, dict):
-                # Spark default spark.sql.jsonGenerator.ignoreNullFields
-                # =true: null struct fields are OMITTED from the output
-                v = {k: val for k, val in v.items() if val is not None}
-            py.append(json.dumps(v, separators=(",", ":")))
+            py.append(json.dumps(render(x.as_py(), a.type),
+                                 separators=(",", ":")))
     return ColVal.host(UTF8, pa.array(py, type=pa.utf8()))

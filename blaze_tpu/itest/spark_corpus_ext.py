@@ -1527,3 +1527,31 @@ def _smj_types():
              l, [], [(2, 100), (2, 300)], unordered=True, input2=r,
              plan=_join_plan("sort_merge_join", "right_semi")),
     ]
+
+
+@_suite("ToJsonShapeSuite")
+def _to_json_shape():
+    nested = pa.struct([("a", pa.struct([("b", pa.int64()),
+                                         ("c", pa.int64())]))])
+    return [
+        Case("null struct fields are omitted RECURSIVELY",
+             pa.table({"s": pa.array([{"a": {"b": None, "c": 1}}],
+                                     nested)}),
+             [_fn("to_json", _col(0), rt="utf8")],
+             [('{"a":{"c":1}}',)]),
+        Case("null MAP values are kept (ignoreNullFields is "
+             "struct-only, JacksonGenerator.writeMapData)",
+             pa.table({"m": pa.array([[("k", None), ("j", 1)]],
+                                     pa.map_(pa.utf8(), pa.int64()))}),
+             [_fn("to_json", _col(0), rt="utf8")],
+             [('{"k":null,"j":1}',)]),
+        Case("empty map renders as {} not []",
+             pa.table({"m": pa.array([[]],
+                                     pa.map_(pa.utf8(), pa.int64()))}),
+             [_fn("to_json", _col(0), rt="utf8")],
+             [("{}",)]),
+        Case("null array elements are kept",
+             pa.table({"a": pa.array([[1, None, 3]])}),
+             [_fn("to_json", _col(0), rt="utf8")],
+             [("[1,null,3]",)]),
+    ]
