@@ -122,7 +122,65 @@ class BinaryExpr(PhysicalExpr):
             return _kleene(self.op, a, b)
         if self.op in _CMP:
             return _compare(self.op, a, b)
-        return _arith(self.op, a, b, self.data_type(batch.schema))
+        out = _arith(self.op, a, b, self.data_type(batch.schema))
+        if self.op in ("+", "-", "*", "/", "%", "pmod"):
+            from blaze_tpu import config
+            if config.ANSI_ENABLED.get():
+                self._ansi_arith_check(batch, a, b, out)
+        return out
+
+    def _ansi_arith_check(self, batch, a: ColVal, b: ColVal,
+                          out: ColVal) -> None:
+        """ANSI mode: integral division/modulo by zero raises
+        DIVIDE_BY_ZERO and integer overflow raises ARITHMETIC_OVERFLOW
+        instead of null/wrap.  Mirrors Cast._ansi_check_device: only
+        SELECTED rows can raise (filters set the mask without
+        compacting), one device sync per op, zero cost with ANSI off."""
+        from blaze_tpu.xputil import xp_of
+        mask = batch.row_mask()
+        both = _both_valid(a, b) & mask
+        xp = xp_of(a.data, b.data)
+        is_int = not (jnp.issubdtype(a.data.dtype, jnp.floating) or
+                      jnp.issubdtype(b.data.dtype, jnp.floating))
+        if self.op in ("/", "%", "pmod") and is_int:
+            # the non-ANSI kernel encodes /0 as result-null; a row that
+            # was valid on both inputs but null in the output divided
+            # by zero
+            lost = both & ~out.validity
+            if bool(xp_of(lost).any(lost)):
+                raise ValueError(
+                    "[DIVIDE_BY_ZERO] division by zero (ANSI mode; "
+                    "use try_divide or nullif to tolerate)")
+        if jnp.issubdtype(out.data.dtype, jnp.integer) and \
+                self.op in ("+", "-", "*", "/"):
+            x = a.data.astype(out.data.dtype)
+            y = b.data.astype(out.data.dtype)
+            r = out.data
+            int_min = jnp.iinfo(out.data.dtype).min
+            if self.op == "+":
+                ovf = ((x > 0) & (y > 0) & (r < 0)) | \
+                      ((x < 0) & (y < 0) & (r >= 0))
+            elif self.op == "-":
+                ovf = ((x >= 0) & (y < 0) & (r < 0)) | \
+                      ((x < 0) & (y > 0) & (r >= 0))
+            elif self.op == "*":
+                # verify by division (exact where y != 0); the verify
+                # division ITSELF wraps for INT_MIN // -1, so that pair
+                # needs an explicit clause
+                y_safe = xp.where(y == 0, xp.ones_like(y), y)
+                with np.errstate(all="ignore"):  # wrap IS the signal
+                    ovf = ((y != 0) & (r // y_safe != x)) | \
+                          ((x == int_min) & (y == -1)) | \
+                          ((y == int_min) & (x == -1))
+            else:
+                # integral division overflows ONLY at INT_MIN / -1
+                # (wraps to a perfectly valid INT_MIN)
+                ovf = (x == int_min) & (y == -1)
+            ovf = ovf & both
+            if bool(xp_of(ovf).any(ovf)):
+                raise ValueError(
+                    "[ARITHMETIC_OVERFLOW] integer overflow (ANSI "
+                    "mode; use try_add/try_multiply to tolerate)")
 
     def _decimal_device_ok(self, ldt: DataType, rdt: DataType) -> bool:
         """Equal-scale narrow decimals keep the vectorized device path:

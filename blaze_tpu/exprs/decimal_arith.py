@@ -93,9 +93,21 @@ def _to_pylist(cv, n: int, t: DataType):
 
 def evaluate(op: str, a_cv, b_cv, lt: DataType, rt: DataType, batch):
     """Exact decimal arithmetic / comparison over host values.
-    Returns a host ColVal of the Spark result type (arith) or BOOL."""
+    Returns a host ColVal of the Spark result type (arith) or BOOL.
+    ANSI mode raises DIVIDE_BY_ZERO / NUMERIC_VALUE_OUT_OF_RANGE for
+    SELECTED rows instead of yielding null."""
+    from blaze_tpu import config
     from blaze_tpu.exprs.base import ColVal
     n = batch.num_rows
+    ansi = config.ANSI_ENABLED.get()
+    sel = None
+
+    def _selected(row: int) -> bool:
+        nonlocal sel
+        if sel is None:
+            sel = batch.selected_mask()
+        return row >= len(sel) or bool(sel[row])
+
     av = _to_pylist(a_cv, n, lt)
     bv = _to_pylist(b_cv, n, rt)
     if op in ("==", "!=", "<", "<=", ">", ">=", "<=>"):
@@ -115,9 +127,16 @@ def evaluate(op: str, a_cv, b_cv, lt: DataType, rt: DataType, batch):
     out = []
     with pydec.localcontext() as ctx:
         ctx.prec = 76  # two full decimal128 operands
-        for x, y in zip(av, bv):
+        for row, (x, y) in enumerate(zip(av, bv)):
             if x is None or y is None:
                 out.append(None)
+                continue
+            if op in ("/", "%", "pmod") and y == 0:
+                if ansi and _selected(row):
+                    raise ValueError(
+                        "[DIVIDE_BY_ZERO] decimal division by zero "
+                        "(ANSI mode)")
+                out.append(None)  # non-ANSI
                 continue
             try:
                 if op == "+":
@@ -127,19 +146,10 @@ def evaluate(op: str, a_cv, b_cv, lt: DataType, rt: DataType, batch):
                 elif op == "*":
                     r = x * y
                 elif op == "/":
-                    if y == 0:
-                        out.append(None)  # non-ANSI DIVIDE_BY_ZERO
-                        continue
                     r = x / y
                 elif op == "%":
-                    if y == 0:
-                        out.append(None)
-                        continue
                     r = x % y  # sign follows dividend (Java remainder)
                 else:  # pmod
-                    if y == 0:
-                        out.append(None)
-                        continue
                     r = x % y
                     if r != 0 and (r < 0) != (y < 0):
                         r += y
@@ -148,6 +158,14 @@ def evaluate(op: str, a_cv, b_cv, lt: DataType, rt: DataType, batch):
                 out.append(None)
                 continue
             unscaled = int(r.scaleb(rt_out.scale))
-            # CheckOverflow: beyond the capped precision -> NULL
-            out.append(None if abs(unscaled) >= limit else r)
+            if abs(unscaled) >= limit:
+                # CheckOverflow: beyond the capped precision
+                if ansi and _selected(row):
+                    raise ValueError(
+                        "[NUMERIC_VALUE_OUT_OF_RANGE] decimal overflow "
+                        f"at {rt_out.precision},{rt_out.scale} "
+                        "(ANSI mode)")
+                out.append(None)
+            else:
+                out.append(r)
     return ColVal.host(rt_out, pa.array(out, type=rt_out.to_arrow()))

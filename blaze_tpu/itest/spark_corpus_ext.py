@@ -1555,3 +1555,78 @@ def _to_json_shape():
              [_fn("to_json", _col(0), rt="utf8")],
              [("[1,null,3]",)]),
     ]
+
+
+@_suite("AnsiArithmeticSuite")
+def _ansi_arithmetic():
+    return [
+        Case("ANSI: integral division by zero raises",
+             pa.table({"a": pa.array([10])}),
+             [_bin("%", _col(0), _lit(0))],
+             [], confs=_ANSI_ON, raises="DIVIDE_BY_ZERO"),
+        Case("ANSI: int64 addition overflow raises",
+             pa.table({"a": pa.array([I64MAX])}),
+             [_bin("+", _col(0), _lit(1))],
+             [], confs=_ANSI_ON, raises="ARITHMETIC_OVERFLOW"),
+        Case("ANSI: int64 multiply overflow raises",
+             pa.table({"a": pa.array([1 << 62])}),
+             [_bin("*", _col(0), _lit(4))],
+             [], confs=_ANSI_ON, raises="ARITHMETIC_OVERFLOW"),
+        Case("ANSI: subtraction underflow raises",
+             pa.table({"a": pa.array([I64MIN])}),
+             [_bin("-", _col(0), _lit(1))],
+             [], confs=_ANSI_ON, raises="ARITHMETIC_OVERFLOW"),
+        Case("ANSI: in-range arithmetic still computes",
+             pa.table({"a": pa.array([3])}),
+             [_bin("*", _col(0), _lit(4)),
+              _bin("%", _col(0), _lit(2))],
+             [(12, 1)], confs=_ANSI_ON),
+        Case("ANSI: float division by zero is Infinity, not an error",
+             pa.table({"a": pa.array([1.0])}),
+             [_bin("/", _col(0), _lit(0.0, "float64"))],
+             [(INF,)], confs=_ANSI_ON),
+        Case("ANSI: filtered-out rows cannot raise",
+             pa.table({"a": pa.array([10, 10]),
+                       "b": pa.array([2, 0])}),
+             [], [(5,)],
+             confs=_ANSI_ON,
+             plan=lambda scan: {
+                 "kind": "project",
+                 "exprs": [_bin("/", _col(0), _col(1))],
+                 "names": ["q"],
+                 "input": {"kind": "filter",
+                           "predicates": [_bin("!=", _col(1), _lit(0))],
+                           "input": scan}}),
+    ]
+
+
+@_suite("AnsiArithmeticEdgeSuite")
+def _ansi_arith_edge():
+    from decimal import Decimal as D
+    return [
+        Case("ANSI: INT64_MIN * -1 raises (verify-division wraps)",
+             pa.table({"a": pa.array([I64MIN])}),
+             [_bin("*", _col(0), _lit(-1))],
+             [], confs=_ANSI_ON, raises="ARITHMETIC_OVERFLOW"),
+        Case("ANSI: INT64_MIN / -1 raises, not wraps",
+             pa.table({"a": pa.array([I64MIN])}),
+             [_bin("/", _col(0), _lit(-1))],
+             [], confs=_ANSI_ON, raises="ARITHMETIC_OVERFLOW"),
+        Case("ANSI: decimal division by zero raises",
+             pa.table({"a": pa.array([D("1.00")], pa.decimal128(10, 2)),
+                       "b": pa.array([D("0.00")],
+                                     pa.decimal128(10, 2))}),
+             [_bin("/", _col(0), _col(1))],
+             [], confs=_ANSI_ON, raises="DIVIDE_BY_ZERO"),
+        Case("ANSI: decimal overflow raises",
+             pa.table({"a": pa.array([D("9" * 38)],
+                                     pa.decimal128(38, 0)),
+                       "b": pa.array([D("9" * 38)],
+                                     pa.decimal128(38, 0))}),
+             [_bin("+", _col(0), _col(1))],
+             [], confs=_ANSI_ON, raises="NUMERIC_VALUE_OUT_OF_RANGE"),
+        Case("non-ANSI: the same edges stay null/wrap",
+             pa.table({"a": pa.array([I64MIN])}),
+             [_bin("*", _col(0), _lit(-1))],
+             [(I64MIN,)]),
+    ]
