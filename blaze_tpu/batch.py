@@ -293,6 +293,16 @@ class ColumnBatch:
         n = self.num_rows if n is None else n
         return _np.asarray(self.row_mask())[:n]
 
+    def is_selected(self, row: int) -> bool:
+        """Row-level selection probe for raise-gating paths (ANSI casts,
+        element_at, decimal ANSI): lazily caches the host mask — one
+        device sync per batch at most, none when never consulted."""
+        m = getattr(self, "_sel_mask_cache", None)
+        if m is None:
+            m = self.selected_mask()
+            self._sel_mask_cache = m
+        return row >= len(m) or bool(m[row])
+
     def selected_count(self) -> int:
         """Host-synced surviving row count (one scalar D2H, cached — on a
         tunneled device every sync costs a full round trip)."""

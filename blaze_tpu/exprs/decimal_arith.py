@@ -91,23 +91,20 @@ def _to_pylist(cv, n: int, t: DataType):
     return out
 
 
-def evaluate(op: str, a_cv, b_cv, lt: DataType, rt: DataType, batch):
+def evaluate(op: str, a_cv, b_cv, lt: DataType, rt: DataType, batch,
+             ansi: Optional[bool] = None):
     """Exact decimal arithmetic / comparison over host values.
     Returns a host ColVal of the Spark result type (arith) or BOOL.
     ANSI mode raises DIVIDE_BY_ZERO / NUMERIC_VALUE_OUT_OF_RANGE for
-    SELECTED rows instead of yielding null."""
+    SELECTED rows instead of yielding null.  `ansi` overrides the
+    session conf — try_* callers pass False EXPLICITLY rather than
+    scoping the process-global config (worker threads share it)."""
     from blaze_tpu import config
     from blaze_tpu.exprs.base import ColVal
     n = batch.num_rows
-    ansi = config.ANSI_ENABLED.get()
-    sel = None
-
-    def _selected(row: int) -> bool:
-        nonlocal sel
-        if sel is None:
-            sel = batch.selected_mask()
-        return row >= len(sel) or bool(sel[row])
-
+    if ansi is None:
+        ansi = config.ANSI_ENABLED.get()
+    _selected = batch.is_selected
     av = _to_pylist(a_cv, n, lt)
     bv = _to_pylist(b_cv, n, rt)
     if op in ("==", "!=", "<", "<=", ">", ">=", "<=>"):

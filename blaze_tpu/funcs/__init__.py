@@ -86,6 +86,7 @@ from blaze_tpu.funcs import collections as _coll   # noqa: E402,F401
 from blaze_tpu.funcs import crypto as _crypto      # noqa: E402,F401
 from blaze_tpu.funcs import decimal_fns as _dec    # noqa: E402,F401
 from blaze_tpu.funcs import json_fns as _json      # noqa: E402,F401
+from blaze_tpu.funcs import try_arith as _try      # noqa: E402,F401
 
 __all__ = ["ScalarFunctionExpr", "fn", "register", "lookup",
            "registered_names"]

@@ -209,20 +209,14 @@ def _map_values(args, batch, out_type):
 
 
 @register("element_at")
-def _element_at(args, batch, out_type):
+def _element_at(args, batch, out_type, ansi=None):
     from blaze_tpu import config
     a, k = _host(args, batch)
-    ansi = config.ANSI_ENABLED.get()
-    # raises must only fire for SELECTED rows (batch.selected_mask);
-    # the mask costs a device sync, so fetch it lazily at first need
-    sel = None
-
-    def _selected(row: int) -> bool:
-        nonlocal sel
-        if sel is None:
-            sel = batch.selected_mask()
-        return row >= len(sel) or bool(sel[row])
-
+    if ansi is None:
+        ansi = config.ANSI_ENABLED.get()
+    # raises must only fire for SELECTED rows (batch.is_selected caches
+    # the host mask lazily — no sync unless a raise path is consulted)
+    _selected = batch.is_selected
     py = []
     if pa.types.is_map(a.type):
         for row, (x, key) in enumerate(zip(a, k)):

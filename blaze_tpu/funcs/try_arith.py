@@ -1,0 +1,137 @@
+"""Spark `try_*` arithmetic family.
+
+Parity: Spark's TryAdd/TrySubtract/TryMultiply/TryDivide/TryElementAt —
+the ANSI-tolerant forms our own ANSI error messages point users at
+("use try_divide or nullif", "use try_add/try_multiply").  Semantics:
+
+  * try_add/try_subtract/try_multiply: the plain op, but integer
+    overflow AT THE OPERANDS' COMMON WIDTH -> NULL (never raises,
+    even in ANSI mode); decimals use the exact decimal path with
+    Spark's widened result types (overflow -> NULL);
+  * try_divide: DOUBLE division with divisor 0 -> NULL (Spark's
+    try_divide nulls /0 even for doubles); decimal/decimal stays
+    decimal with /0 -> NULL;
+  * try_element_at: element_at with out-of-bounds -> NULL in every
+    mode (index 0 still INVALID_INDEX_OF_ZERO, matching Spark).
+
+ANSI suppression is passed EXPLICITLY into the shared evaluators
+(ansi=False) — scoping the process-global config would race with
+concurrently evaluating task threads (r5 review finding).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import pyarrow as pa
+
+from blaze_tpu.exprs.base import ColVal
+from blaze_tpu.funcs import register
+from blaze_tpu.schema import (DataType, FLOAT64, INT8, INT16, INT32,
+                              INT64, TypeId)
+
+_INT_ORDER = [("int8", INT8, 8), ("int16", INT16, 16),
+              ("int32", INT32, 32), ("int64", INT64, 64)]
+_INT_BITS = {tid: bits for tid, _t, bits in _INT_ORDER}
+
+
+def _decimal_pair_types(lt: DataType, rt: DataType):
+    from blaze_tpu.exprs import decimal_arith as D
+    if TypeId.DECIMAL not in (lt.id, rt.id):
+        return None
+    la, lb = D.as_decimal_type(lt), D.as_decimal_type(rt)
+    if la is None or lb is None:
+        return None
+    return la, lb
+
+
+def _promoted_int(lt: DataType, rt: DataType) -> DataType:
+    bits = max(_INT_BITS.get(lt.id.value, 64),
+               _INT_BITS.get(rt.id.value, 64))
+    for tid, t, b in _INT_ORDER:
+        if b == bits:
+            return t
+    return INT64
+
+
+def _try_type_fn(op):
+    """Result type: Spark's decimal widening when decimals are
+    involved, double for try_divide, else the operands' promoted
+    integer width / double for float mixes."""
+    def tf(ts):
+        from blaze_tpu.exprs import decimal_arith as D
+        lt = ts[0] if ts else INT64
+        rt = ts[1] if len(ts) > 1 else lt
+        dec = _decimal_pair_types(lt, rt)
+        if dec is not None:
+            return D.result_type(op, *dec)
+        if op == "/":
+            return FLOAT64
+        if lt.is_floating or rt.is_floating:
+            return FLOAT64
+        return _promoted_int(lt, rt)
+    return tf
+
+
+def _try_int_arith(op: str, a: ColVal, b: ColVal, batch,
+                   out_t: DataType) -> ColVal:
+    """Integer op with overflow AT out_t's WIDTH -> NULL: exact Python
+    ints host-side (try_* sites are boundary-value checks, not hot
+    loops)."""
+    n = batch.num_rows
+    av = a.to_host(n).to_pylist()
+    bv = b.to_host(n).to_pylist()
+    bits = _INT_BITS.get(out_t.id.value, 64)
+    lo, hi = -(1 << (bits - 1)), (1 << (bits - 1)) - 1
+    out = []
+    for x, y in zip(av, bv):
+        if x is None or y is None:
+            out.append(None)
+            continue
+        r = x + y if op == "+" else x - y if op == "-" else x * y
+        out.append(r if lo <= r <= hi else None)
+    return ColVal.host(out_t, pa.array(out, type=out_t.to_arrow()))
+
+
+def _try_binary(op):
+    def fn(args, batch, out_type):
+        a, b = args[0], args[1]
+        dec = _decimal_pair_types(a.dtype, b.dtype)
+        if dec is not None:
+            from blaze_tpu.exprs import decimal_arith as D
+            # ansi=False EXPLICITLY: try_* never raises
+            return D.evaluate(op, a, b, dec[0], dec[1], batch,
+                              ansi=False)
+        if op == "/":
+            # Spark try_divide: DOUBLE division, /0 -> NULL even for
+            # floats (unlike plain `/`, which gives Infinity)
+            da = a.to_device(batch.capacity)
+            db = b.to_device(batch.capacity)
+            x = da.data.astype(jnp.float64)
+            y = db.data.astype(jnp.float64)
+            zero = y == 0
+            data = x / jnp.where(zero, jnp.ones_like(y), y)
+            valid = da.validity & db.validity & ~zero
+            return ColVal(FLOAT64, data=jnp.where(valid, data, 0.0),
+                          validity=valid)
+        if a.dtype.is_floating or b.dtype.is_floating:
+            from blaze_tpu.exprs.binary import _arith
+            da = a.to_device(batch.capacity)
+            db = b.to_device(batch.capacity)
+            return _arith(op, da, db, FLOAT64)
+        return _try_int_arith(op, a, b, batch,
+                              _promoted_int(a.dtype, b.dtype))
+    return fn
+
+
+register("try_add", _try_type_fn("+"))(_try_binary("+"))
+register("try_subtract", _try_type_fn("-"))(_try_binary("-"))
+register("try_multiply", _try_type_fn("*"))(_try_binary("*"))
+register("try_divide", _try_type_fn("/"))(_try_binary("/"))
+
+
+@register("try_element_at")
+def _try_element_at(args, batch, out_type):
+    """element_at with out-of-bounds -> NULL in every mode (Spark
+    TryElementAt); index 0 still raises INVALID_INDEX_OF_ZERO."""
+    from blaze_tpu.funcs.collections import _element_at
+    return _element_at(args, batch, out_type, ansi=False)

@@ -1630,3 +1630,64 @@ def _ansi_arith_edge():
              [_bin("*", _col(0), _lit(-1))],
              [(I64MIN,)]),
     ]
+
+
+@_suite("TryArithmeticSuite")
+def _try_arithmetic():
+    from decimal import Decimal as D
+    return [
+        Case("try_add nulls int64 overflow in every mode",
+             pa.table({"a": pa.array([I64MAX, 5])}),
+             [_fn("try_add", _col(0), _lit(1), rt="int64")],
+             [(None,), (6,)], confs=_ANSI_ON),
+        Case("try_subtract nulls underflow",
+             pa.table({"a": pa.array([I64MIN])}),
+             [_fn("try_subtract", _col(0), _lit(1), rt="int64")],
+             [(None,)]),
+        Case("try_multiply nulls overflow incl INT64_MIN * -1",
+             pa.table({"a": pa.array([I64MIN, 3])}),
+             [_fn("try_multiply", _col(0), _lit(-1), rt="int64")],
+             [(None,), (-3,)]),
+        Case("try_divide is double division, /0 null even for floats",
+             pa.table({"a": pa.array([7, 1])}),
+             [_fn("try_divide", _col(0), _lit(2), rt="float64"),
+              _fn("try_divide", _col(0), _lit(0), rt="float64")],
+             [(3.5, None), (0.5, None)]),
+        Case("try_divide decimal keeps decimal, /0 null under ANSI",
+             pa.table({"a": pa.array([D("1.00")], pa.decimal128(10, 2)),
+                       "b": pa.array([D("0.00")],
+                                     pa.decimal128(10, 2))}),
+             [_fn("try_divide", _col(0), _col(1))],
+             [(None,)], confs=_ANSI_ON),
+        Case("try_element_at out-of-bounds is null under ANSI too",
+             pa.table({"a": pa.array([[1, 2]])}),
+             [_fn("try_element_at", _col(0), _lit(5), rt="int64")],
+             [(None,)], confs=_ANSI_ON),
+        Case("try_element_at index 0 still raises",
+             pa.table({"a": pa.array([[1, 2]])}),
+             [_fn("try_element_at", _col(0), _lit(0), rt="int64")],
+             [], raises="INVALID_INDEX_OF_ZERO"),
+    ]
+
+
+@_suite("TryArithmeticWidthSuite")
+def _try_arith_width():
+    from decimal import Decimal as D
+    return [
+        Case("try_add nulls at INT32 bounds for int32 operands",
+             pa.table({"a": pa.array([I32MAX, 5], pa.int32()),
+                       "b": pa.array([1, 1], pa.int32())}),
+             [_fn("try_add", _col(0), _col(1))],
+             [(None,), (6,)]),
+        Case("try_multiply on decimals reports the widened type",
+             pa.table({"a": pa.array([D("2.50")], pa.decimal128(10, 2)),
+                       "b": pa.array([D("4.00")],
+                                     pa.decimal128(10, 2))}),
+             [_fn("try_multiply", _col(0), _col(1))],
+             [(D("10.0000"),)]),
+        Case("try_add float operands widen to double",
+             pa.table({"a": pa.array([1.5], pa.float64()),
+                       "b": pa.array([2], pa.int64())}),
+             [_fn("try_add", _col(0), _col(1))],
+             [(3.5,)]),
+    ]
