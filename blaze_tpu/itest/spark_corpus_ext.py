@@ -1685,6 +1685,10 @@ def _try_arith_width():
                                      pa.decimal128(10, 2))}),
              [_fn("try_multiply", _col(0), _col(1))],
              [(D("10.0000"),)]),
+        Case("array() is an alias of make_array",
+             pa.table({"x": pa.array([1]), "y": pa.array([2])}),
+             [_fn("array", _col(0), _col(1))],
+             [([1, 2],)]),
         Case("try_add float operands widen to double",
              pa.table({"a": pa.array([1.5], pa.float64()),
                        "b": pa.array([2], pa.int64())}),
