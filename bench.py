@@ -267,6 +267,31 @@ def _tasks(fn, n, what):
     return run_tasks(fn, n, STAGE_TIMEOUT_S, what)
 
 
+def _record_tree(tree) -> None:
+    """Feed finalize()-time operator metric trees to the observability
+    store so the run's profile can be persisted next to the BENCH json.
+    In-process tasks only: process-pool workers record in their own
+    interpreter and those trees are not collected here."""
+    from blaze_tpu.bridge import profiling
+    profiling.record_metrics(tree.to_dict())
+
+
+def _persist_profile() -> None:
+    """Write the per-operator/XLA profile of this bench run alongside the
+    BENCH_*.json output line (BLAZE_BENCH_PROFILE_PATH overrides)."""
+    from blaze_tpu.bridge import profiling, xla_stats
+    path = os.environ.get(
+        "BLAZE_BENCH_PROFILE_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_PROFILE.json"))
+    rec = {"metric": METRIC_NAME,
+           "xla": xla_stats.compile_report(),
+           "transfers": xla_stats.transfer_stats(),
+           "metric_trees": profiling.recent_metrics()}
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, default=str)
+
+
 # ---- process-pool execution for host-placed stages ------------------------
 # Spark's executors are separate JVMs with true thread parallelism; the
 # analogous host deployment here is a pool of worker PROCESSES (each its
@@ -528,7 +553,7 @@ def run_engine(sr_paths, dd_path, tmpdir, n_maps=None, n_reduces=None):
                 for _ in rt.batches():
                     pass
             finally:
-                rt.finalize()
+                _record_tree(rt.finalize())
 
         _tasks(run_map, n_maps, "q01 map stage")
 
@@ -570,7 +595,7 @@ def run_engine(sr_paths, dd_path, tmpdir, n_maps=None, n_reduces=None):
                 s = pa.compute.sum(rb.column(2)).as_py()
                 total += s if s is not None else 0.0
         finally:
-            rt.finalize()
+            _record_tree(rt.finalize())
         return groups, total
 
     results = _tasks(run_reduce, n_reduces, "q01 reduce stage")
@@ -682,7 +707,7 @@ def run_join_engine(sr_paths, dd_path, n_maps=None):
                 cnt += pa.compute.sum(rb.column(0)).as_py() or 0
                 amt += pa.compute.sum(rb.column(1)).as_py() or 0.0
         finally:
-            rt.finalize()
+            _record_tree(rt.finalize())
         return cnt, amt
 
     results = _tasks(run_map, n_maps, "q06-shaped join stage")
@@ -804,6 +829,10 @@ def child_main():
     from blaze_tpu.bridge.placement import placement_info
     pi = placement_info()
     bytes_per_s = input_bytes / tpu_s
+    try:  # profile JSON rides alongside; never kills the bench line
+        _persist_profile()
+    except Exception:
+        pass
     print(json.dumps({
         "metric": METRIC_NAME,
         "compute_placement": (pi.device_kind if pi else "unknown"),

@@ -116,6 +116,8 @@ class DeviceColumn:
         v[:n] = True if valid is None else valid
         if _host_resident():
             return DeviceColumn(dtype, data, v)
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_h2d(data.nbytes + v.nbytes)
         return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(v))
 
     @staticmethod
@@ -372,6 +374,11 @@ class ColumnBatch:
             fetched = to_fetch  # host-resident: nothing to sync
         else:
             fetched = jax.device_get(to_fetch) if to_fetch else []
+            if to_fetch:
+                from blaze_tpu.bridge import xla_stats
+                xla_stats.note_d2h(sum(
+                    x.nbytes for x, src in zip(fetched, to_fetch)
+                    if not isinstance(src, np.ndarray)))
         pos = 0
         sel = None
         if self.selection is not None:

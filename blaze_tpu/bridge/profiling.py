@@ -6,23 +6,32 @@ flamegraphs auron/src/http/pprof.rs:71 and jemalloc heap profiles
 http/memory_profiling.rs:49).
 
 TPU-native equivalents served over a stdlib HTTP endpoint:
-  /status   — engine status: memory manager dump, device memory stats
-  /metrics  — last collected metric trees (JSON)
-  /trace    — start/stop a JAX profiler trace (XLA's own profiler is the
-              pprof analog: it captures device + host timelines viewable
-              in TensorBoard/Perfetto)
+  /status         — engine status: memory manager dump, device memory stats
+  /metrics        — last collected metric trees (JSON)
+  /metrics.prom   — Prometheus text exposition: XLA compile/cache-hit
+                    counters per kernel, transfer volume, memory-manager
+                    totals, per-operator aggregates
+  /profile        — list of recorded query profiles (id + summary)
+  /profile/<qid>  — full explain-analyze profile for one query (JSON)
+  /trace/start?dir=<path>, /trace/stop — JAX profiler trace (XLA's own
+                    profiler is the pprof analog: device + host timelines
+                    viewable in TensorBoard/Perfetto)
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 _lock = threading.Lock()
 _recent_metrics: List[dict] = []
 _MAX_METRICS = 64
+_profiles: Dict[str, dict] = {}
+_profile_order: List[str] = []
+_MAX_PROFILES = 64
 
 
 def record_metrics(tree: dict) -> None:
@@ -30,6 +39,107 @@ def record_metrics(tree: dict) -> None:
     with _lock:
         _recent_metrics.append(tree)
         del _recent_metrics[:-_MAX_METRICS]
+
+
+def recent_metrics() -> List[dict]:
+    with _lock:
+        return list(_recent_metrics)
+
+
+def record_profile(query_id: str, profile: dict) -> None:
+    """explain_analyze pushes finished query profiles here, keyed by the
+    ui-store query id; served on /profile/<qid>."""
+    with _lock:
+        if query_id not in _profiles:
+            _profile_order.append(query_id)
+        _profiles[query_id] = profile
+        while len(_profile_order) > _MAX_PROFILES:
+            _profiles.pop(_profile_order.pop(0), None)
+
+
+def get_profile(query_id: str) -> Optional[dict]:
+    with _lock:
+        return _profiles.get(query_id)
+
+
+def list_profiles() -> List[dict]:
+    with _lock:
+        return [{"query_id": q,
+                 "wall_ns": _profiles[q].get("wall_ns"),
+                 "output_rows": (_profiles[q].get("tree") or {})
+                 .get("values", {}).get("output_rows")}
+                for q in _profile_order]
+
+
+def _prom_escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition (version 0.0.4) of the engine gauges:
+    XLA compile accounting, host<->device transfer volume, memory-manager
+    spill totals, and per-operator aggregates over the recent trees."""
+    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.memory import MemManager
+    lines: List[str] = []
+
+    def emit(name, value, help_=None, labels=None, seen=set()):
+        if help_ and name not in seen:
+            seen.add(name)
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} gauge")
+        lab = ""
+        if labels:
+            lab = "{" + ",".join(
+                f'{k}="{_prom_escape(str(v))}"'
+                for k, v in sorted(labels.items())) + "}"
+        lines.append(f"{name}{lab} {int(value)}")
+
+    rep = xla_stats.compile_report()
+    for kname, e in rep["kernels"].items():
+        lab = {"kernel": kname}
+        emit("blaze_xla_compiles_total", e["compiles"],
+             "XLA compilations per kernel signature", lab)
+        emit("blaze_xla_cache_hits_total", e["cache_hits"],
+             "jit dispatches served from the compile cache", lab)
+        emit("blaze_xla_compile_ns_total", e["compile_ns"],
+             "nanoseconds spent compiling", lab)
+        emit("blaze_xla_distinct_signatures", e["distinct_signatures"],
+             "distinct arg signatures seen (churn when high)", lab)
+    t = xla_stats.transfer_stats()
+    emit("blaze_h2d_bytes_total", t["h2d_bytes"],
+         "host-to-device bytes at batch placement")
+    emit("blaze_d2h_bytes_total", t["d2h_bytes"],
+         "device-to-host bytes (Arrow export, host fetches)")
+    mm = MemManager.get()
+    emit("blaze_mem_spill_count_total", mm.total_spill_count,
+         "memory-manager spills")
+    emit("blaze_mem_spilled_bytes_total", mm.total_spilled_bytes,
+         "bytes released by spills")
+    emit("blaze_mem_peak_used_bytes", mm.peak_used,
+         "peak retained bytes across consumers")
+
+    per_op: Dict[str, Dict[str, int]] = {}
+
+    def fold(node):
+        op = node.get("name") or "unknown"
+        agg = per_op.setdefault(op, {})
+        for k, v in node.get("values", {}).items():
+            agg[k] = agg.get(k, 0) + int(v)
+        for c in node.get("children", ()):
+            fold(c)
+
+    with _lock:
+        for tree in _recent_metrics:
+            fold(tree)
+    for op, vals in sorted(per_op.items()):
+        for metric in ("output_rows", "output_batches",
+                       "elapsed_compute_ns", "spilled_bytes", "io_bytes"):
+            if metric in vals:
+                emit(f"blaze_operator_{metric}_total", vals[metric],
+                     f"per-operator {metric} over recent metric trees",
+                     {"operator": op})
+    return "\n".join(lines) + "\n"
 
 
 def engine_status() -> dict:
@@ -61,31 +171,63 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def do_GET(self):
-        if self.path == "/auron":
+        parsed = urllib.parse.urlsplit(self.path)
+        route = parsed.path
+        if route == "/auron":
             from blaze_tpu.bridge import ui
             self._send(200, json.dumps(
                 {"executions": ui.executions(),
                  "fallback_summary": ui.fallback_summary()}))
-        elif self.path == "/auron.html":
+        elif route == "/auron.html":
             from blaze_tpu.bridge import ui
             self._send(200, ui.executions_html(), ctype="text/html")
-        elif self.path == "/status":
+        elif route == "/status":
             self._send(200, json.dumps(engine_status()))
-        elif self.path == "/metrics":
+        elif route == "/metrics":
             with _lock:
                 self._send(200, json.dumps(_recent_metrics))
-        elif self.path.startswith("/trace/start"):
+        elif route == "/metrics.prom":
+            self._send(200, prometheus_text(),
+                       ctype="text/plain; version=0.0.4")
+        elif route == "/profile":
+            self._send(200, json.dumps(list_profiles()))
+        elif route.startswith("/profile/"):
+            qid = urllib.parse.unquote(route[len("/profile/"):])
+            profile = get_profile(qid)
+            if profile is None:
+                self._send(404, json.dumps(
+                    {"error": f"no profile for {qid!r}",
+                     "known": [p["query_id"] for p in list_profiles()]}))
+            else:
+                self._send(200, json.dumps(profile))
+        elif route == "/trace/start":
             import jax
-            out = "/tmp/blaze-tpu-trace"
-            if "?" in self.path:
-                out = self.path.split("?", 1)[1] or out
+            # the trace dir arrives as ?dir=<path> (query STRING, not the
+            # raw text after '?' — that produced directories literally
+            # named "dir=/tmp/x")
+            # keep_blank_values so a stray "?/tmp/x" (no '=') surfaces as
+            # an unknown key instead of silently starting a default trace
+            params = urllib.parse.parse_qs(parsed.query,
+                                           keep_blank_values=True)
+            out = params.get("dir", ["/tmp/blaze-tpu-trace"])[0]
+            bad_keys = set(params) - {"dir"}
+            if bad_keys:
+                self._send(400, json.dumps(
+                    {"error": f"unknown query params {sorted(bad_keys)}; "
+                              f"expected ?dir=<path>"}))
+                return
+            if not out or "\x00" in out or not out.startswith("/"):
+                self._send(400, json.dumps(
+                    {"error": "trace dir must be an absolute path",
+                     "dir": out}))
+                return
             try:
                 jax.profiler.start_trace(out)
                 _Handler._tracing = True
                 self._send(200, json.dumps({"tracing": True, "dir": out}))
             except Exception as e:
                 self._send(500, json.dumps({"error": str(e)}))
-        elif self.path == "/trace/stop":
+        elif route == "/trace/stop":
             import jax
             try:
                 jax.profiler.stop_trace()
@@ -96,6 +238,9 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, json.dumps({"error": "unknown path",
                                         "paths": ["/status", "/metrics",
+                                                  "/metrics.prom",
+                                                  "/profile",
+                                                  "/profile/<qid>",
                                                   "/auron", "/auron.html",
                                                   "/trace/start",
                                                   "/trace/stop"]}))

@@ -79,7 +79,11 @@ class NativeExecutionRuntime:
         # arrow_batches: plans whose output is already Arrow-resident
         # (fused host agg, scans) skip the ColumnBatch round trip; the
         # base implementation is exactly the old compact().to_arrow()
-        with task_scope(self.task):
+        from blaze_tpu.bridge import tracing
+        with task_scope(self.task), \
+                tracing.execution_context(stage=self.task.stage_id,
+                                          partition=self.task.partition_id), \
+                tracing.span("task", mode="sync"):
             stream = self.plan.arrow_batches(self.task.partition_id)
             stats = config.INPUT_BATCH_STATISTICS.get()
             for rb in stream:
@@ -95,8 +99,13 @@ class NativeExecutionRuntime:
                 yield rb
 
     def _produce(self) -> None:
+        from blaze_tpu.bridge import tracing
         try:
-            with task_scope(self.task):
+            with task_scope(self.task), \
+                    tracing.execution_context(
+                        stage=self.task.stage_id,
+                        partition=self.task.partition_id), \
+                    tracing.span("task", mode="producer"):
                 stream = self.plan.arrow_batches(self.task.partition_id)
                 stats = config.INPUT_BATCH_STATISTICS.get()
                 for rb in stream:  # HOT LOOP (ref rt.rs:175-192)

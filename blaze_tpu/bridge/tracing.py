@@ -1,0 +1,151 @@
+"""Lightweight span tracer for query execution.
+
+The reference exposes pprof flamegraphs over its HTTP service; the
+TPU-port equivalent is a structured span log: every task, shuffle
+exchange, operator stream, and fused-kernel dispatch can emit a span
+carrying the (query, stage, partition) execution context.  Spans are
+buffered in memory and optionally streamed to a JSONL file (one JSON
+object per line: name, t0/t1 ns, thread, context, attrs) that loads
+directly into Perfetto-style tooling or pandas.
+
+Disabled tracing is a near-free boolean check — operators call
+`span(...)` unconditionally.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+_enabled = False
+_lock = threading.Lock()
+_spans: List[dict] = []
+_MAX_SPANS = 100_000
+_sink = None  # open JSONL file, when exporting
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _ctx_stack() -> List[Dict[str, Any]]:
+    stack = getattr(_tls, "ctx", None)
+    if stack is None:
+        stack = _tls.ctx = []
+    return stack
+
+
+def current_context() -> Dict[str, Any]:
+    """Innermost query/stage/partition context on this thread."""
+    out: Dict[str, Any] = {}
+    for frame in _ctx_stack():
+        out.update(frame)
+    return out
+
+
+@contextmanager
+def execution_context(**fields):
+    """Push query_id/stage/partition (any subset) for spans emitted on
+    this thread; nests — inner frames override outer keys."""
+    stack = _ctx_stack()
+    stack.append({k: v for k, v in fields.items() if v is not None})
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+@contextmanager
+def span(name: str, **attrs):
+    """Emit one span covering the `with` body.  No-op when disabled."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter_ns()
+        record = {"name": name, "t0_ns": t0, "t1_ns": t1,
+                  "dur_ns": t1 - t0,
+                  "thread": threading.current_thread().name}
+        ctx = current_context()
+        if ctx:
+            record["ctx"] = ctx
+        if attrs:
+            record["attrs"] = attrs
+        _emit(record)
+
+
+def emit_span(name: str, dur_ns: int, **attrs) -> None:
+    """Record a span whose duration was measured externally (the operator
+    stream meter accumulates time across many next() calls)."""
+    if not _enabled:
+        return
+    t1 = time.perf_counter_ns()
+    record = {"name": name, "t0_ns": t1 - int(dur_ns), "t1_ns": t1,
+              "dur_ns": int(dur_ns),
+              "thread": threading.current_thread().name}
+    ctx = current_context()
+    if ctx:
+        record["ctx"] = ctx
+    if attrs:
+        record["attrs"] = attrs
+    _emit(record)
+
+
+def instant(name: str, **attrs) -> None:
+    """Zero-duration event (e.g. an XLA compile)."""
+    if not _enabled:
+        return
+    t = time.perf_counter_ns()
+    record = {"name": name, "t0_ns": t, "t1_ns": t, "dur_ns": 0,
+              "thread": threading.current_thread().name}
+    ctx = current_context()
+    if ctx:
+        record["ctx"] = ctx
+    if attrs:
+        record["attrs"] = attrs
+    _emit(record)
+
+
+def _emit(record: dict) -> None:
+    with _lock:
+        _spans.append(record)
+        del _spans[:-_MAX_SPANS]
+        if _sink is not None:
+            _sink.write(json.dumps(record, default=str) + "\n")
+            _sink.flush()
+
+
+def start_tracing(path: Optional[str] = None) -> None:
+    """Enable span collection; `path` additionally streams JSONL there."""
+    global _enabled, _sink
+    with _lock:
+        _spans.clear()
+        if _sink is not None:
+            _sink.close()
+            _sink = None
+        if path:
+            _sink = open(path, "w")
+    _enabled = True
+
+
+def stop_tracing() -> List[dict]:
+    """Disable collection; returns (and keeps) the buffered spans."""
+    global _enabled, _sink
+    _enabled = False
+    with _lock:
+        if _sink is not None:
+            _sink.close()
+            _sink = None
+        return list(_spans)
+
+
+def spans() -> List[dict]:
+    with _lock:
+        return list(_spans)

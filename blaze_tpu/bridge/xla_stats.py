@@ -1,0 +1,176 @@
+"""XLA compile + host<->device transfer accounting.
+
+The two TPU-specific hazards the profiler must surface (ROADMAP north
+star; Flare and the Arrow-interface papers identify the analogous
+native/JVM and host/device boundary costs):
+
+* recompilation — every new (shape, dtype, static-arg) signature at a
+  jit boundary triggers a fresh XLA compile; over a tunneled TPU these
+  dominate cold starts.  `meter_jit` wraps `jax.jit` call sites so each
+  dispatch is classified compile vs cache-hit, compile time accumulates
+  per kernel, and shape churn (many distinct signatures on one kernel)
+  is flagged.
+* transfer volume — H2D on batch placement, D2H on Arrow export /
+  host fetches.  `note_h2d`/`note_d2h` are called from the batch layer.
+
+Compile detection is portable across jax versions: the traced Python
+function only RUNS when XLA is actually tracing (i.e. compiling) the
+call; a cache hit never re-enters it.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.Lock()
+
+# kernel name -> stats dict
+_kernels: Dict[str, Dict[str, Any]] = {}
+_transfers = {"h2d_bytes": 0, "h2d_transfers": 0,
+              "d2h_bytes": 0, "d2h_transfers": 0}
+
+# Distinct signatures beyond this on one kernel = shape churn (the
+# recompilation-storm smell: unpadded dynamic shapes hitting jit).
+SHAPE_CHURN_THRESHOLD = 8
+
+
+def _kernel_entry(name: str) -> Dict[str, Any]:
+    entry = _kernels.get(name)
+    if entry is None:
+        entry = _kernels[name] = {
+            "calls": 0, "compiles": 0, "cache_hits": 0,
+            "compile_ns": 0, "dispatch_ns": 0, "signatures": set(),
+        }
+    return entry
+
+
+def _signature(args, kwargs) -> tuple:
+    def one(a):
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None:
+            return ("arr", tuple(shape), str(dtype))
+        if isinstance(a, (int, float, bool, str, bytes, type(None))):
+            return ("lit", a)
+        if isinstance(a, (tuple, list)):
+            return ("seq", tuple(one(x) for x in a))
+        return ("obj", type(a).__name__)
+    return (tuple(one(a) for a in args),
+            tuple(sorted((k, one(v)) for k, v in kwargs.items())))
+
+
+def meter_jit(fun: Callable, *, name: Optional[str] = None,
+              **jit_kwargs) -> Callable:
+    """`jax.jit` with compile/cache-hit accounting.
+
+    Drop-in for `jax.jit(fun, **kwargs)` — supports static_argnums /
+    static_argnames / donate_argnums.  Each call is timed; a call during
+    which the traced body executed is a compile, otherwise a cache hit.
+    """
+    import jax
+
+    kname = name or getattr(fun, "__name__", "jit_fn")
+    traced = threading.local()
+
+    @functools.wraps(fun)
+    def _noting(*args, **kwargs):
+        traced.hit = True
+        return fun(*args, **kwargs)
+
+    jitted = jax.jit(_noting, **jit_kwargs)
+
+    @functools.wraps(fun)
+    def wrapper(*args, **kwargs):
+        traced.hit = False
+        t0 = time.perf_counter_ns()
+        out = jitted(*args, **kwargs)
+        dt = time.perf_counter_ns() - t0
+        compiled = getattr(traced, "hit", False)
+        with _lock:
+            entry = _kernel_entry(kname)
+            entry["calls"] += 1
+            entry["dispatch_ns"] += dt
+            try:
+                entry["signatures"].add(_signature(args, kwargs))
+            except TypeError:
+                pass  # unhashable leaf: skip churn tracking for this call
+            if compiled:
+                entry["compiles"] += 1
+                entry["compile_ns"] += dt
+            else:
+                entry["cache_hits"] += 1
+        if compiled:
+            from blaze_tpu.bridge import tracing
+            tracing.instant("xla_compile", kernel=kname, ns=dt)
+        return out
+
+    wrapper._blaze_metered_jit = kname  # introspection / tests
+    return wrapper
+
+
+def note_h2d(nbytes: int) -> None:
+    if nbytes <= 0:
+        return
+    with _lock:
+        _transfers["h2d_bytes"] += int(nbytes)
+        _transfers["h2d_transfers"] += 1
+
+
+def note_d2h(nbytes: int) -> None:
+    if nbytes <= 0:
+        return
+    with _lock:
+        _transfers["d2h_bytes"] += int(nbytes)
+        _transfers["d2h_transfers"] += 1
+
+
+def compile_report() -> dict:
+    """Per-kernel compile stats + totals, JSON-ready."""
+    with _lock:
+        kernels = {}
+        totals = {"calls": 0, "compiles": 0, "cache_hits": 0,
+                  "compile_ns": 0}
+        for kname, e in sorted(_kernels.items()):
+            sigs = len(e["signatures"])
+            kernels[kname] = {
+                "calls": e["calls"], "compiles": e["compiles"],
+                "cache_hits": e["cache_hits"],
+                "compile_ns": e["compile_ns"],
+                "dispatch_ns": e["dispatch_ns"],
+                "distinct_signatures": sigs,
+                "shape_churn": sigs > SHAPE_CHURN_THRESHOLD,
+            }
+            for k in totals:
+                totals[k] += e[k]
+        return {"kernels": kernels, "totals": totals}
+
+
+def transfer_stats() -> dict:
+    with _lock:
+        return dict(_transfers)
+
+
+def snapshot() -> dict:
+    """Flat counter snapshot for before/after deltas (explain_analyze)."""
+    rep = compile_report()
+    flat = {"h2d_bytes": 0, "d2h_bytes": 0,
+            "h2d_transfers": 0, "d2h_transfers": 0}
+    flat.update(transfer_stats())
+    flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
+    return flat
+
+
+def delta(before: dict) -> dict:
+    now = snapshot()
+    return {k: now.get(k, 0) - before.get(k, 0) for k in now}
+
+
+def reset() -> None:
+    """Test helper: clear all counters."""
+    with _lock:
+        _kernels.clear()
+        for k in _transfers:
+            _transfers[k] = 0

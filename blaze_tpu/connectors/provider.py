@@ -111,7 +111,7 @@ class ProviderScanExec(ExecutionPlan):
                 cb = ColumnBatch.from_arrow(rb)
                 cb = self._delete.apply(cb, split, row_offset)
                 row_offset += rb.num_rows
-                self.metrics.add("output_rows", cb.selected_count())
+                self.metrics.add("io_bytes", rb.nbytes)
                 yield cb
 
     def _with_partition_values(self, rb: pa.RecordBatch,

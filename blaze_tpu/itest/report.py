@@ -46,9 +46,8 @@ def run_report(scale: float, partitions: int, names=None,
     from blaze_tpu.itest.runner import compare_frames
     from blaze_tpu.itest.tpcds_data import write_parquet_splits
     from blaze_tpu.memory import MemManager
-    from blaze_tpu.plan import create_plan
+    from blaze_tpu.plan import create_plan, explain_analyze
     from blaze_tpu.plan.fused import fuse_plan
-    from blaze_tpu.plan.stages import DagScheduler
 
     MemManager.init(budget_bytes)
     rows = []
@@ -60,19 +59,18 @@ def run_report(scale: float, partitions: int, names=None,
             paths = write_parquet_splits(tables, tmp, partitions)
             plan_dict, oracle = builder(paths, tables, partitions)
             t0 = time.perf_counter()
-            exec_mode = "in-process"
             if wire:
                 # work_dir defaults to the RAM disk (stages.py); the
                 # per-query tmp dir here is disk-backed
-                sched = DagScheduler()
-                got_tbl = sched.run_collect(plan_dict)
-                exec_mode = sched.exec_mode or "staged"
+                prof = explain_analyze(plan_dict, keep_result=True,
+                                       query_id=f"itest-{qname}")
+                exec_mode = prof.exec_mode
             else:
                 plan = fuse_plan(create_plan(plan_dict))
-                got_tbl = plan.execute_collect().to_arrow()
-                import pyarrow as pa
-                if isinstance(got_tbl, pa.RecordBatch):
-                    got_tbl = pa.Table.from_batches([got_tbl])
+                prof = explain_analyze(plan, keep_result=True,
+                                       query_id=f"itest-{qname}")
+                exec_mode = "in-process"
+            got_tbl = prof.result
             engine_s = time.perf_counter() - t0
             # the baseline reads the SAME parquet splits the engine
             # scans — the reference's comparison has both sides go
@@ -99,7 +97,10 @@ def run_report(scale: float, partitions: int, names=None,
                 "budget_bytes": mm.total,
                 "spill_count": mm.total_spill_count,
                 "spilled_bytes": mm.total_spilled_bytes,
-                "peak_mem_bytes": mm.peak_used})
+                "peak_mem_bytes": mm.peak_used,
+                # per-operator profile (explain_analyze), also served on
+                # /profile/itest-<query> by the HTTP service
+                "profile": prof.to_dict()})
             # per-query deltas, not cumulative across the report
             mm.total_spill_count = 0
             mm.total_spilled_bytes = 0

@@ -39,7 +39,10 @@ def build_runs(sorted_hashes: np.ndarray
     return uh, start.astype(np.int64), count.astype(np.int64)
 
 
-@jax.jit
+from blaze_tpu.bridge.xla_stats import meter_jit
+
+
+@functools.partial(meter_jit, name="join.probe_counts")
 def probe_counts(unique_hashes: jax.Array, run_start: jax.Array,
                  run_count: jax.Array, probe_hashes: jax.Array,
                  probe_null: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -56,7 +59,8 @@ def probe_counts(unique_hashes: jax.Array, run_start: jax.Array,
     return start, count
 
 
-@functools.partial(jax.jit, static_argnames=("cap",))
+@functools.partial(meter_jit, name="join.expand_pairs",
+                   static_argnames=("cap",))
 def expand_pairs(start: jax.Array, count: jax.Array, cap: int
                  ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Bounded two-pass expansion of (start, count) runs into pair arrays.

@@ -41,6 +41,11 @@ class MemConsumer:
         self._mem_used = 0
         self._manager: Optional[MemManager] = None
         self.spill_metrics = SpillMetrics()
+        # owning operator's MetricNode; when set, retained-byte peaks are
+        # recorded there as `mem_used` (baseline metric vocabulary).  A
+        # class may be both ExecutionPlan and MemConsumer — keep the
+        # operator MetricNode if one is already attached.
+        self.metrics = getattr(self, "metrics", None)
 
     @property
     def mem_used(self) -> int:
@@ -53,6 +58,8 @@ class MemConsumer:
     def update_mem_used(self, nbytes: int) -> None:
         """Declare current retained bytes; may trigger spills (incl. self)."""
         self._mem_used = max(0, int(nbytes))
+        if self.metrics is not None:
+            self.metrics.set_max("mem_used", self._mem_used)
         if self._manager is not None:
             self._manager.on_mem_updated(self)
 

@@ -148,6 +148,7 @@ class _AggState(MemConsumer):
     def __init__(self, op: AggExec):
         super().__init__("agg")
         self.op = op
+        self.metrics = op.metrics
         self.in_schema = op.children[0].schema
         self.num_keys = len(op._group_exprs)
         # dictionary per string key column: an accumulated pyarrow array
@@ -621,7 +622,6 @@ class _AggState(MemConsumer):
             arrays = [_cast_output(a, f.type) for a, f in
                       zip(arrays, out_schema)]
             out = pa.RecordBatch.from_arrays(arrays, schema=out_schema)
-            self.op.metrics.add("output_rows", out.num_rows)
             self.groups_emitted += out.num_rows
             yield ColumnBatch.from_arrow(out)
 

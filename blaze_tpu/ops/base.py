@@ -14,23 +14,148 @@ enqueued ahead while the host iterates.
 
 from __future__ import annotations
 
+import functools
+import threading
+import time
 from typing import Iterator, List, Optional, Sequence
 
 from blaze_tpu import config
 from blaze_tpu.batch import ColumnBatch, round_capacity
 from blaze_tpu.bridge.context import current_task
-from blaze_tpu.bridge.metrics import MetricNode
+from blaze_tpu.bridge.metrics import BASELINE_METRICS, MetricNode
 from blaze_tpu.schema import Schema
 
 BatchIterator = Iterator[ColumnBatch]
 
+# Per-thread set of operator-instance ids currently inside a metered
+# stream.  Several operators route execute() through their own
+# arrow_batches() (or vice versa); the guard makes the inner self-call
+# pass through unmetered so rows/time are not double-counted.
+_metering = threading.local()
+
+
+def _active_ids() -> set:
+    ids = getattr(_metering, "ids", None)
+    if ids is None:
+        ids = _metering.ids = set()
+    return ids
+
+
+def _batch_rows(item) -> int:
+    sc = getattr(item, "selected_count", None)  # ColumnBatch
+    if sc is not None:
+        return sc()
+    return getattr(item, "num_rows", 0)  # pyarrow RecordBatch
+
+
+class _MeteredIter:
+    """Wraps an operator's batch stream: per-next() wall time goes to
+    `elapsed_compute_ns` (INCLUSIVE of child pull; renderers derive
+    self-time), rows/batches counted per yield.  Metrics accumulate
+    incrementally so a downstream early break (LimitExec) still records
+    the partial work."""
+
+    __slots__ = ("_it", "_plan", "_key", "_partition", "_kind",
+                 "_total_ns", "_done")
+
+    def __init__(self, it, plan, key, partition, kind, setup_ns):
+        self._it = iter(it)
+        self._plan = plan
+        self._key = key
+        self._partition = partition
+        self._kind = kind
+        self._total_ns = setup_ns
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        active = _active_ids()
+        reenter = self._key in active
+        if not reenter:
+            active.add(self._key)
+        t0 = time.perf_counter_ns()
+        try:
+            item = next(self._it)
+        except StopIteration:
+            self._finish()
+            raise
+        finally:
+            dt = time.perf_counter_ns() - t0
+            self._plan.metrics.add("elapsed_compute_ns", dt)
+            self._total_ns += dt
+            if not reenter:
+                active.discard(self._key)
+        m = self._plan.metrics
+        m.add("output_batches")
+        m.add("output_rows", _batch_rows(item))
+        return item
+
+    def _finish(self):
+        if self._done:
+            return
+        self._done = True
+        from blaze_tpu.bridge import tracing
+        if tracing.enabled():
+            tracing.emit_span(
+                f"operator:{type(self._plan).__name__}",
+                self._total_ns, partition=self._partition,
+                kind=self._kind,
+                rows=self._plan.metrics.get("output_rows"))
+
+
+def _meter_stream(fn, kind: str):
+    """Wrap a subclass execute/arrow_batches with the standard meter."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        active = _active_ids()
+        key = id(self)
+        if key in active:  # inner self-call (execute <-> arrow_batches)
+            return fn(self, *args, **kwargs)
+        partition = args[0] if args else kwargs.get("partition", 0)
+        active.add(key)
+        t0 = time.perf_counter_ns()
+        try:
+            # eager call under the meter: operators like IpcWriterExec do
+            # all their work here and return an empty iterator
+            it = fn(self, *args, **kwargs)
+        finally:
+            setup_ns = time.perf_counter_ns() - t0
+            active.discard(key)
+        self.metrics.add("elapsed_compute_ns", setup_ns)
+        return _MeteredIter(it, self, key, partition, kind, setup_ns)
+
+    wrapper._blaze_metered = True
+    wrapper._blaze_wraps = fn
+    return wrapper
+
 
 class ExecutionPlan:
-    """One physical operator node."""
+    """One physical operator node.
+
+    Every subclass's `execute`/`arrow_batches` override is wrapped at
+    class-creation time with the standard meter, so all operators emit
+    the BASELINE_METRICS vocabulary (output_rows, output_batches,
+    elapsed_compute_ns, spilled_bytes, mem_used, io_bytes) without
+    per-operator bookkeeping; operator code only adds extras
+    (pruned_row_groups, spill_count, ...).
+    """
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        for attr in ("execute", "arrow_batches"):
+            fn = cls.__dict__.get(attr)
+            if fn is not None and callable(fn) and \
+                    not getattr(fn, "_blaze_metered", False):
+                setattr(cls, attr, _meter_stream(fn, attr))
 
     def __init__(self, children: Sequence["ExecutionPlan"] = ()):
         self._children: List[ExecutionPlan] = list(children)
         self.metrics = MetricNode(name=type(self).__name__)
+        for m in BASELINE_METRICS:
+            self.metrics.values.setdefault(m, 0)
 
     # -- topology -----------------------------------------------------------
     @property

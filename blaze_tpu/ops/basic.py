@@ -33,10 +33,7 @@ class FilterExec(ExecutionPlan):
         ev = CachedExprsEvaluator(filters=self._predicates)
         def gen():
             for batch in self.children[0].execute(partition):
-                with self.metrics.timer("elapsed_compute"):
-                    out = ev.filter(batch)
-                self.metrics.add("output_batches")
-                yield out
+                yield ev.filter(batch)
         return iter(CoalesceStream(gen(), metrics=self.metrics))
 
 
@@ -61,10 +58,7 @@ class ProjectExec(ExecutionPlan):
         ev = CachedExprsEvaluator(projections=self._exprs)
         out_schema = self.schema
         for batch in self.children[0].execute(partition):
-            with self.metrics.timer("elapsed_compute"):
-                out = ev.project(batch, out_schema)
-            self.metrics.add("output_batches")
-            yield out
+            yield ev.project(batch, out_schema)
 
 
 class FilterProjectExec(ExecutionPlan):
@@ -94,9 +88,7 @@ class FilterProjectExec(ExecutionPlan):
         out_schema = self.schema
         def gen():
             for batch in self.children[0].execute(partition):
-                with self.metrics.timer("elapsed_compute"):
-                    out = ev.filter_project(batch, out_schema)
-                yield out
+                yield ev.filter_project(batch, out_schema)
         return iter(CoalesceStream(gen(), metrics=self.metrics))
 
 
@@ -246,5 +238,4 @@ class DebugExec(ExecutionPlan):
         for i, batch in enumerate(self.children[0].execute(partition)):
             log.info("[%s] partition=%d batch=%d rows=%d", self._tag,
                      partition, i, batch.selected_count())
-            self.metrics.add("output_rows", batch.selected_count())
             yield batch

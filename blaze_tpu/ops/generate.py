@@ -213,6 +213,5 @@ class GenerateExec(ExecutionPlan):
                           if not a.type.equals(f.type) else a
                           for a, f in zip(arrays, out_schema)]
                 out = pa.RecordBatch.from_arrays(arrays, schema=out_schema)
-                self.metrics.add("output_rows", out.num_rows)
                 yield ColumnBatch.from_arrow(out)
         return iter(CoalesceStream(gen(), metrics=self.metrics))

@@ -177,7 +177,6 @@ class BroadcastNestedLoopJoinExec(ExecutionPlan):
                   if not col.type.equals(f.type) else col
                   for col, f in zip(rb.columns, out_arrow)]
         out = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
-        self.metrics.add("output_rows", out.num_rows)
         return ColumnBatch.from_arrow(out)
 
     def _join_batch(self, probe_rb, build_tbl, build_matched,

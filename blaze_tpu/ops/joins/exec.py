@@ -73,7 +73,8 @@ def _hash_valid_jit(tids: Tuple[str, ...]):
             nv = ~val
             anyn = nv if anyn is None else (anyn | nv)
         return h, anyn
-    return jax.jit(f)
+    from blaze_tpu.bridge.xla_stats import meter_jit
+    return meter_jit(f, name="join.hash_valid")
 
 
 def _device_hash_keys(batch: ColumnBatch, key_exprs: Sequence[PhysicalExpr]
@@ -817,7 +818,6 @@ class BaseJoinExec(ExecutionPlan):
                 col = col.cast(f.type, safe=False)
             arrays.append(col)
         rb = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
-        self.metrics.add("output_rows", rb.num_rows)
         bs = config.BATCH_SIZE.get()
         for off in range(0, rb.num_rows, bs):
             yield ColumnBatch.from_arrow(
@@ -930,9 +930,7 @@ class BaseJoinExec(ExecutionPlan):
 
     def _materialize(self, probe_rb, jmap, p_idx, b_idx, probe_is_left
                      ) -> ColumnBatch:
-        out = self._joined_batch(probe_rb, jmap, p_idx, b_idx, probe_is_left)
-        self.metrics.add("output_rows", out.num_rows)
-        return out
+        return self._joined_batch(probe_rb, jmap, p_idx, b_idx, probe_is_left)
 
     def _emit_unmatched_build(self, jmap: JoinMap, probe_is_left: bool
                               ) -> Iterator[ColumnBatch]:
@@ -966,7 +964,6 @@ class BaseJoinExec(ExecutionPlan):
         arrays = (null_probe + bt_cols) if probe_is_left else \
             (bt_cols + null_probe)
         rb = pa.RecordBatch.from_arrays(arrays, schema=self.schema.to_arrow())
-        self.metrics.add("output_rows", rb.num_rows)
         yield ColumnBatch.from_arrow(rb)
 
 
@@ -1131,7 +1128,6 @@ class SortMergeJoinExec(BaseJoinExec):
 
         def gen():
             for rb in joiner.join(lcur, rcur):
-                self.metrics.add("output_rows", rb.num_rows)
                 yield ColumnBatch.from_arrow(rb)
         return iter(CoalesceStream(gen(), metrics=self.metrics))
 

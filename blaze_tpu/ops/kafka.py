@@ -138,7 +138,7 @@ class MockKafkaScanExec(ExecutionPlan):
         for off in range(0, len(recs), bs):
             chunk = recs[off:off + bs]
             rb = self._deser.deserialize([r.value for r in chunk])
-            self.metrics.add("output_rows", rb.num_rows)
+            self.metrics.add("io_bytes", rb.nbytes)
             yield ColumnBatch.from_arrow(rb)
 
 
@@ -177,5 +177,5 @@ class KafkaScanExec(ExecutionPlan):
             if not recs:
                 continue
             rb = self._deser.deserialize([r.value for r in recs])
-            self.metrics.add("output_rows", rb.num_rows)
+            self.metrics.add("io_bytes", rb.nbytes)
             yield ColumnBatch.from_arrow(rb)

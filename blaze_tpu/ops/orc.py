@@ -95,16 +95,14 @@ class OrcScanExec(ExecutionPlan):
                 tbl = self._read_stripe(f, stripe)
                 if tbl is None or tbl.num_rows == 0:
                     continue
-                self.metrics.add("bytes_scanned", tbl.nbytes)
+                self.metrics.add("io_bytes", tbl.nbytes)
                 for rb in tbl.to_batches(max_chunksize=self._batch_rows):
                     if self._partition_schema is not None:
                         rb = assemble_partition_constants(
                             rb, self._schema, self._partition_schema,
                             self._partition_values, partition, fidx)
                     rb = _align_schema(rb, self._schema)
-                    cb = ColumnBatch.from_arrow(rb)
-                    self.metrics.add("output_rows", cb.num_rows)
-                    yield cb
+                    yield ColumnBatch.from_arrow(rb)
             del f  # drop the reader (and any FS-bridge handle) eagerly
 
     # ------------------------------------------------------------------

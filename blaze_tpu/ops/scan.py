@@ -94,7 +94,6 @@ class MemoryScanExec(ExecutionPlan):
 
     def execute(self, partition: int) -> BatchIterator:
         for b in self._partitions[partition]:
-            self.metrics.add("output_rows", b.selected_count())
             yield b
 
 
@@ -204,7 +203,7 @@ class ParquetScanExec(ExecutionPlan):
                     if rb.num_rows == 0:
                         continue
                     rb = _align_schema(rb, self._file_part)
-                    self.metrics.add("output_rows", rb.num_rows)
+                    self.metrics.add("io_bytes", rb.nbytes)
                     yield rb
                 return
         for fidx, path in enumerate(self._file_groups[partition]):
@@ -232,7 +231,7 @@ class ParquetScanExec(ExecutionPlan):
                 if rb.num_rows == 0:
                     continue
                 rb = _align_schema(rb, self._file_part)
-                self.metrics.add("output_rows", rb.num_rows)
+                self.metrics.add("io_bytes", rb.nbytes)
                 yield self._assemble_output(rb, partition, fidx)
 
     def _assemble_output(self, rb: pa.RecordBatch, partition: int,

@@ -53,6 +53,7 @@ class ParquetSinkExec(ExecutionPlan):
             out = os.path.join(self.path, f"part-{partition:05d}.parquet")
             pq.write_table(table, out, compression=self.compression)
         self.metrics.add("output_rows", rows)
+        self.metrics.add("io_bytes", table.nbytes)
         return iter(())
 
 
@@ -79,4 +80,5 @@ class OrcSinkExec(ExecutionPlan):
         out = os.path.join(self.path, f"part-{partition:05d}.orc")
         orc.write_table(table, out)
         self.metrics.add("output_rows", table.num_rows)
+        self.metrics.add("io_bytes", table.nbytes)
         return iter(())

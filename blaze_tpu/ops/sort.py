@@ -165,7 +165,6 @@ class SortExec(ExecutionPlan, MemConsumer):
                     if out_rows + rb.num_rows > self._fetch:
                         rb = rb.slice(0, self._fetch - out_rows)
                 out_rows += rb.num_rows
-                self.metrics.add("output_rows", rb.num_rows)
                 yield ColumnBatch.from_arrow(rb)
         finally:
             state.unregister()
@@ -182,6 +181,7 @@ class _SortState(MemConsumer):
     def __init__(self, op: SortExec, schema: Schema, specs: Sequence[SortSpec]):
         super().__init__("sort")
         self._op = op
+        self.metrics = op.metrics
         self._schema = schema
         self._specs = specs
         self._staged: List[pa.RecordBatch] = []

@@ -96,6 +96,7 @@ class _WindowBuffer(MemConsumer):
     def __init__(self, op: "WindowExec"):
         super().__init__("WindowExec.buffer")
         self._op = op
+        self.metrics = op.metrics
         self._mem: List[pa.RecordBatch] = []
         self._mem_bytes = 0
         self._spills: list = []
@@ -271,7 +272,6 @@ class WindowExec(ExecutionPlan):
             # window-group-limit: keep rows with rank <= k (proto :600)
             keep = np.asarray(rank_val) <= self.group_limit
             out = out.filter(pa.array(keep))
-        self.metrics.add("output_rows", out.num_rows)
         return [ColumnBatch.from_arrow(out)]
 
     def _part_keys(self, rb: pa.RecordBatch,

@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from blaze_tpu.bridge.xla_stats import meter_jit
+
 DP_AXIS = "dp"
 
 
@@ -79,7 +81,7 @@ def distributed_grouped_agg(mesh: Mesh, key_specs, agg_specs,
         in_specs=P(DP_AXIS),
         out_specs=P(DP_AXIS),
         check_vma=False)
-    return jax.jit(sharded)
+    return meter_jit(sharded, name="mesh.grouped_agg")
 
 
 def distributed_sort(mesh: Mesh, num_payloads: int, capacity: int,
@@ -171,7 +173,7 @@ def distributed_sort(mesh: Mesh, num_payloads: int, capacity: int,
         in_specs=P(DP_AXIS),
         out_specs=P(DP_AXIS),
         check_vma=False)
-    return jax.jit(sharded)
+    return meter_jit(sharded, name="mesh.sort")
 
 
 def distributed_hash_join(mesh: Mesh, num_build_payloads: int,
@@ -262,7 +264,7 @@ def distributed_hash_join(mesh: Mesh, num_build_payloads: int,
         in_specs=P(DP_AXIS),
         out_specs=P(DP_AXIS),
         check_vma=False)
-    return jax.jit(sharded)
+    return meter_jit(sharded, name="mesh.hash_join")
 
 
 def distributed_broadcast_join_agg(mesh: Mesh, build_capacity: int):
@@ -302,4 +304,4 @@ def distributed_broadcast_join_agg(mesh: Mesh, build_capacity: int):
         in_specs=(P(), P(DP_AXIS), P(DP_AXIS), P(DP_AXIS)),
         out_specs=(P(), P()),
         check_vma=False)
-    return jax.jit(sharded)
+    return meter_jit(sharded, name="mesh.broadcast_join_agg")

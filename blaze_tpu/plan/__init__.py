@@ -5,6 +5,7 @@ PhysicalPlanNode/PhysicalExprNode oneofs — see planner.py and exprs.py for
 the kind lists and proto line citations.
 """
 
+from blaze_tpu.plan.explain import QueryProfile, explain_analyze
 from blaze_tpu.plan.exprs import expr_from_dict, sort_spec_from_dict
 from blaze_tpu.plan.planner import (CoalesceBatchesExec, create_plan,
                                     decode_task_definition,
@@ -15,6 +16,7 @@ from blaze_tpu.plan.types import (field_from_dict, field_to_dict,
                                   type_from_dict, type_to_dict)
 
 __all__ = [
+    "QueryProfile", "explain_analyze",
     "expr_from_dict", "sort_spec_from_dict",
     "CoalesceBatchesExec", "create_plan", "decode_task_definition",
     "partitioning_from_dict", "plan_from_json", "plan_to_json",

@@ -1,0 +1,253 @@
+"""EXPLAIN ANALYZE: execute a plan and render the annotated operator tree.
+
+Parity role: Spark's `EXPLAIN ANALYZE` / the SQL-tab per-node SQLMetrics
+view over the reference engine.  `explain_analyze` runs the query through
+the production task path, merges the per-partition metric trees into one
+query-level profile (MetricNode.merge_from), snapshots XLA compile and
+host<->device transfer counters around the run, and renders the result as
+an annotated plan text or a JSON-ready dict.
+
+The profile is registered with the observability service
+(bridge/profiling.record_profile), so the same data is retrievable over
+HTTP at /profile/<qid> and folded into /metrics.prom.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+from blaze_tpu.bridge.metrics import BASELINE_METRICS, MetricNode
+
+
+def _fmt_ns(ns: int) -> str:
+    if ns >= 1_000_000_000:
+        return f"{ns / 1e9:.2f}s"
+    if ns >= 1_000_000:
+        return f"{ns / 1e6:.2f}ms"
+    if ns >= 1_000:
+        return f"{ns / 1e3:.1f}us"
+    return f"{ns}ns"
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if n >= div:
+            return f"{n / div:.1f}{unit}"
+    return f"{n}B"
+
+
+def _node_line(node: MetricNode) -> str:
+    v = node.values
+    total = v.get("elapsed_compute_ns", 0)
+    self_ns = max(0, total - sum(c.values.get("elapsed_compute_ns", 0)
+                                 for c in node.children))
+    parts = [f"rows={v.get('output_rows', 0)}",
+             f"batches={v.get('output_batches', 0)}",
+             f"time={_fmt_ns(total)}"]
+    if node.children:
+        parts.append(f"(self {_fmt_ns(self_ns)})")
+    if v.get("mem_used", 0):
+        parts.append(f"mem={_fmt_bytes(v['mem_used'])}")
+    if v.get("spilled_bytes", 0):
+        parts.append(f"spilled={_fmt_bytes(v['spilled_bytes'])}")
+    if v.get("io_bytes", 0):
+        parts.append(f"io={_fmt_bytes(v['io_bytes'])}")
+    for k in sorted(v):
+        if k not in BASELINE_METRICS and v[k]:
+            parts.append(f"{k}={v[k]}")
+    return f"{node.name or '?'}  [{' '.join(parts)}]"
+
+
+def render_tree(node: MetricNode, indent: str = "", last: bool = True,
+                root: bool = True) -> List[str]:
+    if root:
+        lines = [_node_line(node)]
+        child_indent = ""
+    else:
+        branch = "└─ " if last else "├─ "
+        lines = [indent + branch + _node_line(node)]
+        child_indent = indent + ("   " if last else "│  ")
+    for i, c in enumerate(node.children):
+        lines.extend(render_tree(c, child_indent,
+                                 last=(i == len(node.children) - 1),
+                                 root=False))
+    return lines
+
+
+@dataclass
+class QueryProfile:
+    """One executed query's merged profile (the /profile/<qid> payload)."""
+    query_id: str
+    wall_ns: int
+    tree: MetricNode
+    partitions: int
+    exec_mode: str
+    xla: Dict[str, int] = field(default_factory=dict)
+    kernels: Dict[str, dict] = field(default_factory=dict)
+    placement: str = ""
+    output_rows: int = 0
+    # result table, only populated under keep_result=True; NOT serialized
+    result: Optional[Any] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "query_id": self.query_id,
+            "wall_ns": self.wall_ns,
+            "tree": self.tree.to_dict(),
+            "partitions": self.partitions,
+            "exec_mode": self.exec_mode,
+            "xla": dict(self.xla),
+            "kernels": {k: dict(v) for k, v in self.kernels.items()},
+            "placement": self.placement,
+            "output_rows": self.output_rows,
+        }
+
+    def render_text(self) -> str:
+        lines = [f"== query profile {self.query_id} "
+                 f"(wall {_fmt_ns(self.wall_ns)}, "
+                 f"{self.partitions} partition(s), "
+                 f"mode={self.exec_mode}, placement={self.placement}) =="]
+        lines.extend(render_tree(self.tree))
+        x = self.xla
+        lines.append(
+            f"XLA: compiles={x.get('total_compiles', 0)} "
+            f"cache_hits={x.get('total_cache_hits', 0)} "
+            f"compile_time={_fmt_ns(x.get('total_compile_ns', 0))}")
+        churny = [f"{k} ({v['distinct_signatures']} signatures)"
+                  for k, v in sorted(self.kernels.items())
+                  if v.get("shape_churn")]
+        if churny:
+            lines.append("shape-churn kernels: " + ", ".join(churny))
+        lines.append(
+            f"transfers: h2d={_fmt_bytes(x.get('h2d_bytes', 0))} "
+            f"({x.get('h2d_transfers', 0)}) "
+            f"d2h={_fmt_bytes(x.get('d2h_bytes', 0))} "
+            f"({x.get('d2h_transfers', 0)})")
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        return self.render_text()
+
+
+def _run_execution_plan(plan, keep_result: bool) -> tuple:
+    """Run every partition of an in-process ExecutionPlan through the
+    task runtime; returns (merged tree, partitions, rows, table|None)."""
+    import pyarrow as pa
+
+    from blaze_tpu.bridge.runtime import NativeExecutionRuntime
+
+    n = plan.num_partitions
+    merged = MetricNode()
+    rows = 0
+    batches = []
+    for p in range(n):
+        rt = NativeExecutionRuntime(
+            {"stage_id": 0, "partition_id": p, "num_partitions": n},
+            plan=plan)
+        # snapshot BEFORE start(): the producer thread begins pulling
+        # batches immediately, and the fused tree may be shared across
+        # partition runtimes (counters accumulate on the same nodes)
+        before = rt.plan.collect_metrics()
+        rt.start()
+        try:
+            for rb in rt.batches():
+                rows += rb.num_rows
+                if keep_result:
+                    batches.append(rb)
+        finally:
+            after = rt.finalize()
+        merged.merge_from(after.diff(before))
+    table = None
+    if keep_result:
+        table = (pa.Table.from_batches(batches) if batches
+                 else pa.Table.from_batches([], schema=plan.schema.to_arrow()))
+    return merged, n, rows, table
+
+
+_READER_NODES = ("IpcReaderExec", "FFIReaderExec")
+
+
+def _stitch_stages(tree: MetricNode, deps: List[int], sched) -> MetricNode:
+    """Reconnect producer-stage metric trees under the reader nodes that
+    consumed them, recreating the full pre-split operator tree.  Reader
+    nodes appear in the result tree in the same DFS order the splitter
+    discovered the exchanges (Stage.deps order)."""
+    pending = list(deps)
+
+    def walk(node: MetricNode) -> None:
+        # snapshot: the appended subtree was stitched recursively with its
+        # OWN stage's deps — walking into it would consume this level's
+        children = list(node.children)
+        if node.name in _READER_NODES and pending:
+            sid = pending.pop(0)
+            sub = sched.stage_metrics.get(sid)
+            if sub is not None and sid < len(sched.stages):
+                node.children.append(
+                    _stitch_stages(sub, sched.stages[sid].deps, sched))
+        for c in children:
+            walk(c)
+
+    walk(tree)
+    return tree
+
+
+def _run_plan_dict(plan: Dict[str, Any],
+                   work_dir: Optional[str]) -> tuple:
+    """Run an engine-IR dict through the stage DAG scheduler."""
+    from blaze_tpu.plan.stages import DagScheduler
+
+    sched = DagScheduler(work_dir=work_dir)
+    table = sched.run_collect(plan)
+    tree = sched.collect_metrics() or MetricNode()
+    if sched.exec_mode == "staged" and sched.stages:
+        tree = _stitch_stages(tree, sched.stages[-1].deps, sched)
+    if sched.exec_mode == "staged" and sched.stages:
+        partitions = sched.stages[-1].num_tasks
+    else:
+        partitions = 1
+    return (tree, partitions, table.num_rows, sched.exec_mode or "local",
+            table)
+
+
+def explain_analyze(plan: Union[Dict[str, Any], Any], *,
+                    query_id: Optional[str] = None,
+                    work_dir: Optional[str] = None,
+                    record: bool = True,
+                    keep_result: bool = False) -> QueryProfile:
+    """Execute `plan` (an ExecutionPlan instance or an engine-IR dict)
+    and return the merged query profile.
+
+    `print(explain_analyze(plan))` renders the annotated operator tree;
+    `.to_dict()` is the JSON served on /profile/<qid> when `record`.
+    With `keep_result` the output table rides along on `.result` (for
+    harnesses that profile AND verify rows in one run)."""
+    from blaze_tpu.bridge import profiling, tracing, ui, xla_stats
+    from blaze_tpu.bridge.placement import host_resident
+    from blaze_tpu.ops.base import ExecutionPlan
+
+    qid = query_id or ui.next_query_id()
+    xla_before = xla_stats.snapshot()
+    t0 = time.perf_counter_ns()
+    with tracing.execution_context(query=qid), \
+            tracing.span("explain_analyze", query=qid):
+        if isinstance(plan, ExecutionPlan):
+            tree, partitions, rows, table = _run_execution_plan(
+                plan, keep_result)
+            mode = "local"
+        else:
+            tree, partitions, rows, mode, table = _run_plan_dict(
+                plan, work_dir)
+    wall_ns = time.perf_counter_ns() - t0
+
+    profile = QueryProfile(
+        query_id=qid, wall_ns=wall_ns, tree=tree, partitions=partitions,
+        exec_mode=mode, xla=xla_stats.delta(xla_before),
+        kernels=xla_stats.compile_report()["kernels"],
+        placement="host" if host_resident() else "device",
+        output_rows=rows, result=table if keep_result else None)
+    if record:
+        profiling.record_profile(qid, profile.to_dict())
+        ui.record_completion(qid, wall_ns / 1e9, metrics=tree.to_dict())
+    return profile

@@ -40,6 +40,7 @@ import pyarrow as pa
 
 from blaze_tpu import config
 from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.bridge.xla_stats import meter_jit
 from blaze_tpu.exprs import BoundReference, PhysicalExpr
 from blaze_tpu.ops.agg.exec import AggExec, AggMode
 from blaze_tpu.ops.agg.functions import CountAgg, MinMaxAgg, SumAgg
@@ -650,6 +651,7 @@ class FusedPartialAggExec(ExecutionPlan):
 
             def __init__(c):
                 super().__init__("host_vectorized_agg")
+                c.metrics = self.metrics
 
             def spill(c) -> int:
                 if not state["chunks"]:
@@ -758,9 +760,7 @@ class FusedPartialAggExec(ExecutionPlan):
         Arrow-resident; execute() wraps into ColumnBatch at the edge)."""
         bs = config.BATCH_SIZE.get()
         for off in range(0, rb.num_rows, bs):
-            chunk = rb.slice(off, min(bs, rb.num_rows - off))
-            self.metrics.add("output_rows", chunk.num_rows)
-            yield chunk
+            yield rb.slice(off, min(bs, rb.num_rows - off))
 
     def _host_passthrough(self, tbl, key_names) -> BatchIterator:
         """One raw keys/args table emitted in PARTIAL-output (acc) form
@@ -1737,7 +1737,6 @@ class FusedPartialAggExec(ExecutionPlan):
         bs = config.BATCH_SIZE.get()
         for off in range(0, rb.num_rows, bs):
             chunk = rb.slice(off, min(bs, rb.num_rows - off))
-            self.metrics.add("output_rows", chunk.num_rows)
             yield ColumnBatch.from_arrow(chunk)
 
     # -- unbounded keys: device open-addressing hash table -----------------
@@ -1873,7 +1872,6 @@ class FusedPartialAggExec(ExecutionPlan):
         bs = config.BATCH_SIZE.get()
         for off in range(0, rb.num_rows, bs):
             chunk = rb.slice(off, min(bs, rb.num_rows - off))
-            self.metrics.add("output_rows", chunk.num_rows)
             yield ColumnBatch.from_arrow(chunk)
 
 
@@ -2030,7 +2028,7 @@ def _dense_fold_factory(key, prepare, ranges, kinds, num_slots: int):
                                        num_slots)
         return jax.lax.fori_loop(0, masks.shape[0], body, carry)
 
-    fold = partial(jax.jit, donate_argnums=0)(fold_impl)
+    fold = meter_jit(fold_impl, name="fused.dense_fold", donate_argnums=0)
     fold.raw = fold_impl  # see _mxu_fold_factory: embeddable traced body
     _DENSE_STEP_CACHE[skey] = fold
     return fold
@@ -2114,7 +2112,7 @@ def _mxu_fold_factory(key, prepare, ranges, meta: _MxuMeta,
             return (table, tuple(new_mm), ok)
         return jax.lax.fori_loop(0, masks.shape[0], body, carry)
 
-    fold = partial(jax.jit, donate_argnums=0)(fold_impl)
+    fold = meter_jit(fold_impl, name="fused.mxu_fold", donate_argnums=0)
     # raw traced body, for callers embedding the fold in a larger
     # program (bench device loop): a nested-jit call boundary inside a
     # fori_loop defeats XLA's cross-stage fusion on TPU (~10x slower)
@@ -2127,7 +2125,7 @@ def _mxu_fold_factory(key, prepare, ranges, meta: _MxuMeta,
 def _dense_step_factory(ranges, kinds, num_slots: int):
     ranges = list(ranges)
 
-    @partial(jax.jit, donate_argnums=0)
+    @partial(meter_jit, name="fused.dense_step", donate_argnums=0)
     def step(carry, key_data, key_valid, agg_data, agg_valid, mask):
         gid, _total = pack_dense_keys(list(zip(key_data, key_valid)),
                                       ranges)
@@ -2225,7 +2223,7 @@ def _dict_dense_step(caps: tuple, kinds: tuple, capacity: int):
                                           pack_dense_keys_i32)
     ranges = tuple((0, c - 1) for c in caps)
 
-    @jax.jit
+    @partial(meter_jit, name="fused.dict_device_step")
     def step(carry, kd, kv, ad, av, mask):
         accs, avalid, occupied = carry
         gid, total = pack_dense_keys_i32(list(zip(kd, kv)), ranges)
@@ -2271,12 +2269,13 @@ def _hash_step_jit(kinds):
     def f(carry, kd, kv, ad, av, mask):
         specs = [(k, d, v) for k, d, v in zip(kinds, ad, av)]
         return hash_agg_step(carry, list(zip(kd, kv)), specs, mask)
-    return jax.jit(f)
+    return meter_jit(f, name="fused.hash_step")
 
 
 @functools.lru_cache(maxsize=128)
 def _rehash_jit(kinds, new_slots: int):
-    return jax.jit(lambda c: rehash_carry(c, list(kinds), new_slots))
+    return meter_jit(lambda c: rehash_carry(c, list(kinds), new_slots),
+                     name="fused.rehash")
 
 
 def _hash_chain_step_factory(key, prepare, kinds):
@@ -2287,7 +2286,7 @@ def _hash_chain_step_factory(key, prepare, kinds):
         return step
     _evict_if_full(_DENSE_STEP_CACHE)
 
-    @jax.jit
+    @partial(meter_jit, name="fused.hash_chain_step")
     def step(carry, cols_flat, mask):
         kd, kv, ad, av, m = prepare(cols_flat, mask)
         specs = [(k, d, v) for k, d, v in zip(kinds, ad, av)]

@@ -29,12 +29,14 @@ from __future__ import annotations
 
 import os
 import tempfile
+import threading
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 import pyarrow as pa
 
+from blaze_tpu.bridge.metrics import MetricNode
 from blaze_tpu.bridge.resource import put_resource, remove_resource
 
 _SCAN_KINDS = ("parquet_scan", "orc_scan")
@@ -89,6 +91,25 @@ class DagScheduler:
         self.stages: List[Stage] = []
         self._resources: List[str] = []
         self.exec_mode: Optional[str] = None  # "local" | "staged"
+        # per-stage operator-metric trees, merged across that stage's
+        # tasks at finalize time (the MetricsUpdater analog)
+        self.stage_metrics: Dict[int, MetricNode] = {}
+        self._metrics_lock = threading.Lock()
+
+    def _record_task_metrics(self, sid: int, tree: MetricNode) -> None:
+        from blaze_tpu.bridge import profiling
+        with self._metrics_lock:
+            merged = self.stage_metrics.setdefault(
+                sid, MetricNode(name=tree.name))
+            merged.merge_from(tree)
+        profiling.record_metrics(tree.to_dict())
+
+    def collect_metrics(self) -> Optional[MetricNode]:
+        """Merged metric tree of the result stage (the operator tree the
+        caller's rows actually flowed through), or None before any run."""
+        if not self.stage_metrics:
+            return None
+        return self.stage_metrics[max(self.stage_metrics)]
 
     # -- splitting ---------------------------------------------------------
 
@@ -238,10 +259,14 @@ class DagScheduler:
                 for _ in rt.batches():
                     pass
             finally:
-                rt.finalize()
+                self._record_task_metrics(stage.sid, rt.finalize())
 
-        self._run_tasks(run_map, stage.num_tasks,
-                        f"stage {stage.sid} (shuffle write)")
+        from blaze_tpu.bridge import tracing
+        with tracing.span("shuffle_exchange", stage=stage.sid,
+                          tasks=stage.num_tasks,
+                          partitioning=part["kind"]):
+            self._run_tasks(run_map, stage.num_tasks,
+                            f"stage {stage.sid} (shuffle write)")
 
         outputs = []
         for m in range(stage.num_tasks):
@@ -300,6 +325,7 @@ class DagScheduler:
 
         node = fuse_plan(prune_columns(create_plan(plan)))
         out = node.execute_collect().to_arrow()
+        self._record_task_metrics(0, node.collect_metrics())
         if isinstance(out, pa.RecordBatch):
             return pa.Table.from_batches([out])
         return out
@@ -311,6 +337,7 @@ class DagScheduler:
         from blaze_tpu.plan.types import schema_from_dict
 
         from blaze_tpu import config
+        self.stage_metrics = {}  # instance may be reused per query
         threshold = config.DAG_SINGLE_TASK_BYTES.get()
         if threshold > 0 and self._scan_input_bytes(plan) <= threshold:
             self.exec_mode = "local"
@@ -337,7 +364,7 @@ class DagScheduler:
                 try:
                     return list(rt.batches())
                 finally:
-                    rt.finalize()
+                    self._record_task_metrics(result.sid, rt.finalize())
 
             parts = self._run_tasks(run_result, result.num_tasks,
                                     f"stage {result.sid} (result)")

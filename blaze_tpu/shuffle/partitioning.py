@@ -57,7 +57,8 @@ def _hash_pmod_jit(tids: Tuple[str, ...], n_parts: int):
                 for (v, val), tid in zip(flat_cols, tids)]
         h = H.hash_columns(cols, seed=42, xp=jnp, algo="murmur3")
         return H.pmod(h, n_parts, xp=jnp)
-    return jax.jit(f)
+    from blaze_tpu.bridge.xla_stats import meter_jit
+    return meter_jit(f, name="shuffle.hash_pmod")
 
 
 def _native_pmod(flat_cols, tids, n_parts):

@@ -106,7 +106,7 @@ class IpcReaderExec(ExecutionPlan):
         blocks = source(partition) if callable(source) else source
         for block in blocks:
             for rb in read_block(block):
-                self.metrics.add("output_rows", rb.num_rows)
+                self.metrics.add("io_bytes", rb.nbytes)
                 yield rb
 
 
@@ -130,6 +130,8 @@ class IpcWriterExec(ExecutionPlan):
             rb = batch.compact().to_arrow()
             if rb.num_rows:
                 w.write_batch(rb)
+                self.metrics.add("output_rows", rb.num_rows)
+                self.metrics.add("io_bytes", rb.nbytes)
         w.finish()
         return iter(())
 
@@ -160,5 +162,4 @@ class FFIReaderExec(ExecutionPlan):
             raise KeyError(f"ffi resource {self.resource_id!r} not found")
         batches = source(partition) if callable(source) else source
         for rb in batches:
-            self.metrics.add("output_rows", rb.num_rows)
             yield ColumnBatch.from_arrow(rb)

@@ -61,6 +61,7 @@ class ShuffleRepartitioner(MemConsumer):
         super().__init__("shuffle")
         self.partitioning = partitioning
         self.schema = schema
+        self.metrics = metrics
         self._staged: List[pa.RecordBatch] = []  # with __pid lead column
         self._staged_bytes = 0
         self._spills: List[_PartitionedSpill] = []
@@ -391,19 +392,25 @@ class ShuffleWriterExec(ExecutionPlan):
                         and type(child).arrow_batches
                         is not ExecutionPlan.arrow_batches)
         try:
-            with self.metrics.timer("elapsed_compute"):
-                # single-reduce local writes stream frames to disk as
-                # they arrive (compute/IO overlap, no staging hump)
-                rep.open_stream(self.data_file)
-                if arrow_native:
-                    for rb in child.arrow_batches(partition):
-                        rep.insert_arrow(rb)
-                else:
-                    for batch in child.execute(partition):
-                        rep.insert_batch(batch)
-                self.partition_lengths = rep.write(self.data_file,
-                                                   self.index_file)
+            # single-reduce local writes stream frames to disk as
+            # they arrive (compute/IO overlap, no staging hump)
+            rep.open_stream(self.data_file)
+            # sinks yield nothing, so the stream meter never sees rows;
+            # count what is written (rows in == rows shuffled out)
+            if arrow_native:
+                for rb in child.arrow_batches(partition):
+                    self.metrics.add("output_rows", rb.num_rows)
+                    self.metrics.add("output_batches")
+                    rep.insert_arrow(rb)
+            else:
+                for batch in child.execute(partition):
+                    self.metrics.add("output_rows", batch.num_rows)
+                    self.metrics.add("output_batches")
+                    rep.insert_batch(batch)
+            self.partition_lengths = rep.write(self.data_file,
+                                               self.index_file)
             self.metrics.add("data_size", sum(self.partition_lengths))
+            self.metrics.add("io_bytes", sum(self.partition_lengths))
         finally:
             rep.close()
             rep.unregister()
@@ -430,6 +437,8 @@ class RssShuffleWriterExec(ExecutionPlan):
         rep.set_spillable(MemManager.get())
         try:
             for batch in self.children[0].execute(partition):
+                self.metrics.add("output_rows", batch.num_rows)
+                self.metrics.add("output_batches")
                 rep.insert_batch(batch)
             rep.write_rss(self._rss_write)
         finally:

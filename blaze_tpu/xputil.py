@@ -51,7 +51,10 @@ def xp_of(*arrays):
 
 def asnp(a) -> np.ndarray:
     """Pull an array to host numpy (zero-copy for numpy and for CPU-backend
-    jax arrays)."""
+    jax arrays).  Device pulls are accounted as D2H transfer volume."""
     if isinstance(a, np.ndarray):
         return a
-    return np.asarray(a)
+    out = np.asarray(a)
+    from blaze_tpu.bridge import xla_stats
+    xla_stats.note_d2h(out.nbytes)
+    return out

@@ -404,7 +404,7 @@ def test_orc_stripe_streaming_and_metrics(tmp_path):
     for cb in scan.execute(0):
         total += cb.num_rows
     assert total == n
-    assert (scan.collect_metrics().get("bytes_scanned") or 0) > 0
+    assert (scan.collect_metrics().get("io_bytes") or 0) > 0
 
 
 def test_orc_partition_constants(tmp_path):

@@ -1,0 +1,140 @@
+"""Profiling HTTP service endpoints: /status, /metrics, /metrics.prom,
+/profile/<qid>, /auron, and the /trace/start query-string validation
+(the raw text after '?' was previously used verbatim as the trace dir).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from blaze_tpu.bridge import profiling, ui
+from blaze_tpu.memory import MemManager
+
+
+@pytest.fixture(autouse=True)
+def service():
+    MemManager.init(4 << 30)
+    ui.reset()
+    port = profiling.start_http_service()
+    yield port
+    profiling.stop_http_service()
+    ui.reset()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.headers.get("Content-Type"), r.read().decode()
+
+
+def _get_error(port, path):
+    try:
+        _get(port, path)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+    raise AssertionError(f"{path} unexpectedly succeeded")
+
+
+def test_status_reports_memory_manager(service):
+    code, ctype, body = _get(service, "/status")
+    assert code == 200
+    status = json.loads(body)
+    assert "mem_manager" in status
+    assert "device_memory" in status
+
+
+def test_metrics_serves_recorded_trees(service):
+    profiling.record_metrics({"name": "FilterExec",
+                              "values": {"output_rows": 42},
+                              "children": []})
+    code, _ctype, body = _get(service, "/metrics")
+    assert code == 200
+    trees = json.loads(body)
+    assert any(t.get("name") == "FilterExec" and
+               t["values"]["output_rows"] == 42 for t in trees)
+
+
+def test_metrics_prom_exposition(service):
+    from blaze_tpu.bridge import xla_stats
+    import jax.numpy as jnp
+    xla_stats.reset()
+    f = xla_stats.meter_jit(lambda x: x + 1, name="prom.kernel")
+    f(jnp.arange(4))
+    f(jnp.arange(4))
+    profiling.record_metrics({"name": "ScanExec",
+                              "values": {"output_rows": 7,
+                                         "io_bytes": 123},
+                              "children": []})
+    code, ctype, body = _get(service, "/metrics.prom")
+    assert code == 200
+    assert ctype.startswith("text/plain")
+    assert 'blaze_xla_compiles_total{kernel="prom.kernel"} 1' in body
+    assert 'blaze_xla_cache_hits_total{kernel="prom.kernel"} 1' in body
+    assert "blaze_h2d_bytes_total" in body
+    assert "blaze_mem_peak_used_bytes" in body
+    assert 'blaze_operator_output_rows_total{operator="ScanExec"} 7' in body
+    assert 'blaze_operator_io_bytes_total{operator="ScanExec"} 123' in body
+    # HELP/TYPE emitted once per metric family
+    assert body.count("# TYPE blaze_h2d_bytes_total gauge") == 1
+
+
+def test_profile_endpoints(service):
+    profiling.record_profile("q-http-1", {
+        "query_id": "q-http-1", "wall_ns": 1000,
+        "tree": {"name": "AggExec", "values": {"output_rows": 5},
+                 "children": []},
+        "output_rows": 5})
+    code, _ctype, body = _get(service, "/profile")
+    assert code == 200
+    listing = json.loads(body)
+    assert any(p["query_id"] == "q-http-1" for p in listing)
+
+    code, _ctype, body = _get(service, "/profile/q-http-1")
+    assert code == 200
+    prof = json.loads(body)
+    assert prof["tree"]["name"] == "AggExec"
+
+    code, err = _get_error(service, "/profile/nope")
+    assert code == 404
+    assert "q-http-1" in err["known"]
+
+
+def test_profile_ring_evicts_oldest(service):
+    for i in range(profiling._MAX_PROFILES + 3):
+        profiling.record_profile(f"ring-{i}", {"wall_ns": i})
+    known = [p["query_id"] for p in profiling.list_profiles()]
+    assert len(known) == profiling._MAX_PROFILES
+    assert "ring-0" not in known
+    assert f"ring-{profiling._MAX_PROFILES + 2}" in known
+
+
+def test_auron_endpoint(service):
+    qid = ui.next_query_id()
+    ui.record_conversion(qid, ["FilterExec"], [])
+    code, _ctype, body = _get(service, "/auron")
+    assert code == 200
+    data = json.loads(body)
+    assert any(e["query_id"] == qid for e in data["executions"])
+
+
+def test_trace_start_rejects_unknown_params(service):
+    # the old handler took the raw text after '?' as the directory, so
+    # '/trace/start?/tmp/x' created a directory literally named that
+    code, err = _get_error(service, "/trace/start?/tmp/x")
+    assert code == 400
+    assert "expected ?dir=" in err["error"]
+
+
+def test_trace_start_rejects_relative_dir(service):
+    code, err = _get_error(service, "/trace/start?dir=relative/path")
+    assert code == 400
+    assert "absolute" in err["error"]
+
+
+def test_unknown_path_404_lists_routes(service):
+    code, err = _get_error(service, "/nope")
+    assert code == 404
+    assert "/metrics.prom" in err["paths"]
+    assert "/profile/<qid>" in err["paths"]
