@@ -49,6 +49,49 @@ def round_capacity(n: int) -> int:
     return max(LANE, -(-n // LANE) * LANE)
 
 
+def _bucket_policy() -> tuple:
+    """(base rung, growth factor) of the capacity ladder, both sanitized:
+    the base lane-rounds, the factor floors at 9/8 so the ladder always
+    terminates and stays geometric."""
+    base = max(LANE, round_capacity(config.BATCH_BUCKET_MIN.get()))
+    growth = max(1.125, config.BATCH_BUCKET_GROWTH.get())
+    return base, growth
+
+
+def _next_rung(cap: int, growth: float) -> int:
+    return max(round_capacity(int(cap * growth)), cap + LANE)
+
+
+def bucket_ladder(limit: int) -> List[int]:
+    """The ladder rungs `bucket_capacity` can return, ascending, up to the
+    first rung >= limit (docs/tests; the default config yields 128*2^k)."""
+    base, growth = _bucket_policy()
+    rungs = [base]
+    while rungs[-1] < limit:
+        rungs.append(_next_rung(rungs[-1], growth))
+    return rungs
+
+
+def bucket_capacity(n: int) -> int:
+    """Quantize a requested row capacity onto the geometric bucket ladder.
+
+    Every jit boundary keyed by buffer capacity then sees a bounded set
+    of static shapes — at most one XLA compile per (kernel, rung) instead
+    of one per distinct ragged tail size (the recompilation storm
+    `meter_jit` flags as shape churn).  Memory overhead is bounded by the
+    growth factor.  With bucketing disabled this degrades to plain lane
+    rounding."""
+    if not config.BATCH_BUCKETING_ENABLE.get():
+        cap = round_capacity(n)
+    else:
+        cap, growth = _bucket_policy()
+        while cap < n:
+            cap = _next_rung(cap, growth)
+    from blaze_tpu.bridge import xla_stats
+    xla_stats.note_bucket(cap, cap - min(int(n), cap))
+    return cap
+
+
 def _unpack_validity(arr: pa.Array) -> np.ndarray:
     """Arrow validity bitmap -> bool array of len(arr)."""
     if arr.null_count == 0:
@@ -106,7 +149,12 @@ class DeviceColumn:
 
     @staticmethod
     def from_numpy(values: np.ndarray, valid: Optional[np.ndarray],
-                   dtype: DataType, capacity: int) -> "DeviceColumn":
+                   dtype: DataType, capacity: int,
+                   stage_host: bool = False) -> "DeviceColumn":
+        """`stage_host` keeps the padded buffers as numpy even under device
+        placement, so a batch-level caller can issue ONE device_put over
+        every column (ColumnBatch.place_device) instead of a transfer per
+        column."""
         n = len(values)
         assert capacity >= n
         np_dtype = dtype.np_dtype()
@@ -114,14 +162,15 @@ class DeviceColumn:
         data[:n] = values
         v = np.zeros(capacity, dtype=bool)
         v[:n] = True if valid is None else valid
-        if _host_resident():
+        if stage_host or _host_resident():
             return DeviceColumn(dtype, data, v)
         from blaze_tpu.bridge import xla_stats
         xla_stats.note_h2d(data.nbytes + v.nbytes)
         return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(v))
 
     @staticmethod
-    def from_arrow(arr: pa.Array, dtype: DataType, capacity: int) -> "DeviceColumn":
+    def from_arrow(arr: pa.Array, dtype: DataType, capacity: int,
+                   stage_host: bool = False) -> "DeviceColumn":
         arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
         values = _arrow_fixed_values(arr, dtype)
         valid = _unpack_validity(arr)
@@ -132,7 +181,8 @@ class DeviceColumn:
             return DeviceColumn(dtype,
                                 values.astype(dtype.np_dtype(), copy=False),
                                 valid)
-        return DeviceColumn.from_numpy(values, valid, dtype, capacity)
+        return DeviceColumn.from_numpy(values, valid, dtype, capacity,
+                                       stage_host=stage_host)
 
     def to_arrow(self, num_rows: int, selection: Optional[np.ndarray] = None,
                  prefetched: Optional[tuple] = None) -> pa.Array:
@@ -170,7 +220,7 @@ class DeviceColumn:
         values = asnp(self.data)[indices]
         valid = asnp(self.validity)[indices]
         return DeviceColumn.from_numpy(values, valid, self.dtype,
-                                       round_capacity(len(indices)))
+                                       bucket_capacity(len(indices)))
 
 
 @dataclass
@@ -229,20 +279,21 @@ class ColumnBatch:
             cap = n  # unpadded: numpy needs no static shapes; buffers wrap
             # the Arrow memory zero-copy (jit consumers re-pad on entry)
         else:
-            cap = round_capacity(n)
+            cap = bucket_capacity(n)
         cols: List[Column] = []
         for arr, f in zip(arrays, schema):
             if f.data_type.is_fixed_width:
-                cols.append(DeviceColumn.from_arrow(arr, f.data_type, cap))
+                cols.append(DeviceColumn.from_arrow(arr, f.data_type, cap,
+                                                    stage_host=True))
             else:
                 cols.append(HostColumn(f.data_type, arr))
-        return ColumnBatch(schema, cols, n)
+        return ColumnBatch(schema, cols, n).place_device()
 
     @staticmethod
     def from_numpy(schema: Schema, arrays: Sequence[np.ndarray],
                    capacity: Optional[int] = None) -> "ColumnBatch":
         n = len(arrays[0]) if arrays else 0
-        cap = capacity or round_capacity(n)
+        cap = capacity or bucket_capacity(n)
         cols: List[Column] = []
         for arr, f in zip(arrays, schema):
             if f.data_type.is_fixed_width:
@@ -315,6 +366,33 @@ class ColumnBatch:
             c = int(self._xp().sum(self.row_mask()))
             self._sel_count = c  # dataclasses.replace drops the cache
         return c
+
+    def place_device(self) -> "ColumnBatch":
+        """Issue ONE batched async device placement for every numpy-backed
+        device column (jax.device_put over the flat buffer list — a
+        transfer per column serializes round trips on a tunneled device).
+        Run from the IO prefetch worker, the NEXT batch's H2D overlaps the
+        current batch's compute: double-buffered placement.  No-op under
+        host residency or when everything is already placed."""
+        if _host_resident():
+            return self
+        idx = [i for i, c in enumerate(self.columns)
+               if isinstance(c, DeviceColumn)
+               and isinstance(c.data, np.ndarray)]
+        if not idx:
+            return self
+        bufs: List[np.ndarray] = []
+        for i in idx:
+            bufs.append(self.columns[i].data)
+            bufs.append(np.asarray(self.columns[i].validity))
+        placed = jax.device_put(bufs)
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_h2d(sum(b.nbytes for b in bufs))
+        cols = list(self.columns)
+        for j, i in enumerate(idx):
+            c = cols[i]
+            cols[i] = DeviceColumn(c.dtype, placed[2 * j], placed[2 * j + 1])
+        return replace(self, columns=cols)
 
     # -- transformations ----------------------------------------------------
     def with_selection(self, sel: jax.Array) -> "ColumnBatch":
@@ -403,7 +481,7 @@ class ColumnBatch:
         batches = [b.compact() for b in batches]
         schema = batches[0].schema
         total = sum(b.num_rows for b in batches)
-        cap = capacity or round_capacity(total)
+        cap = capacity or bucket_capacity(total)
         cols: List[Column] = []
         for i, f in enumerate(schema):
             if f.data_type.is_fixed_width:

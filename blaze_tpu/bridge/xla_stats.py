@@ -31,6 +31,15 @@ _lock = threading.Lock()
 _kernels: Dict[str, Dict[str, Any]] = {}
 _transfers = {"h2d_bytes": 0, "h2d_transfers": 0,
               "d2h_bytes": 0, "d2h_transfers": 0}
+# batch-shaping + IO-pipeline counters (batch.bucket_capacity /
+# ops.base.PrefetchIterator): how many capacity requests were quantized
+# onto the bucket ladder (and the padding that cost), and how often the
+# consumer actually waited on the prefetch queue (0 wait = IO fully
+# overlapped with compute).
+_pipeline = {"bucket_batches": 0, "bucket_pad_rows": 0,
+             "prefetch_batches": 0, "prefetch_wait_ns": 0,
+             "prefetch_waits": 0}
+_bucket_caps: set = set()
 
 # Distinct signatures beyond this on one kernel = shape churn (the
 # recompilation-storm smell: unpadded dynamic shapes hitting jit).
@@ -127,6 +136,36 @@ def note_d2h(nbytes: int) -> None:
         _transfers["d2h_transfers"] += 1
 
 
+def note_bucket(capacity: int, pad_rows: int) -> None:
+    """One capacity request quantized onto the bucket ladder
+    (batch.bucket_capacity)."""
+    with _lock:
+        _pipeline["bucket_batches"] += 1
+        _pipeline["bucket_pad_rows"] += max(0, int(pad_rows))
+        _bucket_caps.add(int(capacity))
+
+
+def note_prefetch(batches: int = 0, wait_ns: int = 0) -> None:
+    """Prefetch-queue accounting from the consumer side: `batches` =
+    items delivered through a prefetch queue, `wait_ns` = time the
+    consumer blocked on the queue (the un-overlapped IO residue)."""
+    with _lock:
+        _pipeline["prefetch_batches"] += int(batches)
+        if wait_ns > 0:
+            _pipeline["prefetch_wait_ns"] += int(wait_ns)
+            _pipeline["prefetch_waits"] += 1
+
+
+def pipeline_stats() -> dict:
+    """Bucket + prefetch counters; `bucket_capacities` is the distinct
+    ladder rungs observed (the static-shape universe jit kernels see)."""
+    with _lock:
+        d = dict(_pipeline)
+        d["distinct_buckets"] = len(_bucket_caps)
+        d["bucket_capacities"] = sorted(_bucket_caps)
+        return d
+
+
 def compile_report() -> dict:
     """Per-kernel compile stats + totals, JSON-ready."""
     with _lock:
@@ -159,6 +198,9 @@ def snapshot() -> dict:
     flat = {"h2d_bytes": 0, "d2h_bytes": 0,
             "h2d_transfers": 0, "d2h_transfers": 0}
     flat.update(transfer_stats())
+    ps = pipeline_stats()
+    ps.pop("bucket_capacities", None)  # list: not delta-able
+    flat.update(ps)
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -174,3 +216,6 @@ def reset() -> None:
         _kernels.clear()
         for k in _transfers:
             _transfers[k] = 0
+        for k in _pipeline:
+            _pipeline[k] = 0
+        _bucket_caps.clear()

@@ -259,6 +259,33 @@ IGNORE_CORRUPTED_FILES = bool_conf(
 INPUT_BATCH_PREFETCH = int_conf(
     "auron.input.batch.prefetch", 2,
     "Host->device double-buffering depth (the sync_channel(1) analog, rt.rs:142).")
+BATCH_BUCKETING_ENABLE = bool_conf(
+    "auron.tpu.batch.bucketing", True,
+    "Quantize device-buffer capacities onto the geometric bucket ladder "
+    "(batch.bucket_capacity) so every jit'd kernel sees a bounded set of "
+    "static shapes and compiles at most once per (kernel, bucket); off, "
+    "capacities lane-round per batch and each ragged tail size compiles "
+    "its own program.")
+BATCH_BUCKET_MIN = int_conf(
+    "auron.tpu.batch.bucket.min", 128,
+    "Smallest rung of the capacity bucket ladder (rounded up to the "
+    "128-lane tile).")
+BATCH_BUCKET_GROWTH = float_conf(
+    "auron.tpu.batch.bucket.growth", 2.0,
+    "Geometric growth factor between bucket-ladder rungs; 2.0 gives the "
+    "128*2^k ladder (memory overhead bounded by the factor, kernel "
+    "variants bounded by log_growth(max_rows)).")
+IO_PREFETCH_ENABLE = bool_conf(
+    "auron.tpu.io.prefetch", True,
+    "Async pipelined executor at host-IO edges (ops/base.py "
+    "PrefetchIterator): parquet row-group decode, shuffle IPC segment "
+    "reads and map-side materialization run on a bounded background "
+    "worker so the device never idles on host IO.  Kill-switch for "
+    "debugging; depth comes from auron.tpu.io.prefetch.depth.")
+IO_PREFETCH_DEPTH = int_conf(
+    "auron.tpu.io.prefetch.depth", 2,
+    "Bounded queue depth of the IO prefetcher; <= 0 degrades to a "
+    "synchronous passthrough (same as disabling the kill-switch).")
 ON_DEVICE_AGG_CAPACITY = int_conf(
     "auron.tpu.agg.table.capacity", 1 << 18,
     "Static group slots for the fused sorted-table aggregation stage; "

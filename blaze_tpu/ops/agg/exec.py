@@ -28,7 +28,7 @@ import numpy as np
 import pyarrow as pa
 
 from blaze_tpu import config
-from blaze_tpu.batch import ColumnBatch, DeviceColumn, round_capacity
+from blaze_tpu.batch import ColumnBatch, DeviceColumn, bucket_capacity
 from blaze_tpu.exprs import PhysicalExpr
 from blaze_tpu.exprs.base import ColVal
 from blaze_tpu.kernels import compare
@@ -604,7 +604,7 @@ class _AggState(MemConsumer):
                         sink.add_host(fn.host_eval(
                             [rb.column(j + t) for t in range(nacc)]))
                     else:
-                        cap = round_capacity(rb.num_rows)
+                        cap = bucket_capacity(rb.num_rows)
                         accs = []
                         for t in range(nacc):
                             f = fn.acc_fields(self.in_schema)[t]

@@ -15,12 +15,13 @@ enqueued ahead while the host iterates.
 from __future__ import annotations
 
 import functools
+import queue
 import threading
 import time
-from typing import Iterator, List, Optional, Sequence
+from typing import Callable, Iterator, List, Optional, Sequence
 
 from blaze_tpu import config
-from blaze_tpu.batch import ColumnBatch, round_capacity
+from blaze_tpu.batch import ColumnBatch, bucket_capacity
 from blaze_tpu.bridge.context import current_task
 from blaze_tpu.bridge.metrics import BASELINE_METRICS, MetricNode
 from blaze_tpu.schema import Schema
@@ -130,6 +131,146 @@ def _meter_stream(fn, kind: str):
     wrapper._blaze_metered = True
     wrapper._blaze_wraps = fn
     return wrapper
+
+
+class _Raised:
+    """Worker-side exception in transit to the consumer."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+_DONE = object()
+
+
+class PrefetchIterator:
+    """Bounded-depth background prefetch of a batch stream — the async
+    pipelined executor applied at host-IO edges (parquet row-group decode,
+    shuffle IPC segment reads, map-side materialization).  The reference
+    gets IO/compute overlap from tokio streams + sync_channel (rt.rs:142);
+    here a single worker thread pulls `source` (optionally applying
+    `transform`, e.g. Arrow decode + device placement, so that work also
+    leaves the consumer's critical path) into a bounded queue.
+
+    Contract:
+      * ordering preserved (one worker, FIFO queue);
+      * a source/transform exception is re-raised at the consumer, in
+        position, after every item produced before it;
+      * close() stops AND joins the worker — no leaked threads; called on
+        early downstream termination and from __del__;
+      * depth <= 0, or the `auron.tpu.io.prefetch` kill-switch off,
+        degrades to a fully synchronous passthrough (no thread).
+    """
+
+    def __init__(self, source, depth: Optional[int] = None,
+                 transform: Optional[Callable] = None,
+                 name: str = "prefetch"):
+        if depth is None:
+            depth = (config.IO_PREFETCH_DEPTH.get()
+                     if config.IO_PREFETCH_ENABLE.get() else 0)
+        self._source = iter(source)
+        self._transform = transform
+        self._done = False
+        if depth <= 0:
+            self._queue = None
+            self._thread = None
+            return
+        # the worker re-enters the consumer's TaskContext: cancellation
+        # checks and task-scoped state are thread-local
+        self._ctx = current_task()
+        self._queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._work, name=f"blaze-prefetch-{name}", daemon=True)
+        self._thread.start()
+
+    # -- worker --------------------------------------------------------------
+    def _work(self):
+        from blaze_tpu.bridge.context import task_scope
+        try:
+            with task_scope(self._ctx):
+                for item in self._source:
+                    if self._transform is not None:
+                        item = self._transform(item)
+                    if not self._put(item):
+                        return  # closed under us
+            self._put(_DONE)
+        except BaseException as exc:
+            self._put(_Raised(exc))
+        finally:
+            close = getattr(self._source, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except BaseException:
+                    pass
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # -- consumer ------------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._queue is None:  # synchronous passthrough
+            item = next(self._source)
+            return (self._transform(item) if self._transform is not None
+                    else item)
+        if self._done:
+            raise StopIteration
+        from blaze_tpu.bridge import xla_stats
+        t0 = time.perf_counter_ns()
+        item = self._queue.get()
+        xla_stats.note_prefetch(wait_ns=time.perf_counter_ns() - t0)
+        if item is _DONE:
+            self._done = True
+            self._thread.join(timeout=10)
+            raise StopIteration
+        if isinstance(item, _Raised):
+            self._done = True
+            self._thread.join(timeout=10)
+            raise item.exc
+        xla_stats.note_prefetch(batches=1)
+        return item
+
+    def close(self):
+        """Stop + join the worker, draining the queue so a blocked put
+        unblocks.  Idempotent; safe after exhaustion."""
+        if self._queue is None or self._done:
+            self._done = True
+            return
+        self._done = True
+        self._stop.set()
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=10)
+
+    def __del__(self):
+        try:
+            self.close()
+        except BaseException:
+            pass
+
+
+def prefetch(source, depth: Optional[int] = None,
+             transform: Optional[Callable] = None,
+             name: str = "prefetch"):
+    """Wrap a host-IO stream with the bounded background prefetcher (see
+    PrefetchIterator); semantics of the stream are unchanged."""
+    return PrefetchIterator(source, depth=depth, transform=transform,
+                            name=name)
 
 
 class ExecutionPlan:
@@ -255,10 +396,10 @@ class CoalesceStream:
             staged_rows += n
             if staged_rows >= self._batch_size:
                 yield ColumnBatch.concat(staged,
-                                         round_capacity(staged_rows))
+                                         bucket_capacity(staged_rows))
                 staged, staged_rows = [], 0
         if staged:
-            yield ColumnBatch.concat(staged, round_capacity(staged_rows))
+            yield ColumnBatch.concat(staged, bucket_capacity(staged_rows))
 
 
 def coalesce(stream: BatchIterator, batch_size: Optional[int] = None) -> BatchIterator:

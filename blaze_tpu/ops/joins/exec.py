@@ -28,7 +28,7 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from blaze_tpu import config
-from blaze_tpu.batch import ColumnBatch, round_capacity
+from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.xputil import asnp
 from blaze_tpu.bridge.resource import get_or_create
 from blaze_tpu.exprs import PhysicalExpr

@@ -35,15 +35,55 @@ def open_source(path: str):
     return path
 
 
-_META_CACHE: dict = {}
+class _MetaLru:
+    """Bounded LRU for parquet footer metadata, keyed by path with the
+    file mtime as validity stamp: a rewritten file refreshes IN PLACE (no
+    stale twin lingering under an old (path, mtime) key), touches move
+    entries to the MRU end, and inserts evict from the LRU end — a
+    long-running session holds at most `metadataCacheSize` footers."""
+
+    def __init__(self):
+        import collections
+        import threading
+        self._lock = threading.Lock()
+        self._entries = collections.OrderedDict()  # path -> (mtime, md)
+
+    def get(self, path: str, mtime: float):
+        with self._lock:
+            entry = self._entries.get(path)
+            if entry is None or entry[0] != mtime:
+                if entry is not None:
+                    del self._entries[path]  # stale: mtime moved
+                return None
+            self._entries.move_to_end(path)
+            return entry[1]
+
+    def put(self, path: str, mtime: float, md) -> None:
+        limit = max(1, config.PARQUET_METADATA_CACHE_SIZE.get())
+        with self._lock:
+            self._entries[path] = (mtime, md)
+            self._entries.move_to_end(path)
+            while len(self._entries) > limit:
+                self._entries.popitem(last=False)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+
+_META_CACHE = _MetaLru()
 
 
 def parquet_metadata(path: str):
     """Footer metadata cached across scans and fused-stage bound discovery
-    (ref auron.parquet.metadataCacheSize; keyed by path + mtime so
-    rewritten files refresh).  Remote paths have no local mtime to
-    invalidate on, so they bypass the cache rather than serve stale
-    footers after an in-place rewrite."""
+    (ref auron.parquet.metadataCacheSize; validated by mtime so rewritten
+    files refresh).  Remote paths have no local mtime to invalidate on, so
+    they bypass the cache rather than serve stale footers after an
+    in-place rewrite."""
     import os
     if "://" in path and not path.startswith("file://"):
         return pq.ParquetFile(open_source(path)).metadata
@@ -51,14 +91,10 @@ def parquet_metadata(path: str):
         mtime = os.path.getmtime(path)
     except OSError:
         mtime = 0
-    key = (path, mtime)
-    md = _META_CACHE.get(key)
+    md = _META_CACHE.get(path, mtime)
     if md is None:
         md = pq.ParquetFile(open_source(path)).metadata
-        limit = max(1, config.PARQUET_METADATA_CACHE_SIZE.get())
-        if len(_META_CACHE) >= limit:
-            _META_CACHE.pop(next(iter(_META_CACHE)))
-        _META_CACHE[key] = md
+        _META_CACHE.put(path, mtime, md)
     return md
 
 
@@ -158,10 +194,22 @@ class ParquetScanExec(ExecutionPlan):
         return len(self._file_groups)
 
     def execute(self, partition: int) -> BatchIterator:
-        for rb in self.arrow_batches(partition):
-            yield ColumnBatch.from_arrow(rb)
+        # decode AND ColumnBatch conversion (incl. device placement) run on
+        # the prefetch worker: the next batch's pyarrow decode + H2D
+        # overlap downstream compute (double-buffering; kill-switch
+        # auron.tpu.io.prefetch)
+        from blaze_tpu.ops.base import prefetch
+        return prefetch(self._decode_batches(partition),
+                        transform=ColumnBatch.from_arrow,
+                        name="parquet_scan")
 
     def arrow_batches(self, partition: int, extra_prune=None):
+        """Prefetched Arrow-resident scan stream (see _decode_batches)."""
+        from blaze_tpu.ops.base import prefetch
+        return prefetch(self._decode_batches(partition, extra_prune),
+                        name="parquet_scan")
+
+    def _decode_batches(self, partition: int, extra_prune=None):
         """Arrow-resident scan stream.  Files under the eager threshold
         decode with pq.read_row_groups (multithreaded column decode,
         measurably faster than the single-threaded iter_batches slicer);

@@ -29,7 +29,7 @@ import numpy as np
 import pyarrow as pa
 
 from blaze_tpu import config
-from blaze_tpu.batch import ColumnBatch, DeviceColumn, round_capacity
+from blaze_tpu.batch import ColumnBatch, DeviceColumn, bucket_capacity
 from blaze_tpu.exprs import PhysicalExpr
 from blaze_tpu.memory import MemConsumer, MemManager, Spill, try_new_spill
 from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
@@ -265,7 +265,7 @@ class _SortState(MemConsumer):
             for i in key_cols:
                 dc = DeviceColumn.from_arrow(
                     rb.column(i), DataType.from_arrow(rb.column(i).type),
-                    round_capacity(rb.num_rows))
+                    bucket_capacity(rb.num_rows))
                 cols.append((dc.data, dc.validity, dc.dtype))
             keys = compare.order_keys(cols, desc, nf)
             valid = jnp.arange(cols[0][0].shape[0]) < rb.num_rows

@@ -125,6 +125,16 @@ class QueryProfile:
             f"({x.get('h2d_transfers', 0)}) "
             f"d2h={_fmt_bytes(x.get('d2h_bytes', 0))} "
             f"({x.get('d2h_transfers', 0)})")
+        if x.get("bucket_batches"):
+            lines.append(
+                f"batch shaping: bucketed_caps={x.get('bucket_batches', 0)} "
+                f"new_buckets={x.get('distinct_buckets', 0)} "
+                f"pad_rows={x.get('bucket_pad_rows', 0)}")
+        if x.get("prefetch_batches") or x.get("prefetch_wait_ns"):
+            lines.append(
+                f"prefetch: batches={x.get('prefetch_batches', 0)} "
+                f"consumer_wait={_fmt_ns(x.get('prefetch_wait_ns', 0))} "
+                f"({x.get('prefetch_waits', 0)} waits)")
         return "\n".join(lines)
 
     def __str__(self) -> str:

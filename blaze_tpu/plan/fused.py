@@ -1881,13 +1881,14 @@ from blaze_tpu.batch import DeviceColumn
 
 
 def _pad_lane(a):
-    """Pad a host-resident (numpy) array up to the 128-lane tile before it
-    enters a jit program — unpadded lengths would compile one program per
-    distinct tail-batch size."""
+    """Pad a host-resident (numpy) array up to its capacity bucket before
+    it enters a jit program — unpadded lengths would compile one program
+    per distinct tail-batch size; the geometric ladder bounds the set of
+    static shapes every stage kernel ever sees (batch.bucket_capacity)."""
     if not isinstance(a, np.ndarray):
         return a
-    from blaze_tpu.batch import round_capacity
-    cap = round_capacity(a.shape[0])
+    from blaze_tpu.batch import bucket_capacity
+    cap = bucket_capacity(a.shape[0])
     if cap == a.shape[0]:
         return a
     return np.pad(a, (0, cap - a.shape[0]))

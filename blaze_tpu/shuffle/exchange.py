@@ -11,10 +11,11 @@ frames, same index contract as the distributed deployment.
 from __future__ import annotations
 
 import os
-import struct
 import tempfile
 import uuid
 from typing import List, Optional
+
+import numpy as np
 
 from blaze_tpu.bridge.context import TaskContext, task_scope
 from blaze_tpu.bridge.resource import put_resource, remove_resource
@@ -27,12 +28,9 @@ from blaze_tpu.shuffle.writer import ShuffleWriterExec
 
 def read_index_file(path: str) -> List[int]:
     """Cumulative offsets (ref AuronShuffleWriterBase.scala:68-78)."""
-    out = []
     with open(path, "rb") as f:
         data = f.read()
-    for i in range(0, len(data), 8):
-        out.append(struct.unpack_from("<q", data, i)[0])
-    return out
+    return np.frombuffer(data, dtype="<i8").tolist()
 
 
 class LocalShuffleExchange(ExecutionPlan):

@@ -99,7 +99,13 @@ class IpcReaderExec(ExecutionPlan):
     def arrow_batches(self, partition: int):
         """Arrow-resident read: decoded IPC frames go straight to
         Arrow-resident consumers (the reduce-side host agg) without a
-        ColumnBatch round trip."""
+        ColumnBatch round trip.  Segment reads + IPC decode run on the
+        prefetch worker so reduce-side compute overlaps them
+        (kill-switch auron.tpu.io.prefetch)."""
+        from blaze_tpu.ops.base import prefetch
+        return prefetch(self._read_blocks(partition), name="ipc_reader")
+
+    def _read_blocks(self, partition: int):
         source = get_resource(self.resource_id)
         if source is None:
             raise KeyError(f"shuffle resource {self.resource_id!r} not found")

@@ -396,14 +396,19 @@ class ShuffleWriterExec(ExecutionPlan):
             # they arrive (compute/IO overlap, no staging hump)
             rep.open_stream(self.data_file)
             # sinks yield nothing, so the stream meter never sees rows;
-            # count what is written (rows in == rows shuffled out)
+            # count what is written (rows in == rows shuffled out).
+            # the child stream pulls on a prefetch worker so upstream
+            # compute overlaps this map task's partition/write IO
+            from blaze_tpu.ops.base import prefetch
             if arrow_native:
-                for rb in child.arrow_batches(partition):
+                for rb in prefetch(child.arrow_batches(partition),
+                                   name="shuffle_map"):
                     self.metrics.add("output_rows", rb.num_rows)
                     self.metrics.add("output_batches")
                     rep.insert_arrow(rb)
             else:
-                for batch in child.execute(partition):
+                for batch in prefetch(child.execute(partition),
+                                      name="shuffle_map"):
                     self.metrics.add("output_rows", batch.num_rows)
                     self.metrics.add("output_batches")
                     rep.insert_batch(batch)
