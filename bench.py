@@ -1268,7 +1268,113 @@ def _parquet_rows(path):
     return pq.ParquetFile(path).metadata.num_rows
 
 
+# ===========================================================================
+# --expr: eager-vs-fused expression microbenchmark (ISSUE 3)
+# ===========================================================================
+
+def expr_bench_main() -> int:
+    """Standalone whole-stage-expression microbenchmark (`--expr`).
+
+    One filter->project chain over a memory-resident table, run through
+    the SAME FilterProjectExec operator both ways: fused = the chain
+    compiled into one XLA program per batch (auron.tpu.expr.fuse=true),
+    eager = per-op kernel dispatch through CachedExprsEvaluator.  Sides
+    are warmed, then timed interleaved with min-of-samples (same noise
+    discipline as the e2e bench).  Writes BENCH_EXPR.json next to this
+    file and prints the record as one JSON line."""
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+    import numpy as np
+    import pyarrow as pa
+
+    from blaze_tpu import config
+    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.exprs import BinaryExpr, If, col, lit
+    from blaze_tpu.exprs.program import (clear_program_cache,
+                                         program_cache_info)
+    from blaze_tpu.ops import FilterProjectExec, MemoryScanExec
+
+    n = int(os.environ.get("BLAZE_BENCH_EXPR_ROWS", str(1 << 20)))
+    iters = int(os.environ.get("BLAZE_BENCH_EXPR_ITERS", "10"))
+    batch_rows = int(os.environ.get("BLAZE_BENCH_EXPR_BATCH", "65536"))
+    rng = np.random.default_rng(0)
+    tbl = pa.table({
+        "a": pa.array(rng.integers(-100, 100, n)),
+        "b": pa.array(rng.random(n) * 100),
+        "c": pa.array(rng.integers(0, 1 << 16, n)),
+    })
+    filters = [BinaryExpr(">", col(0), lit(-50)),
+               BinaryExpr("<", col(1), lit(90.0))]
+    projs = [col(0),
+             BinaryExpr("+", BinaryExpr("*", col(1), lit(2.0)), col(2)),
+             If(BinaryExpr(">=", col(0), lit(0)), col(1),
+                BinaryExpr("-", lit(0.0), col(1)))]
+    names = ["a", "bc", "abs_b"]
+
+    def run_once(fuse):
+        with config.scoped(**{"auron.tpu.expr.fuse": fuse}):
+            plan = FilterProjectExec(
+                MemoryScanExec.from_arrow(tbl, batch_rows=batch_rows),
+                filters, projs, names)
+            return plan.execute_collect().num_rows
+
+    clear_program_cache()
+    rows_fused = run_once(True)   # warm: builds + compiles the program
+    rows_eager = run_once(False)  # warm the eager kernels too
+    assert rows_fused == rows_eager, (rows_fused, rows_eager)
+    warm = xla_stats.snapshot()
+
+    walls = {"fused": [], "eager": []}
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run_once(True)
+        walls["fused"].append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_once(False)
+        walls["eager"].append(time.perf_counter() - t0)
+
+    d = xla_stats.delta(warm)
+    fused_s = float(np.min(walls["fused"]))
+    eager_s = float(np.min(walls["eager"]))
+    lookups = d["expr_programs_built"] + d["expr_program_cache_hits"]
+    steady_hit_rate = (d["expr_program_cache_hits"] / lookups
+                       if lookups else 0.0)
+    rec = {
+        "metric": "expr_fused_rows_per_sec",
+        "value": round(n / fused_s),
+        "unit": "rows/s",
+        "vs_eager": round(eager_s / fused_s, 3),
+        "rows": n,
+        "batch_rows": batch_rows,
+        "iters": iters,
+        "selected_rows": int(rows_fused),
+        "fused_wall_s": round(fused_s, 4),
+        "eager_wall_s": round(eager_s, 4),
+        "eager_rows_per_sec": round(n / eager_s),
+        "steady_state_recompiles": int(d["total_compiles"]),
+        "steady_programs_built": int(d["expr_programs_built"]),
+        "steady_cache_hit_rate": round(steady_hit_rate, 3),
+        "fused_batches": int(d["expr_fused_batches"]),
+        "eager_batches": int(d["expr_eager_batches"]),
+        "program_cache": program_cache_info(),
+        "expr_stats": xla_stats.expr_stats(),
+    }
+    path = os.environ.get(
+        "BLAZE_BENCH_EXPR_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_EXPR.json"))
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, default=str)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+    return 0
+
+
 def main():
+    if "--expr" in sys.argv:
+        sys.exit(expr_bench_main())
     if "--child" in sys.argv:
         try:
             child_main()

@@ -48,8 +48,9 @@ class NativeExecutionRuntime:
             num_partitions=td.get("num_partitions", 1),
             task_attempt_id=td.get("task_attempt_id", 0))
         from blaze_tpu.plan.column_pruning import prune_columns
-        self.plan = fuse_plan(prune_columns(
-            plan if plan is not None else create_plan(td["plan"])))
+        from blaze_tpu.plan.planner import collapse_filter_project
+        self.plan = fuse_plan(prune_columns(collapse_filter_project(
+            plan if plan is not None else create_plan(td["plan"]))))
         depth = max(1, config.INPUT_BATCH_PREFETCH.get())
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._error: Optional[BaseException] = None

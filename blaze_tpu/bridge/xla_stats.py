@@ -41,6 +41,16 @@ _pipeline = {"bucket_batches": 0, "bucket_pad_rows": 0,
              "prefetch_waits": 0}
 _bucket_caps: set = set()
 
+# Whole-stage expression-program accounting (exprs/program.py).  Programs
+# are keyed by expression FINGERPRINT, not callable identity: every
+# partition-local evaluator instance resolves to the ONE process-wide
+# metered callable per fingerprint, so per-partition instances cannot
+# report false recompiles (each jit cache — and its compile counters
+# above — is shared through the program cache).
+_exprs = {"expr_programs_built": 0, "expr_program_cache_hits": 0,
+          "expr_program_evictions": 0,
+          "expr_fused_batches": 0, "expr_eager_batches": 0}
+
 # Distinct signatures beyond this on one kernel = shape churn (the
 # recompilation-storm smell: unpadded dynamic shapes hitting jit).
 SHAPE_CHURN_THRESHOLD = 8
@@ -156,6 +166,38 @@ def note_prefetch(batches: int = 0, wait_ns: int = 0) -> None:
             _pipeline["prefetch_waits"] += 1
 
 
+def note_expr_program(built: bool = False, cache_hit: bool = False,
+                      evicted: bool = False) -> None:
+    """One program-cache resolution (exprs/program.py get_program)."""
+    with _lock:
+        if built:
+            _exprs["expr_programs_built"] += 1
+        if cache_hit:
+            _exprs["expr_program_cache_hits"] += 1
+        if evicted:
+            _exprs["expr_program_evictions"] += 1
+
+
+def note_expr_dispatch(fused: int = 0, eager: int = 0) -> None:
+    """Per-batch dispatch accounting: `fused` batches went through a
+    compiled expression program, `eager` fell back to the interpreted
+    evaluator (host-only exprs, ANSI mode, non-device columns)."""
+    with _lock:
+        _exprs["expr_fused_batches"] += int(fused)
+        _exprs["expr_eager_batches"] += int(eager)
+
+
+def expr_stats() -> dict:
+    """Expression-program counters; `expr_cache_hit_rate` is hits over
+    cache resolutions (the recompile-guard's steady-state signal)."""
+    with _lock:
+        d = dict(_exprs)
+    lookups = d["expr_programs_built"] + d["expr_program_cache_hits"]
+    d["expr_cache_hit_rate"] = (
+        d["expr_program_cache_hits"] / lookups if lookups else 0.0)
+    return d
+
+
 def pipeline_stats() -> dict:
     """Bucket + prefetch counters; `bucket_capacities` is the distinct
     ladder rungs observed (the static-shape universe jit kernels see)."""
@@ -201,6 +243,9 @@ def snapshot() -> dict:
     ps = pipeline_stats()
     ps.pop("bucket_capacities", None)  # list: not delta-able
     flat.update(ps)
+    es = expr_stats()
+    es.pop("expr_cache_hit_rate", None)  # ratio: not delta-able
+    flat.update(es)
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -218,4 +263,6 @@ def reset() -> None:
             _transfers[k] = 0
         for k in _pipeline:
             _pipeline[k] = 0
+        for k in _exprs:
+            _exprs[k] = 0
         _bucket_caps.clear()

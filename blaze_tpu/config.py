@@ -411,6 +411,40 @@ HOST_TASK_PARALLELISM = int_conf(
     "Python-orchestrated around intra-op-parallel C++ kernels, so serial "
     "tasks with all cores inside each kernel beat GIL-contended task "
     "concurrency (the TASK_CPUS analog for the host path).")
+EXPR_FUSE = bool_conf(
+    "auron.tpu.expr.fuse", True,
+    "Whole-stage expression compilation (exprs/program.py): lower each "
+    "Filter/Project/FilterProject expression chain into ONE jit'd XLA "
+    "program — mask computation, selection and projection fused — cached "
+    "process-wide by expression fingerprint so repeated queries and all "
+    "partitions share the compiled executable.  Host-only expressions "
+    "(strings, UDFs, decimals, ANSI mode) fall back to the eager "
+    "evaluator automatically; this is the kill-switch.")
+EXPR_CACHE_SIZE = int_conf(
+    "auron.tpu.expr.cache.size", 256,
+    "Bounded LRU capacity of the cross-query expression-program cache "
+    "(distinct (fingerprint, dtype-signature) entries; each entry also "
+    "holds jit's per-bucket-capacity executables).")
+EXPR_DONATE = bool_conf(
+    "auron.tpu.expr.donate", False,
+    "Donate input buffers to fused expression programs "
+    "(jit donate_argnums) so XLA may reuse them in place.  Off by "
+    "default: filter output batches alias their input columns and "
+    "memory scans re-yield the same buffers across executes, so "
+    "donation is only safe when the producer guarantees single-use "
+    "batches.")
+EXPR_CONST_FOLD = bool_conf(
+    "auron.tpu.expr.constFold", True,
+    "Fold literal-only subexpressions (lit(2)*lit(3), casts of "
+    "literals) to a single Literal at plan-decode time (exprs/fold.py) "
+    "— smaller traced programs and stabler program fingerprints.")
+COLLAPSE_FILTER_PROJECT = bool_conf(
+    "auron.tpu.plan.collapseFilterProject", True,
+    "Planner rewrite (plan/planner.py collapse_filter_project): merge "
+    "adjacent Filter->Project chains into one FilterProjectExec and "
+    "Project->Project into a single Project by substituting bound "
+    "references, so the fused expression program sees the whole chain "
+    "as one XLA-compiled stage.")
 CASE_SENSITIVE = bool_conf("spark.sql.caseSensitive", False, "Column name matching.")
 ANSI_ENABLED = bool_conf(
     "spark.sql.ansi.enabled", False,

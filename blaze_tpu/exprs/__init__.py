@@ -7,6 +7,9 @@ from blaze_tpu.exprs.cast import Cast, TryCast
 from blaze_tpu.exprs.conditional import (CaseWhen, Coalesce, If, InList,
                                          IsNotNull, IsNull, Not)
 from blaze_tpu.exprs.evaluator import CachedExprsEvaluator, split_conjuncts
+from blaze_tpu.exprs.fold import fold_constants, fold_node
+from blaze_tpu.exprs.program import (FusedExprsEvaluator, fused_filter,
+                                     is_traceable)
 from blaze_tpu.exprs.special import (BloomFilterMightContain, GetIndexedField,
                                      GetMapValue, MonotonicallyIncreasingId,
                                      NamedStruct, Rand, RowNum,
@@ -21,6 +24,8 @@ __all__ = [
     "Cast", "TryCast",
     "CaseWhen", "Coalesce", "If", "InList", "IsNotNull", "IsNull", "Not",
     "CachedExprsEvaluator", "split_conjuncts",
+    "FusedExprsEvaluator", "fused_filter", "is_traceable",
+    "fold_constants", "fold_node",
     "BloomFilterMightContain", "GetIndexedField", "GetMapValue",
     "MonotonicallyIncreasingId", "NamedStruct", "Rand", "RowNum",
     "ScalarSubqueryWrapper", "SparkPartitionId", "UDFWrapper",

@@ -66,7 +66,9 @@ def run_report(scale: float, partitions: int, names=None,
                                        query_id=f"itest-{qname}")
                 exec_mode = prof.exec_mode
             else:
-                plan = fuse_plan(create_plan(plan_dict))
+                from blaze_tpu.plan.planner import collapse_filter_project
+                plan = fuse_plan(collapse_filter_project(
+                    create_plan(plan_dict)))
                 prof = explain_analyze(plan, keep_result=True,
                                        query_id=f"itest-{qname}")
                 exec_mode = "in-process"

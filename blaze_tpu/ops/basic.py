@@ -12,7 +12,8 @@ from __future__ import annotations
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 from blaze_tpu.batch import ColumnBatch
-from blaze_tpu.exprs import CachedExprsEvaluator, PhysicalExpr
+from blaze_tpu.exprs import (CachedExprsEvaluator, FusedExprsEvaluator,
+                             PhysicalExpr)
 from blaze_tpu.ops.base import BatchIterator, CoalesceStream, ExecutionPlan
 from blaze_tpu.schema import Field, Schema
 
@@ -30,7 +31,10 @@ class FilterExec(ExecutionPlan):
         return self.children[0].schema
 
     def execute(self, partition: int) -> BatchIterator:
-        ev = CachedExprsEvaluator(filters=self._predicates)
+        # per-partition instance, but the compiled program behind it is
+        # resolved from the process-wide fingerprint cache (exprs/program)
+        ev = FusedExprsEvaluator(filters=self._predicates,
+                                 in_schema=self.schema)
         def gen():
             for batch in self.children[0].execute(partition):
                 yield ev.filter(batch)
@@ -55,7 +59,8 @@ class ProjectExec(ExecutionPlan):
         return self._out_schema
 
     def execute(self, partition: int) -> BatchIterator:
-        ev = CachedExprsEvaluator(projections=self._exprs)
+        ev = FusedExprsEvaluator(projections=self._exprs,
+                                 in_schema=self.children[0].schema)
         out_schema = self.schema
         for batch in self.children[0].execute(partition):
             yield ev.project(batch, out_schema)
@@ -83,8 +88,9 @@ class FilterProjectExec(ExecutionPlan):
         return self._out_schema
 
     def execute(self, partition: int) -> BatchIterator:
-        ev = CachedExprsEvaluator(filters=self._predicates,
-                                  projections=self._exprs)
+        ev = FusedExprsEvaluator(filters=self._predicates,
+                                 projections=self._exprs,
+                                 in_schema=self.children[0].schema)
         out_schema = self.schema
         def gen():
             for batch in self.children[0].execute(partition):

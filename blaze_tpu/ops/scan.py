@@ -199,9 +199,29 @@ class ParquetScanExec(ExecutionPlan):
         # overlap downstream compute (double-buffering; kill-switch
         # auron.tpu.io.prefetch)
         from blaze_tpu.ops.base import prefetch
+        transform = ColumnBatch.from_arrow
+        post = self._post_decode_filter()
+        if post is not None:
+            def transform(rb, _post=post):
+                return _post(ColumnBatch.from_arrow(rb))
         return prefetch(self._decode_batches(partition),
-                        transform=ColumnBatch.from_arrow,
+                        transform=transform,
                         name="parquet_scan")
+
+    def _post_decode_filter(self):
+        """Scan-embedded filtering: when the pushdown predicate is fully
+        traceable, the fused filter program ANDs its exact row mask into
+        each decoded batch ON THE PREFETCH WORKER — the mask computation
+        overlaps downstream compute, and the Filter operator above (which
+        evaluates the same conjuncts) re-ANDs an identical mask.  Only
+        applies when the output schema is the file schema (the predicate
+        is bound against file-column ordinals; projections / partition
+        columns reorder the space)."""
+        if self._predicate is None or self._projection is not None \
+                or self._partition_schema is not None:
+            return None
+        from blaze_tpu.exprs.program import fused_filter
+        return fused_filter([self._predicate], self._schema)
 
     def arrow_batches(self, partition: int, extra_prune=None):
         """Prefetched Arrow-resident scan stream (see _decode_batches)."""

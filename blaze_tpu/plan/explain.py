@@ -135,6 +135,19 @@ class QueryProfile:
                 f"prefetch: batches={x.get('prefetch_batches', 0)} "
                 f"consumer_wait={_fmt_ns(x.get('prefetch_wait_ns', 0))} "
                 f"({x.get('prefetch_waits', 0)} waits)")
+        if (x.get("expr_fused_batches") or x.get("expr_eager_batches")
+                or x.get("expr_programs_built")):
+            looked_up = (x.get("expr_programs_built", 0)
+                         + x.get("expr_program_cache_hits", 0))
+            rate = (x.get("expr_program_cache_hits", 0) / looked_up
+                    if looked_up else 0.0)
+            lines.append(
+                f"expr programs: built={x.get('expr_programs_built', 0)} "
+                f"cache_hits={x.get('expr_program_cache_hits', 0)} "
+                f"(hit_rate={rate:.2f}) "
+                f"fused_batches={x.get('expr_fused_batches', 0)} "
+                f"eager_batches={x.get('expr_eager_batches', 0)} "
+                f"evictions={x.get('expr_program_evictions', 0)}")
         return "\n".join(lines)
 
     def __str__(self) -> str:

@@ -32,6 +32,17 @@ from blaze_tpu.schema import Schema
 
 def expr_from_dict(d: Dict[str, Any], schema: Optional[Schema] = None
                    ) -> PhysicalExpr:
+    """Decode one expression node, then constant-fold it if every child
+    is a Literal.  Recursive child decodes come back through this
+    wrapper, so folding a single node here yields full bottom-up
+    folding across the tree (exprs/fold.py, auron.tpu.expr.constFold)."""
+    from blaze_tpu.exprs.fold import fold_node
+    e = _expr_from_dict(d, schema)
+    return fold_node(e, schema)
+
+
+def _expr_from_dict(d: Dict[str, Any], schema: Optional[Schema] = None
+                    ) -> PhysicalExpr:
     k = d["kind"]
     if k == "column":
         idx = d.get("index")

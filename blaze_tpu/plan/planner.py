@@ -273,6 +273,70 @@ def create_plan(d: Dict[str, Any]) -> ExecutionPlan:
     raise ValueError(f"unknown plan node kind {k!r}")
 
 
+def collapse_filter_project(node: ExecutionPlan) -> ExecutionPlan:
+    """Planner rewrite: merge adjacent Filter->Project chains into one
+    `FilterProjectExec`, and Project->Project into a single Project by
+    substituting the inner projections into the outer's bound references
+    — so the whole-stage expression compiler (exprs/program.py) traces
+    the full chain as ONE XLA program instead of one per operator.
+
+    Runs before prune_columns/fuse_plan in the runtime rewrite chains
+    (both passes already understand FilterProjectExec).  Stateful inner
+    expressions are never substituted (duplication would re-evaluate
+    them); collapse simply stops at those nodes."""
+    from blaze_tpu import config
+    if not config.COLLAPSE_FILTER_PROJECT.get():
+        return node
+    return _collapse(node)
+
+
+def _collapse(node: ExecutionPlan) -> ExecutionPlan:
+    kids = node.children
+    for i, c in enumerate(kids):
+        kids[i] = _collapse(c)
+    if isinstance(node, ProjectExec):
+        child = node.children[0]
+        if isinstance(child, FilterExec):
+            return FilterProjectExec(child.children[0], child._predicates,
+                                     node._exprs, node._names)
+        if isinstance(child, ProjectExec):
+            merged = _substitute_all(node._exprs, child._exprs)
+            if merged is not None:
+                return ProjectExec(child.children[0], merged, node._names)
+    return node
+
+
+#: Pure expression classes safe to duplicate/re-evaluate when an inner
+#: projection substitutes into several outer references.  Stateful or
+#: context-reading exprs (Rand, RowNum, UDFs, subqueries, scalar
+#: functions...) are deliberately absent: substitution bails.
+def _pure(e) -> bool:
+    from blaze_tpu.exprs import (BinaryExpr, BoundReference, CaseWhen, Cast,
+                                 Coalesce, If, InList, IsNotNull, IsNull,
+                                 Like, Literal, Not, RLike, StringPredicate)
+    ok = (BoundReference, Literal, BinaryExpr, Not, IsNull, IsNotNull, If,
+          CaseWhen, Coalesce, InList, Cast, Like, RLike, StringPredicate)
+    return isinstance(e, ok) and all(_pure(c) for c in e.children())
+
+
+def _substitute_all(outer, inner):
+    """outer exprs rewritten over inner's input, or None to bail."""
+    if not all(_pure(e) for e in inner):
+        return None
+    from blaze_tpu.exprs.fold import map_exprs
+    from blaze_tpu.exprs import BoundReference
+
+    def subst(e):
+        if isinstance(e, BoundReference):
+            return inner[e.index]
+        return map_exprs(e, subst)
+
+    try:
+        return [subst(e) for e in outer]
+    except (TypeError, IndexError):
+        return None
+
+
 def _sink_path(d: Dict[str, Any]) -> str:
     """Sinks address their output through either a direct path or a
     host-registered FS resource (ref NativeParquetSinkUtils via the JVM

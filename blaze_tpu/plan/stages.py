@@ -322,8 +322,10 @@ class DagScheduler:
         from blaze_tpu.plan import create_plan
         from blaze_tpu.plan.column_pruning import prune_columns
         from blaze_tpu.plan.fused import fuse_plan
+        from blaze_tpu.plan.planner import collapse_filter_project
 
-        node = fuse_plan(prune_columns(create_plan(plan)))
+        node = fuse_plan(prune_columns(
+            collapse_filter_project(create_plan(plan))))
         out = node.execute_collect().to_arrow()
         self._record_task_metrics(0, node.collect_metrics())
         if isinstance(out, pa.RecordBatch):

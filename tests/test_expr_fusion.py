@@ -1,0 +1,316 @@
+"""Whole-stage expression compilation: fused-vs-eager parity, fallback
+rules, the process-wide program cache, constant folding, and the planner
+Filter->Project collapse (ISSUE 3)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import config
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.bridge import xla_stats
+from blaze_tpu.exprs import (BinaryExpr, CachedExprsEvaluator, Cast,
+                             Coalesce, FusedExprsEvaluator, If, InList,
+                             IsNull, Like, Literal, Not, col,
+                             fold_constants, fold_node, fused_filter,
+                             is_traceable, lit)
+from blaze_tpu.exprs.program import (clear_program_cache, get_program,
+                                     program_cache_info)
+from blaze_tpu.exprs.special import Rand
+from blaze_tpu.ops import (FilterExec, FilterProjectExec, MemoryScanExec,
+                           ProjectExec)
+from blaze_tpu.plan.planner import collapse_filter_project
+from blaze_tpu.schema import DataType, Field, Schema, TypeId
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    clear_program_cache()
+    yield
+    clear_program_cache()
+
+
+def _table(n=500, seed=0, nulls=False):
+    rng = np.random.default_rng(seed)
+    a = rng.integers(-50, 50, n)
+    b = rng.random(n) * 100 - 50
+    c = rng.integers(0, 1000, n).astype(np.int32)
+    if not nulls:
+        return pa.table({"a": pa.array(a), "b": pa.array(b),
+                         "c": pa.array(c)})
+    mask = rng.random(n) < 0.25
+    return pa.table({
+        "a": pa.array([None if m else int(v) for m, v in zip(mask, a)],
+                      pa.int64()),
+        "b": pa.array([None if m else float(v)
+                       for m, v in zip(np.roll(mask, 7), b)], pa.float64()),
+        "c": pa.array(c),
+    })
+
+
+def _out_schema(projections, in_schema):
+    return Schema([Field(f"o{i}", e.data_type(in_schema))
+                   for i, e in enumerate(projections)])
+
+
+def _parity_fp(tbl, filters, projections):
+    """Run the chain fused and eager; both must be row-identical."""
+    batch = ColumnBatch.from_arrow(tbl)
+    in_schema = batch.schema
+    out_schema = _out_schema(projections, in_schema)
+    fused = FusedExprsEvaluator(filters=filters, projections=projections,
+                                in_schema=in_schema)
+    eager = CachedExprsEvaluator(filters=filters, projections=projections)
+    got = fused.filter_project(batch, out_schema).compact().to_arrow()
+    want = eager.filter_project(batch, out_schema).compact().to_arrow()
+    assert got.num_rows == want.num_rows
+    for i in range(want.num_columns):
+        assert got.column(i).equals(want.column(i)), \
+            f"col {i}: {got.column(i)} != {want.column(i)}"
+    return got
+
+
+# ---------------------------------------------------------------------------
+# parity
+# ---------------------------------------------------------------------------
+
+def test_filter_parity_3vl_nulls():
+    # NULL > 5 is NULL -> row excluded; OR keeps TRUE when one side NULL
+    tbl = _table(nulls=True)
+    pred = BinaryExpr("or",
+                      BinaryExpr(">", col(0), lit(5)),
+                      BinaryExpr("and",
+                                 BinaryExpr("<", col(1), lit(-10.0)),
+                                 Not(IsNull(col(0)))))
+    _parity_fp(tbl, [pred], [col(0), col(1)])
+
+
+def test_project_parity_dtype_promotion():
+    # int32 + int64 and int64 * float64 promotions inside one program
+    tbl = _table()
+    projs = [BinaryExpr("+", col(2), col(0)),
+             BinaryExpr("*", col(0), col(1)),
+             Cast(col(2), DataType(TypeId.FLOAT64))]
+    _parity_fp(tbl, [], projs)
+
+
+def test_filter_project_parity_conditionals():
+    tbl = _table(nulls=True)
+    pred = InList(col(2), tuple(range(0, 1000, 3)))
+    projs = [If(BinaryExpr(">", col(0), lit(0)), col(1), lit(0.0)),
+             Coalesce((col(0), lit(-1)))]
+    got = _parity_fp(tbl, [pred], projs)
+    assert got.num_rows > 0  # the parity must not be vacuous
+
+
+def test_empty_batch():
+    tbl = pa.table({"a": pa.array([], pa.int64()),
+                    "b": pa.array([], pa.float64()),
+                    "c": pa.array([], pa.int32())})
+    got = _parity_fp(tbl, [BinaryExpr(">", col(0), lit(0))], [col(1)])
+    assert got.num_rows == 0
+
+
+def test_bucket_boundary_sizes():
+    # sizes straddling capacity rungs: pad-to-bucket must not leak
+    # padding rows into results, and resizing must not change rows
+    for n in (1, 127, 128, 129, 500):
+        tbl = _table(n=n, seed=n)
+        _parity_fp(tbl, [BinaryExpr(">=", col(0), lit(0))],
+                   [BinaryExpr("+", col(1), lit(1.0))])
+
+
+# ---------------------------------------------------------------------------
+# fallback rules
+# ---------------------------------------------------------------------------
+
+def test_string_predicate_falls_back_eager():
+    tbl = pa.table({"s": pa.array([f"id_{i % 7}" for i in range(64)])})
+    batch = ColumnBatch.from_arrow(tbl)
+    pred = Like(col(0), "id_1%")
+    assert not is_traceable(pred, batch.schema)
+    before = xla_stats.expr_stats()["expr_eager_batches"]
+    ev = FusedExprsEvaluator(filters=[pred], in_schema=batch.schema)
+    out = ev.filter(batch)
+    assert xla_stats.expr_stats()["expr_eager_batches"] == before + 1
+    assert out.compact().to_arrow().num_rows == \
+        sum(1 for i in range(64) if i % 7 == 1)
+
+
+def test_literal_only_filter_stays_eager():
+    # no column refs -> the jit would have no array argument; stays eager
+    tbl = _table(64)
+    batch = ColumnBatch.from_arrow(tbl)
+    ev = FusedExprsEvaluator(filters=[BinaryExpr(">", lit(2), lit(1))],
+                             in_schema=batch.schema)
+    assert ev._filter_prog is None and ev._fp_prog is None
+    assert ev.filter(batch).selected_count() == 64
+
+
+def test_fuse_config_off():
+    tbl = _table(64)
+    batch = ColumnBatch.from_arrow(tbl)
+    with config.scoped(**{"auron.tpu.expr.fuse": False}):
+        ev = FusedExprsEvaluator(filters=[BinaryExpr(">", col(0), lit(0))],
+                                 in_schema=batch.schema)
+        assert ev._filter_prog is None
+        out = ev.filter(batch)
+    want = CachedExprsEvaluator(
+        filters=[BinaryExpr(">", col(0), lit(0))]).filter(batch)
+    assert out.selected_count() == want.selected_count()
+
+
+def test_mixed_chain_fuses_filter_only():
+    # traceable filter + host-only projection: fused mask, eager project
+    tbl = pa.table({"a": pa.array(range(100), pa.int64()),
+                    "s": pa.array([f"x{i}" for i in range(100)])})
+    batch = ColumnBatch.from_arrow(tbl)
+    filters = [BinaryExpr(">", col(0), lit(49))]
+    projs = [col(0), col(1)]
+    ev = FusedExprsEvaluator(filters=filters, projections=projs,
+                             in_schema=batch.schema)
+    assert ev._fp_prog is None and ev._filter_prog is not None
+    out_schema = _out_schema(projs, batch.schema)
+    got = ev.filter_project(batch, out_schema).compact().to_arrow()
+    assert got.column(1).to_pylist() == [f"x{i}" for i in range(50, 100)]
+
+
+# ---------------------------------------------------------------------------
+# the program cache
+# ---------------------------------------------------------------------------
+
+def test_cache_shared_across_evaluator_instances():
+    tbl = _table(64)
+    sch = ColumnBatch.from_arrow(tbl).schema
+    filters = [BinaryExpr(">", col(0), lit(0))]
+    before = xla_stats.expr_stats()
+    ev1 = FusedExprsEvaluator(filters=filters, in_schema=sch)
+    ev2 = FusedExprsEvaluator(filters=filters, in_schema=sch)
+    after = xla_stats.expr_stats()
+    assert after["expr_programs_built"] - before["expr_programs_built"] == 1
+    assert after["expr_program_cache_hits"] - \
+        before["expr_program_cache_hits"] == 1
+    assert ev1._filter_prog is ev2._filter_prog
+
+
+def test_cache_lru_eviction():
+    sch = Schema([Field("a", DataType(TypeId.INT64))])
+    before = xla_stats.expr_stats()["expr_program_evictions"]
+    with config.scoped(**{"auron.tpu.expr.cache.size": 2}):
+        for k in range(4):
+            get_program("filter", [BinaryExpr(">", col(0), lit(k))], (), sch)
+    assert program_cache_info()["size"] == 2
+    assert xla_stats.expr_stats()["expr_program_evictions"] == before + 2
+
+
+def test_fingerprint_distinguishes_dtype_signature():
+    f64 = Schema([Field("a", DataType(TypeId.FLOAT64))])
+    i64 = Schema([Field("a", DataType(TypeId.INT64))])
+    filters = [BinaryExpr(">", col(0), lit(0))]
+    p1 = get_program("filter", filters, (), f64)
+    p2 = get_program("filter", filters, (), i64)
+    assert p1 is not p2 and p1.name != p2.name
+
+
+# ---------------------------------------------------------------------------
+# scan-embedded filtering
+# ---------------------------------------------------------------------------
+
+def test_fused_filter_for_scan():
+    tbl = _table(200, seed=3)
+    batch = ColumnBatch.from_arrow(tbl)
+    pred = BinaryExpr("and", BinaryExpr(">", col(0), lit(0)),
+                      BinaryExpr("<", col(1), lit(25.0)))
+    apply = fused_filter([pred], batch.schema)
+    assert apply is not None
+    got = apply(batch).compact().to_arrow()
+    want = CachedExprsEvaluator(filters=[pred]).filter(
+        batch).compact().to_arrow()
+    assert got.num_rows == want.num_rows
+    assert got.column(0).equals(want.column(0))
+    # host-only predicate: scan must decline and defer to the operator
+    stbl = pa.table({"s": pa.array(["a", "b"])})
+    sbatch = ColumnBatch.from_arrow(stbl)
+    assert fused_filter([Like(col(0), "a%")], sbatch.schema) is None
+
+
+# ---------------------------------------------------------------------------
+# constant folding
+# ---------------------------------------------------------------------------
+
+def test_fold_constants_arithmetic():
+    sch = Schema([Field("a", DataType(TypeId.INT64))])
+    e = BinaryExpr(">", col(0),
+                   BinaryExpr("*", lit(5), BinaryExpr("+", lit(4), lit(6))))
+    folded = fold_constants(e, sch)
+    assert isinstance(folded.right, Literal) and folded.right.value == 50
+    assert isinstance(folded.left, type(col(0)))
+
+
+def test_fold_preserves_null_semantics():
+    sch = Schema([Field("a", DataType(TypeId.INT64))])
+    e = BinaryExpr("+", lit(1), Literal(None, DataType(TypeId.INT64)))
+    folded = fold_node(e, sch)
+    assert isinstance(folded, Literal) and folded.value is None
+
+
+def test_fold_config_off():
+    sch = Schema([Field("a", DataType(TypeId.INT64))])
+    e = BinaryExpr("+", lit(1), lit(2))
+    with config.scoped(**{"auron.tpu.expr.constFold": False}):
+        assert not isinstance(fold_node(e, sch), Literal)
+    assert fold_node(e, sch).value == 3
+
+
+# ---------------------------------------------------------------------------
+# planner collapse
+# ---------------------------------------------------------------------------
+
+def _scan(tbl, **kw):
+    return MemoryScanExec.from_arrow(tbl, **kw)
+
+
+def test_collapse_filter_then_project():
+    tbl = _table(300)
+    plan = ProjectExec(
+        FilterExec(_scan(tbl), [BinaryExpr(">", col(0), lit(0))]),
+        [BinaryExpr("*", col(1), lit(2.0))], ["b2"])
+    want = plan.execute_collect().to_arrow()
+    collapsed = collapse_filter_project(plan)
+    assert isinstance(collapsed, FilterProjectExec)
+    got = collapsed.execute_collect().to_arrow()
+    assert got.num_rows == want.num_rows
+    assert np.allclose(np.sort(got.column(0).to_numpy()),
+                       np.sort(want.column(0).to_numpy()))
+
+
+def test_collapse_project_project():
+    tbl = _table(300)
+    inner = ProjectExec(_scan(tbl),
+                        [BinaryExpr("+", col(0), col(0)), col(1)],
+                        ["a2", "b"])
+    plan = ProjectExec(inner, [BinaryExpr("*", col(0), lit(3))], ["a6"])
+    want = plan.execute_collect().to_arrow()
+    collapsed = collapse_filter_project(plan)
+    assert isinstance(collapsed, ProjectExec)
+    assert not isinstance(collapsed.children[0], ProjectExec)
+    got = collapsed.execute_collect().to_arrow()
+    assert got.column(0).to_pylist() == want.column(0).to_pylist()
+
+
+def test_collapse_bails_on_stateful_inner():
+    # Rand duplicated through substitution would re-roll: must not merge
+    tbl = _table(100)
+    inner = ProjectExec(_scan(tbl), [Rand(seed=7), col(1)], ["r", "b"])
+    plan = ProjectExec(inner, [BinaryExpr("+", col(0), col(0))], ["r2"])
+    collapsed = collapse_filter_project(plan)
+    assert isinstance(collapsed.children[0], ProjectExec)
+
+
+def test_collapse_config_off():
+    tbl = _table(100)
+    plan = ProjectExec(
+        FilterExec(_scan(tbl), [BinaryExpr(">", col(0), lit(0))]),
+        [col(1)], ["b"])
+    with config.scoped(**{"auron.tpu.plan.collapseFilterProject": False}):
+        assert collapse_filter_project(plan) is plan

@@ -221,7 +221,8 @@ def test_explain_analyze_in_process_plan():
     assert prof.output_rows == 50
     assert prof.result.num_rows == 50
     text = prof.render_text()
-    for op in ("ProjectExec", "FilterExec", "MemoryScanExec"):
+    # the planner collapses Filter->Project into one FilterProjectExec
+    for op in ("FilterProjectExec", "MemoryScanExec"):
         assert op in text
     assert "XLA:" in text and "transfers:" in text
 
@@ -310,8 +311,9 @@ def test_explain_analyze_staged_acceptance(tmp_path, staged_mode):
     # the shuffle split is stitched back: the full operator chain shows
     # in ONE tree, scan at the leaf
     text = prof.render_text()
-    for op in ("IpcReaderExec", "ShuffleWriterExec", "ProjectExec",
-               "FilterExec", "ParquetScanExec"):
+    # Filter->Project arrives collapsed to one FilterProjectExec node
+    for op in ("IpcReaderExec", "ShuffleWriterExec", "FilterProjectExec",
+               "ParquetScanExec"):
         assert op in text, text
 
     def every_node(n):

@@ -1,0 +1,83 @@
+"""Steady-state compilation guard (ISSUE 3 acceptance): a repeated
+filter->project query must run warm with ZERO XLA recompiles and an expr
+program cache hit rate >= 0.9 — per-partition evaluator instances and
+repeated runs must all resolve to the one fingerprint-keyed program."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.bridge import xla_stats
+from blaze_tpu.exprs import BinaryExpr, col, lit
+from blaze_tpu.exprs.program import clear_program_cache
+from blaze_tpu.ops import FilterProjectExec, MemoryScanExec
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    clear_program_cache()
+    yield
+    clear_program_cache()
+
+
+def _plan(tbl, partitions=1):
+    scan = MemoryScanExec.from_arrow(tbl, num_partitions=partitions,
+                                     batch_rows=256)
+    return FilterProjectExec(
+        scan,
+        [BinaryExpr(">", col(0), lit(0)),
+         BinaryExpr("<", col(1), lit(40.0))],
+        [col(0), BinaryExpr("*", col(1), lit(2.0)),
+         BinaryExpr("+", col(0), col(0))],
+        ["a", "b2", "a2"])
+
+
+def _table(n=2048, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({"a": pa.array(rng.integers(-50, 50, n)),
+                     "b": pa.array(rng.random(n) * 100)})
+
+
+def test_steady_state_zero_recompiles():
+    tbl = _table()
+    _plan(tbl).execute_collect()  # warm-up: builds + compiles the program
+    before = xla_stats.snapshot()
+    for run in range(10):
+        out = _plan(tbl).execute_collect()
+        assert out.num_rows > 0
+    d = xla_stats.delta(before)
+    assert d["total_compiles"] == 0, \
+        f"steady-state recompiles: {d['total_compiles']}"
+    assert d["expr_programs_built"] == 0
+    # every steady-state run is a cache hit: 10/10
+    looked_up = d["expr_programs_built"] + d["expr_program_cache_hits"]
+    hit_rate = d["expr_program_cache_hits"] / looked_up if looked_up else 0.0
+    assert hit_rate >= 0.9, f"expr cache hit rate {hit_rate:.2f} < 0.9"
+    # and every batch dispatched through the fused program, none eagerly
+    assert d["expr_fused_batches"] > 0
+    assert d["expr_eager_batches"] == 0
+
+
+def test_partitions_share_one_program():
+    # satellite: per-partition evaluator instances must meter under ONE
+    # kernel name — no false per-partition recompiles
+    tbl = _table(4096, seed=1)
+    plan = _plan(tbl, partitions=4)
+    before = xla_stats.snapshot()
+    plan.execute_collect()
+    d = xla_stats.delta(before)
+    assert d["expr_programs_built"] == 1
+    assert d["expr_program_cache_hits"] >= 3  # partitions 2..4
+    assert d["total_compiles"] <= 1, \
+        f"per-partition recompiles detected: {d['total_compiles']}"
+
+
+def test_cross_query_program_reuse():
+    # two distinct scans, same expression chain + dtypes: the second
+    # query reuses the first's compiled program without any compile
+    _plan(_table(seed=2)).execute_collect()
+    before = xla_stats.snapshot()
+    _plan(_table(seed=3)).execute_collect()
+    d = xla_stats.delta(before)
+    assert d["expr_programs_built"] == 0
+    assert d["total_compiles"] == 0
