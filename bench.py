@@ -1372,9 +1372,139 @@ def expr_bench_main() -> int:
     return 0
 
 
+# ===========================================================================
+# --chaos: fault-injection soak over the itest corpus (ISSUE 4)
+# ===========================================================================
+
+def chaos_bench_main() -> int:
+    """Chaos soak (`--chaos`): run the itest queries through the staged
+    DAG scheduler twice — once fault-free for the baseline, once with a
+    seeded fault-injection script (task failures, fetch failures, frame
+    corruption) — and assert ZERO divergence between the two result
+    sets.  The point is the acceptance criterion of the fault-tolerance
+    work: injected failures cost retries and recovery rounds, never
+    wrong answers.  Writes BENCH_CHAOS.json and prints it as one JSON
+    line."""
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+    import tempfile
+
+    from blaze_tpu import config, faults
+    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.itest import generate
+    from blaze_tpu.itest.queries import QUERIES
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.itest.tpcds_data import write_parquet_splits
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.plan.stages import DagScheduler
+
+    seed = int(os.environ.get("BLAZE_BENCH_CHAOS_SEED", "1234"))
+    names = os.environ.get("BLAZE_BENCH_CHAOS_QUERIES",
+                           "q01,q06,q95").split(",")
+    scale = float(os.environ.get("BLAZE_BENCH_CHAOS_SCALE", "0.2"))
+    rules = os.environ.get(
+        "BLAZE_BENCH_CHAOS_RULES",
+        "task-start=0.15,shuffle-read=0.08,"
+        "shuffle-write=0.05:corrupt,ipc-decode=0.05")
+
+    MemManager.init(4 << 30)
+    # force the staged wire path (a chaos run over the AQE local mode
+    # would never touch shuffle files), keep retries fast, and give the
+    # scripted failure rates enough budget to always converge
+    knobs = {config.DAG_SINGLE_TASK_BYTES.key: 0,
+             config.TASK_RETRY_BACKOFF_MS.key: 5,
+             config.TASK_MAX_ATTEMPTS.key: 6,
+             config.STAGE_MAX_RECOVERIES.key: 8}
+    for k, v in knobs.items():
+        config.conf.set(k, v)
+
+    def frame(tbl):
+        import pandas as pd
+        return tbl.to_pandas() if tbl.num_rows else pd.DataFrame(
+            {n: [] for n in tbl.schema.names})
+
+    queries = []
+    diverged = 0
+    try:
+        for qname in names:
+            qname = qname.strip()
+            builder, table_names = QUERIES[qname]
+            tables = generate(table_names, scale=scale)
+            with tempfile.TemporaryDirectory(prefix="chaos-") as d:
+                paths = write_parquet_splits(tables, d, 2)
+                plan_dict, _oracle = builder(paths, tables, 2)
+
+                faults.clear()
+                t0 = time.perf_counter()
+                base = DagScheduler(work_dir=os.path.join(d, "dag0")) \
+                    .run_collect(plan_dict)
+                base_wall = time.perf_counter() - t0
+
+                faults.configure(rules, seed=seed)
+                before = xla_stats.snapshot()
+                t0 = time.perf_counter()
+                try:
+                    got = DagScheduler(work_dir=os.path.join(d, "dag1")) \
+                        .run_collect(plan_dict)
+                finally:
+                    inj_stats = faults.stats()
+                    faults.clear()
+                chaos_wall = time.perf_counter() - t0
+                d_stats = xla_stats.delta(before)
+
+                err = compare_frames(frame(got), frame(base))
+                if err is not None:
+                    diverged += 1
+                queries.append({
+                    "query": qname,
+                    "base_wall_s": round(base_wall, 4),
+                    "chaos_wall_s": round(chaos_wall, 4),
+                    "divergence": err,
+                    "faults_injected": int(d_stats["faults_injected"]),
+                    "task_retries": int(d_stats["task_retries"]),
+                    "fetch_failures": int(d_stats["fetch_failures"]),
+                    "stage_recoveries": int(d_stats["stage_recoveries"]),
+                    "recovered_map_tasks":
+                        int(d_stats["recovered_map_tasks"]),
+                    "site_stats": inj_stats,
+                })
+    finally:
+        faults.clear()
+        for k in knobs:
+            config.conf.unset(k)
+
+    rec = {
+        "metric": "chaos_divergent_queries",
+        "value": diverged,
+        "unit": "queries",
+        "seed": seed,
+        "rules": rules,
+        "scale": scale,
+        "queries": queries,
+        "total_faults_injected":
+            sum(q["faults_injected"] for q in queries),
+        "total_task_retries": sum(q["task_retries"] for q in queries),
+        "total_stage_recoveries":
+            sum(q["stage_recoveries"] for q in queries),
+    }
+    path = os.environ.get(
+        "BLAZE_BENCH_CHAOS_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_CHAOS.json"))
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, default=str)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+    return 0 if diverged == 0 else 1
+
+
 def main():
     if "--expr" in sys.argv:
         sys.exit(expr_bench_main())
+    if "--chaos" in sys.argv:
+        sys.exit(chaos_bench_main())
     if "--child" in sys.argv:
         try:
             child_main()

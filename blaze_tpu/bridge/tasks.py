@@ -5,12 +5,33 @@ TimeoutError for the caller instead of hanging ThreadPoolExecutor
 forever (the failure mode of BENCH_r02: rc=124 with threads stuck in
 `jax.devices()`).  shutdown(wait=False) leaves any stuck thread behind;
 callers that must exit promptly despite one should use os._exit after
-reporting (bench.py child does)."""
+reporting (bench.py child does).
+
+Fault tolerance: each task gets bounded retries with exponential
+backoff + jitter for RETRYABLE failures (transient IO, injected faults —
+faults.classify_exception), the spark.task.maxFailures analog.  Fatal
+errors (plan/serde/logic) and FetchFailedError reach the caller after
+ONE attempt: retrying a bad plan wastes budget, and a fetch failure
+needs the DAG scheduler's lineage recovery, not a local re-read of the
+same poisoned block.  The pool waits with FIRST_EXCEPTION semantics so
+a task that fails in the first millisecond surfaces immediately instead
+of sitting out the full timeout behind healthy siblings.
+"""
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor, wait
+import logging
+import random
+import time
+from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
 from typing import Any, Callable, List, Optional
+
+from blaze_tpu import faults
+from blaze_tpu.faults import FetchFailedError, classify_exception
+
+log = logging.getLogger("blaze_tpu.tasks")
+
+_BACKOFF_CAP_S = 10.0
 
 
 def default_task_parallelism(n: int) -> int:
@@ -27,21 +48,79 @@ def default_task_parallelism(n: int) -> int:
     return max(1, min(n, config.HOST_TASK_PARALLELISM.get()))
 
 
+def _run_with_retries(fn: Callable[[int], Any], i: int, what: str) -> Any:
+    """One task slot: bounded attempts around `fn(i)` (runs ON the pool
+    thread, so retries never hold a second slot)."""
+    from blaze_tpu import config
+    from blaze_tpu.bridge import tracing, xla_stats
+    max_attempts = max(1, config.TASK_MAX_ATTEMPTS.get())
+    base_s = max(0, config.TASK_RETRY_BACKOFF_MS.get()) / 1e3
+    wait_ns = 0
+    attempt = 1
+    while True:
+        try:
+            faults.maybe_fail("task-start", task=i, attempt=attempt,
+                              what=what)
+            out = fn(i)
+            xla_stats.note_task_attempts(attempt, wait_ns)
+            return out
+        except BaseException as e:
+            kind = classify_exception(e)
+            if kind != "retryable" or attempt >= max_attempts:
+                xla_stats.note_task_attempts(attempt, wait_ns, failed=True)
+                raise
+            delay = min(base_s * (2 ** (attempt - 1)), _BACKOFF_CAP_S)
+            delay *= 1.0 + 0.25 * random.random()  # decorrelate herds
+            log.warning("%s: task %d attempt %d/%d failed (%s: %s); "
+                        "retrying in %.2fs", what, i, attempt,
+                        max_attempts, type(e).__name__, e, delay)
+            tracing.instant("task_retry", task=i, attempt=attempt,
+                            error=type(e).__name__, what=what)
+            time.sleep(delay)
+            wait_ns += int(delay * 1e9)
+            attempt += 1
+
+
 def run_tasks(fn: Callable[[int], Any], n: int, timeout_s: float,
               what: str, max_workers: Optional[int] = None) -> List[Any]:
     pool = ThreadPoolExecutor(max_workers=max_workers or
                               default_task_parallelism(n))
-    futs = [pool.submit(fn, i) for i in range(n)]
-    done, not_done = wait(futs, timeout=timeout_s)
-    if not_done:
-        pool.shutdown(wait=False, cancel_futures=True)
-        # surface a completed task's REAL failure over the phantom hang:
-        # a sibling wedged in backend init must not mask the root cause
+    futs = [pool.submit(_run_with_retries, fn, i, what) for i in range(n)]
+    deadline = time.monotonic() + timeout_s
+    pending = set(futs)
+    while pending:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            pool.shutdown(wait=False, cancel_futures=True)
+            # surface a completed task's REAL failure over the phantom
+            # hang: a sibling wedged in backend init must not mask the
+            # root cause
+            for f in futs:
+                if f.done() and not f.cancelled() \
+                        and f.exception() is not None:
+                    raise f.exception()
+            raise TimeoutError(f"{what}: {len(pending)}/{n} tasks still "
+                               f"running after {timeout_s:g}s")
+        # FIRST_EXCEPTION: a task that failed terminally (retries
+        # exhausted / fatal / fetch-failed) wakes the caller NOW, not
+        # after the slowest sibling or the full timeout
+        done, pending = wait(pending, timeout=remaining,
+                             return_when=FIRST_EXCEPTION)
+        first_err = fetch_err = None
         for f in done:
+            if f.cancelled():
+                continue
             exc = f.exception()
-            if exc is not None:
-                raise exc
-        raise TimeoutError(f"{what}: {len(not_done)}/{n} tasks still "
-                           f"running after {timeout_s:g}s")
+            if exc is None:
+                continue
+            if isinstance(exc, FetchFailedError) and fetch_err is None:
+                fetch_err = exc
+            elif first_err is None:
+                first_err = exc
+        if fetch_err is not None or first_err is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+            # a FetchFailedError outranks sibling errors: it carries the
+            # lineage the scheduler needs to recover the whole stage
+            raise fetch_err if fetch_err is not None else first_err
     pool.shutdown(wait=False)
     return [f.result() for f in futs]

@@ -51,6 +51,14 @@ _exprs = {"expr_programs_built": 0, "expr_program_cache_hits": 0,
           "expr_program_evictions": 0,
           "expr_fused_batches": 0, "expr_eager_batches": 0}
 
+# Fault-tolerance accounting (bridge/tasks.py retry loop, shuffle
+# readers, plan/stages.py lineage recovery, faults.py injector): how
+# many attempts tasks burned, how long retries waited, how often a
+# shuffle block came back poisoned and what recovery re-ran.
+_faults = {"task_attempts": 0, "task_retries": 0, "task_retry_wait_ns": 0,
+           "task_failures": 0, "fetch_failures": 0, "stage_recoveries": 0,
+           "recovered_map_tasks": 0, "faults_injected": 0}
+
 # Distinct signatures beyond this on one kernel = shape churn (the
 # recompilation-storm smell: unpadded dynamic shapes hitting jit).
 SHAPE_CHURN_THRESHOLD = 8
@@ -187,6 +195,42 @@ def note_expr_dispatch(fused: int = 0, eager: int = 0) -> None:
         _exprs["expr_eager_batches"] += int(eager)
 
 
+def note_task_attempts(attempts: int = 1, retry_wait_ns: int = 0,
+                       failed: bool = False) -> None:
+    """One task reached a terminal state after `attempts` tries, having
+    slept `retry_wait_ns` in backoff (bridge/tasks.py)."""
+    with _lock:
+        _faults["task_attempts"] += int(attempts)
+        _faults["task_retries"] += max(0, int(attempts) - 1)
+        _faults["task_retry_wait_ns"] += int(retry_wait_ns)
+        if failed:
+            _faults["task_failures"] += 1
+
+
+def note_fetch_failure() -> None:
+    """One shuffle block failed verification/fetch (FetchFailedError)."""
+    with _lock:
+        _faults["fetch_failures"] += 1
+
+
+def note_stage_recovery(map_tasks: int = 1) -> None:
+    """One lineage-recovery round re-ran `map_tasks` producer tasks."""
+    with _lock:
+        _faults["stage_recoveries"] += 1
+        _faults["recovered_map_tasks"] += int(map_tasks)
+
+
+def note_fault_injected() -> None:
+    """The chaos injector fired one scripted fault (faults.py)."""
+    with _lock:
+        _faults["faults_injected"] += 1
+
+
+def fault_stats() -> dict:
+    with _lock:
+        return dict(_faults)
+
+
 def expr_stats() -> dict:
     """Expression-program counters; `expr_cache_hit_rate` is hits over
     cache resolutions (the recompile-guard's steady-state signal)."""
@@ -246,6 +290,7 @@ def snapshot() -> dict:
     es = expr_stats()
     es.pop("expr_cache_hit_rate", None)  # ratio: not delta-able
     flat.update(es)
+    flat.update(fault_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -265,4 +310,6 @@ def reset() -> None:
             _pipeline[k] = 0
         for k in _exprs:
             _exprs[k] = 0
+        for k in _faults:
+            _faults[k] = 0
         _bucket_caps.clear()

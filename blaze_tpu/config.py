@@ -445,6 +445,48 @@ COLLAPSE_FILTER_PROJECT = bool_conf(
     "Project->Project into a single Project by substituting bound "
     "references, so the fused expression program sees the whole chain "
     "as one XLA-compiled stage.")
+FAULTS_ENABLE = bool_conf(
+    "auron.tpu.faults.enable", False,
+    "Activate the deterministic fault-injection registry (faults.py) "
+    "from auron.tpu.faults.rules/.seed — chaos testing only; production "
+    "queries leave this off.", category="fault-tolerance")
+FAULTS_SEED = int_conf(
+    "auron.tpu.faults.seed", 0,
+    "Seed for injection decisions: the k-th evaluation of a site fires "
+    "as a pure function of (seed, site, k), so a fixed seed reproduces "
+    "the exact failure schedule.", category="fault-tolerance")
+FAULTS_RULES = str_conf(
+    "auron.tpu.faults.rules", "",
+    "Comma-separated injection rules: `site=p` (probability), "
+    "`site=p*max` (capped fires), `site@k1+k2` (exact occurrences), "
+    "optional `:corrupt` action suffix (flip a frame byte instead of "
+    "raising).  Sites: task-start, shuffle-write, shuffle-read, "
+    "ipc-decode, mem-pressure.", category="fault-tolerance")
+TASK_MAX_ATTEMPTS = int_conf(
+    "auron.tpu.task.maxAttempts", 4,
+    "Bounded per-task attempts for retryable failures (transient IO, "
+    "injected faults) — the spark.task.maxFailures analog.  Fatal "
+    "errors (plan/serde/logic) and FetchFailedError never retry "
+    "in place; 1 disables retry.", category="fault-tolerance")
+TASK_RETRY_BACKOFF_MS = int_conf(
+    "auron.tpu.task.backoff", 100,
+    "Base backoff between task attempts in ms; attempt n sleeps "
+    "base*2^(n-1) with up to +25% jitter, capped at 10s.",
+    category="fault-tolerance")
+STAGE_MAX_RECOVERIES = int_conf(
+    "auron.tpu.stage.maxRecoveries", 3,
+    "Lineage-recovery rounds per query: each FetchFailedError re-runs "
+    "only the poisoned producer map task and restarts the consuming "
+    "stage; beyond this many rounds the failure propagates (the "
+    "spark.stage.maxConsecutiveAttempts analog).",
+    category="fault-tolerance")
+SHUFFLE_CHECKSUM_ENABLE = bool_conf(
+    "auron.tpu.shuffle.checksum", True,
+    "CRC32C checksum on every shuffle/spill IPC frame (4 bytes/frame, "
+    "verified on read).  A mismatched frame raises FetchFailedError "
+    "with the writing map task's identity so the scheduler can re-run "
+    "exactly that task instead of failing the query.",
+    category="fault-tolerance")
 CASE_SENSITIVE = bool_conf("spark.sql.caseSensitive", False, "Column name matching.")
 ANSI_ENABLED = bool_conf(
     "spark.sql.ansi.enabled", False,

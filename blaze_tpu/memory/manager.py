@@ -133,6 +133,15 @@ class MemManager:
                 self.peak_used = used
             overflow = used - self.total
             cap = self.consumer_cap()
+            # chaos hook: a scripted mem-pressure fault spills the
+            # updating consumer as if the pool had overflowed (exercises
+            # the spill / re-read path without a real over-budget
+            # workload)
+            from blaze_tpu import faults
+            if faults.fires("mem-pressure") and updated.mem_used > 0:
+                released = updated.spill()
+                self.total_spill_count += 1
+                self.total_spilled_bytes += released
             # a consumer far over its fair share spills even without global
             # overflow, so one giant sort cannot starve later operators
             if overflow <= 0 and updated.mem_used <= cap * 2:

@@ -148,6 +148,18 @@ class QueryProfile:
                 f"fused_batches={x.get('expr_fused_batches', 0)} "
                 f"eager_batches={x.get('expr_eager_batches', 0)} "
                 f"evictions={x.get('expr_program_evictions', 0)}")
+        if any(x.get(k) for k in ("task_retries", "task_failures",
+                                  "fetch_failures", "stage_recoveries",
+                                  "faults_injected")):
+            lines.append(
+                f"fault tolerance: attempts={x.get('task_attempts', 0)} "
+                f"retries={x.get('task_retries', 0)} "
+                f"retry_wait={_fmt_ns(x.get('task_retry_wait_ns', 0))} "
+                f"failures={x.get('task_failures', 0)} "
+                f"fetch_failures={x.get('fetch_failures', 0)} "
+                f"recoveries={x.get('stage_recoveries', 0)} "
+                f"recovered_map_tasks={x.get('recovered_map_tasks', 0)} "
+                f"faults_injected={x.get('faults_injected', 0)}")
         return "\n".join(lines)
 
     def __str__(self) -> str:

@@ -38,6 +38,7 @@ import pyarrow as pa
 
 from blaze_tpu.bridge.metrics import MetricNode
 from blaze_tpu.bridge.resource import put_resource, remove_resource
+from blaze_tpu.faults import FetchFailedError
 
 _SCAN_KINDS = ("parquet_scan", "orc_scan")
 
@@ -91,6 +92,14 @@ class DagScheduler:
         self.stages: List[Stage] = []
         self._resources: List[str] = []
         self.exec_mode: Optional[str] = None  # "local" | "staged"
+        # sid -> {map_id -> (data_file, offsets)}: the MapOutputTracker
+        # analog.  blocks_for closures read THIS dict at call time, so a
+        # recovered map task's fresh output is what the retried reduce
+        # task fetches — never a stale snapshot of the poisoned one.
+        self._stage_outputs: Dict[int, Dict[int, tuple]] = {}
+        # (sid, map_id) -> times the task body ran; lineage-recovery
+        # tests assert exactly ONE map task re-ran after a poisoned block
+        self.task_runs: Dict[tuple, int] = {}
         # per-stage operator-metric trees, merged across that stage's
         # tasks at finalize time (the MetricsUpdater analog)
         self.stage_metrics: Dict[int, MetricNode] = {}
@@ -226,63 +235,123 @@ class DagScheduler:
         workers = min(self._par, default_task_parallelism(n))
         return run_tasks(fn, n, self._timeout, what, max_workers=workers)
 
-    def _run_producer(self, stage: Stage) -> None:
-        from blaze_tpu.bridge.runtime import NativeExecutionRuntime
-        from blaze_tpu.plan.proto_serde import task_definition_to_bytes
-        from blaze_tpu.shuffle.exchange import read_index_file
-        from blaze_tpu.shuffle.reader import FileSegmentBlock
-
-        os.makedirs(self._dir, exist_ok=True)
-
+    @staticmethod
+    def _part_of(stage: Stage) -> Dict[str, Any]:
         part = dict(stage.partitioning)
         if part["kind"] == "single":
             part = {"kind": "single", "num_partitions": 1}
+        return part
+
+    def _map_data_path(self, sid: int, m: int) -> str:
+        return os.path.join(self._dir, f"s{self._run_id}-{sid}-{m}.data")
+
+    def _run_map_task(self, stage: Stage, part: Dict[str, Any],
+                      m: int) -> None:
+        """One producer map task: stage plan -> shuffle_writer ->
+        .data/.index (the writer commits via tmp + os.replace, so a
+        recovery re-run atomically replaces the poisoned output)."""
+        from blaze_tpu.bridge.runtime import NativeExecutionRuntime
+        from blaze_tpu.plan.proto_serde import task_definition_to_bytes
+        data = self._map_data_path(stage.sid, m)
+        plan = {"kind": "shuffle_writer", "partitioning": part,
+                "data_file": data,
+                "index_file": data[:-5] + ".index",
+                "input": self._per_task(stage.plan, m, stage.num_tasks)}
+        td = task_definition_to_bytes(
+            {"stage_id": stage.sid, "partition_id": m,
+             "num_partitions": stage.num_tasks, "plan": plan})
+        rt = NativeExecutionRuntime(td).start()
+        try:
+            for _ in rt.batches():
+                pass
+        finally:
+            self._record_task_metrics(stage.sid, rt.finalize())
+        with self._metrics_lock:
+            self.task_runs[(stage.sid, m)] = \
+                self.task_runs.get((stage.sid, m), 0) + 1
+
+    def _read_map_output(self, stage: Stage, m: int, n_out: int) -> tuple:
+        """Validated (data_file, offsets) for one map output; a bad index
+        is re-raised carrying the producer's (stage, map) identity so the
+        recovery loop knows exactly which task to re-run."""
+        from blaze_tpu.shuffle.exchange import read_index_file
+        data = self._map_data_path(stage.sid, m)
+        try:
+            return data, read_index_file(data[:-5] + ".index",
+                                         expected_partitions=n_out,
+                                         data_file=data)
+        except FetchFailedError as e:
+            raise FetchFailedError(stage.sid, m, e.reason) from e
+
+    def _run_producer(self, stage: Stage) -> None:
+        from blaze_tpu.shuffle.reader import FileSegmentBlock
+
+        os.makedirs(self._dir, exist_ok=True)
+        part = self._part_of(stage)
+        n_out = int(part.get("num_partitions", 1))
 
         for m in range(stage.num_tasks):
-            data = os.path.join(
-                self._dir, f"s{self._run_id}-{stage.sid}-{m}.data")
-            self._files += [data, data[:-5] + ".index"]
-
-        def run_map(m: int) -> None:
-            data = os.path.join(
-                self._dir, f"s{self._run_id}-{stage.sid}-{m}.data")
-            plan = {"kind": "shuffle_writer", "partitioning": part,
-                    "data_file": data,
-                    "index_file": data[:-5] + ".index",
-                    "input": self._per_task(stage.plan, m,
-                                            stage.num_tasks)}
-            td = task_definition_to_bytes(
-                {"stage_id": stage.sid, "partition_id": m,
-                 "num_partitions": stage.num_tasks, "plan": plan})
-            rt = NativeExecutionRuntime(td).start()
-            try:
-                for _ in rt.batches():
-                    pass
-            finally:
-                self._record_task_metrics(stage.sid, rt.finalize())
+            data = self._map_data_path(stage.sid, m)
+            for p in (data, data[:-5] + ".index"):
+                if p not in self._files:
+                    self._files.append(p)
 
         from blaze_tpu.bridge import tracing
         with tracing.span("shuffle_exchange", stage=stage.sid,
                           tasks=stage.num_tasks,
                           partitioning=part["kind"]):
-            self._run_tasks(run_map, stage.num_tasks,
+            self._run_tasks(lambda m: self._run_map_task(stage, part, m),
+                            stage.num_tasks,
                             f"stage {stage.sid} (shuffle write)")
 
-        outputs = []
-        for m in range(stage.num_tasks):
-            data = os.path.join(
-                self._dir, f"s{self._run_id}-{stage.sid}-{m}.data")
-            outputs.append((data,
-                            read_index_file(data[:-5] + ".index")))
+        self._stage_outputs[stage.sid] = {
+            m: self._read_map_output(stage, m, n_out)
+            for m in range(stage.num_tasks)}
+
+        sid = stage.sid
 
         def blocks_for(reduce_id: int):
-            for data, offsets in outputs:
+            # live read of the output map, in map-id order: recovered
+            # outputs are picked up, and reduce input order stays
+            # deterministic across recovery rounds
+            outputs = self._stage_outputs[sid]
+            for map_id in sorted(outputs):
+                data, offsets = outputs[map_id]
                 length = offsets[reduce_id + 1] - offsets[reduce_id]
                 if length:
-                    yield FileSegmentBlock(data, offsets[reduce_id], length)
+                    yield FileSegmentBlock(data, offsets[reduce_id],
+                                           length, stage_id=sid,
+                                           map_id=map_id)
 
         put_resource(stage.resource_id, blocks_for)
-        self._resources.append(stage.resource_id)
+        if stage.resource_id not in self._resources:
+            self._resources.append(stage.resource_id)
+
+    # -- lineage recovery --------------------------------------------------
+
+    def _recover_map_output(self, ff: FetchFailedError,
+                            stages_by_id: Dict[int, Stage]) -> None:
+        """Re-run exactly the map task that produced a poisoned block and
+        republish its output (Spark's stage-resubmission narrowed to one
+        task: in-process there is no executor loss, so only the named
+        output can be bad)."""
+        stage = stages_by_id.get(ff.stage_id)
+        if stage is None or stage.partitioning is None \
+                or not 0 <= ff.map_id < stage.num_tasks:
+            raise ff  # no lineage to recover from
+        from blaze_tpu.bridge import tracing, xla_stats
+        part = self._part_of(stage)
+        with tracing.span("stage_recovery", stage=ff.stage_id,
+                          map_task=ff.map_id):
+            # through the task pool: the re-run gets the same bounded
+            # retry/backoff as any task (transient faults may still fire)
+            self._run_tasks(
+                lambda _i: self._run_map_task(stage, part, ff.map_id), 1,
+                f"stage {ff.stage_id} recovery (map {ff.map_id})")
+            self._stage_outputs[stage.sid][ff.map_id] = \
+                self._read_map_output(stage, ff.map_id,
+                                      int(part.get("num_partitions", 1)))
+        xla_stats.note_stage_recovery(1)
 
     # -- AQE small-query fast path -----------------------------------------
 
@@ -340,6 +409,7 @@ class DagScheduler:
 
         from blaze_tpu import config
         self.stage_metrics = {}  # instance may be reused per query
+        self.task_runs = {}
         threshold = config.DAG_SINGLE_TASK_BYTES.get()
         if threshold > 0 and self._scan_input_bytes(plan) <= threshold:
             self.exec_mode = "local"
@@ -350,9 +420,9 @@ class DagScheduler:
 
         self.exec_mode = "staged"
         stages = self.split(plan)
+        stages_by_id = {st.sid: st for st in stages}
+        max_recoveries = max(0, config.STAGE_MAX_RECOVERIES.get())
         try:
-            for st in stages[:-1]:
-                self._run_producer(st)
             result = stages[-1]
             out_schema = schema_from_dict(result.out_schema).to_arrow()
 
@@ -368,8 +438,32 @@ class DagScheduler:
                 finally:
                     self._record_task_metrics(result.sid, rt.finalize())
 
-            parts = self._run_tasks(run_result, result.num_tasks,
-                                    f"stage {result.sid} (result)")
+            # bounded lineage recovery: a FetchFailedError anywhere in
+            # the DAG names the producer map task whose output is
+            # poisoned; re-run just that task, then resume from the
+            # first stage that never completed (auron.tpu.stage
+            # .maxRecoveries caps the rounds so persistent corruption
+            # still terminates)
+            completed: set = set()
+            recoveries = 0
+            while True:
+                try:
+                    for st in stages[:-1]:
+                        if st.sid not in completed:
+                            self._run_producer(st)
+                            completed.add(st.sid)
+                    parts = self._run_tasks(
+                        run_result, result.num_tasks,
+                        f"stage {result.sid} (result)")
+                    break
+                except FetchFailedError as ff:
+                    recoveries += 1
+                    if recoveries > max_recoveries:
+                        raise FetchFailedError(
+                            ff.stage_id, ff.map_id,
+                            f"{ff.reason} (gave up after "
+                            f"{max_recoveries} recovery rounds)") from ff
+                    self._recover_map_output(ff, stages_by_id)
             batches = [b for bl in parts for b in bl if b.num_rows]
             if not batches:
                 return out_schema.empty_table()
@@ -378,9 +472,15 @@ class DagScheduler:
             self.cleanup()
 
     def cleanup(self) -> None:
+        """Idempotent: safe to call any number of times (run_collect,
+        context-manager exit and __del__ may all reach it)."""
         for rid in self._resources:
-            remove_resource(rid)
+            try:
+                remove_resource(rid)
+            except Exception:
+                pass
         self._resources = []
+        self._stage_outputs = {}
         for path in self._files:
             try:
                 os.unlink(path)
@@ -391,6 +491,22 @@ class DagScheduler:
             import shutil
             # recreated lazily by the next _run_producer if reused
             shutil.rmtree(self._dir, ignore_errors=True)
+
+    def __enter__(self) -> "DagScheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.cleanup()
+
+    def __del__(self) -> None:
+        # last-resort backstop for callers that drop the scheduler
+        # without run_collect ever reaching its finally (put_resource
+        # entries would otherwise leak process-wide); interpreter
+        # shutdown may have torn down globals, so never let this raise
+        try:
+            self.cleanup()
+        except Exception:
+            pass
 
     # -- observability -----------------------------------------------------
 

@@ -3,6 +3,7 @@
 Ref: datafusion-ext-plans/src/shuffle/ + io/ipc_compression.rs.
 """
 
+from blaze_tpu.faults import FetchFailedError, ShuffleChecksumError
 from blaze_tpu.shuffle.ipc import (IpcCompressionReader, IpcCompressionWriter,
                                    read_batches_from_bytes,
                                    write_batches_to_bytes)
@@ -24,4 +25,5 @@ __all__ = ["IpcCompressionReader", "IpcCompressionWriter",
            "sample_range_bounds",
            "FFIReaderExec", "FileSegmentBlock", "IpcReaderExec",
            "IpcWriterExec", "RssShuffleWriterExec", "ShuffleRepartitioner",
-           "ShuffleWriterExec", "LocalShuffleExchange", "read_index_file"]
+           "ShuffleWriterExec", "LocalShuffleExchange", "read_index_file",
+           "FetchFailedError", "ShuffleChecksumError"]

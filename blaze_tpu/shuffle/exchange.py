@@ -19,6 +19,7 @@ import numpy as np
 
 from blaze_tpu.bridge.context import TaskContext, task_scope
 from blaze_tpu.bridge.resource import put_resource, remove_resource
+from blaze_tpu.faults import FetchFailedError
 from blaze_tpu.ops.base import ExecutionPlan
 from blaze_tpu.schema import Schema
 from blaze_tpu.shuffle.partitioning import Partitioning
@@ -26,11 +27,50 @@ from blaze_tpu.shuffle.reader import FileSegmentBlock, IpcReaderExec
 from blaze_tpu.shuffle.writer import ShuffleWriterExec
 
 
-def read_index_file(path: str) -> List[int]:
-    """Cumulative offsets (ref AuronShuffleWriterBase.scala:68-78)."""
-    with open(path, "rb") as f:
-        data = f.read()
-    return np.frombuffer(data, dtype="<i8").tolist()
+def read_index_file(path: str, expected_partitions: Optional[int] = None,
+                    data_file: Optional[str] = None) -> List[int]:
+    """Cumulative offsets (ref AuronShuffleWriterBase.scala:68-78).
+
+    A shuffle index is the map task's MapStatus: if it is truncated or
+    inconsistent, every slice computed from it is garbage.  Validate the
+    shape up front — length a multiple of 8, `expected_partitions`+1
+    entries when the reducer count is known, monotone offsets starting
+    at 0, last offset within the `.data` file — and raise a clear
+    FetchFailedError (callers attach the producer's stage/map identity)
+    instead of silently slicing garbage."""
+
+    def bad(why: str) -> FetchFailedError:
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_fetch_failure()
+        return FetchFailedError(reason=f"bad shuffle index {path}: {why}")
+
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise bad(str(e)) from e
+    if len(data) == 0 or len(data) % 8:
+        raise bad(f"{len(data)} bytes is not a whole number of "
+                  f"int64 offsets")
+    offsets = np.frombuffer(data, dtype="<i8")
+    if expected_partitions is not None \
+            and len(offsets) != expected_partitions + 1:
+        raise bad(f"{len(offsets)} offsets, want "
+                  f"{expected_partitions + 1} for {expected_partitions} "
+                  f"reduce partitions (truncated index?)")
+    if offsets[0] != 0:
+        raise bad(f"first offset {offsets[0]} != 0")
+    if len(offsets) > 1 and bool(np.any(np.diff(offsets) < 0)):
+        raise bad("offsets are not monotone non-decreasing")
+    if data_file is not None:
+        try:
+            size = os.path.getsize(data_file)
+        except OSError as e:
+            raise bad(f"data file missing: {e}") from e
+        if int(offsets[-1]) > size:
+            raise bad(f"last offset {int(offsets[-1])} exceeds data "
+                      f"file size {size}")
+    return offsets.tolist()
 
 
 class LocalShuffleExchange(ExecutionPlan):
@@ -72,13 +112,18 @@ class LocalShuffleExchange(ExecutionPlan):
                                         partition_id=map_id,
                                         num_partitions=child.num_partitions)):
                 list(writer.execute(map_id))
-            self._map_outputs.append((data, read_index_file(index)))
+            self._map_outputs.append((data, read_index_file(
+                index,
+                expected_partitions=self.partitioning.num_partitions,
+                data_file=data)))
 
         def blocks_for(reduce_id: int):
-            for data, offsets in self._map_outputs:
+            for map_id, (data, offsets) in enumerate(self._map_outputs):
                 length = offsets[reduce_id + 1] - offsets[reduce_id]
                 if length:
-                    yield FileSegmentBlock(data, offsets[reduce_id], length)
+                    yield FileSegmentBlock(data, offsets[reduce_id], length,
+                                           stage_id=self.stage_id,
+                                           map_id=map_id)
         put_resource(f"shuffle://{self._shuffle_id}", blocks_for)
         self._materialized = True
 

@@ -5,15 +5,26 @@ IpcCompressionWriter, `:135` IpcCompressionReader) — the one wire/disk format
 shared by shuffle `.data` files, spill files and broadcast byte arrays.
 
 Frame layout (little-endian):
-    [u8  codec]  0 = raw, 1 = zstd, 2 = lz4-frame (the reference's default
-                 shuffle codec, via Arrow C++; ref SPILL_COMPRESSION_CODEC)
+    [u8  codec]  low 7 bits: 0 = raw, 1 = zstd, 2 = lz4-frame (the
+                 reference's default shuffle codec, via Arrow C++; ref
+                 SPILL_COMPRESSION_CODEC).  High bit (FLAG_CRC, format
+                 v2): a u32 CRC32C of the payload follows the length.
     [u32 length] compressed payload size
+    [u32 crc32c] only when FLAG_CRC — checksum of the payload bytes
     [payload]    one Arrow IPC *stream* (schema + N record batches)
 
 Frames are self-describing and concatenable: a reader can start at any frame
 boundary, which is what the shuffle `.index` file points at.  Batches are
 buffered until the target frame size so small batches amortize compression
 (ref auron.shuffle.compression.target.buf.size).
+
+Integrity (format v2, auron.tpu.shuffle.checksum): each frame carries a
+CRC32C over its (compressed) payload, verified on every read; a mismatch
+raises ShuffleChecksumError, which file-segment readers upgrade to
+FetchFailedError so the DAG scheduler can re-run exactly the map task
+that wrote the block.  Codec bytes with unknown flag/codec bits are
+rejected with a clear error instead of decoding garbage — a reader older
+than the frame format fails loudly, never silently.
 """
 
 from __future__ import annotations
@@ -24,12 +35,49 @@ from typing import BinaryIO, Iterator, List, Optional
 
 import pyarrow as pa
 
-from blaze_tpu import config
+from blaze_tpu import config, faults
+from blaze_tpu.faults import ShuffleChecksumError
 
 _HEADER = struct.Struct("<BI")
+_CRC = struct.Struct("<I")
 CODEC_RAW = 0
 CODEC_ZSTD = 1
 CODEC_LZ4 = 2
+FLAG_CRC = 0x80
+_CODEC_MASK = 0x7F
+_KNOWN_CODECS = (CODEC_RAW, CODEC_ZSTD, CODEC_LZ4)
+
+try:
+    from google_crc32c import value as _crc32c_impl
+
+    def _crc32c(data) -> int:
+        if not isinstance(data, bytes):
+            data = bytes(data)  # google_crc32c rejects memoryviews
+        return _crc32c_impl(data)
+except ImportError:  # pragma: no cover - image always ships google_crc32c
+    import zlib
+
+    def _crc32c(data) -> int:
+        return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _check_frame_byte(raw_codec: int) -> int:
+    """Validate a frame's codec byte; returns the codec id."""
+    codec = raw_codec & _CODEC_MASK
+    if codec not in _KNOWN_CODECS or (raw_codec & ~(FLAG_CRC | _CODEC_MASK)):
+        raise ShuffleChecksumError(
+            f"unknown shuffle frame codec byte 0x{raw_codec:02x}: frame "
+            f"written by a newer format than this reader understands")
+    return codec
+
+
+def _verify_crc(expected: int, payload) -> None:
+    actual = _crc32c(payload)
+    if actual != expected:
+        raise ShuffleChecksumError(
+            f"shuffle frame CRC32C mismatch: stored 0x{expected:08x}, "
+            f"computed 0x{actual:08x} over {len(payload)} bytes "
+            f"(corrupted block)")
 
 
 def _lz4():
@@ -105,12 +153,15 @@ class IpcCompressionWriter:
 
     def __init__(self, sink: BinaryIO,
                  target_frame_bytes: Optional[int] = None,
-                 codec_name: Optional[str] = None):
+                 codec_name: Optional[str] = None,
+                 checksum: Optional[bool] = None):
         self._sink = sink
         self._codec = (_codec_from_name(codec_name) if codec_name
                        else _get_codec())
         self._target = (target_frame_bytes or
                         config.SHUFFLE_COMPRESSION_TARGET_BUF_SIZE.get())
+        self._checksum = (config.SHUFFLE_CHECKSUM_ENABLE.get()
+                          if checksum is None else checksum)
         self._pending: List[pa.RecordBatch] = []
         self._pending_bytes = 0
         self.raw_bytes_written = 0
@@ -134,7 +185,18 @@ class IpcCompressionWriter:
             for b in self._pending:
                 w.write_batch(b)
         payload = _compress(self._codec, buf.getvalue())
-        self._sink.write(_HEADER.pack(self._codec, len(payload)))
+        if self._checksum:
+            # crc first, corruption second: the injected flip models
+            # bit-rot AFTER a correct write, which is exactly what the
+            # read-side verification must catch
+            crc = _crc32c(payload)
+            payload = faults.corrupt("shuffle-write", payload)
+            self._sink.write(_HEADER.pack(self._codec | FLAG_CRC,
+                                          len(payload)))
+            self._sink.write(_CRC.pack(crc))
+        else:
+            payload = faults.corrupt("shuffle-write", payload)
+            self._sink.write(_HEADER.pack(self._codec, len(payload)))
         self._sink.write(payload)
         self.raw_bytes_written += self._pending_bytes
         self.frames_written += 1
@@ -174,10 +236,20 @@ class IpcCompressionReader:
             header = self._read_exact(_HEADER.size)
             if header is None:
                 return
-            codec, length = _HEADER.unpack(header)
+            raw_codec, length = _HEADER.unpack(header)
+            codec = _check_frame_byte(raw_codec)
+            crc = None
+            if raw_codec & FLAG_CRC:
+                crc_bytes = self._read_exact(_CRC.size)
+                if crc_bytes is None:
+                    raise EOFError("truncated IPC frame checksum")
+                (crc,) = _CRC.unpack(crc_bytes)
             payload = self._read_exact(length)
             if payload is None:
                 raise EOFError("truncated IPC frame payload")
+            faults.maybe_fail("ipc-decode")
+            if crc is not None:
+                _verify_crc(crc, payload)
             raw = _decompress(codec, payload)
             with pa.ipc.open_stream(io.BytesIO(raw)) as r:
                 yield from r
@@ -192,8 +264,14 @@ def read_frames_from_buffer(buf: "pa.Buffer") -> Iterator[pa.RecordBatch]:
     pos = 0
     end = len(buf)
     while pos < end:
-        codec, length = _HEADER.unpack_from(mv, pos)
+        raw_codec, length = _HEADER.unpack_from(mv, pos)
         pos += _HEADER.size
+        codec = _check_frame_byte(raw_codec)
+        faults.maybe_fail("ipc-decode")
+        if raw_codec & FLAG_CRC:
+            (crc,) = _CRC.unpack_from(mv, pos)
+            pos += _CRC.size
+            _verify_crc(crc, mv[pos:pos + length])
         if codec == CODEC_RAW:
             payload = buf.slice(pos, length)
             if payload.address % 64:

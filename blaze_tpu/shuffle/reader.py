@@ -15,8 +15,11 @@ from typing import BinaryIO, Callable, Iterator, List, Optional, Union
 
 import pyarrow as pa
 
+from blaze_tpu import faults
 from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.bridge.resource import get_resource
+from blaze_tpu.faults import (FetchFailedError, InjectedFault,
+                              ShuffleChecksumError)
 from blaze_tpu.ops.base import BatchIterator, CoalesceStream, ExecutionPlan
 from blaze_tpu.schema import Schema
 from blaze_tpu.shuffle.ipc import IpcCompressionReader, IpcCompressionWriter
@@ -25,11 +28,15 @@ from blaze_tpu.shuffle.ipc import IpcCompressionReader, IpcCompressionWriter
 @dataclass
 class FileSegmentBlock:
     """(path, offset, length) — the FileSegment fast path
-    (ref ipc_reader_exec.rs:277)."""
+    (ref ipc_reader_exec.rs:277).  stage_id/map_id carry the writing
+    map task's lineage so a corrupted/truncated segment can be traced
+    back to — and re-produced by — exactly that task."""
 
     path: str
     offset: int
     length: int
+    stage_id: int = -1
+    map_id: int = -1
 
 
 Block = Union[FileSegmentBlock, bytes, BinaryIO]
@@ -39,32 +46,49 @@ def read_block(block: Block) -> Iterator[pa.RecordBatch]:
     if isinstance(block, FileSegmentBlock):
         if block.length == 0:
             return
-        # mmap fast path: raw frames decode zero-copy against the page
-        # cache (the FileSegment mmap read of ipc_reader_exec.rs:277);
-        # the pa.py_buffer keeps the mapping alive as long as any batch
-        # references it
-        buf = None
         try:
-            import mmap
-            with open(block.path, "rb") as f:
-                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-            buf = pa.py_buffer(mm).slice(block.offset, block.length)
-        except (OSError, ValueError):
-            buf = None  # exotic FS / zero-length mapping: buffered path
-        if buf is not None:
-            # decode OUTSIDE the fallback guard: a mid-stream decode
-            # error must propagate, not restart the block and hand
-            # duplicate batches downstream
-            from blaze_tpu.shuffle.ipc import read_frames_from_buffer
-            yield from read_frames_from_buffer(buf)
-            return
-        with open(block.path, "rb") as f:
-            f.seek(block.offset)
-            yield from IpcCompressionReader(f, limit=block.length).read_batches()
+            faults.maybe_fail("shuffle-read", path=block.path)
+            yield from _read_segment(block)
+        except (ShuffleChecksumError, EOFError, OSError,
+                InjectedFault) as e:
+            # the Spark FetchFailed contract: a block that cannot be
+            # read back intact (bit rot, truncation, lost file, injected
+            # fetch failure) names its producer so the DAG scheduler can
+            # re-run just that map task instead of failing the query
+            from blaze_tpu.bridge import xla_stats
+            xla_stats.note_fetch_failure()
+            raise FetchFailedError(
+                block.stage_id, block.map_id,
+                f"{block.path}@{block.offset}+{block.length}: {e}") from e
     elif isinstance(block, (bytes, bytearray, memoryview)):
         yield from IpcCompressionReader(io.BytesIO(block)).read_batches()
     else:  # file-like channel
         yield from IpcCompressionReader(block).read_batches()
+
+
+def _read_segment(block: FileSegmentBlock) -> Iterator[pa.RecordBatch]:
+    # mmap fast path: raw frames decode zero-copy against the page
+    # cache (the FileSegment mmap read of ipc_reader_exec.rs:277);
+    # the pa.py_buffer keeps the mapping alive as long as any batch
+    # references it
+    buf = None
+    try:
+        import mmap
+        with open(block.path, "rb") as f:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        buf = pa.py_buffer(mm).slice(block.offset, block.length)
+    except (OSError, ValueError):
+        buf = None  # exotic FS / zero-length mapping: buffered path
+    if buf is not None:
+        # decode OUTSIDE the fallback guard: a mid-stream decode
+        # error must propagate, not restart the block and hand
+        # duplicate batches downstream
+        from blaze_tpu.shuffle.ipc import read_frames_from_buffer
+        yield from read_frames_from_buffer(buf)
+        return
+    with open(block.path, "rb") as f:
+        f.seek(block.offset)
+        yield from IpcCompressionReader(f, limit=block.length).read_batches()
 
 
 class IpcReaderExec(ExecutionPlan):
