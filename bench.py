@@ -1500,11 +1500,217 @@ def chaos_bench_main() -> int:
     return 0 if diverged == 0 else 1
 
 
+# ===========================================================================
+# --aggskip: adaptive partial-agg skipping microbenchmark (ISSUE 5)
+# ===========================================================================
+
+def aggskip_bench_main() -> int:
+    """Partial-agg skipping microbenchmark (`--aggskip`).
+
+    Two legs:
+
+      1. High-NDV microbenchmark: a unique-ish int64 group key at two
+         scales, partial stage timed with adaptive skipping ON (the
+         ratio probe fires and the rest of the input streams through
+         the pass-through lane) vs OFF (every batch lexsorted and
+         compacted).  Values are INTEGERS so the skip/no-skip final
+         results are byte-identical (float summation order differs
+         between the two partial forms by design).
+
+      2. Forced-skip itest leg: the chaos-bench query subset run
+         through the staged DAG scheduler with ratio=0.0/minRows=1
+         (every eligible partial agg switches immediately; pass-through
+         batches interleave with the probe window's hashed batches on
+         the shuffle wire) and compared frame-by-frame against the
+         skip-disabled run.  divergent_queries MUST be 0.
+
+    Writes BENCH_AGGSKIP.json and prints the record as one JSON line."""
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+
+    from blaze_tpu import config
+    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.exprs import col
+    from blaze_tpu.itest import generate
+    from blaze_tpu.itest.queries import QUERIES
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.itest.tpcds_data import write_parquet_splits
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.ops import MemoryScanExec
+    from blaze_tpu.ops.agg import AggExec, AggMode, make_agg
+    from blaze_tpu.plan.stages import DagScheduler
+
+    MemManager.init(4 << 30)
+    iters = int(os.environ.get("BLAZE_BENCH_AGGSKIP_ITERS", "5"))
+    batch_rows = int(os.environ.get("BLAZE_BENCH_AGGSKIP_BATCH", "8192"))
+    scales = [int(s) for s in os.environ.get(
+        "BLAZE_BENCH_AGGSKIP_SCALES", "1,10").split(",")]
+    base_rows = int(os.environ.get("BLAZE_BENCH_AGGSKIP_ROWS", "200000"))
+
+    def make_table(n):
+        rng = np.random.default_rng(42)
+        # unique-ish key: drawn from a space 8x the row count, so the
+        # probe window's reduction ratio is ~0.99 — far above the 0.9
+        # default and representative of a mis-planned pre-aggregation
+        return pa.table({
+            "k": pa.array(rng.integers(0, n * 8, n)),
+            "v": pa.array(rng.integers(-1000, 1000, n)),
+        })
+
+    def partial_stage(tbl, skip):
+        scan = MemoryScanExec.from_arrow(tbl, batch_rows=batch_rows)
+        plan = AggExec(scan, [(col(0, "k"), "k")],
+                       [(make_agg("sum", [col(1, "v")]), AggMode.PARTIAL,
+                         "s"),
+                        (make_agg("count", [col(1, "v")]), AggMode.PARTIAL,
+                         "c")])
+        with config.scoped(**{
+                config.PARTIAL_AGG_SKIPPING_ENABLE.key: skip}):
+            t0 = time.perf_counter()
+            out = plan.execute_collect().to_arrow()
+            return time.perf_counter() - t0, out, plan
+
+    def finalize(partial_tbl):
+        scan = MemoryScanExec.from_arrow(partial_tbl)
+        plan = AggExec(scan, [(col(0, "k"), "k")],
+                       [(make_agg("sum", [col(1)]), AggMode.PARTIAL_MERGE,
+                         "s"),
+                        (make_agg("count", [col(2)]), AggMode.PARTIAL_MERGE,
+                         "c")])
+        out = plan.execute_collect().to_arrow()
+        idx = pa.compute.sort_indices(out.column("k"))
+        return out.take(idx)
+
+    scale_recs = []
+    for sf in scales:
+        n = base_rows * sf
+        tbl = make_table(n)
+        # warm both paths (compiles the segmented-reduce and identity-gid
+        # programs), then interleave timed runs, min-of-samples
+        partial_stage(tbl, True)
+        partial_stage(tbl, False)
+        walls = {"skip": [], "noskip": []}
+        last = {}
+        for _ in range(iters):
+            w, out_on, plan_on = partial_stage(tbl, True)
+            walls["skip"].append(w)
+            last["on"] = (out_on, plan_on)
+            w, out_off, plan_off = partial_stage(tbl, False)
+            walls["noskip"].append(w)
+            last["off"] = (out_off, plan_off)
+        out_on, plan_on = last["on"]
+        out_off, plan_off = last["off"]
+        fin_on = finalize(out_on)
+        fin_off = finalize(out_off)
+        identical = fin_on.equals(fin_off)  # byte-identical final merge
+        skip_s = float(np.min(walls["skip"]))
+        noskip_s = float(np.min(walls["noskip"]))
+        scale_recs.append({
+            "scale": sf,
+            "rows": n,
+            "groups": int(fin_on.num_rows),
+            "skip_wall_s": round(skip_s, 4),
+            "noskip_wall_s": round(noskip_s, 4),
+            "speedup": round(noskip_s / skip_s, 3),
+            "partial_skipped": int(plan_on.metrics.get("partial_skipped")),
+            "passthrough_rows":
+                int(plan_on.metrics.get("passthrough_rows")),
+            "final_identical": bool(identical),
+        })
+
+    # --- forced-skip itest leg -------------------------------------------
+    names = os.environ.get("BLAZE_BENCH_AGGSKIP_QUERIES",
+                           "q01,q06,q95").split(",")
+    itest_scale = float(os.environ.get("BLAZE_BENCH_AGGSKIP_SCALE", "0.2"))
+    force = {config.PARTIAL_AGG_SKIPPING_ENABLE.key: True,
+             config.PARTIAL_AGG_SKIPPING_RATIO.key: 0.0,
+             config.PARTIAL_AGG_SKIPPING_MIN_ROWS.key: 1,
+             config.DAG_SINGLE_TASK_BYTES.key: 0}
+
+    def frame(tbl):
+        return tbl.to_pandas() if tbl.num_rows else pd.DataFrame(
+            {c: [] for c in tbl.schema.names})
+
+    queries = []
+    diverged = 0
+    for qname in names:
+        qname = qname.strip()
+        builder, table_names = QUERIES[qname]
+        tables = generate(table_names, scale=itest_scale)
+        with tempfile.TemporaryDirectory(prefix="aggskip-") as d:
+            paths = write_parquet_splits(tables, d, 2)
+            plan_dict, _oracle = builder(paths, tables, 2)
+            config.conf.set(config.DAG_SINGLE_TASK_BYTES.key, 0)
+            try:
+                config.conf.set(config.PARTIAL_AGG_SKIPPING_ENABLE.key,
+                                False)
+                t0 = time.perf_counter()
+                base = DagScheduler(work_dir=os.path.join(d, "dag0")) \
+                    .run_collect(plan_dict)
+                base_wall = time.perf_counter() - t0
+                for k, v in force.items():
+                    config.conf.set(k, v)
+                before = xla_stats.snapshot()
+                t0 = time.perf_counter()
+                got = DagScheduler(work_dir=os.path.join(d, "dag1")) \
+                    .run_collect(plan_dict)
+                skip_wall = time.perf_counter() - t0
+                d_stats = xla_stats.delta(before)
+            finally:
+                for k in set(force) | {
+                        config.PARTIAL_AGG_SKIPPING_ENABLE.key}:
+                    config.conf.unset(k)
+            err = compare_frames(frame(got), frame(base))
+            if err is not None:
+                diverged += 1
+            queries.append({
+                "query": qname,
+                "base_wall_s": round(base_wall, 4),
+                "forced_skip_wall_s": round(skip_wall, 4),
+                "divergence": err,
+                "skip_events": int(d_stats["partial_agg_skip_events"]),
+                "skipped_rows": int(d_stats["partial_agg_skipped_rows"]),
+            })
+
+    rec = {
+        "metric": "aggskip_divergent_queries",
+        "value": diverged,
+        "unit": "queries",
+        "divergent_queries": diverged,
+        "batch_rows": batch_rows,
+        "iters": iters,
+        "scales": scale_recs,
+        "itest": {"scale": itest_scale, "queries": queries},
+        "agg_stats": xla_stats.agg_stats(),
+    }
+    path = os.environ.get(
+        "BLAZE_BENCH_AGGSKIP_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_AGGSKIP.json"))
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, default=str)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+    bad = (diverged or
+           any(not s["final_identical"] or not s["partial_skipped"]
+               for s in scale_recs))
+    return 1 if bad else 0
+
+
 def main():
     if "--expr" in sys.argv:
         sys.exit(expr_bench_main())
     if "--chaos" in sys.argv:
         sys.exit(chaos_bench_main())
+    if "--aggskip" in sys.argv:
+        sys.exit(aggskip_bench_main())
     if "--child" in sys.argv:
         try:
             child_main()

@@ -59,6 +59,14 @@ _faults = {"task_attempts": 0, "task_retries": 0, "task_retry_wait_ns": 0,
            "task_failures": 0, "fetch_failures": 0, "stage_recoveries": 0,
            "recovered_map_tasks": 0, "faults_injected": 0}
 
+# Adaptive partial-aggregation accounting (ops/agg/exec.py _AggState,
+# plan/fused.py host lane): cardinality probes run, mode switches
+# (ratio-triggered vs memory-pressure-triggered), and the rows that
+# streamed through the pass-through lane un-aggregated.
+_agg = {"partial_agg_skip_events": 0, "partial_agg_skipped_rows": 0,
+        "partial_agg_probe_rows": 0, "partial_agg_probe_groups": 0,
+        "partial_agg_switch_rows": 0, "partial_agg_spill_switches": 0}
+
 # Distinct signatures beyond this on one kernel = shape churn (the
 # recompilation-storm smell: unpadded dynamic shapes hitting jit).
 SHAPE_CHURN_THRESHOLD = 8
@@ -231,6 +239,36 @@ def fault_stats() -> dict:
         return dict(_faults)
 
 
+def note_partial_agg_probe(rows: int, groups: int) -> None:
+    """One cardinality probe over `rows` buffered rows that resolved
+    `groups` distinct groups (the skip decision's evidence)."""
+    with _lock:
+        _agg["partial_agg_probe_rows"] += int(rows)
+        _agg["partial_agg_probe_groups"] += int(groups)
+
+
+def note_partial_agg_skip(switch_row: int, on_spill: bool = False) -> None:
+    """One partial agg switched to pass-through after consuming
+    `switch_row` rows; `on_spill` when memory pressure (not the ratio
+    probe) forced the switch."""
+    with _lock:
+        _agg["partial_agg_skip_events"] += 1
+        _agg["partial_agg_switch_rows"] += int(switch_row)
+        if on_spill:
+            _agg["partial_agg_spill_switches"] += 1
+
+
+def note_partial_agg_rows(rows: int) -> None:
+    """Rows streamed through the pass-through lane un-aggregated."""
+    with _lock:
+        _agg["partial_agg_skipped_rows"] += int(rows)
+
+
+def agg_stats() -> dict:
+    with _lock:
+        return dict(_agg)
+
+
 def expr_stats() -> dict:
     """Expression-program counters; `expr_cache_hit_rate` is hits over
     cache resolutions (the recompile-guard's steady-state signal)."""
@@ -291,6 +329,7 @@ def snapshot() -> dict:
     es.pop("expr_cache_hit_rate", None)  # ratio: not delta-able
     flat.update(es)
     flat.update(fault_stats())
+    flat.update(agg_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -312,4 +351,6 @@ def reset() -> None:
             _exprs[k] = 0
         for k in _faults:
             _faults[k] = 0
+        for k in _agg:
+            _agg[k] = 0
         _bucket_caps.clear()

@@ -175,7 +175,8 @@ def scoped(**kv: Any) -> _Scoped:
 def describe_all() -> List[Dict[str, Any]]:
     """Doc generator feed (ref SparkAuronConfigurationDocGenerator.java)."""
     return [
-        {"key": o.key, "default": o.default, "doc": o.doc, "category": o.category}
+        {"key": o.key, "default": o.default, "doc": o.doc,
+         "category": o.category, "alt_keys": o.alt_keys}
         for o in sorted(_REGISTRY.values(), key=lambda o: o.key)
     ]
 
@@ -193,8 +194,12 @@ def generate_docs() -> str:
         lines.append("| key | default | description |")
         lines.append("|---|---|---|")
         for o in by_cat[cat]:
+            doc = o["doc"]
+            if o["alt_keys"]:
+                alts = ", ".join(f"`{k}`" for k in o["alt_keys"])
+                doc = f"{doc} (aliases: {alts})"
             lines.append(f"| `{o['key']}` | `{o['default']}` | "
-                         f"{o['doc']} |")
+                         f"{doc} |")
         lines.append("")
     return "\n".join(lines)
 
@@ -225,17 +230,20 @@ SMJ_FALLBACK_MEM_THRESHOLD = int_conf(
     "auron.smjfallback.mem.threshold", 134217728,
     "Build-side bytes that trigger hash->SMJ fallback (128MB default).")
 PARTIAL_AGG_SKIPPING_ENABLE = bool_conf(
-    "auron.partialAggSkipping.enable", True,
+    "auron.tpu.partialAgg.skipping.enable", True,
     "Pass rows through un-aggregated when partial-agg cardinality is too high "
-    "(ref agg_table.rs:108-122).")
+    "(ref agg_table.rs:108-122 AGG_TRIGGER_PARTIAL_SKIPPING).",
+    alt_keys=("auron.partialAggSkipping.enable",))
 PARTIAL_AGG_SKIPPING_RATIO = float_conf(
-    "auron.partialAggSkipping.ratio", 0.9,
-    "Cardinality/rows ratio beyond which partial agg switches to "
-    "pass-through (reference default 0.9, SparkAuronConfiguration.java).")
+    "auron.tpu.partialAgg.skipping.ratio", 0.9,
+    "Groups-emitted/rows-consumed ratio beyond which partial agg switches "
+    "to pass-through (reference default 0.9, SparkAuronConfiguration.java).",
+    alt_keys=("auron.partialAggSkipping.ratio",))
 PARTIAL_AGG_SKIPPING_MIN_ROWS = int_conf(
-    "auron.partialAggSkipping.minRows", 50000,
-    "Rows observed before partial-agg skipping may trigger (the "
-    "reference defaults to 5x its 10000-row batch size).")
+    "auron.tpu.partialAgg.skipping.minRows", 50000,
+    "Probe window: rows observed before the one-shot cardinality probe "
+    "runs (the reference defaults to 5x its 10000-row batch size).",
+    alt_keys=("auron.partialAggSkipping.minRows",))
 SPILL_COMPRESSION_CODEC = str_conf(
     "auron.spill.compression.codec", "zstd", "Codec for spill files + shuffle IPC.")
 SHUFFLE_COMPRESSION_TARGET_BUF_SIZE = int_conf(
@@ -544,13 +552,14 @@ CAST_TRIM_STRING = bool_conf(
     "Trim whitespace before string->numeric/date casts (Spark behavior).",
     category="operator")
 PARTIAL_AGG_SKIPPING_PROBE_ROWS = int_conf(
-    "auron.tpu.partialAggSkipping.probeRows", 16384,
+    "auron.tpu.partialAgg.skipping.probeRows", 16384,
     "Uniform-sample size for the cardinality-ratio probe that drives "
     "partial-agg skipping (minRows still gates WHEN the probe may run; "
     "this bounds what it costs).  The sample is strided across the "
     "whole buffer, so repeated keys depress the ratio and the skip "
     "decision errs toward keeping the aggregation.",
-    category="operator")
+    category="operator",
+    alt_keys=("auron.tpu.partialAggSkipping.probeRows",))
 SMJ_ACERO_ENABLE = bool_conf(
     "auron.tpu.smj.acero.enable", True,
     "Sort-merge joins whose sides fit the host collect budget run "
@@ -558,10 +567,14 @@ SMJ_ACERO_ENABLE = bool_conf(
     "join keys (preserving SMJ's ordering contract); larger inputs "
     "keep the spillable streaming merge.",
     category="operator")
-PARTIAL_AGG_SKIPPING_SKIP_SPILL = bool_conf(
-    "auron.partialAggSkipping.skipSpill", False,
-    "Under memory pressure, switch a partial agg to pass-through instead "
-    "of spilling its buffer.", category="operator")
+PARTIAL_AGG_SKIPPING_ON_SPILL = bool_conf(
+    "auron.tpu.partialAgg.skipping.onSpill", False,
+    "Under memory pressure, switch an eligible partial agg to pass-through "
+    "instead of spilling its buffer (skip-before-spill; off keeps the "
+    "reference's spill-before-skip ordering).", category="operator",
+    alt_keys=("auron.partialAggSkipping.skipSpill",))
+#: Back-compat alias (pre-rename name).
+PARTIAL_AGG_SKIPPING_SKIP_SPILL = PARTIAL_AGG_SKIPPING_ON_SPILL
 PARQUET_MAX_OVER_READ_SIZE = int_conf(
     "auron.parquet.maxOverReadSize", 16384,
     "Coalesce adjacent column-chunk reads separated by at most this many "

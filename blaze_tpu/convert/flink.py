@@ -79,10 +79,12 @@ _FNS = {"UPPER": "upper", "LOWER": "lower", "CHAR_LENGTH": "length",
 
 def _op_name(call: dict) -> str:
     """CALL operator: `internalName` "$OP$1" (compiled plan) or a bare
-    `operator` field."""
+    `operator` field.  Calcite spells some internal aggregates with a
+    leading dollar and no suffix ("$SUM0") — strip that too, or the
+    lookup key never matches."""
     name = call.get("internalName") or call.get("operator") or ""
     m = re.fullmatch(r"\$(.+)\$\d+", name)
-    return (m.group(1) if m else name).upper()
+    return (m.group(1) if m else name.lstrip("$")).upper()
 
 
 # ---------------------------------------------------------------------------
@@ -130,7 +132,14 @@ def convert_agg_call(call: dict) -> Dict[str, Any]:
                               f"DISTINCT {name} has no native kernel")
     if name == "COUNT" and not args:
         args = [{"kind": "literal", "value": 1, "type": {"id": "int64"}}]
-    return {"fn": fns[name], "args": args}
+    spec = {"fn": fns[name], "args": args}
+    if name == "SUM0":
+        # Calcite SUM0 ($SUM0): sum that returns 0 — not NULL — for a
+        # group whose values are all NULL.  Lowered as coalesce(sum, 0)
+        # over the FINAL/COMPLETE output (the partial accumulator must
+        # stay null-preserving for the merge)
+        spec["zero_on_null"] = True
+    return spec
 
 
 def convert_rex(node: dict) -> Dict[str, Any]:
@@ -266,6 +275,7 @@ def _convert_group_aggregate(node: dict, child: Dict[str, Any],
             "stream-exec-global-group-aggregate": "final",
             "stream-exec-group-aggregate": "complete"}[ntype]
     aggs = []
+    zero_on_null = []  # agg positions needing coalesce(out, 0) (SUM0)
     if mode == "final":
         pos = len(grouping)
         for i, call in enumerate(calls):
@@ -275,6 +285,8 @@ def _convert_group_aggregate(node: dict, child: Dict[str, Any],
                          "name": str(call.get("name") or f"agg{i}"),
                          "args": [{"kind": "column", "index": pos + t}
                                   for t in range(nacc)]})
+            if spec.get("zero_on_null"):
+                zero_on_null.append(i)
             pos += nacc
         groupings = [{"expr": {"kind": "column", "index": i},
                       "name": f"g{g}"}
@@ -285,10 +297,33 @@ def _convert_group_aggregate(node: dict, child: Dict[str, Any],
             aggs.append({"fn": spec["fn"], "mode": mode,
                          "name": str(call.get("name") or f"agg{i}"),
                          "args": spec["args"]})
+            if spec.get("zero_on_null") and mode == "complete":
+                zero_on_null.append(i)
         groupings = [{"expr": {"kind": "column", "index": g},
                       "name": f"g{g}"} for g in grouping]
-    return {"kind": "hash_agg", "groupings": groupings,
-            "aggs": aggs, "input": child}
+    agg = {"kind": "hash_agg", "groupings": groupings,
+           "aggs": aggs, "input": child}
+    if not zero_on_null:
+        return agg
+    # SUM0 finalization: output columns are groupings then one column
+    # per agg; replace the SUM0 outputs with coalesce(col, 0) — the
+    # Coalesce kernel casts the int64 zero to the sum's own type
+    ng = len(groupings)
+    exprs, names = [], []
+    for j in range(ng):
+        exprs.append({"kind": "column", "index": j})
+        names.append(groupings[j]["name"])
+    zeros = set(zero_on_null)
+    for i, a in enumerate(aggs):
+        c = {"kind": "column", "index": ng + i}
+        if i in zeros:
+            c = {"kind": "coalesce", "args": [
+                c, {"kind": "literal", "value": 0,
+                    "type": {"id": "int64"}}]}
+        exprs.append(c)
+        names.append(a["name"])
+    return {"kind": "project", "input": agg, "exprs": exprs,
+            "names": names}
 
 
 def _convert_source(node: dict, num_partitions: int) -> Dict[str, Any]:

@@ -95,11 +95,14 @@ class BinaryExpr(PhysicalExpr):
         BATCH, and re-deriving child types walks the whole subtree —
         quadratic in expression depth without the cache."""
         cached = getattr(self, "_ct_cache", None)
-        if cached is not None and cached[0] == id(schema):
+        if cached is not None and cached[0] is schema:
             return cached[1], cached[2]
         lt = self.left.data_type(schema)
         rt = self.right.data_type(schema)
-        object.__setattr__(self, "_ct_cache", (id(schema), lt, rt))
+        # hold the schema itself, not id(schema): a freed schema's id can
+        # be reused by a NEW schema at the same address, silently serving
+        # stale types (keeping the reference alive also pins the id)
+        object.__setattr__(self, "_ct_cache", (schema, lt, rt))
         return lt, rt
 
     def evaluate(self, batch: ColumnBatch) -> ColVal:
@@ -140,12 +143,10 @@ class BinaryExpr(PhysicalExpr):
         mask = batch.row_mask()
         both = _both_valid(a, b) & mask
         xp = xp_of(a.data, b.data)
-        is_int = not (jnp.issubdtype(a.data.dtype, jnp.floating) or
-                      jnp.issubdtype(b.data.dtype, jnp.floating))
-        if self.op in ("/", "%", "pmod") and is_int:
-            # the non-ANSI kernel encodes /0 as result-null; a row that
-            # was valid on both inputs but null in the output divided
-            # by zero
+        if self.op in ("/", "%", "pmod"):
+            # the non-ANSI kernel encodes /0 as result-null for every
+            # numeric type (DivModLike); a row that was valid on both
+            # inputs but null in the output divided by zero
             lost = both & ~out.validity
             if bool(xp_of(lost).any(lost)):
                 raise ValueError(
@@ -266,7 +267,10 @@ def _arith(op: str, a: ColVal, b: ColVal, out_dtype: DataType) -> ColVal:
     valid = _both_valid(a, b)
     is_float = jnp.issubdtype(x.dtype, jnp.floating)
 
-    if op in ("/", "%", "pmod") and not is_float:
+    if op in ("/", "%", "pmod"):
+        # Spark DivModLike: divisor == 0 -> NULL for ALL numeric types in
+        # non-ANSI mode — double division by literal zero is NULL, not
+        # ±Inf (Inf/NaN only arise from non-zero divisor math below)
         zero = y == 0
         valid = valid & ~zero
         y = xp.where(zero, xp.ones_like(y), y)  # avoid div-by-zero traps
@@ -280,7 +284,7 @@ def _arith(op: str, a: ColVal, b: ColVal, out_dtype: DataType) -> ColVal:
             data = x * y
         elif op == "/":
             if is_float:
-                data = x / y      # inf/nan like Spark double division
+                data = x / y      # zero divisors already nulled above
             elif a.dtype.id == TypeId.DECIMAL or b.dtype.id == TypeId.DECIMAL:
                 data = x // y     # decimal div handled by planner rescale
             else:

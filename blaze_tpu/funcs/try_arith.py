@@ -26,8 +26,8 @@ import pyarrow as pa
 
 from blaze_tpu.exprs.base import ColVal
 from blaze_tpu.funcs import register
-from blaze_tpu.schema import (DataType, FLOAT64, INT8, INT16, INT32,
-                              INT64, TypeId)
+from blaze_tpu.schema import (DataType, FLOAT32, FLOAT64, INT8, INT16,
+                              INT32, INT64, TypeId)
 
 _INT_ORDER = [("int8", INT8, 8), ("int16", INT16, 16),
               ("int32", INT32, 32), ("int64", INT64, 64)]
@@ -53,10 +53,19 @@ def _promoted_int(lt: DataType, rt: DataType) -> DataType:
     return INT64
 
 
+def _promoted_float(lt: DataType, rt: DataType) -> DataType:
+    """Spark numeric precedence: ints < float < double — float32 mixed
+    with any integral stays FLOAT32; only a float64 operand widens the
+    result to double (try_divide alone is always double)."""
+    if TypeId.FLOAT64 in (lt.id, rt.id):
+        return FLOAT64
+    return FLOAT32
+
+
 def _try_type_fn(op):
     """Result type: Spark's decimal widening when decimals are
     involved, double for try_divide, else the operands' promoted
-    integer width / double for float mixes."""
+    integer width / highest-precedence float for float mixes."""
     def tf(ts):
         from blaze_tpu.exprs import decimal_arith as D
         lt = ts[0] if ts else INT64
@@ -67,7 +76,7 @@ def _try_type_fn(op):
         if op == "/":
             return FLOAT64
         if lt.is_floating or rt.is_floating:
-            return FLOAT64
+            return _promoted_float(lt, rt)
         return _promoted_int(lt, rt)
     return tf
 
@@ -117,7 +126,7 @@ def _try_binary(op):
             from blaze_tpu.exprs.binary import _arith
             da = a.to_device(batch.capacity)
             db = b.to_device(batch.capacity)
-            return _arith(op, da, db, FLOAT64)
+            return _arith(op, da, db, _promoted_float(a.dtype, b.dtype))
         return _try_int_arith(op, a, b, batch,
                               _promoted_int(a.dtype, b.dtype))
     return fn

@@ -1581,10 +1581,10 @@ def _ansi_arithmetic():
              [_bin("*", _col(0), _lit(4)),
               _bin("%", _col(0), _lit(2))],
              [(12, 1)], confs=_ANSI_ON),
-        Case("ANSI: float division by zero is Infinity, not an error",
+        Case("ANSI: float division by zero raises DIVIDE_BY_ZERO",
              pa.table({"a": pa.array([1.0])}),
              [_bin("/", _col(0), _lit(0.0, "float64"))],
-             [(INF,)], confs=_ANSI_ON),
+             [], confs=_ANSI_ON, raises="DIVIDE_BY_ZERO"),
         Case("ANSI: filtered-out rows cannot raise",
              pa.table({"a": pa.array([10, 10]),
                        "b": pa.array([2, 0])}),

@@ -70,6 +70,16 @@ class MemConsumer:
         """Release memory down a tier; returns bytes released."""
         raise NotImplementedError
 
+    def try_release_pressure(self) -> int:
+        """Cheaper-than-spill release under pressure, if the consumer has
+        one; returns bytes released (0 = nothing cheap, spill() follows).
+
+        The one current implementor is the partial-agg state: with
+        auron.tpu.partialAgg.skipping.onSpill it hands its buffered
+        partials downstream un-merged (mode switch to pass-through)
+        instead of paying spill IO the final stage must re-read anyway."""
+        return 0
+
     def unregister(self) -> None:
         if self._manager is not None:
             self._manager.unregister_consumer(self)
@@ -88,6 +98,7 @@ class MemManager:
         self._consumers: List[MemConsumer] = []
         self.total_spill_count = 0
         self.total_spilled_bytes = 0
+        self.total_pressure_releases = 0
         self.peak_used = 0
 
     # -- singleton wiring (ref MemManager::init, lib.rs:46) ---------------
@@ -147,11 +158,18 @@ class MemManager:
             if overflow <= 0 and updated.mem_used <= cap * 2:
                 return
             # spill biggest consumers until under budget (ref lib.rs: spill
-            # of the biggest consumer on pressure)
+            # of the biggest consumer on pressure).  A consumer offering a
+            # cheaper-than-spill release (partial-agg pass-through switch)
+            # is taken at its word first — the released partials stream
+            # downstream instead of hitting spill IO.
             for c in sorted(self._consumers, key=lambda c: -c.mem_used):
                 if self.mem_used <= self.total * MEM_SPILL_FACTOR:
                     break
                 if c.mem_used == 0:
+                    continue
+                released = c.try_release_pressure()
+                if released > 0:
+                    self.total_pressure_releases += 1
                     continue
                 released = c.spill()
                 self.total_spill_count += 1
@@ -162,7 +180,8 @@ class MemManager:
         with self._lock:
             lines = [f"MemManager total={self.total} used={self.mem_used} "
                      f"spills={self.total_spill_count} "
-                     f"spilled_bytes={self.total_spilled_bytes}"]
+                     f"spilled_bytes={self.total_spilled_bytes} "
+                     f"pressure_releases={self.total_pressure_releases}"]
             for c in self._consumers:
                 lines.append(f"  {c.name}: used={c.mem_used}")
             return "\n".join(lines)

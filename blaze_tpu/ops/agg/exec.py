@@ -170,30 +170,52 @@ class _AggState(MemConsumer):
         self.skipping = False
         self.rows_seen = 0
         self.groups_emitted = 0
+        self.passthrough_rows = 0
+        self._probe_done = False  # the cardinality probe runs ONCE
         self._internal_schema: Optional[pa.Schema] = None
 
     # ------------------------------------------------------------------
     # ingest
     # ------------------------------------------------------------------
     def process(self, batch: ColumnBatch) -> Iterator[pa.RecordBatch]:
+        if self.skipping:
+            # pass-through lane: no lexsort, no compaction, no dict
+            # encode/decode round trip, no spill — raw rows leave as
+            # accumulator-shaped batches, each row its own group (the
+            # partial-unmerged form PartialMerge/Final already handle)
+            if self.flush_pending:
+                pending, self.flush_pending = self.flush_pending, []
+                yield from self._emit(pending)
+            n = batch.selected_count()
+            if n == 0:
+                return
+            self.rows_seen += n
+            out = self._passthrough_batch(batch)
+            if out is not None:
+                yield out
+            return
         partial = self._aggregate_input_batch(batch)
         if partial is None:
             return
         self.rows_seen += batch.selected_count()
-        if self.skipping:
-            if self.flush_pending:
-                pending, self.flush_pending = self.flush_pending, []
-                yield from self._emit(pending)
-            yield from self._emit([partial])
-            return
         self.buffer.append(partial)
         self.buffered_bytes += partial.nbytes
         self.update_mem_used(self.buffered_bytes + self._dict_bytes())
+        if self.skipping:
+            # update_mem_used hit memory pressure and the manager took
+            # our try_release_pressure() offer mid-update: the buffer
+            # already moved to flush_pending; drain it now
+            if self.flush_pending:
+                pending, self.flush_pending = self.flush_pending, []
+                yield from self._emit(pending)
+            return
         if self._should_skip_partials():
             # flush everything downstream un-merged from now on
             # (ref AGG_TRIGGER_PARTIAL_SKIPPING, agg_table.rs:108-122)
             self.skipping = True
             self.op.metrics.add("partial_skipped", 1)
+            from blaze_tpu.bridge import xla_stats
+            xla_stats.note_partial_agg_skip(self.rows_seen)
             flushed, self.buffer, self.buffered_bytes = self.buffer, [], 0
             self.update_mem_used(self._dict_bytes())
             yield from self._emit(flushed)
@@ -202,20 +224,86 @@ class _AggState(MemConsumer):
         if sum(rb.num_rows for rb in self.buffer) >= limit * 2:
             self._combine_buffer()
 
+    def _skip_eligible(self) -> bool:
+        """Pass-through preserves semantics only for keyed all-PARTIAL
+        device aggs: host accumulators (collect/bloom/UDAF/min-max over
+        strings) and merge/final stages must keep hashing."""
+        return (bool(self.op._aggs)
+                and all(m == AggMode.PARTIAL for _, m, _ in self.op._aggs)
+                and self.num_keys > 0
+                and not any(fn.is_host for fn, _, _ in self.op._aggs))
+
     def _should_skip_partials(self) -> bool:
-        if not (self.op._aggs and all(m == AggMode.PARTIAL for _, m, _
-                                      in self.op._aggs)):
-            return False
-        if not self.num_keys or any(fn.is_host for fn, _, _ in self.op._aggs):
+        if self._probe_done or not self._skip_eligible():
             return False
         if not config.PARTIAL_AGG_SKIPPING_ENABLE.get():
             return False
         if self.rows_seen < config.PARTIAL_AGG_SKIPPING_MIN_ROWS.get():
             return False
+        # one-shot probe at the end of the minRows window (the reference
+        # checks once when num_records crosses partial_skipping_min_rows,
+        # agg_table.rs:108-122) — re-probing every batch would re-merge
+        # the buffer per batch just to re-learn the same answer
+        self._probe_done = True
         self._combine_buffer()
         distinct = sum(rb.num_rows for rb in self.buffer)
         ratio = distinct / max(1, self.rows_seen)
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_partial_agg_probe(self.rows_seen, distinct)
         return ratio > config.PARTIAL_AGG_SKIPPING_RATIO.get()
+
+    # ------------------------------------------------------------------
+    # pass-through lane (the AGG_TRIGGER_PARTIAL_SKIPPING fast path)
+    # ------------------------------------------------------------------
+    def _passthrough_batch(self, batch: ColumnBatch) -> Optional[ColumnBatch]:
+        """One raw input batch -> ONE accumulator-shaped output batch with
+        each row its own group.  Per-row accumulators come from
+        partial_update over IDENTITY group ids (acc row i depends only on
+        input row i — no cross-row reduction happens), so every agg
+        function's unmerged state is produced by the same code the sorted
+        engine uses, and the final merge is bit-identical.  Group keys
+        leave as raw values: the per-operator dictionary never grows."""
+        op = self.op
+        cb = batch.compact()  # no-op unless a selection mask is pending
+        n = cb.num_rows
+        if n == 0:
+            return None
+        cap = cb.capacity
+        xp = cb._xp()
+        sink = _ArrowSink()
+        for e, _name in op._group_exprs:
+            cv = e.evaluate(cb)
+            if cv.is_device:
+                sink.add_device(cv.data, cv.validity, n)
+            else:
+                sink.add_host(cv.to_host(n))
+        gids = xp.arange(cap)
+        from blaze_tpu.ops.agg.functions import CountAgg
+        for fn, _mode, _name in op._aggs:
+            args = []
+            for c in (c.evaluate(cb) for c in fn.children):
+                if not c.dtype.is_fixed_width and isinstance(fn, CountAgg):
+                    # count(utf8_col): only validity feeds the kernel
+                    # (same contract as _aggregate_input_batch)
+                    av = np.zeros(cap, dtype=bool)
+                    av[:len(c.array)] = np.asarray(c.array.is_valid())
+                    av = av if xp is np else jnp.asarray(av)
+                    args.append((av.astype(xp.int8), av))
+                    continue
+                dv = c.to_device(cap)
+                args.append((dv.data, dv.validity))
+            for ad, av in fn.partial_update(args, gids, cap):
+                sink.add_device(ad, av, n)
+        out_schema = op.schema.to_arrow()
+        arrays = [_cast_output(a, f.type)
+                  for a, f in zip(sink.materialize(), out_schema)]
+        out = pa.RecordBatch.from_arrays(arrays, schema=out_schema)
+        self.passthrough_rows += n
+        self.groups_emitted += n
+        self.op.metrics.add("passthrough_rows", n)
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_partial_agg_rows(n)
+        return ColumnBatch.from_arrow(out)
 
     # ------------------------------------------------------------------
     # one input batch -> one partial batch (keys + accs, one row per group)
@@ -470,25 +558,33 @@ class _AggState(MemConsumer):
         return pa.RecordBatch.from_arrays(sink.materialize(),
                                           schema=self._internal_schema)
 
+    def try_release_pressure(self) -> int:
+        if not (config.PARTIAL_AGG_SKIPPING_ON_SPILL.get() and
+                not self.skipping and not self._output_started and
+                self.buffer and self._skip_eligible()):
+            return 0
+        # under pressure, hand the buffered partials downstream un-merged
+        # and switch to pass-through instead of paying spill IO the final
+        # stage must re-read anyway: process()/output() drain
+        # flush_pending at the next pull
+        # (ref auron.partialAggSkipping.skipSpill)
+        self.skipping = True
+        self._probe_done = True
+        self.flush_pending.extend(self.buffer)
+        released = self.buffered_bytes
+        self.buffer = []
+        self.buffered_bytes = 0
+        self._mem_used = self._dict_bytes()  # dict cannot spill
+        self.op.metrics.add("partial_skipped", 1)
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_partial_agg_skip(self.rows_seen, on_spill=True)
+        return released
+
     def spill(self) -> int:
         if not self.buffer:
             return 0
-        if (config.PARTIAL_AGG_SKIPPING_SKIP_SPILL.get() and
-                not self.skipping and not self._output_started and
-                self.num_keys and self.op._aggs and
-                all(m == AggMode.PARTIAL for _, m, _ in self.op._aggs) and
-                not any(fn.is_host for fn, _, _ in self.op._aggs)):
-            # under pressure, hand the buffered partials downstream
-            # un-merged instead of spilling: process()/output() drain
-            # flush_pending at the next pull
-            # (ref auron.partialAggSkipping.skipSpill)
-            self.skipping = True
-            self.flush_pending.extend(self.buffer)
-            released = self.buffered_bytes
-            self.buffer = []
-            self.buffered_bytes = 0
-            self._mem_used = self._dict_bytes()  # dict cannot spill
-            self.op.metrics.add("partial_skipped", 1)
+        released = self.try_release_pressure()
+        if released:
             return released
         self._combine_buffer()
         if not self.buffer:

@@ -148,6 +148,18 @@ class QueryProfile:
                 f"fused_batches={x.get('expr_fused_batches', 0)} "
                 f"eager_batches={x.get('expr_eager_batches', 0)} "
                 f"evictions={x.get('expr_program_evictions', 0)}")
+        if x.get("partial_agg_skip_events") or x.get("partial_agg_probe_rows"):
+            probe_rows = x.get("partial_agg_probe_rows", 0)
+            ratio = (x.get("partial_agg_probe_groups", 0) / probe_rows
+                     if probe_rows else 0.0)
+            events = x.get("partial_agg_skip_events", 0)
+            switch_row = (x.get("partial_agg_switch_rows", 0) // events
+                          if events else 0)
+            lines.append(
+                f"partial agg: probe_ratio={ratio:.2f} "
+                f"skip_events={events} switch_row={switch_row} "
+                f"passed_rows={x.get('partial_agg_skipped_rows', 0)} "
+                f"spill_switches={x.get('partial_agg_spill_switches', 0)}")
         if any(x.get(k) for k in ("task_retries", "task_failures",
                                   "fetch_failures", "stage_recoveries",
                                   "faults_injected")):

@@ -40,6 +40,7 @@ import pyarrow as pa
 
 from blaze_tpu import config
 from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.bridge import xla_stats
 from blaze_tpu.bridge.xla_stats import meter_jit
 from blaze_tpu.exprs import BoundReference, PhysicalExpr
 from blaze_tpu.ops.agg.exec import AggExec, AggMode
@@ -692,6 +693,7 @@ class FusedPartialAggExec(ExecutionPlan):
                 if tbl is None or tbl.num_rows == 0:
                     continue
                 if skipping:
+                    xla_stats.note_partial_agg_rows(tbl.num_rows)
                     yield from self._host_passthrough(tbl, key_names)
                     continue
                 rows_seen += tbl.num_rows
@@ -721,15 +723,21 @@ class FusedPartialAggExec(ExecutionPlan):
                         min(skip_min,
                             config.PARTIAL_AGG_SKIPPING_PROBE_ROWS.get()))
                     n_distinct = self._probe_distinct(probe, key_names)
+                    xla_stats.note_partial_agg_probe(probe.num_rows,
+                                                     n_distinct)
                     if (n_distinct / max(1, probe.num_rows)
                             > skip_ratio):
                         skipping = True
                         self.metrics.add("partial_skipped", 1)
+                        xla_stats.note_partial_agg_skip(rows_seen)
                         if state["merged"] is not None:
                             yield from self._emit_host(state["merged"],
                                                        key_names)
                             state["merged"] = None
                         for c in state["chunks"]:
+                            # buffered raw chunks leave UNAGGREGATED —
+                            # they are pass-through rows too
+                            xla_stats.note_partial_agg_rows(c.num_rows)
                             yield from self._host_passthrough(c, key_names)
                         state["chunks"] = []
                         state["rows"] = 0
@@ -1763,15 +1771,18 @@ class FusedPartialAggExec(ExecutionPlan):
                 c, *self._device_inputs(b))
         key_dtypes = [e.data_type(self._in_schema).jnp_dtype()
                       for e, _n in self._group_exprs]
+        rows_seen = 0
         for batch in stream:
             if skipping:
                 # batch-local dedup then pass through (downstream
                 # re-merges) — ref AGG_TRIGGER_PARTIAL_SKIPPING,
                 # agg_table.rs:108-122
+                xla_stats.note_partial_agg_rows(batch.selected_count())
                 yield from self._emit_hash(
                     self._insert_batch_local(step, key_dtypes, kinds,
                                              batch))
                 continue
+            rows_seen += batch.selected_count()
             if carry is None:
                 carry = init_hash_carry(key_dtypes, kinds,
                                         self._acc_dtypes(), slots)
@@ -1792,6 +1803,7 @@ class FusedPartialAggExec(ExecutionPlan):
             if new_carry is None:
                 skipping = True
                 self.metrics.add("partial_skipped", 1)
+                xla_stats.note_partial_agg_skip(rows_seen)
                 if carry is not None:
                     yield from self._emit_hash(carry)
                     carry = None

@@ -46,6 +46,8 @@ REFERENCE_KEYS = [
 
 def test_every_reference_key_is_defined():
     defined = {o["key"] for o in config.describe_all()}
+    for o in config.describe_all():
+        defined.update(o["alt_keys"])
     missing = [k for k in REFERENCE_KEYS if k not in defined]
     assert not missing, f"missing reference keys: {missing}"
 

@@ -174,4 +174,11 @@ def test_float_mod_and_nan():
     out = col_py(b, BinaryExpr("%", col(0), col(1)))
     assert out[0] == pytest.approx(1.5)
     assert np.isnan(out[1])
-    assert np.isnan(out[2])  # float % 0.0 -> NaN (Spark double semantics)
+    # Spark DivModLike: divisor 0 -> NULL for doubles too (non-ANSI)
+    assert out[2] is None
+
+
+def test_float_divide_by_zero_is_null():
+    b = make_batch(a=[1.0, -2.5, 7.0], b=[0.0, 0.0, 2.0])
+    out = col_py(b, BinaryExpr("/", col(0), col(1)))
+    assert out == [None, None, 3.5]

@@ -1,0 +1,259 @@
+"""Adaptive partial-aggregate skipping (ref AGG_TRIGGER_PARTIAL_SKIPPING,
+agg_table.rs:108-122): the ratio probe, the pass-through lane, the
+memory-pressure mode switch, and bit-exactness of the final merge."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import config
+from blaze_tpu import schema as S
+from blaze_tpu.bridge import xla_stats
+from blaze_tpu.exprs import col
+from blaze_tpu.memory import MemManager
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.ops.agg import AggExec, AggMode, make_agg
+
+
+@pytest.fixture(autouse=True)
+def big_budget():
+    MemManager.init(4 << 30)
+    yield
+    MemManager.init(4 << 30)
+
+
+def partial_agg(table, group_cols, aggs, batch_rows=512, **conf):
+    scan = MemoryScanExec.from_arrow(table, batch_rows=batch_rows)
+    schema = S.Schema.from_arrow(table.schema)
+    group_exprs = [(col(schema.index_of(c), c), c) for c in group_cols]
+    agg_list = []
+    for fname, in_col, out_name in aggs:
+        children = [col(schema.index_of(in_col), in_col)] if in_col else []
+        agg_list.append((make_agg(fname, children), AggMode.PARTIAL,
+                         out_name))
+    plan = AggExec(scan, group_exprs, agg_list)
+    with config.scoped(**conf):
+        return plan.execute_collect().to_arrow(), plan
+
+
+def finalize(partial_tbl, num_group_cols, specs):
+    """Final-stage merge over a partial-form table: specs are
+    (fname, nacc) per agg in order, acc columns positional."""
+    scan = MemoryScanExec.from_arrow(partial_tbl)
+    names = partial_tbl.schema.names
+    groups = [(col(i, names[i]), names[i]) for i in range(num_group_cols)]
+    aggs, pos = [], num_group_cols
+    for fname, nacc in specs:
+        mode = AggMode.FINAL if fname == "avg" else AggMode.PARTIAL_MERGE
+        aggs.append((make_agg(fname, [col(pos + t) for t in range(nacc)]),
+                     mode, fname))
+        pos += nacc
+    plan = AggExec(scan, groups, aggs)
+    return plan.execute_collect().to_arrow()
+
+
+def sort_table(t):
+    keys = [(n, "ascending") for n in t.schema.names]
+    return t.take(pa.compute.sort_indices(t, sort_keys=keys))
+
+
+HIGH_NDV_CONF = {"auron.tpu.partialAgg.skipping.minRows": 1000,
+                 "auron.tpu.partialAgg.skipping.ratio": 0.5}
+
+
+def _high_ndv_table(n=6000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, n * 8, n)),
+        "v": pa.array(rng.integers(-50, 50, n)),
+    })
+
+
+def test_ratio_probe_triggers_switch():
+    t = _high_ndv_table()
+    got, plan = partial_agg(t, ["k"], [("count", "v", "c")],
+                            **HIGH_NDV_CONF)
+    assert plan.metrics.get("partial_skipped") == 1
+    assert plan.metrics.get("passthrough_rows") > 0
+    # every input row is represented exactly once across the mixed
+    # hashed-prefix + pass-through-tail output
+    assert sum(got.column("c.count").to_pylist()) == t.num_rows
+
+
+def test_low_cardinality_never_switches():
+    n = 6000
+    t = pa.table({"k": pa.array(np.arange(n) % 5),
+                  "v": pa.array(np.ones(n, dtype=np.int64))})
+    got, plan = partial_agg(t, ["k"], [("count", "v", "c")],
+                            **HIGH_NDV_CONF)
+    assert plan.metrics.get("partial_skipped") == 0
+    assert got.num_rows == 5
+
+
+def test_min_rows_gates_the_probe():
+    # high-NDV input that ENDS before the probe window does: no switch
+    t = _high_ndv_table(n=800)
+    got, plan = partial_agg(
+        t, ["k"], [("count", "v", "c")],
+        **{"auron.tpu.partialAgg.skipping.minRows": 100000,
+           "auron.tpu.partialAgg.skipping.ratio": 0.0})
+    assert plan.metrics.get("partial_skipped") == 0
+
+
+def test_enable_off_never_switches():
+    t = _high_ndv_table()
+    got, plan = partial_agg(
+        t, ["k"], [("count", "v", "c")],
+        **dict(HIGH_NDV_CONF,
+               **{"auron.tpu.partialAgg.skipping.enable": False}))
+    assert plan.metrics.get("partial_skipped") == 0
+
+
+def test_final_results_bit_identical_across_modes():
+    """sum/count/avg/min/max over INTEGER values: the skipped partial
+    stream must merge to the byte-identical final table."""
+    rng = np.random.default_rng(3)
+    n = 8000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, n * 4, n)),
+        "ks": pa.array([f"g{int(x):05d}" for x in rng.integers(0, n * 4, n)]),
+        "v": pa.array(np.where(rng.random(n) < 0.1, None,
+                               rng.integers(-100, 100, n)).tolist(),
+                      type=pa.int64()),
+    })
+    aggs = [("sum", "v", "s"), ("count", "v", "c"), ("avg", "v", "a"),
+            ("min", "v", "mn"), ("max", "v", "mx")]
+    specs = [("sum", 1), ("count", 1), ("avg", 2), ("min", 1), ("max", 1)]
+    p_on, plan_on = partial_agg(
+        t, ["k", "ks"], aggs,
+        **{"auron.tpu.partialAgg.skipping.minRows": 500,
+           "auron.tpu.partialAgg.skipping.ratio": 0.5})
+    p_off, plan_off = partial_agg(
+        t, ["k", "ks"], aggs,
+        **{"auron.tpu.partialAgg.skipping.enable": False})
+    assert plan_on.metrics.get("partial_skipped") == 1
+    assert plan_off.metrics.get("partial_skipped") == 0
+    assert p_on.schema == p_off.schema  # same partial wire schema
+    f_on = sort_table(finalize(p_on, 2, specs))
+    f_off = sort_table(finalize(p_off, 2, specs))
+    assert f_on.equals(f_off)
+
+
+def test_distinct_style_two_level_rollup_identical():
+    """count-distinct rollup shape: inner partial group-by (k, v) with
+    skipping forced, outer count over the merged inner — identical to
+    the unskipped rollup."""
+    rng = np.random.default_rng(5)
+    n = 5000
+    t = pa.table({"k": pa.array(rng.integers(0, 40, n)),
+                  "v": pa.array(rng.integers(0, n, n))})
+
+    def rollup(skip):
+        conf = ({"auron.tpu.partialAgg.skipping.minRows": 200,
+                 "auron.tpu.partialAgg.skipping.ratio": 0.1} if skip
+                else {"auron.tpu.partialAgg.skipping.enable": False})
+        inner, plan = partial_agg(t, ["k", "v"], [("count", "v", "c")],
+                                  **conf)
+        assert bool(plan.metrics.get("partial_skipped")) is skip
+        # merge the (possibly repeated) inner keys, then count distinct
+        # v per k = rows per k of the merged inner table
+        merged = finalize(inner, 2, [("count", 1)])
+        df = merged.to_pandas().groupby("k").size().sort_index()
+        return df
+
+    pd.testing.assert_series_equal(rollup(True), rollup(False))
+
+
+def test_memory_pressure_prefers_passthrough_over_spill():
+    rng = np.random.default_rng(2)
+    n = 50000
+    t = pa.table({"k": pa.array(rng.integers(0, 5000, n)),
+                  "v": pa.array(np.ones(n, dtype=np.int64))})
+    MemManager.init(150_000)
+    mm = MemManager.get()
+    got, plan = partial_agg(
+        t, ["k"], [("count", "v", "c")], batch_rows=4096,
+        **{"auron.tpu.partialAgg.skipping.onSpill": True,
+           "auron.tpu.partialAgg.skipping.ratio": 1.1})
+    assert plan.metrics.get("spill_count") == 0
+    assert plan.metrics.get("partial_skipped") == 1
+    assert mm.total_pressure_releases >= 1
+    totals = {}
+    for k, c in zip(got.column("k").to_pylist(),
+                    got.column("c.count").to_pylist()):
+        totals[k] = totals.get(k, 0) + c
+    want = t.to_pandas().groupby("k").v.count()
+    assert totals == {k: int(v) for k, v in want.items()}
+
+
+def test_on_spill_off_still_spills():
+    rng = np.random.default_rng(2)
+    n = 50000
+    t = pa.table({"k": pa.array(rng.integers(0, 5000, n)),
+                  "v": pa.array(np.ones(n, dtype=np.int64))})
+    MemManager.init(150_000)
+    got, plan = partial_agg(
+        t, ["k"], [("count", "v", "c")], batch_rows=4096,
+        **{"auron.tpu.partialAgg.skipping.ratio": 1.1})
+    assert plan.metrics.get("spill_count") >= 1
+    assert plan.metrics.get("partial_skipped") == 0
+
+
+def test_skip_and_spill_interleave():
+    """Spill (onSpill off) during the probe window, then the ratio
+    probe still switches: spilled runs + flush + pass-through tail all
+    merge to the right totals."""
+    rng = np.random.default_rng(9)
+    n = 40000
+    t = pa.table({"k": pa.array(rng.integers(0, n * 8, n)),
+                  "v": pa.array(np.ones(n, dtype=np.int64))})
+    MemManager.init(400_000)
+    got, plan = partial_agg(
+        t, ["k"], [("count", "v", "c")], batch_rows=2048,
+        **{"auron.tpu.partialAgg.skipping.minRows": 20000,
+           "auron.tpu.partialAgg.skipping.ratio": 0.5})
+    assert plan.metrics.get("partial_skipped") == 1
+    assert sum(got.column("c.count").to_pylist()) == n
+
+
+def test_xla_stats_counters_and_explain_footer():
+    xla_stats.reset()
+    t = _high_ndv_table()
+    before = xla_stats.snapshot()
+    _got, _plan = partial_agg(t, ["k"], [("count", "v", "c")],
+                              **HIGH_NDV_CONF)
+    d = xla_stats.delta(before)
+    assert d["partial_agg_skip_events"] == 1
+    assert d["partial_agg_skipped_rows"] > 0
+    assert d["partial_agg_probe_rows"] >= 1000
+    assert d["partial_agg_probe_groups"] > 0
+    assert d["partial_agg_switch_rows"] > 0
+    from blaze_tpu.bridge.metrics import MetricNode
+    from blaze_tpu.plan.explain import QueryProfile
+    prof = QueryProfile(query_id="t", wall_ns=1,
+                        tree=MetricNode("root"), partitions=1,
+                        exec_mode="local", xla=d)
+    text = prof.render_text()
+    assert "partial agg:" in text
+    assert "probe_ratio=" in text and "skip_events=1" in text
+
+
+def test_passthrough_respects_selection_mask():
+    """A filtered batch entering the pass-through lane must only emit
+    SELECTED rows (compaction, not capacity, defines the group count)."""
+    n = 4000
+    t = pa.table({"k": pa.array(np.arange(n)),
+                  "v": pa.array(np.ones(n, dtype=np.int64))})
+    from blaze_tpu.exprs import BinaryExpr, lit
+    from blaze_tpu.ops import FilterExec
+    scan = MemoryScanExec.from_arrow(t, batch_rows=512)
+    filt = FilterExec(scan, [BinaryExpr("<", col(0, "k"), lit(n // 2))])
+    plan = AggExec(filt, [(col(0, "k"), "k")],
+                   [(make_agg("sum", [col(1, "v")]), AggMode.PARTIAL, "s")])
+    with config.scoped(**{"auron.tpu.partialAgg.skipping.minRows": 256,
+                          "auron.tpu.partialAgg.skipping.ratio": 0.5}):
+        got = plan.execute_collect().to_arrow()
+    assert plan.metrics.get("partial_skipped") == 1
+    assert sum(got.column("s.sum").to_pylist()) == n // 2
+    assert max(got.column("k").to_pylist()) == n // 2 - 1
