@@ -1704,6 +1704,253 @@ def aggskip_bench_main() -> int:
     return 1 if bad else 0
 
 
+# ===========================================================================
+# --multichip: mesh-sharded map-stage scaling + device-shuffle legs (ISSUE 6)
+# ===========================================================================
+
+MULTICHIP_TIMEOUT_S = float(
+    os.environ.get("BLAZE_BENCH_MULTICHIP_TIMEOUT", "900"))
+
+
+def multichip_child_main() -> int:
+    """One scaling leg (`--multichip-child N [--queries]`): build an
+    N-device mesh and time the sharded map stage — partial agg +
+    on-device hash partition + ICI all-to-all + final merge as ONE
+    compiled XLA program (`distributed_grouped_agg`), the collective
+    replacement for the host-file shuffle.  Total rows are FIXED across
+    legs (strong scaling), so wall-clock should drop near-linearly with
+    mesh size on a real multi-chip backend.
+
+    With `--queries` (the widest leg) it also runs the itest trio
+    q01/q06/q95 through the staged scheduler with the device shuffle on
+    vs off (divergent_queries must be 0) and once more with a seeded
+    shard-kill mid-collective (fallback to shuffle files, still 0
+    divergence).  Prints ONE JSON line."""
+    n_req = int(sys.argv[sys.argv.index("--multichip-child") + 1])
+    platform = os.environ.get("BLAZE_BENCH_PLATFORM", "cpu")
+    if platform == "cpu":
+        # virtual host devices must be forced before jax import
+        import re
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d" % n_req
+        ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    from blaze_tpu.parallel import distributed_grouped_agg, make_mesh
+    from blaze_tpu.parallel.mesh import shard_rows
+
+    n_use = min(n_req, len(jax.devices()))
+    mesh = make_mesh(n_use)
+
+    rows = int(os.environ.get("BLAZE_BENCH_MULTICHIP_ROWS", str(1 << 20)))
+    rows -= rows % max(n_use, 1)  # NamedSharding needs even splits
+    n_groups = 4096
+    rng = np.random.default_rng(42)
+    keys = rng.integers(0, n_groups, rows, dtype=np.int64)
+    vals = rng.random(rows)
+    ones = np.ones(rows, dtype=bool)
+
+    step = distributed_grouped_agg(
+        mesh, key_specs=1, agg_specs=["sum", "count"],
+        num_slots=2 * n_groups, out_slots=2 * n_groups,
+        merge_kinds=["sum", "count"])
+    args = shard_rows(mesh, jnp.asarray(ones), jnp.asarray(keys),
+                      jnp.asarray(ones), jnp.asarray(vals),
+                      jnp.asarray(ones))
+
+    out = step(*args)  # compile + warmup
+    jax.block_until_ready(out.accs[0])
+    assert int(np.asarray(out.slot_valid).sum()) == n_groups
+    walls = []
+    for _ in range(int(os.environ.get("BLAZE_BENCH_MULTICHIP_REPS", "5"))):
+        t0 = time.perf_counter()
+        out = step(*args)
+        jax.block_until_ready(out.accs[0])
+        walls.append(time.perf_counter() - t0)
+    wall = min(walls)
+
+    rec = {
+        "n_devices_requested": n_req,
+        "n_devices": n_use,
+        "platform": jax.default_backend(),
+        "map_stage": {"rows": rows, "groups": n_groups,
+                      "wall_s": round(wall, 6),
+                      "rows_per_sec": int(rows / wall)},
+    }
+    if "--queries" in sys.argv:
+        rec["itest"] = _multichip_queries(chaos=False)
+        rec["chaos"] = _multichip_queries(chaos=True)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+    return 0
+
+
+def _multichip_queries(chaos: bool) -> dict:
+    """q01/q06/q95 through the staged DAG path: device shuffle ON vs
+    the file-shuffle baseline, `compare_frames` as the divergence
+    oracle.  chaos=True additionally kills one shard mid-collective
+    (`device-collective@1`) so every eligible exchange exercises the
+    file-shuffle fallback."""
+    import tempfile
+
+    from blaze_tpu import config, faults
+    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.itest import generate
+    from blaze_tpu.itest.queries import QUERIES
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.itest.tpcds_data import write_parquet_splits
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.plan.stages import DagScheduler
+
+    names = os.environ.get("BLAZE_BENCH_MULTICHIP_QUERIES",
+                           "q01,q06,q95").split(",")
+    scale = float(os.environ.get("BLAZE_BENCH_MULTICHIP_SCALE", "0.2"))
+    MemManager.init(4 << 30)
+    knobs = {config.DAG_SINGLE_TASK_BYTES.key: 0,
+             config.TASK_RETRY_BACKOFF_MS.key: 5}
+    for k, v in knobs.items():
+        config.conf.set(k, v)
+
+    def frame(tbl):
+        import pandas as pd
+        return tbl.to_pandas() if tbl.num_rows else pd.DataFrame(
+            {n: [] for n in tbl.schema.names})
+
+    queries = []
+    diverged = 0
+    try:
+        for qname in names:
+            qname = qname.strip()
+            builder, table_names = QUERIES[qname]
+            tables = generate(table_names, scale=scale)
+            with tempfile.TemporaryDirectory(prefix="multichip-") as d:
+                paths = write_parquet_splits(tables, d, 2)
+                plan_dict, _oracle = builder(paths, tables, 2)
+
+                faults.clear()
+                config.conf.set(config.SHUFFLE_DEVICE.key, "off")
+                base = DagScheduler(work_dir=os.path.join(d, "dag0")) \
+                    .run_collect(plan_dict)
+
+                config.conf.set(config.SHUFFLE_DEVICE.key, "on")
+                if chaos:
+                    faults.configure("device-collective@1", seed=7)
+                before = xla_stats.snapshot()
+                try:
+                    got = DagScheduler(work_dir=os.path.join(d, "dag1")) \
+                        .run_collect(plan_dict)
+                finally:
+                    faults.clear()
+                    config.conf.unset(config.SHUFFLE_DEVICE.key)
+                ds = xla_stats.delta(before)
+
+                err = compare_frames(frame(got), frame(base))
+                if err is not None:
+                    diverged += 1
+                queries.append({
+                    "query": qname,
+                    "divergence": err,
+                    "device_exchanges":
+                        int(ds.get("shuffle_device_exchanges", 0)),
+                    "device_rows": int(ds.get("shuffle_device_rows", 0)),
+                    "fallbacks":
+                        int(ds.get("shuffle_device_fallbacks", 0)),
+                })
+    finally:
+        faults.clear()
+        config.conf.unset(config.SHUFFLE_DEVICE.key)
+        for k in knobs:
+            config.conf.unset(k)
+    return {"queries": queries, "divergent_queries": diverged,
+            "scale": scale}
+
+
+def multichip_bench_main() -> int:
+    """Supervisor for `--multichip` (never imports jax): run one child
+    per mesh width, merge the scaling curve + device-shuffle itest/chaos
+    legs into BENCH_SF100.json, print the record as one JSON line."""
+    legs_req = [int(x) for x in os.environ.get(
+        "BLAZE_BENCH_MULTICHIP_DEVICES", "1,4,8").split(",")]
+    widest = max(legs_req)
+    legs = []
+    errors = []
+    for n in legs_req:
+        args = [sys.executable, os.path.abspath(__file__),
+                "--multichip-child", str(n)]
+        if n == widest:
+            args.append("--queries")
+        rc, out, err, timed_out = _run_group(args, MULTICHIP_TIMEOUT_S)
+        line = None
+        for ln in reversed(out.splitlines()):
+            if ln.startswith("{"):
+                line = ln
+                break
+        if rc == 0 and line is not None:
+            try:
+                legs.append(json.loads(line))
+                continue
+            except json.JSONDecodeError:
+                pass
+        errors.append("leg n=%d: %s" % (
+            n, "killed after %gs" % MULTICHIP_TIMEOUT_S if timed_out
+            else (line or (err or out).strip()[-500:])))
+
+    mc = {"metric": "multichip_map_stage_scaling", "unit": "x",
+          "legs": []}
+    base_wall = None
+    for leg in legs:
+        ms = leg["map_stage"]
+        if leg["n_devices"] == 1:
+            base_wall = ms["wall_s"]
+        entry = {"n_devices": leg["n_devices"],
+                 "n_devices_requested": leg["n_devices_requested"],
+                 "platform": leg["platform"], **ms}
+        mc["legs"].append(entry)
+        if "itest" in leg:
+            mc["itest"] = leg["itest"]
+        if "chaos" in leg:
+            mc["chaos"] = leg["chaos"]
+    for entry in mc["legs"]:
+        entry["speedup_vs_1"] = (
+            round(base_wall / entry["wall_s"], 3) if base_wall else None)
+    widest_entry = max(mc["legs"], key=lambda e: e["n_devices"],
+                       default=None)
+    mc["value"] = (widest_entry or {}).get("speedup_vs_1") or 0
+    it = mc.get("itest", {}).get("divergent_queries")
+    ch = mc.get("chaos", {}).get("divergent_queries")
+    mc["divergent_queries"] = (
+        it + ch if it is not None and ch is not None else -1)
+    if errors:
+        mc["errors"] = errors
+
+    path = os.environ.get(
+        "BLAZE_BENCH_SF100_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_SF100.json"))
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        rec = {}
+    rec["multichip"] = mc
+    if widest_entry:
+        rec["n_devices"] = max(int(rec.get("n_devices", 1) or 1),
+                               widest_entry["n_devices"])
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, default=str)
+    print(json.dumps(mc))
+    sys.stdout.flush()
+    ok = (not errors and mc["divergent_queries"] == 0 and
+          len(mc["legs"]) == len(legs_req))
+    return 0 if ok else 1
+
+
 def main():
     if "--expr" in sys.argv:
         sys.exit(expr_bench_main())
@@ -1711,6 +1958,10 @@ def main():
         sys.exit(chaos_bench_main())
     if "--aggskip" in sys.argv:
         sys.exit(aggskip_bench_main())
+    if "--multichip-child" in sys.argv:
+        sys.exit(multichip_child_main())
+    if "--multichip" in sys.argv:
+        sys.exit(multichip_bench_main())
     if "--child" in sys.argv:
         try:
             child_main()

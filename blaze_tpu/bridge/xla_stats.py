@@ -59,6 +59,15 @@ _faults = {"task_attempts": 0, "task_retries": 0, "task_retry_wait_ns": 0,
            "task_failures": 0, "fetch_failures": 0, "stage_recoveries": 0,
            "recovered_map_tasks": 0, "faults_injected": 0}
 
+# Exchange-transport accounting (plan/stages.py DagScheduler,
+# parallel/stage.py DeviceExchange): bytes moved through the on-device
+# collective exchange vs the host file shuffle, collective dispatches,
+# and how often the device lane bailed to the file fallback.
+_shuffle = {"shuffle_device_bytes": 0, "shuffle_host_bytes": 0,
+            "shuffle_device_rows": 0, "shuffle_device_exchanges": 0,
+            "shuffle_device_collectives": 0,
+            "shuffle_device_fallbacks": 0}
+
 # Adaptive partial-aggregation accounting (ops/agg/exec.py _AggState,
 # plan/fused.py host lane): cardinality probes run, mode switches
 # (ratio-triggered vs memory-pressure-triggered), and the rows that
@@ -239,6 +248,38 @@ def fault_stats() -> dict:
         return dict(_faults)
 
 
+def note_device_exchange(rows: int, nbytes: int,
+                         collectives: int = 1) -> None:
+    """One map->reduce repartition completed over device collectives:
+    `rows` real rows exchanged, `nbytes` buffer bytes that rode the
+    all-to-all (padded send buffers — what actually moved), and the
+    number of collective ops the program issued."""
+    with _lock:
+        _shuffle["shuffle_device_exchanges"] += 1
+        _shuffle["shuffle_device_rows"] += int(rows)
+        _shuffle["shuffle_device_bytes"] += int(nbytes)
+        _shuffle["shuffle_device_collectives"] += int(collectives)
+
+
+def note_host_exchange(nbytes: int) -> None:
+    """One producer stage's map outputs landed in host shuffle files
+    (`nbytes` = total .data bytes across its map tasks)."""
+    with _lock:
+        _shuffle["shuffle_host_bytes"] += int(nbytes)
+
+
+def note_device_shuffle_fallback() -> None:
+    """A device-resident exchange aborted (fault, overflow, capacity)
+    and the stage re-ran through the file shuffle."""
+    with _lock:
+        _shuffle["shuffle_device_fallbacks"] += 1
+
+
+def shuffle_stats() -> dict:
+    with _lock:
+        return dict(_shuffle)
+
+
 def note_partial_agg_probe(rows: int, groups: int) -> None:
     """One cardinality probe over `rows` buffered rows that resolved
     `groups` distinct groups (the skip decision's evidence)."""
@@ -330,6 +371,7 @@ def snapshot() -> dict:
     flat.update(es)
     flat.update(fault_stats())
     flat.update(agg_stats())
+    flat.update(shuffle_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -353,4 +395,6 @@ def reset() -> None:
             _faults[k] = 0
         for k in _agg:
             _agg[k] = 0
+        for k in _shuffle:
+            _shuffle[k] = 0
         _bucket_caps.clear()

@@ -469,7 +469,8 @@ FAULTS_RULES = str_conf(
     "`site=p*max` (capped fires), `site@k1+k2` (exact occurrences), "
     "optional `:corrupt` action suffix (flip a frame byte instead of "
     "raising).  Sites: task-start, shuffle-write, shuffle-read, "
-    "ipc-decode, mem-pressure.", category="fault-tolerance")
+    "ipc-decode, mem-pressure, device-collective.",
+    category="fault-tolerance")
 TASK_MAX_ATTEMPTS = int_conf(
     "auron.tpu.task.maxAttempts", 4,
     "Bounded per-task attempts for retryable failures (transient IO, "
@@ -495,6 +496,37 @@ SHUFFLE_CHECKSUM_ENABLE = bool_conf(
     "with the writing map task's identity so the scheduler can re-run "
     "exactly that task instead of failing the query.",
     category="fault-tolerance")
+MESH_DEVICES = int_conf(
+    "auron.tpu.mesh.devices", 0,
+    "Devices in the 1-D data-parallel mesh that runs device-resident "
+    "stage execution (parallel/mesh.py make_mesh).  0 = every visible "
+    "device.  On CPU hosts, XLA_FLAGS="
+    "--xla_force_host_platform_device_count=N provides N virtual "
+    "devices for the same code path.", category="scale-out")
+SHUFFLE_DEVICE = str_conf(
+    "auron.tpu.shuffle.device", "auto",
+    "Device-resident map->reduce exchange: 'auto' moves eligible hash "
+    "repartitions (fixed-width row schema, column-reference keys) over "
+    "mesh collectives when compute is device-resident (bridge/"
+    "placement) and >1 device is visible, 'on' forces the attempt "
+    "regardless of placement, 'off' always writes host shuffle files.  "
+    "Any device-lane "
+    "failure — injected fault, capacity overflow, unsupported shape — "
+    "falls back to the file shuffle for that stage (counted as "
+    "shuffle_device_fallbacks), so lineage recovery keeps working.",
+    category="scale-out")
+SHUFFLE_DEVICE_MAX_BYTES = int_conf(
+    "auron.tpu.shuffle.device.maxBytes", 1 << 30,
+    "Estimated per-exchange payload above which the device lane "
+    "declines and the stage spills to the file shuffle (device "
+    "exchanges buffer whole map outputs; the file path streams).",
+    category="scale-out")
+MESH_EXCHANGE_SKEW = float_conf(
+    "auron.tpu.mesh.exchangeSkew", 2.0,
+    "Headroom factor on the per-destination send-buffer capacity of "
+    "the collective exchange (capacity ladder rung >= skew * "
+    "rows/destination).  Skewed key distributions that still overflow "
+    "re-dispatch at the next ladder rung.", category="scale-out")
 CASE_SENSITIVE = bool_conf("spark.sql.caseSensitive", False, "Column name matching.")
 ANSI_ENABLED = bool_conf(
     "spark.sql.ansi.enabled", False,

@@ -16,6 +16,9 @@ Sites (the code points that call in here):
     shuffle-read   shuffle/reader.py, per block fetch
     ipc-decode     shuffle/ipc.py, per frame decode
     mem-pressure   memory/manager.py, per mem_used update (forces spill)
+    device-collective  parallel/stage.py DeviceExchange, per shard per
+                   collective dispatch (kills the device-resident
+                   exchange; the scheduler falls back to file shuffle)
 
 Determinism: every decision is a pure function of (seed, site,
 occurrence-index) — the k-th evaluation of a site fires or not
@@ -44,7 +47,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 SITES = ("task-start", "shuffle-write", "shuffle-read", "ipc-decode",
-         "mem-pressure")
+         "mem-pressure", "device-collective")
 
 
 class InjectedFault(RuntimeError):

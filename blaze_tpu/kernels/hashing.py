@@ -342,6 +342,30 @@ def pmod(hashes, n: int, xp=jnp):
     return xp.where(m < 0, m + xp.int32(n), m)
 
 
+def spark_partition_ids(flat_cols, tids, num_partitions: int, xp=jnp):
+    """THE Spark-compatible partition id: pmod(murmur3(normalize(keys),
+    seed=42), P).
+
+    Single source of truth shared by the host hash-partition path
+    (shuffle/partitioning.py) and the device collective lane
+    (parallel/collective.partition_ids_for_keys): both MUST route the
+    same row to the same reducer or a device exchange and its file-path
+    fallback would disagree about where a key lives.  Normalization
+    (NormalizeFloatingNumbers: -0.0 -> 0.0, NaN -> one canonical
+    pattern) is part of the definition, not the caller's problem — it
+    is idempotent, so pre-normalized host columns pass through
+    unchanged.
+
+    flat_cols: [(values, validity_or_None)] aligned with `tids`
+    (type-id strings; utf8/binary values are (byte_mat, lengths)).
+    Traceable under jit/shard_map with xp=jnp; pure numpy with xp=np.
+    """
+    flat_cols = norm_float_keys(flat_cols, tids, xp)
+    cols = [(v, val, tid) for (v, val), tid in zip(flat_cols, tids)]
+    h = hash_columns(cols, seed=42, xp=xp, algo="murmur3")
+    return pmod(h, num_partitions, xp=xp)
+
+
 def string_column_to_padded_bytes(arr, xp=np) -> Tuple:
     """pyarrow string/binary array -> (byte_mat uint8 (n, max_len), lengths).
 

@@ -4,13 +4,15 @@ from blaze_tpu.parallel.collective import (all_to_all_regroup,
                                            all_to_all_rows,
                                            partition_ids_for_keys,
                                            psum_table_accs)
-from blaze_tpu.parallel.mesh import (DP_AXIS,
+from blaze_tpu.parallel.mesh import (DP_AXIS, current_mesh,
                                      distributed_broadcast_join_agg,
                                      distributed_grouped_agg,
                                      distributed_hash_join,
                                      distributed_sort,
                                      make_mesh, shard_rows)
-from blaze_tpu.parallel.stage import (AggTable, merge_agg_tables,
+from blaze_tpu.parallel.stage import (AggTable, DeviceExchange,
+                                      DeviceExchangeError,
+                                      merge_agg_tables,
                                       partial_agg_table)
 
 __all__ = ["all_to_all_regroup", "all_to_all_rows",
@@ -18,5 +20,6 @@ __all__ = ["all_to_all_regroup", "all_to_all_rows",
            "psum_table_accs", "DP_AXIS", "distributed_grouped_agg",
            "distributed_broadcast_join_agg", "distributed_hash_join",
            "distributed_sort",
-           "make_mesh", "shard_rows", "AggTable", "merge_agg_tables",
-           "partial_agg_table"]
+           "make_mesh", "shard_rows", "current_mesh",
+           "AggTable", "DeviceExchange", "DeviceExchangeError",
+           "merge_agg_tables", "partial_agg_table"]

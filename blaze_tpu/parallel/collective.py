@@ -25,14 +25,18 @@ from blaze_tpu.parallel.stage import AggTable, merge_agg_tables
 
 def partition_ids_for_keys(keys: Sequence[Tuple[jax.Array, jax.Array]],
                            num_partitions: int) -> jax.Array:
-    """Spark-compatible pid = pmod(murmur3(keys, 42), P) on device
-    (ref shuffle/mod.rs:164-189) — traceable under jit/shard_map."""
-    cols = []
+    """Spark-compatible pid = pmod(murmur3(normalize(keys), 42), P) on
+    device (ref shuffle/mod.rs:164-189) — traceable under jit/shard_map.
+    Delegates to the ONE shared definition (H.spark_partition_ids) so
+    the device lane and the host file-shuffle path agree bit-for-bit on
+    where every key lives (incl. -0.0/NaN float normalization)."""
+    from blaze_tpu.parallel.stage import _dtype_of
+    flat_cols = []
+    tids = []
     for data, valid in keys:
-        from blaze_tpu.parallel.stage import _dtype_of
-        cols.append((data, valid, _dtype_of(data).id.value))
-    h = H.hash_columns(cols, seed=42, xp=jnp, algo="murmur3")
-    return H.pmod(h, num_partitions, xp=jnp)
+        flat_cols.append((data, valid))
+        tids.append(_dtype_of(data).id.value)
+    return H.spark_partition_ids(flat_cols, tids, num_partitions, xp=jnp)
 
 
 def _dest_slots(pid: jax.Array, num_partitions: int, capacity: int):

@@ -22,11 +22,43 @@ from blaze_tpu.bridge.xla_stats import meter_jit
 DP_AXIS = "dp"
 
 
+def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions.  Newer jax exposes it at the
+    top level with `check_vma`; 0.4.x only has
+    jax.experimental.shard_map with the older `check_rep` flag.  Both
+    checks are disabled for the same reason: the collective programs
+    here intentionally mix per-device and replicated intermediates."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
 def make_mesh(num_devices: Optional[int] = None,
               axis: str = DP_AXIS) -> Mesh:
     devs = jax.devices()
     n = num_devices or len(devs)
     return Mesh(np.array(devs[:n]), (axis,))
+
+
+_mesh_cache: dict = {}
+
+
+def current_mesh() -> Mesh:
+    """The process-wide dp mesh, sized by `auron.tpu.mesh.devices`
+    (0 = every visible device).  Cached per size: Mesh construction is
+    cheap but mesh IDENTITY keys the jit cache, so handing out a fresh
+    Mesh per exchange would recompile every collective program."""
+    from blaze_tpu import config
+    visible = len(jax.devices())
+    n = config.MESH_DEVICES.get() or visible
+    n = max(1, min(int(n), visible))
+    m = _mesh_cache.get(n)
+    if m is None:
+        m = _mesh_cache[n] = make_mesh(n)
+    return m
 
 
 def shard_rows(mesh: Mesh, *arrays: jax.Array):
@@ -76,11 +108,7 @@ def distributed_grouped_agg(mesh: Mesh, key_specs, agg_specs,
         # (1,)-axis so out_specs P('dp') stacks per-device counts
         return final._replace(num_groups=final.num_groups.reshape(1))
 
-    sharded = jax.shard_map(
-        stage, mesh=mesh,
-        in_specs=P(DP_AXIS),
-        out_specs=P(DP_AXIS),
-        check_vma=False)
+    sharded = shard_map_compat(stage, mesh, P(DP_AXIS), P(DP_AXIS))
     return meter_jit(sharded, name="mesh.grouped_agg")
 
 
@@ -168,11 +196,7 @@ def distributed_sort(mesh: Mesh, num_payloads: int, capacity: int,
         return tuple([out_keys, out_valid] + out_payloads +
                      [overflow.reshape(1)])
 
-    sharded = jax.shard_map(
-        stage, mesh=mesh,
-        in_specs=P(DP_AXIS),
-        out_specs=P(DP_AXIS),
-        check_vma=False)
+    sharded = shard_map_compat(stage, mesh, P(DP_AXIS), P(DP_AXIS))
     return meter_jit(sharded, name="mesh.sort")
 
 
@@ -259,11 +283,7 @@ def distributed_hash_join(mesh: Mesh, num_build_payloads: int,
         return tuple([jkeys, pair_valid] + out_b + out_p +
                      [counts.reshape(3)])
 
-    sharded = jax.shard_map(
-        stage, mesh=mesh,
-        in_specs=P(DP_AXIS),
-        out_specs=P(DP_AXIS),
-        check_vma=False)
+    sharded = shard_map_compat(stage, mesh, P(DP_AXIS), P(DP_AXIS))
     return meter_jit(sharded, name="mesh.hash_join")
 
 
@@ -299,9 +319,7 @@ def distributed_broadcast_join_agg(mesh: Mesh, build_capacity: int):
         return (jax.lax.psum(sums, DP_AXIS),
                 jax.lax.psum(counts, DP_AXIS))
 
-    sharded = jax.shard_map(
-        stage, mesh=mesh,
-        in_specs=(P(), P(DP_AXIS), P(DP_AXIS), P(DP_AXIS)),
-        out_specs=(P(), P()),
-        check_vma=False)
+    sharded = shard_map_compat(stage, mesh,
+                               (P(), P(DP_AXIS), P(DP_AXIS), P(DP_AXIS)),
+                               (P(), P()))
     return meter_jit(sharded, name="mesh.broadcast_join_agg")

@@ -18,11 +18,13 @@ path when it overflows.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from blaze_tpu.kernels import compare
 
@@ -447,3 +449,179 @@ def _identity(dtype, minimum: bool):
         return jnp.array(-jnp.inf if minimum else jnp.inf, dtype=dtype)
     info = jnp.iinfo(dtype)
     return jnp.array(info.min if minimum else info.max, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Device-resident exchange: the shard_map stage runner behind the
+# DagScheduler's device shuffle.  The reference repartitions map output
+# through shuffle files + BlockManager RPC; on a mesh the same
+# repartition is ONE collective program — every device hash-partitions
+# its local rows with the Spark-compatible pid, stages them into
+# bucket-ladder-padded per-destination buffers, and `lax.all_to_all`
+# moves every partition simultaneously over ICI.  The file shuffle
+# (shuffle/writer.py) stays behind it as the spill + fault-tolerance
+# fallback: any failure here raises and the scheduler re-runs the stage
+# through the file path, where PR 4's lineage recovery applies.
+
+
+class DeviceExchangeError(RuntimeError):
+    """The device-resident exchange declined or failed.  The scheduler
+    catches this (and any other exchange-side error), bumps
+    `shuffle_device_fallbacks`, and re-runs the stage through the host
+    file shuffle — device shuffle is an optimization, never a new
+    failure mode."""
+
+
+@functools.lru_cache(maxsize=64)
+def _exchange_program(mesh, n_out: int, capacity: int,
+                      key_idx: Tuple[int, ...], dtypes: Tuple[str, ...]):
+    """Build + cache the jit'd shard_map exchange for one static shape.
+
+    Cache key = (mesh, reduce partition count, bucket-ladder rung, key
+    column positions, column dtype signature): the collective compiles
+    once per rung and is reused by every batch that lands on it.
+    """
+    from jax.sharding import PartitionSpec as PS
+
+    from blaze_tpu.bridge.xla_stats import meter_jit
+    from blaze_tpu.parallel.collective import (all_to_all_rows,
+                                               partition_ids_for_keys)
+    from blaze_tpu.parallel.mesh import DP_AXIS, shard_map_compat
+
+    n_dev = mesh.shape[DP_AXIS]
+    ncols = len(dtypes)
+
+    def stage(row_valid, *cols):
+        datas = cols[:ncols]
+        valids = cols[ncols:]
+        keys = [(datas[i], valids[i]) for i in key_idx]
+        pid = partition_ids_for_keys(keys, n_out).astype(jnp.int32)
+        # reduce partition r is served by device r % n_dev; the pid
+        # column rides the exchange so the host can split received rows
+        # back into exact reduce partitions
+        dev = pid % n_dev
+        out_cols, out_valid, overflow = all_to_all_rows(
+            list(datas) + list(valids) + [pid],
+            row_valid, dev, DP_AXIS, n_dev, capacity)
+        return tuple(out_cols) + (out_valid, overflow.reshape(1))
+
+    sharded = shard_map_compat(stage, mesh, PS(DP_AXIS), PS(DP_AXIS))
+    return meter_jit(sharded, name="mesh.exchange_rows")
+
+
+class DeviceExchange:
+    """Host-side driver for the on-device repartition.
+
+    Pads the map output to a static per-device row count (so sharding
+    splits evenly), dispatches the cached `_exchange_program` at a
+    bucket-ladder capacity rung sized for `auron.tpu.mesh.exchangeSkew`,
+    climbs to the next rung when a destination bucket overflows (the
+    final rung = per-device row count can never overflow), and splits
+    the received rows back into per-reduce-partition columns in a
+    deterministic (destination, source, slot) order.
+    """
+
+    def __init__(self, mesh=None):
+        if mesh is None:
+            from blaze_tpu.parallel.mesh import current_mesh
+            mesh = current_mesh()
+        self.mesh = mesh
+
+    def exchange(self, columns: Sequence[np.ndarray],
+                 valids: Sequence[np.ndarray],
+                 key_indices: Sequence[int], n_out: int, ctx: str = ""):
+        """columns/valids: per-column (data, bool validity) numpy arrays
+        of one common length n.  Returns `parts`: n_out entries of
+        ([data...], [valid...]) holding that reduce partition's rows."""
+        from blaze_tpu import config, faults
+        from blaze_tpu.batch import bucket_capacity, bucket_ladder
+        from blaze_tpu.bridge import xla_stats
+        from blaze_tpu.parallel.mesh import DP_AXIS, shard_rows
+
+        ncols = len(columns)
+        if ncols == 0:
+            raise DeviceExchangeError("no columns to exchange")
+        n = int(len(columns[0]))
+        n_dev = int(self.mesh.shape[DP_AXIS])
+        if n == 0:
+            return [([np.zeros(0, c.dtype) for c in columns],
+                     [np.zeros(0, dtype=bool) for _ in columns])
+                    for _ in range(n_out)]
+
+        # pad to n_dev * rows_per_dev so NamedSharding splits evenly;
+        # padding rows carry row_valid=False and are never sent
+        rows_per_dev = bucket_capacity(-(-n // n_dev))
+        total = n_dev * rows_per_dev
+        row_valid = np.zeros(total, dtype=bool)
+        row_valid[:n] = True
+        datas = []
+        for c in columns:
+            buf = np.zeros(total, dtype=c.dtype)
+            buf[:n] = c
+            datas.append(buf)
+        vbufs = []
+        for v in valids:
+            buf = np.zeros(total, dtype=bool)
+            buf[:n] = v
+            vbufs.append(buf)
+
+        # capacity ladder: start at skew * expected rows/destination,
+        # retry the next rung on overflow; rows_per_dev (= every local
+        # row routed to ONE destination) is the guaranteed-fit ceiling
+        skew = max(1.0, config.MESH_EXCHANGE_SKEW.get())
+        expect = -(-rows_per_dev // n_dev)
+        start = bucket_capacity(max(int(expect * skew), 1))
+        rungs = [c for c in bucket_ladder(rows_per_dev) if c >= start]
+        if not rungs:
+            rungs = [start]
+        if rungs[-1] < rows_per_dev:
+            rungs.append(bucket_capacity(rows_per_dev))
+
+        key_idx = tuple(int(i) for i in key_indices)
+        dtypes = tuple(np.dtype(c.dtype).name for c in columns)
+        itemsizes = [np.dtype(d).itemsize for d in dtypes]
+        moved_bytes = 0
+        collectives = 0
+        result = None
+        for cap in rungs:
+            # the scripted mid-collective kill: one decision per shard
+            # per dispatch, so `device-collective@k` targets shard k-1
+            for d in range(n_dev):
+                faults.maybe_fail("device-collective", shard=d, stage=ctx)
+            fn = _exchange_program(self.mesh, int(n_out), int(cap),
+                                   key_idx, dtypes)
+            out = fn(*shard_rows(self.mesh, row_valid, *datas, *vbufs))
+            # send buffers are (n_dev dests x cap) per device per column:
+            # data cols + bool validity cols + int32 pid + bool row mask
+            per_slot = sum(itemsizes) + ncols + 4 + 1
+            moved_bytes += n_dev * n_dev * cap * per_slot
+            collectives += 2 * ncols + 2
+            overflow = int(np.sum(np.asarray(out[-1])))
+            if overflow == 0:
+                result = out
+                break
+        if result is None:
+            raise DeviceExchangeError(
+                f"destination bucket overflow persisted through rung "
+                f"{rungs[-1]} (rows_per_dev={rows_per_dev})")
+        xla_stats.note_device_exchange(n, moved_bytes, collectives)
+
+        out_cols = [np.asarray(a) for a in result[:ncols]]
+        out_vals = [np.asarray(a).astype(bool)
+                    for a in result[ncols:2 * ncols]]
+        pid_r = np.asarray(result[2 * ncols])
+        valid_r = np.asarray(result[2 * ncols + 1]).astype(bool)
+
+        # received layout is already (dest device, source device, slot)
+        # deterministic; a stable sort by pid keeps it reproducible
+        pids = pid_r[valid_r]
+        order = np.argsort(pids, kind="stable")
+        bounds = np.searchsorted(pids[order], np.arange(n_out + 1))
+        datas_live = [c[valid_r][order] for c in out_cols]
+        vals_live = [v[valid_r][order] for v in out_vals]
+        parts = []
+        for r in range(n_out):
+            lo, hi = int(bounds[r]), int(bounds[r + 1])
+            parts.append(([d[lo:hi] for d in datas_live],
+                          [v[lo:hi] for v in vals_live]))
+        return parts

@@ -172,6 +172,16 @@ class QueryProfile:
                 f"recoveries={x.get('stage_recoveries', 0)} "
                 f"recovered_map_tasks={x.get('recovered_map_tasks', 0)} "
                 f"faults_injected={x.get('faults_injected', 0)}")
+        if any(x.get(k) for k in ("shuffle_device_bytes",
+                                  "shuffle_host_bytes",
+                                  "shuffle_device_fallbacks")):
+            lines.append(
+                f"shuffle: device={_fmt_bytes(x.get('shuffle_device_bytes', 0))} "
+                f"({x.get('shuffle_device_collectives', 0)} collectives, "
+                f"{x.get('shuffle_device_exchanges', 0)} exchanges, "
+                f"{x.get('shuffle_device_rows', 0)} rows) "
+                f"host={_fmt_bytes(x.get('shuffle_host_bytes', 0))} "
+                f"fallbacks={x.get('shuffle_device_fallbacks', 0)}")
         return "\n".join(lines)
 
     def __str__(self) -> str:

@@ -398,6 +398,77 @@ def partitioning_from_dict(d: Dict[str, Any],
     raise ValueError(f"unknown partitioning kind {k!r}")
 
 
+# fixed-width row schemas the mesh exchange can carry: each column
+# travels as one jnp array + one bool validity lane.  date32 rides as
+# int32 and timestamp_us as int64 — the murmur3 pid of the underlying
+# integer is identical either way (partitioning.py hashes them through
+# the same mode), so re-tagging at the arrow boundary is lossless.
+_DEVICE_EXCHANGE_TIDS = frozenset((
+    "bool", "int8", "int16", "int32", "int64", "float32", "float64",
+    "date32", "timestamp_us"))
+
+
+def exchange_device_spec(partitioning: Optional[Dict[str, Any]],
+                         out_schema: Optional[Dict[str, Any]]
+                         ) -> Optional[Dict[str, Any]]:
+    """Tentpole planner pass: decide whether one exchange boundary can
+    go device-resident, i.e. ride the mesh collective instead of the
+    host file shuffle.  Returns {'key_indices', 'num_partitions'} when
+    BOTH sides of the boundary are mesh-shardable:
+
+      map side    every output column fixed-width (no strings/decimals/
+                  nested — those still need the host row format) so the
+                  whole row set shards as flat device arrays;
+      reduce side the hash keys are direct column references, so the
+                  Spark-compatible pid is computable on device with the
+                  ONE shared hash definition (H.spark_partition_ids).
+
+    `auron.tpu.shuffle.device`: off -> never; on -> whenever eligible;
+    auto (default) -> eligible AND compute is device-resident (bridge/
+    placement) AND more than one device in the mesh.  Host-pinned
+    placement (CPU tests, tunneled backends) keeps the file path: there
+    the collective is emulation-only overhead, and a 1-device
+    collective never beats the local fast path.
+    """
+    from blaze_tpu import config
+
+    mode = (config.SHUFFLE_DEVICE.get() or "auto").strip().lower()
+    if mode not in ("on", "auto"):
+        return None
+    if not partitioning or partitioning.get("kind") != "hash":
+        return None
+    n_out = int(partitioning.get("num_partitions", 1))
+    if n_out < 1:
+        return None
+    fields = (out_schema or {}).get("fields", [])
+    if not fields:
+        return None
+    for f in fields:
+        if f.get("type", {}).get("id") not in _DEVICE_EXCHANGE_TIDS:
+            return None
+    names = [f.get("name") for f in fields]
+    key_indices = []
+    for e in partitioning.get("exprs", []):
+        if not isinstance(e, dict) or e.get("kind") != "column":
+            return None  # computed keys still go through the host path
+        idx = e.get("index")
+        if idx is None:
+            name = e.get("name")
+            idx = names.index(name) if name in names else None
+        if idx is None or not (0 <= int(idx) < len(fields)):
+            return None
+        key_indices.append(int(idx))
+    if not key_indices:
+        return None
+    if mode == "auto":
+        import jax
+
+        from blaze_tpu.bridge.placement import host_resident
+        if host_resident() or len(jax.devices()) < 2:
+            return None
+    return {"key_indices": key_indices, "num_partitions": n_out}
+
+
 # ---------------------------------------------------------------------------
 # TaskDefinition (ref auron.proto:814, rt.rs:79-90)
 # ---------------------------------------------------------------------------

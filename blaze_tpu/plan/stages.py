@@ -43,6 +43,73 @@ from blaze_tpu.faults import FetchFailedError
 _SCAN_KINDS = ("parquet_scan", "orc_scan")
 
 
+def _broadcast_reader_rids(d: Any, in_broadcast: bool = False) -> set:
+    """Resource ids of ipc_readers sitting under a broadcast build side
+    anywhere in `d` (those exchanges stay on the file shuffle)."""
+    rids: set = set()
+    if not isinstance(d, dict) or "kind" not in d:
+        return rids
+    k = d.get("kind")
+    if k == "ipc_reader" and in_broadcast:
+        rids.add(d.get("resource_id"))
+    if k in ("broadcast_join", "broadcast_nested_loop_join"):
+        build = d.get("build_side", "right")
+        for side in ("left", "right"):
+            rids |= _broadcast_reader_rids(d.get(side),
+                                           in_broadcast or side == build)
+        return rids
+    if k == "broadcast_join_build_hash_map":
+        return rids | _broadcast_reader_rids(d.get("input"), True)
+    for key, val in d.items():
+        if isinstance(val, dict) and "kind" in val:
+            rids |= _broadcast_reader_rids(val, in_broadcast)
+        elif key == "inputs" and isinstance(val, list):
+            for v in val:
+                rids |= _broadcast_reader_rids(v, in_broadcast)
+    return rids
+
+
+def _batches_to_columns(batches: List[pa.RecordBatch], schema):
+    """Concatenate record batches into per-column (data, validity) numpy
+    arrays — the flat layout DeviceExchange shards over the mesh."""
+    import numpy as np
+
+    from blaze_tpu.batch import _arrow_fixed_values, _unpack_validity
+    ncols = len(schema.fields)
+    datas: List[list] = [[] for _ in range(ncols)]
+    valids: List[list] = [[] for _ in range(ncols)]
+    for rb in batches:
+        for i, f in enumerate(schema.fields):
+            arr = rb.column(i)
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            datas[i].append(np.ascontiguousarray(
+                _arrow_fixed_values(arr, f.data_type)))
+            valids[i].append(_unpack_validity(arr))
+    return ([np.concatenate(d) for d in datas],
+            [np.concatenate(v) for v in valids])
+
+
+def _columns_to_batch(datas, valids, arrow_schema: pa.Schema
+                      ) -> pa.RecordBatch:
+    """Inverse of _batches_to_columns for one reduce partition.  date32
+    and timestamps travelled the mesh as their integer storage; the
+    cast back to the logical arrow type is lossless."""
+    import numpy as np
+    arrays = []
+    for data, valid, f in zip(datas, valids, arrow_schema):
+        valid = np.asarray(valid, dtype=bool)
+        mask = None if bool(valid.all()) else ~valid
+        t = f.type
+        if pa.types.is_date32(t) or pa.types.is_timestamp(t):
+            arrays.append(pa.array(data, mask=mask).cast(t))
+        elif pa.types.is_boolean(t):
+            arrays.append(pa.array(np.asarray(data, dtype=bool), mask=mask))
+        else:
+            arrays.append(pa.array(data, type=t, mask=mask))
+    return pa.RecordBatch.from_arrays(arrays, schema=arrow_schema)
+
+
 def _shuffle_scratch_base() -> Optional[str]:
     """Shuffle files are transient: prefer the RAM disk (the standard
     spark.local.dir-on-tmpfs deployment) when it has real headroom —
@@ -66,6 +133,9 @@ class Stage:
     num_tasks: int = 1            # producer-side task count
     deps: List[int] = field(default_factory=list)
     out_schema: Optional[Dict[str, Any]] = None
+    # planner verdict for the device-resident exchange (plan/planner.py
+    # exchange_device_spec); None = this boundary stays on file shuffle
+    device_spec: Optional[Dict[str, Any]] = None
 
 
 class DagScheduler:
@@ -132,7 +202,27 @@ class DagScheduler:
                        resource_id=None, deps=deps, num_tasks=n_tasks,
                        out_schema=schema)
         self.stages.append(result)
+        self._mark_device_exchanges()
         return self.stages
+
+    def _mark_device_exchanges(self) -> None:
+        """Planner pass: mark each exchange device-resident when BOTH
+        sides of the boundary are mesh-shardable.  The producer side is
+        decided by exchange_device_spec (hash keys as direct column
+        refs, all-fixed-width row schema); the consumer side declines
+        readers under broadcast builds — a broadcast replays EVERY
+        partition once per task, which the file path streams through
+        the page cache while in-memory device blocks would pin the full
+        copy per replay."""
+        from blaze_tpu.plan.planner import exchange_device_spec
+        demoted: set = set()
+        for st in self.stages:
+            demoted |= _broadcast_reader_rids(st.plan)
+        for st in self.stages:
+            if st.partitioning is None or st.resource_id in demoted:
+                continue
+            st.device_spec = exchange_device_spec(st.partitioning,
+                                                  st.out_schema)
 
     def _split_node(self, d: Dict[str, Any]):
         """Rewrite one node; returns (new_dict, dep_stage_ids)."""
@@ -284,6 +374,104 @@ class DagScheduler:
             raise FetchFailedError(stage.sid, m, e.reason) from e
 
     def _run_producer(self, stage: Stage) -> None:
+        """One exchange boundary: device-resident collective when the
+        planner marked it eligible, host shuffle files otherwise — and
+        the file path is ALSO the fallback for any device-lane failure
+        (a dead shard mid-collective, payload over the device cap, an
+        unsupported runtime shape).  Device shuffle is an optimization,
+        never a new failure mode."""
+        if stage.device_spec is not None:
+            try:
+                self._run_producer_device(stage)
+                return
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except FetchFailedError:
+                # an UPSTREAM block was poisoned: the lineage identity
+                # must reach the recovery loop, not trigger a fallback
+                raise
+            except Exception as e:
+                from blaze_tpu.bridge import tracing, xla_stats
+                xla_stats.note_device_shuffle_fallback()
+                tracing.instant("device_shuffle_fallback",
+                                stage=stage.sid, error=type(e).__name__)
+        self._run_producer_file(stage)
+
+    def _run_map_task_collect(self, stage: Stage,
+                              m: int) -> List[pa.RecordBatch]:
+        """One producer map task WITHOUT the shuffle_writer wrapper: the
+        stage plan's batches come back over the wire for the device
+        exchange to repartition.  Same TaskDefinition path, metrics and
+        task_runs accounting as the file-shuffle map task."""
+        from blaze_tpu.bridge.runtime import NativeExecutionRuntime
+        from blaze_tpu.plan.proto_serde import task_definition_to_bytes
+        td = task_definition_to_bytes(
+            {"stage_id": stage.sid, "partition_id": m,
+             "num_partitions": stage.num_tasks,
+             "plan": self._per_task(stage.plan, m, stage.num_tasks)})
+        rt = NativeExecutionRuntime(td).start()
+        try:
+            out = list(rt.batches())
+        finally:
+            self._record_task_metrics(stage.sid, rt.finalize())
+        with self._metrics_lock:
+            self.task_runs[(stage.sid, m)] = \
+                self.task_runs.get((stage.sid, m), 0) + 1
+        return out
+
+    def _run_producer_device(self, stage: Stage) -> None:
+        """Tentpole path: run the producer's map tasks, repartition
+        their output through the mesh collective (parallel/stage.py
+        DeviceExchange) and publish per-reduce-partition rows as
+        in-memory IPC bytes blocks (shuffle/reader.py read_block
+        consumes raw bytes directly).  Any failure raises out to
+        _run_producer, which falls back to the file path."""
+        from blaze_tpu import config
+        from blaze_tpu.bridge import tracing
+        from blaze_tpu.parallel.stage import (DeviceExchange,
+                                              DeviceExchangeError)
+        from blaze_tpu.plan.types import schema_from_dict
+        from blaze_tpu.shuffle.ipc import write_batches_to_bytes
+
+        spec = stage.device_spec
+        n_out = int(spec["num_partitions"])
+        schema = schema_from_dict(stage.out_schema)
+        with tracing.span("device_exchange", stage=stage.sid,
+                          tasks=stage.num_tasks, partitions=n_out):
+            per_task = self._run_tasks(
+                lambda m: self._run_map_task_collect(stage, m),
+                stage.num_tasks, f"stage {stage.sid} (device shuffle)")
+            batches = [b for bl in per_task for b in bl if b.num_rows]
+            blocks: Dict[int, bytes] = {}
+            if batches:
+                cols, valids = _batches_to_columns(batches, schema)
+                est = sum(int(c.nbytes) for c in cols)
+                if est > config.SHUFFLE_DEVICE_MAX_BYTES.get():
+                    raise DeviceExchangeError(
+                        f"map output {est}B exceeds "
+                        f"auron.tpu.shuffle.device.maxBytes")
+                parts = DeviceExchange().exchange(
+                    cols, valids, spec["key_indices"], n_out,
+                    ctx=str(stage.sid))
+                arrow_schema = schema.to_arrow()
+                for r, (datas, vls) in enumerate(parts):
+                    if datas and len(datas[0]):
+                        rb = _columns_to_batch(datas, vls, arrow_schema)
+                        blocks[r] = write_batches_to_bytes([rb])
+
+        sid = stage.sid
+        self._stage_outputs[sid] = {}
+
+        def blocks_for(reduce_id: int):
+            blk = blocks.get(reduce_id)
+            if blk is not None:
+                yield blk
+
+        put_resource(stage.resource_id, blocks_for)
+        if stage.resource_id not in self._resources:
+            self._resources.append(stage.resource_id)
+
+    def _run_producer_file(self, stage: Stage) -> None:
         from blaze_tpu.shuffle.reader import FileSegmentBlock
 
         os.makedirs(self._dir, exist_ok=True)
@@ -307,6 +495,10 @@ class DagScheduler:
         self._stage_outputs[stage.sid] = {
             m: self._read_map_output(stage, m, n_out)
             for m in range(stage.num_tasks)}
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_host_exchange(sum(
+            int(off[-1])
+            for _, off in self._stage_outputs[stage.sid].values()))
 
         sid = stage.sid
 

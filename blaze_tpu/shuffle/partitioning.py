@@ -50,13 +50,9 @@ import functools
 @functools.lru_cache(maxsize=128)
 def _hash_pmod_jit(tids: Tuple[str, ...], n_parts: int):
     def f(flat_cols):
-        # Spark inserts NormalizeFloatingNumbers upstream of
-        # HashPartitioning: -0.0/NaN variants must land on ONE reducer
-        flat_cols = H.norm_float_keys(flat_cols, tids, jnp)
-        cols = [(v, val, tid)
-                for (v, val), tid in zip(flat_cols, tids)]
-        h = H.hash_columns(cols, seed=42, xp=jnp, algo="murmur3")
-        return H.pmod(h, n_parts, xp=jnp)
+        # the ONE shared pid definition (normalization included) —
+        # identical to the device collective lane and the host path
+        return H.spark_partition_ids(flat_cols, tids, n_parts, xp=jnp)
     from blaze_tpu.bridge.xla_stats import meter_jit
     return meter_jit(f, name="shuffle.hash_pmod")
 
@@ -168,15 +164,16 @@ class HashPartitioning(Partitioning):
                                       jnp.asarray(pad_valid)))
                 tids.append("utf8")
         if on_host:
+            # the native kernel hashes raw bit views, so it needs the
+            # normalization applied up front; the numpy fallback goes
+            # through the shared definition (normalization idempotent)
             flat_cols = H.norm_float_keys(flat_cols, tids, np)
             pids = _native_pmod(flat_cols, tids, self.num_partitions)
             if pids is not None:
                 return pids[:n]
-            cols = [(v, val, tid)
-                    for (v, val), tid in zip(flat_cols, tids)]
-            h = H.hash_columns(cols, seed=42, xp=np, algo="murmur3")
-            return np.asarray(H.pmod(h, self.num_partitions,
-                                     xp=np))[:n].astype(np.int32)
+            pids = H.spark_partition_ids(flat_cols, tids,
+                                         self.num_partitions, xp=np)
+            return np.asarray(pids)[:n].astype(np.int32)
         pids = _hash_pmod_jit(tuple(tids), self.num_partitions)(flat_cols)
         return np.asarray(pids)[:n].astype(np.int32)
 

@@ -19,6 +19,20 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def device_mesh():
+    """Session-wide dp mesh over every virtual device (8 on the forced
+    host platform above); multi-device collective tests share it so the
+    shard_map programs compile once per session."""
+    from blaze_tpu.parallel.mesh import make_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("multi-device mesh unavailable")
+    return make_mesh(len(jax.devices()))
+
+
 def _build_native_libs() -> None:
     """Build the C++ libs (zstd IPC codec + host bridge) so their tests
     are always load-bearing instead of skipped (VERDICT r3 #9).  Cached:

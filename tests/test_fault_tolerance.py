@@ -426,3 +426,74 @@ def test_faults_disabled_zero_overhead_counters(tmp_path, staged_path):
     assert fs["stage_recoveries"] == 0
     assert fs["faults_injected"] == 0
     assert fs["task_failures"] == 0
+
+
+# -- device-shuffle fallback x lineage recovery (ISSUE 6) -------------------
+
+@pytest.fixture
+def device_shuffle_on():
+    config.conf.set(config.SHUFFLE_DEVICE.key, "on")
+    try:
+        yield
+    finally:
+        config.conf.unset(config.SHUFFLE_DEVICE.key)
+
+
+def test_device_shuffle_falls_back_to_files_bit_identical(
+        tmp_path, staged_path, fast_retries, device_shuffle_on):
+    """A shard dying mid-collective must not fail the query: the stage
+    falls back wholesale to the host file shuffle and produces the
+    exact same bytes."""
+    config.conf.set(config.SHUFFLE_DEVICE.key, "off")
+    plan = _two_stage_plan(tmp_path, n=4_000)
+    clean = _sorted_df(DagScheduler(
+        work_dir=str(tmp_path / "dag0")).run_collect(plan))
+    config.conf.set(config.SHUFFLE_DEVICE.key, "on")
+
+    xla_stats.reset()
+    with faults.scoped(("device-collective", dict(at=(1,)))):
+        sched = DagScheduler(work_dir=str(tmp_path / "dag1"))
+        got = _sorted_df(sched.run_collect(plan))
+
+    assert got.equals(clean)
+    ss = xla_stats.shuffle_stats()
+    assert ss["shuffle_device_fallbacks"] == 1
+    assert ss["shuffle_device_exchanges"] == 0  # collective never landed
+    assert ss["shuffle_host_bytes"] > 0         # files took over
+    # map tasks ran twice: once collecting for the device exchange,
+    # once re-partitioning into shuffle files on the fallback path
+    assert sched.task_runs[(0, 0)] == 2
+    assert sched.task_runs[(0, 1)] == 2
+
+
+def test_device_fallback_composes_with_lineage_recovery(
+        tmp_path, staged_path, fast_retries, device_shuffle_on):
+    """Worst case end-to-end: the collective dies AND the fallback's
+    first shuffle file is corrupt.  PR 4's lineage recovery must kick
+    in on the file path and still deliver bit-identical output."""
+    config.conf.set(config.SHUFFLE_DEVICE.key, "off")
+    plan = _two_stage_plan(tmp_path, n=4_000)
+    clean = _sorted_df(DagScheduler(
+        work_dir=str(tmp_path / "dag0")).run_collect(plan))
+    config.conf.set(config.SHUFFLE_DEVICE.key, "on")
+
+    xla_stats.reset()
+    # device-collective@1 kills the first shard touched by the first
+    # dispatch; the device-collect map runs never hit shuffle-write, so
+    # shuffle-write@1 corrupts the FIRST frame the fallback path
+    # flushes — map task 0's output, exactly as in the pure-file test
+    with faults.scoped(("device-collective", dict(at=(1,))),
+                       ("shuffle-write", dict(at=(1,), action="corrupt"))):
+        sched = DagScheduler(work_dir=str(tmp_path / "dag1"))
+        got = _sorted_df(sched.run_collect(plan))
+
+    assert got.equals(clean)
+    ss = xla_stats.shuffle_stats()
+    assert ss["shuffle_device_fallbacks"] == 1
+    fs = xla_stats.fault_stats()
+    assert fs["stage_recoveries"] == 1
+    assert fs["recovered_map_tasks"] == 1
+    # device collect + file fallback + lineage re-run for the poisoned
+    # map task; its healthy sibling skips the recovery round
+    assert sched.task_runs[(0, 0)] == 3
+    assert sched.task_runs[(0, 1)] == 2
