@@ -1951,11 +1951,196 @@ def multichip_bench_main() -> int:
     return 0 if ok else 1
 
 
+# ===========================================================================
+# --serve: concurrent query-service soak + latency profile (ISSUE 7)
+# ===========================================================================
+
+def serve_bench_main() -> int:
+    """Serving soak (`--serve`): replay the itest corpus through the
+    admission-controlled QueryService at increasing concurrency
+    (default 8..64), with seeded chaos (task faults, admission sheds,
+    cancel races), a slice of tight deadlines, and a slice of explicit
+    mid-flight cancels.  Acceptance: ZERO divergent surviving queries
+    (every completed result bit-identical to its fault-free solo run)
+    and ZERO leaks (scheduler leak reports empty, no registered
+    MemConsumers, no service threads left).  Records p50/p99 wall
+    latency plus shed/cancel counts per level into BENCH_SERVE.json."""
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+    import tempfile
+    import threading as _threading
+
+    import numpy as _np
+
+    from blaze_tpu import config, faults
+    from blaze_tpu.itest import generate
+    from blaze_tpu.itest.queries import QUERIES
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.itest.tpcds_data import write_parquet_splits
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.plan.stages import DagScheduler
+    from blaze_tpu.serving import QueryRejected, QueryService
+    from blaze_tpu.serving.service import _percentile
+
+    seed = int(os.environ.get("BLAZE_BENCH_SERVE_SEED", "1234"))
+    names = os.environ.get("BLAZE_BENCH_SERVE_QUERIES",
+                           "q01,q06,q95").split(",")
+    scale = float(os.environ.get("BLAZE_BENCH_SERVE_SCALE", "0.2"))
+    levels = [int(x) for x in os.environ.get(
+        "BLAZE_BENCH_SERVE_LEVELS", "8,16,32,64").split(",")]
+    rules = os.environ.get(
+        "BLAZE_BENCH_SERVE_RULES",
+        "task-start=0.05,shuffle-read=0.03,admit=0.03,cancel-race=0.5")
+
+    MemManager.init(4 << 30)
+    knobs = {config.DAG_SINGLE_TASK_BYTES.key: 0,
+             config.TASK_RETRY_BACKOFF_MS.key: 5,
+             config.TASK_MAX_ATTEMPTS.key: 6,
+             config.STAGE_MAX_RECOVERIES.key: 8}
+    for k, v in knobs.items():
+        config.conf.set(k, v)
+
+    def frame(tbl):
+        import pandas as pd
+        return tbl.to_pandas() if tbl.num_rows else pd.DataFrame(
+            {n: [] for n in tbl.schema.names})
+
+    rec_levels = []
+    divergent = 0
+    leaks = 0
+    try:
+        with tempfile.TemporaryDirectory(prefix="serve-") as d:
+            # corpus + fault-free solo baselines, shared across levels
+            plans, bases = [], []
+            for qname in names:
+                qname = qname.strip()
+                builder, table_names = QUERIES[qname]
+                tables = generate(table_names, scale=scale)
+                paths = write_parquet_splits(
+                    tables, os.path.join(d, qname), 2)
+                plan_dict, _oracle = builder(paths, tables, 2)
+                plans.append((qname, plan_dict))
+                bases.append(frame(
+                    DagScheduler().run_collect(plan_dict)))
+
+            for conc in levels:
+                n_queries = int(os.environ.get(
+                    "BLAZE_BENCH_SERVE_PER_LEVEL", str(2 * conc)))
+                rng = _np.random.default_rng(seed + conc)
+                threads_before = {t.name
+                                  for t in _threading.enumerate()}
+                svc = QueryService(max_concurrent=conc,
+                                   max_queue=n_queries,
+                                   tenant_max_inflight=n_queries)
+                faults.configure(rules, seed=seed + conc)
+                submitted, timers, shed = [], [], 0
+                t_level = time.perf_counter()
+                try:
+                    for i in range(n_queries):
+                        j = i % len(plans)
+                        deadline_ms = (float(rng.integers(5, 40))
+                                       if i % 10 == 7 else 0.0)
+                        try:
+                            h = svc.submit(plans[j][1],
+                                           tenant=f"t{i % 4}",
+                                           deadline_ms=deadline_ms)
+                        except QueryRejected:
+                            shed += 1
+                            continue
+                        if i % 9 == 4:
+                            tm = _threading.Timer(
+                                float(rng.uniform(0.0, 0.1)),
+                                svc.cancel, args=(h.query_id,))
+                            tm.start()
+                            timers.append(tm)
+                        submitted.append((h, j))
+
+                    outcome = {"done": 0, "cancelled": 0, "failed": 0}
+                    walls = []
+                    for h, j in submitted:
+                        err = h.exception(timeout=600)
+                        outcome[h.status] += 1
+                        if h.status == "done":
+                            walls.append(h.wall_s or 0.0)
+                            if compare_frames(frame(h.result()),
+                                              bases[j]) is not None:
+                                divergent += 1
+                        elif h.status == "failed" and not isinstance(
+                                err, (faults.InjectedFault,
+                                      faults.FetchFailedError)):
+                            divergent += 1  # non-chaos failure: count it
+                        if h.leak_report is not None and any(
+                                h.leak_report.values()):
+                            leaks += 1
+                finally:
+                    for tm in timers:
+                        tm.cancel()
+                    faults.clear()
+                    svc.shutdown(wait=True, cancel_running=True)
+                wall_level = time.perf_counter() - t_level
+                if MemManager.get()._consumers:
+                    leaks += 1
+                for _ in range(50):
+                    lingering = [
+                        t.name for t in _threading.enumerate()
+                        if t.name.startswith("blaze-serve")
+                        and t.name not in threads_before]
+                    if not lingering:
+                        break
+                    time.sleep(0.1)
+                leaks += len(lingering)
+                walls.sort()
+                cnt = svc.stats()["counters"]
+                rec_levels.append({
+                    "concurrency": conc,
+                    "submitted": len(submitted),
+                    "shed_at_submit": shed,
+                    "completed": outcome["done"],
+                    "cancelled": cnt["cancelled"],
+                    "deadline": cnt["deadline"],
+                    "failed": outcome["failed"],
+                    "p50_ms": round(_percentile(walls, 0.50) * 1e3, 2),
+                    "p99_ms": round(_percentile(walls, 0.99) * 1e3, 2),
+                    "wall_s": round(wall_level, 3),
+                    "qps": round(len(submitted) / wall_level, 2)
+                    if wall_level > 0 else None,
+                })
+    finally:
+        faults.clear()
+        for k in knobs:
+            config.conf.unset(k)
+
+    rec = {
+        "metric": "serve_divergent_queries",
+        "value": divergent,
+        "unit": "queries",
+        "seed": seed,
+        "rules": rules,
+        "scale": scale,
+        "queries": [q.strip() for q in names],
+        "levels": rec_levels,
+        "leaks": leaks,
+    }
+    path = os.environ.get(
+        "BLAZE_BENCH_SERVE_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_SERVE.json"))
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, default=str)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+    return 0 if divergent == 0 and leaks == 0 else 1
+
+
 def main():
     if "--expr" in sys.argv:
         sys.exit(expr_bench_main())
     if "--chaos" in sys.argv:
         sys.exit(chaos_bench_main())
+    if "--serve" in sys.argv:
+        sys.exit(serve_bench_main())
     if "--aggskip" in sys.argv:
         sys.exit(aggskip_bench_main())
     if "--multichip-child" in sys.argv:

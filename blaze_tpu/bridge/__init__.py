@@ -4,8 +4,10 @@ Ref: auron-core (JVM core) + native-engine/auron (entry/runtime) layers.
 """
 
 from blaze_tpu.bridge.context import (TaskContext, TaskKilledError,
-                                      current_task, set_current_task,
-                                      task_scope)
+                                      active_query, current_query,
+                                      current_task, query_scope,
+                                      set_current_task, task_scope)
 
 __all__ = ["TaskContext", "TaskKilledError", "current_task",
-           "set_current_task", "task_scope"]
+           "set_current_task", "task_scope", "current_query",
+           "active_query", "query_scope"]

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 
 @dataclass
@@ -23,6 +23,10 @@ class TaskContext:
     # cooperative-cancel probe (ref JniBridge.isTaskRunning,
     # AuronAdaptor.java:76-80; polled in long loops)
     is_running: Callable[[], bool] = lambda: True
+    # owning serving.QueryContext, if this task runs inside the query
+    # service; carried on the TaskContext so PrefetchIterator workers
+    # re-entering via task_scope() inherit the cancellation token.
+    query: Optional[Any] = None
 
     def check_running(self):
         if not self.is_running():
@@ -34,6 +38,9 @@ class TaskContext:
             raise TaskKilledError(
                 f"task stage={self.stage_id} "
                 f"partition={self.partition_id} killed by host")
+        q = self.query if self.query is not None else current_query()
+        if q is not None:
+            q.check()
 
 
 class TaskKilledError(RuntimeError):
@@ -69,6 +76,48 @@ class task_scope:
 
     def __exit__(self, *exc):
         _local.ctx = self._prev
+        return False
+
+
+_query_local = threading.local()
+
+
+def current_query():
+    """The serving.QueryContext bound to this thread, or None."""
+    return getattr(_query_local, "query", None)
+
+
+def active_query():
+    """The query governing the current execution, or None.
+
+    Prefers the query attached to the current TaskContext (survives
+    hand-off to prefetch workers via task_scope) and falls back to the
+    thread-local set by query_scope.
+    """
+    ctx = getattr(_local, "ctx", None)
+    if ctx is not None and ctx.query is not None:
+        return ctx.query
+    return current_query()
+
+
+class query_scope:
+    """`with query_scope(qctx):` — binds a query to this thread.
+
+    Accepts None (no-op binding) so call sites can thread an optional
+    query without branching.  Restores the previous binding on exit.
+    """
+
+    def __init__(self, query):
+        self._query = query
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_query_local, "query", None)
+        _query_local.query = self._query
+        return self._query
+
+    def __exit__(self, *exc):
+        _query_local.query = self._prev
         return False
 
 

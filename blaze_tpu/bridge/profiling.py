@@ -235,6 +235,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps({"tracing": False}))
             except Exception as e:
                 self._send(500, json.dumps({"error": str(e)}))
+        elif route == "/serving":
+            from blaze_tpu.serving import serving_stats
+            self._send(200, json.dumps({"services": serving_stats()}))
+        elif route == "/serving/cancel":
+            from blaze_tpu.serving import cancel_query
+            params = urllib.parse.parse_qs(parsed.query,
+                                           keep_blank_values=True)
+            qid = params.get("qid", [""])[0]
+            if not qid:
+                self._send(400, json.dumps(
+                    {"error": "expected ?qid=<query id>"}))
+                return
+            self._send(200, json.dumps({"query_id": qid,
+                                        "cancelled": cancel_query(qid)}))
         else:
             self._send(404, json.dumps({"error": "unknown path",
                                         "paths": ["/status", "/metrics",
@@ -243,7 +257,9 @@ class _Handler(BaseHTTPRequestHandler):
                                                   "/profile/<qid>",
                                                   "/auron", "/auron.html",
                                                   "/trace/start",
-                                                  "/trace/stop"]}))
+                                                  "/trace/stop",
+                                                  "/serving",
+                                                  "/serving/cancel"]}))
 
 
 _server: Optional[ThreadingHTTPServer] = None

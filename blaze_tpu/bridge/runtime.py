@@ -42,11 +42,16 @@ class NativeExecutionRuntime:
         from blaze_tpu.plan.fused import fuse_plan
         ensure_placement()  # once per process; may pin compute to host XLA
         td = decode_task_definition(task_definition)
+        from blaze_tpu.bridge.context import current_query
         self.task = TaskContext(
             stage_id=td.get("stage_id", 0),
             partition_id=td.get("partition_id", 0),
             num_partitions=td.get("num_partitions", 1),
-            task_attempt_id=td.get("task_attempt_id", 0))
+            task_attempt_id=td.get("task_attempt_id", 0),
+            # the constructor runs on the task-pool thread inside the
+            # service's query_scope: the query rides the TaskContext into
+            # the producer/prefetch threads that re-enter via task_scope
+            query=current_query())
         from blaze_tpu.plan.column_pruning import prune_columns
         from blaze_tpu.plan.planner import collapse_filter_project
         self.plan = fuse_plan(prune_columns(collapse_filter_project(

@@ -48,47 +48,65 @@ def default_task_parallelism(n: int) -> int:
     return max(1, min(n, config.HOST_TASK_PARALLELISM.get()))
 
 
-def _run_with_retries(fn: Callable[[int], Any], i: int, what: str) -> Any:
+def _run_with_retries(fn: Callable[[int], Any], i: int, what: str,
+                      query=None) -> Any:
     """One task slot: bounded attempts around `fn(i)` (runs ON the pool
-    thread, so retries never hold a second slot)."""
+    thread, so retries never hold a second slot).  `query` (an optional
+    serving.QueryContext) is bound to the pool thread for the duration
+    and makes backoff sleeps interruptible: a cancelled query raises
+    from inside the sleep instead of sitting out the full backoff."""
     from blaze_tpu import config
     from blaze_tpu.bridge import tracing, xla_stats
+    from blaze_tpu.bridge.context import query_scope
     max_attempts = max(1, config.TASK_MAX_ATTEMPTS.get())
     base_s = max(0, config.TASK_RETRY_BACKOFF_MS.get()) / 1e3
     wait_ns = 0
     attempt = 1
-    while True:
-        try:
-            faults.maybe_fail("task-start", task=i, attempt=attempt,
-                              what=what)
-            out = fn(i)
-            xla_stats.note_task_attempts(attempt, wait_ns)
-            return out
-        except BaseException as e:
-            kind = classify_exception(e)
-            if kind != "retryable" or attempt >= max_attempts:
-                xla_stats.note_task_attempts(attempt, wait_ns, failed=True)
-                raise
-            delay = min(base_s * (2 ** (attempt - 1)), _BACKOFF_CAP_S)
-            delay *= 1.0 + 0.25 * random.random()  # decorrelate herds
-            log.warning("%s: task %d attempt %d/%d failed (%s: %s); "
-                        "retrying in %.2fs", what, i, attempt,
-                        max_attempts, type(e).__name__, e, delay)
-            tracing.instant("task_retry", task=i, attempt=attempt,
-                            error=type(e).__name__, what=what)
-            time.sleep(delay)
-            wait_ns += int(delay * 1e9)
-            attempt += 1
+    with query_scope(query):
+        while True:
+            try:
+                if query is not None:
+                    query.check()
+                faults.maybe_fail("task-start", task=i, attempt=attempt,
+                                  what=what)
+                out = fn(i)
+                xla_stats.note_task_attempts(attempt, wait_ns)
+                return out
+            except BaseException as e:
+                kind = classify_exception(e)
+                if kind != "retryable" or attempt >= max_attempts:
+                    xla_stats.note_task_attempts(attempt, wait_ns,
+                                                 failed=True)
+                    raise
+                delay = min(base_s * (2 ** (attempt - 1)), _BACKOFF_CAP_S)
+                delay *= 1.0 + 0.25 * random.random()  # decorrelate herds
+                log.warning("%s: task %d attempt %d/%d failed (%s: %s); "
+                            "retrying in %.2fs", what, i, attempt,
+                            max_attempts, type(e).__name__, e, delay)
+                tracing.instant("task_retry", task=i, attempt=attempt,
+                                error=type(e).__name__, what=what)
+                if query is not None:
+                    if query.wait_cancelled(delay):
+                        query.check()
+                else:
+                    time.sleep(delay)
+                wait_ns += int(delay * 1e9)
+                attempt += 1
 
 
 def run_tasks(fn: Callable[[int], Any], n: int, timeout_s: float,
-              what: str, max_workers: Optional[int] = None) -> List[Any]:
+              what: str, max_workers: Optional[int] = None,
+              query=None) -> List[Any]:
     pool = ThreadPoolExecutor(max_workers=max_workers or
                               default_task_parallelism(n))
-    futs = [pool.submit(_run_with_retries, fn, i, what) for i in range(n)]
+    futs = [pool.submit(_run_with_retries, fn, i, what, query)
+            for i in range(n)]
     deadline = time.monotonic() + timeout_s
     pending = set(futs)
     while pending:
+        if query is not None and query.cancelled:
+            pool.shutdown(wait=False, cancel_futures=True)
+            query.check()
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             pool.shutdown(wait=False, cancel_futures=True)
@@ -103,8 +121,11 @@ def run_tasks(fn: Callable[[int], Any], n: int, timeout_s: float,
                                f"running after {timeout_s:g}s")
         # FIRST_EXCEPTION: a task that failed terminally (retries
         # exhausted / fatal / fetch-failed) wakes the caller NOW, not
-        # after the slowest sibling or the full timeout
-        done, pending = wait(pending, timeout=remaining,
+        # after the slowest sibling or the full timeout.  With a query
+        # bound, poll in short rounds so an external cancel() is
+        # noticed without waiting for a task to hit a check point.
+        poll = remaining if query is None else min(remaining, 0.25)
+        done, pending = wait(pending, timeout=poll,
                              return_when=FIRST_EXCEPTION)
         first_err = fetch_err = None
         for f in done:

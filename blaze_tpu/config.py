@@ -469,7 +469,8 @@ FAULTS_RULES = str_conf(
     "`site=p*max` (capped fires), `site@k1+k2` (exact occurrences), "
     "optional `:corrupt` action suffix (flip a frame byte instead of "
     "raising).  Sites: task-start, shuffle-write, shuffle-read, "
-    "ipc-decode, mem-pressure, device-collective.",
+    "ipc-decode, mem-pressure, device-collective, admit, cancel-race, "
+    "quota-breach.",
     category="fault-tolerance")
 TASK_MAX_ATTEMPTS = int_conf(
     "auron.tpu.task.maxAttempts", 4,
@@ -527,6 +528,50 @@ MESH_EXCHANGE_SKEW = float_conf(
     "the collective exchange (capacity ladder rung >= skew * "
     "rows/destination).  Skewed key distributions that still overflow "
     "re-dispatch at the next ladder rung.", category="scale-out")
+SHUFFLE_SERVICE = str_conf(
+    "auron.tpu.shuffle.service", "",
+    "Shared-storage root of the elastic shuffle tier (shuffle/rss.py, "
+    "the Celeborn/Uniffle analog): map tasks push partition frames "
+    "there instead of writing local .data/.index files, so concurrent "
+    "queries don't contend on local disk.  Empty (default) keeps the "
+    "local file shuffle; any service-tier failure falls back to files "
+    "for that stage.", category="scale-out")
+SERVING_MAX_CONCURRENT = int_conf(
+    "auron.tpu.serving.maxConcurrent", 4,
+    "Queries executing simultaneously in the QueryService "
+    "(serving/service.py); admitted queries beyond this wait in the "
+    "bounded queue.", category="serving")
+SERVING_MAX_QUEUE = int_conf(
+    "auron.tpu.serving.maxQueue", 32,
+    "Bounded admission queue depth: submissions past it are shed "
+    "immediately with QueryRejected(kind='queue-full') — the service "
+    "never wedges under overload.", category="serving")
+SERVING_TENANT_MAX_INFLIGHT = int_conf(
+    "auron.tpu.serving.tenant.maxInflight", 8,
+    "Per-tenant in-flight cap (queued + running): submissions past it "
+    "are shed with QueryRejected(kind='tenant-quota'), so one tenant "
+    "cannot monopolize the queue.", category="serving")
+SERVING_ADMIT_MEM_BYTES = int_conf(
+    "auron.tpu.serving.admitMemBytes", 0,
+    "Estimated-input-bytes admission ceiling: a query whose scan "
+    "footprint estimate exceeds this is shed with QueryRejected"
+    "(kind='memory') instead of admitted to OOM later.  0 disables; "
+    "un-stat-able inputs (remote FS, memory tables) always admit.",
+    category="serving")
+QUERY_DEADLINE_MS = int_conf(
+    "auron.tpu.query.deadlineMs", 0,
+    "Default per-query deadline in ms, applied at submission when the "
+    "caller doesn't pass one: past it the query is cancelled "
+    "cooperatively (DeadlineExceeded) within one batch boundary and "
+    "fully torn down.  0 = no deadline.", category="serving")
+QUERY_MEM_QUOTA = int_conf(
+    "auron.tpu.query.memQuota", 0,
+    "Default per-query memory quota in bytes over the unified "
+    "MemManager: a breaching query first sheds its own state and "
+    "climbs the degradation ladder (partial-agg pass-through, then "
+    "batch-capacity shrink) and is killed (QueryMemoryExceeded) only "
+    "when degradation cannot bring it under.  0 = no quota.",
+    category="serving")
 CASE_SENSITIVE = bool_conf("spark.sql.caseSensitive", False, "Column name matching.")
 ANSI_ENABLED = bool_conf(
     "spark.sql.ansi.enabled", False,

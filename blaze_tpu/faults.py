@@ -19,6 +19,12 @@ Sites (the code points that call in here):
     device-collective  parallel/stage.py DeviceExchange, per shard per
                    collective dispatch (kills the device-resident
                    exchange; the scheduler falls back to file shuffle)
+    admit          serving/service.py, per admission decision (sheds the
+                   query with QueryRejected kind="injected")
+    cancel-race    serving/service.py QueryService.cancel, widens the
+                   cancel-vs-completion race window
+    quota-breach   memory/manager.py, per quota evaluation (forces a
+                   per-query quota breach → degradation rung)
 
 Determinism: every decision is a pure function of (seed, site,
 occurrence-index) — the k-th evaluation of a site fires or not
@@ -47,7 +53,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 SITES = ("task-start", "shuffle-write", "shuffle-read", "ipc-decode",
-         "mem-pressure", "device-collective")
+         "mem-pressure", "device-collective", "admit", "cancel-race",
+         "quota-breach")
 
 
 class InjectedFault(RuntimeError):

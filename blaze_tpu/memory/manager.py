@@ -39,7 +39,16 @@ class MemConsumer:
     def __init__(self, name: str):
         self.name = name
         self._mem_used = 0
+        #: set (under the manager lock) by cross-query arbitration; the
+        #: consumer sheds itself on its OWN thread at its next
+        #: update_mem_used — a foreign thread must never mutate another
+        #: query's operator state mid-batch
+        self._release_requested = False
         self._manager: Optional[MemManager] = None
+        #: owning serving.QueryContext (captured at set_spillable time);
+        #: None for standalone consumers.  Lets the manager arbitrate
+        #: ACROSS queries and enforce per-query quotas.
+        self.query = None
         self.spill_metrics = SpillMetrics()
         # owning operator's MetricNode; when set, retained-byte peaks are
         # recorded there as `mem_used` (baseline metric vocabulary).  A
@@ -52,6 +61,9 @@ class MemConsumer:
         return self._mem_used
 
     def set_spillable(self, manager: "MemManager") -> None:
+        from blaze_tpu.bridge.context import active_query
+        if self.query is None:
+            self.query = active_query()
         self._manager = manager
         manager.register_consumer(self)
 
@@ -99,7 +111,14 @@ class MemManager:
         self.total_spill_count = 0
         self.total_spilled_bytes = 0
         self.total_pressure_releases = 0
+        self.total_quota_breaches = 0
         self.peak_used = 0
+        #: per-query shed attribution: query_id (or "<solo>") -> bytes
+        #: released on its consumers by pressure/quota arbitration
+        self.shed_bytes_by_query: Dict[str, int] = {}
+        #: query_id of the first consumer shed under GLOBAL pressure —
+        #: the observable form of "the heaviest query pays first"
+        self.first_shed_query: Optional[str] = None
 
     # -- singleton wiring (ref MemManager::init, lib.rs:46) ---------------
     @classmethod
@@ -139,6 +158,20 @@ class MemManager:
     # -- pressure handling -------------------------------------------------
     def on_mem_updated(self, updated: MemConsumer) -> None:
         with self._lock:
+            # a pending cross-query release request is honored first, on
+            # the consumer's own thread (the only thread that may touch
+            # its state)
+            if updated._release_requested and updated.mem_used > 0:
+                updated._release_requested = False
+                released = updated.try_release_pressure()
+                if released > 0:
+                    self.total_pressure_releases += 1
+                else:
+                    released = updated.spill()
+                    self.total_spill_count += 1
+                    self.total_spilled_bytes += released
+                self._attribute_shed(updated, released,
+                                     global_pressure=True)
             used = self.mem_used
             if used > self.peak_used:
                 self.peak_used = used
@@ -153,27 +186,114 @@ class MemManager:
                 released = updated.spill()
                 self.total_spill_count += 1
                 self.total_spilled_bytes += released
+            # per-query quota first: a query over ITS budget sheds its
+            # own state (and climbs the degradation ladder) before its
+            # pressure is socialized across the pool
+            self._enforce_query_quota(updated)
             # a consumer far over its fair share spills even without global
             # overflow, so one giant sort cannot starve later operators
             if overflow <= 0 and updated.mem_used <= cap * 2:
                 return
             # spill biggest consumers until under budget (ref lib.rs: spill
-            # of the biggest consumer on pressure).  A consumer offering a
-            # cheaper-than-spill release (partial-agg pass-through switch)
-            # is taken at its word first — the released partials stream
-            # downstream instead of hitting spill IO.
-            for c in sorted(self._consumers, key=lambda c: -c.mem_used):
+            # of the biggest consumer on pressure).  Across queries the
+            # heaviest QUERY pays first (its largest consumer leading), so
+            # a light query sharing the pool with a hog is untouched.  A
+            # consumer offering a cheaper-than-spill release (partial-agg
+            # pass-through switch) is taken at its word first — the
+            # released partials stream downstream instead of hitting
+            # spill IO.  Consumers of a DIFFERENT query are never shed
+            # from this thread (their owner may be mid-mutation): they
+            # get a release request they honor at their next update,
+            # and because the order is heaviest-first, this thread stops
+            # rather than shed its lighter self while the hog's release
+            # is pending.
+            upd_q = getattr(updated, "query", None)
+            for c in self._arbitration_order():
                 if self.mem_used <= self.total * MEM_SPILL_FACTOR:
                     break
                 if c.mem_used == 0:
                     continue
+                c_q = getattr(c, "query", None)
+                if c_q is not None and c_q is not upd_q:
+                    c._release_requested = True
+                    break
                 released = c.try_release_pressure()
                 if released > 0:
                     self.total_pressure_releases += 1
+                    self._attribute_shed(c, released, global_pressure=True)
                     continue
                 released = c.spill()
                 self.total_spill_count += 1
                 self.total_spilled_bytes += released
+                self._attribute_shed(c, released, global_pressure=True)
+
+    def _attribute_shed(self, c: MemConsumer, released: int,
+                        global_pressure: bool = False) -> None:
+        if released <= 0:
+            return
+        qid = str(getattr(getattr(c, "query", None), "query_id", None)
+                  or "<solo>")
+        if global_pressure and self.first_shed_query is None:
+            self.first_shed_query = qid
+        self.shed_bytes_by_query[qid] = (
+            self.shed_bytes_by_query.get(qid, 0) + released)
+
+    def _arbitration_order(self) -> List[MemConsumer]:
+        """Consumers ordered heaviest-query-first, then biggest-first.
+
+        Standalone consumers (no query) form singleton groups, which
+        preserves the single-query behaviour: biggest consumer first.
+        """
+        totals: Dict[object, int] = {}
+        for c in self._consumers:
+            q = getattr(c, "query", None)
+            key = id(q) if q is not None else ("solo", id(c))
+            totals[key] = totals.get(key, 0) + c.mem_used
+
+        def order(c: MemConsumer):
+            q = getattr(c, "query", None)
+            key = id(q) if q is not None else ("solo", id(c))
+            return (-totals[key], -c.mem_used)
+
+        return sorted(self._consumers, key=order)
+
+    def _enforce_query_quota(self, updated: MemConsumer) -> None:
+        """Per-query quota: shed the breaching query's own state largest-
+        first, and advance its degradation ladder one rung per breaching
+        update (pass-through → shrink-capacity → kill)."""
+        from blaze_tpu import faults
+        q = getattr(updated, "query", None)
+        if q is None:
+            return
+        quota = int(getattr(q, "mem_quota", 0) or 0)
+        mine = [c for c in self._consumers if getattr(c, "query", None) is q]
+        used = sum(c.mem_used for c in mine)
+        forced = faults.fires("quota-breach")
+        if not forced and (quota <= 0 or used <= quota):
+            return
+        self.total_quota_breaches += 1
+        rung = q.degrade()
+        try:
+            from blaze_tpu.bridge import tracing
+            tracing.instant("quota_breach", query=q.query_id, used=used,
+                            quota=quota, rung=rung)
+        except Exception:
+            pass
+        target = int((quota if quota > 0 else used) * MEM_SPILL_FACTOR)
+        for c in sorted(mine, key=lambda c: -c.mem_used):
+            if sum(x.mem_used for x in mine) <= target:
+                break
+            if c.mem_used == 0:
+                continue
+            released = c.try_release_pressure()
+            if released > 0:
+                self.total_pressure_releases += 1
+                self._attribute_shed(c, released)
+                continue
+            released = c.spill()
+            self.total_spill_count += 1
+            self.total_spilled_bytes += released
+            self._attribute_shed(c, released)
 
     # -- diagnostics (ref lib.rs:143 dump_status) -------------------------
     def dump_status(self) -> str:
@@ -182,6 +302,10 @@ class MemManager:
                      f"spills={self.total_spill_count} "
                      f"spilled_bytes={self.total_spilled_bytes} "
                      f"pressure_releases={self.total_pressure_releases}"]
+            if self.shed_bytes_by_query:
+                shed = " ".join(f"{q}={b}" for q, b in
+                                sorted(self.shed_bytes_by_query.items()))
+                lines.append(f"  shed_by_query: {shed}")
             for c in self._consumers:
                 lines.append(f"  {c.name}: used={c.mem_used}")
             return "\n".join(lines)

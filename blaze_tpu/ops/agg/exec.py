@@ -236,6 +236,15 @@ class _AggState(MemConsumer):
     def _should_skip_partials(self) -> bool:
         if self._probe_done or not self._skip_eligible():
             return False
+        # degradation rung 1 (serving quota breach): force pass-through
+        # regardless of the probe — the query trades merge ratio for
+        # bounded partial-agg state (kill-switch config still respected
+        # via _skip_eligible only; the ladder overrides enable/minRows)
+        from blaze_tpu.bridge.context import active_query
+        q = getattr(self, "query", None) or active_query()
+        if q is not None and getattr(q, "force_agg_passthrough", False):
+            self._probe_done = True
+            return True
         if not config.PARTIAL_AGG_SKIPPING_ENABLE.get():
             return False
         if self.rows_seen < config.PARTIAL_AGG_SKIPPING_MIN_ROWS.get():
@@ -559,7 +568,13 @@ class _AggState(MemConsumer):
                                           schema=self._internal_schema)
 
     def try_release_pressure(self) -> int:
-        if not (config.PARTIAL_AGG_SKIPPING_ON_SPILL.get() and
+        # a query on the degradation ladder accepts the pass-through
+        # offer even with onSpill off: its quota breach already chose
+        # degradation over spill IO
+        q = getattr(self, "query", None)
+        degraded = q is not None and getattr(q, "force_agg_passthrough",
+                                             False)
+        if not ((config.PARTIAL_AGG_SKIPPING_ON_SPILL.get() or degraded) and
                 not self.skipping and not self._output_started and
                 self.buffer and self._skip_eligible()):
             return 0

@@ -75,6 +75,11 @@ class _MeteredIter:
         active = _active_ids()
         reenter = self._key in active
         if not reenter:
+            # cooperative cancellation at every metered batch step: a
+            # cancelled/overdue query stops within one batch no matter
+            # which operator is driving (reentrant self-calls skip the
+            # check — the outer frame already ran it this step)
+            current_task().check_running()
             active.add(self._key)
         t0 = time.perf_counter_ns()
         try:
@@ -360,6 +365,20 @@ class ExecutionPlan:
         return "\n".join(lines)
 
 
+def effective_batch_size(base: Optional[int] = None) -> int:
+    """Coalesce target honouring the active query's degradation ladder:
+    each `shrink-capacity` rung halves the target (floor 256 rows), so a
+    quota-breaching query re-batches smaller and retains less state."""
+    from blaze_tpu.bridge.context import active_query
+    size = base or config.BATCH_SIZE.get()
+    q = active_query()
+    if q is not None:
+        shrink = getattr(q, "capacity_shrink", 0)
+        if shrink:
+            size = max(256, size >> shrink)
+    return size
+
+
 class CoalesceStream:
     """Re-batches a stream to ~batch_size dense rows.
 
@@ -383,18 +402,21 @@ class CoalesceStream:
         ctx = current_task()
         for batch in self._stream:
             ctx.check_running()
+            # re-evaluated per batch so a mid-query degradation rung
+            # takes effect at the next boundary
+            target = effective_batch_size(self._batch_size)
             n = batch.selected_count()
             if n == 0:
                 continue
             density = n / max(1, batch.capacity)
             if density < self._min_density:
                 batch = batch.compact()
-            if n >= self._batch_size // 2 and not staged:
+            if n >= target // 2 and not staged:
                 yield batch
                 continue
             staged.append(batch)
             staged_rows += n
-            if staged_rows >= self._batch_size:
+            if staged_rows >= target:
                 yield ColumnBatch.concat(staged,
                                          bucket_capacity(staged_rows))
                 staged, staged_rows = [], 0

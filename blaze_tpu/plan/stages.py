@@ -143,12 +143,22 @@ class DagScheduler:
 
     def __init__(self, work_dir: Optional[str] = None,
                  max_task_parallelism: Optional[int] = None,
-                 task_timeout_s: float = 600.0):
+                 task_timeout_s: float = 600.0,
+                 query_ctx=None):
         self._owns_dir = work_dir is None
         self._dir = work_dir or tempfile.mkdtemp(
             prefix="blaze-dag-", dir=_shuffle_scratch_base())
         os.makedirs(self._dir, exist_ok=True)
         self._files: List[str] = []
+        # owning serving.QueryContext: threaded to every task slot so
+        # cancellation/deadline interrupts retries, pool waits and batch
+        # loops (None = standalone single-query use, unchanged)
+        from blaze_tpu.bridge.context import current_query
+        self._query = query_ctx if query_ctx is not None else current_query()
+        # elastic-shuffle clients (auron.tpu.shuffle.service), torn down
+        # with the rest of the scratch state
+        self._rss_clients: List[Any] = []
+        self._cleanup_lock = threading.Lock()
         if max_task_parallelism is None:
             # executor sizing knob (ref rt.rs:108-112 tokio worker threads
             # = TOKIO_WORKER_THREADS_PER_CPU x task cpus)
@@ -323,7 +333,8 @@ class DagScheduler:
         # serial tasks around intra-op-parallel C++ kernels beat
         # GIL-contended task concurrency (see default_task_parallelism)
         workers = min(self._par, default_task_parallelism(n))
-        return run_tasks(fn, n, self._timeout, what, max_workers=workers)
+        return run_tasks(fn, n, self._timeout, what, max_workers=workers,
+                         query=self._query)
 
     @staticmethod
     def _part_of(stage: Stage) -> Dict[str, Any]:
@@ -373,13 +384,23 @@ class DagScheduler:
         except FetchFailedError as e:
             raise FetchFailedError(stage.sid, m, e.reason) from e
 
+    @staticmethod
+    def _is_cancellation(e: BaseException) -> bool:
+        """Cancellation/deadline/kill must never be swallowed into a
+        shuffle-tier fallback: the query is being torn down, not
+        recovering."""
+        from blaze_tpu.bridge.context import TaskKilledError
+        from blaze_tpu.serving.context import QueryCancelled
+        return isinstance(e, (QueryCancelled, TaskKilledError))
+
     def _run_producer(self, stage: Stage) -> None:
         """One exchange boundary: device-resident collective when the
-        planner marked it eligible, host shuffle files otherwise — and
-        the file path is ALSO the fallback for any device-lane failure
-        (a dead shard mid-collective, payload over the device cap, an
-        unsupported runtime shape).  Device shuffle is an optimization,
-        never a new failure mode."""
+        planner marked it eligible; else the elastic shuffle service
+        (auron.tpu.shuffle.service) when configured, so concurrent
+        queries don't contend on local disk; host shuffle files
+        otherwise — and the file path is ALSO the fallback for any
+        device- or service-tier failure.  The higher tiers are
+        optimizations, never a new failure mode."""
         if stage.device_spec is not None:
             try:
                 self._run_producer_device(stage)
@@ -391,11 +412,36 @@ class DagScheduler:
                 # must reach the recovery loop, not trigger a fallback
                 raise
             except Exception as e:
+                if self._is_cancellation(e):
+                    raise
                 from blaze_tpu.bridge import tracing, xla_stats
                 xla_stats.note_device_shuffle_fallback()
                 tracing.instant("device_shuffle_fallback",
                                 stage=stage.sid, error=type(e).__name__)
+        rss_root = self._rss_root()
+        if rss_root is not None:
+            try:
+                self._run_producer_rss(stage, rss_root)
+                return
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except FetchFailedError:
+                raise
+            except Exception as e:
+                if self._is_cancellation(e):
+                    raise
+                from blaze_tpu.bridge import tracing
+                tracing.instant("rss_shuffle_fallback", stage=stage.sid,
+                                error=type(e).__name__)
         self._run_producer_file(stage)
+
+    @staticmethod
+    def _rss_root() -> Optional[str]:
+        """Shared-storage root of the elastic shuffle tier, or None for
+        local files (the default)."""
+        from blaze_tpu import config
+        root = config.SHUFFLE_SERVICE.get().strip()
+        return root or None
 
     def _run_map_task_collect(self, stage: Stage,
                               m: int) -> List[pa.RecordBatch]:
@@ -465,6 +511,70 @@ class DagScheduler:
         def blocks_for(reduce_id: int):
             blk = blocks.get(reduce_id)
             if blk is not None:
+                yield blk
+
+        put_resource(stage.resource_id, blocks_for)
+        if stage.resource_id not in self._resources:
+            self._resources.append(stage.resource_id)
+
+    def _run_producer_rss(self, stage: Stage, root: str) -> None:
+        """Elastic shuffle tier: map tasks PUSH partition frames to the
+        shared-storage shuffle service (shuffle/rss.py, the Celeborn
+        analog) instead of writing local .data/.index files.  Each task
+        retry pushes under a FRESH attempt id — commits are first-wins,
+        so readers see exactly one complete attempt per map regardless
+        of mid-push failures."""
+        from blaze_tpu.bridge import tracing
+        from blaze_tpu.bridge.runtime import NativeExecutionRuntime
+        from blaze_tpu.plan.proto_serde import task_definition_to_bytes
+        from blaze_tpu.shuffle.rss import RssPushClient
+
+        part = self._part_of(stage)
+        n_out = int(part.get("num_partitions", 1))
+        client = RssPushClient(root, f"{self._run_id}-{stage.sid}",
+                               stage.num_tasks, n_out)
+        self._rss_clients.append(client)
+        attempts: Dict[int, int] = {}
+        attempts_lock = threading.Lock()
+
+        def run_map(m: int) -> None:
+            with attempts_lock:
+                attempt = attempts.get(m, 0)
+                attempts[m] = attempt + 1
+            writer = client.partition_writer(m, attempt)
+            rid = f"rss://{self._run_id}/{stage.sid}/{m}/a{attempt}"
+            put_resource(rid, writer)
+            try:
+                plan = {"kind": "rss_shuffle_writer", "partitioning": part,
+                        "rss_resource_id": rid,
+                        "input": self._per_task(stage.plan, m,
+                                                stage.num_tasks)}
+                td = task_definition_to_bytes(
+                    {"stage_id": stage.sid, "partition_id": m,
+                     "num_partitions": stage.num_tasks, "plan": plan})
+                rt = NativeExecutionRuntime(td).start()
+                try:
+                    for _ in rt.batches():
+                        pass
+                finally:
+                    self._record_task_metrics(stage.sid, rt.finalize())
+                writer.commit()
+            finally:
+                remove_resource(rid)
+            with self._metrics_lock:
+                self.task_runs[(stage.sid, m)] = \
+                    self.task_runs.get((stage.sid, m), 0) + 1
+
+        with tracing.span("rss_exchange", stage=stage.sid,
+                          tasks=stage.num_tasks, partitions=n_out):
+            self._run_tasks(run_map, stage.num_tasks,
+                            f"stage {stage.sid} (rss push)")
+
+        self._stage_outputs[stage.sid] = {}
+        timeout = self._timeout
+
+        def blocks_for(reduce_id: int):
+            for blk in client.reader_blocks(reduce_id, timeout_s=timeout):
                 yield blk
 
         put_resource(stage.resource_id, blocks_for)
@@ -600,6 +710,8 @@ class DagScheduler:
         from blaze_tpu.plan.types import schema_from_dict
 
         from blaze_tpu import config
+        if self._query is not None:
+            self._query.check()  # shed before any work if already overdue
         self.stage_metrics = {}  # instance may be reused per query
         self.task_runs = {}
         threshold = config.DAG_SINGLE_TASK_BYTES.get()
@@ -664,25 +776,68 @@ class DagScheduler:
             self.cleanup()
 
     def cleanup(self) -> None:
-        """Idempotent: safe to call any number of times (run_collect,
-        context-manager exit and __del__ may all reach it)."""
-        for rid in self._resources:
+        """Idempotent AND safe under concurrent callers: run_collect's
+        finally, a cancelling service thread, context-manager exit and
+        __del__ may all race here.  State lists are swapped out under a
+        lock, so every resource/file is released exactly once."""
+        # __del__ can run during interpreter shutdown after the lock (or
+        # the module globals) are torn down — degrade to best-effort
+        lock = getattr(self, "_cleanup_lock", None)
+        if lock is None:
+            return
+        with lock:
+            resources, self._resources = self._resources, []
+            files, self._files = self._files, []
+            rss_clients, self._rss_clients = self._rss_clients, []
+            self._stage_outputs = {}
+        for rid in resources:
             try:
                 remove_resource(rid)
             except Exception:
                 pass
-        self._resources = []
-        self._stage_outputs = {}
-        for path in self._files:
+        for path in files:
             try:
                 os.unlink(path)
             except OSError:
                 pass
-        self._files = []
+        for client in rss_clients:
+            try:
+                client.cleanup()
+            except Exception:
+                pass
         if self._owns_dir:
             import shutil
             # recreated lazily by the next _run_producer if reused
             shutil.rmtree(self._dir, ignore_errors=True)
+
+    def leak_report(self) -> Dict[str, List[str]]:
+        """What this scheduler still holds: shuffle temp files on disk,
+        resource-map entries, RSS shuffle roots, and the owned scratch
+        dir.  Empty lists everywhere == nothing leaked; tests assert
+        exactly that after failed/cancelled queries."""
+        from blaze_tpu.bridge.resource import get_resource
+        report: Dict[str, List[str]] = {
+            "files": [], "resources": [], "rss_roots": [], "dirs": []}
+        with self._cleanup_lock:
+            files = list(self._files)
+            resources = list(self._resources)
+            rss_clients = list(self._rss_clients)
+        for path in files:
+            if os.path.exists(path):
+                report["files"].append(path)
+        for rid in resources:
+            if get_resource(rid) is not None:
+                report["resources"].append(rid)
+        for client in rss_clients:
+            if os.path.isdir(client.root):
+                report["rss_roots"].append(client.root)
+        if self._owns_dir and os.path.isdir(self._dir):
+            leftovers = [os.path.join(self._dir, f)
+                         for f in os.listdir(self._dir)]
+            if leftovers:
+                report["dirs"].append(self._dir)
+                report["files"].extend(leftovers)
+        return report
 
     def __enter__(self) -> "DagScheduler":
         return self

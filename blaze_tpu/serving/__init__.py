@@ -1,0 +1,20 @@
+"""Admission-controlled concurrent query service (ISSUE 7).
+
+The serving layer that turns the single-query engine into a process that
+survives "heavy traffic": a bounded admission queue with per-tenant
+quotas and load shedding (`service.QueryService`), per-query deadline +
+cooperative cancellation + memory-quota degradation (`context
+.QueryContext`), and the failure taxonomy callers program against
+(`QueryRejected`, `QueryCancelled`, `DeadlineExceeded`,
+`QueryMemoryExceeded`).
+"""
+
+from blaze_tpu.serving.context import (DeadlineExceeded, QueryCancelled,
+                                       QueryContext, QueryMemoryExceeded)
+from blaze_tpu.serving.service import (QueryHandle, QueryRejected,
+                                       QueryService, cancel_query,
+                                       serving_stats)
+
+__all__ = ["QueryContext", "QueryCancelled", "DeadlineExceeded",
+           "QueryMemoryExceeded", "QueryService", "QueryHandle",
+           "QueryRejected", "serving_stats", "cancel_query"]

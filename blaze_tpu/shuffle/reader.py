@@ -130,11 +130,16 @@ class IpcReaderExec(ExecutionPlan):
         return prefetch(self._read_blocks(partition), name="ipc_reader")
 
     def _read_blocks(self, partition: int):
+        from blaze_tpu.bridge.context import current_task
         source = get_resource(self.resource_id)
         if source is None:
             raise KeyError(f"shuffle resource {self.resource_id!r} not found")
         blocks = source(partition) if callable(source) else source
+        ctx = current_task()
         for block in blocks:
+            # per-block cancellation point: a cancelled query stops
+            # fetching mid-shuffle instead of draining every segment
+            ctx.check_running()
             for rb in read_block(block):
                 self.metrics.add("io_bytes", rb.nbytes)
                 yield rb

@@ -104,8 +104,10 @@ class ShuffleRepartitioner(MemConsumer):
             self._stream_writer.write_batch(rb)
 
     def close(self) -> None:
-        """Abandon an un-finalized stream (task failure path): the
-        temp file is removed, the final path never existed."""
+        """Abandon an un-finalized write (task failure/cancel path): the
+        stream temp file is removed, the final path never existed, and
+        any spill files are released — a query cancelled between spill
+        and write() must not leak them."""
         if self._stream_sink is not None:
             try:
                 self._stream_sink.close()
@@ -117,6 +119,13 @@ class ShuffleRepartitioner(MemConsumer):
                 pass
             self._stream_sink = None
             self._stream_writer = None
+        if self._spills:
+            spills, self._spills = self._spills, []
+            for s in spills:
+                try:
+                    s.release()
+                except OSError:
+                    pass
 
     # -- insert (ref ShuffleRepartitioner::insert_batch, shuffle/mod.rs:55)
     def insert_batch(self, batch: ColumnBatch) -> None:

@@ -1,0 +1,639 @@
+"""Admission-controlled query service (serving/): admission + shedding,
+deadline/cancel cooperative teardown, per-query memory quotas with the
+degradation ladder, cross-query arbitration, interruptible backoff,
+concurrent-safe cleanup, and the elastic RSS shuffle tier."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu import config, faults
+from blaze_tpu.bridge import query_scope
+from blaze_tpu.bridge.resource import get_resource, put_resource
+from blaze_tpu.bridge.tasks import run_tasks
+from blaze_tpu.exprs import col
+from blaze_tpu.memory import MemManager
+from blaze_tpu.memory.manager import MemConsumer
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.ops.agg import AggExec, AggMode, make_agg
+from blaze_tpu.ops.base import effective_batch_size
+from blaze_tpu.plan.stages import DagScheduler
+from blaze_tpu.serving import (DeadlineExceeded, QueryCancelled,
+                               QueryContext, QueryMemoryExceeded,
+                               QueryRejected, QueryService)
+
+
+@pytest.fixture(autouse=True)
+def clean_slate():
+    faults.clear()
+    MemManager.init(4 << 30)
+    try:
+        yield
+    finally:
+        faults.clear()
+        MemManager.init(4 << 30)
+
+
+@pytest.fixture
+def fast_retries():
+    config.conf.set(config.TASK_RETRY_BACKOFF_MS.key, 1)
+    try:
+        yield
+    finally:
+        config.conf.unset(config.TASK_RETRY_BACKOFF_MS.key)
+
+
+@pytest.fixture
+def staged_path():
+    config.conf.set(config.DAG_SINGLE_TASK_BYTES.key, 0)
+    try:
+        yield
+    finally:
+        config.conf.unset(config.DAG_SINGLE_TASK_BYTES.key)
+
+
+def _two_stage_plan(tmp_path, n=20_000, n_reduce=3, seed=7, tag="",
+                    n_keys=200):
+    rng = np.random.default_rng(seed)
+    t = pa.table({"k": pa.array(rng.integers(0, n_keys, n),
+                                type=pa.int64()),
+                  "v": pa.array(rng.random(n))})
+    paths = []
+    for i in range(2):
+        p = str(tmp_path / f"in{tag}-{i}.parquet")
+        pq.write_table(t.slice(i * (n // 2), n // 2), p)
+        paths.append(p)
+    schema = {"fields": [
+        {"name": "k", "type": {"id": "int64"}, "nullable": True},
+        {"name": "v", "type": {"id": "float64"}, "nullable": True}]}
+    return {
+        "kind": "hash_agg",
+        "groupings": [{"expr": {"kind": "column", "index": 0},
+                       "name": "k"}],
+        "aggs": [{"fn": "sum", "mode": "final", "name": "s",
+                  "args": [{"kind": "column", "index": 1}]}],
+        "input": {
+            "kind": "local_exchange",
+            "partitioning": {"kind": "hash",
+                             "exprs": [{"kind": "column", "index": 0}],
+                             "num_partitions": n_reduce},
+            "input": {
+                "kind": "hash_agg",
+                "groupings": [{"expr": {"kind": "column", "name": "k"},
+                               "name": "k"}],
+                "aggs": [{"fn": "sum", "mode": "partial", "name": "s",
+                          "args": [{"kind": "column", "name": "v"}]}],
+                "input": {"kind": "parquet_scan", "schema": schema,
+                          "file_groups": [[paths[0]], [paths[1]]]}}}}
+
+
+def _sorted_df(tbl):
+    return tbl.to_pandas().sort_values("k").reset_index(drop=True)
+
+
+# -- QueryContext ------------------------------------------------------------
+
+def test_cancel_first_wins_and_check_raises():
+    ctx = QueryContext("qx", tenant="t")
+    assert not ctx.cancelled
+    ctx.check()  # live: no-op
+    assert ctx.cancel("stop it") is True
+    assert ctx.cancel("too late", kind="deadline") is False  # first wins
+    with pytest.raises(QueryCancelled, match="stop it"):
+        ctx.check()
+    assert ctx.wait_cancelled(0.0) is True
+
+
+def test_deadline_autocancels_on_check():
+    ctx = QueryContext(deadline_ms=1)
+    time.sleep(0.01)
+    with pytest.raises(DeadlineExceeded):
+        ctx.check()
+    assert ctx.cancelled
+
+
+def test_degrade_ladder_rungs_then_kill():
+    ctx = QueryContext(mem_quota=123)
+    assert ctx.degrade() == "agg-passthrough"
+    assert ctx.force_agg_passthrough and ctx.capacity_shrink == 0
+    assert ctx.degrade() == "shrink-capacity"
+    assert ctx.capacity_shrink == 1
+    assert not ctx.cancelled
+    assert ctx.degrade() == "kill"
+    with pytest.raises(QueryMemoryExceeded, match="123"):
+        ctx.check()
+
+
+def test_effective_batch_size_shrinks_with_ladder():
+    assert effective_batch_size(8192) == 8192
+    ctx = QueryContext()
+    ctx.degrade()          # rung 1: no shrink yet
+    ctx.degrade()          # rung 2: halve once
+    with query_scope(ctx):
+        assert effective_batch_size(8192) == 4096
+        assert effective_batch_size(300) == 256  # floor
+
+
+# -- admission & load shedding ----------------------------------------------
+
+def _blocking_executor(release: threading.Event):
+    def ex(plan, ctx, handle):
+        while not release.wait(0.01):
+            ctx.check()
+        return "done"
+    return ex
+
+
+def test_queue_full_sheds_typed():
+    release = threading.Event()
+    svc = QueryService(max_concurrent=1, max_queue=1,
+                       executor=_blocking_executor(release))
+    try:
+        running = svc.submit({"kind": "noop"})
+        time.sleep(0.05)  # let it start (leaves the queue)
+        queued = svc.submit({"kind": "noop"})
+        with pytest.raises(QueryRejected) as e:
+            svc.submit({"kind": "noop"})
+        assert e.value.kind == "queue-full"
+        assert svc.stats()["counters"]["shed_queue_full"] == 1
+        release.set()
+        assert running.result(10) == "done"
+        assert queued.result(10) == "done"
+    finally:
+        release.set()
+        svc.shutdown()
+
+
+def test_tenant_quota_sheds_only_that_tenant():
+    release = threading.Event()
+    svc = QueryService(max_concurrent=1, max_queue=16,
+                       tenant_max_inflight=2,
+                       executor=_blocking_executor(release))
+    try:
+        hs = [svc.submit({"kind": "noop"}, tenant="hog") for _ in range(2)]
+        with pytest.raises(QueryRejected) as e:
+            svc.submit({"kind": "noop"}, tenant="hog")
+        assert e.value.kind == "tenant-quota"
+        # another tenant still admits
+        other = svc.submit({"kind": "noop"}, tenant="polite")
+        release.set()
+        for h in hs + [other]:
+            assert h.result(10) == "done"
+    finally:
+        release.set()
+        svc.shutdown()
+
+
+def test_memory_admission_sheds_on_estimate(tmp_path):
+    plan = _two_stage_plan(tmp_path, n=4_000)
+    svc = QueryService(admit_mem_bytes=16,  # any real file beats 16B
+                       executor=lambda p, c, h: "ran")
+    try:
+        with pytest.raises(QueryRejected) as e:
+            svc.submit(plan)
+        assert e.value.kind == "memory"
+        # un-stat-able input (no file scans) always admits
+        assert svc.submit({"kind": "memory_scan"}).result(10) == "ran"
+    finally:
+        svc.shutdown()
+
+
+def test_injected_admit_fault_sheds():
+    svc = QueryService(executor=lambda p, c, h: "ran")
+    try:
+        with faults.scoped(("admit", dict(p=1.0))):
+            with pytest.raises(QueryRejected) as e:
+                svc.submit({"kind": "noop"})
+        assert e.value.kind == "injected"
+        assert svc.stats()["counters"]["shed_injected"] == 1
+        assert svc.submit({"kind": "noop"}).result(10) == "ran"
+    finally:
+        svc.shutdown()
+
+
+def test_shutdown_rejects_new_queries():
+    svc = QueryService(executor=lambda p, c, h: "ran")
+    svc.shutdown()
+    with pytest.raises(QueryRejected) as e:
+        svc.submit({"kind": "noop"})
+    assert e.value.kind == "shutdown"
+
+
+# -- cancellation & deadlines ------------------------------------------------
+
+def test_cancel_queued_query_sheds_at_pop():
+    release = threading.Event()
+    ran = []
+
+    def ex(plan, ctx, handle):
+        ran.append(ctx.query_id)
+        while not release.wait(0.01):
+            ctx.check()
+        return "done"
+
+    svc = QueryService(max_concurrent=1, max_queue=4, executor=ex)
+    try:
+        running = svc.submit({"kind": "noop"})
+        time.sleep(0.05)
+        queued = svc.submit({"kind": "noop"})
+        assert queued.cancel() is True
+        release.set()
+        assert running.result(10) == "done"
+        with pytest.raises(QueryCancelled):
+            queued.result(10)
+        assert queued.status == "cancelled"
+        assert queued.query_id not in ran  # zero work done
+    finally:
+        release.set()
+        svc.shutdown()
+
+
+def test_cancel_running_query_tears_down_within_a_step():
+    steps = []
+
+    def ex(plan, ctx, handle):
+        for i in range(1000):
+            ctx.check()   # the per-batch cooperative point
+            steps.append(i)
+            time.sleep(0.005)
+        return "done"
+
+    svc = QueryService(max_concurrent=1, executor=ex)
+    try:
+        h = svc.submit({"kind": "noop"})
+        time.sleep(0.05)
+        assert svc.cancel(h.query_id) is True
+        with pytest.raises(QueryCancelled):
+            h.result(10)
+        n_at_cancel = len(steps)
+        time.sleep(0.05)
+        assert len(steps) <= n_at_cancel + 1  # stopped within one step
+        assert svc.stats()["counters"]["cancelled"] == 1
+    finally:
+        svc.shutdown()
+
+
+def test_deadline_on_staged_query_tears_down_clean(tmp_path, staged_path):
+    plan = _two_stage_plan(tmp_path, n=8_000)
+    svc = QueryService(max_concurrent=2)
+    try:
+        h = svc.submit(plan, deadline_ms=1)
+        with pytest.raises(DeadlineExceeded):
+            h.result(60)
+        assert h.status == "cancelled"
+        assert svc.stats()["counters"]["deadline"] == 1
+        # full teardown: no shuffle files, resources or scratch dirs left
+        assert h.leak_report is not None
+        assert all(v == [] for v in h.leak_report.values()), h.leak_report
+    finally:
+        svc.shutdown()
+
+
+def test_retry_backoff_interruptible_by_cancel():
+    config.conf.set(config.TASK_RETRY_BACKOFF_MS.key, 30_000)
+    config.conf.set(config.TASK_MAX_ATTEMPTS.key, 4)
+    try:
+        ctx = QueryContext("qb")
+
+        def always_fails(i):
+            raise IOError("transient")  # classified retryable
+
+        timer = threading.Timer(0.15, ctx.cancel, args=("bored",))
+        timer.start()
+        t0 = time.monotonic()
+        with pytest.raises(QueryCancelled):
+            run_tasks(always_fails, 1, timeout_s=90, what="backoff-test",
+                      query=ctx)
+        elapsed = time.monotonic() - t0
+        timer.cancel()
+        # without the interruptible sleep this sits out a 30s backoff
+        assert elapsed < 5, f"backoff not interrupted ({elapsed:.1f}s)"
+    finally:
+        config.conf.unset(config.TASK_RETRY_BACKOFF_MS.key)
+        config.conf.unset(config.TASK_MAX_ATTEMPTS.key)
+
+
+# -- cleanup & leak checks ---------------------------------------------------
+
+def test_cleanup_concurrent_and_idempotent(tmp_path):
+    (tmp_path / "dag").mkdir()
+    sched = DagScheduler(work_dir=str(tmp_path / "dag"))
+    files = []
+    for i in range(16):
+        p = str(tmp_path / "dag" / f"s-{i}.data")
+        with open(p, "wb") as f:
+            f.write(b"x" * 64)
+        files.append(p)
+    sched._files.extend(files)
+    for i in range(4):
+        rid = f"stage://test/{i}"
+        put_resource(rid, lambda r: iter(()))
+        sched._resources.append(rid)
+
+    errors = []
+
+    def call():
+        try:
+            sched.cleanup()
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=call) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert not any(os.path.exists(p) for p in files)
+    assert all(get_resource(f"stage://test/{i}") is None for i in range(4))
+    report = sched.leak_report()
+    assert all(v == [] for v in report.values()), report
+    sched.cleanup()  # still safe afterwards
+
+
+def test_failed_query_removes_shuffle_files(tmp_path, staged_path,
+                                            fast_retries):
+    plan = _two_stage_plan(tmp_path, n=4_000)
+    config.conf.set(config.TASK_MAX_ATTEMPTS.key, 2)
+    try:
+        sched = DagScheduler()
+        with faults.scoped(("task-start", dict(p=1.0))):
+            with pytest.raises(faults.InjectedFault):
+                sched.run_collect(plan)
+        report = sched.leak_report()
+        assert all(v == [] for v in report.values()), report
+    finally:
+        config.conf.unset(config.TASK_MAX_ATTEMPTS.key)
+
+
+# -- per-query quotas & cross-query arbitration ------------------------------
+
+class _FakeConsumer(MemConsumer):
+    def __init__(self, name, query=None, releasable=0):
+        super().__init__(name)
+        self.query = query
+        self.releasable = releasable
+        self.spill_calls = 0
+        self.release_calls = 0
+
+    def spill(self):
+        self.spill_calls += 1
+        released = self._mem_used
+        self._mem_used = 0
+        return released
+
+    def try_release_pressure(self):
+        self.release_calls += 1
+        if self.releasable:
+            released = min(self.releasable, self._mem_used)
+            self._mem_used -= released
+            return released
+        return 0
+
+
+def test_quota_breach_walks_degradation_ladder():
+    mgr = MemManager(total_bytes=1 << 30)  # global pool never pressures
+    ctx = QueryContext("qq", mem_quota=1000)
+    c = _FakeConsumer("agg", query=ctx)
+    c.set_spillable(mgr)
+    c.update_mem_used(500)      # under quota: nothing happens
+    assert ctx.degrade_level == 0
+    c.update_mem_used(2000)     # breach 1: pass-through rung + spill
+    assert ctx.degrade_level == 1 and ctx.force_agg_passthrough
+    assert c.spill_calls == 1   # shed its own state largest-first
+    c.update_mem_used(2000)     # breach 2: shrink rung
+    assert ctx.degrade_level == 2 and ctx.capacity_shrink == 1
+    c.update_mem_used(2000)     # breach 3: kill
+    assert ctx.cancelled
+    with pytest.raises(QueryMemoryExceeded):
+        ctx.check()
+    assert mgr.total_quota_breaches == 3
+    c.unregister()
+
+
+def test_injected_quota_breach_forces_ladder():
+    mgr = MemManager(total_bytes=1 << 30)
+    ctx = QueryContext("qf", mem_quota=0)  # no quota set
+    c = _FakeConsumer("agg", query=ctx)
+    c.set_spillable(mgr)
+    with faults.scoped(("quota-breach", dict(at=(1,)))):
+        c.update_mem_used(10)
+        assert ctx.degrade_level == 1   # fault forced the first rung
+        c.update_mem_used(20)
+        assert ctx.degrade_level == 1   # only the scripted occurrence
+    c.unregister()
+
+
+def test_arbitration_order_heaviest_query_first():
+    mgr = MemManager(total_bytes=1 << 30)
+    heavy, light = QueryContext("heavy"), QueryContext("light")
+    h1 = _FakeConsumer("h1", query=heavy)
+    h2 = _FakeConsumer("h2", query=heavy)
+    l1 = _FakeConsumer("l1", query=light)
+    solo = _FakeConsumer("solo")
+    for c in (h1, h2, l1, solo):
+        c.set_spillable(mgr)
+    h1._mem_used, h2._mem_used = 300, 500       # heavy total 800
+    l1._mem_used = 600                          # light total 600
+    solo._mem_used = 100
+    order = [c.name for c in mgr._arbitration_order()]
+    # heavy query pays first, ITS largest consumer leading; the light
+    # query's single bigger-than-h2 consumer still waits its turn
+    assert order == ["h2", "h1", "l1", "solo"]
+    for c in (h1, h2, l1, solo):
+        c.unregister()
+
+
+def test_global_pressure_spills_heavy_spares_light():
+    mgr = MemManager(total_bytes=1000)
+    heavy, light = QueryContext("heavy"), QueryContext("light")
+    h = _FakeConsumer("h", query=heavy)
+    li = _FakeConsumer("l", query=light)
+    h.set_spillable(mgr)
+    li.set_spillable(mgr)
+    li._mem_used = 200
+    h.update_mem_used(900)  # pool at 1100 > 1000: arbitrate
+    assert h.spill_calls == 1       # heavy paid
+    assert li.spill_calls == 0      # light untouched
+    assert mgr.mem_used <= 800      # back under total * MEM_SPILL_FACTOR
+    assert mgr.first_shed_query == "heavy"
+    assert mgr.shed_bytes_by_query == {"heavy": 900}
+
+    # now the LIGHT query's thread observes the pressure: the hog is
+    # only FLAGGED (a foreign thread must never mutate its state) and
+    # sheds itself at its own next update; light is never the payer
+    h._mem_used = 900
+    li.update_mem_used(200)
+    assert h.spill_calls == 1 and h._release_requested
+    assert li.spill_calls == 0
+    h.update_mem_used(900)  # honors the pending release request
+    assert h.spill_calls == 2 and not h._release_requested
+    assert mgr.shed_bytes_by_query == {"heavy": 1800}
+    h.unregister()
+    li.unregister()
+
+
+def test_cross_query_arbitration_bit_identical(tmp_path, staged_path):
+    """Satellite: two queries over a small budget — the heavy one
+    spills/degrades, the light one completes untouched, and both match
+    their solo runs bit-for-bit."""
+    # heavy = high-cardinality groups (real retained agg state);
+    # light = a handful of groups (near-zero state)
+    heavy_plan = _two_stage_plan(tmp_path, n=60_000, n_keys=60_000,
+                                 tag="h", seed=7)
+    light_plan = _two_stage_plan(tmp_path, n=2_000, n_keys=20,
+                                 tag="l", seed=11)
+    solo_heavy = _sorted_df(DagScheduler().run_collect(heavy_plan))
+    solo_light = _sorted_df(DagScheduler().run_collect(light_plan))
+
+    MemManager.init(256 << 10)  # 256 KiB shared pool: heavy must shed
+    scheds = {}
+
+    def ex(plan, ctx, handle):
+        sched = DagScheduler(query_ctx=ctx)
+        try:
+            return sched.run_collect(plan)
+        finally:
+            scheds[ctx.query_id] = sched
+
+    svc = QueryService(max_concurrent=2, executor=ex)
+    try:
+        hh = svc.submit(heavy_plan, query_id="heavy")
+        hl = svc.submit(light_plan, query_id="light")
+        got_heavy = _sorted_df(hh.result(120))
+        got_light = _sorted_df(hl.result(120))
+    finally:
+        svc.shutdown()
+    assert got_heavy.equals(solo_heavy)
+    assert got_light.equals(solo_light)
+
+    def shed_evidence(qid):
+        total = {"spilled_bytes": 0, "partial_skipped": 0}
+
+        def fold(node):
+            for k in total:
+                total[k] += int(node.values.get(k, 0) or 0)
+            for c in node.children:
+                fold(c)
+
+        for tree in scheds[qid].stage_metrics.values():
+            fold(tree)
+        return total
+
+    mm = MemManager.get()
+    shed = dict(mm.shed_bytes_by_query)
+    # arbitration fired, and the hog paid FIRST and paid materially
+    assert mm.total_spill_count + mm.total_pressure_releases > 0
+    assert mm.first_shed_query == "heavy", (mm.first_shed_query, shed)
+    assert shed.get("heavy", 0) > 0, shed
+    # the light query was never degraded, and at most pocket change of
+    # its state was ever touched (arbitration reaches another query's
+    # consumers only after the hog's releases fell short)
+    assert hl.ctx.degrade_level == 0
+    assert shed.get("light", 0) <= max(4096, shed["heavy"] // 10), shed
+    assert sum(shed_evidence("light").values()) <= 4096
+
+
+# -- forced partial-agg pass-through (degradation rung 1) --------------------
+
+def test_degraded_query_forces_agg_passthrough():
+    n = 6000
+    t = pa.table({"k": pa.array(np.arange(n) % 5),   # LOW cardinality:
+                  "v": pa.array(np.ones(n, dtype=np.int64))})
+
+    def run(ctx):
+        scan = MemoryScanExec.from_arrow(t, batch_rows=512)
+        plan = AggExec(scan, [(col(0, "k"), "k")],
+                       [(make_agg("count", [col(1, "v")]),
+                         AggMode.PARTIAL, "c")])
+        with query_scope(ctx):
+            return plan.execute_collect().to_arrow(), plan
+
+    _, plain = run(None)
+    assert plain.metrics.get("partial_skipped") == 0  # probe says hash
+
+    ctx = QueryContext("qd")
+    ctx.degrade()  # rung 1
+    got, degraded = run(ctx)
+    assert degraded.metrics.get("partial_skipped") == 1  # forced
+    # pass-through stays correct: every row represented exactly once
+    counts = got.column(got.num_columns - 1).to_pylist()
+    assert sum(counts) == n
+
+
+# -- elastic shuffle tier (rss) ----------------------------------------------
+
+def test_rss_tier_bit_identical_and_clean(tmp_path, staged_path):
+    plan = _two_stage_plan(tmp_path, n=8_000)
+    solo = _sorted_df(DagScheduler().run_collect(plan))
+    root = tmp_path / "rss-root"
+    root.mkdir()
+    config.conf.set(config.SHUFFLE_SERVICE.key, str(root))
+    try:
+        sched = DagScheduler()
+        got = _sorted_df(sched.run_collect(plan))
+        assert got.equals(solo)
+        report = sched.leak_report()
+        assert all(v == [] for v in report.values()), report
+        assert os.listdir(str(root)) == []  # rss shuffle dirs removed
+    finally:
+        config.conf.unset(config.SHUFFLE_SERVICE.key)
+
+
+def test_rss_retry_pushes_fresh_attempt(tmp_path, staged_path,
+                                        fast_retries):
+    plan = _two_stage_plan(tmp_path, n=8_000)
+    solo = _sorted_df(DagScheduler().run_collect(plan))
+    root = tmp_path / "rss-root"
+    root.mkdir()
+    config.conf.set(config.SHUFFLE_SERVICE.key, str(root))
+    try:
+        # task-start fault on the first attempt: the retry must commit
+        # under a fresh attempt id and readers accept exactly one
+        with faults.scoped(("task-start", dict(at=(1,)))):
+            sched = DagScheduler()
+            got = _sorted_df(sched.run_collect(plan))
+        assert got.equals(solo)
+    finally:
+        config.conf.unset(config.SHUFFLE_SERVICE.key)
+
+
+def test_local_files_when_service_unset(tmp_path, staged_path):
+    plan = _two_stage_plan(tmp_path, n=4_000)
+    sched = DagScheduler()
+    sched.run_collect(plan)
+    assert sched._rss_clients == []  # fallback tier: local files only
+
+
+# -- http surface ------------------------------------------------------------
+
+def test_http_serving_stats_and_cancel():
+    from blaze_tpu.bridge.profiling import (start_http_service,
+                                            stop_http_service)
+    release = threading.Event()
+    svc = QueryService(max_concurrent=1,
+                       executor=_blocking_executor(release))
+    port = start_http_service(0)
+    try:
+        h = svc.submit({"kind": "noop"}, tenant="http")
+        time.sleep(0.05)
+        base = f"http://127.0.0.1:{port}"
+        stats = json.loads(urllib.request.urlopen(
+            f"{base}/serving", timeout=10).read())
+        assert any(s["running"] == 1 for s in stats["services"])
+        out = json.loads(urllib.request.urlopen(
+            f"{base}/serving/cancel?qid={h.query_id}", timeout=10).read())
+        assert out == {"query_id": h.query_id, "cancelled": True}
+        with pytest.raises(QueryCancelled):
+            h.result(10)
+    finally:
+        release.set()
+        svc.shutdown()
+        stop_http_service()

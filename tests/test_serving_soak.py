@@ -1,0 +1,177 @@
+"""Concurrency chaos soak: the query service under concurrent load with
+seeded faults, explicit cancels, and tight deadlines.  Asserts the two
+robustness invariants end-to-end: zero divergent SURVIVING queries
+(everything that completes is bit-identical to its solo run) and zero
+leaks (no shuffle files, no resources, no registered MemConsumers, no
+service threads left behind).  Bounded well under 60s; runs in tier-1
+(`-m soak` selects it alone)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu import config, faults
+from blaze_tpu.memory import MemManager
+from blaze_tpu.plan.stages import DagScheduler
+from blaze_tpu.serving import (QueryCancelled, QueryRejected, QueryService)
+
+CONCURRENCY = 8
+N_QUERIES = 40
+
+
+@pytest.fixture(autouse=True)
+def soak_env():
+    faults.clear()
+    MemManager.init(4 << 30)
+    config.conf.set(config.DAG_SINGLE_TASK_BYTES.key, 0)  # staged path
+    config.conf.set(config.TASK_RETRY_BACKOFF_MS.key, 1)
+    try:
+        yield
+    finally:
+        config.conf.unset(config.DAG_SINGLE_TASK_BYTES.key)
+        config.conf.unset(config.TASK_RETRY_BACKOFF_MS.key)
+        faults.clear()
+        MemManager.init(4 << 30)
+
+
+def _corpus(tmp_path):
+    """Three small two-stage agg plans with distinct data + baselines."""
+    plans = []
+    for j, (n, n_keys) in enumerate([(4_000, 50), (6_000, 2_000),
+                                     (3_000, 7)]):
+        rng = np.random.default_rng(100 + j)
+        t = pa.table({"k": pa.array(rng.integers(0, n_keys, n),
+                                    type=pa.int64()),
+                      "v": pa.array(rng.random(n))})
+        paths = []
+        for i in range(2):
+            p = str(tmp_path / f"soak-{j}-{i}.parquet")
+            pq.write_table(t.slice(i * (n // 2), n // 2), p)
+            paths.append(p)
+        schema = {"fields": [
+            {"name": "k", "type": {"id": "int64"}, "nullable": True},
+            {"name": "v", "type": {"id": "float64"}, "nullable": True}]}
+        plans.append({
+            "kind": "hash_agg",
+            "groupings": [{"expr": {"kind": "column", "index": 0},
+                           "name": "k"}],
+            "aggs": [{"fn": "sum", "mode": "final", "name": "s",
+                      "args": [{"kind": "column", "index": 1}]}],
+            "input": {
+                "kind": "local_exchange",
+                "partitioning": {"kind": "hash",
+                                 "exprs": [{"kind": "column",
+                                            "index": 0}],
+                                 "num_partitions": 3},
+                "input": {
+                    "kind": "hash_agg",
+                    "groupings": [{"expr": {"kind": "column",
+                                            "name": "k"}, "name": "k"}],
+                    "aggs": [{"fn": "sum", "mode": "partial",
+                              "name": "s",
+                              "args": [{"kind": "column",
+                                        "name": "v"}]}],
+                    "input": {"kind": "parquet_scan", "schema": schema,
+                              "file_groups": [[paths[0]],
+                                              [paths[1]]]}}}})
+    baselines = [DagScheduler().run_collect(p).to_pandas()
+                 .sort_values("k").reset_index(drop=True) for p in plans]
+    return plans, baselines
+
+
+@pytest.mark.soak
+def test_chaos_soak_concurrency8(tmp_path):
+    plans, baselines = _corpus(tmp_path)
+    rng = np.random.default_rng(42)
+    t0 = time.monotonic()
+    threads_before = {t.name for t in threading.enumerate()}
+
+    svc = QueryService(max_concurrent=CONCURRENCY, max_queue=N_QUERIES,
+                       tenant_max_inflight=N_QUERIES)
+    submitted = []   # (handle, corpus index, expected-cancel?)
+    shed = 0
+    timers = []
+    with faults.scoped(
+            ("task-start", dict(p=0.05)),
+            ("shuffle-read", dict(p=0.03)),
+            ("admit", dict(p=0.05)),
+            ("cancel-race", dict(p=0.5)),
+            seed=7):
+        for i in range(N_QUERIES):
+            j = i % len(plans)
+            deadline_ms = 0.0
+            if i % 10 == 7:
+                deadline_ms = float(rng.integers(1, 10))  # doomed-ish
+            try:
+                h = svc.submit(plans[j], tenant=f"t{i % 3}",
+                               deadline_ms=deadline_ms)
+            except QueryRejected as e:
+                assert e.kind in ("injected", "queue-full",
+                                  "tenant-quota")
+                shed += 1
+                continue
+            expect_cancel = deadline_ms > 0
+            if i % 9 == 4:
+                expect_cancel = True
+                tm = threading.Timer(float(rng.uniform(0.0, 0.05)),
+                                     svc.cancel, args=(h.query_id,))
+                tm.start()
+                timers.append(tm)
+            submitted.append((h, j, expect_cancel))
+
+        outcomes = {"done": 0, "cancelled": 0, "failed": 0}
+        for h, j, _expect in submitted:
+            err = h.exception(timeout=60)
+            outcomes[h.status] += 1
+            if h.status == "done":
+                # ZERO DIVERGENCE: every survivor bit-identical to solo
+                got = (h.result().to_pandas().sort_values("k")
+                       .reset_index(drop=True))
+                assert got.equals(baselines[j]), \
+                    f"divergent surviving query {h.query_id} (plan {j})"
+            elif h.status == "cancelled":
+                assert isinstance(err, QueryCancelled)
+            else:
+                # chaos may exhaust retries; the failure must be the
+                # injected kind, never silent corruption
+                assert isinstance(err, (faults.InjectedFault,
+                                        faults.FetchFailedError)), err
+            # ZERO LEAKS per query: scheduler post-mortem is clean.
+            # (cancelled-while-queued queries never ran — no report)
+            if h.status in ("done", "failed"):
+                assert h.leak_report is not None, h.query_id
+            if h.leak_report is not None:
+                assert all(v == [] for v in h.leak_report.values()), \
+                    (h.query_id, h.status, h.leak_report)
+
+    for tm in timers:
+        tm.cancel()
+    stats = svc.stats()
+    svc.shutdown(wait=True, cancel_running=True)
+
+    # the run exercised every lane of the taxonomy
+    assert outcomes["done"] >= N_QUERIES // 2, (outcomes, shed)
+    assert outcomes["cancelled"] >= 1, (outcomes, shed)
+    assert stats["counters"]["admitted"] == len(submitted)
+    assert sum(outcomes.values()) == len(submitted)
+
+    # ZERO LEAKS process-wide: consumers, service threads, temp files
+    assert MemManager.get()._consumers == []
+    for _ in range(50):  # pool threads wind down asynchronously
+        alive = [t.name for t in threading.enumerate()
+                 if t.name.startswith("blaze-serve")
+                 and t.name not in threads_before]
+        if not alive:
+            break
+        time.sleep(0.1)
+    assert alive == [], alive
+    leftovers = [f for f in os.listdir(str(tmp_path))
+                 if not f.endswith(".parquet")]
+    assert leftovers == [], leftovers
+
+    assert time.monotonic() - t0 < 60, "soak exceeded its time budget"
