@@ -276,6 +276,52 @@ def _record_tree(tree) -> None:
     profiling.record_metrics(tree.to_dict())
 
 
+def _observed_placement(pi):
+    """(compute_placement, per-stage breakdown) derived from EVIDENCE of
+    the run instead of the session-level policy: the recorded metric
+    trees carry per-operator lane counters (agg host_lane/device_lane
+    batches) and xla_stats records stage-loop engagement.  The old
+    session-level field reported the launch placement even when the
+    actual lanes ran elsewhere — per-stage observation keeps the
+    headline honest."""
+    from blaze_tpu.bridge import profiling, xla_stats
+
+    def fold(node, acc):
+        vals = node.get("values", {}) or {}
+        acc[0] += int(vals.get("device_lane_batches", 0))
+        acc[1] += int(vals.get("host_lane_batches", 0))
+        for ch in node.get("children", []) or []:
+            fold(ch, acc)
+        return acc
+
+    kind = pi.device_kind if pi else "unknown"
+    per_stage = {}
+    for tree in profiling.recent_metrics():
+        root = tree.get("name") or "stage"
+        dev, host = fold(tree, [0, 0])
+        s = per_stage.setdefault(root, {"device_lane_batches": 0,
+                                        "host_lane_batches": 0})
+        s["device_lane_batches"] += dev
+        s["host_lane_batches"] += host
+    for s in per_stage.values():
+        d, h = s["device_lane_batches"], s["host_lane_batches"]
+        s["placement"] = (kind if d and not h
+                          else "host" if h and not d
+                          else f"mixed({kind}+host)" if d else kind)
+    sl = xla_stats.stage_loop_stats()
+    dev_total = sum(s["device_lane_batches"] for s in per_stage.values())
+    host_total = sum(s["host_lane_batches"] for s in per_stage.values())
+    if sl.get("stage_loop_tasks"):
+        overall = f"device-loop({kind})"
+    elif dev_total and host_total:
+        overall = f"mixed({kind}+host)"
+    elif host_total:
+        overall = "host"
+    else:
+        overall = kind
+    return overall, per_stage
+
+
 def _persist_profile() -> None:
     """Write the per-operator/XLA profile of this bench run alongside the
     BENCH_*.json output line (BLAZE_BENCH_PROFILE_PATH overrides)."""
@@ -859,9 +905,12 @@ def child_main():
         _persist_profile()
     except Exception:
         pass
+    placement, stage_lanes = _observed_placement(pi)
     print(json.dumps({
         "metric": METRIC_NAME,
-        "compute_placement": (pi.device_kind if pi else "unknown"),
+        "compute_placement": placement,
+        "compute_placement_stages": stage_lanes,
+        "session_device_kind": (pi.device_kind if pi else "unknown"),
         "dispatch_rtt_ms": (round(pi.rtt_ms, 1) if pi else None),
         "placement_policy": (pi.policy if pi else "unknown"),
         "value": round(n_rows / tpu_s),
@@ -1407,7 +1456,7 @@ def chaos_bench_main() -> int:
     rules = os.environ.get(
         "BLAZE_BENCH_CHAOS_RULES",
         "task-start=0.15,shuffle-read=0.08,"
-        "shuffle-write=0.05:corrupt,ipc-decode=0.05")
+        "shuffle-write=0.05:corrupt,ipc-decode=0.05,device-loop=0.5")
 
     MemManager.init(4 << 30)
     # force the staged wire path (a chaos run over the AQE local mode
@@ -1416,7 +1465,11 @@ def chaos_bench_main() -> int:
     knobs = {config.DAG_SINGLE_TASK_BYTES.key: 0,
              config.TASK_RETRY_BACKOFF_MS.key: 5,
              config.TASK_MAX_ATTEMPTS.key: 6,
-             config.STAGE_MAX_RECOVERIES.key: 8}
+             config.STAGE_MAX_RECOVERIES.key: 8,
+             # stage loop forced on so the device-loop fault site is
+             # live: an injected fault there must become a wholesale
+             # staged fallback, never a divergent result
+             config.STAGE_DEVICE_LOOP_ENABLE.key: "on"}
     for k, v in knobs.items():
         config.conf.set(k, v)
 
@@ -1468,6 +1521,10 @@ def chaos_bench_main() -> int:
                     "stage_recoveries": int(d_stats["stage_recoveries"]),
                     "recovered_map_tasks":
                         int(d_stats["recovered_map_tasks"]),
+                    "stage_loop_tasks":
+                        int(d_stats.get("stage_loop_tasks", 0)),
+                    "stage_loop_fallbacks":
+                        int(d_stats.get("stage_loop_fallbacks", 0)),
                     "site_stats": inj_stats,
                 })
     finally:
@@ -1498,6 +1555,234 @@ def chaos_bench_main() -> int:
     print(json.dumps(rec))
     sys.stdout.flush()
     return 0 if diverged == 0 else 1
+
+
+# ===========================================================================
+# --deviceloop: device-resident stage loop vs staged per-batch (ISSUE 8)
+# ===========================================================================
+
+def deviceloop_bench_main() -> int:
+    """Device-loop leg (`--deviceloop`): the same staged two-stage
+    rollup (partial hash-agg -> hash exchange -> final agg) run twice —
+    stage loop OFF (the per-batch staged executor) and ON (runtime/
+    loop.py folds chunks of batches in ONE jit'd program per dispatch)
+    — plus the itest q01/q06/q95 subset with the loop forced on vs off.
+
+    Asserts and records:
+      * bit-identical finals between the legs (the loop inherits the
+        staged grow schedule exactly; q01/q06 are loop-INELIGIBLE —
+        string keys / no group key — and must come back identical via
+        the wholesale fallback);
+      * the dispatch tax: total jit dispatches per map partition drop
+        from O(batches x operators) to O(chunk boundaries);
+      * loop wall vs staged wall on the synthetic rollup.
+
+    The host-vectorized Arrow lane is disabled for BOTH legs so the
+    staged twin uses the same jax hash lane the loop compiles — the
+    bit-identity claim is then exact, not approximate.  Writes
+    BENCH_DEVLOOP.json and prints it as one JSON line."""
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu import config
+    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.itest import generate
+    from blaze_tpu.itest.queries import QUERIES
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.itest.tpcds_data import write_parquet_splits
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.plan.stages import DagScheduler
+
+    MemManager.init(4 << 30)
+    n_rows = int(os.environ.get("BLAZE_BENCH_DEVLOOP_ROWS", 400_000))
+    n_groups = int(os.environ.get("BLAZE_BENCH_DEVLOOP_GROUPS", 4096))
+    n_maps, n_reduces = 2, 3
+    iters = int(os.environ.get("BLAZE_BENCH_DEVLOOP_ITERS", 3))
+    knobs = {config.DAG_SINGLE_TASK_BYTES.key: 0,
+             config.FUSED_HOST_VECTORIZED_ENABLE.key: False,
+             # many batches per map task so the chunk fold has dispatch
+             # tax to amortize
+             config.BATCH_SIZE.key: 8192}
+    for k, v in knobs.items():
+        config.conf.set(k, v)
+
+    root = tempfile.mkdtemp(prefix="devloop-")
+    try:
+        rng = np.random.default_rng(11)
+        # wide int64 key domain: the dense lane declines (no compact
+        # range), so the partial agg takes the hash lane the stage
+        # compiler admits
+        keys = (rng.integers(0, n_groups, n_rows) * 1000003 + 17
+                ).astype(np.int64)
+        vals = rng.integers(0, 10_000, n_rows).astype(np.int64)
+        t = pa.table({"k": pa.array(keys), "v": pa.array(vals)})
+        paths = []
+        per = n_rows // n_maps
+        for i in range(n_maps):
+            p = os.path.join(root, f"in-{i}.parquet")
+            pq.write_table(t.slice(i * per, per), p)
+            paths.append(p)
+        schema = {"fields": [
+            {"name": "k", "type": {"id": "int64"}, "nullable": True},
+            {"name": "v", "type": {"id": "int64"}, "nullable": True}]}
+        plan = {
+            "kind": "hash_agg",
+            "groupings": [{"expr": {"kind": "column", "index": 0},
+                           "name": "k"}],
+            "aggs": [{"fn": "sum", "mode": "final", "name": "s",
+                      "args": [{"kind": "column", "index": 1}]}],
+            "input": {
+                "kind": "local_exchange",
+                "partitioning": {"kind": "hash",
+                                 "exprs": [{"kind": "column",
+                                            "index": 0}],
+                                 "num_partitions": n_reduces},
+                "input": {
+                    "kind": "hash_agg",
+                    "groupings": [{"expr": {"kind": "column",
+                                            "name": "k"}, "name": "k"}],
+                    "aggs": [{"fn": "sum", "mode": "partial",
+                              "name": "s",
+                              "args": [{"kind": "column",
+                                        "name": "v"}]}],
+                    "input": {"kind": "parquet_scan", "schema": schema,
+                              "file_groups": [[p] for p in paths]}}}}
+
+        def one_run(tag):
+            d = os.path.join(root, tag)
+            try:
+                return DagScheduler(work_dir=d).run_collect(plan)
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+
+        def leg(mode):
+            config.conf.set(config.STAGE_DEVICE_LOOP_ENABLE.key, mode)
+            try:
+                one_run(f"warm-{mode}")  # compile outside the clock
+                walls = []
+                before = xla_stats.snapshot()
+                for it in range(iters):
+                    t0 = time.perf_counter()
+                    tbl = one_run(f"{mode}-{it}")
+                    walls.append(time.perf_counter() - t0)
+                d = xla_stats.delta(before)
+                return tbl, float(np.min(walls)), d
+            finally:
+                config.conf.unset(config.STAGE_DEVICE_LOOP_ENABLE.key)
+
+        staged_tbl, staged_wall, staged_d = leg("off")
+        loop_tbl, loop_wall, loop_d = leg("on")
+
+        def sorted_rows(tbl):
+            df = tbl.to_pandas().sort_values("k").reset_index(drop=True)
+            return list(map(tuple, df.itertuples(index=False)))
+
+        # int64 sums: bit-identical is exact equality, no tolerance
+        identical = sorted_rows(staged_tbl) == sorted_rows(loop_tbl)
+
+        part_runs = iters * n_maps  # timed map-partition executions/leg
+        staged_dispatches = int(staged_d["total_calls"])
+        loop_dispatches = int(loop_d["total_calls"])
+        rec = {
+            "metric": "deviceloop_dispatches_per_partition",
+            "value": round(loop_d["stage_loop_calls"]
+                           / max(1, loop_d["stage_loop_tasks"]), 2),
+            "unit": "program dispatches/partition",
+            "rows": n_rows, "groups": n_groups,
+            "maps": n_maps, "reduces": n_reduces,
+            "bit_identical": identical,
+            "staged_wall_s": round(staged_wall, 4),
+            "loop_wall_s": round(loop_wall, 4),
+            "loop_speedup": round(staged_wall / loop_wall, 3),
+            # whole-leg jit dispatch counts (every metered kernel):
+            # the tax the loop exists to kill
+            "staged_total_dispatches": staged_dispatches,
+            "loop_total_dispatches": loop_dispatches,
+            "staged_dispatches_per_partition":
+                round(staged_dispatches / part_runs, 1),
+            "loop_dispatches_per_partition":
+                round(loop_dispatches / part_runs, 1),
+            "loop_tasks": int(loop_d["stage_loop_tasks"]),
+            "loop_program_calls": int(loop_d["stage_loop_calls"]),
+            "loop_batches_folded": int(loop_d["stage_loop_batches"]),
+            "loop_dispatches_avoided":
+                int(loop_d["stage_loop_staged_dispatches_avoided"]),
+            "loop_fallbacks": int(loop_d["stage_loop_fallbacks"]),
+            "loop_programs_built":
+                int(loop_d["stage_loop_programs_built"]),
+            "loop_program_cache_hits":
+                int(loop_d["stage_loop_program_cache_hits"]),
+        }
+
+        # ---- itest subset: loop on vs off must be frame-identical ----
+        names = os.environ.get("BLAZE_BENCH_DEVLOOP_QUERIES",
+                               "q01,q06,q95").split(",")
+        scale = float(os.environ.get("BLAZE_BENCH_DEVLOOP_SCALE", "0.2"))
+
+        def frame(tbl):
+            import pandas as pd
+            return tbl.to_pandas() if tbl.num_rows else pd.DataFrame(
+                {n: [] for n in tbl.schema.names})
+
+        divergent = 0
+        qrecs = []
+        for qname in names:
+            qname = qname.strip()
+            builder, table_names = QUERIES[qname]
+            tables = generate(table_names, scale=scale)
+            with tempfile.TemporaryDirectory(prefix="devloop-q-") as d:
+                qpaths = write_parquet_splits(tables, d, 2)
+                plan_dict, _oracle = builder(qpaths, tables, 2)
+                config.conf.set(config.STAGE_DEVICE_LOOP_ENABLE.key,
+                                "off")
+                base = DagScheduler(
+                    work_dir=os.path.join(d, "dag0")).run_collect(
+                        plan_dict)
+                config.conf.set(config.STAGE_DEVICE_LOOP_ENABLE.key,
+                                "on")
+                before = xla_stats.snapshot()
+                try:
+                    got = DagScheduler(
+                        work_dir=os.path.join(d, "dag1")).run_collect(
+                            plan_dict)
+                finally:
+                    config.conf.unset(
+                        config.STAGE_DEVICE_LOOP_ENABLE.key)
+                d_stats = xla_stats.delta(before)
+            err = compare_frames(frame(got), frame(base))
+            if err is not None:
+                divergent += 1
+            qrecs.append({
+                "query": qname, "divergence": err,
+                "loop_tasks": int(d_stats.get("stage_loop_tasks", 0)),
+                "loop_fallbacks":
+                    int(d_stats.get("stage_loop_fallbacks", 0))})
+        rec["queries"] = qrecs
+        rec["divergent_queries"] = divergent
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+        for k in knobs:
+            config.conf.unset(k)
+
+    path = os.environ.get(
+        "BLAZE_BENCH_DEVLOOP_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_DEVLOOP.json"))
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, default=str)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+    ok = (rec["bit_identical"] and divergent == 0
+          and rec["loop_tasks"] > 0)
+    return 0 if ok else 1
 
 
 # ===========================================================================
@@ -2143,6 +2428,8 @@ def main():
         sys.exit(serve_bench_main())
     if "--aggskip" in sys.argv:
         sys.exit(aggskip_bench_main())
+    if "--deviceloop" in sys.argv:
+        sys.exit(deviceloop_bench_main())
     if "--multichip-child" in sys.argv:
         sys.exit(multichip_child_main())
     if "--multichip" in sys.argv:

@@ -27,6 +27,11 @@ class TaskContext:
     # service; carried on the TaskContext so PrefetchIterator workers
     # re-entering via task_scope() inherit the cancellation token.
     query: Optional[Any] = None
+    # device-resident stage loop progress (runtime/loop.py): chunks this
+    # task has folded so far.  The cancellation token is checked at each
+    # chunk boundary, so teardown tests can assert the loop stopped
+    # within one chunk of the cancel by reading this counter.
+    loop_chunks: int = 0
 
     def check_running(self):
         if not self.is_running():

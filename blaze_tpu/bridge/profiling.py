@@ -111,6 +111,11 @@ def prometheus_text() -> str:
          "host-to-device bytes at batch placement")
     emit("blaze_d2h_bytes_total", t["d2h_bytes"],
          "device-to-host bytes (Arrow export, host fetches)")
+    for k, v in xla_stats.stage_loop_stats().items():
+        # device-resident stage loop (runtime/loop.py): engagement,
+        # amortized dispatches, wholesale fallbacks
+        emit(f"blaze_{k}_total", v,
+             "device-resident stage loop counter")
     mm = MemManager.get()
     emit("blaze_mem_spill_count_total", mm.total_spill_count,
          "memory-manager spills")

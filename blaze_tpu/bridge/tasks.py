@@ -69,7 +69,16 @@ def _run_with_retries(fn: Callable[[int], Any], i: int, what: str,
                     query.check()
                 faults.maybe_fail("task-start", task=i, attempt=attempt,
                                   what=what)
-                out = fn(i)
+                if attempt == 1:
+                    out = fn(i)
+                else:
+                    # retries take the most conservative path: decline
+                    # the device-resident stage loop (an optimization
+                    # that was live during the attempt that failed)
+                    from blaze_tpu.plan.stage_compiler import \
+                        decline_loop_scope
+                    with decline_loop_scope():
+                        out = fn(i)
                 xla_stats.note_task_attempts(attempt, wait_ns)
                 return out
             except BaseException as e:

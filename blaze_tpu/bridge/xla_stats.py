@@ -68,6 +68,20 @@ _shuffle = {"shuffle_device_bytes": 0, "shuffle_host_bytes": 0,
             "shuffle_device_collectives": 0,
             "shuffle_device_fallbacks": 0}
 
+# Device-resident stage-loop accounting (runtime/loop.py,
+# plan/stage_compiler.py): stage programs built vs served from the
+# fingerprint cache, loop program calls (the O(1)-per-chunk dispatch
+# the loop buys) vs the per-batch dispatches the staged path would have
+# issued, rows folded device-side, overflow-driven table regrows, and
+# wholesale fallbacks to the staged per-batch executor.
+_stage_loop = {"stage_loop_programs_built": 0,
+               "stage_loop_program_cache_hits": 0,
+               "stage_loop_calls": 0, "stage_loop_chunks": 0,
+               "stage_loop_batches": 0, "stage_loop_rows": 0,
+               "stage_loop_tasks": 0, "stage_loop_regrows": 0,
+               "stage_loop_fallbacks": 0,
+               "stage_loop_staged_dispatches_avoided": 0}
+
 # Adaptive partial-aggregation accounting (ops/agg/exec.py _AggState,
 # plan/fused.py host lane): cardinality probes run, mode switches
 # (ratio-triggered vs memory-pressure-triggered), and the rows that
@@ -280,6 +294,46 @@ def shuffle_stats() -> dict:
         return dict(_shuffle)
 
 
+def note_stage_program(cache_hit: bool) -> None:
+    """A StageProgram lookup: built fresh (new stage fingerprint /
+    capacity rung / dtype signature) or served from the process LRU."""
+    with _lock:
+        if cache_hit:
+            _stage_loop["stage_loop_program_cache_hits"] += 1
+        else:
+            _stage_loop["stage_loop_programs_built"] += 1
+
+
+def note_stage_loop_task(chunks: int, batches: int, rows: int,
+                         regrows: int, dispatches_avoided: int) -> None:
+    """One map task completed through the device-resident stage loop:
+    `chunks` loop program calls folded `batches` batches / `rows` rows,
+    growing the agg table `regrows` times; the staged per-batch path
+    would have issued `dispatches_avoided` extra Python dispatches."""
+    with _lock:
+        _stage_loop["stage_loop_tasks"] += 1
+        _stage_loop["stage_loop_calls"] += int(chunks)
+        _stage_loop["stage_loop_chunks"] += int(chunks)
+        _stage_loop["stage_loop_batches"] += int(batches)
+        _stage_loop["stage_loop_rows"] += int(rows)
+        _stage_loop["stage_loop_regrows"] += int(regrows)
+        _stage_loop["stage_loop_staged_dispatches_avoided"] += \
+            int(dispatches_avoided)
+
+
+def note_stage_loop_fallback() -> None:
+    """A stage-loop task aborted (ineligible chain, injected fault,
+    overflow past the cap) and re-ran through the staged per-batch
+    executor."""
+    with _lock:
+        _stage_loop["stage_loop_fallbacks"] += 1
+
+
+def stage_loop_stats() -> dict:
+    with _lock:
+        return dict(_stage_loop)
+
+
 def note_partial_agg_probe(rows: int, groups: int) -> None:
     """One cardinality probe over `rows` buffered rows that resolved
     `groups` distinct groups (the skip decision's evidence)."""
@@ -372,6 +426,7 @@ def snapshot() -> dict:
     flat.update(fault_stats())
     flat.update(agg_stats())
     flat.update(shuffle_stats())
+    flat.update(stage_loop_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -397,4 +452,6 @@ def reset() -> None:
             _agg[k] = 0
         for k in _shuffle:
             _shuffle[k] = 0
+        for k in _stage_loop:
+            _stage_loop[k] = 0
         _bucket_caps.clear()

@@ -469,8 +469,8 @@ FAULTS_RULES = str_conf(
     "`site=p*max` (capped fires), `site@k1+k2` (exact occurrences), "
     "optional `:corrupt` action suffix (flip a frame byte instead of "
     "raising).  Sites: task-start, shuffle-write, shuffle-read, "
-    "ipc-decode, mem-pressure, device-collective, admit, cancel-race, "
-    "quota-breach.",
+    "ipc-decode, mem-pressure, device-collective, device-loop, admit, "
+    "cancel-race, quota-breach.",
     category="fault-tolerance")
 TASK_MAX_ATTEMPTS = int_conf(
     "auron.tpu.task.maxAttempts", 4,
@@ -528,6 +528,36 @@ MESH_EXCHANGE_SKEW = float_conf(
     "the collective exchange (capacity ladder rung >= skew * "
     "rows/destination).  Skewed key distributions that still overflow "
     "re-dispatch at the next ladder rung.", category="scale-out")
+STAGE_DEVICE_LOOP_ENABLE = str_conf(
+    "auron.tpu.stage.deviceLoop.enable", "auto",
+    "Device-resident stage loop (runtime/loop.py): compile an eligible "
+    "map-stage pipeline (filter -> project -> partial hash-agg) into ONE "
+    "jit'd program whose body fori_loops over a chunk of bucket-padded "
+    "batches, so Python dispatch cost is paid per chunk instead of per "
+    "batch x operator.  'auto' runs it for device-resident compute — "
+    "where the per-batch dispatch RTT it amortizes exists — on stages "
+    "that compile (plan/stage_compiler.py eligibility: fixed-width "
+    "dtypes, traceable exprs, hash-lane agg); 'on' forces it wherever "
+    "it compiles, regardless of placement (tests/bench on CPU hosts); "
+    "'off' always uses the staged per-batch executor.  Any loop "
+    "failure — injected fault, overflow past the "
+    "table cap, untraceable chain — falls back wholesale to the staged "
+    "path for that task (counted as stage_loop_fallbacks), preserving "
+    "lineage recovery and cancellation semantics.", category="scale-out")
+STAGE_DEVICE_LOOP_CHUNK = int_conf(
+    "auron.tpu.stage.deviceLoop.chunkBatches", 8,
+    "Batches folded per stage-loop program call.  Cancellation/deadline "
+    "tokens and fault-injection sites are checked between chunks, so "
+    "teardown latency is bounded by one chunk; degraded queries "
+    "(capacity_shrink) halve the chunk per shrink level, floor 1.",
+    category="scale-out")
+STAGE_DEVICE_LOOP_DONATE = bool_conf(
+    "auron.tpu.stage.deviceLoop.donate", True,
+    "Donate the agg-carry buffers (hash table keys/accumulators) to the "
+    "stage-loop program so XLA updates them in place across chunk calls "
+    "instead of allocating a fresh table per chunk.  Disable when "
+    "debugging with jax_check_tracer_leaks or on backends that reject "
+    "donation (harmless: XLA warns and copies).", category="scale-out")
 SHUFFLE_SERVICE = str_conf(
     "auron.tpu.shuffle.service", "",
     "Shared-storage root of the elastic shuffle tier (shuffle/rss.py, "

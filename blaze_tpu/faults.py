@@ -19,6 +19,10 @@ Sites (the code points that call in here):
     device-collective  parallel/stage.py DeviceExchange, per shard per
                    collective dispatch (kills the device-resident
                    exchange; the scheduler falls back to file shuffle)
+    device-loop    runtime/loop.py, per chunk boundary of the
+                   device-resident stage loop (kills the loop mid-fold;
+                   the task falls back wholesale to the staged
+                   per-batch executor)
     admit          serving/service.py, per admission decision (sheds the
                    query with QueryRejected kind="injected")
     cancel-race    serving/service.py QueryService.cancel, widens the
@@ -53,8 +57,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 SITES = ("task-start", "shuffle-write", "shuffle-read", "ipc-decode",
-         "mem-pressure", "device-collective", "admit", "cancel-race",
-         "quota-breach")
+         "mem-pressure", "device-collective", "device-loop", "admit",
+         "cancel-race", "quota-breach")
 
 
 class InjectedFault(RuntimeError):
@@ -99,6 +103,12 @@ def classify_exception(e: BaseException) -> str:
         return "fatal"
     if isinstance(e, OSError):
         return "retryable"  # transient filesystem/socket trouble
+    if type(e).__name__ == "StageLoopFallback":
+        # containment escape hatch: every stage-loop caller handles the
+        # fallback in place, but if one leaks, the retry runs with the
+        # loop declined (bridge/tasks.py) — by name to keep faults.py a
+        # leaf module below blaze_tpu.runtime
+        return "retryable"
     return "fatal"
 
 

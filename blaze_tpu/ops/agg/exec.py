@@ -331,6 +331,10 @@ class _AggState(MemConsumer):
         key_dev = self._encode_keys(key_vals, batch)
 
         xp = xp_of(valid_mask, *[d for d, _v in key_dev])
+        # observed-lane evidence: bench's per-stage placement breakdown
+        # reads these instead of trusting the session-level default
+        op.metrics.add("device_lane_batches" if xp is not np
+                       else "host_lane_batches", 1)
         if self.num_keys:
             operands = []
             for (data, valid), _ in zip(key_dev, range(self.num_keys)):

@@ -319,6 +319,18 @@ class ExecutionPlan:
             return self._children[0].num_partitions
         return 1
 
+    @property
+    def reexecutable(self) -> bool:
+        """Whether execute(partition) can be called again from scratch
+        (file/memory-backed sources: yes).  The device-resident stage
+        loop (plan/stage_compiler.py) only admits stages whose source
+        is re-executable, because its wholesale fallback re-runs the
+        partition through the staged path.  One-shot streams (already-
+        consumed resource readers) must override this to False."""
+        if self._children:
+            return all(c.reexecutable for c in self._children)
+        return True
+
     # -- execution ----------------------------------------------------------
     def execute(self, partition: int) -> BatchIterator:
         """Pull-stream of batches for one partition."""

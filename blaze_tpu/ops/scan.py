@@ -205,8 +205,24 @@ class ParquetScanExec(ExecutionPlan):
             def transform(rb, _post=post):
                 return _post(ColumnBatch.from_arrow(rb))
         return prefetch(self._decode_batches(partition),
+                        depth=self._prefetch_depth(),
                         transform=transform,
                         name="parquet_scan")
+
+    @staticmethod
+    def _prefetch_depth():
+        """Default double-buffering depth, widened to one stage-loop
+        chunk when the device-resident loop is active: the loop consumes
+        a whole chunk of batches per dispatch, so a depth-2 ring would
+        stall it on decode every chunk."""
+        from blaze_tpu import config
+        if not config.IO_PREFETCH_ENABLE.get():
+            return 0
+        depth = config.IO_PREFETCH_DEPTH.get()
+        from blaze_tpu.plan.stage_compiler import stage_loop_active
+        if stage_loop_active():
+            depth = max(depth, config.STAGE_DEVICE_LOOP_CHUNK.get())
+        return depth
 
     def _post_decode_filter(self):
         """Scan-embedded filtering: when the pushdown predicate is fully

@@ -509,6 +509,20 @@ def _exchange_program(mesh, n_out: int, capacity: int,
     return meter_jit(sharded, name="mesh.exchange_rows")
 
 
+def _pad_rows(a, total: int, dtype=None):
+    """Zero-pad one column to `total` rows.  Host (numpy) input pads in
+    numpy; device (jax) input — the stage loop's D2D drain — pads with
+    jnp.pad so it never leaves the device."""
+    n = int(a.shape[0])
+    if isinstance(a, np.ndarray):
+        buf = np.zeros(total, dtype=dtype or a.dtype)
+        buf[:n] = a
+        return buf
+    import jax.numpy as jnp
+    out = jnp.pad(a, (0, total - n))
+    return out.astype(dtype) if dtype is not None else out
+
+
 class DeviceExchange:
     """Host-side driver for the on-device repartition.
 
@@ -530,8 +544,11 @@ class DeviceExchange:
     def exchange(self, columns: Sequence[np.ndarray],
                  valids: Sequence[np.ndarray],
                  key_indices: Sequence[int], n_out: int, ctx: str = ""):
-        """columns/valids: per-column (data, bool validity) numpy arrays
-        of one common length n.  Returns `parts`: n_out entries of
+        """columns/valids: per-column (data, bool validity) arrays of
+        one common length n — numpy from the staged collect, or device
+        (jax) arrays straight from the stage loop's drain (runtime/
+        loop.py), which stay on device through padding and sharding
+        (D2D, no host round trip).  Returns `parts`: n_out entries of
         ([data...], [valid...]) holding that reduce partition's rows."""
         from blaze_tpu import config, faults
         from blaze_tpu.batch import bucket_capacity, bucket_ladder
@@ -554,16 +571,8 @@ class DeviceExchange:
         total = n_dev * rows_per_dev
         row_valid = np.zeros(total, dtype=bool)
         row_valid[:n] = True
-        datas = []
-        for c in columns:
-            buf = np.zeros(total, dtype=c.dtype)
-            buf[:n] = c
-            datas.append(buf)
-        vbufs = []
-        for v in valids:
-            buf = np.zeros(total, dtype=bool)
-            buf[:n] = v
-            vbufs.append(buf)
+        datas = [_pad_rows(c, total) for c in columns]
+        vbufs = [_pad_rows(v, total, dtype=bool) for v in valids]
 
         # capacity ladder: start at skew * expected rows/destination,
         # retry the next rung on overflow; rows_per_dev (= every local

@@ -182,6 +182,17 @@ class QueryProfile:
                 f"{x.get('shuffle_device_rows', 0)} rows) "
                 f"host={_fmt_bytes(x.get('shuffle_host_bytes', 0))} "
                 f"fallbacks={x.get('shuffle_device_fallbacks', 0)}")
+        if any(x.get(k) for k in ("stage_loop_tasks",
+                                  "stage_loop_fallbacks")):
+            lines.append(
+                f"stage loop: tasks={x.get('stage_loop_tasks', 0)} "
+                f"programs={x.get('stage_loop_calls', 0)} "
+                f"batches={x.get('stage_loop_batches', 0)} "
+                f"rows={x.get('stage_loop_rows', 0)} "
+                f"dispatches_avoided="
+                f"{x.get('stage_loop_staged_dispatches_avoided', 0)} "
+                f"regrows={x.get('stage_loop_regrows', 0)} "
+                f"fallbacks={x.get('stage_loop_fallbacks', 0)}")
         return "\n".join(lines)
 
     def __str__(self) -> str:

@@ -557,7 +557,33 @@ class FusedPartialAggExec(ExecutionPlan):
         return any(not e.data_type(self._in_schema).is_fixed_width
                    for e, _n in self._group_exprs)
 
+    def _stage_loop_program(self):
+        """StageProgram for the device-resident loop, or None when the
+        knob or placement declines it / the stage doesn't compile.
+        Under 'auto' the loop and the host Arrow lane are mutually
+        exclusive (stage_loop_active requires device placement), so
+        there is no priority question between them."""
+        from blaze_tpu.plan import stage_compiler
+        if not stage_compiler.stage_loop_active():
+            return None
+        return stage_compiler.try_compile(self)
+
     def execute(self, partition: int) -> BatchIterator:
+        prog = self._stage_loop_program()
+        if prog is not None:
+            # device-resident stage loop (runtime/loop.py): ONE jit'd
+            # program folds a chunk of batches, amortizing dispatch per
+            # chunk instead of per batch.  The loop emits only at its
+            # final drain, so StageLoopFallback here is lossless and the
+            # partition re-runs through the staged lanes below.
+            from blaze_tpu.runtime.loop import (StageLoopFallback,
+                                                execute_loop)
+            try:
+                yield from execute_loop(prog, partition)
+                return
+            except StageLoopFallback:
+                xla_stats.note_stage_loop_fallback()
+                self.metrics.add("stage_loop_fallback", 1)
         if self._has_var_keys and not self._use_host_vectorized():
             # re-check the ADMISSION-time exclusion (dict_ok in
             # _try_fuse_agg): a plan fused for the host path whose

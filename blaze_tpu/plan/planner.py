@@ -425,7 +425,9 @@ def exchange_device_spec(partitioning: Optional[Dict[str, Any]],
 
     `auron.tpu.shuffle.device`: off -> never; on -> whenever eligible;
     auto (default) -> eligible AND compute is device-resident (bridge/
-    placement) AND more than one device in the mesh.  Host-pinned
+    placement) AND more than one device in the mesh — or the stage
+    loop is forced on (auron.tpu.stage.deviceLoop.enable=on), whose
+    device-resident map output should stay D2D.  Host-pinned
     placement (CPU tests, tunneled backends) keeps the file path: there
     the collective is emulation-only overhead, and a 1-device
     collective never beats the local fast path.
@@ -464,7 +466,12 @@ def exchange_device_spec(partitioning: Optional[Dict[str, Any]],
         import jax
 
         from blaze_tpu.bridge.placement import host_resident
-        if host_resident() or len(jax.devices()) < 2:
+        if config.STAGE_DEVICE_LOOP_ENABLE.get().strip().lower() == "on":
+            # a forced stage loop produces device-resident map output
+            # (runtime/loop.py drain_device); keeping the exchange on
+            # device avoids a pointless D2H just to re-upload
+            pass
+        elif host_resident() or len(jax.devices()) < 2:
             return None
     return {"key_indices": key_indices, "num_partitions": n_out}
 

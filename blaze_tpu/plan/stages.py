@@ -184,6 +184,12 @@ class DagScheduler:
         # tasks at finalize time (the MetricsUpdater analog)
         self.stage_metrics: Dict[int, MetricNode] = {}
         self._metrics_lock = threading.Lock()
+        # sid -> {"compute": "device-loop"|"staged"|"mixed",
+        #         "exchange": "device"|"rss"|"file"|"result"} — the
+        # OBSERVED per-stage placement (bench/explain derive
+        # compute_placement from this instead of the session-level
+        # default, which reported "cpu" even when device lanes ran)
+        self.stage_placement: Dict[int, Dict[str, str]] = {}
 
     def _record_task_metrics(self, sid: int, tree: MetricNode) -> None:
         from blaze_tpu.bridge import profiling
@@ -336,6 +342,18 @@ class DagScheduler:
         return run_tasks(fn, n, self._timeout, what, max_workers=workers,
                          query=self._query)
 
+    def _note_placement(self, sid: int, exchange: str,
+                        loop_before: int) -> None:
+        """Record the OBSERVED placement of one stage.  On the rss/file
+        tiers the device loop engages inside the fused operator itself,
+        so the evidence is the xla_stats stage_loop_tasks delta across
+        the stage's map tasks (best-effort under concurrent queries)."""
+        from blaze_tpu.bridge import xla_stats
+        after = xla_stats.stage_loop_stats()["stage_loop_tasks"]
+        self.stage_placement[sid] = {
+            "compute": "device-loop" if after > loop_before else "staged",
+            "exchange": exchange}
+
     @staticmethod
     def _part_of(stage: Stage) -> Dict[str, Any]:
         part = dict(stage.partitioning)
@@ -389,9 +407,8 @@ class DagScheduler:
         """Cancellation/deadline/kill must never be swallowed into a
         shuffle-tier fallback: the query is being torn down, not
         recovering."""
-        from blaze_tpu.bridge.context import TaskKilledError
-        from blaze_tpu.serving.context import QueryCancelled
-        return isinstance(e, (QueryCancelled, TaskKilledError))
+        from blaze_tpu.serving.context import is_cancellation
+        return is_cancellation(e)
 
     def _run_producer(self, stage: Stage) -> None:
         """One exchange boundary: device-resident collective when the
@@ -465,13 +482,86 @@ class DagScheduler:
                 self.task_runs.get((stage.sid, m), 0) + 1
         return out
 
+    def _run_map_task_loop(self, stage: Stage, m: int):
+        """One producer map task through the device-resident stage loop
+        (runtime/loop.py): ONE program dispatch per chunk of batches,
+        then a device-side drain so the map output reaches
+        DeviceExchange without a host round trip.  Returns (datas,
+        valids, n) device column arrays, or None — disabled, stage
+        ineligible, or wholesale fallback — in which case the caller
+        runs the staged per-batch collect.  Cancellation and lineage
+        (FetchFailed) always propagate."""
+        from blaze_tpu.bridge.runtime import NativeExecutionRuntime
+        from blaze_tpu.plan import stage_compiler
+        from blaze_tpu.plan.proto_serde import task_definition_to_bytes
+        if not stage_compiler.stage_loop_active():
+            return None
+        td = task_definition_to_bytes(
+            {"stage_id": stage.sid, "partition_id": m,
+             "num_partitions": stage.num_tasks,
+             "plan": self._per_task(stage.plan, m, stage.num_tasks)})
+        rt = NativeExecutionRuntime(td)  # plan pipeline only: not started
+        prog = stage_compiler.compile_task_plan(rt.plan)
+        if prog is None:
+            return None
+        from blaze_tpu.bridge import tracing, xla_stats
+        from blaze_tpu.bridge.context import task_scope
+        from blaze_tpu.runtime import loop as device_loop
+        try:
+            with task_scope(rt.task):
+                carry = device_loop.run_partition(prog, m,
+                                                  ctx=str(stage.sid))
+                out = device_loop.drain_device(prog, carry)
+        except (KeyboardInterrupt, SystemExit, FetchFailedError):
+            raise
+        except Exception as e:
+            if self._is_cancellation(e):
+                raise
+            xla_stats.note_stage_loop_fallback()
+            tracing.instant("stage_loop_fallback", stage=stage.sid,
+                            task=m, reason=str(e))
+            return None
+        finally:
+            self._record_task_metrics(stage.sid, rt.finalize())
+        with self._metrics_lock:
+            self.task_runs[(stage.sid, m)] = \
+                self.task_runs.get((stage.sid, m), 0) + 1
+        return out
+
+    @staticmethod
+    def _merge_map_outputs(batches: List[pa.RecordBatch], col_tasks,
+                           schema):
+        """Per-task map outputs -> one (cols, valids) column set for the
+        exchange.  All-loop output stays as device arrays (D2D: the
+        exchange shards them without a host round trip); any staged
+        batches force the host concat path."""
+        import numpy as np
+        if col_tasks and not batches:
+            import jax.numpy as jnp
+            ncols = len(col_tasks[0][0])
+            cols = [jnp.concatenate([t[0][i] for t in col_tasks])
+                    for i in range(ncols)]
+            valids = [jnp.concatenate([t[1][i] for t in col_tasks])
+                      for i in range(ncols)]
+            return cols, valids
+        cols, valids = _batches_to_columns(batches, schema)
+        for datas, vls, _n in col_tasks:
+            for i, (d, v) in enumerate(zip(datas, vls)):
+                cols[i] = np.concatenate(
+                    [cols[i], np.asarray(d).astype(cols[i].dtype)])
+                valids[i] = np.concatenate(
+                    [valids[i], np.asarray(v).astype(bool)])
+        return cols, valids
+
     def _run_producer_device(self, stage: Stage) -> None:
-        """Tentpole path: run the producer's map tasks, repartition
-        their output through the mesh collective (parallel/stage.py
-        DeviceExchange) and publish per-reduce-partition rows as
-        in-memory IPC bytes blocks (shuffle/reader.py read_block
-        consumes raw bytes directly).  Any failure raises out to
-        _run_producer, which falls back to the file path."""
+        """Tentpole path: run the producer's map tasks — through the
+        device-resident stage loop when the stage compiles, the staged
+        per-batch executor otherwise — repartition their output through
+        the mesh collective (parallel/stage.py DeviceExchange) and
+        publish per-reduce-partition rows as in-memory IPC bytes blocks
+        (shuffle/reader.py read_block consumes raw bytes directly).
+        Any failure raises out to _run_producer, which falls back to
+        the file path."""
         from blaze_tpu import config
         from blaze_tpu.bridge import tracing
         from blaze_tpu.parallel.stage import (DeviceExchange,
@@ -482,15 +572,28 @@ class DagScheduler:
         spec = stage.device_spec
         n_out = int(spec["num_partitions"])
         schema = schema_from_dict(stage.out_schema)
+
+        def one_map(m: int):
+            out = self._run_map_task_loop(stage, m)
+            if out is not None:
+                return ("cols", out)
+            return ("batches", self._run_map_task_collect(stage, m))
+
         with tracing.span("device_exchange", stage=stage.sid,
                           tasks=stage.num_tasks, partitions=n_out):
             per_task = self._run_tasks(
-                lambda m: self._run_map_task_collect(stage, m),
-                stage.num_tasks, f"stage {stage.sid} (device shuffle)")
-            batches = [b for bl in per_task for b in bl if b.num_rows]
+                one_map, stage.num_tasks,
+                f"stage {stage.sid} (device shuffle)")
+            batches = [b for kind, out in per_task if kind == "batches"
+                       for b in out if b.num_rows]
+            col_tasks = [out for kind, out in per_task
+                         if kind == "cols" and out[2] > 0]
+            loop_tasks = sum(1 for kind, _o in per_task
+                             if kind == "cols")
             blocks: Dict[int, bytes] = {}
-            if batches:
-                cols, valids = _batches_to_columns(batches, schema)
+            if batches or col_tasks:
+                cols, valids = self._merge_map_outputs(batches,
+                                                       col_tasks, schema)
                 est = sum(int(c.nbytes) for c in cols)
                 if est > config.SHUFFLE_DEVICE_MAX_BYTES.get():
                     raise DeviceExchangeError(
@@ -504,6 +607,10 @@ class DagScheduler:
                     if datas and len(datas[0]):
                         rb = _columns_to_batch(datas, vls, arrow_schema)
                         blocks[r] = write_batches_to_bytes([rb])
+        self.stage_placement[stage.sid] = {
+            "compute": ("device-loop" if loop_tasks == stage.num_tasks
+                        else "mixed" if loop_tasks else "staged"),
+            "exchange": "device"}
 
         sid = stage.sid
         self._stage_outputs[sid] = {}
@@ -565,10 +672,13 @@ class DagScheduler:
                 self.task_runs[(stage.sid, m)] = \
                     self.task_runs.get((stage.sid, m), 0) + 1
 
+        from blaze_tpu.bridge import xla_stats
+        loop_before = xla_stats.stage_loop_stats()["stage_loop_tasks"]
         with tracing.span("rss_exchange", stage=stage.sid,
                           tasks=stage.num_tasks, partitions=n_out):
             self._run_tasks(run_map, stage.num_tasks,
                             f"stage {stage.sid} (rss push)")
+        self._note_placement(stage.sid, "rss", loop_before)
 
         self._stage_outputs[stage.sid] = {}
         timeout = self._timeout
@@ -594,13 +704,15 @@ class DagScheduler:
                 if p not in self._files:
                     self._files.append(p)
 
-        from blaze_tpu.bridge import tracing
+        from blaze_tpu.bridge import tracing, xla_stats
+        loop_before = xla_stats.stage_loop_stats()["stage_loop_tasks"]
         with tracing.span("shuffle_exchange", stage=stage.sid,
                           tasks=stage.num_tasks,
                           partitioning=part["kind"]):
             self._run_tasks(lambda m: self._run_map_task(stage, part, m),
                             stage.num_tasks,
                             f"stage {stage.sid} (shuffle write)")
+        self._note_placement(stage.sid, "file", loop_before)
 
         self._stage_outputs[stage.sid] = {
             m: self._read_map_output(stage, m, n_out)
@@ -756,9 +868,14 @@ class DagScheduler:
                         if st.sid not in completed:
                             self._run_producer(st)
                             completed.add(st.sid)
+                    from blaze_tpu.bridge import xla_stats
+                    loop_before = xla_stats.stage_loop_stats()[
+                        "stage_loop_tasks"]
                     parts = self._run_tasks(
                         run_result, result.num_tasks,
                         f"stage {result.sid} (result)")
+                    self._note_placement(result.sid, "result",
+                                         loop_before)
                     break
                 except FetchFailedError as ff:
                     recoveries += 1

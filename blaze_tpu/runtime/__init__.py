@@ -1,0 +1,14 @@
+"""Device-resident stage runtime.
+
+`plan/stage_compiler.py` decides WHAT compiles (a StageProgram per
+eligible stage pipeline); this package decides HOW it runs: a
+persistent jit'd loop that folds a partition's batches in chunks with a
+donated agg carry, amortizing Python dispatch per chunk instead of per
+batch x operator (loop.py).
+"""
+
+from blaze_tpu.runtime.loop import (StageLoopFallback, drain_device,
+                                    execute_loop, run_partition)
+
+__all__ = ["StageLoopFallback", "drain_device", "execute_loop",
+           "run_partition"]

@@ -1,0 +1,239 @@
+"""Persistent device loop for compiled stage programs.
+
+The staged executor dispatches one XLA program per batch (the fused
+chain step) plus a host sync for the overflow scalar — at BENCH_SF100's
+~100ms dispatch RTT the engine is dispatch-bound, not compute-bound.
+This loop folds a CHUNK of bucket-padded batches per dispatch:
+`lax.fori_loop` runs chain + probe-insert + accumulate for every batch
+of the chunk inside ONE program, carrying the agg hash table across
+iterations with buffer donation, so Python-side dispatches per
+partition drop from O(batches x operators) to O(chunks).
+
+Discipline inherited from the staged path, kept intact:
+
+  * ATOMIC overflow (hash_agg_step): the first batch that overflows
+    leaves the carry unchanged and masks every later batch of the chunk
+    to a no-op; the host doubles + rehashes (exact modes) and resumes
+    the SAME chunk at the overflow batch — bit-identical to the staged
+    grow schedule.  Partial mode keeps its skip semantics by falling
+    back wholesale instead of growing (the loop emits nothing until its
+    final drain, so the staged re-run is lossless).
+  * Cancellation/deadline (PR 7): the query token is checked between
+    chunks (and per source batch by the metered stream), so teardown
+    latency is bounded by one chunk.
+  * Fault injection (PR 4): the `device-loop` site fires at chunk
+    boundaries; an injected fault becomes a wholesale fallback, never a
+    divergent result.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blaze_tpu import config, faults
+from blaze_tpu.bridge import xla_stats
+from blaze_tpu.bridge.context import current_task
+from blaze_tpu.bridge.xla_stats import meter_jit
+from blaze_tpu.parallel.stage import hash_agg_step, init_hash_carry
+
+# hard ceiling on grow-on-overflow table size: past this the partition
+# is cheaper to re-run staged (which streams) than to hold on device
+_MAX_SLOTS = 1 << 24
+
+
+class StageLoopFallback(RuntimeError):
+    """The loop declined or failed BEFORE emitting anything; the caller
+    re-runs the partition through the staged per-batch executor.  Like
+    DeviceExchangeError, this is an optimization bailing out — never a
+    new failure mode."""
+
+
+# fingerprint -> jit'd chunk fold; bounded FIFO like fused's step caches
+_FOLD_CACHE: dict = {}
+_FOLD_LIMIT = 128
+
+
+def _fold_factory(program, donate: bool):
+    skey = (program.fingerprint, bool(donate))
+    fold = _FOLD_CACHE.get(skey)
+    if fold is not None:
+        return fold
+    if len(_FOLD_CACHE) >= _FOLD_LIMIT:
+        _FOLD_CACHE.pop(next(iter(_FOLD_CACHE)))
+    prepare = program.prepare
+    kinds = program.kinds
+
+    def fold_impl(carry, cols_stacked, masks, start):
+        def body(b, state):
+            c, ovf_seen, first_ovf = state
+            cols_b = tuple(
+                None if col is None else (col[0][b], col[1][b])
+                for col in cols_stacked)
+            kd, kv, ad, av, m = prepare(cols_b, masks[b])
+            # once a batch overflows, later batches fold as no-ops: the
+            # carry stays exactly at the pre-overflow state (hash_agg_step
+            # is atomic), so the host can regrow and resume mid-chunk
+            live = jnp.logical_and(m, jnp.logical_not(ovf_seen))
+            specs = [(k, d, v) for k, d, v in zip(kinds, ad, av)]
+            new_c, ovf, _ng = hash_agg_step(c, list(zip(kd, kv)), specs,
+                                            live)
+            hit = ovf > 0
+            first_ovf = jnp.where(hit & ~ovf_seen,
+                                  jnp.asarray(b, jnp.int32), first_ovf)
+            return (new_c, jnp.logical_or(ovf_seen, hit), first_ovf)
+
+        init = (carry, jnp.asarray(False), jnp.asarray(0, jnp.int32))
+        return jax.lax.fori_loop(start, masks.shape[0], body, init)
+
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    fold = meter_jit(fold_impl, name="runtime.stage_loop", **kwargs)
+    _FOLD_CACHE[skey] = fold
+    return fold
+
+
+def _donate_active() -> bool:
+    """Donation only pays where buffers are device-resident; XLA CPU
+    rejects it with a warning per call, so gate on backend."""
+    return (config.STAGE_DEVICE_LOOP_DONATE.get()
+            and jax.default_backend() != "cpu")
+
+
+def _pad_chunk(cols_stacked, masks, window: int):
+    """Pad a tail chunk up to the full window with masked-out batches so
+    every chunk of a rung shares ONE jit signature (the batch-axis analog
+    of the row-axis bucket ladder)."""
+    w = int(masks.shape[0])
+    if w == window:
+        return cols_stacked, masks
+    extra = window - w
+
+    def padto(a):
+        widths = [(0, extra)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths)
+
+    cols = tuple(None if c is None else (padto(c[0]), padto(c[1]))
+                 for c in cols_stacked)
+    return cols, padto(masks)
+
+
+def loop_chunk_batches() -> int:
+    """Configured chunk width, shrunk for degraded queries: the memory
+    degradation ladder (serving/context.py) halves the chunk per shrink
+    level — same policy as ops.base.effective_batch_size, floor 1."""
+    from blaze_tpu.bridge.context import active_query
+    chunk = max(1, config.STAGE_DEVICE_LOOP_CHUNK.get())
+    q = active_query()
+    if q is not None and q.capacity_shrink:
+        chunk = max(1, chunk >> q.capacity_shrink)
+    return chunk
+
+
+def run_partition(program, partition: int, ctx: str = "",
+                  source_stream=None):
+    """Fold one partition through the stage program; returns the final
+    HashAggCarry.  Raises StageLoopFallback on any ineligibility or
+    failure — nothing has been emitted at that point, so the caller's
+    staged re-run is lossless.  Cancellation (QueryCancelled /
+    TaskKilledError / deadline) propagates untranslated."""
+    from blaze_tpu.plan.fused import _batch_windows, _pow2, _rehash_jit
+    task = current_task()
+    q = task.query
+    if q is None:
+        from blaze_tpu.bridge.context import active_query
+        q = active_query()
+    if q is not None and q.force_agg_passthrough:
+        raise StageLoopFallback("query degraded to agg pass-through")
+    chunk = loop_chunk_batches()
+    fold = _fold_factory(program, _donate_active())
+    slots = _pow2(config.ON_DEVICE_AGG_CAPACITY.get())
+    carry = init_hash_carry(list(program.key_dtypes), program.kinds,
+                            list(program.acc_dtypes), slots)
+    stream = (source_stream if source_stream is not None
+              else program.source.execute(partition))
+    batches = rows = fold_calls = regrows = ci = 0
+    try:
+        for cols_stacked, masks, count in _batch_windows(stream, chunk):
+            # chunk boundary: the ONLY host sync points of the loop —
+            # cooperative cancel, fault site, overflow scalar
+            task.check_running()
+            faults.maybe_fail("device-loop", stage=ctx, chunk=ci)
+            rows += int(np.asarray(jnp.sum(masks)))
+            cols_stacked, masks = _pad_chunk(cols_stacked, masks, chunk)
+            start = 0
+            while True:
+                carry, ovf_seen, first_ovf = fold(
+                    carry, cols_stacked, masks,
+                    jnp.asarray(start, jnp.int32))
+                fold_calls += 1
+                if not bool(ovf_seen):
+                    break
+                if not program.grow:
+                    # PARTIAL mode: skip semantics (batch-local dedup
+                    # pass-through) belong to the staged path; growing
+                    # here would diverge from its bit pattern
+                    raise StageLoopFallback(
+                        "hash table overflow in partial mode")
+                if slots * 2 > _MAX_SLOTS:
+                    raise StageLoopFallback(
+                        f"table would exceed {_MAX_SLOTS} slots")
+                slots *= 2
+                bigger, re_ovf, _ = _rehash_jit(program.kinds,
+                                                slots)(carry)
+                if int(re_ovf) > 0:
+                    continue  # rare probe clustering: double again
+                carry = bigger
+                regrows += 1
+                start = int(first_ovf)
+            ci += 1
+            batches += count
+            task.loop_chunks = ci
+    except faults.InjectedFault as e:
+        # scripted chaos at the device-loop site: wholesale fallback,
+        # not a task retry — the chaos soak asserts THIS path converges
+        raise StageLoopFallback(f"injected fault: {e}") from e
+    xla_stats.note_stage_loop_task(
+        chunks=fold_calls, batches=batches, rows=rows, regrows=regrows,
+        dispatches_avoided=max(0, batches - fold_calls))
+    return carry
+
+
+def execute_loop(program, partition: int, ctx: str = ""):
+    """Generator form for FusedPartialAggExec.execute: fold, then drain
+    through the shared emission path (ColumnBatch chunks).  Guaranteed
+    to raise StageLoopFallback only BEFORE the first yield."""
+    carry = run_partition(program, partition, ctx=ctx)
+    yield from program.agg._emit_hash(carry)
+
+
+def drain_device(program, carry):
+    """D2D drain: compact the carry's used slots ON DEVICE and cast to
+    the stage out-schema storage dtypes, so the partitioned output feeds
+    DeviceExchange without a host round trip.  Returns (datas, valids,
+    n) — lists of length-n device arrays in output column order."""
+    from blaze_tpu.plan.fused import _bucket
+    used = carry.used
+    count = int(jax.device_get(jnp.sum(used)))
+    if count == 0:
+        return [], [], 0
+    padded = _bucket(count, used.shape[0])
+    sel = jnp.nonzero(used, size=padded, fill_value=0)[0]
+    fields = list(program.out_schema)
+    datas, valids = [], []
+    i = 0
+    for kd, kv in zip(carry.keys, carry.key_valid):
+        dt = fields[i].data_type.jnp_dtype()
+        i += 1
+        datas.append(jnp.take(kd, sel)[:count].astype(dt))
+        valids.append(jnp.take(kv, sel)[:count])
+    for (_rk, out_kind, _a), acc, av in zip(program.agg._specs,
+                                            carry.accs, carry.acc_valid):
+        dt = fields[i].data_type.jnp_dtype()
+        i += 1
+        datas.append(jnp.take(acc, sel)[:count].astype(dt))
+        if out_kind == "count":
+            valids.append(jnp.ones((count,), dtype=bool))
+        else:
+            valids.append(jnp.take(av, sel)[:count])
+    return datas, valids, count

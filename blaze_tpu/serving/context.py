@@ -59,7 +59,20 @@ class QueryMemoryExceeded(QueryCancelled):
 
 
 #: degradation rungs, in order; ``degrade()`` returns the rung it entered.
+#: Rung 1 also declines the device-resident stage loop (runtime/loop.py
+#: checks ``force_agg_passthrough``), and rung 2's capacity shrink halves
+#: the loop's chunk width along with the coalesce batch target.
 DEGRADE_LADDER = ("agg-passthrough", "shrink-capacity", "kill")
+
+
+def is_cancellation(exc: BaseException) -> bool:
+    """True when ``exc`` means the query is being torn down rather than
+    failing: cancellation/deadline/kill must never be swallowed into an
+    optimization fallback (device shuffle, rss tier, stage loop) — the
+    ONE classifier shared by plan/stages.py and runtime/loop.py so the
+    tiers can't drift."""
+    from blaze_tpu.bridge.context import TaskKilledError
+    return isinstance(exc, (QueryCancelled, TaskKilledError))
 
 
 class QueryContext:

@@ -1,12 +1,20 @@
 """Steady-state compilation guard (ISSUE 3 acceptance): a repeated
 filter->project query must run warm with ZERO XLA recompiles and an expr
 program cache hit rate >= 0.9 — per-partition evaluator instances and
-repeated runs must all resolve to the one fingerprint-keyed program."""
+repeated runs must all resolve to the one fingerprint-keyed program.
+
+ISSUE 8 extends the guard to StageProgram: the device-resident stage
+loop must build ONE program per (chain, reduce-kinds, dtype, grow)
+fingerprint, hit the cache on every later run, and keep steady state at
+zero recompiles even while the capacity ladder regrows the hash table
+mid-partition."""
 
 import numpy as np
 import pyarrow as pa
+import pyarrow.parquet as pq
 import pytest
 
+from blaze_tpu import config
 from blaze_tpu.bridge import xla_stats
 from blaze_tpu.exprs import BinaryExpr, col, lit
 from blaze_tpu.exprs.program import clear_program_cache
@@ -81,3 +89,109 @@ def test_cross_query_program_reuse():
     d = xla_stats.delta(before)
     assert d["expr_programs_built"] == 0
     assert d["total_compiles"] == 0
+
+
+# -- ISSUE 8: StageProgram guard (device-resident stage loop) ---------------
+
+@pytest.fixture
+def loop_on():
+    from blaze_tpu.plan import stage_compiler
+    stage_compiler._SEEN_FINGERPRINTS.clear()
+    config.conf.set(config.STAGE_DEVICE_LOOP_ENABLE.key, "on")
+    try:
+        yield
+    finally:
+        config.conf.unset(config.STAGE_DEVICE_LOOP_ENABLE.key)
+
+
+def _loop_agg_plan(tmp_path, tag="a", n=4000, mode="partial",
+                   value="float64", seed=5):
+    """hash_agg over a 2-partition parquet scan.  Keys are WIDE int64
+    (compact 0..199 ranges take the dense lane, which the stage compiler
+    rejects — the loop is the hash lane's fold)."""
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 200, n) * 1000003 + 17
+    if value == "int64":
+        v = pa.array(rng.integers(0, 1000, n), type=pa.int64())
+    else:
+        v = pa.array(rng.random(n))
+    t = pa.table({"k": pa.array(k, type=pa.int64()), "v": v})
+    paths = []
+    for i in range(2):
+        p = str(tmp_path / f"loop-{tag}-{i}.parquet")
+        pq.write_table(t.slice(i * (n // 2), n // 2), p)
+        paths.append(p)
+    schema = {"fields": [
+        {"name": "k", "type": {"id": "int64"}, "nullable": True},
+        {"name": "v", "type": {"id": value}, "nullable": True}]}
+    return {"kind": "hash_agg",
+            "groupings": [{"expr": {"kind": "column", "index": 0},
+                           "name": "k"}],
+            "aggs": [{"fn": "sum", "mode": mode, "name": "s",
+                      "args": [{"kind": "column", "index": 1}]}],
+            "input": {"kind": "parquet_scan", "schema": schema,
+                      "file_groups": [[paths[0]], [paths[1]]]}}
+
+
+def _fused(plan_dict):
+    from blaze_tpu.plan.column_pruning import prune_columns
+    from blaze_tpu.plan.fused import fuse_plan
+    from blaze_tpu.plan.planner import collapse_filter_project, create_plan
+    return fuse_plan(prune_columns(collapse_filter_project(
+        create_plan(plan_dict))))
+
+
+def test_stage_loop_steady_state_zero_recompiles(tmp_path, loop_on):
+    plan = _fused(_loop_agg_plan(tmp_path))
+    nparts = plan.num_partitions
+    for p in range(nparts):  # warm-up: builds the program, compiles fold
+        assert list(plan.execute(p))
+    before = xla_stats.snapshot()
+    runs = 0
+    for _ in range(3):
+        fresh = _fused(_loop_agg_plan(tmp_path))  # new plan instances
+        for p in range(nparts):
+            assert list(fresh.execute(p))
+            runs += 1
+    d = xla_stats.delta(before)
+    assert d["total_compiles"] == 0, \
+        f"steady-state recompiles: {d['total_compiles']}"
+    assert d["stage_loop_programs_built"] == 0
+    assert d["stage_loop_program_cache_hits"] >= runs
+    assert d["stage_loop_fallbacks"] == 0
+    # and the loop actually ran every partition (not the staged path)
+    assert d["stage_loop_tasks"] == runs
+
+
+def test_stage_loop_new_dtype_signature_builds_new_program(tmp_path,
+                                                           loop_on):
+    plan = _fused(_loop_agg_plan(tmp_path, tag="f"))
+    assert list(plan.execute(0))
+    before = xla_stats.snapshot()
+    other = _fused(_loop_agg_plan(tmp_path, tag="i", value="int64"))
+    assert list(other.execute(0))
+    d = xla_stats.delta(before)
+    # int64 accumulator => new dtype signature => exactly one new program
+    assert d["stage_loop_programs_built"] == 1
+    assert d["stage_loop_fallbacks"] == 0
+
+
+def test_stage_loop_capacity_rungs_compile_once(tmp_path, loop_on):
+    # exact (final) mode grows the table on overflow: capacity 16 with
+    # ~200 groups forces the rung ladder.  The warm run compiles every
+    # rung's rehash + the one fold program; the repeat run climbs the
+    # same ladder with ZERO new compiles.
+    config.conf.set(config.ON_DEVICE_AGG_CAPACITY.key, 16)
+    try:
+        plan = _fused(_loop_agg_plan(tmp_path, tag="rung", mode="final"))
+        assert list(plan.execute(0))
+        before = xla_stats.snapshot()
+        again = _fused(_loop_agg_plan(tmp_path, tag="rung", mode="final"))
+        assert list(again.execute(0))
+        d = xla_stats.delta(before)
+        assert d["total_compiles"] == 0, \
+            f"capacity-rung recompiles: {d['total_compiles']}"
+        assert d["stage_loop_regrows"] > 0  # the ladder actually climbed
+        assert d["stage_loop_fallbacks"] == 0
+    finally:
+        config.conf.unset(config.ON_DEVICE_AGG_CAPACITY.key)
