@@ -90,6 +90,19 @@ _agg = {"partial_agg_skip_events": 0, "partial_agg_skipped_rows": 0,
         "partial_agg_probe_rows": 0, "partial_agg_probe_groups": 0,
         "partial_agg_switch_rows": 0, "partial_agg_spill_switches": 0}
 
+# Pallas scatter/hash lane resolutions (kernels/lane.py): which lane
+# each hash-update / radix-partition dispatch took, plus envelope
+# declines and fault-injected fallbacks.  Surfaced in the
+# explain_analyze footer.
+_scatter_lane = {"scatter_lane_hash_pallas": 0,
+                 "scatter_lane_hash_interpret": 0,
+                 "scatter_lane_hash_scatter": 0,
+                 "scatter_lane_partition_pallas": 0,
+                 "scatter_lane_partition_interpret": 0,
+                 "scatter_lane_partition_scatter": 0,
+                 "scatter_lane_declines": 0,
+                 "scatter_lane_fault_fallbacks": 0}
+
 # Distinct signatures beyond this on one kernel = shape churn (the
 # recompilation-storm smell: unpadded dynamic shapes hitting jit).
 SHAPE_CHURN_THRESHOLD = 8
@@ -364,6 +377,33 @@ def agg_stats() -> dict:
         return dict(_agg)
 
 
+def note_scatter_lane(kind: str, lane: str) -> None:
+    """One kernel-lane resolution: kind in hash/partition, lane in
+    pallas/interpret/scatter (kernels/lane.py resolve)."""
+    key = f"scatter_lane_{kind}_{lane}"
+    with _lock:
+        if key in _scatter_lane:
+            _scatter_lane[key] += 1
+
+
+def note_scatter_lane_decline() -> None:
+    """A kernel-lane dispatch fell outside the kernel envelope (VMEM
+    footprint) and degraded to the scatter formulation."""
+    with _lock:
+        _scatter_lane["scatter_lane_declines"] += 1
+
+
+def note_scatter_lane_fault() -> None:
+    """An injected pallas-kernel fault forced the scatter fallback."""
+    with _lock:
+        _scatter_lane["scatter_lane_fault_fallbacks"] += 1
+
+
+def scatter_lane_stats() -> dict:
+    with _lock:
+        return dict(_scatter_lane)
+
+
 def expr_stats() -> dict:
     """Expression-program counters; `expr_cache_hit_rate` is hits over
     cache resolutions (the recompile-guard's steady-state signal)."""
@@ -427,6 +467,7 @@ def snapshot() -> dict:
     flat.update(agg_stats())
     flat.update(shuffle_stats())
     flat.update(stage_loop_stats())
+    flat.update(scatter_lane_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -454,4 +495,6 @@ def reset() -> None:
             _shuffle[k] = 0
         for k in _stage_loop:
             _stage_loop[k] = 0
+        for k in _scatter_lane:
+            _scatter_lane[k] = 0
         _bucket_caps.clear()

@@ -311,6 +311,22 @@ FUSED_STAGE_CAPACITY = int_conf(
     "auron.tpu.fused.stage.capacity", 1 << 24,
     "Max dense group-table slots (product of key ranges) for the fused "
     "dense-group-id path before falling back to the sorted table.")
+KERNELS_PALLAS = str_conf(
+    "auron.tpu.kernels.pallas", "auto",
+    "Lane strategy for the scatter-shaped Pallas kernels (open-"
+    "addressing hash-table update, radix partitioning): 'auto' compiles "
+    "the Mosaic kernels on TPU and keeps the verified scatter "
+    "formulation elsewhere; 'on' forces the kernel layer everywhere "
+    "(interpret mode off-TPU — bit-identical, used by CI and parity "
+    "benches); 'off' pins the scatter formulation.  Every resolution is "
+    "counted in xla_stats (scatter_lane_*) and shown in the "
+    "explain_analyze footer.", category="kernels")
+KERNELS_PALLAS_VMEM_BUDGET = int_conf(
+    "auron.tpu.kernels.pallas.vmemBudget", 12 << 20,
+    "VMEM bytes the hash-update kernel may keep grid-resident (table "
+    "limbs + probe state).  Dispatches whose estimated footprint "
+    "exceeds it decline to the scatter formulation "
+    "(scatter_lane_declines counts them).", category="kernels")
 AGG_MXU_ENABLE = bool_conf(
     "auron.tpu.mxuAgg.enable", True,
     "Aggregate compact dense group tables as MXU one-hot matmuls "
@@ -470,7 +486,7 @@ FAULTS_RULES = str_conf(
     "optional `:corrupt` action suffix (flip a frame byte instead of "
     "raising).  Sites: task-start, shuffle-write, shuffle-read, "
     "ipc-decode, mem-pressure, device-collective, device-loop, admit, "
-    "cancel-race, quota-breach.",
+    "cancel-race, quota-breach, pallas-kernel.",
     category="fault-tolerance")
 TASK_MAX_ATTEMPTS = int_conf(
     "auron.tpu.task.maxAttempts", 4,

@@ -58,7 +58,7 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 
 SITES = ("task-start", "shuffle-write", "shuffle-read", "ipc-decode",
          "mem-pressure", "device-collective", "device-loop", "admit",
-         "cancel-race", "quota-breach")
+         "cancel-race", "quota-breach", "pallas-kernel")
 
 
 class InjectedFault(RuntimeError):

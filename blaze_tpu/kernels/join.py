@@ -33,10 +33,15 @@ import numpy as np
 
 def build_runs(sorted_hashes: np.ndarray
                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """(unique_hashes, run_start, run_count) for an ascending hash array."""
+    """(unique_hashes, run_start, run_count) for an ascending hash array.
+
+    Positional arrays are int32 whenever they can be (build side below
+    2^31 rows): TPU v5e emulates every 64-bit op as a multi-instruction
+    sequence (~10x), and these arrays ride the probe hot path."""
     uh, start, count = np.unique(sorted_hashes, return_index=True,
                                  return_counts=True)
-    return uh, start.astype(np.int64), count.astype(np.int64)
+    idt = np.int32 if sorted_hashes.shape[0] < (1 << 31) else np.int64
+    return uh, start.astype(idt), count.astype(idt)
 
 
 from blaze_tpu.bridge.xla_stats import meter_jit
@@ -68,22 +73,28 @@ def expand_pairs(start: jax.Array, count: jax.Array, cap: int
     Returns (probe_idx[cap], sorted_pos[cap], valid[cap], total).
     `sorted_pos` indexes the hash-sorted build order; the caller maps it
     through the build permutation.  Entries at output offset >= cap are
-    dropped (caller grows `cap` and retries when total > cap)."""
+    dropped (caller grows `cap` and retries when total > cap).
+
+    Pair arrays are int32 when `cap` fits (the 64-bit-emulation rule
+    from build_runs); `total` is always computed in int64 because the
+    TRUE pair count can exceed the current bucket."""
     n = start.shape[0]
-    offsets = jnp.cumsum(count) - count  # exclusive scan
+    idt = jnp.int32 if cap < (1 << 31) else jnp.int64
+    offsets = jnp.cumsum(count.astype(jnp.int64)) - count
     total = offsets[-1] + count[-1] if n else jnp.int64(0)
+    off32 = offsets.astype(idt)
     # scatter probe-row boundaries into the output domain, then a
     # max-scan assigns each output slot its probe row (vectorized
     # "which run am I in": standard scan-based expansion)
-    slot_probe = jnp.zeros(cap, dtype=jnp.int64).at[
+    slot_probe = jnp.zeros(cap, dtype=idt).at[
         jnp.where(count > 0, offsets, cap)].max(
-        jnp.arange(n, dtype=jnp.int64), mode="drop")
+        jnp.arange(n, dtype=idt), mode="drop")
     slot_probe = jax.lax.associative_scan(jnp.maximum, slot_probe)
-    out_pos = jnp.arange(cap, dtype=jnp.int64)
-    valid = out_pos < jnp.minimum(total, cap)
+    out_pos = jnp.arange(cap, dtype=idt)
+    valid = out_pos < jnp.minimum(total, cap).astype(idt)
     p = jnp.clip(slot_probe, 0, max(n - 1, 0))
-    within = out_pos - jnp.take(offsets, p)
-    sorted_pos = jnp.take(start, p) + within
+    within = out_pos - jnp.take(off32, p)
+    sorted_pos = jnp.take(start, p).astype(idt) + within
     return p, sorted_pos, valid, total
 
 
@@ -106,6 +117,12 @@ def probe_expand_device(unique_hashes, run_start, run_count, sorted_idx,
         return z, z
     cap = _pow2_at_least(total)
     p, sorted_pos, valid, _t = expand_pairs(start, count, cap)
+    # regression guard: the pair arrays must stay narrow — a silent
+    # promotion back to i64 would re-enter TPU 64-bit emulation
+    want = jnp.int32 if cap < (1 << 31) else jnp.int64
+    assert p.dtype == want and sorted_pos.dtype == want, (
+        f"join pair arrays widened: {p.dtype}/{sorted_pos.dtype}, "
+        f"expected {want} at cap={cap}")
     p_np, sp_np, v_np = jax.device_get((p, sorted_pos, valid))
     p_np = p_np[v_np[: len(p_np)]][:total]
     sp_np = sp_np[v_np[: len(sp_np)]][:total]

@@ -208,16 +208,19 @@ class JoinMap:
             self._valid = ~any_null
             order = np.argsort(hashes, kind="stable")
             self.sorted_hashes = hashes[order]
-            self.sorted_idx = order
+            # slot arrays narrow to i32 below 2^31 build rows — keeps
+            # the probe's gather indices off TPU 64-bit emulation
+            self.sorted_idx = (order.astype(np.int32)
+                               if n < (1 << 31) else order)
             self.uh, self.ustart, self.ucount = build_runs(self.sorted_hashes)
             self._uh_pa = pa.array(self.uh, type=pa.int64())
         else:
             self._valid = np.zeros(0, dtype=bool)
             self.sorted_hashes = np.zeros(0, dtype=np.int64)
-            self.sorted_idx = np.zeros(0, dtype=np.int64)
+            self.sorted_idx = np.zeros(0, dtype=np.int32)
             self.uh = np.zeros(0, dtype=np.int64)
-            self.ustart = np.zeros(0, dtype=np.int64)
-            self.ucount = np.zeros(0, dtype=np.int64)
+            self.ustart = np.zeros(0, dtype=np.int32)
+            self.ucount = np.zeros(0, dtype=np.int32)
             self.key_arrays = []
         self._built = True
 

@@ -193,6 +193,25 @@ class QueryProfile:
                 f"{x.get('stage_loop_staged_dispatches_avoided', 0)} "
                 f"regrows={x.get('stage_loop_regrows', 0)} "
                 f"fallbacks={x.get('stage_loop_fallbacks', 0)}")
+        lane_keys = ("scatter_lane_hash_pallas",
+                     "scatter_lane_hash_interpret",
+                     "scatter_lane_hash_scatter",
+                     "scatter_lane_partition_pallas",
+                     "scatter_lane_partition_interpret",
+                     "scatter_lane_partition_scatter")
+        if any(x.get(k) for k in lane_keys):
+            lines.append(
+                "scatter lanes: hash="
+                f"{x.get('scatter_lane_hash_pallas', 0)}p/"
+                f"{x.get('scatter_lane_hash_interpret', 0)}i/"
+                f"{x.get('scatter_lane_hash_scatter', 0)}s "
+                "partition="
+                f"{x.get('scatter_lane_partition_pallas', 0)}p/"
+                f"{x.get('scatter_lane_partition_interpret', 0)}i/"
+                f"{x.get('scatter_lane_partition_scatter', 0)}s "
+                f"declines={x.get('scatter_lane_declines', 0)} "
+                f"fault_fallbacks="
+                f"{x.get('scatter_lane_fault_fallbacks', 0)}")
         return "\n".join(lines)
 
     def __str__(self) -> str:

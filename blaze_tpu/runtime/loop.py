@@ -55,8 +55,8 @@ _FOLD_CACHE: dict = {}
 _FOLD_LIMIT = 128
 
 
-def _fold_factory(program, donate: bool):
-    skey = (program.fingerprint, bool(donate))
+def _fold_factory(program, donate: bool, lane: str = "scatter"):
+    skey = (program.fingerprint, bool(donate), lane)
     fold = _FOLD_CACHE.get(skey)
     if fold is not None:
         return fold
@@ -78,7 +78,7 @@ def _fold_factory(program, donate: bool):
             live = jnp.logical_and(m, jnp.logical_not(ovf_seen))
             specs = [(k, d, v) for k, d, v in zip(kinds, ad, av)]
             new_c, ovf, _ng = hash_agg_step(c, list(zip(kd, kv)), specs,
-                                            live)
+                                            live, lane=lane)
             hit = ovf > 0
             first_ovf = jnp.where(hit & ~ovf_seen,
                                   jnp.asarray(b, jnp.int32), first_ovf)
@@ -146,7 +146,9 @@ def run_partition(program, partition: int, ctx: str = "",
     if q is not None and q.force_agg_passthrough:
         raise StageLoopFallback("query degraded to agg pass-through")
     chunk = loop_chunk_batches()
-    fold = _fold_factory(program, _donate_active())
+    from blaze_tpu.kernels import lane as lane_mod
+    lane = lane_mod.resolve("hash")
+    fold = _fold_factory(program, _donate_active(), lane)
     slots = _pow2(config.ON_DEVICE_AGG_CAPACITY.get())
     carry = init_hash_carry(list(program.key_dtypes), program.kinds,
                             list(program.acc_dtypes), slots)
@@ -180,7 +182,7 @@ def run_partition(program, partition: int, ctx: str = "",
                         f"table would exceed {_MAX_SLOTS} slots")
                 slots *= 2
                 bigger, re_ovf, _ = _rehash_jit(program.kinds,
-                                                slots)(carry)
+                                                slots, lane)(carry)
                 if int(re_ovf) > 0:
                     continue  # rare probe clustering: double again
                 carry = bigger

@@ -176,6 +176,44 @@ def test_stage_loop_new_dtype_signature_builds_new_program(tmp_path,
     assert d["stage_loop_fallbacks"] == 0
 
 
+# -- ISSUE 9: Pallas kernel lane guard --------------------------------------
+
+@pytest.fixture
+def pallas_on():
+    config.conf.set(config.KERNELS_PALLAS.key, "on")
+    try:
+        yield
+    finally:
+        config.conf.unset(config.KERNELS_PALLAS.key)
+
+
+@pytest.mark.pallas
+def test_pallas_lane_capacity_rungs_compile_once(tmp_path, loop_on,
+                                                 pallas_on):
+    # the rung ladder with the kernel lane forced on: the warm run
+    # compiles one placement kernel per capacity rung (the lane rides
+    # the fold/rehash cache keys); the repeat run climbs the same
+    # ladder with ZERO new compiles and zero fallbacks
+    config.conf.set(config.ON_DEVICE_AGG_CAPACITY.key, 16)
+    try:
+        plan = _fused(_loop_agg_plan(tmp_path, tag="prung", mode="final"))
+        assert list(plan.execute(0))
+        before = xla_stats.snapshot()
+        again = _fused(_loop_agg_plan(tmp_path, tag="prung",
+                                      mode="final"))
+        assert list(again.execute(0))
+        d = xla_stats.delta(before)
+        assert d["total_compiles"] == 0, \
+            f"pallas-lane rung recompiles: {d['total_compiles']}"
+        assert d["stage_loop_regrows"] > 0
+        assert d["stage_loop_fallbacks"] == 0
+        # the kernel lane actually resolved (interpret on a CPU session)
+        assert (d["scatter_lane_hash_interpret"]
+                + d["scatter_lane_hash_pallas"]) > 0
+    finally:
+        config.conf.unset(config.ON_DEVICE_AGG_CAPACITY.key)
+
+
 def test_stage_loop_capacity_rungs_compile_once(tmp_path, loop_on):
     # exact (final) mode grows the table on overflow: capacity 16 with
     # ~200 groups forces the rung ladder.  The warm run compiles every

@@ -1,0 +1,306 @@
+"""Scatter/hash lane kernels (ISSUE 9): the interpret-mode Pallas
+kernels must be BITWISE identical to the scatter formulations they
+replace, across dtypes, NULL masks, -0.0/NaN bit patterns, masked rows,
+and overflow at capacity — and every fallback (knob off, VMEM decline,
+injected fault) must land on the verified scatter lane losslessly.
+
+Property style: seeded trial loops (no hypothesis in the image), each
+trial drawing keys/masks/values from a fresh generator so tier-1 walks a
+different corner of the space per seed while staying reproducible."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu import config, faults
+from blaze_tpu.bridge import xla_stats
+from blaze_tpu.kernels import hash_update, radix
+from blaze_tpu.kernels import lane as lane_mod
+from blaze_tpu.parallel.collective import _dest_slots
+from blaze_tpu.parallel.stage import (hash_agg_step, init_hash_carry,
+                                      rehash_carry)
+
+pytestmark = pytest.mark.pallas
+
+
+def bits_equal(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return (a.shape == b.shape and a.dtype == b.dtype
+            and a.tobytes() == b.tobytes())
+
+
+def carries_bit_identical(ca, cb):
+    la = jax.tree_util.tree_leaves(ca)
+    lb = jax.tree_util.tree_leaves(cb)
+    return len(la) == len(lb) and all(
+        bits_equal(a, b) for a, b in zip(la, lb))
+
+
+def _nan_payloads(n, rng):
+    """float64 NaNs with DIFFERENT bit patterns: quiet, payload-bearing,
+    and negative-sign — grouping must normalize them into one group on
+    every lane."""
+    pats = np.array([0x7FF8000000000000, 0x7FF8000000000001,
+                     0xFFF8000000000099], dtype=np.uint64)
+    return pats[rng.integers(0, 3, n)].view(np.float64)
+
+
+def _trial_key_col(rng, n, dtype):
+    if dtype == np.float64 or dtype == np.float32:
+        d = (rng.integers(0, 300, n) - 150).astype(dtype)
+        zero = rng.random(n) < 0.08
+        d = np.where(zero, np.where(rng.random(n) < 0.5, 0.0, -0.0
+                                    ).astype(dtype), d)
+        nan = rng.random(n) < 0.08
+        if dtype == np.float64:
+            d = np.where(nan, _nan_payloads(n, rng), d)
+        else:
+            d = np.where(nan, np.float32(np.nan), d)
+    else:
+        d = rng.integers(-1000, 1000, n).astype(dtype)
+    v = rng.random(n) > 0.15  # SQL NULL keys: still group together
+    return jnp.asarray(d), jnp.asarray(v)
+
+
+def _step_both(carry_args, key_cols, agg_specs, mask):
+    outs = {}
+    for lane in ("interpret", "scatter"):
+        c = init_hash_carry(*carry_args)
+        outs[lane] = hash_agg_step(c, key_cols, agg_specs, mask,
+                                   lane=lane)
+    return outs["interpret"], outs["scatter"]
+
+
+@pytest.mark.parametrize("dtype", [np.int64, np.int32, np.float64,
+                                   np.float32])
+def test_hash_step_parity_across_dtypes(dtype):
+    n, S = 1024, 1 << 11
+    for seed in range(3):
+        rng = np.random.default_rng(seed)
+        kd, kv = _trial_key_col(rng, n, dtype)
+        vals = jnp.asarray(rng.random(n))
+        av = jnp.asarray(rng.random(n) > 0.2)
+        cnt = jnp.asarray(rng.integers(0, 5, n).astype(np.int64))
+        mask = jnp.asarray(rng.random(n) > 0.25)
+        (ca, oa, ga), (cb, ob, gb) = _step_both(
+            ([jnp.dtype(dtype)], ["sum", "min", "max", "count"],
+             (jnp.float64, jnp.float64, jnp.float64, jnp.int64), S),
+            [(kd, kv)],
+            [("sum", vals, av), ("min", vals, av), ("max", vals, av),
+             ("count", cnt, av)], mask)
+        assert int(oa) == int(ob) and int(ga) == int(gb)
+        assert carries_bit_identical(ca, cb), \
+            f"lane divergence at dtype={dtype} seed={seed}"
+
+
+def test_hash_step_parity_multi_key():
+    n, S = 1024, 1 << 11
+    for seed in range(3):
+        rng = np.random.default_rng(100 + seed)
+        k1 = _trial_key_col(rng, n, np.int64)
+        k2 = _trial_key_col(rng, n, np.float64)
+        vals = jnp.asarray(rng.random(n))
+        av = jnp.asarray(rng.random(n) > 0.2)
+        mask = jnp.asarray(rng.random(n) > 0.25)
+        (ca, oa, ga), (cb, ob, gb) = _step_both(
+            ([jnp.int64, jnp.float64], ["sum"], (jnp.float64,), S),
+            [k1, k2], [("sum", vals, av)], mask)
+        assert int(oa) == int(ob) and int(ga) == int(gb)
+        assert carries_bit_identical(ca, cb)
+
+
+def test_hash_step_overflow_at_capacity_is_atomic():
+    # S=32 with ~300 distinct keys: placement MUST overflow; both lanes
+    # return the overflow count AND the untouched pre-state carry
+    n, S = 512, 32
+    rng = np.random.default_rng(9)
+    kd = jnp.asarray(rng.integers(0, 300, n).astype(np.int64))
+    kv = jnp.asarray(np.ones(n, bool))
+    vals = jnp.asarray(rng.random(n))
+    av = kv
+    mask = kv
+    (ca, oa, _), (cb, ob, _) = _step_both(
+        ([jnp.int64], ["sum"], (jnp.float64,), S),
+        [(kd, kv)], [("sum", vals, av)], mask)
+    assert int(oa) > 0 and int(oa) == int(ob)
+    assert carries_bit_identical(ca, cb)
+    # atomic: the returned carry is the original empty table
+    assert int(jnp.sum(ca.used)) == 0
+
+
+def test_rehash_parity():
+    n, S = 1024, 1 << 10
+    rng = np.random.default_rng(21)
+    kd = jnp.asarray(rng.integers(0, 400, n).astype(np.int64))
+    kv = jnp.asarray(rng.random(n) > 0.1)
+    vals = jnp.asarray(rng.random(n))
+    av = jnp.asarray(rng.random(n) > 0.1)
+    mask = jnp.asarray(np.ones(n, bool))
+    seeded, _, _ = hash_agg_step(
+        init_hash_carry([jnp.int64], ["sum"], (jnp.float64,), S),
+        [(kd, kv)], [("sum", vals, av)], mask, lane="scatter")
+    outs = {}
+    for lane in ("interpret", "scatter"):
+        grown, ovf, ng = rehash_carry(seeded, ["sum"], 4 * S, lane=lane)
+        outs[lane] = (grown, int(ovf), int(ng))
+    assert outs["interpret"][1:] == outs["scatter"][1:]
+    assert carries_bit_identical(outs["interpret"][0],
+                                 outs["scatter"][0])
+
+
+# -- radix partition kernel -------------------------------------------------
+
+def test_radix_dest_slots_buffers_bit_identical():
+    # the scattered per-destination buffers (what all_to_all actually
+    # ships) must match the argsort formulation's buffers exactly,
+    # including parked pids and capacity overflow routing
+    for seed, (P, cap) in ((0, (4, 512)), (1, (7, 64)), (2, (16, 128))):
+        rng = np.random.default_rng(seed)
+        n = 2000
+        pid = jnp.asarray(
+            rng.integers(0, P + 2, n).astype(np.int64))  # some parked
+        col = jnp.asarray(rng.random(n))
+
+        def buffers(lane):
+            order, dest, ovf = _dest_slots(pid, P, cap, lane=lane)
+            sc = jnp.take(col, order) if order is not None else col
+            buf = jnp.zeros((P + 1, cap + 1), dtype=col.dtype)
+            return buf.at[dest].set(sc, mode="drop")[:P, :cap], int(ovf)
+
+        buf_k, ovf_k = buffers("interpret")
+        buf_s, ovf_s = buffers("scatter")
+        assert ovf_k == ovf_s
+        assert bits_equal(buf_k, buf_s), f"seed={seed} P={P} cap={cap}"
+
+
+def test_partition_order_matches_stable_argsort():
+    for seed, n in ((0, 1), (1, 777), (2, 4096), (3, 5000)):
+        rng = np.random.default_rng(seed)
+        pids = rng.integers(0, 9, n).astype(np.int64)
+        order, starts, ends = radix.partition_order(pids, 9,
+                                                    interpret=True)
+        ref = np.argsort(pids, kind="stable")
+        assert np.array_equal(order, ref)
+        assert np.array_equal(
+            starts, np.searchsorted(pids[ref], np.arange(9), "left"))
+        assert np.array_equal(
+            ends, np.searchsorted(pids[ref], np.arange(9), "right"))
+    # empty batch contract
+    order, starts, ends = radix.partition_order(
+        np.zeros(0, np.int64), 3, interpret=True)
+    assert len(order) == 0 and np.array_equal(ends, np.zeros(3))
+
+
+# -- lane resolution, declines, faults --------------------------------------
+
+@pytest.fixture
+def _clean_lane():
+    faults.clear()
+    yield
+    faults.clear()
+    config.conf.unset(config.KERNELS_PALLAS.key)
+    config.conf.unset(config.KERNELS_PALLAS_VMEM_BUDGET.key)
+
+
+def test_lane_knob_resolution(_clean_lane):
+    config.conf.set(config.KERNELS_PALLAS.key, "off")
+    assert lane_mod.resolve("hash") == "scatter"
+    config.conf.set(config.KERNELS_PALLAS.key, "on")
+    want = "pallas" if jax.default_backend() == "tpu" else "interpret"
+    assert lane_mod.resolve("hash") == want
+    config.conf.set(config.KERNELS_PALLAS.key, "auto")
+    want = "pallas" if jax.default_backend() == "tpu" else "scatter"
+    assert lane_mod.resolve("partition") == want
+
+
+def test_vmem_decline_falls_back_to_scatter(_clean_lane):
+    # shrink the budget below any real footprint: place_rows declines,
+    # hash_agg_step lands on the scatter lane, results stay identical
+    n, S = 512, 1 << 10
+    rng = np.random.default_rng(3)
+    kd = jnp.asarray(rng.integers(0, 100, n).astype(np.int64))
+    kv = jnp.asarray(np.ones(n, bool))
+    vals = jnp.asarray(rng.random(n))
+    ref, _, _ = hash_agg_step(
+        init_hash_carry([jnp.int64], ["sum"], (jnp.float64,), S),
+        [(kd, kv)], [("sum", vals, kv)], kv, lane="scatter")
+    config.conf.set(config.KERNELS_PALLAS_VMEM_BUDGET.key, 1024)
+    before = xla_stats.snapshot()
+    got, _, _ = hash_agg_step(
+        init_hash_carry([jnp.int64], ["sum"], (jnp.float64,), S),
+        [(kd, kv)], [("sum", vals, kv)], kv, lane="interpret")
+    d = xla_stats.delta(before)
+    assert d["scatter_lane_declines"] >= 1
+    assert carries_bit_identical(ref, got)
+    assert hash_update.vmem_estimate(n, S, 3) > 1024
+
+
+def test_fault_site_forces_lossless_scatter_fallback(_clean_lane):
+    # chaos at the pallas-kernel site: resolve() swallows the injected
+    # fault, notes it, and degrades to the scatter lane — never an error
+    config.conf.set(config.KERNELS_PALLAS.key, "on")
+    faults.configure("pallas-kernel=1.0", seed=1)  # always fire
+    before = xla_stats.snapshot()
+    assert lane_mod.resolve("hash") == "scatter"
+    d = xla_stats.delta(before)
+    assert d["scatter_lane_fault_fallbacks"] == 1
+    assert d["scatter_lane_hash_scatter"] == 1
+    faults.clear()
+    assert lane_mod.resolve("hash") in ("pallas", "interpret")
+
+
+def test_fault_site_chaos_results_identical(_clean_lane):
+    # seeded intermittent chaos: some steps take the kernel lane, some
+    # are forced onto scatter mid-stream — the folded table must be
+    # bitwise the same as an all-scatter run
+    n, S = 512, 1 << 10
+    rng = np.random.default_rng(17)
+    batches = []
+    for _ in range(4):
+        kd = jnp.asarray(rng.integers(0, 200, n).astype(np.int64))
+        kv = jnp.asarray(rng.random(n) > 0.1)
+        vals = jnp.asarray(rng.random(n))
+        batches.append((kd, kv, vals))
+
+    def run():
+        c = init_hash_carry([jnp.int64], ["sum"], (jnp.float64,), S)
+        for kd, kv, vals in batches:
+            c, ovf, _ = hash_agg_step(c, [(kd, kv)],
+                                      [("sum", vals, kv)], kv)
+            assert int(ovf) == 0
+        return c
+
+    config.conf.set(config.KERNELS_PALLAS.key, "off")
+    ref = run()
+    config.conf.set(config.KERNELS_PALLAS.key, "on")
+    faults.configure("pallas-kernel@2", seed=5)  # fire on the 2nd visit
+    try:
+        got = run()
+    finally:
+        faults.clear()
+    assert carries_bit_identical(ref, got)
+
+
+def test_knob_on_off_jit_fold_bit_identical(_clean_lane):
+    # end-to-end shape: the jit'd fori fold (runtime/loop.py's pattern)
+    # with the lane threaded through the cache key — flip the knob, get
+    # a fresh trace, identical bits
+    n, S = 2048, 1 << 11
+    rng = np.random.default_rng(31)
+    kd = jnp.asarray(rng.integers(0, 500, n).astype(np.int64))
+    kv = jnp.asarray(rng.random(n) > 0.1)
+    vals = jnp.asarray(rng.random(n))
+
+    def fold(lane):
+        @jax.jit
+        def run(c, kd, kv, ad):
+            def body(_i, c):
+                return hash_agg_step(c, [(kd, kv)], [("sum", ad, kv)],
+                                     kv, lane=lane)[0]
+            return jax.lax.fori_loop(0, 3, body, c)
+        return run(init_hash_carry([jnp.int64], ["sum"],
+                                   (jnp.float64,), S), kd, kv, vals)
+
+    assert carries_bit_identical(fold("interpret"), fold("scatter"))
