@@ -2678,6 +2678,180 @@ def scatterlane_bench_main() -> int:
     return 0 if ok else 1
 
 
+# ===========================================================================
+# --stream: streaming soak — epochs, mid-soak chaos, exactly-once gate
+# ===========================================================================
+
+def stream_bench_main() -> int:
+    """Streaming soak (`--stream`): a Kafka -> tumbling event-time
+    window -> sink query runs as ONE continuous query through the
+    serving layer and the staged DagScheduler for >= 20 micro-batch
+    epochs, with a seeded `stream-epoch` fault killing an epoch
+    mid-soak and a `checkpoint-commit` fault crashing a commit.
+    Recovery must replay from the last committed checkpoint manifest,
+    and the final sink output must be BIT-IDENTICAL to an offline batch
+    recompute over the same records — zero lost, zero duplicated rows.
+    Persists sustained rows/s, p50/p99 epoch wall and recovery time to
+    BENCH_STREAM.json; exit 1 on any divergence."""
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    from blaze_tpu import config, faults
+    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.ops.kafka import KafkaRecord
+    from blaze_tpu.ops.window import EventTimeWindowSpec
+    from blaze_tpu.serving.service import QueryService
+    from blaze_tpu.streaming import (MemoryStreamSource, StreamExecutor,
+                                     StreamWindowConfig,
+                                     streaming_service_executor)
+
+    MemManager.init(4 << 30)
+    parts_n = int(os.environ.get("BLAZE_BENCH_STREAM_PARTITIONS", "4"))
+    per_part = int(os.environ.get("BLAZE_BENCH_STREAM_RECORDS", "2000"))
+    poll = int(os.environ.get("BLAZE_BENCH_STREAM_POLL", "100"))
+    seed = int(os.environ.get("BLAZE_BENCH_STREAM_SEED", "77"))
+    window_ms = 5_000
+    min_epochs = 20
+
+    import random as _random
+    rng = _random.Random(seed)
+    partitions = []
+    for p in range(parts_n):
+        recs, ts = [], 0
+        for i in range(per_part):
+            ts += rng.randint(0, 50)  # monotone per partition: no lates
+            row = {"k": f"k{rng.randint(0, 7)}", "v": rng.randint(0, 999)}
+            recs.append(KafkaRecord(
+                value=json.dumps(row).encode("utf-8"),
+                offset=i, partition=p, timestamp_ms=ts))
+        partitions.append(recs)
+
+    plan = {"kind": "kafka_scan", "topic": "bench", "format": "json",
+            "operator_id": "stream-bench", "num_partitions": parts_n,
+            "schema": {"fields": [
+                {"name": "k", "type": {"id": "utf8"}, "nullable": True},
+                {"name": "v", "type": {"id": "int64"}, "nullable": True}]}}
+    win = StreamWindowConfig(
+        spec=EventTimeWindowSpec(size_ms=window_ms), keys=["k"],
+        aggs=[("sum", "v"), ("count", None)])
+    sink_dir = tempfile.mkdtemp(prefix="blaze-stream-sink-")
+    ckpt_dir = tempfile.mkdtemp(prefix="blaze-stream-ckpt-")
+
+    holder = {}
+
+    def build(plan_ir, ctx):
+        ex = StreamExecutor(
+            plan_ir, MemoryStreamSource(partitions), win,
+            sink_dir=sink_dir, checkpoint_dir=ckpt_dir, ctx=ctx,
+            max_records_per_poll=poll)
+        holder["ex"] = ex
+        return ex
+
+    # mid-soak chaos: kill one epoch outright and one manifest commit
+    mid = max(2, (per_part // poll) // 2)
+    xla_stats.reset()
+    service = QueryService(max_concurrent=1,
+                           executor=streaming_service_executor(build))
+    t0 = time.perf_counter()
+    with faults.scoped(("stream-epoch", dict(at=(mid,))),
+                       ("checkpoint-commit", dict(at=(mid + 3,))),
+                       seed=seed):
+        handle = service.submit(plan, tenant="stream-bench")
+        summary = handle.result(timeout=600)
+        injected = sum(st["fires"] for st in faults.stats().values())
+    wall_s = time.perf_counter() - t0
+    service.shutdown()
+    ex = holder["ex"]
+
+    # offline batch oracle: independent recompute with pyarrow group_by
+    rows_k, rows_v, rows_ts = [], [], []
+    for recs in partitions:
+        for r in recs:
+            row = json.loads(r.value)
+            rows_k.append(row["k"])
+            rows_v.append(row["v"])
+            rows_ts.append(r.timestamp_ms)
+    flat = pa.table({"k": pa.array(rows_k, pa.string()),
+                     "v": pa.array(rows_v, pa.int64()),
+                     "ts": pa.array(rows_ts, pa.int64())})
+    ws = pc.multiply(pc.divide(flat["ts"], window_ms), window_ms)
+    flat = flat.append_column("window_start", ws.cast(pa.int64()))
+    oracle = flat.group_by(["k", "window_start"]).aggregate(
+        [("v", "sum"), ("v", "count")])
+    oracle = oracle.append_column(
+        "window_end", pc.add(oracle["window_start"], window_ms)
+        .cast(pa.int64()))
+    oracle = oracle.select(["k", "window_start", "window_end",
+                            "v_sum", "v_count"]) \
+        .rename_columns(["k", "window_start", "window_end",
+                         "sum_v", "count"])
+    oracle = oracle.cast(pa.schema([
+        ("k", pa.string()), ("window_start", pa.int64()),
+        ("window_end", pa.int64()), ("sum_v", pa.int64()),
+        ("count", pa.int64())]))
+
+    got = ex.sink.committed_table()
+    order = [("window_start", "ascending"), ("k", "ascending")]
+    got_s = got.sort_by(order)
+    oracle_s = oracle.sort_by(order)
+    identical = got_s.equals(oracle_s)
+    lost = max(0, oracle_s.num_rows - got_s.num_rows)
+    duplicated = max(0, got_s.num_rows - oracle_s.num_rows)
+
+    walls_ms = sorted(w / 1e6 for w in ex.epoch_walls_ns)
+
+    def pct(q):
+        if not walls_ms:
+            return 0.0
+        return walls_ms[min(len(walls_ms) - 1,
+                            int(q * (len(walls_ms) - 1) + 0.5))]
+
+    stats = xla_stats.stream_stats()
+    rec = {
+        "metric": "stream_soak_rows_per_sec",
+        "value": round(summary["records_consumed"] / wall_s, 1),
+        "unit": "rows/s",
+        "epochs": summary["epochs"],
+        "records": summary["records_consumed"],
+        "rows_emitted": summary["rows_emitted"],
+        "epoch_wall_ms_p50": round(pct(0.50), 3),
+        "epoch_wall_ms_p99": round(pct(0.99), 3),
+        "recoveries": summary["recoveries"],
+        "recovery_ms": [round(w / 1e6, 3)
+                        for w in ex.recovery_walls_ns],
+        "faults_injected": injected,
+        "checkpoints": stats["stream_checkpoints"],
+        "sink_commits": stats["stream_sink_commits"],
+        "sink_dup_skips": stats["stream_sink_dup_skips"],
+        "lost_rows": lost,
+        "duplicated_rows": duplicated,
+        "bit_identical_vs_offline": identical,
+        "min_epochs_gate": summary["epochs"] >= min_epochs,
+        "seed": seed,
+        "partitions": parts_n,
+        "records_per_partition": per_part,
+    }
+    path = os.environ.get(
+        "BLAZE_BENCH_STREAM_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_STREAM.json"))
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, default=str)
+    print(json.dumps(rec, default=str))
+    sys.stdout.flush()
+    ok = (identical and lost == 0 and duplicated == 0
+          and summary["epochs"] >= min_epochs
+          and summary["recoveries"] >= 1)
+    return 0 if ok else 1
+
+
 def main():
     if "--expr" in sys.argv:
         sys.exit(expr_bench_main())
@@ -2691,6 +2865,8 @@ def main():
         sys.exit(deviceloop_bench_main())
     if "--scatterlane" in sys.argv:
         sys.exit(scatterlane_bench_main())
+    if "--stream" in sys.argv:
+        sys.exit(stream_bench_main())
     if "--multichip-child" in sys.argv:
         sys.exit(multichip_child_main())
     if "--multichip" in sys.argv:

@@ -116,6 +116,14 @@ def prometheus_text() -> str:
         # amortized dispatches, wholesale fallbacks
         emit(f"blaze_{k}_total", v,
              "device-resident stage loop counter")
+    for k, v in xla_stats.stream_stats().items():
+        # streaming runtime (streaming/executor.py): epochs, watermark
+        # delay, window-state bytes, checkpoint/recovery/sink outcomes;
+        # *_last keys are point-in-time gauges, the rest are totals
+        if k.endswith("_last"):
+            emit(f"blaze_{k[:-5]}", v, "streaming runtime gauge")
+        else:
+            emit(f"blaze_{k}_total", v, "streaming runtime counter")
     mm = MemManager.get()
     emit("blaze_mem_spill_count_total", mm.total_spill_count,
          "memory-manager spills")

@@ -103,6 +103,23 @@ _scatter_lane = {"scatter_lane_hash_pallas": 0,
                  "scatter_lane_declines": 0,
                  "scatter_lane_fault_fallbacks": 0}
 
+# Streaming-runtime accounting (streaming/executor.py StreamExecutor):
+# committed epochs and their wall time, rows/records through the
+# pipeline, late-record routing, checkpoint commits, recovery rounds
+# and exactly-once sink outcomes.  The *_last entries are gauges (most
+# recent observation), kept here so snapshot()/prometheus share one
+# source: watermark delay (processing time - watermark), window-state
+# retained bytes, and source lag (records staged but not yet polled).
+_stream = {"stream_epochs": 0, "stream_epoch_wall_ns": 0,
+           "stream_rows": 0, "stream_records": 0,
+           "stream_late_records": 0, "stream_late_side_rows": 0,
+           "stream_checkpoints": 0, "stream_checkpoint_bytes": 0,
+           "stream_recoveries": 0, "stream_replayed_epochs": 0,
+           "stream_sink_commits": 0, "stream_sink_dup_skips": 0,
+           "stream_watermark_delay_ms_last": 0,
+           "stream_window_state_bytes_last": 0,
+           "stream_source_lag_records_last": 0}
+
 # Distinct signatures beyond this on one kernel = shape churn (the
 # recompilation-storm smell: unpadded dynamic shapes hitting jit).
 SHAPE_CHURN_THRESHOLD = 8
@@ -404,6 +421,68 @@ def scatter_lane_stats() -> dict:
         return dict(_scatter_lane)
 
 
+def note_stream_epoch(wall_ns: int, rows: int = 0,
+                      records: int = 0) -> None:
+    """One committed micro-batch epoch: wall time, sink rows emitted,
+    source records consumed."""
+    with _lock:
+        _stream["stream_epochs"] += 1
+        _stream["stream_epoch_wall_ns"] += int(wall_ns)
+        _stream["stream_rows"] += int(rows)
+        _stream["stream_records"] += int(records)
+
+
+def note_stream_late(records: int, side_rows: int = 0) -> None:
+    """Late records seen past the watermark; side_rows counts the ones
+    routed to the late-side output (policy `side`)."""
+    with _lock:
+        _stream["stream_late_records"] += int(records)
+        _stream["stream_late_side_rows"] += int(side_rows)
+
+
+def note_stream_checkpoint(nbytes: int = 0) -> None:
+    with _lock:
+        _stream["stream_checkpoints"] += 1
+        _stream["stream_checkpoint_bytes"] += int(nbytes)
+
+
+def note_stream_recovery(replayed_epochs: int = 0) -> None:
+    """One recovery round: restore from the last committed manifest."""
+    with _lock:
+        _stream["stream_recoveries"] += 1
+        _stream["stream_replayed_epochs"] += int(replayed_epochs)
+
+
+def note_stream_sink(committed: int = 0, dup_skips: int = 0) -> None:
+    """Exactly-once sink outcomes: first-wins commits vs replayed
+    attempts skipped because the epoch manifest already existed."""
+    with _lock:
+        _stream["stream_sink_commits"] += int(committed)
+        _stream["stream_sink_dup_skips"] += int(dup_skips)
+
+
+def note_stream_gauges(watermark_delay_ms: Optional[int] = None,
+                       window_state_bytes: Optional[int] = None,
+                       source_lag_records: Optional[int] = None) -> None:
+    """Latest-observation gauges (watermark delay, retained window-state
+    bytes, unread source records)."""
+    with _lock:
+        if watermark_delay_ms is not None:
+            _stream["stream_watermark_delay_ms_last"] = \
+                int(watermark_delay_ms)
+        if window_state_bytes is not None:
+            _stream["stream_window_state_bytes_last"] = \
+                int(window_state_bytes)
+        if source_lag_records is not None:
+            _stream["stream_source_lag_records_last"] = \
+                int(source_lag_records)
+
+
+def stream_stats() -> dict:
+    with _lock:
+        return dict(_stream)
+
+
 def expr_stats() -> dict:
     """Expression-program counters; `expr_cache_hit_rate` is hits over
     cache resolutions (the recompile-guard's steady-state signal)."""
@@ -468,6 +547,7 @@ def snapshot() -> dict:
     flat.update(shuffle_stats())
     flat.update(stage_loop_stats())
     flat.update(scatter_lane_stats())
+    flat.update(stream_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -497,4 +577,6 @@ def reset() -> None:
             _stage_loop[k] = 0
         for k in _scatter_lane:
             _scatter_lane[k] = 0
+        for k in _stream:
+            _stream[k] = 0
         _bucket_caps.clear()

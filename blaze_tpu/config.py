@@ -486,7 +486,8 @@ FAULTS_RULES = str_conf(
     "optional `:corrupt` action suffix (flip a frame byte instead of "
     "raising).  Sites: task-start, shuffle-write, shuffle-read, "
     "ipc-decode, mem-pressure, device-collective, device-loop, admit, "
-    "cancel-race, quota-breach, pallas-kernel.",
+    "cancel-race, quota-breach, pallas-kernel, stream-epoch, "
+    "checkpoint-commit.",
     category="fault-tolerance")
 TASK_MAX_ATTEMPTS = int_conf(
     "auron.tpu.task.maxAttempts", 4,
@@ -784,3 +785,38 @@ def operator_enabled(op: str) -> bool:
     AuronConverters.scala:98-128)."""
     opt = _OPERATOR_SWITCHES.get(op)
     return True if opt is None else opt.get()
+
+
+# -- streaming runtime (blaze_tpu/streaming/) --------------------------------
+STREAM_EPOCH_INTERVAL_MS = int_conf(
+    "auron.tpu.stream.epoch.intervalMs", 0,
+    "Target pacing between micro-batch epochs of the streaming runtime "
+    "(streaming/executor.py).  0 = run epochs back-to-back (drain mode, "
+    "the bench/test default); >0 sleeps out the remainder of the "
+    "interval after each epoch, like Flink's checkpoint interval.",
+    category="streaming")
+STREAM_CHECKPOINT_DIR = str_conf(
+    "auron.tpu.stream.checkpoint.dir", "",
+    "Directory for streaming checkpoint manifests (ckpt-NNNNNN.json: "
+    "per-partition source offsets, watermark, window-state snapshot, "
+    "sink attempt).  Empty = the StreamExecutor creates a private "
+    "tempdir torn down with the query.", category="streaming")
+STREAM_WATERMARK_LATENESS_MS = int_conf(
+    "auron.tpu.stream.watermark.latenessMs", 0,
+    "Allowed event-time lateness: the watermark trails the minimum "
+    "per-partition max event time by this many ms, so records up to "
+    "this late still land in their window before it fires.",
+    category="streaming")
+STREAM_LATE_SIDE_POLICY = str_conf(
+    "auron.tpu.stream.lateSide.policy", "drop",
+    "Where records older than the watermark go: `drop` discards them "
+    "(counted as stream_late_records), `side` routes them to the "
+    "executor's late-side output for the caller to reprocess, `accept` "
+    "folds them into a re-opened window (its pane re-emits; downstream "
+    "must tolerate updates).", category="streaming")
+STREAM_MAX_RECOVERIES = int_conf(
+    "auron.tpu.stream.maxRecoveries", 3,
+    "Bounded checkpoint-recovery rounds per streaming query: each "
+    "retryable epoch failure replays from the last committed manifest "
+    "at most this many times before the error propagates.",
+    category="streaming")

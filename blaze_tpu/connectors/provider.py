@@ -65,16 +65,32 @@ def get_provider(name: str) -> ScanProvider:
 
 class ProviderScanExec(ExecutionPlan):
     """Scan through a provider: base file scan + delete filtering +
-    partition-constant columns."""
+    partition-constant columns.
+
+    With a `predicate` (the scan filter's PhysicalExpr) two pruning tiers
+    run before decode: whole splits whose partition constants disprove
+    the predicate are dropped (ops/pruning.split_may_match), and parquet
+    row groups are pruned against min/max statistics
+    (ops/pruning.prune_with_stats, gated like the plain parquet scan by
+    auron.parquet.enable.pageFiltering)."""
 
     def __init__(self, provider: ScanProvider, descriptor: dict,
-                 schema: Schema, num_partitions: int = 1):
+                 schema: Schema, num_partitions: int = 1,
+                 predicate=None):
         super().__init__()
         if not provider.enabled():
             raise RuntimeError(f"provider {provider.name} disabled by conf")
         self._provider = provider
         self._schema = schema
+        self._predicate = predicate
         splits = provider.resolve_splits(descriptor)
+        if predicate is not None:
+            from blaze_tpu.ops.pruning import split_may_match
+            kept = [s for s in splits
+                    if split_may_match(predicate, schema,
+                                       s.partition_values)]
+            self.metrics.add("pruned_splits", len(splits) - len(kept))
+            splits = kept
         self._groups: List[List[ScanSplit]] = [[] for _ in
                                                range(num_partitions)]
         for i, s in enumerate(splits):
@@ -93,20 +109,45 @@ class ProviderScanExec(ExecutionPlan):
         import pyarrow.parquet as pq
         bs = config.BATCH_SIZE.get()
         for split in self._groups[partition]:
-            row_offset = 0
             if split.file_format == "parquet":
                 f = pq.ParquetFile(split.path)
+                md = f.metadata
                 groups = (split.row_groups if split.row_groups is not None
-                          else list(range(f.metadata.num_row_groups)))
-                it = f.iter_batches(batch_size=bs, row_groups=groups,
-                                    columns=[n for n in self._schema.names
-                                             if n not in
-                                             split.partition_values])
-            else:
-                from pyarrow import orc
-                tbl = orc.ORCFile(split.path).read()
-                it = tbl.to_batches(max_chunksize=bs)
-            for rb in it:
+                          else list(range(md.num_row_groups)))
+                if (split.row_groups is None
+                        and self._predicate is not None
+                        and config.PARQUET_ENABLE_PAGE_FILTERING.get()):
+                    from blaze_tpu.ops.pruning import prune_with_stats
+                    kept = prune_with_stats(md, self._schema,
+                                            self._predicate, groups)
+                    self.metrics.add("pruned_row_groups",
+                                     len(groups) - len(kept))
+                    groups = kept
+                # positional deletes address ABSOLUTE file rows, so each
+                # group carries its file-order start offset even when
+                # earlier groups were pruned away
+                starts, acc = {}, 0
+                for g in range(md.num_row_groups):
+                    starts[g] = acc
+                    acc += md.row_group(g).num_rows
+                cols = [n for n in self._schema.names
+                        if n not in split.partition_values]
+                for g in groups:
+                    row_offset = starts[g]
+                    for rb in f.iter_batches(batch_size=bs,
+                                             row_groups=[g],
+                                             columns=cols):
+                        rb = self._with_partition_values(rb, split)
+                        cb = ColumnBatch.from_arrow(rb)
+                        cb = self._delete.apply(cb, split, row_offset)
+                        row_offset += rb.num_rows
+                        self.metrics.add("io_bytes", rb.nbytes)
+                        yield cb
+                continue
+            from pyarrow import orc
+            tbl = orc.ORCFile(split.path).read()
+            row_offset = 0
+            for rb in tbl.to_batches(max_chunksize=bs):
                 rb = self._with_partition_values(rb, split)
                 cb = ColumnBatch.from_arrow(rb)
                 cb = self._delete.apply(cb, split, row_offset)
@@ -132,6 +173,7 @@ class ProviderScanExec(ExecutionPlan):
 
 
 def build_scan(format_name: str, descriptor: dict, schema: Schema,
-               num_partitions: int = 1) -> ProviderScanExec:
+               num_partitions: int = 1,
+               predicate=None) -> ProviderScanExec:
     return ProviderScanExec(get_provider(format_name), descriptor, schema,
-                            num_partitions)
+                            num_partitions, predicate=predicate)

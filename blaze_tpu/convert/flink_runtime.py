@@ -14,9 +14,14 @@ streaming runtime gets the same effect with a micro-batch loop:
      records behind the kafka poll resource, ships the converted plan as
      protobuf TaskDefinition bytes through NativeExecutionRuntime (the
      FULL wire path), and returns the transformed Arrow batches.
-  3. Offsets advance only after a successful batch — replay after a
-     failed batch re-reads the same records (at-least-once, like the
-     reference's source checkpointing).
+  3. Offsets advance PER PARTITION as each partition's task completes —
+     a failure mid-batch leaves only the unprocessed partitions behind,
+     and replay re-reads exactly those (at-least-once, like the
+     reference's source checkpointing).  Handing the operator a
+     streaming CheckpointManager upgrades replay to idempotent: a
+     micro-batch whose epoch manifest is already committed restores the
+     committed offsets and runs nothing, so a recovering driver can
+     blindly re-feed epochs without double-processing.
 """
 
 from __future__ import annotations
@@ -33,7 +38,8 @@ class FlinkMicroBatchOperator:
     """One operator instance per converted plan (the FlinkAuronCalcOperator
     analog).  Thread-compatible with one caller, like a Flink task."""
 
-    def __init__(self, plan_json: dict, num_partitions: int = 1):
+    def __init__(self, plan_json: dict, num_partitions: int = 1,
+                 checkpoint=None):
         self._ir = convert_flink_plan(plan_json,
                                       num_partitions=num_partitions)
         self._num_partitions = num_partitions
@@ -51,6 +57,9 @@ class FlinkMicroBatchOperator:
         self.offsets: Dict[int, int] = {p: 0
                                         for p in range(num_partitions)}
         self.batches_run = 0
+        # optional streaming.CheckpointManager: epoch-keyed manifests
+        # make replay idempotent (see run_micro_batch)
+        self._checkpoint = checkpoint
 
     @staticmethod
     def _find_scan(node: dict) -> Optional[dict]:
@@ -72,13 +81,27 @@ class FlinkMicroBatchOperator:
         self.offsets = dict(offsets)
 
     def run_micro_batch(self,
-                        records_by_partition: Sequence[Sequence[KafkaRecord]]
+                        records_by_partition: Sequence[Sequence[KafkaRecord]],
+                        epoch: Optional[int] = None
                         ) -> List[pa.RecordBatch]:
         """Run ONE micro-batch through the wire path; returns the
-        transformed batches and advances offsets on success."""
+        transformed batches.  Offsets advance per partition as soon as
+        THAT partition's task completes, so a failure leaves the
+        already-processed partitions committed and replay re-feeds only
+        the rest.  With a CheckpointManager and an ``epoch`` id the
+        whole call is idempotent: a replay of a committed epoch restores
+        its manifest's offsets and runs nothing."""
         from blaze_tpu.bridge.resource import put_resource
         from blaze_tpu.bridge.runtime import NativeExecutionRuntime
         from blaze_tpu.plan.proto_serde import task_definition_to_bytes
+
+        if (self._checkpoint is not None and epoch is not None
+                and self._checkpoint.committed(epoch)):
+            manifest = self._checkpoint.load(epoch)
+            self.offsets.update(self._checkpoint.offsets_from(manifest))
+            from blaze_tpu.bridge import xla_stats
+            xla_stats.note_stream_sink(dup_skips=1)
+            return []
 
         staged = [list(p) for p in records_by_partition]
 
@@ -99,12 +122,18 @@ class FlinkMicroBatchOperator:
                 out.extend(rt.batches())
             finally:
                 rt.finalize()
-        # success: commit offsets (at-least-once on failure/replay)
-        for p, recs in enumerate(records_by_partition):
+            # partition p fully consumed: commit ITS offset now (the
+            # partitions after it stay rewindable if the next task dies)
+            recs = (records_by_partition[p]
+                    if p < len(records_by_partition) else [])
             if recs:
                 self.offsets[p] = max(self.offsets.get(p, 0),
                                       max(r.offset for r in recs) + 1)
         self.batches_run += 1
+        if self._checkpoint is not None and epoch is not None:
+            self._checkpoint.commit(
+                epoch, {"offsets": {str(p): o
+                                    for p, o in self.offsets.items()}})
         return out
 
     def run_stream(self,

@@ -29,6 +29,12 @@ Sites (the code points that call in here):
                    cancel-vs-completion race window
     quota-breach   memory/manager.py, per quota evaluation (forces a
                    per-query quota breach → degradation rung)
+    stream-epoch   streaming/executor.py, at each micro-batch epoch
+                   boundary (kills the epoch mid-flight; the stream
+                   replays from the last committed checkpoint)
+    checkpoint-commit  streaming/checkpoint.py, before the first-wins
+                   manifest create (a crash between sink attempt and
+                   commit; replay must not double-emit)
 
 Determinism: every decision is a pure function of (seed, site,
 occurrence-index) — the k-th evaluation of a site fires or not
@@ -58,7 +64,8 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 
 SITES = ("task-start", "shuffle-write", "shuffle-read", "ipc-decode",
          "mem-pressure", "device-collective", "device-loop", "admit",
-         "cancel-race", "quota-breach", "pallas-kernel")
+         "cancel-race", "quota-breach", "pallas-kernel", "stream-epoch",
+         "checkpoint-commit")
 
 
 class InjectedFault(RuntimeError):

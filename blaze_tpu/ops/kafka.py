@@ -22,7 +22,7 @@ import pyarrow as pa
 from blaze_tpu import config
 from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
-from blaze_tpu.schema import DataType, Field, Schema, TypeId
+from blaze_tpu.schema import DataType, Field, INT64, Schema, TypeId
 
 
 class RecordDeserializer:
@@ -112,15 +112,38 @@ class KafkaRecord:
     timestamp_ms: int = 0
 
 
+def schema_with_event_time(schema: Schema,
+                           event_time_field: Optional[str]) -> Schema:
+    """Scan output schema when record timestamps are surfaced: the
+    deserialized columns plus one int64 event-time column (epoch ms,
+    from KafkaRecord.timestamp_ms — Flink's StreamRecord timestamp)."""
+    if not event_time_field:
+        return schema
+    if event_time_field in schema.names:
+        raise ValueError(
+            f"event-time field {event_time_field!r} collides with a "
+            "deserialized column")
+    return Schema(list(schema) + [Field(event_time_field, INT64, False)])
+
+
+def _append_event_time(rb: pa.RecordBatch, recs: Sequence[KafkaRecord],
+                       out_schema: Schema) -> pa.RecordBatch:
+    ts = pa.array([int(r.timestamp_ms) for r in recs], type=pa.int64())
+    return pa.RecordBatch.from_arrays(list(rb.columns) + [ts],
+                                      schema=out_schema.to_arrow())
+
+
 class MockKafkaScanExec(ExecutionPlan):
     """Broker-less source (ref kafka_mock_scan_exec.rs): serves pre-staged
     records, one kafka partition per plan partition."""
 
     def __init__(self, schema: Schema, deserializer: RecordDeserializer,
                  partitions: Sequence[Sequence[KafkaRecord]],
-                 max_batches: Optional[int] = None):
+                 max_batches: Optional[int] = None,
+                 event_time_field: Optional[str] = None):
         super().__init__()
-        self._schema = schema
+        self._event_time_field = event_time_field
+        self._schema = schema_with_event_time(schema, event_time_field)
         self._deser = deserializer
         self._partitions = [list(p) for p in partitions]
 
@@ -138,6 +161,8 @@ class MockKafkaScanExec(ExecutionPlan):
         for off in range(0, len(recs), bs):
             chunk = recs[off:off + bs]
             rb = self._deser.deserialize([r.value for r in chunk])
+            if self._event_time_field:
+                rb = _append_event_time(rb, chunk, self._schema)
             self.metrics.add("io_bytes", rb.nbytes)
             yield ColumnBatch.from_arrow(rb)
 
@@ -149,9 +174,11 @@ class KafkaScanExec(ExecutionPlan):
     """
 
     def __init__(self, schema: Schema, deserializer: RecordDeserializer,
-                 poll_resource_id: str, num_partitions: int = 1):
+                 poll_resource_id: str, num_partitions: int = 1,
+                 event_time_field: Optional[str] = None):
         super().__init__()
-        self._schema = schema
+        self._event_time_field = event_time_field
+        self._schema = schema_with_event_time(schema, event_time_field)
         self._deser = deserializer
         self._poll_id = poll_resource_id
         self._n = num_partitions
@@ -177,5 +204,7 @@ class KafkaScanExec(ExecutionPlan):
             if not recs:
                 continue
             rb = self._deser.deserialize([r.value for r in recs])
+            if self._event_time_field:
+                rb = _append_event_time(rb, recs, self._schema)
             self.metrics.add("io_bytes", rb.nbytes)
             yield ColumnBatch.from_arrow(rb)

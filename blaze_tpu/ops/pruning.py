@@ -61,6 +61,16 @@ def _pred_cols_map(md, schema: Schema, predicate: PhysicalExpr) -> dict:
     return {n: i for n, i in _name_to_col(md).items() if n in wanted}
 
 
+def split_may_match(predicate: PhysicalExpr, schema: Schema,
+                    constants: dict) -> bool:
+    """Partition pruning for provider scans: a split whose partition
+    constants (each a degenerate [v, v] interval) PROVE the predicate
+    false can be dropped before any file IO.  Conservative — True
+    whenever the predicate references non-partition columns."""
+    stats = {k: (v, v, v is None) for k, v in constants.items()}
+    return _may_match(predicate, schema, stats)
+
+
 def prune_with_stats(md, schema: Schema, predicate: PhysicalExpr,
                      groups: List[int]) -> List[int]:
     name_to_col = _pred_cols_map(md, schema, predicate)

@@ -20,6 +20,20 @@ from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
 from blaze_tpu.schema import Schema
 
 
+def write_parquet_atomic(table: pa.Table, path: str,
+                         compression: str = "zstd") -> int:
+    """Crash-safe single-file write: full file lands under a dot-tmp
+    name, then renames into place — a reader (or a streaming recovery
+    scan) never sees a torn parquet footer.  Returns bytes written."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = os.path.join(os.path.dirname(path),
+                       f".{os.path.basename(path)}.tmp-{os.getpid()}")
+    pq.write_table(table, tmp, compression=compression)
+    nbytes = os.path.getsize(tmp)
+    os.replace(tmp, path)
+    return nbytes
+
+
 class ParquetSinkExec(ExecutionPlan):
 
     def __init__(self, child: ExecutionPlan, path: str,

@@ -546,3 +546,265 @@ def _segmented_cummax(values, part_seg, xp=jnp):
 
 def _segmented_cummin(values, part_seg, xp=jnp):
     return -_segmented_cummax(-values, part_seg, xp)
+
+
+# -- event-time windows (streaming runtime) ----------------------------------
+# Parity: Flink's SliceAssigners / WindowOperator watermark semantics
+# (the reference accelerates the operator *body*; window assignment and
+# the watermark clock stay host-side, exactly as here).  The streaming
+# StreamExecutor (streaming/executor.py) feeds scheduler output batches
+# through EventTimeWindowState and fires panes when the watermark passes
+# window end; state snapshots ride in the checkpoint manifest.
+
+
+@dataclass(frozen=True)
+class EventTimeWindowSpec:
+    """Tumbling (slide_ms None) or sliding event-time window, epoch ms."""
+
+    size_ms: int
+    slide_ms: Optional[int] = None
+
+    def __post_init__(self):
+        if self.size_ms <= 0:
+            raise ValueError("window size_ms must be > 0")
+        if self.slide_ms is not None and self.slide_ms <= 0:
+            raise ValueError("window slide_ms must be > 0")
+
+    def assign(self, ts_ms: int) -> List[int]:
+        """Window starts containing ts (Flink SlidingEventTimeWindows
+        .assignWindows; one start for tumbling)."""
+        slide = self.slide_ms or self.size_ms
+        last = ts_ms - (ts_ms % slide)
+        starts = []
+        w = last
+        while w > ts_ms - self.size_ms:
+            starts.append(w)
+            w -= slide
+        return starts
+
+    def end(self, start_ms: int) -> int:
+        return start_ms + self.size_ms
+
+
+class WatermarkTracker:
+    """Event-time clock: per-partition max record timestamp, watermark =
+    min over partitions that have emitted - allowed lateness (Flink's
+    per-split watermark combination; never-seen partitions are idle and
+    do not hold the clock back).  A record with ts >= watermark is on
+    time; the watermark only moves forward."""
+
+    def __init__(self, lateness_ms: int = 0):
+        self.lateness_ms = int(lateness_ms)
+        self._max_ts: dict = {}
+        self._wm: Optional[int] = None
+
+    def observe(self, partition: int, ts_ms: int) -> None:
+        cur = self._max_ts.get(partition)
+        if cur is None or ts_ms > cur:
+            self._max_ts[partition] = int(ts_ms)
+
+    def watermark(self) -> Optional[int]:
+        if not self._max_ts:
+            return self._wm
+        wm = min(self._max_ts.values()) - self.lateness_ms
+        if self._wm is None or wm > self._wm:
+            self._wm = wm
+        return self._wm
+
+    def snapshot(self) -> dict:
+        return {"max_ts": {str(p): t for p, t in self._max_ts.items()},
+                "wm": self._wm}
+
+    def restore(self, state: dict) -> None:
+        self._max_ts = {int(p): int(t)
+                        for p, t in (state.get("max_ts") or {}).items()}
+        self._wm = state.get("wm")
+
+
+_ETW_AGGS = ("count", "sum", "min", "max", "avg")
+
+
+class EventTimeWindowState(MemConsumer):
+    """Keyed windowed-aggregation state for the streaming runtime.
+
+    Folds scheduler output rows into per-(window, key) accumulators;
+    `advance(wm)` fires every pane whose window end <= watermark.  Late
+    rows (ts < watermark at arrival) follow the late-side policy:
+    `drop` counts them, `side` buffers them for `take_late()`, `accept`
+    folds them anyway (a fired pane re-opens and re-emits).  The whole
+    state is JSON-snapshotable so it rides in the checkpoint manifest,
+    and the object is a MemConsumer so per-query memory quotas see the
+    retained bytes (there is no cheaper tier than firing: spill()
+    releases nothing, so quota pressure climbs the degrade ladder)."""
+
+    def __init__(self, spec: EventTimeWindowSpec, in_schema: pa.Schema,
+                 ts_field: str, key_fields: Sequence[str],
+                 aggs: Sequence[Tuple[str, Optional[str]]],
+                 late_policy: str = "drop"):
+        MemConsumer.__init__(self, "EventTimeWindowState")
+        self.spec = spec
+        self.ts_field = ts_field
+        self.key_fields = list(key_fields)
+        for fn, _col in aggs:
+            if fn not in _ETW_AGGS:
+                raise ValueError(f"unsupported window agg {fn!r}")
+        self.aggs = [(fn, col) for fn, col in aggs]
+        self.late_policy = late_policy
+        if late_policy not in ("drop", "side", "accept"):
+            raise ValueError(f"unknown late-side policy {late_policy!r}")
+        self._in_schema = in_schema
+        # (window_start, key tuple) -> [acc per agg]
+        self._state: dict = {}
+        self.late_records = 0
+        self._late_rows: List[dict] = []
+        self._fired: set = set()  # panes already emitted (accept policy)
+        from blaze_tpu.memory import MemManager
+        self.set_spillable(MemManager.get())
+
+    # -- accumulators ---------------------------------------------------
+    @staticmethod
+    def _acc_init(fn: str):
+        if fn == "count":
+            return 0
+        if fn == "avg":
+            return [0.0, 0]
+        return None  # sum/min/max start empty (null on no input)
+
+    @staticmethod
+    def _acc_fold(fn: str, acc, v):
+        if fn == "count":
+            return acc + (1 if v is not None else 0)
+        if v is None:
+            return acc
+        if fn == "sum":
+            return v if acc is None else acc + v
+        if fn == "min":
+            return v if acc is None or v < acc else acc
+        if fn == "max":
+            return v if acc is None or v > acc else acc
+        if fn == "avg":
+            return [acc[0] + v, acc[1] + 1]
+        raise ValueError(fn)
+
+    @staticmethod
+    def _acc_result(fn: str, acc):
+        if fn == "avg":
+            return acc[0] / acc[1] if acc[1] else None
+        return acc
+
+    # -- folding --------------------------------------------------------
+    def add_batch(self, rb, partition: Optional[int] = None,
+                  watermark: Optional[int] = None) -> int:
+        """Fold one RecordBatch/Table; returns the late-record count for
+        this batch (already routed per policy)."""
+        cols = {name: rb.column(i).to_pylist()
+                for i, name in enumerate(rb.schema.names)}
+        ts_col = cols[self.ts_field]
+        keys = [cols[k] for k in self.key_fields]
+        vals = [cols[c] if c is not None else None for _fn, c in self.aggs]
+        late = 0
+        for r in range(len(ts_col)):
+            ts = ts_col[r]
+            key = tuple(k[r] for k in keys)
+            if (watermark is not None and ts is not None
+                    and ts < watermark):
+                late += 1
+                if self.late_policy == "drop":
+                    continue
+                if self.late_policy == "side":
+                    self._late_rows.append(
+                        {n: cols[n][r] for n in rb.schema.names})
+                    continue
+                # accept: fall through and fold (pane may re-fire)
+            for w in self.spec.assign(int(ts)):
+                slot = self._state.get((w, key))
+                if slot is None:
+                    slot = [self._acc_init(fn) for fn, _ in self.aggs]
+                    self._state[(w, key)] = slot
+                for i, (fn, _col) in enumerate(self.aggs):
+                    # col None = count(*): every row counts
+                    v = vals[i][r] if vals[i] is not None else 1
+                    slot[i] = self._acc_fold(fn, slot[i], v)
+        self.late_records += late
+        self.update_mem_used(self.state_bytes())
+        return late
+
+    # -- firing ---------------------------------------------------------
+    def _out_schema(self) -> pa.Schema:
+        fields = [self._in_schema.field(k) for k in self.key_fields]
+        fields += [pa.field("window_start", pa.int64()),
+                   pa.field("window_end", pa.int64())]
+        for i, (fn, col) in enumerate(self.aggs):
+            name = f"{fn}_{col}" if col else fn
+            if fn == "count":
+                t = pa.int64()
+            elif fn == "avg":
+                t = pa.float64()
+            else:
+                t = self._in_schema.field(col).type
+            fields.append(pa.field(name, t))
+        return pa.schema(fields)
+
+    def advance(self, watermark: Optional[int]) -> pa.Table:
+        """Fire every pane whose window end <= watermark (all panes when
+        watermark is None at end-of-stream flush); deterministic order
+        (window_start, key)."""
+        due = [wk for wk in self._state
+               if watermark is None or self.spec.end(wk[0]) <= watermark]
+        due.sort(key=lambda wk: (wk[0], tuple(str(k) for k in wk[1])))
+        schema = self._out_schema()
+        rows: List[list] = [[] for _ in schema]
+        for w, key in due:
+            accs = self._state.pop((w, key))
+            c = 0
+            for k in key:
+                rows[c].append(k)
+                c += 1
+            rows[c].append(w)
+            rows[c + 1].append(self.spec.end(w))
+            c += 2
+            for i, (fn, _col) in enumerate(self.aggs):
+                rows[c + i].append(self._acc_result(fn, accs[i]))
+            self._fired.add((w, key))
+        self.update_mem_used(self.state_bytes())
+        arrays = [pa.array(v, type=f.type)
+                  for v, f in zip(rows, schema)]
+        return pa.Table.from_arrays(arrays, schema=schema)
+
+    def flush(self) -> pa.Table:
+        """End-of-stream: fire everything still buffered."""
+        return self.advance(None)
+
+    def take_late(self) -> List[dict]:
+        out, self._late_rows = self._late_rows, []
+        return out
+
+    # -- checkpoint snapshot --------------------------------------------
+    def state_bytes(self) -> int:
+        # rough retained-bytes model: dict entry + key tuple + accs
+        per = 96 + 24 * (len(self.key_fields) + len(self.aggs))
+        return len(self._state) * per + 48 * len(self._late_rows)
+
+    def snapshot(self) -> dict:
+        return {"windows": [[w, list(key), accs]
+                            for (w, key), accs in
+                            sorted(self._state.items(),
+                                   key=lambda kv: (kv[0][0],
+                                                   str(kv[0][1])))],
+                "late_records": self.late_records}
+
+    def restore(self, state: dict) -> None:
+        self._state = {(int(w), tuple(key)): list(accs)
+                       for w, key, accs in (state.get("windows") or [])}
+        self.late_records = int(state.get("late_records", 0))
+        self._fired = set()
+        self.update_mem_used(self.state_bytes())
+
+    def spill(self) -> int:
+        # window accumulators have no colder tier (firing early would
+        # break event-time semantics); report nothing released so quota
+        # arbitration escalates to the degrade ladder instead
+        return 0
+
+    def close(self) -> None:
+        self.unregister()

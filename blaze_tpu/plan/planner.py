@@ -364,15 +364,18 @@ def _create_kafka_scan(d: Dict[str, Any]) -> ExecutionPlan:
         deser = PbDeserializer(schema, cfg)
     else:
         raise ValueError(f"unknown kafka format {fmt!r}")
+    ts_field = d.get("event_time_field")
     mock = d.get("mock_data_json_array")
     if mock:
         rows = _json.loads(mock)
         recs = [KafkaRecord(value=_json.dumps(r).encode("utf-8"), offset=i)
                 for i, r in enumerate(rows)]
-        return MockKafkaScanExec(schema, deser, [recs])
+        return MockKafkaScanExec(schema, deser, [recs],
+                                 event_time_field=ts_field)
     source = d.get("operator_id") or d.get("topic")
     return KafkaScanExec(schema, deser, f"kafka://{source}",
-                         d.get("num_partitions", 1))
+                         d.get("num_partitions", 1),
+                         event_time_field=ts_field)
 
 
 def partitioning_from_dict(d: Dict[str, Any],

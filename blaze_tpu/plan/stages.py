@@ -835,6 +835,10 @@ class DagScheduler:
                 self.cleanup()  # the owned scratch dir lives on tmpfs
 
         self.exec_mode = "staged"
+        # re-arm the scratch dir: a streaming executor reuses one
+        # scheduler across micro-batch epochs and cleanup() removed it
+        # at the end of the previous epoch
+        os.makedirs(self._dir, exist_ok=True)
         stages = self.split(plan)
         stages_by_id = {st.sid: st for st in stages}
         max_recoveries = max(0, config.STAGE_MAX_RECOVERIES.get())

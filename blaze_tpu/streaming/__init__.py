@@ -1,0 +1,14 @@
+"""Streaming runtime: continuous micro-batch execution with
+checkpointed state, event-time watermarks and exactly-once sinks
+(docs/streaming.md; ref auron-flink-extension/)."""
+
+from blaze_tpu.streaming.checkpoint import CheckpointManager
+from blaze_tpu.streaming.executor import (MemoryStreamSource,
+                                          StreamExecutor,
+                                          StreamWindowConfig,
+                                          streaming_service_executor)
+from blaze_tpu.streaming.sink import ExactlyOnceParquetSink
+
+__all__ = ["CheckpointManager", "ExactlyOnceParquetSink",
+           "MemoryStreamSource", "StreamExecutor", "StreamWindowConfig",
+           "streaming_service_executor"]

@@ -1,0 +1,100 @@
+"""Checkpoint manifests for the streaming runtime.
+
+One JSON manifest per committed epoch (``ckpt-NNNNNN.json``): the
+per-partition source offsets the NEXT epoch reads from, the watermark
+clock, the windowed-agg accumulator snapshot, and the sink attempt the
+epoch produced.  Commit is FIRST-WINS and atomic — the manifest is
+written to a temp name and ``os.link``ed into place, so a replayed
+epoch racing its own earlier attempt can never publish twice (the same
+contract `_run_producer_rss` in plan/stages.py gives shuffle map
+attempts).  Recovery = read the highest committed manifest and restore
+everything from it; an uncommitted epoch left no manifest, so its
+records replay from the previous offsets.
+
+Fault site ``checkpoint-commit`` fires BEFORE the link, modeling a
+crash between the sink attempt and the commit — the window where
+at-least-once systems double-emit and this design must not.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from blaze_tpu import faults
+
+_PREFIX = "ckpt-"
+_SUFFIX = ".json"
+
+
+class CheckpointManager:
+    """Manifest directory driver (single writer per streaming query;
+    crash-vs-replay races are resolved by the first-wins link)."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _path(self, epoch: int) -> str:
+        return os.path.join(self.dir, f"{_PREFIX}{epoch:06d}{_SUFFIX}")
+
+    def committed(self, epoch: int) -> bool:
+        return os.path.exists(self._path(epoch))
+
+    def commit(self, epoch: int, manifest: dict) -> bool:
+        """First-wins commit of one epoch's manifest.  Returns True if
+        this call published it, False if a manifest for the epoch was
+        already committed (replay detected — caller must discard its
+        side effects instead of double-applying them)."""
+        faults.maybe_fail("checkpoint-commit", epoch=epoch)
+        path = self._path(epoch)
+        if os.path.exists(path):
+            return False
+        payload = json.dumps({"epoch": epoch, **manifest},
+                             sort_keys=True).encode("utf-8")
+        tmp = f"{path}.a{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, path)  # atomic + exclusive: first attempt wins
+        except FileExistsError:
+            return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_stream_checkpoint(len(payload))
+        return True
+
+    def load(self, epoch: int) -> dict:
+        with open(self._path(epoch), "rb") as f:
+            return json.loads(f.read().decode("utf-8"))
+
+    def epochs(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith(_PREFIX) and name.endswith(_SUFFIX):
+                try:
+                    out.append(int(name[len(_PREFIX):-len(_SUFFIX)]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def latest(self) -> Optional[Tuple[int, dict]]:
+        """Highest committed epoch and its manifest (the recovery
+        point), or None before the first commit."""
+        epochs = self.epochs()
+        if not epochs:
+            return None
+        e = epochs[-1]
+        return e, self.load(e)
+
+    @staticmethod
+    def offsets_from(manifest: dict) -> Dict[int, int]:
+        return {int(p): int(o)
+                for p, o in (manifest.get("offsets") or {}).items()}
