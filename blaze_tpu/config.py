@@ -812,8 +812,10 @@ STREAM_LATE_SIDE_POLICY = str_conf(
     "Where records older than the watermark go: `drop` discards them "
     "(counted as stream_late_records), `side` routes them to the "
     "executor's late-side output for the caller to reprocess, `accept` "
-    "folds them into a re-opened window (its pane re-emits; downstream "
-    "must tolerate updates).", category="streaming")
+    "folds them into the pane's retained accumulator so a re-opened "
+    "window re-emits corrected cumulative values (downstream must "
+    "tolerate updates; fired accumulators stay in window state).",
+    category="streaming")
 STREAM_MAX_RECOVERIES = int_conf(
     "auron.tpu.stream.maxRecoveries", 3,
     "Bounded checkpoint-recovery rounds per streaming query: each "

@@ -14,19 +14,26 @@ streaming runtime gets the same effect with a micro-batch loop:
      records behind the kafka poll resource, ships the converted plan as
      protobuf TaskDefinition bytes through NativeExecutionRuntime (the
      FULL wire path), and returns the transformed Arrow batches.
-  3. Offsets advance PER PARTITION as each partition's task completes —
-     a failure mid-batch leaves only the unprocessed partitions behind,
-     and replay re-reads exactly those (at-least-once, like the
-     reference's source checkpointing).  Handing the operator a
-     streaming CheckpointManager upgrades replay to idempotent: a
-     micro-batch whose epoch manifest is already committed restores the
-     committed offsets and runs nothing, so a recovering driver can
-     blindly re-feed epochs without double-processing.
+  3. Offsets advance only AFTER the transformed output has been handed
+     to the caller — committing earlier would mark rows consumed whose
+     output dies with a mid-batch exception (at-most-once row loss).
+     `run_micro_batch` returns everything at once, so it commits all
+     consumed partitions together after the last task succeeds
+     (at-least-once: a mid-batch failure rewinds the whole batch).
+     `iter_micro_batch` yields (partition, batches) and commits each
+     partition's offset only once the caller resumes the generator —
+     per-partition granularity without losing delivered-but-uncommitted
+     rows: a failure replays only the partitions whose output was never
+     handed over.  Handing the operator a streaming CheckpointManager
+     upgrades replay to idempotent: a micro-batch whose epoch manifest
+     is already committed restores the committed offsets and runs
+     nothing, so a recovering driver can blindly re-feed epochs without
+     double-processing.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import pyarrow as pa
 
@@ -80,28 +87,22 @@ class FlinkMicroBatchOperator:
     def restore_state(self, offsets: Dict[int, int]) -> None:
         self.offsets = dict(offsets)
 
-    def run_micro_batch(self,
-                        records_by_partition: Sequence[Sequence[KafkaRecord]],
-                        epoch: Optional[int] = None
-                        ) -> List[pa.RecordBatch]:
-        """Run ONE micro-batch through the wire path; returns the
-        transformed batches.  Offsets advance per partition as soon as
-        THAT partition's task completes, so a failure leaves the
-        already-processed partitions committed and replay re-feeds only
-        the rest.  With a CheckpointManager and an ``epoch`` id the
-        whole call is idempotent: a replay of a committed epoch restores
-        its manifest's offsets and runs nothing."""
-        from blaze_tpu.bridge.resource import put_resource
-        from blaze_tpu.bridge.runtime import NativeExecutionRuntime
-        from blaze_tpu.plan.proto_serde import task_definition_to_bytes
+    def _replay_of_committed(self, epoch: Optional[int]) -> bool:
+        """Idempotent-replay check: a committed epoch manifest restores
+        its offsets and short-circuits the run."""
+        if (self._checkpoint is None or epoch is None
+                or not self._checkpoint.committed(epoch)):
+            return False
+        manifest = self._checkpoint.load(epoch)
+        self.offsets.update(self._checkpoint.offsets_from(manifest))
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_stream_sink(dup_skips=1)
+        return True
 
-        if (self._checkpoint is not None and epoch is not None
-                and self._checkpoint.committed(epoch)):
-            manifest = self._checkpoint.load(epoch)
-            self.offsets.update(self._checkpoint.offsets_from(manifest))
-            from blaze_tpu.bridge import xla_stats
-            xla_stats.note_stream_sink(dup_skips=1)
-            return []
+    def _stage_polls(self,
+                     records_by_partition: Sequence[Sequence[KafkaRecord]]
+                     ) -> None:
+        from blaze_tpu.bridge.resource import put_resource
 
         staged = [list(p) for p in records_by_partition]
 
@@ -111,30 +112,84 @@ class FlinkMicroBatchOperator:
             return batch if batch else None
 
         put_resource(self._resource_id, poll)
-        out: List[pa.RecordBatch] = []
-        for p in range(self._num_partitions):
-            td = task_definition_to_bytes(
-                {"stage_id": 0, "partition_id": p,
-                 "num_partitions": self._num_partitions,
-                 "plan": self._ir})
-            rt = NativeExecutionRuntime(td).start()
-            try:
-                out.extend(rt.batches())
-            finally:
-                rt.finalize()
-            # partition p fully consumed: commit ITS offset now (the
-            # partitions after it stay rewindable if the next task dies)
-            recs = (records_by_partition[p]
-                    if p < len(records_by_partition) else [])
-            if recs:
-                self.offsets[p] = max(self.offsets.get(p, 0),
-                                      max(r.offset for r in recs) + 1)
+
+    def _run_partition(self, p: int) -> List[pa.RecordBatch]:
+        from blaze_tpu.bridge.runtime import NativeExecutionRuntime
+        from blaze_tpu.plan.proto_serde import task_definition_to_bytes
+
+        td = task_definition_to_bytes(
+            {"stage_id": 0, "partition_id": p,
+             "num_partitions": self._num_partitions,
+             "plan": self._ir})
+        rt = NativeExecutionRuntime(td).start()
+        try:
+            return list(rt.batches())
+        finally:
+            rt.finalize()
+
+    def _advance_offset(self, p: int,
+                        records_by_partition: Sequence[Sequence[KafkaRecord]]
+                        ) -> None:
+        recs = (records_by_partition[p]
+                if p < len(records_by_partition) else [])
+        if recs:
+            self.offsets[p] = max(self.offsets.get(p, 0),
+                                  max(r.offset for r in recs) + 1)
+
+    def _commit_epoch(self, epoch: Optional[int]) -> None:
         self.batches_run += 1
         if self._checkpoint is not None and epoch is not None:
             self._checkpoint.commit(
                 epoch, {"offsets": {str(p): o
                                     for p, o in self.offsets.items()}})
+
+    def run_micro_batch(self,
+                        records_by_partition: Sequence[Sequence[KafkaRecord]],
+                        epoch: Optional[int] = None
+                        ) -> List[pa.RecordBatch]:
+        """Run ONE micro-batch through the wire path; returns the
+        transformed batches.  Output reaches the caller only at return,
+        so offsets for every consumed partition commit together AFTER
+        the last task succeeds — a mid-batch failure rewinds the whole
+        batch and replay re-feeds all of it (at-least-once; committing
+        completed partitions earlier would discard their output with
+        the exception and lose those rows).  Use `iter_micro_batch` for
+        per-partition offset granularity.  With a CheckpointManager and
+        an ``epoch`` id the whole call is idempotent: a replay of a
+        committed epoch restores its manifest's offsets and runs
+        nothing."""
+        if self._replay_of_committed(epoch):
+            return []
+        self._stage_polls(records_by_partition)
+        out: List[pa.RecordBatch] = []
+        for p in range(self._num_partitions):
+            out.extend(self._run_partition(p))
+        # every task succeeded and the batches are handed back on
+        # return: NOW the consumed offsets are safe to commit
+        for p in range(self._num_partitions):
+            self._advance_offset(p, records_by_partition)
+        self._commit_epoch(epoch)
         return out
+
+    def iter_micro_batch(self,
+                         records_by_partition: Sequence[Sequence[KafkaRecord]],
+                         epoch: Optional[int] = None
+                         ) -> Iterator[Tuple[int, List[pa.RecordBatch]]]:
+        """Per-partition delivery protocol: yields ``(partition,
+        batches)`` and commits THAT partition's offset only after the
+        caller resumes the generator — i.e. after it durably received
+        the output.  A failure mid-batch therefore leaves exactly the
+        delivered partitions committed; replay re-feeds the rest, and
+        no delivered row is re-run nor any undelivered row lost."""
+        if self._replay_of_committed(epoch):
+            return
+        self._stage_polls(records_by_partition)
+        for p in range(self._num_partitions):
+            yield p, self._run_partition(p)
+            # the caller consumed partition p's output: commit ITS
+            # offset (later partitions stay rewindable if a task dies)
+            self._advance_offset(p, records_by_partition)
+        self._commit_epoch(epoch)
 
     def run_stream(self,
                    micro_batches: Iterable[Sequence[Sequence[KafkaRecord]]]

@@ -631,7 +631,13 @@ class EventTimeWindowState(MemConsumer):
     `advance(wm)` fires every pane whose window end <= watermark.  Late
     rows (ts < watermark at arrival) follow the late-side policy:
     `drop` counts them, `side` buffers them for `take_late()`, `accept`
-    folds them anyway (a fired pane re-opens and re-emits).  The whole
+    folds them into the pane's RETAINED accumulator — a fired pane
+    re-opens with the state it fired with, so the re-emitted pane
+    carries corrected cumulative values (valid for min/max/avg, not
+    just count/sum deltas) and downstream treats it as an update.
+    Accept therefore keeps fired accumulators for the life of the query
+    (counted in `state_bytes()`, so memory quotas see them); drop/side
+    retain nothing after a fire.  The whole
     state is JSON-snapshotable so it rides in the checkpoint manifest,
     and the object is a MemConsumer so per-query memory quotas see the
     retained bytes (there is no cheaper tier than firing: spill()
@@ -657,7 +663,9 @@ class EventTimeWindowState(MemConsumer):
         self._state: dict = {}
         self.late_records = 0
         self._late_rows: List[dict] = []
-        self._fired: set = set()  # panes already emitted (accept policy)
+        # accept policy: accumulators of already-fired panes, kept so a
+        # late row re-opens its pane with cumulative state
+        self._fired: dict = {}
         from blaze_tpu.memory import MemManager
         self.set_spillable(MemManager.get())
 
@@ -719,7 +727,11 @@ class EventTimeWindowState(MemConsumer):
             for w in self.spec.assign(int(ts)):
                 slot = self._state.get((w, key))
                 if slot is None:
-                    slot = [self._acc_init(fn) for fn, _ in self.aggs]
+                    # re-open a fired pane with the accumulators it
+                    # fired with (accept policy), else start fresh
+                    slot = self._fired.pop((w, key), None)
+                    if slot is None:
+                        slot = [self._acc_init(fn) for fn, _ in self.aggs]
                     self._state[(w, key)] = slot
                 for i, (fn, _col) in enumerate(self.aggs):
                     # col None = count(*): every row counts
@@ -765,7 +777,8 @@ class EventTimeWindowState(MemConsumer):
             c += 2
             for i, (fn, _col) in enumerate(self.aggs):
                 rows[c + i].append(self._acc_result(fn, accs[i]))
-            self._fired.add((w, key))
+            if self.late_policy == "accept":
+                self._fired[(w, key)] = accs
         self.update_mem_used(self.state_bytes())
         arrays = [pa.array(v, type=f.type)
                   for v, f in zip(rows, schema)]
@@ -783,21 +796,27 @@ class EventTimeWindowState(MemConsumer):
     def state_bytes(self) -> int:
         # rough retained-bytes model: dict entry + key tuple + accs
         per = 96 + 24 * (len(self.key_fields) + len(self.aggs))
-        return len(self._state) * per + 48 * len(self._late_rows)
+        return ((len(self._state) + len(self._fired)) * per
+                + 48 * len(self._late_rows))
+
+    @staticmethod
+    def _panes_out(panes: dict) -> list:
+        return [[w, list(key), accs]
+                for (w, key), accs in
+                sorted(panes.items(),
+                       key=lambda kv: (kv[0][0], str(kv[0][1])))]
 
     def snapshot(self) -> dict:
-        return {"windows": [[w, list(key), accs]
-                            for (w, key), accs in
-                            sorted(self._state.items(),
-                                   key=lambda kv: (kv[0][0],
-                                                   str(kv[0][1])))],
+        return {"windows": self._panes_out(self._state),
+                "fired": self._panes_out(self._fired),
                 "late_records": self.late_records}
 
     def restore(self, state: dict) -> None:
         self._state = {(int(w), tuple(key)): list(accs)
                        for w, key, accs in (state.get("windows") or [])}
+        self._fired = {(int(w), tuple(key)): list(accs)
+                       for w, key, accs in (state.get("fired") or [])}
         self.late_records = int(state.get("late_records", 0))
-        self._fired = set()
         self.update_mem_used(self.state_bytes())
 
     def spill(self) -> int:

@@ -28,6 +28,23 @@ _PREFIX = "ckpt-"
 _SUFFIX = ".json"
 
 
+def fsync_dir(path: str) -> None:
+    """Make a just-linked/renamed directory entry power-loss durable:
+    fsync of the FILE orders its data, but the entry itself lives in
+    the parent directory's metadata and needs its own fsync.  Best
+    effort — some platforms/filesystems refuse directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 class CheckpointManager:
     """Manifest directory driver (single writer per streaming query;
     crash-vs-replay races are resolved by the first-wins link)."""
@@ -67,6 +84,7 @@ class CheckpointManager:
                 os.unlink(tmp)
             except OSError:
                 pass
+        fsync_dir(self.dir)  # the manifest's dir entry must survive too
         from blaze_tpu.bridge import xla_stats
         xla_stats.note_stream_checkpoint(len(payload))
         return True

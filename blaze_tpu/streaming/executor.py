@@ -139,8 +139,16 @@ class StreamExecutor:
         if scan is None:
             raise ValueError("streaming plan has no kafka_scan source")
         scan.pop("mock_data_json_array", None)  # executor feeds the poll
-        self._n = int(num_partitions or scan.get("num_partitions", 1)
-                      or getattr(source, "num_partitions", 1))
+        # the source's real partition count wins over the scan's default
+        # of 1 — otherwise a multi-partition source would silently be
+        # polled on partition 0 only and declare end-of-stream early
+        src_n = getattr(source, "num_partitions", None)
+        self._n = int(num_partitions or src_n
+                      or scan.get("num_partitions", 1) or 1)
+        if src_n is not None and int(src_n) != self._n:
+            raise ValueError(
+                f"num_partitions={self._n} disagrees with "
+                f"source.num_partitions={src_n}")
         scan["num_partitions"] = self._n
         _ensure_event_time(self._ir, window.ts_field)
         self._resource_id = (f"kafka://"
@@ -193,12 +201,13 @@ class StreamExecutor:
     @classmethod
     def from_flink_plan(cls, plan_json: dict, source: Any,
                         window: StreamWindowConfig,
-                        num_partitions: int = 1,
+                        num_partitions: Optional[int] = None,
                         **kw) -> "StreamExecutor":
         from blaze_tpu.convert.flink import convert_flink_plan
-        ir = convert_flink_plan(plan_json, num_partitions=num_partitions)
-        return cls(ir, source, window, num_partitions=num_partitions,
-                   **kw)
+        n = int(num_partitions
+                or getattr(source, "num_partitions", None) or 1)
+        ir = convert_flink_plan(plan_json, num_partitions=n)
+        return cls(ir, source, window, num_partitions=n, **kw)
 
     # -- one epoch -------------------------------------------------------
     def _run_plan(self, polled: Dict[int, List[KafkaRecord]]) -> pa.Table:

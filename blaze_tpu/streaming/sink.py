@@ -25,6 +25,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from blaze_tpu.ops.sink import write_parquet_atomic
+from blaze_tpu.streaming.checkpoint import fsync_dir
 
 _FINAL = "epoch-{epoch:06d}.parquet"
 
@@ -60,6 +61,7 @@ class ExactlyOnceParquetSink:
             self.discard(attempt_path)
             return False
         os.replace(attempt_path, final)
+        fsync_dir(self.dir)  # the rename must survive power loss too
         return True
 
     def discard(self, attempt_path: str) -> None:
@@ -89,10 +91,14 @@ class ExactlyOnceParquetSink:
     def committed_table(self) -> pa.Table:
         """All committed epoch outputs, concatenated in epoch order (the
         stream's total sink output — what the bench compares against an
-        offline batch run)."""
-        tables = [pq.read_table(self._final_path(e))
-                  for e in self.committed_epochs()]
-        tables = [t for t in tables if t.num_rows]
-        if not tables:
+        offline batch run).  Raises only when NO epoch has committed;
+        committed-but-all-empty epochs (a query whose windows produced
+        no output) yield an empty table with the sink schema."""
+        epochs = self.committed_epochs()
+        if not epochs:
             raise FileNotFoundError(f"no committed epochs in {self.dir}")
-        return pa.concat_tables(tables)
+        tables = [pq.read_table(self._final_path(e)) for e in epochs]
+        non_empty = [t for t in tables if t.num_rows]
+        if non_empty:
+            return pa.concat_tables(non_empty)
+        return tables[0]  # legitimately empty stream output

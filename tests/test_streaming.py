@@ -172,10 +172,38 @@ def test_late_policy_accept_refires_pane():
         first = st.advance(2000)  # pane [0, 1000) fires
         assert first.column("sum_v").to_pylist() == [1]
         st.add_batch(_rb(schema, [("a", 5, 200)]), watermark=2000)
-        refire = st.flush()  # accepted late row re-opens the pane
-        assert refire.column("sum_v").to_pylist() == [5]
+        # the accepted late row re-opens the pane with its fired
+        # accumulators: the re-fire is a corrected CUMULATIVE pane
+        refire = st.flush()
+        assert refire.column("sum_v").to_pylist() == [6]
+        assert refire.column("count").to_pylist() == [2]
     finally:
         st.close()
+
+
+def test_late_policy_accept_survives_checkpoint_roundtrip():
+    """Fired accumulators ride the snapshot so a recovered query still
+    re-fires cumulative panes for accepted late rows."""
+    import json as _json
+
+    st, schema = _state("accept")
+    try:
+        st.add_batch(_rb(schema, [("a", 1, 100), ("a", 3, 150)]),
+                     watermark=None)
+        st.advance(2000)  # pane fires with sum=4, count=2
+        snap = _json.loads(_json.dumps(st.snapshot()))
+    finally:
+        st.close()
+
+    st2, _ = _state("accept")
+    try:
+        st2.restore(snap)
+        st2.add_batch(_rb(schema, [("a", 10, 200)]), watermark=2000)
+        refire = st2.flush()
+        assert refire.column("sum_v").to_pylist() == [14]
+        assert refire.column("count").to_pylist() == [3]
+    finally:
+        st2.close()
 
 
 def test_windows_fire_only_after_watermark():
@@ -201,6 +229,43 @@ def test_checkpoint_commit_first_wins(tmp_path):
     epoch, manifest = ck.latest()
     assert epoch == 1
     assert CheckpointManager.offsets_from(manifest) == {0: 7}
+
+
+def test_sink_all_empty_epochs_returns_empty_table(tmp_path):
+    """Committed-but-empty epochs are a legitimate state (windows that
+    produced no output): committed_table() must return an empty table
+    with the sink schema, not claim nothing committed."""
+    sink = ExactlyOnceParquetSink(str(tmp_path / "sink"))
+    schema = pa.schema([("k", pa.string()), ("sum_v", pa.int64())])
+    empty = pa.Table.from_arrays(
+        [pa.array([], pa.string()), pa.array([], pa.int64())],
+        schema=schema)
+    for e in (0, 1):
+        assert sink.promote(e, sink.write_attempt(e, empty))
+    t = sink.committed_table()
+    assert t.num_rows == 0 and t.schema.equals(schema)
+    # raising stays reserved for NO committed epoch at all
+    with pytest.raises(FileNotFoundError, match="no committed"):
+        ExactlyOnceParquetSink(str(tmp_path / "fresh")).committed_table()
+
+
+def test_executor_prefers_source_partition_count(tmp_path):
+    """A multi-partition source must not be shadowed down to the scan's
+    default of 1 (which would silently poll only partition 0 and
+    declare end-of-stream with the rest unread)."""
+    parts = [_records(0, 4), _records(1, 4, key="k1")]
+    ex = StreamExecutor(_plan(1), MemoryStreamSource(parts), WIN,
+                        sink_dir=str(tmp_path / "sink"),
+                        checkpoint_dir=str(tmp_path / "ckpt"))
+    summary = ex.run()
+    assert summary["records_consumed"] == 8  # BOTH partitions read
+    assert _sink_rows(ex.sink) == _window_oracle(parts, 1000)
+
+    # an explicit override that disagrees with the source is an error,
+    # not a silent drop
+    with pytest.raises(ValueError, match="disagrees"):
+        StreamExecutor(_plan(2), MemoryStreamSource(parts[:1]), WIN,
+                       sink_dir=str(tmp_path / "s2"), num_partitions=2)
 
 
 # -- the continuous query -----------------------------------------------
@@ -465,10 +530,14 @@ def test_flink_per_partition_offsets_on_midbatch_failure(monkeypatch):
                         FlakySecondTask)
     op = FlinkMicroBatchOperator(_flink_plan(), num_partitions=2)
     p0, p1 = _flink_recs(0, 3), _flink_recs(1, 3)
+    delivered = []
     with pytest.raises(RuntimeError, match="partition 1"):
-        op.run_micro_batch([p0, p1])
-    # partition 0 completed before the failure: ITS offset committed,
-    # partition 1 stays rewindable
+        for _part, batches in op.iter_micro_batch([p0, p1]):
+            delivered.extend(batches)
+    # partition 0's output was HANDED OVER before the failure, so its
+    # offset committed; partition 1 stays rewindable
+    assert sorted(i for rb in delivered
+                  for i in rb.column(0).to_pylist()) == [0, 1, 2]
     assert op.offsets == {0: 3, 1: 0}
 
     # replay feeds only the un-committed partition
@@ -478,6 +547,50 @@ def test_flink_per_partition_offsets_on_midbatch_failure(monkeypatch):
     ids = sorted(i for rb in out
                  for i in rb.column(0).to_pylist())
     assert ids == [100, 101, 102]  # p1 rows exactly once, p0 not re-run
+    assert op.offsets == {0: 3, 1: 3}
+
+
+def test_flink_midbatch_failure_rewinds_whole_batch(monkeypatch):
+    """run_micro_batch hands output back only at return, so a mid-batch
+    failure must NOT leave earlier partitions' offsets committed — their
+    batches died with the exception and a replay has to re-emit them
+    (at-least-once, zero loss)."""
+    from blaze_tpu.bridge import runtime as bridge_runtime
+    from blaze_tpu.convert.flink_runtime import FlinkMicroBatchOperator
+
+    real = bridge_runtime.NativeExecutionRuntime
+    calls = {"n": 0}
+
+    class FlakySecondTask:
+        def __init__(self, td):
+            calls["n"] += 1
+            self._boom = calls["n"] == 2
+            self._inner = real(td)
+
+        def start(self):
+            self._inner.start()
+            return self
+
+        def batches(self):
+            if self._boom:
+                raise RuntimeError("injected: partition 1 task died")
+            return self._inner.batches()
+
+        def finalize(self):
+            self._inner.finalize()
+
+    monkeypatch.setattr(bridge_runtime, "NativeExecutionRuntime",
+                        FlakySecondTask)
+    op = FlinkMicroBatchOperator(_flink_plan(), num_partitions=2)
+    p0, p1 = _flink_recs(0, 3), _flink_recs(1, 3)
+    with pytest.raises(RuntimeError, match="partition 1"):
+        op.run_micro_batch([p0, p1])
+    # nothing was delivered, so nothing may be marked consumed
+    assert op.offsets == {0: 0, 1: 0}
+
+    out = op.run_micro_batch([p0, p1])  # full replay
+    ids = sorted(i for rb in out for i in rb.column(0).to_pylist())
+    assert ids == [0, 1, 2, 100, 101, 102]  # every row exactly once
     assert op.offsets == {0: 3, 1: 3}
 
 
