@@ -1558,6 +1558,269 @@ def chaos_bench_main() -> int:
 
 
 # ===========================================================================
+# --workers: process-isolated worker-pool crash soak (ISSUE 11)
+# ===========================================================================
+
+def workers_bench_main() -> int:
+    """Worker-pool crash soak (`--workers`): route staged task execution
+    through the process-isolated worker pool and kill it, repeatedly.
+    Three legs, every result compared bit for bit against a fault-free
+    in-process baseline:
+
+      chaos      q01/q06/q95 with seeded SIGKILLs mid-map-task /
+                 mid-shuffle-write (`worker-crash`), suppressed
+                 heartbeats (`worker-hang`), and slow-but-alive workers
+                 (`worker-slow`).  Crashes must cost retries on OTHER
+                 workers and bounded recoveries — never wrong answers
+                 or leaked spill files.
+      blacklist  crash budget 0 plus one seeded kill: the crashed
+                 worker must be observably blacklisted in pool health
+                 while the query completes on the survivors.
+      serve      concurrent QueryService run with one seeded worker
+                 crash: the victim retries on another worker, every
+                 admitted query completes correct, the service never
+                 wedges.
+
+    Writes BENCH_WORKERS.json and prints it as one JSON line."""
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+    import tempfile
+
+    from blaze_tpu import config, faults
+    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.itest import generate
+    from blaze_tpu.itest.queries import QUERIES
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.itest.tpcds_data import write_parquet_splits
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.parallel import workers
+    from blaze_tpu.plan.stages import DagScheduler
+    from blaze_tpu.serving import QueryService
+
+    seed = int(os.environ.get("BLAZE_BENCH_WORKERS_SEED", "1234"))
+    names = os.environ.get("BLAZE_BENCH_WORKERS_QUERIES",
+                           "q01,q06,q95").split(",")
+    scale = float(os.environ.get("BLAZE_BENCH_WORKERS_SCALE", "0.2"))
+    rules = os.environ.get(
+        "BLAZE_BENCH_WORKERS_RULES",
+        "worker-crash=0.25,worker-hang@3,worker-slow=0.2")
+
+    MemManager.init(4 << 30)
+    # staged wire path forced on (the pool only carries shuffle map
+    # tasks), fast retries, and a liveness deadline short enough that a
+    # seeded hang costs ~2s instead of the production default
+    knobs = {config.DAG_SINGLE_TASK_BYTES.key: 0,
+             config.TASK_RETRY_BACKOFF_MS.key: 5,
+             config.TASK_MAX_ATTEMPTS.key: 6,
+             config.STAGE_MAX_RECOVERIES.key: 8,
+             config.WORKERS_COUNT.key: 2,
+             config.WORKERS_HEARTBEAT_MS.key: 50,
+             config.WORKERS_LIVENESS_MS.key: 1500,
+             config.WORKERS_RESTART_BACKOFF_MS.key: 10,
+             # the chaos leg kills workers far past the production
+             # crash budget; it must keep recovering, not blacklist
+             # the whole pool — blacklisting is leg 2's job
+             config.WORKERS_CRASH_BUDGET.key: -1}
+    for k, v in knobs.items():
+        config.conf.set(k, v)
+
+    def frame(tbl):
+        import pandas as pd
+        return tbl.to_pandas() if tbl.num_rows else pd.DataFrame(
+            {n: [] for n in tbl.schema.names})
+
+    queries = []
+    diverged = 0
+    leaked = 0
+    blacklist = {}
+    serve = {}
+    try:
+        with tempfile.TemporaryDirectory(prefix="workers-") as d:
+            # corpus + fault-free IN-PROCESS baselines: chaos legs must
+            # match the thread path bit for bit, which also proves plain
+            # cross-process determinism before any fault fires
+            plans, bases, base_walls = [], [], []
+            config.conf.set(config.WORKERS_ENABLE.key, "off")
+            for qname in names:
+                qname = qname.strip()
+                builder, table_names = QUERIES[qname]
+                tables = generate(table_names, scale=scale)
+                paths = write_parquet_splits(
+                    tables, os.path.join(d, qname), 2)
+                plan_dict, _oracle = builder(paths, tables, 2)
+                plans.append((qname, plan_dict))
+                t0 = time.perf_counter()
+                bases.append(frame(DagScheduler(
+                    work_dir=os.path.join(d, qname, "base"))
+                    .run_collect(plan_dict)))
+                base_walls.append(time.perf_counter() - t0)
+            config.conf.set(config.WORKERS_ENABLE.key, "on")
+
+            # --- leg 1: per-query crash/hang/slow chaos through the pool
+            for (qname, plan_dict), base, bwall in zip(plans, bases,
+                                                       base_walls):
+                faults.configure(rules, seed=seed)
+                before = xla_stats.snapshot()
+                sched = DagScheduler(
+                    work_dir=os.path.join(d, qname, "chaos"))
+                t0 = time.perf_counter()
+                try:
+                    got = sched.run_collect(plan_dict)
+                finally:
+                    inj_stats = faults.stats()
+                    faults.clear()
+                wall = time.perf_counter() - t0
+                ds = xla_stats.delta(before)
+                leaks = sched.leak_report()
+                n_leaked = sum(len(v) for v in leaks.values())
+                leaked += n_leaked
+                err = compare_frames(frame(got), base)
+                if err is not None:
+                    diverged += 1
+                queries.append({
+                    "query": qname,
+                    "base_wall_s": round(bwall, 4),
+                    "chaos_wall_s": round(wall, 4),
+                    "divergence": err,
+                    "worker_tasks": int(ds["worker_tasks"]),
+                    "worker_crashes": int(ds["worker_crashes"]),
+                    "worker_hangs": int(ds["worker_hangs"]),
+                    "worker_restarts": int(ds["worker_restarts"]),
+                    "worker_cancels": int(ds["worker_cancels"]),
+                    "task_retries": int(ds["task_retries"]),
+                    "fetch_failures": int(ds["fetch_failures"]),
+                    "stage_recoveries": int(ds["stage_recoveries"]),
+                    "recovered_map_tasks":
+                        int(ds["recovered_map_tasks"]),
+                    "leaked": n_leaked,
+                    "site_stats": inj_stats,
+                })
+
+            # --- leg 2: blacklist observability.  Budget 0 = first
+            # crash blacklists; the retry must land on the survivor and
+            # the dead slot must show up in pool health.
+            workers.shutdown_pool(wait=False)
+            config.conf.set(config.WORKERS_CRASH_BUDGET.key, 0)
+            faults.configure("worker-crash@1", seed=seed)
+            before = xla_stats.snapshot()
+            sched = DagScheduler(work_dir=os.path.join(d, "blacklist"))
+            try:
+                got = sched.run_collect(plans[0][1])
+            finally:
+                faults.clear()
+            ds = xla_stats.delta(before)
+            health = workers.pool_health()
+            black = [s["worker"] for s in health.get("slots", [])
+                     if s["state"] == "blacklisted"]
+            err = compare_frames(frame(got), bases[0])
+            if err is not None:
+                diverged += 1
+            leaks = sched.leak_report()
+            leaked += sum(len(v) for v in leaks.values())
+            blacklist = {
+                "query": plans[0][0],
+                "rules": "worker-crash@1",
+                "crash_budget": 0,
+                "divergence": err,
+                "worker_crashes": int(ds["worker_crashes"]),
+                "worker_blacklisted": int(ds["worker_blacklisted"]),
+                "blacklisted_workers": black,
+                "health": health,
+            }
+            config.conf.set(config.WORKERS_CRASH_BUDGET.key, -1)
+
+            # --- leg 3: concurrent serve with one seeded worker crash;
+            # the victim retries on another worker, nobody else notices
+            workers.shutdown_pool(wait=False)
+            n_conc = int(os.environ.get("BLAZE_BENCH_WORKERS_SERVE",
+                                        "8"))
+            faults.configure("worker-crash@2", seed=seed)
+            before = xla_stats.snapshot()
+            svc = QueryService(max_concurrent=n_conc,
+                               max_queue=4 * n_conc,
+                               tenant_max_inflight=4 * n_conc)
+            sdiv = sleaks = failed = done = 0
+            try:
+                handles = [(svc.submit(plans[i % len(plans)][1],
+                                       tenant=f"t{i % 4}",
+                                       deadline_ms=0.0),
+                            i % len(plans))
+                           for i in range(n_conc)]
+                for h, j in handles:
+                    h.exception(timeout=600)
+                    if h.status == "done":
+                        done += 1
+                        if compare_frames(frame(h.result()),
+                                          bases[j]) is not None:
+                            sdiv += 1
+                    else:
+                        failed += 1
+                    if h.leak_report is not None and any(
+                            h.leak_report.values()):
+                        sleaks += 1
+            finally:
+                faults.clear()
+                svc.shutdown(wait=True, cancel_running=True)
+            ds = xla_stats.delta(before)
+            diverged += sdiv
+            leaked += sleaks
+            serve = {
+                "concurrency": n_conc,
+                "submitted": n_conc,
+                "completed": done,
+                "failed": failed,
+                "divergent": sdiv,
+                "leaked": sleaks,
+                "worker_crashes": int(ds["worker_crashes"]),
+                "worker_restarts": int(ds["worker_restarts"]),
+                "task_retries": int(ds["task_retries"]),
+            }
+    finally:
+        faults.clear()
+        workers.shutdown_pool(wait=False)
+        config.conf.unset(config.WORKERS_ENABLE.key)
+        config.conf.unset(config.WORKERS_CRASH_BUDGET.key)
+        for k in knobs:
+            config.conf.unset(k)
+
+    total_crashes = (sum(q["worker_crashes"] for q in queries)
+                     + blacklist.get("worker_crashes", 0)
+                     + serve.get("worker_crashes", 0))
+    rec = {
+        "metric": "workers_divergent_queries",
+        "value": diverged,
+        "unit": "queries",
+        "seed": seed,
+        "rules": rules,
+        "scale": scale,
+        "queries": queries,
+        "blacklist": blacklist,
+        "serve": serve,
+        "leaked": leaked,
+        "total_worker_crashes": total_crashes,
+        "total_worker_tasks": sum(q["worker_tasks"] for q in queries),
+        "total_task_retries": sum(q["task_retries"] for q in queries),
+        "total_stage_recoveries":
+            sum(q["stage_recoveries"] for q in queries),
+    }
+    path = os.environ.get(
+        "BLAZE_BENCH_WORKERS_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_WORKERS.json"))
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, default=str)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+    ok = (diverged == 0 and leaked == 0 and total_crashes >= 1
+          and len(blacklist.get("blacklisted_workers", [])) >= 1
+          and serve.get("failed", 1) == 0
+          and serve.get("completed", 0) == serve.get("submitted", -1))
+    return 0 if ok else 1
+
+
+# ===========================================================================
 # --deviceloop: device-resident stage loop vs staged per-batch (ISSUE 8)
 # ===========================================================================
 
@@ -2070,6 +2333,11 @@ def multichip_child_main() -> int:
         # flag the leg so the curve reader discounts it
         "host_core_limited": (jax.default_backend() == "cpu"
                               and n_req > cores),
+        # staged query execution in this leg runs through the
+        # process-isolated worker pool (crash fault domains), not bare
+        # threads; BLAZE_BENCH_MULTICHIP_WORKERS=0 opts out
+        "worker_isolated": os.environ.get(
+            "BLAZE_BENCH_MULTICHIP_WORKERS", "1") != "0",
         "platform": jax.default_backend(),
         "map_stage": {"rows": rows, "groups": n_groups,
                       "wall_s": round(wall, 6),
@@ -2106,6 +2374,15 @@ def _multichip_queries(chaos: bool) -> dict:
     MemManager.init(4 << 30)
     knobs = {config.DAG_SINGLE_TASK_BYTES.key: 0,
              config.TASK_RETRY_BACKOFF_MS.key: 5}
+    workers_on = os.environ.get(
+        "BLAZE_BENCH_MULTICHIP_WORKERS", "1") != "0"
+    if workers_on:
+        # map tasks run process-isolated: a worker crash here must fall
+        # back exactly like a shard-kill does (retry elsewhere), never
+        # change the answer
+        knobs.update({config.WORKERS_ENABLE.key: "on",
+                      config.WORKERS_COUNT.key: 2,
+                      config.WORKERS_RESTART_BACKOFF_MS.key: 10})
     for k, v in knobs.items():
         config.conf.set(k, v)
 
@@ -2153,14 +2430,18 @@ def _multichip_queries(chaos: bool) -> dict:
                     "device_rows": int(ds.get("shuffle_device_rows", 0)),
                     "fallbacks":
                         int(ds.get("shuffle_device_fallbacks", 0)),
+                    "worker_tasks": int(ds.get("worker_tasks", 0)),
                 })
     finally:
         faults.clear()
         config.conf.unset(config.SHUFFLE_DEVICE.key)
         for k in knobs:
             config.conf.unset(k)
+        if workers_on:
+            from blaze_tpu.parallel import workers as _workers
+            _workers.shutdown_pool(wait=False)
     return {"queries": queries, "divergent_queries": diverged,
-            "scale": scale}
+            "scale": scale, "worker_isolated": workers_on}
 
 
 def multichip_bench_main() -> int:
@@ -2204,6 +2485,7 @@ def multichip_bench_main() -> int:
                  "n_devices_requested": leg["n_devices_requested"],
                  "host_cpu_cores": leg.get("host_cpu_cores"),
                  "host_core_limited": leg.get("host_core_limited", False),
+                 "worker_isolated": leg.get("worker_isolated", False),
                  "platform": leg["platform"], **ms}
         mc["legs"].append(entry)
         if "itest" in leg:
@@ -2857,6 +3139,8 @@ def main():
         sys.exit(expr_bench_main())
     if "--chaos" in sys.argv:
         sys.exit(chaos_bench_main())
+    if "--workers" in sys.argv:
+        sys.exit(workers_bench_main())
     if "--serve" in sys.argv:
         sys.exit(serve_bench_main())
     if "--aggskip" in sys.argv:

@@ -124,6 +124,10 @@ def prometheus_text() -> str:
             emit(f"blaze_{k[:-5]}", v, "streaming runtime gauge")
         else:
             emit(f"blaze_{k}_total", v, "streaming runtime counter")
+    for k, v in xla_stats.worker_stats().items():
+        # process-isolated worker pool (parallel/workers.py): spawns,
+        # shipped tasks, crash/hang/blacklist/cancel supervision events
+        emit(f"blaze_{k}_total", v, "worker pool counter")
     mm = MemManager.get()
     emit("blaze_mem_spill_count_total", mm.total_spill_count,
          "memory-manager spills")
@@ -249,8 +253,10 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:
                 self._send(500, json.dumps({"error": str(e)}))
         elif route == "/serving":
+            from blaze_tpu.parallel.workers import pool_health
             from blaze_tpu.serving import serving_stats
-            self._send(200, json.dumps({"services": serving_stats()}))
+            self._send(200, json.dumps({"services": serving_stats(),
+                                        "workers": pool_health()}))
         elif route == "/serving/cancel":
             from blaze_tpu.serving import cancel_query
             params = urllib.parse.parse_qs(parsed.query,

@@ -27,7 +27,8 @@ from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
 from typing import Any, Callable, List, Optional
 
 from blaze_tpu import faults
-from blaze_tpu.faults import FetchFailedError, classify_exception
+from blaze_tpu.faults import FetchFailedError, WorkerCrashed, \
+    classify_exception
 
 log = logging.getLogger("blaze_tpu.tasks")
 
@@ -49,12 +50,20 @@ def default_task_parallelism(n: int) -> int:
 
 
 def _run_with_retries(fn: Callable[[int], Any], i: int, what: str,
-                      query=None) -> Any:
+                      query=None, remote=None, deadline=None) -> Any:
     """One task slot: bounded attempts around `fn(i)` (runs ON the pool
     thread, so retries never hold a second slot).  `query` (an optional
     serving.QueryContext) is bound to the pool thread for the duration
     and makes backoff sleeps interruptible: a cancelled query raises
-    from inside the sleep instead of sitting out the full backoff."""
+    from inside the sleep instead of sitting out the full backoff.
+
+    `remote` optionally maps `i` to a worker-pool task spec
+    ({"fn": "module:qualname", "args": tuple}); when the pool is enabled
+    the attempt runs process-isolated there instead of via `fn(i)`, a
+    crash comes back as retryable WorkerCrashed, and the retry EXCLUDES
+    the crashed worker so it lands on a different one.  `deadline`
+    (monotonic) bounds each remote attempt so a wedged worker is killed
+    instead of holding its slot past the wave timeout."""
     from blaze_tpu import config
     from blaze_tpu.bridge import tracing, xla_stats
     from blaze_tpu.bridge.context import query_scope
@@ -62,6 +71,7 @@ def _run_with_retries(fn: Callable[[int], Any], i: int, what: str,
     base_s = max(0, config.TASK_RETRY_BACKOFF_MS.get()) / 1e3
     wait_ns = 0
     attempt = 1
+    exclude: set = set()
     with query_scope(query):
         while True:
             try:
@@ -69,19 +79,34 @@ def _run_with_retries(fn: Callable[[int], Any], i: int, what: str,
                     query.check()
                 faults.maybe_fail("task-start", task=i, attempt=attempt,
                                   what=what)
-                if attempt == 1:
-                    out = fn(i)
-                else:
-                    # retries take the most conservative path: decline
-                    # the device-resident stage loop (an optimization
-                    # that was live during the attempt that failed)
-                    from blaze_tpu.plan.stage_compiler import \
-                        decline_loop_scope
-                    with decline_loop_scope():
+                out = _POOL_MISS
+                if remote is not None:
+                    # resolved per ATTEMPT: shuffle-input locations may
+                    # have moved after a lineage recovery round, and an
+                    # invalidated input must surface as FetchFailedError
+                    # now, not ship a stale block list
+                    spec = remote(i)
+                    if spec is not None:
+                        out = _run_remote(spec, exclude, deadline, query,
+                                          what)
+                if out is _POOL_MISS:
+                    if attempt == 1:
                         out = fn(i)
+                    else:
+                        # retries take the most conservative path:
+                        # decline the device-resident stage loop (an
+                        # optimization that was live during the attempt
+                        # that failed)
+                        from blaze_tpu.plan.stage_compiler import \
+                            decline_loop_scope
+                        with decline_loop_scope():
+                            out = fn(i)
                 xla_stats.note_task_attempts(attempt, wait_ns)
                 return out
             except BaseException as e:
+                if isinstance(e, WorkerCrashed) \
+                        and e.worker_id is not None:
+                    exclude.add(e.worker_id)
                 kind = classify_exception(e)
                 if kind != "retryable" or attempt >= max_attempts:
                     xla_stats.note_task_attempts(attempt, wait_ns,
@@ -103,14 +128,48 @@ def _run_with_retries(fn: Callable[[int], Any], i: int, what: str,
                 attempt += 1
 
 
+_POOL_MISS = object()
+
+
+def _run_remote(spec, exclude: set, deadline, query, what: str) -> Any:
+    """One process-isolated attempt on the worker pool.  Returns
+    _POOL_MISS when the pool can't take it (disabled / spawn failed /
+    fully blacklisted) so the caller falls back to in-process."""
+    from blaze_tpu import config
+    if not config.WORKERS_ENABLE.get():
+        return _POOL_MISS
+    from blaze_tpu.parallel import workers
+    pool = workers.get_pool()
+    if pool is None:
+        return _POOL_MISS
+    timeout_s = None
+    if deadline is not None:
+        timeout_s = deadline - time.monotonic()
+        if timeout_s <= 0:
+            raise TimeoutError("worker task deadline already expired")
+    try:
+        return pool.run(spec, exclude=exclude, timeout_s=timeout_s,
+                        query=query, what=what)
+    except workers.WorkerPoolUnavailable:
+        return _POOL_MISS
+
+
 def run_tasks(fn: Callable[[int], Any], n: int, timeout_s: float,
               what: str, max_workers: Optional[int] = None,
-              query=None) -> List[Any]:
+              query=None, remote=None) -> List[Any]:
+    deadline = time.monotonic() + timeout_s
+    if remote is not None:
+        # process-isolated tasks don't contend on the GIL: give every
+        # map task its own slot-waiter thread and let the worker pool's
+        # slot count be the real concurrency limit
+        from blaze_tpu import config
+        if config.WORKERS_ENABLE.get() and max_workers is None:
+            max_workers = max(1, n)
     pool = ThreadPoolExecutor(max_workers=max_workers or
                               default_task_parallelism(n))
-    futs = [pool.submit(_run_with_retries, fn, i, what, query)
+    futs = [pool.submit(_run_with_retries, fn, i, what, query, remote,
+                        deadline)
             for i in range(n)]
-    deadline = time.monotonic() + timeout_s
     pending = set(futs)
     while pending:
         if query is not None and query.cancelled:

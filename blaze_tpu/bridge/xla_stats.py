@@ -120,6 +120,14 @@ _stream = {"stream_epochs": 0, "stream_epoch_wall_ns": 0,
            "stream_window_state_bytes_last": 0,
            "stream_source_lag_records_last": 0}
 
+# Worker-pool accounting (parallel/workers.py WorkerPool): processes
+# spawned (incl. restarts), tasks shipped over the pipe, crashes (exit
+# classified), hangs (liveness-deadline SIGKILLs), supervised restarts,
+# slots blacklisted by the crash budget, and cancel escalations.
+_workers = {"worker_spawns": 0, "worker_tasks": 0, "worker_crashes": 0,
+            "worker_hangs": 0, "worker_restarts": 0,
+            "worker_blacklisted": 0, "worker_cancels": 0}
+
 # Distinct signatures beyond this on one kernel = shape churn (the
 # recompilation-storm smell: unpadded dynamic shapes hitting jit).
 SHAPE_CHURN_THRESHOLD = 8
@@ -290,6 +298,45 @@ def note_fault_injected() -> None:
 def fault_stats() -> dict:
     with _lock:
         return dict(_faults)
+
+
+def note_worker_spawn(restart: bool = False) -> None:
+    """One worker process forked (restart=True when replacing a crash)."""
+    with _lock:
+        _workers["worker_spawns"] += 1
+        if restart:
+            _workers["worker_restarts"] += 1
+
+
+def note_worker_task() -> None:
+    """One task shipped over the pipe to a pool worker."""
+    with _lock:
+        _workers["worker_tasks"] += 1
+
+
+def note_worker_crash(hang: bool = False) -> None:
+    """A worker died mid-task (hang=True: liveness-deadline SIGKILL)."""
+    with _lock:
+        _workers["worker_crashes"] += 1
+        if hang:
+            _workers["worker_hangs"] += 1
+
+
+def note_worker_blacklisted() -> None:
+    """A slot exhausted its crash budget and was blacklisted."""
+    with _lock:
+        _workers["worker_blacklisted"] += 1
+
+
+def note_worker_cancel() -> None:
+    """A cancel/deadline escalated into the child (SIGTERM->SIGKILL)."""
+    with _lock:
+        _workers["worker_cancels"] += 1
+
+
+def worker_stats() -> dict:
+    with _lock:
+        return dict(_workers)
 
 
 def note_device_exchange(rows: int, nbytes: int,
@@ -548,6 +595,7 @@ def snapshot() -> dict:
     flat.update(stage_loop_stats())
     flat.update(scatter_lane_stats())
     flat.update(stream_stats())
+    flat.update(worker_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -579,4 +627,6 @@ def reset() -> None:
             _scatter_lane[k] = 0
         for k in _stream:
             _stream[k] = 0
+        for k in _workers:
+            _workers[k] = 0
         _bucket_caps.clear()

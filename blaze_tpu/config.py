@@ -108,6 +108,13 @@ class ConfSession:
         with self._lock:
             return dict(self._overrides)
 
+    def replace(self, overrides: Dict[str, str]) -> None:
+        """Swap the whole override map (worker children apply the
+        parent's snapshot per task; update() would leak keys the parent
+        has since unset)."""
+        with self._lock:
+            self._overrides = {k: str(v) for k, v in overrides.items()}
+
 
 class _Scoped:
     """Context manager restoring overridden keys on exit (test helper)."""
@@ -487,7 +494,9 @@ FAULTS_RULES = str_conf(
     "raising).  Sites: task-start, shuffle-write, shuffle-read, "
     "ipc-decode, mem-pressure, device-collective, device-loop, admit, "
     "cancel-race, quota-breach, pallas-kernel, stream-epoch, "
-    "checkpoint-commit.",
+    "checkpoint-commit, worker-crash, worker-hang, worker-slow.  Site "
+    "names are validated at parse time (faults.register_site declares "
+    "dynamic sites).",
     category="fault-tolerance")
 TASK_MAX_ATTEMPTS = int_conf(
     "auron.tpu.task.maxAttempts", 4,
@@ -507,6 +516,46 @@ STAGE_MAX_RECOVERIES = int_conf(
     "stage; beyond this many rounds the failure propagates (the "
     "spark.stage.maxConsecutiveAttempts analog).",
     category="fault-tolerance")
+WORKERS_ENABLE = bool_conf(
+    "auron.tpu.workers.enable", False,
+    "Route map tasks through the supervised worker-process pool "
+    "(parallel/workers.py) instead of in-process threads: a native "
+    "segfault / OOM-kill / hung dispatch costs ONE worker process and a "
+    "retry, not the whole query service.  Off by default — the thread "
+    "path stays the seed-verified baseline.", category="fault-tolerance")
+WORKERS_COUNT = int_conf(
+    "auron.tpu.workers.count", 2,
+    "Long-lived worker processes in the pool (the executor-count "
+    "analog).  Each worker runs one task at a time; crashed workers are "
+    "restarted with backoff until the crash budget blacklists them.",
+    category="fault-tolerance")
+WORKERS_HEARTBEAT_MS = int_conf(
+    "auron.tpu.workers.heartbeatMs", 100,
+    "Worker heartbeat period while running a task.  Heartbeats ride the "
+    "same CRC-framed pipe as results, so a wedged child (native hang, "
+    "GIL-free deadlock) stops producing them.",
+    category="fault-tolerance")
+WORKERS_LIVENESS_MS = int_conf(
+    "auron.tpu.workers.livenessMs", 2000,
+    "Liveness deadline: a busy worker silent for this long is declared "
+    "hung, SIGKILLed, and its task re-dispatched as WorkerCrashed "
+    "(the spark.network.timeout / executor-heartbeat analog).  Must "
+    "comfortably exceed heartbeatMs.", category="fault-tolerance")
+WORKERS_CRASH_BUDGET = int_conf(
+    "auron.tpu.workers.crashBudget", 3,
+    "Crashes a worker slot survives before it is blacklisted (never "
+    "restarted, never receives tasks again) — the repeat-offender "
+    "analog of Spark's excludeOnFailure.", category="fault-tolerance")
+WORKERS_RESTART_BACKOFF_MS = int_conf(
+    "auron.tpu.workers.restartBackoffMs", 50,
+    "Base delay before respawning a crashed worker; doubles per "
+    "accumulated crash on that slot so a crash-looping environment "
+    "backs off instead of spinning fork+die.", category="fault-tolerance")
+WORKERS_DRAIN_MS = int_conf(
+    "auron.tpu.workers.drainMs", 1000,
+    "Graceful-drain budget at pool shutdown: workers get a shutdown "
+    "message and this long to exit cleanly before SIGTERM, then "
+    "SIGKILL.", category="fault-tolerance")
 SHUFFLE_CHECKSUM_ENABLE = bool_conf(
     "auron.tpu.shuffle.checksum", True,
     "CRC32C checksum on every shuffle/spill IPC frame (4 bytes/frame, "

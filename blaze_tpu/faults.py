@@ -35,6 +35,16 @@ Sites (the code points that call in here):
     checkpoint-commit  streaming/checkpoint.py, before the first-wins
                    manifest create (a crash between sink attempt and
                    commit; replay must not double-emit)
+    worker-crash   parallel/workers.py, per task dispatch (the child
+                   really SIGKILLs itself mid-task; the pool classifies
+                   the exit as WorkerCrashed and the retry lands on a
+                   different worker)
+    worker-hang    parallel/workers.py, per task dispatch (the child
+                   suppresses heartbeats and wedges; the pool's liveness
+                   deadline detects the miss and kills the process)
+    worker-slow    parallel/workers.py, per task dispatch (the child
+                   stalls but keeps heartbeating: slow must never be
+                   mistaken for dead)
 
 Determinism: every decision is a pure function of (seed, site,
 occurrence-index) — the k-th evaluation of a site fires or not
@@ -65,7 +75,19 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 SITES = ("task-start", "shuffle-write", "shuffle-read", "ipc-decode",
          "mem-pressure", "device-collective", "device-loop", "admit",
          "cancel-race", "quota-breach", "pallas-kernel", "stream-epoch",
-         "checkpoint-commit")
+         "checkpoint-commit", "worker-crash", "worker-hang", "worker-slow")
+
+#: dynamically registered sites (register_site): rule validation accepts
+#: them alongside the static SITES tuple
+_extra_sites: set = set()
+
+
+def register_site(site: str) -> None:
+    """Escape hatch for sites created at runtime (plugins, tests):
+    parse_rules validates rule site names against SITES, and a
+    dynamically registered site must opt in here or its rules are
+    rejected as typos."""
+    _extra_sites.add(site)
 
 
 class InjectedFault(RuntimeError):
@@ -75,6 +97,28 @@ class InjectedFault(RuntimeError):
 
 class ShuffleChecksumError(IOError):
     """A shuffle/spill IPC frame failed its CRC32C verification."""
+
+
+class WorkerCrashed(RuntimeError):
+    """A pool worker process died (or missed its liveness deadline) while
+    running a task — the lost-executor analog.  Retryable: the task pool
+    re-dispatches the attempt, and the crashed worker's id rides along so
+    the retry can land on a DIFFERENT worker."""
+
+    def __init__(self, worker_id: Optional[int] = None,
+                 exit_code: Optional[int] = None, reason: str = ""):
+        self.worker_id = worker_id
+        self.exit_code = exit_code
+        self.reason = reason
+        detail = []
+        if worker_id is not None:
+            detail.append(f"worker={worker_id}")
+        if exit_code is not None:
+            detail.append(f"exit={exit_code}")
+        if reason:
+            detail.append(reason)
+        super().__init__("worker crashed"
+                         + (f" ({', '.join(detail)})" if detail else ""))
 
 
 class FetchFailedError(RuntimeError):
@@ -103,9 +147,16 @@ def classify_exception(e: BaseException) -> str:
     must fail fast without burning retry budget."""
     if isinstance(e, FetchFailedError):
         return "fetch-failed"
-    if isinstance(e, (InjectedFault, ShuffleChecksumError, EOFError,
-                      ConnectionError, BrokenPipeError, InterruptedError)):
+    if isinstance(e, (InjectedFault, ShuffleChecksumError, WorkerCrashed,
+                      EOFError, ConnectionError, BrokenPipeError,
+                      InterruptedError)):
         return "retryable"
+    # a worker-side failure arrives re-raised in the parent as a proxy
+    # exception carrying the CHILD's classification verdict: honor it
+    # (the child saw the real type; the proxy is just a RuntimeError)
+    remote = getattr(e, "remote_classify", None)
+    if remote in ("retryable", "fetch-failed", "fatal"):
+        return remote
     if isinstance(e, (MemoryError, KeyboardInterrupt, SystemExit)):
         return "fatal"
     if isinstance(e, OSError):
@@ -201,8 +252,24 @@ class FaultInjector:
                     r.fires = 0
 
 
+def _check_site(site: str) -> str:
+    """A typo'd site name would silently never fire — the worst possible
+    chaos-rule failure mode (the soak 'passes' having injected nothing).
+    Fail loudly at parse time; register_site() is the escape hatch for
+    sites created at runtime."""
+    if site not in SITES and site not in _extra_sites:
+        raise ValueError(
+            f"unknown fault site {site!r}; known sites: "
+            f"{', '.join(SITES)}"
+            + (f"; registered: {', '.join(sorted(_extra_sites))}"
+               if _extra_sites else "")
+            + " (faults.register_site() declares dynamic sites)")
+    return site
+
+
 def parse_rules(spec: str) -> list:
-    """Parse the `auron.tpu.faults.rules` grammar into (site, kwargs)."""
+    """Parse the `auron.tpu.faults.rules` grammar into (site, kwargs).
+    Site names are validated against SITES (+ register_site entries)."""
     out = []
     for part in spec.split(","):
         part = part.strip()
@@ -218,12 +285,12 @@ def parse_rules(spec: str) -> list:
         if "@" in part:
             site, at_s = part.split("@", 1)
             at = tuple(int(x) for x in at_s.split("+"))
-            out.append((site.strip(), dict(at=at, times=times,
-                                           action=action)))
+            out.append((_check_site(site.strip()),
+                        dict(at=at, times=times, action=action)))
         elif "=" in part:
             site, p_s = part.split("=", 1)
-            out.append((site.strip(), dict(p=float(p_s), times=times,
-                                           action=action)))
+            out.append((_check_site(site.strip()),
+                        dict(p=float(p_s), times=times, action=action)))
         else:
             raise ValueError(f"bad fault rule {part!r} "
                              f"(want site=p or site@k)")

@@ -172,6 +172,16 @@ class QueryProfile:
                 f"recoveries={x.get('stage_recoveries', 0)} "
                 f"recovered_map_tasks={x.get('recovered_map_tasks', 0)} "
                 f"faults_injected={x.get('faults_injected', 0)}")
+        if any(x.get(k) for k in ("worker_tasks", "worker_crashes",
+                                  "worker_hangs", "worker_blacklisted")):
+            lines.append(
+                f"workers: tasks={x.get('worker_tasks', 0)} "
+                f"spawns={x.get('worker_spawns', 0)} "
+                f"crashes={x.get('worker_crashes', 0)} "
+                f"hangs={x.get('worker_hangs', 0)} "
+                f"restarts={x.get('worker_restarts', 0)} "
+                f"blacklisted={x.get('worker_blacklisted', 0)} "
+                f"cancels={x.get('worker_cancels', 0)}")
         if any(x.get(k) for k in ("shuffle_device_bytes",
                                   "shuffle_host_bytes",
                                   "shuffle_device_fallbacks")):

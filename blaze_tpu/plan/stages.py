@@ -27,6 +27,7 @@ Cutting rules:
 
 from __future__ import annotations
 
+import logging
 import os
 import tempfile
 import threading
@@ -39,6 +40,8 @@ import pyarrow as pa
 from blaze_tpu.bridge.metrics import MetricNode
 from blaze_tpu.bridge.resource import put_resource, remove_resource
 from blaze_tpu.faults import FetchFailedError
+
+log = logging.getLogger("blaze_tpu.stages")
 
 _SCAN_KINDS = ("parquet_scan", "orc_scan")
 
@@ -177,6 +180,12 @@ class DagScheduler:
         # recovered map task's fresh output is what the retried reduce
         # task fetches — never a stale snapshot of the poisoned one.
         self._stage_outputs: Dict[int, Dict[int, tuple]] = {}
+        # (sid, map_id) -> pool worker id that produced the committed
+        # output (None on the in-process path).  A worker crash
+        # re-validates exactly these entries; validation failure marks
+        # the table entry None, which blocks_for converts into the
+        # FetchFailedError the lineage recovery already handles.
+        self._map_worker: Dict[tuple, Optional[int]] = {}
         # (sid, map_id) -> times the task body ran; lineage-recovery
         # tests assert exactly ONE map task re-ran after a poisoned block
         self.task_runs: Dict[tuple, int] = {}
@@ -333,14 +342,14 @@ class DagScheduler:
 
     # -- execution ---------------------------------------------------------
 
-    def _run_tasks(self, fn, n: int, what: str) -> List[Any]:
+    def _run_tasks(self, fn, n: int, what: str, remote=None) -> List[Any]:
         from blaze_tpu.bridge.tasks import default_task_parallelism, run_tasks
         # host placement caps slots harder than the executor-size knob:
         # serial tasks around intra-op-parallel C++ kernels beat
         # GIL-contended task concurrency (see default_task_parallelism)
         workers = min(self._par, default_task_parallelism(n))
         return run_tasks(fn, n, self._timeout, what, max_workers=workers,
-                         query=self._query)
+                         query=self._query, remote=remote)
 
     def _note_placement(self, sid: int, exchange: str,
                         loop_before: int) -> None:
@@ -364,6 +373,19 @@ class DagScheduler:
     def _map_data_path(self, sid: int, m: int) -> str:
         return os.path.join(self._dir, f"s{self._run_id}-{sid}-{m}.data")
 
+    def _map_task_def(self, stage: Stage, part: Dict[str, Any],
+                      m: int) -> Dict[str, Any]:
+        """The self-contained shuffle-writer TaskDefinition for one map
+        task — everything a worker PROCESS needs (absolute file paths,
+        the per-task plan slice), no scheduler state."""
+        data = self._map_data_path(stage.sid, m)
+        plan = {"kind": "shuffle_writer", "partitioning": part,
+                "data_file": data,
+                "index_file": data[:-5] + ".index",
+                "input": self._per_task(stage.plan, m, stage.num_tasks)}
+        return {"stage_id": stage.sid, "partition_id": m,
+                "num_partitions": stage.num_tasks, "plan": plan}
+
     def _run_map_task(self, stage: Stage, part: Dict[str, Any],
                       m: int) -> None:
         """One producer map task: stage plan -> shuffle_writer ->
@@ -371,14 +393,7 @@ class DagScheduler:
         recovery re-run atomically replaces the poisoned output)."""
         from blaze_tpu.bridge.runtime import NativeExecutionRuntime
         from blaze_tpu.plan.proto_serde import task_definition_to_bytes
-        data = self._map_data_path(stage.sid, m)
-        plan = {"kind": "shuffle_writer", "partitioning": part,
-                "data_file": data,
-                "index_file": data[:-5] + ".index",
-                "input": self._per_task(stage.plan, m, stage.num_tasks)}
-        td = task_definition_to_bytes(
-            {"stage_id": stage.sid, "partition_id": m,
-             "num_partitions": stage.num_tasks, "plan": plan})
+        td = task_definition_to_bytes(self._map_task_def(stage, part, m))
         rt = NativeExecutionRuntime(td).start()
         try:
             for _ in rt.batches():
@@ -388,6 +403,110 @@ class DagScheduler:
         with self._metrics_lock:
             self.task_runs[(stage.sid, m)] = \
                 self.task_runs.get((stage.sid, m), 0) + 1
+            self._map_worker[(stage.sid, m)] = None
+
+    @staticmethod
+    def _reader_rids(d) -> set:
+        """Every stage:// shuffle resource an ipc_reader in this plan
+        slice will resolve at execute time."""
+        rids: set = set()
+        if isinstance(d, dict):
+            rid = d.get("resource_id")
+            if d.get("kind") == "ipc_reader" and isinstance(rid, str) \
+                    and rid.startswith("stage://"):
+                rids.add(rid)
+            for v in d.values():
+                if isinstance(v, dict):
+                    rids |= DagScheduler._reader_rids(v)
+                elif isinstance(v, list):
+                    for x in v:
+                        rids |= DagScheduler._reader_rids(x)
+        return rids
+
+    def _shuffle_inputs(self, plan) -> Optional[Dict[str, list]]:
+        """MapOutputTracker analog: resolve every stage:// reader in a
+        per-task plan to its on-disk segment list, so a worker PROCESS
+        can read upstream shuffle output without the parent's resource
+        map.  {rid: [per-reduce-partition [(data, off, len, sid, mid)]]}.
+        None = some input is not file-backed (device or RSS shuffle
+        tier) and the task must stay in-process.  An invalidated map
+        output raises FetchFailedError here, at dispatch, exactly as
+        blocks_for would at read time."""
+        inputs: Dict[str, list] = {}
+        for rid in self._reader_rids(plan):
+            try:
+                up_sid = int(rid.rsplit("/", 1)[1])
+            except ValueError:
+                return None
+            outputs = dict(self._stage_outputs.get(up_sid) or {})
+            if not outputs:
+                return None  # device/RSS tier: blocks live in-process
+            n_out = None
+            for entry in outputs.values():
+                if entry is not None:
+                    n_out = len(entry[1]) - 1
+                    break
+            if n_out is None:
+                return None
+            parts = []
+            for p in range(n_out):
+                segs = []
+                for map_id in sorted(outputs):
+                    entry = outputs[map_id]
+                    if entry is None:
+                        raise FetchFailedError(
+                            up_sid, map_id,
+                            "map output invalidated after worker crash")
+                    data, offsets = entry
+                    length = offsets[p + 1] - offsets[p]
+                    if length:
+                        segs.append((data, int(offsets[p]), int(length),
+                                     up_sid, map_id))
+                parts.append(segs)
+            inputs[rid] = parts
+        return inputs
+
+    def _map_remote(self, stage: Stage, part: Dict[str, Any]):
+        """Worker-pool spec factory for this stage's map tasks, or None
+        when the pool is disabled (the in-process path stays the
+        default).  spec(m) is re-evaluated per ATTEMPT, so shuffle-input
+        locations are re-resolved after a lineage recovery round; it
+        returns None for a task whose inputs aren't shippable, which
+        falls that one task back in-process."""
+        from blaze_tpu import config
+        if not config.WORKERS_ENABLE.get():
+            return None
+
+        def spec(m: int) -> Optional[Dict[str, Any]]:
+            td = self._map_task_def(stage, part, m)
+            si = self._shuffle_inputs(td["plan"]["input"])
+            if si is None:
+                return None
+            if si:
+                td["shuffle_inputs"] = si
+            return {"fn": "blaze_tpu.parallel.workers:run_shuffle_map_task",
+                    "args": (td,)}
+        return spec
+
+    def _absorb_remote_results(self, stage: Stage, results,
+                               map_ids=None) -> None:
+        """Fold worker-process map-task results into scheduler state:
+        the metric tree rode the result frame home, and the producing
+        worker's id is remembered so a later crash of that worker can
+        re-validate exactly these outputs."""
+        if map_ids is None:
+            map_ids = range(len(results))
+        for m, res in zip(map_ids, results):
+            if not isinstance(res, dict):
+                continue  # in-process fallback already recorded itself
+            tree = res.get("metrics")
+            if tree:
+                self._record_task_metrics(stage.sid,
+                                          MetricNode.from_dict(tree))
+            with self._metrics_lock:
+                self.task_runs[(stage.sid, m)] = \
+                    self.task_runs.get((stage.sid, m), 0) + 1
+                self._map_worker[(stage.sid, m)] = res.get("_worker_id")
 
     def _read_map_output(self, stage: Stage, m: int, n_out: int) -> tuple:
         """Validated (data_file, offsets) for one map output; a bad index
@@ -709,9 +828,11 @@ class DagScheduler:
         with tracing.span("shuffle_exchange", stage=stage.sid,
                           tasks=stage.num_tasks,
                           partitioning=part["kind"]):
-            self._run_tasks(lambda m: self._run_map_task(stage, part, m),
-                            stage.num_tasks,
-                            f"stage {stage.sid} (shuffle write)")
+            results = self._run_tasks(
+                lambda m: self._run_map_task(stage, part, m),
+                stage.num_tasks, f"stage {stage.sid} (shuffle write)",
+                remote=self._map_remote(stage, part))
+        self._absorb_remote_results(stage, results)
         self._note_placement(stage.sid, "file", loop_before)
 
         self._stage_outputs[stage.sid] = {
@@ -730,7 +851,14 @@ class DagScheduler:
             # deterministic across recovery rounds
             outputs = self._stage_outputs[sid]
             for map_id in sorted(outputs):
-                data, offsets = outputs[map_id]
+                entry = outputs[map_id]
+                if entry is None:
+                    # invalidated after a worker crash: the producer
+                    # must re-run before any reduce reads this slot
+                    raise FetchFailedError(
+                        sid, map_id,
+                        "map output invalidated after worker crash")
+                data, offsets = entry
                 length = offsets[reduce_id + 1] - offsets[reduce_id]
                 if length:
                     yield FileSegmentBlock(data, offsets[reduce_id],
@@ -758,14 +886,52 @@ class DagScheduler:
         with tracing.span("stage_recovery", stage=ff.stage_id,
                           map_task=ff.map_id):
             # through the task pool: the re-run gets the same bounded
-            # retry/backoff as any task (transient faults may still fire)
-            self._run_tasks(
+            # retry/backoff as any task (transient faults may still
+            # fire), and under the worker pool it is process-isolated
+            # like any other map task
+            remote = self._map_remote(stage, part)
+            results = self._run_tasks(
                 lambda _i: self._run_map_task(stage, part, ff.map_id), 1,
-                f"stage {ff.stage_id} recovery (map {ff.map_id})")
+                f"stage {ff.stage_id} recovery (map {ff.map_id})",
+                remote=(lambda _i: remote(ff.map_id)) if remote else None)
+            self._absorb_remote_results(stage, results,
+                                        map_ids=[ff.map_id])
             self._stage_outputs[stage.sid][ff.map_id] = \
                 self._read_map_output(stage, ff.map_id,
                                       int(part.get("num_partitions", 1)))
         xla_stats.note_stage_recovery(1)
+
+    def invalidate_worker_outputs(self, worker_id) -> None:
+        """WorkerPool crash listener: re-validate every committed map
+        output the dead worker produced.  Committed outputs are FILES
+        (tmp + os.replace), so unlike an executor's in-memory block
+        store they normally survive the process — but a crash wedged
+        between the .data and .index commits (or mid-rename) leaves a
+        torn pair.  Anything that fails validation is marked None in
+        the map-output table; blocks_for converts that into the
+        FetchFailedError the lineage recovery loop already handles, so
+        ONLY the poisoned producers re-run."""
+        if worker_id is None:
+            return
+        with self._metrics_lock:
+            owned = [key for key, w in self._map_worker.items()
+                     if w == worker_id]
+        if not owned:
+            return
+        stages_by_id = {st.sid: st for st in self.stages}
+        for sid, m in owned:
+            stage = stages_by_id.get(sid)
+            outputs = self._stage_outputs.get(sid)
+            if stage is None or outputs is None or m not in outputs \
+                    or outputs[m] is None:
+                continue
+            n_out = int(self._part_of(stage).get("num_partitions", 1))
+            try:
+                outputs[m] = self._read_map_output(stage, m, n_out)
+            except FetchFailedError:
+                outputs[m] = None
+                log.warning("stage %d map %d output invalidated after "
+                            "worker %s crash", sid, m, worker_id)
 
     # -- AQE small-query fast path -----------------------------------------
 
@@ -842,6 +1008,16 @@ class DagScheduler:
         stages = self.split(plan)
         stages_by_id = {st.sid: st for st in stages}
         max_recoveries = max(0, config.STAGE_MAX_RECOVERIES.get())
+        # under the worker pool, a crashed worker's committed outputs
+        # are re-validated immediately (invalidate_worker_outputs) so a
+        # torn commit surfaces as lineage recovery, not a bad read
+        crash_pool = None
+        if config.WORKERS_ENABLE.get():
+            from blaze_tpu.parallel import workers as _workers
+            crash_pool = _workers.get_pool()
+            if crash_pool is not None:
+                crash_pool.add_crash_listener(
+                    self.invalidate_worker_outputs)
         try:
             result = stages[-1]
             out_schema = schema_from_dict(result.out_schema).to_arrow()
@@ -894,6 +1070,9 @@ class DagScheduler:
                 return out_schema.empty_table()
             return pa.Table.from_batches(batches)
         finally:
+            if crash_pool is not None:
+                crash_pool.remove_crash_listener(
+                    self.invalidate_worker_outputs)
             self.cleanup()
 
     def cleanup(self) -> None:
@@ -911,6 +1090,7 @@ class DagScheduler:
             files, self._files = self._files, []
             rss_clients, self._rss_clients = self._rss_clients, []
             self._stage_outputs = {}
+            self._map_worker = {}
         for rid in resources:
             try:
                 remove_resource(rid)
