@@ -1561,6 +1561,35 @@ def chaos_bench_main() -> int:
 # --workers: process-isolated worker-pool crash soak (ISSUE 11)
 # ===========================================================================
 
+def _pctl(vals, q: float):
+    """Nearest-rank percentile of `vals` (q in [0, 1]); None when empty."""
+    if not vals:
+        return None
+    import math
+    s = sorted(vals)
+    return s[max(0, min(len(s) - 1, int(math.ceil(q * len(s))) - 1))]
+
+
+def _duration_mark():
+    """Length markers into the xla_stats duration reservoirs, so a leg
+    can slice out exactly its own task/wave samples afterwards."""
+    from blaze_tpu.bridge import xla_stats
+    d = xla_stats.duration_samples()
+    return len(d["task_ns"]), len(d["wave_ns"])
+
+
+def _durations_since(mark):
+    from blaze_tpu.bridge import xla_stats
+    d = xla_stats.duration_samples()
+    return d["task_ns"][mark[0]:], d["wave_ns"][mark[1]:]
+
+
+def _task_pctls_ms(task_ns) -> dict:
+    return {"p50": round((_pctl(task_ns, 0.50) or 0) / 1e6, 3),
+            "p99": round((_pctl(task_ns, 0.99) or 0) / 1e6, 3),
+            "samples": len(task_ns)}
+
+
 def workers_bench_main() -> int:
     """Worker-pool crash soak (`--workers`): route staged task execution
     through the process-isolated worker pool and kill it, repeatedly.
@@ -1663,6 +1692,7 @@ def workers_bench_main() -> int:
                                                        base_walls):
                 faults.configure(rules, seed=seed)
                 before = xla_stats.snapshot()
+                dmark = _duration_mark()
                 sched = DagScheduler(
                     work_dir=os.path.join(d, qname, "chaos"))
                 t0 = time.perf_counter()
@@ -1694,6 +1724,8 @@ def workers_bench_main() -> int:
                     "stage_recoveries": int(ds["stage_recoveries"]),
                     "recovered_map_tasks":
                         int(ds["recovered_map_tasks"]),
+                    "task_duration_ms":
+                        _task_pctls_ms(_durations_since(dmark)[0]),
                     "leaked": n_leaked,
                     "site_stats": inj_stats,
                 })
@@ -1705,6 +1737,7 @@ def workers_bench_main() -> int:
             config.conf.set(config.WORKERS_CRASH_BUDGET.key, 0)
             faults.configure("worker-crash@1", seed=seed)
             before = xla_stats.snapshot()
+            dmark = _duration_mark()
             sched = DagScheduler(work_dir=os.path.join(d, "blacklist"))
             try:
                 got = sched.run_collect(plans[0][1])
@@ -1727,6 +1760,8 @@ def workers_bench_main() -> int:
                 "worker_crashes": int(ds["worker_crashes"]),
                 "worker_blacklisted": int(ds["worker_blacklisted"]),
                 "blacklisted_workers": black,
+                "task_duration_ms":
+                    _task_pctls_ms(_durations_since(dmark)[0]),
                 "health": health,
             }
             config.conf.set(config.WORKERS_CRASH_BUDGET.key, -1)
@@ -1738,6 +1773,7 @@ def workers_bench_main() -> int:
                                         "8"))
             faults.configure("worker-crash@2", seed=seed)
             before = xla_stats.snapshot()
+            dmark = _duration_mark()
             svc = QueryService(max_concurrent=n_conc,
                                max_queue=4 * n_conc,
                                tenant_max_inflight=4 * n_conc)
@@ -1776,6 +1812,8 @@ def workers_bench_main() -> int:
                 "worker_crashes": int(ds["worker_crashes"]),
                 "worker_restarts": int(ds["worker_restarts"]),
                 "task_retries": int(ds["task_retries"]),
+                "task_duration_ms":
+                    _task_pctls_ms(_durations_since(dmark)[0]),
             }
     finally:
         faults.clear()
@@ -1817,6 +1855,335 @@ def workers_bench_main() -> int:
           and len(blacklist.get("blacklisted_workers", [])) >= 1
           and serve.get("failed", 1) == 0
           and serve.get("completed", 0) == serve.get("submitted", -1))
+    return 0 if ok else 1
+
+
+# ===========================================================================
+# --speculate: quantile-driven straggler hedging soak (ISSUE 12)
+# ===========================================================================
+
+def speculate_bench_main() -> int:
+    """Speculation soak (`--speculate`): prove quantile-driven straggler
+    hedging wins back tail latency without ever double-counting output.
+    Legs, every result compared bit for bit against a fault-free
+    in-process baseline:
+
+      off   q01/q06/q95 through the worker pool under `worker-slow`
+            chaos (a firing task stalls FAULTS_WORKER_SLOW_MS while
+            alive), speculation DISABLED: stragglers run to completion
+            and dominate the wave wall.
+      on    identical seed/rules with speculation ENABLED: once the
+            quantile share of a wave finishes, a straggler gets a
+            duplicate attempt on a different worker; first commit wins.
+            p99 wave wall must come in BELOW the off leg, with zero
+            divergent queries and zero duplicate output blocks.
+      race  `speculation-loser-commit-race=1.0` forces a winning
+            attempt to SKIP cancelling its loser, so both race the
+            commit on all three tiers — file (claim + one os.replace of
+            the index), RSS with hardlinks, RSS claim-file fallback —
+            and the late loser must be rejected on every one.
+
+    Writes BENCH_SPECULATE.json and prints it as one JSON line."""
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+    import tempfile
+    import threading
+
+    from blaze_tpu import config, faults
+    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.itest import generate
+    from blaze_tpu.itest.queries import QUERIES
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.itest.tpcds_data import write_parquet_splits
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.parallel import workers
+    from blaze_tpu.plan.stages import DagScheduler
+
+    seed = int(os.environ.get("BLAZE_BENCH_SPECULATE_SEED", "1234"))
+    names = os.environ.get("BLAZE_BENCH_SPECULATE_QUERIES",
+                           "q01,q06,q95").split(",")
+    scale = float(os.environ.get("BLAZE_BENCH_SPECULATE_SCALE", "0.2"))
+    rules = os.environ.get("BLAZE_BENCH_SPECULATE_RULES",
+                           "worker-slow=0.2")
+    reps = int(os.environ.get("BLAZE_BENCH_SPECULATE_REPS", "3"))
+
+    MemManager.init(4 << 30)
+    # staged wire path on; CONCURRENT host dispatch (4 slot-waiter
+    # threads) — with the default serial host dispatch a slow first
+    # task blocks its siblings, the quantile trigger never arms, and
+    # there is nothing to hedge.  4 pool workers leave spare capacity
+    # for duplicates even when a sibling stage holds slots (q01 runs
+    # two producer stages concurrently).  The slow fault's stall is
+    # raised to 1500ms so a hedged duplicate has real wall time to win
+    # back.  Quantile 0.25 arms the trigger off a wave's single fastest
+    # task (waves are 4 wide; a wave with 3 stragglers must still arm),
+    # while min runtime 400ms keeps the cutoff above the per-worker
+    # per-stage XLA compile (~150-350ms a duplicate pays when it lands
+    # on a worker that hasn't seen that stage's kernel) so only genuine
+    # stalls hedge — a low cutoff duplicates healthy tasks and the
+    # wasted dispatches eat the slots a real straggler's re-hedge needs.
+    knobs = {config.DAG_SINGLE_TASK_BYTES.key: 0,
+             config.TASK_RETRY_BACKOFF_MS.key: 5,
+             config.TASK_MAX_ATTEMPTS.key: 6,
+             config.STAGE_MAX_RECOVERIES.key: 8,
+             config.HOST_TASK_PARALLELISM.key: 4,
+             # executor sizing is cores-derived and collapses to 1 slot
+             # on small CI hosts, which would serialize the stalls and
+             # starve the trigger; pool tasks just wait on a child, so
+             # 4 waiter threads are cheap regardless of cores
+             config.TOKIO_WORKER_THREADS_PER_CPU.key: 8,
+             # two more workers than the wave is wide: hedges need idle
+             # slots at the exact moment the primaries are stalled
+             config.WORKERS_COUNT.key: 6,
+             config.WORKERS_HEARTBEAT_MS.key: 25,
+             config.WORKERS_LIVENESS_MS.key: 2500,
+             config.WORKERS_RESTART_BACKOFF_MS.key: 10,
+             config.WORKERS_CRASH_BUDGET.key: -1,
+             config.FAULTS_WORKER_SLOW_MS.key: 1500,
+             config.SPECULATION_QUANTILE.key: 0.25,
+             config.SPECULATION_MULTIPLIER.key: 2.0,
+             config.SPECULATION_MIN_MS.key: 400}
+    for k, v in knobs.items():
+        config.conf.set(k, v)
+
+    def frame(tbl):
+        import pandas as pd
+        return tbl.to_pandas() if tbl.num_rows else pd.DataFrame(
+            {n: [] for n in tbl.schema.names})
+
+    diverged = 0
+    leaked = 0
+    legs: dict = {}
+    race: dict = {}
+    try:
+        with tempfile.TemporaryDirectory(prefix="speculate-") as d:
+            # corpus + fault-free in-process baselines
+            plans, bases = [], []
+            config.conf.set(config.WORKERS_ENABLE.key, "off")
+            config.conf.set(config.SPECULATION_ENABLE.key, "off")
+            for qname in names:
+                qname = qname.strip()
+                builder, table_names = QUERIES[qname]
+                tables = generate(table_names, scale=scale)
+                paths = write_parquet_splits(
+                    tables, os.path.join(d, qname), 4)
+                plan_dict, _oracle = builder(paths, tables, 4)
+                plans.append((qname, plan_dict))
+                bases.append(frame(DagScheduler(
+                    work_dir=os.path.join(d, qname, "base"))
+                    .run_collect(plan_dict)))
+
+            # --- off/on legs: identical seeds and chaos, speculation
+            # toggled — the wave-wall tail is the thing under test
+            config.conf.set(config.WORKERS_ENABLE.key, "on")
+            for leg in ("off", "on"):
+                workers.shutdown_pool(wait=False)
+                config.conf.set(config.SPECULATION_ENABLE.key, leg)
+                # warm the fresh pool's workers fault-free first: the
+                # first task in each child pays backend init + compile
+                # (~seconds), and that cold-start wave would drown the
+                # 400ms straggler signal the legs are comparing.  Two
+                # concurrent rounds per query keep every pool slot busy
+                # at once so ALL workers warm, not just the first four —
+                # a hedge landing on a cold worker would pay the init
+                # cost mid-measurement
+                for (qname, plan_dict), base in zip(plans, bases):
+                    rounds = []
+                    for w in range(2):
+                        sched = DagScheduler(work_dir=os.path.join(
+                            d, qname, f"warm-{leg}-{w}"))
+                        rounds.append(threading.Thread(
+                            target=sched.run_collect, args=(plan_dict,)))
+                    for t in rounds:
+                        t.start()
+                    for t in rounds:
+                        t.join()
+                before = xla_stats.snapshot()
+                dmark = _duration_mark()
+                wall_s = 0.0
+                leg_div = 0
+                for rep in range(reps):
+                    for (qname, plan_dict), base in zip(plans, bases):
+                        faults.configure(rules, seed=seed + rep)
+                        sched = DagScheduler(work_dir=os.path.join(
+                            d, qname, f"{leg}{rep}"))
+                        t0 = time.perf_counter()
+                        try:
+                            got = sched.run_collect(plan_dict)
+                        finally:
+                            faults.clear()
+                        wall_s += time.perf_counter() - t0
+                        if compare_frames(frame(got), base) is not None:
+                            leg_div += 1
+                        leaks = sched.leak_report()
+                        leaked += sum(len(v) for v in leaks.values())
+                ds = xla_stats.delta(before)
+                task_ns, wave_ns = _durations_since(dmark)
+                diverged += leg_div
+                legs[leg] = {
+                    "queries": [q for q, _ in plans],
+                    "reps": reps,
+                    "wall_s": round(wall_s, 4),
+                    "divergent": leg_div,
+                    "wave_wall_ms": {
+                        "p50": round((_pctl(wave_ns, 0.50) or 0) / 1e6, 3),
+                        "p99": round((_pctl(wave_ns, 0.99) or 0) / 1e6, 3),
+                        "samples": len(wave_ns)},
+                    "task_duration_ms": _task_pctls_ms(task_ns),
+                    "worker_tasks": int(ds["worker_tasks"]),
+                    "task_retries": int(ds["task_retries"]),
+                    "speculation_waves": int(ds["speculation_waves"]),
+                    "speculation_attempts":
+                        int(ds["speculation_attempts"]),
+                    "speculation_wins": int(ds["speculation_wins"]),
+                    "speculation_losers_cancelled":
+                        int(ds["speculation_losers_cancelled"]),
+                    "speculation_duplicate_commits":
+                        int(ds["speculation_duplicate_commits"]),
+                }
+
+            # --- race leg: force the winner to skip cancelling its
+            # loser, so BOTH attempts reach the commit point on every
+            # tier; the commit arbitration must reject the late one
+            workers.shutdown_pool(wait=False)
+            config.conf.set(config.WORKERS_ENABLE.key, "off")
+            config.conf.set(config.SPECULATION_ENABLE.key, "on")
+            config.conf.set(config.SPECULATION_MULTIPLIER.key, 1.0)
+            config.conf.set(config.SPECULATION_MIN_MS.key, 20)
+
+            # (a) file tier, through the LIVE wave loop: the straggler's
+            # primary attempt stalls long enough for the duplicate to
+            # promote first, then promotes its own attempt-suffixed
+            # output — and must lose the claim
+            from blaze_tpu.bridge.tasks import run_tasks
+            from blaze_tpu.shuffle.writer import promote_attempt_output, \
+                resolve_attempt_data
+            fbase = os.path.join(d, "race-file-0-0")
+            outcomes: dict = {}
+            olock = threading.Lock()
+
+            def race_fn(i: int):
+                if i != 3:
+                    time.sleep(0.02)
+                    return i
+                with olock:
+                    att = outcomes.setdefault("calls", 0)
+                    outcomes["calls"] = att + 1
+                if att == 0:
+                    time.sleep(0.7)  # primary straggles past the dup
+                data = f"{fbase}.a{att}.data"
+                index = f"{fbase}.a{att}.index"
+                with open(data, "wb") as f:
+                    f.write(b"payload-a%d" % att)
+                with open(index, "wb") as f:
+                    f.write(b"index-a%d" % att)
+                won = promote_attempt_output(data, index)
+                with olock:
+                    outcomes[att] = won
+                return i
+
+            before = xla_stats.snapshot()
+            faults.configure("speculation-loser-commit-race=1.0",
+                             seed=seed)
+            try:
+                run_tasks(race_fn, 4, 30.0, "speculate race leg",
+                          max_workers=4)
+                # the un-cancelled loser finishes on its own clock
+                t_end = time.monotonic() + 10
+                while 0 not in outcomes and time.monotonic() < t_end:
+                    time.sleep(0.02)
+            finally:
+                faults.clear()
+            ds_race = xla_stats.delta(before)
+            _winner_data, winner_attempt = resolve_attempt_data(
+                f"{fbase}.data")
+            file_ok = (outcomes.get(1) is True
+                       and outcomes.get(0) is False
+                       and winner_attempt == 1
+                       and not os.path.exists(f"{fbase}.a0.data")
+                       and not os.path.exists(f"{fbase}.a0.index"))
+
+            # (b)+(c) RSS tier: two attempts of the same map race
+            # mapper_end; first commit wins on BOTH storage flavors
+            from blaze_tpu.shuffle.rss import RssPushClient
+
+            def rss_race(tag: str, use_hardlinks: bool) -> bool:
+                client = RssPushClient(os.path.join(d, f"race-{tag}"),
+                                       "race", 1, 1,
+                                       use_hardlinks=use_hardlinks)
+                try:
+                    w0 = client.partition_writer(0, attempt=0)
+                    w0(0, b"attempt0-frame")
+                    w1 = client.partition_writer(0, attempt=1)
+                    w1(0, b"attempt1-frame")
+                    first = w0.commit()
+                    second = w1.commit()
+                    blocks = client.reader_blocks(0, timeout_s=2.0)
+                    return (first is True and second is False
+                            and blocks == [b"attempt0-frame"])
+                finally:
+                    client.cleanup()
+
+            rss_link_ok = rss_race("hardlink", use_hardlinks=True)
+            rss_claim_ok = rss_race("claim", use_hardlinks=False)
+            race = {
+                "rules": "speculation-loser-commit-race=1.0",
+                "file_tier_loser_rejected": file_ok,
+                "rss_hardlink_loser_rejected": rss_link_ok,
+                "rss_claim_loser_rejected": rss_claim_ok,
+                "commit_races_forced":
+                    int(ds_race["speculation_commit_races"]),
+                "loser_commits_rejected":
+                    int(ds_race["speculation_loser_commits_rejected"]),
+                "duplicate_commits":
+                    int(ds_race["speculation_duplicate_commits"]),
+            }
+    finally:
+        faults.clear()
+        workers.shutdown_pool(wait=False)
+        config.conf.unset(config.WORKERS_ENABLE.key)
+        config.conf.unset(config.SPECULATION_ENABLE.key)
+        for k in knobs:
+            config.conf.unset(k)
+
+    p99_off = legs.get("off", {}).get("wave_wall_ms", {}).get("p99") or 0
+    p99_on = legs.get("on", {}).get("wave_wall_ms", {}).get("p99") or 0
+    dup_blocks = (legs.get("on", {})
+                  .get("speculation_duplicate_commits", 0)
+                  + race.get("duplicate_commits", 0))
+    reduction = (1.0 - p99_on / p99_off) if p99_off else 0.0
+    rec = {
+        "metric": "speculation_p99_wave_wall_reduction",
+        "value": round(reduction, 4),
+        "unit": "fraction",
+        "seed": seed,
+        "rules": rules,
+        "scale": scale,
+        "p99_wave_wall_ms_off": p99_off,
+        "p99_wave_wall_ms_on": p99_on,
+        "divergent_queries": diverged,
+        "duplicate_output_blocks": dup_blocks,
+        "leaked": leaked,
+        "legs": legs,
+        "race": race,
+    }
+    path = os.environ.get(
+        "BLAZE_BENCH_SPECULATE_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_SPECULATE.json"))
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, default=str)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+    ok = (diverged == 0 and leaked == 0 and dup_blocks == 0
+          and p99_off > 0 and p99_on < p99_off
+          and legs.get("on", {}).get("speculation_wins", 0) >= 1
+          and race.get("file_tier_loser_rejected") is True
+          and race.get("rss_hardlink_loser_rejected") is True
+          and race.get("rss_claim_loser_rejected") is True
+          and race.get("commit_races_forced", 0) >= 1)
     return 0 if ok else 1
 
 
@@ -3141,6 +3508,8 @@ def main():
         sys.exit(chaos_bench_main())
     if "--workers" in sys.argv:
         sys.exit(workers_bench_main())
+    if "--speculate" in sys.argv:
+        sys.exit(speculate_bench_main())
     if "--serve" in sys.argv:
         sys.exit(serve_bench_main())
     if "--aggskip" in sys.argv:

@@ -84,6 +84,36 @@ class task_scope:
         return False
 
 
+_attempt_local = threading.local()
+
+
+def current_attempt_token():
+    """The speculative-attempt cancel token (threading.Event) bound to
+    this thread, or None.  NativeExecutionRuntime reads it at TaskContext
+    creation (like current_query) so a losing attempt's check_running()
+    raises TaskKilledError as soon as the sibling commits."""
+    return getattr(_attempt_local, "token", None)
+
+
+class attempt_scope:
+    """`with attempt_scope(event):` — binds a per-attempt cancel token
+    to this thread.  Accepts None (no-op binding); restores the previous
+    binding on exit."""
+
+    def __init__(self, token):
+        self._token = token
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_attempt_local, "token", None)
+        _attempt_local.token = self._token
+        return self._token
+
+    def __exit__(self, *exc):
+        _attempt_local.token = self._prev
+        return False
+
+
 _query_local = threading.local()
 
 

@@ -128,6 +128,10 @@ def prometheus_text() -> str:
         # process-isolated worker pool (parallel/workers.py): spawns,
         # shipped tasks, crash/hang/blacklist/cancel supervision events
         emit(f"blaze_{k}_total", v, "worker pool counter")
+    for k, v in xla_stats.speculation_stats().items():
+        # speculative execution (bridge/tasks.py): hedged waves/attempts,
+        # first-wins outcomes, rejected loser commits, forced races
+        emit(f"blaze_{k}_total", v, "speculative execution counter")
     mm = MemManager.get()
     emit("blaze_mem_spill_count_total", mm.total_spill_count,
          "memory-manager spills")

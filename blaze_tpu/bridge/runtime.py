@@ -52,6 +52,14 @@ class NativeExecutionRuntime:
             # service's query_scope: the query rides the TaskContext into
             # the producer/prefetch threads that re-enter via task_scope
             query=current_query())
+        from blaze_tpu.bridge.context import current_attempt_token
+        tok = current_attempt_token()
+        if tok is not None:
+            # speculative-attempt cancel token: when the sibling attempt
+            # commits first, check_running() turns into TaskKilledError
+            # at the next batch boundary and this attempt's output is
+            # discarded before it can reach a commit point
+            self.task.is_running = lambda: not tok.is_set()
         from blaze_tpu.plan.column_pruning import prune_columns
         from blaze_tpu.plan.planner import collapse_filter_project
         self.plan = fuse_plan(prune_columns(collapse_filter_project(

@@ -23,7 +23,7 @@ from __future__ import annotations
 import functools
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 _lock = threading.Lock()
 
@@ -127,6 +127,27 @@ _stream = {"stream_epochs": 0, "stream_epoch_wall_ns": 0,
 _workers = {"worker_spawns": 0, "worker_tasks": 0, "worker_crashes": 0,
             "worker_hangs": 0, "worker_restarts": 0,
             "worker_blacklisted": 0, "worker_cancels": 0}
+
+# Speculative-execution accounting (bridge/tasks.py wave loop,
+# shuffle/writer.py + shuffle/rss.py commit arbitration): waves that
+# hedged at least one straggler, duplicate attempts launched, duplicates
+# that won the first-wins commit, losers cancelled via the cooperative
+# token, forced commit races (the speculation-loser-commit-race site),
+# loser commits rejected at a shuffle tier, and double-accepts (must
+# stay 0 — the duplicate_output_blocks invariant the soak asserts).
+_speculation = {"speculation_waves": 0, "speculation_attempts": 0,
+                "speculation_wins": 0, "speculation_losers_cancelled": 0,
+                "speculation_commit_races": 0,
+                "speculation_loser_commits_rejected": 0,
+                "speculation_duplicate_commits": 0}
+
+# Bounded raw-sample reservoirs feeding tail-latency percentiles
+# (bench.py --workers / --speculate): successful task-attempt durations
+# and run_tasks wave walls, in ns.  Lists, so NOT folded into
+# snapshot()/delta() — read via duration_samples(), cleared by reset().
+_task_duration_ns: List[int] = []
+_wave_wall_ns: List[int] = []
+_SAMPLE_CAP = 8192
 
 # Distinct signatures beyond this on one kernel = shape churn (the
 # recompilation-storm smell: unpadded dynamic shapes hitting jit).
@@ -337,6 +358,52 @@ def note_worker_cancel() -> None:
 def worker_stats() -> dict:
     with _lock:
         return dict(_workers)
+
+
+def note_speculation(waves: int = 0, attempts: int = 0, wins: int = 0,
+                     losers_cancelled: int = 0, commit_races: int = 0,
+                     loser_commits_rejected: int = 0,
+                     duplicate_commits: int = 0) -> None:
+    """Speculative-execution events (bridge/tasks.py wave loop and the
+    per-tier commit arbitration in shuffle/writer.py, shuffle/rss.py)."""
+    with _lock:
+        _speculation["speculation_waves"] += waves
+        _speculation["speculation_attempts"] += attempts
+        _speculation["speculation_wins"] += wins
+        _speculation["speculation_losers_cancelled"] += losers_cancelled
+        _speculation["speculation_commit_races"] += commit_races
+        _speculation["speculation_loser_commits_rejected"] += \
+            loser_commits_rejected
+        _speculation["speculation_duplicate_commits"] += duplicate_commits
+
+
+def speculation_stats() -> dict:
+    with _lock:
+        return dict(_speculation)
+
+
+def note_task_duration(ns: int) -> None:
+    """One successful task attempt's wall time (speculation's straggler
+    cutoff and the bench's p50/p99 task percentiles feed from here)."""
+    with _lock:
+        if len(_task_duration_ns) < _SAMPLE_CAP:
+            _task_duration_ns.append(int(ns))
+
+
+def note_wave_wall(ns: int) -> None:
+    """One run_tasks wave's wall time, submit to last result."""
+    with _lock:
+        if len(_wave_wall_ns) < _SAMPLE_CAP:
+            _wave_wall_ns.append(int(ns))
+
+
+def duration_samples() -> Dict[str, List[int]]:
+    """Raw ns samples: {"task_ns": [...], "wave_ns": [...]}.  Bounded at
+    _SAMPLE_CAP each; callers slice by remembered length for per-leg
+    percentiles."""
+    with _lock:
+        return {"task_ns": list(_task_duration_ns),
+                "wave_ns": list(_wave_wall_ns)}
 
 
 def note_device_exchange(rows: int, nbytes: int,
@@ -596,6 +663,7 @@ def snapshot() -> dict:
     flat.update(scatter_lane_stats())
     flat.update(stream_stats())
     flat.update(worker_stats())
+    flat.update(speculation_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -629,4 +697,8 @@ def reset() -> None:
             _stream[k] = 0
         for k in _workers:
             _workers[k] = 0
+        for k in _speculation:
+            _speculation[k] = 0
+        _task_duration_ns.clear()
+        _wave_wall_ns.clear()
         _bucket_caps.clear()

@@ -494,10 +494,16 @@ FAULTS_RULES = str_conf(
     "raising).  Sites: task-start, shuffle-write, shuffle-read, "
     "ipc-decode, mem-pressure, device-collective, device-loop, admit, "
     "cancel-race, quota-breach, pallas-kernel, stream-epoch, "
-    "checkpoint-commit, worker-crash, worker-hang, worker-slow.  Site "
-    "names are validated at parse time (faults.register_site declares "
-    "dynamic sites).",
+    "checkpoint-commit, worker-crash, worker-hang, worker-slow, "
+    "speculation-loser-commit-race.  Site names are validated at parse "
+    "time (faults.register_site declares dynamic sites).",
     category="fault-tolerance")
+FAULTS_WORKER_SLOW_MS = int_conf(
+    "auron.tpu.faults.workerSlowMs", 50,
+    "Delay injected by a firing worker-slow fault site: the child "
+    "stalls this long while still heartbeating (slow != dead).  The "
+    "speculation soak raises it so a hedged duplicate has real wall "
+    "time to win back.", category="fault-tolerance")
 TASK_MAX_ATTEMPTS = int_conf(
     "auron.tpu.task.maxAttempts", 4,
     "Bounded per-task attempts for retryable failures (transient IO, "
@@ -556,6 +562,31 @@ WORKERS_DRAIN_MS = int_conf(
     "Graceful-drain budget at pool shutdown: workers get a shutdown "
     "message and this long to exit cleanly before SIGTERM, then "
     "SIGKILL.", category="fault-tolerance")
+SPECULATION_ENABLE = bool_conf(
+    "auron.tpu.speculation.enable", False,
+    "Speculative execution (the spark.speculation analog): once the "
+    "quantile share of a wave's tasks has finished, a task running "
+    "longer than multiplier x the wave's median successful duration "
+    "gets a duplicate attempt with a fresh attempt id; the first "
+    "attempt to commit wins and the loser is cancelled via the "
+    "cooperative token.  Off by default — with it off the wave loop "
+    "runs exactly one attempt per task.", category="fault-tolerance")
+SPECULATION_QUANTILE = float_conf(
+    "auron.tpu.speculation.quantile", 0.75,
+    "Share of a wave's tasks that must have finished before any "
+    "straggler is hedged (spark.speculation.quantile).",
+    category="fault-tolerance")
+SPECULATION_MULTIPLIER = float_conf(
+    "auron.tpu.speculation.multiplier", 1.5,
+    "A running task is a straggler when its elapsed time exceeds this "
+    "multiple of the wave's median successful task duration "
+    "(spark.speculation.multiplier).", category="fault-tolerance")
+SPECULATION_MIN_MS = int_conf(
+    "auron.tpu.speculation.minRuntimeMs", 100,
+    "Floor on the straggler cutoff: tasks are never speculated before "
+    "running at least this long, so sub-millisecond waves don't hedge "
+    "on scheduling noise (spark.speculation.minTaskRuntime).",
+    category="fault-tolerance")
 SHUFFLE_CHECKSUM_ENABLE = bool_conf(
     "auron.tpu.shuffle.checksum", True,
     "CRC32C checksum on every shuffle/spill IPC frame (4 bytes/frame, "

@@ -29,6 +29,9 @@ Sites (the code points that call in here):
                    cancel-vs-completion race window
     quota-breach   memory/manager.py, per quota evaluation (forces a
                    per-query quota breach → degradation rung)
+    pallas-kernel  kernels/lane.py, per lane-kernel invocation (forces
+                   the interpret/scatter fallback path; the engine must
+                   degrade, not diverge)
     stream-epoch   streaming/executor.py, at each micro-batch epoch
                    boundary (kills the epoch mid-flight; the stream
                    replays from the last committed checkpoint)
@@ -45,6 +48,11 @@ Sites (the code points that call in here):
     worker-slow    parallel/workers.py, per task dispatch (the child
                    stalls but keeps heartbeating: slow must never be
                    mistaken for dead)
+    speculation-loser-commit-race  bridge/tasks.py, when a winning
+                   attempt would cancel its speculative sibling
+                   (suppresses the cancel so BOTH attempts race the
+                   commit; every shuffle tier must reject the late
+                   loser)
 
 Determinism: every decision is a pure function of (seed, site,
 occurrence-index) — the k-th evaluation of a site fires or not
@@ -75,7 +83,8 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 SITES = ("task-start", "shuffle-write", "shuffle-read", "ipc-decode",
          "mem-pressure", "device-collective", "device-loop", "admit",
          "cancel-race", "quota-breach", "pallas-kernel", "stream-epoch",
-         "checkpoint-commit", "worker-crash", "worker-hang", "worker-slow")
+         "checkpoint-commit", "worker-crash", "worker-hang", "worker-slow",
+         "speculation-loser-commit-race")
 
 #: dynamically registered sites (register_site): rule validation accepts
 #: them alongside the static SITES tuple
@@ -121,6 +130,13 @@ class WorkerCrashed(RuntimeError):
                          + (f" ({', '.join(detail)})" if detail else ""))
 
 
+class TaskDeadlineExpired(TimeoutError):
+    """The wave deadline passed before (or while) an attempt could run.
+    Classified FATAL, not retryable: TimeoutError is an OSError subclass
+    and would otherwise look like transient IO, burning maxAttempts
+    backoff sleeps an already-expired task can never use."""
+
+
 class FetchFailedError(RuntimeError):
     """A shuffle block could not be read back intact (Spark's
     FetchFailedException analog).  Carries the lineage the scheduler
@@ -157,7 +173,8 @@ def classify_exception(e: BaseException) -> str:
     remote = getattr(e, "remote_classify", None)
     if remote in ("retryable", "fetch-failed", "fatal"):
         return remote
-    if isinstance(e, (MemoryError, KeyboardInterrupt, SystemExit)):
+    if isinstance(e, (MemoryError, KeyboardInterrupt, SystemExit,
+                      TaskDeadlineExpired)):
         return "fatal"
     if isinstance(e, OSError):
         return "retryable"  # transient filesystem/socket trouble

@@ -414,21 +414,42 @@ class WorkerPool:
         if faults.fires("worker-hang", what=what):
             d["hang_ms"] = self.liveness_ms * 10
         if faults.fires("worker-slow", what=what):
-            d["delay_ms"] = 50
+            from blaze_tpu import config
+            d["delay_ms"] = max(0, config.FAULTS_WORKER_SLOW_MS.get())
         return d
 
     def run(self, spec: Dict[str, Any], exclude: Optional[Set[int]] = None,
             timeout_s: Optional[float] = None, query=None,
-            what: str = "task") -> Any:
+            what: str = "task", cancel_event=None,
+            on_assign=None) -> Any:
         """Execute `spec` ({"fn": "module:qualname", "args": tuple}) on
         one worker and return its result.  Raises WorkerCrashed (with
         the dead worker's id) on crash/hang, TimeoutError past
-        `timeout_s`, the reconstructed task error otherwise."""
+        `timeout_s`, the reconstructed task error otherwise.
+
+        `cancel_event` is the speculative-attempt token: when set (a
+        sibling attempt committed first) the in-flight task is cancelled
+        like a deadline — stop escalation, no crash-budget charge — and
+        TaskKilledError is raised so the caller's retry loop treats the
+        attempt as dead rather than retryable.  `on_assign(worker_id)`
+        fires once the task is dispatched, letting the wave loop steer a
+        later duplicate attempt away from this worker."""
         from blaze_tpu import config
         from blaze_tpu.bridge import xla_stats
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
         slot = self._acquire(set(exclude or ()), deadline, query)
+        if cancel_event is not None and cancel_event.is_set():
+            # the sibling won while this attempt queued for a slot:
+            # hand the slot straight back instead of dispatching a
+            # task whose output is already dead
+            self._release(slot)
+            from blaze_tpu.bridge.context import TaskKilledError
+            raise TaskKilledError(
+                f"{what}: attempt cancelled before dispatch — a "
+                f"sibling attempt committed first")
+        if on_assign is not None:
+            on_assign(slot.id)
         incarnation = slot.incarnation
         inbox = slot.inbox
         proc = slot.proc
@@ -465,6 +486,20 @@ class WorkerPool:
             if query is not None and query.cancelled:
                 self._cancel_slot(slot, task_id)
                 query.check()
+            if cancel_event is not None and cancel_event.is_set():
+                # sibling attempt won the first-wins commit: ABANDON the
+                # attempt rather than killing the child.  The loser runs
+                # to completion in the worker (its late commit is
+                # rejected by the attempt arbitration on every shuffle
+                # tier) and the process keeps its warm backend + compile
+                # caches — killing it would make the next task on this
+                # slot pay a cold re-init costlier than the straggle
+                # being hedged.  No crash-budget charge.
+                self._abandon_slot(slot, task_id, incarnation)
+                from blaze_tpu.bridge.context import TaskKilledError
+                raise TaskKilledError(
+                    f"{what}: worker {slot.id} attempt cancelled — a "
+                    f"sibling attempt committed first")
             if deadline is not None and now >= deadline:
                 self._cancel_slot(slot, task_id)
                 raise TimeoutError(
@@ -479,6 +514,53 @@ class WorkerPool:
                             "%.2fs; killing", slot.id, slot.pid(),
                             now - slot.last_heartbeat)
                 self._kill(slot, signal.SIGKILL)
+
+    def _abandon_slot(self, slot: _Slot, task_id: int,
+                      incarnation: int) -> None:
+        """Detach from a speculative loser WITHOUT stopping the child:
+        a drainer thread babysits the slot until the task's result
+        frame arrives (discarded — first-wins already settled), then
+        releases it.  The slot stays _BUSY meanwhile so `_acquire`
+        cannot double-book the worker.  Liveness is still enforced: a
+        child that stops heartbeating mid-abandon is killed and takes
+        the normal crash path (with budget charge — it really died)."""
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_worker_cancel()
+        liveness_s = self.liveness_ms / 1e3
+
+        def drain() -> None:
+            while True:
+                if self.closed:
+                    return
+                try:
+                    item = slot.inbox.get(timeout=0.05)
+                except queue.Empty:
+                    item = _PENDING
+                if item is None:
+                    try:
+                        self._handle_crash(slot, incarnation, hang=slot.
+                                           hang_kill)
+                    except BaseException:
+                        pass
+                    return
+                if item is not _PENDING and isinstance(item, dict):
+                    if item.get("task_id") != task_id:
+                        continue
+                    try:
+                        self._finish(slot, item)
+                    except BaseException:
+                        pass  # the loser's result (or error) is dead
+                    return
+                if time.monotonic() - slot.last_heartbeat > liveness_s:
+                    with self._lock:
+                        slot.hang_kill = True
+                    log.warning("worker %d (pid %s) missed heartbeats "
+                                "while draining an abandoned attempt; "
+                                "killing", slot.id, slot.pid())
+                    self._kill(slot, signal.SIGKILL)
+
+        threading.Thread(target=drain, daemon=True,
+                         name=f"blaze-worker-{slot.id}-abandon").start()
 
     def _cancel_slot(self, slot: _Slot, task_id: int) -> None:
         """Deadline/cancel escalation.  If the process survived (it

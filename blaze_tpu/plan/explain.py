@@ -38,6 +38,29 @@ def _fmt_bytes(n: int) -> str:
     return f"{n}B"
 
 
+def format_speculation_footer(x) -> Optional[str]:
+    """The explain-analyze "speculation:" footer for one run's engine
+    stats, or None when no hedging (or rejected loser commit) happened
+    — speculation is off by default and the profile must stay
+    byte-identical then."""
+    if not any(x.get(k) for k in ("speculation_attempts",
+                                  "speculation_wins",
+                                  "speculation_loser_commits_rejected",
+                                  "speculation_commit_races")):
+        return None
+    return (
+        f"speculation: waves={x.get('speculation_waves', 0)} "
+        f"attempts={x.get('speculation_attempts', 0)} "
+        f"wins={x.get('speculation_wins', 0)} "
+        f"losers_cancelled="
+        f"{x.get('speculation_losers_cancelled', 0)} "
+        f"loser_commits_rejected="
+        f"{x.get('speculation_loser_commits_rejected', 0)} "
+        f"commit_races={x.get('speculation_commit_races', 0)} "
+        f"duplicate_commits="
+        f"{x.get('speculation_duplicate_commits', 0)}")
+
+
 def _node_line(node: MetricNode) -> str:
     v = node.values
     total = v.get("elapsed_compute_ns", 0)
@@ -182,6 +205,9 @@ class QueryProfile:
                 f"restarts={x.get('worker_restarts', 0)} "
                 f"blacklisted={x.get('worker_blacklisted', 0)} "
                 f"cancels={x.get('worker_cancels', 0)}")
+        spec_line = format_speculation_footer(x)
+        if spec_line is not None:
+            lines.append(spec_line)
         if any(x.get(k) for k in ("shuffle_device_bytes",
                                   "shuffle_host_bytes",
                                   "shuffle_device_fallbacks")):

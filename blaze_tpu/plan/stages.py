@@ -189,6 +189,13 @@ class DagScheduler:
         # (sid, map_id) -> times the task body ran; lineage-recovery
         # tests assert exactly ONE map task re-ran after a poisoned block
         self.task_runs: Dict[tuple, int] = {}
+        # speculation: monotone per-(sid, map) attempt-id allocator (each
+        # retry OR speculative duplicate gets a fresh id), and the table
+        # of WINNING attempt ids — lineage recovery and crash
+        # invalidation only ever deal with the committed winner
+        self._attempt_seq: Dict[tuple, int] = {}
+        self._map_attempt: Dict[tuple, int] = {}
+        self._attempt_lock = threading.Lock()
         # per-stage operator-metric trees, merged across that stage's
         # tasks at finalize time (the MetricsUpdater analog)
         self.stage_metrics: Dict[int, MetricNode] = {}
@@ -373,18 +380,40 @@ class DagScheduler:
     def _map_data_path(self, sid: int, m: int) -> str:
         return os.path.join(self._dir, f"s{self._run_id}-{sid}-{m}.data")
 
+    def _next_attempt(self, sid: int, m: int) -> int:
+        with self._attempt_lock:
+            a = self._attempt_seq.get((sid, m), 0)
+            self._attempt_seq[(sid, m)] = a + 1
+            return a
+
     def _map_task_def(self, stage: Stage, part: Dict[str, Any],
                       m: int) -> Dict[str, Any]:
         """The self-contained shuffle-writer TaskDefinition for one map
         task — everything a worker PROCESS needs (absolute file paths,
-        the per-task plan slice), no scheduler state."""
+        the per-task plan slice), no scheduler state.
+
+        With speculation enabled every invocation (first run, retry,
+        speculative duplicate, recovery re-run) writes under a FRESH
+        attempt-suffixed .data/.index pair; the writer's first-wins
+        promotion (shuffle.writer.promote_attempt_output) decides which
+        attempt owns the final unsuffixed index — ONE os.replace is the
+        commit, and the loser's files are discarded unread."""
+        from blaze_tpu import config
         data = self._map_data_path(stage.sid, m)
+        index = data[:-5] + ".index"
+        attempt = 0
+        if config.SPECULATION_ENABLE.get():
+            attempt = self._next_attempt(stage.sid, m)
+            base = data[:-5]
+            data = f"{base}.a{attempt}.data"
+            index = f"{base}.a{attempt}.index"
         plan = {"kind": "shuffle_writer", "partitioning": part,
                 "data_file": data,
-                "index_file": data[:-5] + ".index",
+                "index_file": index,
                 "input": self._per_task(stage.plan, m, stage.num_tasks)}
         return {"stage_id": stage.sid, "partition_id": m,
-                "num_partitions": stage.num_tasks, "plan": plan}
+                "num_partitions": stage.num_tasks,
+                "task_attempt_id": attempt, "plan": plan}
 
     def _run_map_task(self, stage: Stage, part: Dict[str, Any],
                       m: int) -> None:
@@ -511,15 +540,60 @@ class DagScheduler:
     def _read_map_output(self, stage: Stage, m: int, n_out: int) -> tuple:
         """Validated (data_file, offsets) for one map output; a bad index
         is re-raised carrying the producer's (stage, map) identity so the
-        recovery loop knows exactly which task to re-run."""
+        recovery loop knows exactly which task to re-run.
+
+        Under speculation the unsuffixed index is the COMMITTED winner's
+        (one os.replace promoted it) and the claim file names which
+        attempt's .data file backs it — resolve_attempt_data maps the
+        base path to the winner; without a claim (speculation off) the
+        base path IS the data file, byte-identical to the old behavior."""
         from blaze_tpu.shuffle.exchange import read_index_file
-        data = self._map_data_path(stage.sid, m)
+        from blaze_tpu.shuffle.writer import resolve_attempt_data
+        base = self._map_data_path(stage.sid, m)
+        data, attempt = resolve_attempt_data(base)
         try:
-            return data, read_index_file(data[:-5] + ".index",
-                                         expected_partitions=n_out,
-                                         data_file=data)
+            offsets = read_index_file(base[:-5] + ".index",
+                                      expected_partitions=n_out,
+                                      data_file=data)
         except FetchFailedError as e:
             raise FetchFailedError(stage.sid, m, e.reason) from e
+        with self._attempt_lock:
+            self._map_attempt[(stage.sid, m)] = attempt
+        return data, offsets
+
+    def _register_stage_files(self, sid: int) -> None:
+        """Sweep the scratch dir for this stage's files (attempt-suffixed
+        outputs, claim files, promoted indexes) into the cleanup list —
+        a losing speculative attempt's leftovers must not outlive the
+        scheduler even when the loser already unlinked its own pair."""
+        prefix = f"s{self._run_id}-{sid}-"
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith(prefix):
+                continue
+            p = os.path.join(self._dir, name)
+            if p not in self._files:
+                self._files.append(p)
+
+    def _clear_map_commit(self, sid: int, m: int) -> None:
+        """Un-commit one map output before a lineage-recovery re-run:
+        the committed winner's index is the poisoned block being
+        recovered, so the claim AND the promoted index must go — a
+        fresh attempt can then win the first-wins race cleanly.  A
+        no-op when no claim exists (speculation off: the recovery
+        re-run os.replaces the unsuffixed index in place, as always)."""
+        base = self._map_data_path(sid, m)
+        owner = base[:-5] + ".index.owner"
+        if not os.path.exists(owner):
+            return
+        for p in (owner, base[:-5] + ".index"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
     @staticmethod
     def _is_cancellation(e: BaseException) -> bool:
@@ -784,7 +858,12 @@ class DagScheduler:
                         pass
                 finally:
                     self._record_task_metrics(stage.sid, rt.finalize())
-                writer.commit()
+                if not writer.commit():
+                    # a sibling attempt already committed: this output
+                    # is dead (reject-late arbitration); the task still
+                    # succeeds — the winner's frames are what readers see
+                    from blaze_tpu.bridge import xla_stats as _xs
+                    _xs.note_speculation(loser_commits_rejected=1)
             finally:
                 remove_resource(rid)
             with self._metrics_lock:
@@ -828,10 +907,16 @@ class DagScheduler:
         with tracing.span("shuffle_exchange", stage=stage.sid,
                           tasks=stage.num_tasks,
                           partitioning=part["kind"]):
-            results = self._run_tasks(
-                lambda m: self._run_map_task(stage, part, m),
-                stage.num_tasks, f"stage {stage.sid} (shuffle write)",
-                remote=self._map_remote(stage, part))
+            try:
+                results = self._run_tasks(
+                    lambda m: self._run_map_task(stage, part, m),
+                    stage.num_tasks, f"stage {stage.sid} (shuffle write)",
+                    remote=self._map_remote(stage, part))
+            finally:
+                # attempt-suffixed outputs, claim files and a late
+                # loser's leftovers all join the cleanup list even when
+                # the wave itself failed
+                self._register_stage_files(stage.sid)
         self._absorb_remote_results(stage, results)
         self._note_placement(stage.sid, "file", loop_before)
 
@@ -885,15 +970,25 @@ class DagScheduler:
         part = self._part_of(stage)
         with tracing.span("stage_recovery", stage=ff.stage_id,
                           map_task=ff.map_id):
+            # the poisoned block IS the committed winner: clear its
+            # commit claim first so the recovery re-run's fresh attempt
+            # can win the first-wins arbitration (also heals a torn
+            # claim-without-index crash window)
+            self._clear_map_commit(stage.sid, ff.map_id)
             # through the task pool: the re-run gets the same bounded
             # retry/backoff as any task (transient faults may still
             # fire), and under the worker pool it is process-isolated
             # like any other map task
             remote = self._map_remote(stage, part)
-            results = self._run_tasks(
-                lambda _i: self._run_map_task(stage, part, ff.map_id), 1,
-                f"stage {ff.stage_id} recovery (map {ff.map_id})",
-                remote=(lambda _i: remote(ff.map_id)) if remote else None)
+            try:
+                results = self._run_tasks(
+                    lambda _i: self._run_map_task(stage, part, ff.map_id),
+                    1,
+                    f"stage {ff.stage_id} recovery (map {ff.map_id})",
+                    remote=(lambda _i: remote(ff.map_id))
+                    if remote else None)
+            finally:
+                self._register_stage_files(stage.sid)
             self._absorb_remote_results(stage, results,
                                         map_ids=[ff.map_id])
             self._stage_outputs[stage.sid][ff.map_id] = \
@@ -1091,6 +1186,8 @@ class DagScheduler:
             rss_clients, self._rss_clients = self._rss_clients, []
             self._stage_outputs = {}
             self._map_worker = {}
+            self._map_attempt = {}
+            self._attempt_seq = {}
         for rid in resources:
             try:
                 remove_resource(rid)

@@ -42,11 +42,15 @@ class RssPushClient:
     """One shuffle's client handle (map or reduce side)."""
 
     def __init__(self, root: str, shuffle_id: str, num_maps: int,
-                 num_reduces: int):
+                 num_reduces: int, use_hardlinks: bool = True):
         self.root = os.path.join(root, f"rss-{shuffle_id}")
         self.shuffle_id = shuffle_id
         self.num_maps = num_maps
         self.num_reduces = num_reduces
+        # False forces the no-hardlink commit arbitration (claim file)
+        # even where os.link works — tests and the speculation soak
+        # exercise the FUSE/object-store code path deterministically
+        self.use_hardlinks = use_hardlinks
         for p in range(num_reduces):
             os.makedirs(os.path.join(self.root, f"part-{p}"),
                         exist_ok=True)
@@ -68,32 +72,64 @@ class RssPushClient:
             f.write(payload)
         os.replace(tmp, final)  # atomic publish
 
+    def _committed_attempt(self, map_id: int):
+        """Attempt id of the committed manifest for `map_id`, or None."""
+        try:
+            with open(os.path.join(self.root, f"commit-m{map_id}")) as f:
+                return int(json.load(f)["attempt"])
+        except (OSError, ValueError, KeyError):
+            return None
+
     def _commit(self, map_id: int, attempt: int,
-                counts: Dict[int, int]) -> None:
+                counts: Dict[int, int]) -> bool:
         """MapperEnd: publish the attempt manifest.  First committed
-        attempt per map wins; later attempts are ignored by readers."""
+        attempt per map wins; later attempts are REJECTED (Celeborn's
+        server-arbitrated attempt dedup) on every storage flavor.
+
+        Returns True when this attempt is the committed one (including
+        an idempotent re-commit of the same attempt after a lost result
+        frame), False when a different attempt won — the caller's output
+        is dead and readers will never see it."""
         final = os.path.join(self.root, f"commit-m{map_id}")
+        committed = self._committed_attempt(map_id)
+        if committed is not None:
+            return committed == attempt
         tmp = final + f".tmp.a{attempt}.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({"attempt": attempt,
                        "counts": {str(k): v for k, v in counts.items()}},
                       f)
-        if os.path.exists(final):
-            os.unlink(tmp)
-            return  # another attempt already committed: lose the race
+        if self.use_hardlinks:
+            try:
+                os.link(tmp, final)  # atomic first-wins where supported
+                os.unlink(tmp)
+                return True
+            except FileExistsError:
+                os.unlink(tmp)
+                return self._committed_attempt(map_id) == attempt
+            except OSError:
+                pass  # mount lacks hard links: claim-file arbitration
+        # FUSE / object-store mounts without hard links: an O_EXCL
+        # claim file names the winning attempt BEFORE the manifest
+        # rename, so a late attempt is rejected instead of the old
+        # last-wins os.replace overwriting the winner
+        claim = final + ".owner"
         try:
-            os.link(tmp, final)  # atomic first-wins where supported
+            fd = os.open(claim, os.O_WRONLY | os.O_CREAT | os.O_EXCL,
+                         0o644)
+            try:
+                os.write(fd, str(attempt).encode())
+            finally:
+                os.close(fd)
         except FileExistsError:
-            pass
-        except OSError:
-            # FUSE / object-store mounts often lack hard links: fall
-            # back to rename, which is atomic but LAST-wins — the
-            # exists() pre-check shrinks the race to concurrent commits
-            # of the same map's attempts, where either manifest is a
-            # complete, self-consistent attempt
-            os.replace(tmp, final)
-            return
-        os.unlink(tmp)
+            os.unlink(tmp)
+            try:
+                with open(claim) as f:
+                    return int(f.read().strip() or "-1") == attempt
+            except (OSError, ValueError):
+                return False
+        os.replace(tmp, final)
+        return True
 
     # -- reduce side -------------------------------------------------------
 
@@ -188,7 +224,10 @@ class RssPartitionWriter:
                            payload)
         self._seq[partition] = seq + 1
 
-    def commit(self) -> None:
-        """MapperEnd: publishes this attempt's manifest."""
+    def commit(self) -> bool:
+        """MapperEnd: publishes this attempt's manifest.  Returns True
+        when this attempt won the first-wins commit race, False when a
+        sibling attempt already committed and this output is dead."""
         self._closed = True
-        self._client._commit(self.map_id, self.attempt, dict(self._seq))
+        return self._client._commit(self.map_id, self.attempt,
+                                    dict(self._seq))

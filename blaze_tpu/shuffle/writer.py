@@ -19,10 +19,12 @@ from __future__ import annotations
 
 import io
 import os
+import re
 import struct
 import tempfile
 from dataclasses import dataclass, field
-from typing import BinaryIO, Callable, Iterator, List, Optional, Sequence
+from typing import BinaryIO, Callable, Iterator, List, Optional, Sequence, \
+    Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -35,6 +37,90 @@ from blaze_tpu.ops.base import BatchIterator, ExecutionPlan
 from blaze_tpu.schema import Schema
 from blaze_tpu.shuffle.ipc import IpcCompressionWriter
 from blaze_tpu.shuffle.partitioning import Partitioning
+
+
+#: attempt-suffixed index sidecar: `<base>.a<N>.index` — the speculative
+#: execution naming scheme (plan/stages.py _map_task_def allocates the
+#: attempt ids; un-suffixed paths take the legacy single-attempt commit)
+_ATTEMPT_INDEX_RE = re.compile(r"^(?P<base>.+)\.a(?P<attempt>\d+)\.index$")
+
+
+def promote_attempt_output(data_file: str, index_file: str
+                           ) -> Optional[bool]:
+    """First-wins commit arbitration for attempt-suffixed shuffle output.
+
+    Every attempt writes its own private `<base>.a<N>.data/.index` pair,
+    so concurrent attempts never race on file CONTENT — only on who gets
+    to be the committed output.  The arbitration is a claim file created
+    with O_EXCL (atomic on POSIX and on the FUSE/object-store mounts
+    that lack hard links) recording the winning attempt id, followed by
+    ONE os.replace of the winner's index to the canonical `<base>.index`
+    path.  A losing attempt deletes its own files, so a cancelled or
+    raced loser can never be read.  Readers resolve the winner through
+    the claim (resolve_attempt_data) and the single canonical index.
+
+    Returns True when this attempt won, False when a sibling already
+    committed (the loser's output is discarded), None when the paths are
+    not attempt-suffixed (speculation off: the caller's tmp+os.replace
+    discipline already committed atomically and nothing changes)."""
+    m = _ATTEMPT_INDEX_RE.match(index_file)
+    if m is None:
+        return None
+    attempt = int(m.group("attempt"))
+    final_index = m.group("base") + ".index"
+    claim = final_index + ".owner"
+    won = False
+    try:
+        fd = os.open(claim, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+        try:
+            os.write(fd, str(attempt).encode())
+        finally:
+            os.close(fd)
+        won = True
+    except FileExistsError:
+        # a sibling claimed first; an identical-attempt re-commit (task
+        # retry after the result frame was lost) is still the winner
+        try:
+            with open(claim) as f:
+                won = int(f.read().strip() or "-1") == attempt
+        except (OSError, ValueError):
+            won = False
+    from blaze_tpu.bridge import xla_stats
+    if won:
+        if not os.path.exists(index_file):
+            # idempotent re-commit after the first promotion already
+            # moved this attempt's index to the canonical path (task
+            # retry of the winner after a lost result frame)
+            return True
+        if os.path.exists(final_index):
+            # the claim is supposed to make this impossible; count it so
+            # the speculation soak's duplicate_output_blocks check sees
+            # any double-accept instead of silently overwriting
+            xla_stats.note_speculation(duplicate_commits=1)
+        os.replace(index_file, final_index)
+        return True
+    for p in (index_file, data_file):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+    xla_stats.note_speculation(loser_commits_rejected=1)
+    return False
+
+
+def resolve_attempt_data(data_file: str) -> Tuple[str, int]:
+    """Map a canonical `<base>.data` path to the committed attempt's
+    actual data file.  Returns (path, attempt): the claim file written
+    by promote_attempt_output names the winner; without one the legacy
+    un-suffixed path is the single attempt (attempt 0)."""
+    base = data_file[:-len(".data")]
+    claim = base + ".index.owner"
+    try:
+        with open(claim) as f:
+            attempt = int(f.read().strip())
+    except (OSError, ValueError):
+        return data_file, 0
+    return f"{base}.a{attempt}.data", attempt
 
 
 class _PartitionedSpill:
@@ -317,6 +403,11 @@ class ShuffleRepartitioner(MemConsumer):
         with open(index_file, "wb") as idx:
             for off in offsets:
                 idx.write(struct.pack("<q", off))
+        # attempt-suffixed paths (speculation): first-wins promotion of
+        # the index to the canonical path; a losing attempt's files are
+        # discarded here and the task still returns normally — the wave
+        # loop already took the winner's result
+        promote_attempt_output(data_file, index_file)
         return [offsets[i + 1] - offsets[i]
                 for i in range(len(offsets) - 1)]
 

@@ -1,0 +1,50 @@
+"""Fault-site conformance: every registered chaos site must be
+exercised somewhere — by a test or by a bench chaos rule — so a new
+site cannot land without coverage and a renamed site cannot silently
+orphan its tests."""
+
+import os
+import re
+
+from blaze_tpu import faults
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+
+def _corpus() -> str:
+    chunks = []
+    for name in sorted(os.listdir(_HERE)):
+        if not (name.startswith("test_") and name.endswith(".py")):
+            continue
+        if name == os.path.basename(__file__):
+            continue  # self-references must not count as coverage
+        with open(os.path.join(_HERE, name)) as f:
+            chunks.append(f.read())
+    with open(os.path.join(_REPO, "bench.py")) as f:
+        chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def test_every_fault_site_is_exercised():
+    corpus = _corpus()
+    missing = []
+    for site in faults.SITES:
+        # word-boundary safe for hyphenated site names: "worker-slow"
+        # must not match inside "worker-slow-extra" or "x-worker-slow"
+        if not re.search(rf"(?<![-\w]){re.escape(site)}(?![-\w])",
+                         corpus):
+            missing.append(site)
+    assert not missing, (
+        f"fault sites with no test or bench coverage: {missing} — add a "
+        f"test exercising faults at the site (faults.scoped / "
+        f"faults.configure) or a bench chaos rule naming it")
+
+
+def test_sites_registry_matches_docstring():
+    """The module docstring's site table is user-facing documentation;
+    every registered site must appear in it."""
+    doc = faults.__doc__ or ""
+    undocumented = [s for s in faults.SITES if s not in doc]
+    assert not undocumented, (
+        f"sites missing from the faults module docstring: {undocumented}")

@@ -173,3 +173,114 @@ def test_crashed_run_leftover_frames_tolerated(tmp_path):
     client._commit(0, 0, {0: 2})
     blocks = client.reader_blocks(0, timeout_s=1.0)
     assert blocks == [b"frame0", b"frame1"]
+
+
+def _race_two_attempts(tmp_path, tag, use_hardlinks):
+    """Two DISTINCT attempts of map 0 push different payloads and both
+    reach the commit point (the forced loser-commit-race shape).  The
+    first committer must win, the second must be rejected, and readers
+    must see exactly the winner's frames."""
+    client = RssPushClient(str(tmp_path), tag, num_maps=1, num_reduces=1,
+                           use_hardlinks=use_hardlinks)
+    client._push(0, 0, 0, 0, b"attempt0-frame")
+    client._push(0, 1, 0, 0, b"attempt1-frame")
+    assert client._commit(0, 0, {0: 1}) is True
+    assert client._commit(0, 1, {0: 1}) is False   # late attempt rejected
+    assert client._committed_attempt(0) == 0
+    # idempotent re-commit of the WINNER stays accepted (lost result
+    # frame -> task-level retry of the same attempt)
+    assert client._commit(0, 0, {0: 1}) is True
+    blocks = client.reader_blocks(0, timeout_s=1.0)
+    assert blocks == [b"attempt0-frame"]  # loser frames ignored
+
+
+def test_distinct_attempt_first_wins_hardlink(tmp_path):
+    _race_two_attempts(tmp_path, "race-hl", use_hardlinks=True)
+
+
+def test_distinct_attempt_first_wins_no_hardlink(tmp_path):
+    """The FUSE/object-store fallback must arbitrate via the O_EXCL
+    claim file, not last-wins os.replace."""
+    _race_two_attempts(tmp_path, "race-claim", use_hardlinks=False)
+    # the claim file names the winner
+    import os
+    claim = os.path.join(str(tmp_path), "rss-race-claim",
+                         "commit-m0.owner")
+    with open(claim) as f:
+        assert f.read().strip() == "0"
+
+
+def test_file_tier_distinct_attempt_first_wins(tmp_path):
+    """File-tier arbitration: each attempt writes a private
+    `<base>.a<N>.data/.index` pair; the first promote wins via the
+    O_EXCL claim + single os.replace of the index, the loser's files
+    are deleted, and resolve_attempt_data maps the canonical path to
+    the winner's data file."""
+    import os
+
+    from blaze_tpu.shuffle.writer import (promote_attempt_output,
+                                          resolve_attempt_data)
+    base = os.path.join(str(tmp_path), "s0-7-0")
+    paths = {}
+    for a in (0, 1):
+        paths[a] = (f"{base}.a{a}.data", f"{base}.a{a}.index")
+        with open(paths[a][0], "wb") as f:
+            f.write(b"data-a%d" % a)
+        with open(paths[a][1], "wb") as f:
+            f.write(b"index-a%d" % a)
+    assert promote_attempt_output(*paths[1]) is True    # attempt 1 wins
+    assert promote_attempt_output(*paths[0]) is False   # loser rejected
+    data, attempt = resolve_attempt_data(base + ".data")
+    assert attempt == 1 and data.endswith(".a1.data")
+    with open(base + ".index", "rb") as f:
+        assert f.read() == b"index-a1"   # canonical index = winner's
+    with open(data, "rb") as f:
+        assert f.read() == b"data-a1"
+    # the loser's private files are gone — unreadable by construction
+    assert not os.path.exists(paths[0][0])
+    assert not os.path.exists(paths[0][1])
+    # idempotent re-promotion of the winner is still the winner
+    # (nothing left to move, but the verdict must not flip)
+    assert promote_attempt_output(*paths[1]) is True
+    # un-suffixed paths are untouched by the arbitration
+    assert promote_attempt_output(base + ".data", base + ".index") is None
+
+
+def test_file_tier_concurrent_promotion_single_winner(tmp_path):
+    """N threads race promote_attempt_output for distinct attempts;
+    exactly one may win and every loser's files must be gone."""
+    import os
+    import threading
+
+    from blaze_tpu.shuffle.writer import (promote_attempt_output,
+                                          resolve_attempt_data)
+    base = os.path.join(str(tmp_path), "s0-9-3")
+    n = 8
+    for a in range(n):
+        with open(f"{base}.a{a}.data", "wb") as f:
+            f.write(b"d%d" % a)
+        with open(f"{base}.a{a}.index", "wb") as f:
+            f.write(b"i%d" % a)
+    verdicts = [None] * n
+    barrier = threading.Barrier(n)
+
+    def go(a):
+        barrier.wait()
+        verdicts[a] = promote_attempt_output(f"{base}.a{a}.data",
+                                             f"{base}.a{a}.index")
+    threads = [threading.Thread(target=go, args=(a,)) for a in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert verdicts.count(True) == 1
+    assert verdicts.count(False) == n - 1
+    winner = verdicts.index(True)
+    data, attempt = resolve_attempt_data(base + ".data")
+    assert attempt == winner
+    with open(data, "rb") as f:
+        assert f.read() == b"d%d" % winner
+    leftovers = [a for a in range(n) if a != winner
+                 and (os.path.exists(f"{base}.a{a}.data")
+                      or os.path.exists(f"{base}.a{a}.index"))]
+    assert leftovers == []

@@ -1,0 +1,234 @@
+"""Speculative execution (bridge/tasks.py): quantile-driven straggler
+hedging with first-wins attempt commit.  Wave-level trigger + cancel
+semantics, the forced loser-commit-race fault site, the pre-dispatch
+deadline fatal-classification, deterministic backoff jitter, and a
+scheduler-level parity run with speculation enabled."""
+
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu import config, faults
+from blaze_tpu.bridge import xla_stats
+from blaze_tpu.bridge import tasks as tasks_mod
+from blaze_tpu.bridge.context import TaskKilledError, current_attempt_token
+from blaze_tpu.bridge.tasks import run_tasks
+from blaze_tpu.faults import TaskDeadlineExpired, classify_exception
+from blaze_tpu.memory import MemManager
+
+
+@pytest.fixture(autouse=True)
+def budget():
+    MemManager.init(1 << 30)
+
+
+@pytest.fixture()
+def speculation_on():
+    config.conf.set(config.SPECULATION_ENABLE.key, "on")
+    config.conf.set(config.SPECULATION_QUANTILE.key, 0.25)
+    config.conf.set(config.SPECULATION_MULTIPLIER.key, 1.0)
+    config.conf.set(config.SPECULATION_MIN_MS.key, 10)
+    try:
+        yield
+    finally:
+        for opt in (config.SPECULATION_ENABLE, config.SPECULATION_QUANTILE,
+                    config.SPECULATION_MULTIPLIER, config.SPECULATION_MIN_MS):
+            config.conf.unset(opt.key)
+
+
+def _spec_delta(before):
+    d = xla_stats.delta(before)
+    return {k[len("speculation_"):]: int(v) for k, v in d.items()
+            if k.startswith("speculation_")}
+
+
+def test_speculation_off_is_single_attempt():
+    """Default-off: one attempt per task, zero speculation counters —
+    the wave loop must be byte-identical to the pre-speculation path."""
+    before = xla_stats.snapshot()
+    calls = []
+    out = run_tasks(lambda i: calls.append(i) or i * 10, 4, 10.0,
+                    "spec off wave", max_workers=4)
+    assert out == [0, 10, 20, 30]
+    assert sorted(calls) == [0, 1, 2, 3]          # exactly one call each
+    assert all(v == 0 for v in _spec_delta(before).values())
+
+
+def test_trigger_hedges_straggler_and_cancels_loser(speculation_on):
+    """A straggler past multiplier x median gets a duplicate attempt;
+    the duplicate's success wins, and the straggling primary is
+    cooperatively cancelled through its attempt token."""
+    before = xla_stats.snapshot()
+    lock = threading.Lock()
+    calls = {}
+
+    def fn(i):
+        with lock:
+            attempt = calls[i] = calls.get(i, -1) + 1
+        if i == 3 and attempt == 0:
+            # primary straggles until first-wins cancels it
+            tok = current_attempt_token()
+            assert tok is not None
+            if not tok.wait(8.0):
+                raise AssertionError("straggler was never cancelled")
+            raise TaskKilledError("cooperative cancel observed")
+        return f"t{i}a{attempt}"
+
+    out = run_tasks(fn, 4, 10.0, "spec trigger wave", max_workers=4)
+    assert out[:3] == ["t0a0", "t1a0", "t2a0"]
+    assert out[3] == "t3a1"                       # the duplicate won
+    d = _spec_delta(before)
+    assert d["waves"] == 1
+    assert d["attempts"] >= 1
+    assert d["wins"] == 1
+    assert d["losers_cancelled"] >= 1
+    assert d["commit_races"] == 0
+
+
+def test_loser_commit_race_lets_both_attempts_finish(speculation_on):
+    """The speculation-loser-commit-race site suppresses loser
+    cancellation: the straggling primary runs to completion and its
+    late result is discarded — first-wins already settled."""
+    before = xla_stats.snapshot()
+    release = threading.Event()
+    finished = {}
+    lock = threading.Lock()
+    calls = {}
+
+    def fn(i):
+        with lock:
+            attempt = calls[i] = calls.get(i, -1) + 1
+        if i == 2 and attempt == 0:
+            tok = current_attempt_token()
+            # the loser must NOT be cancelled: the race site suppresses
+            # the winner's settle_losers, so this wait times out on
+            # `release`, never on the attempt token
+            assert release.wait(8.0)
+            assert tok is not None and not tok.is_set()
+            with lock:
+                finished["loser"] = True
+            return "t2-loser"
+        return f"t{i}a{attempt}"
+
+    with faults.scoped(("speculation-loser-commit-race", dict(p=1.0)),
+                       seed=7):
+        out = run_tasks(fn, 4, 10.0, "spec race wave", max_workers=4)
+    release.set()                                  # loser may now finish
+    assert out[2] == "t2a1"                        # winner, not the loser
+    deadline = time.monotonic() + 5.0
+    while "loser" not in finished and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert finished.get("loser")                   # loser ran to the end
+    d = _spec_delta(before)
+    assert d["commit_races"] >= 1
+    assert d["losers_cancelled"] == 0
+
+
+def test_pre_dispatch_deadline_is_fatal():
+    """TaskDeadlineExpired must classify fatal — a task whose deadline
+    expired before dispatch must not burn maxAttempts backoff sleeps —
+    while a plain TimeoutError stays retryable (OSError subclass)."""
+    assert classify_exception(
+        TaskDeadlineExpired("worker task deadline already expired")) \
+        == "fatal"
+    assert classify_exception(TimeoutError("socket timed out")) \
+        == "retryable"
+
+    calls = []
+
+    def fn(i):
+        calls.append(i)
+        raise TaskDeadlineExpired("worker task deadline already expired")
+
+    with pytest.raises(TaskDeadlineExpired):
+        run_tasks(fn, 1, 10.0, "expired wave", max_workers=1)
+    assert calls == [0]                            # no retry burned
+
+
+def test_backoff_jitter_deterministic():
+    """Jitter derives from (faults seed, what, task, attempt) so chaos
+    soaks replay identically; different coordinates decorrelate."""
+    j = tasks_mod._backoff_jitter
+    assert j("stage 1", 3, 2) == j("stage 1", 3, 2)
+    assert 0.0 <= j("stage 1", 3, 2) < 1.0
+    coords = {("stage 1", 3, 2), ("stage 1", 3, 3), ("stage 1", 4, 2),
+              ("stage 2", 3, 2)}
+    vals = {j(w, t, a) for (w, t, a) in coords}
+    assert len(vals) == len(coords)                # no collisions here
+
+
+def test_scheduler_parity_with_speculation_on(tmp_path, speculation_on):
+    """A staged two-stage aggregate with speculation enabled (attempt-
+    suffixed shuffle files + promote/resolve arbitration on the file
+    tier) returns the same frame as the single-attempt path, and the
+    scheduler's leak report stays clean."""
+    from blaze_tpu.plan.stages import DagScheduler
+    config.conf.set(config.DAG_SINGLE_TASK_BYTES.key, 0)
+    try:
+        rng = np.random.default_rng(11)
+        n = 20_000
+        t = pa.table({"k": pa.array(rng.integers(0, 200, n),
+                                    type=pa.int64()),
+                      "v": pa.array(rng.random(n))})
+        paths = []
+        for i in range(2):
+            p = str(tmp_path / f"in-{i}.parquet")
+            pq.write_table(t.slice(i * (n // 2), n // 2), p)
+            paths.append(p)
+        schema = {"fields": [
+            {"name": "k", "type": {"id": "int64"}, "nullable": True},
+            {"name": "v", "type": {"id": "float64"}, "nullable": True}]}
+        plan = {
+            "kind": "hash_agg",
+            "groupings": [{"expr": {"kind": "column", "index": 0},
+                           "name": "k"}],
+            "aggs": [{"fn": "sum", "mode": "final", "name": "s",
+                      "args": [{"kind": "column", "index": 1}]}],
+            "input": {
+                "kind": "local_exchange",
+                "partitioning": {"kind": "hash",
+                                 "exprs": [{"kind": "column", "index": 0}],
+                                 "num_partitions": 3},
+                "input": {
+                    "kind": "hash_agg",
+                    "groupings": [{"expr": {"kind": "column", "name": "k"},
+                                   "name": "k"}],
+                    "aggs": [{"fn": "sum", "mode": "partial", "name": "s",
+                              "args": [{"kind": "column", "name": "v"}]}],
+                    "input": {"kind": "parquet_scan", "schema": schema,
+                              "file_groups": [[paths[0]], [paths[1]]]}}}}
+        sched = DagScheduler(work_dir=str(tmp_path / "dag"))
+        got = sched.run_collect(plan).to_pandas()
+        want = t.to_pandas().groupby("k", as_index=False).v.sum() \
+            .rename(columns={"v": "s"})
+        got = got.sort_values("k").reset_index(drop=True)
+        want = want.sort_values("k").reset_index(drop=True)
+        assert len(got) == len(want)
+        np.testing.assert_allclose(got["s"].to_numpy(),
+                                   want["s"].to_numpy(), rtol=1e-9)
+        leaks = sched.leak_report()
+        assert sum(len(v) for v in leaks.values()) == 0, leaks
+    finally:
+        config.conf.unset(config.DAG_SINGLE_TASK_BYTES.key)
+
+
+def test_explain_analyze_reports_speculation(speculation_on):
+    """explain_analyze output grows a speculation: footer once hedging
+    happened in the profiled run."""
+    from blaze_tpu.plan.explain import format_speculation_footer
+    stats = {"speculation_waves": 2, "speculation_attempts": 3,
+             "speculation_wins": 2, "speculation_losers_cancelled": 3,
+             "speculation_loser_commits_rejected": 1,
+             "speculation_commit_races": 0,
+             "speculation_duplicate_commits": 0}
+    line = format_speculation_footer(stats)
+    assert line is not None
+    assert "speculation:" in line
+    assert "waves=2" in line and "wins=2" in line
+    assert format_speculation_footer(
+        {k: 0 for k in stats}) is None             # quiet when unused
