@@ -3501,6 +3501,156 @@ def stream_bench_main() -> int:
     return 0 if ok else 1
 
 
+# ===========================================================================
+# --obs: tracing overhead gate + stitched-trace soak (ISSUE 13)
+# ===========================================================================
+
+def obs_bench_main() -> int:
+    """Observability overhead gate (`--obs`): run q01/q06/q95 through
+    the process-isolated worker pool with tracing OFF then ON
+    (`auron.tpu.trace.enable`) and assert the traced wall stays within
+    the overhead budget (default 2%, aggregate across queries,
+    min-of-iters per leg to damp scheduler noise).  The traced legs
+    must also really trace: per-query span counts and child spans
+    stitched in over the worker wire (`obs_spans_ingested`) are
+    recorded and must be non-zero, and traced results must match the
+    untraced runs bit for bit.  Writes BENCH_OBS.json and prints it
+    as one JSON line."""
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+    import tempfile
+
+    from blaze_tpu import config
+    from blaze_tpu.bridge import tracing, xla_stats
+    from blaze_tpu.itest import generate
+    from blaze_tpu.itest.queries import QUERIES
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.itest.tpcds_data import write_parquet_splits
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.parallel import workers
+    from blaze_tpu.plan.stages import DagScheduler
+
+    names = os.environ.get("BLAZE_BENCH_OBS_QUERIES",
+                           "q01,q06,q95").split(",")
+    scale = float(os.environ.get("BLAZE_BENCH_OBS_SCALE", "0.2"))
+    iters = int(os.environ.get("BLAZE_BENCH_OBS_ITERS", "3"))
+    budget = float(os.environ.get("BLAZE_BENCH_OBS_BUDGET", "0.02"))
+
+    MemManager.init(4 << 30)
+    # staged wire path through the pool: the traced leg must pay the
+    # full cross-process span shipping cost, not a thread shortcut
+    knobs = {config.DAG_SINGLE_TASK_BYTES.key: 0,
+             config.WORKERS_ENABLE.key: "on",
+             config.WORKERS_COUNT.key: 2,
+             config.WORKERS_HEARTBEAT_MS.key: 50}
+    for k, v in knobs.items():
+        config.conf.set(k, v)
+
+    def frame(tbl):
+        import pandas as pd
+        return tbl.to_pandas() if tbl.num_rows else pd.DataFrame(
+            {n: [] for n in tbl.schema.names})
+
+    queries = []
+    diverged = 0
+    try:
+        with tempfile.TemporaryDirectory(prefix="obs-") as d:
+            plans = []
+            for qname in names:
+                qname = qname.strip()
+                builder, table_names = QUERIES[qname]
+                tables = generate(table_names, scale=scale)
+                paths = write_parquet_splits(
+                    tables, os.path.join(d, qname), 2)
+                plan_dict, _oracle = builder(paths, tables, 2)
+                plans.append((qname, plan_dict))
+
+            def run(qname, plan_dict, tag, runs):
+                walls, got = [], None
+                for it in range(runs):
+                    sched = DagScheduler(work_dir=os.path.join(
+                        d, qname, f"{tag}{it}"))
+                    t0 = time.perf_counter()
+                    got = sched.run_collect(plan_dict)
+                    walls.append(time.perf_counter() - t0)
+                return min(walls), got
+
+            # warmup: XLA compile caches and pool spawn are paid once,
+            # OUTSIDE both timed legs
+            workers.get_pool()
+            for qname, plan_dict in plans:
+                run(qname, plan_dict, "warm", 1)
+
+            for qname, plan_dict in plans:
+                tracing.stop_tracing()
+                tracing.reset_conf_probe()
+                config.conf.unset(config.TRACE_ENABLE.key)
+                base_wall, base = run(qname, plan_dict, "off", iters)
+
+                config.conf.set(config.TRACE_ENABLE.key, "on")
+                tracing.reset_conf_probe()
+                before = xla_stats.snapshot()
+                span0 = len(tracing.spans())
+                traced_wall, got = run(qname, plan_dict, "on", iters)
+                ds = xla_stats.delta(before)
+                spans = len(tracing.spans()) - span0
+                config.conf.unset(config.TRACE_ENABLE.key)
+                tracing.reset_conf_probe()
+
+                err = compare_frames(frame(got), frame(base))
+                if err is not None:
+                    diverged += 1
+                queries.append({
+                    "query": qname,
+                    "base_wall_s": round(base_wall, 4),
+                    "traced_wall_s": round(traced_wall, 4),
+                    "overhead_pct": round(
+                        (traced_wall / base_wall - 1.0) * 100, 2),
+                    "spans": spans,
+                    "spans_ingested":
+                        int(ds.get("obs_spans_ingested", 0)),
+                    "divergence": err,
+                })
+    finally:
+        workers.shutdown_pool(wait=False)
+        for k in knobs:
+            config.conf.unset(k)
+        config.conf.unset(config.TRACE_ENABLE.key)
+        tracing.stop_tracing()
+        tracing.reset_conf_probe()
+
+    total_base = sum(q["base_wall_s"] for q in queries)
+    total_traced = sum(q["traced_wall_s"] for q in queries)
+    overhead = (total_traced / total_base - 1.0) if total_base else 0.0
+    rec = {
+        "metric": "tracing_overhead_pct",
+        "value": round(overhead * 100, 2),
+        "unit": "percent",
+        "budget_pct": budget * 100,
+        "scale": scale,
+        "iters": iters,
+        "queries": queries,
+        "total_spans": sum(q["spans"] for q in queries),
+        "total_spans_ingested":
+            sum(q["spans_ingested"] for q in queries),
+        "divergent_queries": diverged,
+    }
+    path = os.environ.get(
+        "BLAZE_BENCH_OBS_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_OBS.json"))
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, default=str)
+    print(json.dumps(rec, default=str))
+    sys.stdout.flush()
+    ok = (diverged == 0 and overhead <= budget
+          and all(q["spans"] > 0 for q in queries)
+          and sum(q["spans_ingested"] for q in queries) > 0)
+    return 0 if ok else 1
+
+
 def main():
     if "--expr" in sys.argv:
         sys.exit(expr_bench_main())
@@ -3520,6 +3670,8 @@ def main():
         sys.exit(scatterlane_bench_main())
     if "--stream" in sys.argv:
         sys.exit(stream_bench_main())
+    if "--obs" in sys.argv:
+        sys.exit(obs_bench_main())
     if "--multichip-child" in sys.argv:
         sys.exit(multichip_child_main())
     if "--multichip" in sys.argv:

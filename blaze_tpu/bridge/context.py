@@ -164,3 +164,113 @@ _host_task_probe = None
 def set_host_task_probe(fn) -> None:
     global _host_task_probe
     _host_task_probe = fn
+
+
+# -- flight recorder --------------------------------------------------------
+#
+# A bounded per-query black box: the counter plane is snapshotted at
+# query start, and when the query dies with a fatal classification
+# (quota kill, deadline, pool-unavailable, stream recovery exhaustion)
+# the recorder dumps the last N spans + counter deltas + config
+# snapshot to a post-mortem JSON artifact.  First fatal per query wins;
+# DagScheduler.leak_report() references the artifact path.
+
+_flight_lock = threading.Lock()
+_flight_dumps: dict = {}      # query_id -> dump dict (incl. "path")
+_flight_baselines: dict = {}  # query_id -> xla_stats.snapshot() at start
+_FLIGHT_BASELINE_CAP = 256
+
+
+def note_query_start(query_id) -> None:
+    """Snapshot the counter plane at query start so a later fatal dump
+    carries deltas attributable to this query's lifetime."""
+    if query_id is None:
+        return
+    try:
+        from blaze_tpu.bridge import xla_stats
+        snap = xla_stats.snapshot()
+    except Exception:
+        return
+    with _flight_lock:
+        _flight_baselines[query_id] = snap
+        while len(_flight_baselines) > _FLIGHT_BASELINE_CAP:
+            _flight_baselines.pop(next(iter(_flight_baselines)))
+
+
+def record_fatal(query_id, reason: str, classification: str = "fatal"):
+    """Write the post-mortem artifact for a fatally-classified query.
+
+    Returns the dump dict (also retrievable via flight_dump), or None
+    when the recorder is disabled or this query already dumped."""
+    import json
+    import os
+    import tempfile
+    import time as _time
+    try:
+        from blaze_tpu import config
+        from blaze_tpu.bridge import tracing, xla_stats
+        if not config.FLIGHT_RECORDER_ENABLE.get():
+            return None
+        max_spans = max(1, config.FLIGHT_RECORDER_SPANS.get())
+        out_dir = config.FLIGHT_RECORDER_DIR.get() or os.path.join(
+            tempfile.gettempdir(), "blaze_flight")
+    except Exception:
+        return None
+    with _flight_lock:
+        if query_id in _flight_dumps:
+            return None  # first fatal wins
+        baseline = _flight_baselines.pop(query_id, None)
+        _flight_dumps[query_id] = {}  # claim before the slow I/O below
+    spans = tracing.spans_for_query(query_id)
+    if not spans:  # query ran without span context (or tracing off)
+        spans = tracing.spans()
+    spans = spans[-max_spans:]
+    counters = (xla_stats.delta(baseline) if baseline is not None
+                else xla_stats.snapshot())
+    dump = {
+        "query_id": str(query_id),
+        "reason": str(reason),
+        "classification": str(classification),
+        "wall_time": _time.time(),
+        "spans": spans,
+        "counters": counters,
+        "config": config.conf.snapshot(),
+    }
+    path = None
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "-._" else "_"
+                       for c in str(query_id))
+        path = os.path.join(out_dir,
+                            f"flight-{safe}-{os.getpid()}.json")
+        with open(path, "w") as f:
+            json.dump(dump, f, indent=1, default=str)
+    except OSError:
+        path = None  # keep the in-memory dump even if the disk write failed
+    dump["path"] = path
+    with _flight_lock:
+        _flight_dumps[query_id] = dump
+    xla_stats.note_obs(flight_dumps=1)
+    tracing.instant("flight_dump", query=query_id, reason=reason,
+                    classification=classification, path=path)
+    return dump
+
+
+def flight_dump(query_id):
+    """The post-mortem dump recorded for this query, or None."""
+    with _flight_lock:
+        d = _flight_dumps.get(query_id)
+        return d if d else None
+
+
+def flight_dumps() -> dict:
+    """query_id -> artifact path for every recorded dump."""
+    with _flight_lock:
+        return {q: d.get("path") for q, d in _flight_dumps.items() if d}
+
+
+def reset_flight_recorder() -> None:
+    """Test helper: forget dumps and baselines (files are left on disk)."""
+    with _flight_lock:
+        _flight_dumps.clear()
+        _flight_baselines.clear()

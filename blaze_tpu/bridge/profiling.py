@@ -13,9 +13,17 @@ TPU-native equivalents served over a stdlib HTTP endpoint:
                     totals, per-operator aggregates
   /profile        — list of recorded query profiles (id + summary)
   /profile/<qid>  — full explain-analyze profile for one query (JSON)
+  /query/<qid>/timeline — Chrome-trace-event JSON (Perfetto-loadable)
+                    of the query's stitched span trace: one track per
+                    worker / device / stream epoch, plus a per-query
+                    resource-attribution block
   /trace/start?dir=<path>, /trace/stop — JAX profiler trace (XLA's own
                     profiler is the pprof analog: device + host timelines
                     viewable in TensorBoard/Perfetto)
+
+The query-profile store is a bounded LRU (auron.tpu.profile.maxEntries;
+get_profile touches) so long-lived serving processes don't grow it
+without limit; evictions count as obs_profile_evictions.
 """
 
 from __future__ import annotations
@@ -46,20 +54,41 @@ def recent_metrics() -> List[dict]:
         return list(_recent_metrics)
 
 
+def _profile_cap() -> int:
+    try:
+        from blaze_tpu import config
+        return max(1, config.PROFILE_STORE_MAX.get())
+    except Exception:
+        return _MAX_PROFILES
+
+
 def record_profile(query_id: str, profile: dict) -> None:
     """explain_analyze pushes finished query profiles here, keyed by the
-    ui-store query id; served on /profile/<qid>."""
+    ui-store query id; served on /profile/<qid>.  The store is an LRU
+    bounded by auron.tpu.profile.maxEntries — record and get_profile
+    both refresh recency; evictions are counted in xla_stats."""
+    cap = _profile_cap()
+    evicted = 0
     with _lock:
-        if query_id not in _profiles:
-            _profile_order.append(query_id)
+        if query_id in _profiles:
+            _profile_order.remove(query_id)
+        _profile_order.append(query_id)
         _profiles[query_id] = profile
-        while len(_profile_order) > _MAX_PROFILES:
+        while len(_profile_order) > cap:
             _profiles.pop(_profile_order.pop(0), None)
+            evicted += 1
+    if evicted:
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_obs(profile_evictions=evicted)
 
 
 def get_profile(query_id: str) -> Optional[dict]:
     with _lock:
-        return _profiles.get(query_id)
+        p = _profiles.get(query_id)
+        if p is not None:  # LRU touch
+            _profile_order.remove(query_id)
+            _profile_order.append(query_id)
+        return p
 
 
 def list_profiles() -> List[dict]:
@@ -132,6 +161,49 @@ def prometheus_text() -> str:
         # speculative execution (bridge/tasks.py): hedged waves/attempts,
         # first-wins outcomes, rejected loser commits, forced races
         emit(f"blaze_{k}_total", v, "speculative execution counter")
+    for k, v in xla_stats.obs_stats().items():
+        # observability plane (PR 13): stitched-in child spans, flight
+        # dumps, profile-store LRU evictions
+        emit(f"blaze_{k}_total", v, "observability counter")
+
+    def emit_histogram(name, hist, help_, labels=None, seen=set()):
+        # real Prometheus histogram exposition (cumulative le buckets +
+        # _sum/_count), not the gauge families above
+        lab_items = sorted((labels or {}).items())
+
+        def fmt(extra):
+            items = lab_items + sorted(extra.items())
+            if not items:
+                return ""
+            return "{" + ",".join(
+                f'{k}="{_prom_escape(str(v))}"' for k, v in items) + "}"
+
+        if name not in seen:
+            seen.add(name)
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} histogram")
+        for le, count in hist["buckets"]:
+            lines.append(f"{name}_bucket{fmt({'le': le})} {count}")
+        lines.append(f"{name}_bucket{fmt({'le': '+Inf'})} {hist['count']}")
+        lines.append(f"{name}_sum{fmt({})} {hist['sum']:.6f}")
+        lines.append(f"{name}_count{fmt({})} {hist['count']}")
+
+    hists = xla_stats.latency_histograms()
+    emit_histogram("blaze_task_duration_seconds",
+                   hists["task_duration_seconds"],
+                   "successful task-attempt wall time")
+    emit_histogram("blaze_wave_wall_seconds", hists["wave_wall_seconds"],
+                   "run_tasks wave wall, submit to last result")
+    try:
+        from blaze_tpu.serving.service import tenant_wall_samples
+        for tenant, samples in sorted(tenant_wall_samples().items()):
+            emit_histogram(
+                "blaze_tenant_query_wall_seconds",
+                xla_stats._histogram([int(s * 1e9) for s in samples]),
+                "per-tenant completed-query wall time (attribution)",
+                {"tenant": tenant})
+    except Exception:
+        pass  # serving layer not in use
     mm = MemManager.get()
     emit("blaze_mem_spill_count_total", mm.total_spill_count,
          "memory-manager spills")
@@ -161,6 +233,119 @@ def prometheus_text() -> str:
                      f"per-operator {metric} over recent metric trees",
                      {"operator": op})
     return "\n".join(lines) + "\n"
+
+
+def query_timeline(query_id: str) -> Optional[dict]:
+    """Chrome-trace-event JSON for one query's stitched span trace.
+
+    Loads directly in Perfetto / chrome://tracing: a top-level object
+    with `traceEvents` (complete "X" events for spans, instant "i"
+    events for markers), one process track per origin (driver, each
+    worker slot) and dedicated tracks for device dispatches and each
+    stream epoch.  A per-query resource-attribution block (task CPU
+    seconds, shuffle bytes by tier, device dispatches, spill bytes,
+    speculation hedge cost) rides as a top-level key — extra keys are
+    legal in the trace-event object format.  Returns None when no spans
+    name the query."""
+    from blaze_tpu.bridge import tracing
+    spans = tracing.spans_for_query(query_id)
+    if not spans:
+        return None
+
+    _DRIVER_PID, _WORKER_PID0 = 1, 100
+    events: List[dict] = []
+    tids: Dict[tuple, int] = {}
+    procs: Dict[int, str] = {_DRIVER_PID: "driver"}
+
+    def tid_for(pid, key, label):
+        t = tids.get((pid, key))
+        if t is None:
+            t = tids[(pid, key)] = len(tids) + 1
+            events.append({"ph": "M", "pid": pid, "tid": t,
+                           "name": "thread_name",
+                           "args": {"name": label}})
+        return t
+
+    attribution = {"task_cpu_seconds": 0.0, "worker_task_seconds": 0.0,
+                   "device_dispatches": 0,
+                   "spill_bytes": 0, "speculation_attempts": 0,
+                   "speculation_hedge_seconds": 0.0,
+                   "shuffle_bytes_by_tier": {"device": 0, "rss": 0,
+                                             "file": 0}, "span_count": 0}
+    profile = get_profile(query_id)
+    if profile:
+        x = profile.get("xla") or {}
+        attribution["shuffle_bytes_by_tier"]["device"] = int(
+            x.get("shuffle_device_bytes", 0))
+        attribution["shuffle_bytes_by_tier"]["file"] = int(
+            x.get("shuffle_host_bytes", 0))
+
+    for r in spans:
+        name = r.get("name", "?")
+        attrs = r.get("attrs") or {}
+        ctx = r.get("ctx") or {}
+        worker = r.get("worker")
+        if worker is not None:
+            try:
+                pid = _WORKER_PID0 + int(worker)
+            except (TypeError, ValueError):
+                pid = _WORKER_PID0 + (hash(str(worker)) % 97)
+            procs.setdefault(pid, f"worker-{worker}")
+            tid = tid_for(pid, r.get("thread", "main"),
+                          str(r.get("thread", "main")))
+        elif name in ("device_exchange", "stage_loop_chunk",
+                      "xla_compile"):
+            pid = _DRIVER_PID
+            tid = tid_for(pid, "device", "device")
+        elif name in ("stream_epoch", "stream_recovery"):
+            pid = _DRIVER_PID
+            ep = attrs.get("epoch", ctx.get("epoch", 0)) or 0
+            tid = tid_for(pid, ("epoch", ep), f"epoch-{ep}")
+        else:
+            pid = _DRIVER_PID
+            tid = tid_for(pid, r.get("thread", "main"),
+                          str(r.get("thread", "main")))
+        args = dict(ctx)
+        args.update(attrs)
+        if "sid" in r:
+            args["sid"] = r["sid"]
+        if "parent" in r:
+            args["parent"] = r["parent"]
+        ev = {"name": name, "pid": pid, "tid": tid,
+              "ts": r.get("t0_ns", 0) / 1e3, "args": args}
+        if r.get("dur_ns", 0) > 0:
+            ev["ph"] = "X"
+            ev["dur"] = r["dur_ns"] / 1e3
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        events.append(ev)
+
+        attribution["span_count"] += 1
+        dur_s = r.get("dur_ns", 0) / 1e9
+        if name == "task_attempt":
+            # driver-side attempt wall; child-process execution is the
+            # separate worker_task_seconds (summing both double-counts)
+            attribution["task_cpu_seconds"] += dur_s
+            if attrs.get("speculative"):
+                attribution["speculation_hedge_seconds"] += dur_s
+        elif name == "worker_task":
+            attribution["worker_task_seconds"] += dur_s
+        elif name in ("device_exchange", "stage_loop_chunk"):
+            attribution["device_dispatches"] += 1
+        elif name == "mem_spill":
+            attribution["spill_bytes"] += int(attrs.get("bytes", 0) or 0)
+        elif name == "speculation_attempt":
+            attribution["speculation_attempts"] += 1
+        elif name == "rss_exchange":
+            attribution["shuffle_bytes_by_tier"]["rss"] += int(
+                attrs.get("nbytes", 0) or 0)
+
+    for pid, pname in procs.items():
+        events.append({"ph": "M", "pid": pid, "tid": 0,
+                       "name": "process_name", "args": {"name": pname}})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "query_id": str(query_id), "attribution": attribution}
 
 
 def engine_status() -> dict:
@@ -221,6 +406,16 @@ class _Handler(BaseHTTPRequestHandler):
                      "known": [p["query_id"] for p in list_profiles()]}))
             else:
                 self._send(200, json.dumps(profile))
+        elif route.startswith("/query/") and route.endswith("/timeline"):
+            qid = urllib.parse.unquote(
+                route[len("/query/"):-len("/timeline")])
+            timeline = query_timeline(qid)
+            if timeline is None:
+                self._send(404, json.dumps(
+                    {"error": f"no spans recorded for query {qid!r} "
+                              f"(is tracing enabled?)"}))
+            else:
+                self._send(200, json.dumps(timeline, default=str))
         elif route == "/trace/start":
             import jax
             # the trace dir arrives as ?dir=<path> (query STRING, not the
@@ -278,6 +473,7 @@ class _Handler(BaseHTTPRequestHandler):
                                                   "/metrics.prom",
                                                   "/profile",
                                                   "/profile/<qid>",
+                                                  "/query/<qid>/timeline",
                                                   "/auron", "/auron.html",
                                                   "/trace/start",
                                                   "/trace/stop",

@@ -134,10 +134,13 @@ def _run_with_retries(fn: Callable[[int], Any], i: int, what: str,
     attempt = 1
     cancel = state.cancel if state is not None else None
     exclude: set = state.exclude if state is not None else set()
+    speculative = bool(state is not None and state.speculative)
     t0 = time.monotonic()
     if state is not None:
         state.started = t0
-    with query_scope(query), attempt_scope(cancel):
+    with query_scope(query), attempt_scope(cancel), \
+            tracing.execution_context(
+                query=getattr(query, "query_id", None), task=i, what=what):
         while True:
             try:
                 if cancel is not None and cancel.is_set():
@@ -149,27 +152,30 @@ def _run_with_retries(fn: Callable[[int], Any], i: int, what: str,
                 faults.maybe_fail("task-start", task=i, attempt=attempt,
                                   what=what)
                 out = _POOL_MISS
-                if remote is not None:
-                    # resolved per ATTEMPT: shuffle-input locations may
-                    # have moved after a lineage recovery round, and an
-                    # invalidated input must surface as FetchFailedError
-                    # now, not ship a stale block list
-                    spec = remote(i)
-                    if spec is not None:
-                        out = _run_remote(spec, exclude, deadline, query,
-                                          what, state)
-                if out is _POOL_MISS:
-                    if attempt == 1:
-                        out = fn(i)
-                    else:
-                        # retries take the most conservative path:
-                        # decline the device-resident stage loop (an
-                        # optimization that was live during the attempt
-                        # that failed)
-                        from blaze_tpu.plan.stage_compiler import \
-                            decline_loop_scope
-                        with decline_loop_scope():
+                with tracing.span("task_attempt", task=i, attempt=attempt,
+                                  what=what, speculative=speculative):
+                    if remote is not None:
+                        # resolved per ATTEMPT: shuffle-input locations
+                        # may have moved after a lineage recovery round,
+                        # and an invalidated input must surface as
+                        # FetchFailedError now, not ship a stale block
+                        # list
+                        spec = remote(i)
+                        if spec is not None:
+                            out = _run_remote(spec, exclude, deadline,
+                                              query, what, state)
+                    if out is _POOL_MISS:
+                        if attempt == 1:
                             out = fn(i)
+                        else:
+                            # retries take the most conservative path:
+                            # decline the device-resident stage loop (an
+                            # optimization that was live during the
+                            # attempt that failed)
+                            from blaze_tpu.plan.stage_compiler import \
+                                decline_loop_scope
+                            with decline_loop_scope():
+                                out = fn(i)
                 xla_stats.note_task_attempts(attempt, wait_ns)
                 dur = time.monotonic() - t0
                 if state is not None:
@@ -199,15 +205,18 @@ def _run_with_retries(fn: Callable[[int], Any], i: int, what: str,
                             max_attempts, type(e).__name__, e, delay)
                 tracing.instant("task_retry", task=i, attempt=attempt,
                                 error=type(e).__name__, what=what)
-                if query is not None:
-                    if query.wait_cancelled(delay):
-                        query.check()
-                elif cancel is not None:
-                    # interruptible by a sibling's win: the loser must
-                    # not sit out a capped backoff before noticing
-                    cancel.wait(delay)
-                else:
-                    time.sleep(delay)
+                with tracing.span("backoff_wait", task=i, attempt=attempt,
+                                  what=what, delay_s=round(delay, 4)):
+                    if query is not None:
+                        if query.wait_cancelled(delay):
+                            query.check()
+                    elif cancel is not None:
+                        # interruptible by a sibling's win: the loser
+                        # must not sit out a capped backoff before
+                        # noticing
+                        cancel.wait(delay)
+                    else:
+                        time.sleep(delay)
                 wait_ns += int(delay * 1e9)
                 attempt += 1
 
@@ -257,7 +266,7 @@ def run_tasks(fn: Callable[[int], Any], n: int, timeout_s: float,
               what: str, max_workers: Optional[int] = None,
               query=None, remote=None) -> List[Any]:
     from blaze_tpu import config
-    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.bridge import tracing, xla_stats
     deadline = time.monotonic() + timeout_s
     if remote is not None:
         # process-isolated tasks don't contend on the GIL: give every
@@ -285,9 +294,16 @@ def run_tasks(fn: Callable[[int], Any], n: int, timeout_s: float,
     speculated = False
     wave_t0 = time.monotonic()
 
+    # attempt threads don't inherit the caller's thread-local trace
+    # context (the scheduler's query id); re-apply it around each attempt
+    caller_ctx = tracing.current_context()
+
     def submit(executor, att: _Attempt) -> None:
-        att.future = executor.submit(_run_with_retries, fn, att.task,
-                                     what, query, remote, deadline, att)
+        def call():
+            with tracing.execution_context(**caller_ctx):
+                return _run_with_retries(fn, att.task, what, query,
+                                         remote, deadline, att)
+        att.future = executor.submit(call)
         by_future[att.future] = att
 
     for i in range(n):
@@ -317,8 +333,19 @@ def run_tasks(fn: Callable[[int], Any], n: int, timeout_s: float,
                      "attempt(s) race the commit", what, winner.task,
                      len(losers))
             return
+        atts = attempts[winner.task]
+        tracing.instant("speculation_win", task=winner.task, what=what,
+                        query=getattr(query, "query_id", None),
+                        winner_attempt=atts.index(winner),
+                        winner_speculative=winner.speculative,
+                        loser_attempts=[atts.index(a) for a in losers])
         for a in losers:
             a.cancel.set()
+            tracing.instant("speculation_loser", task=winner.task,
+                            what=what,
+                            query=getattr(query, "query_id", None),
+                            attempt=atts.index(a),
+                            winner_attempt=atts.index(winner))
         xla_stats.note_speculation(losers_cancelled=len(losers))
 
     while len(results) < n:
@@ -438,6 +465,12 @@ def run_tasks(fn: Callable[[int], Any], n: int, timeout_s: float,
                     submit(spec_pool, dup)
                     atts.append(dup)
                     pending.add(dup.future)
+                    tracing.instant(
+                        "speculation_attempt", task=i, what=what,
+                        query=getattr(query, "query_id", None),
+                        attempt=len(atts) - 1,
+                        running_s=round(now - newest.started, 4),
+                        cutoff_s=round(cutoff, 4))
                     xla_stats.note_speculation(
                         attempts=1, waves=0 if speculated else 1)
                     speculated = True

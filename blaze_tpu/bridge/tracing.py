@@ -8,12 +8,31 @@ buffered in memory and optionally streamed to a JSONL file (one JSON
 object per line: name, t0/t1 ns, thread, context, attrs) that loads
 directly into Perfetto-style tooling or pandas.
 
-Disabled tracing is a near-free boolean check — operators call
-`span(...)` unconditionally.
+Since the worker pool (PR 11) the runtime spans process boundaries, so
+the tracer does too: `wire_context()` packs the current (query, stage,
+task, attempt, parent-span) context into the task message riding the
+CRC32C-framed worker protocol, the child adopts it under
+`remote_task_scope()` and buffers its spans locally, heartbeat/result
+frames carry the buffered spans back (`take_buffered()`), and the
+parent stitches them into the one per-query trace via `ingest()` with
+a monotonic-clock rebase — child `perf_counter_ns` origins differ per
+process, so the frame carries the child clock at send time and the
+parent shifts every span by the observed offset.
+
+Tracing can be enabled programmatically (`start_tracing()`) or from
+conf (`auron.tpu.trace.enable`, probed once lazily, same one-shot
+pattern as faults._current).  Disabled tracing is a near-free boolean
+check — operators call `span(...)` unconditionally.
+
+Every span name the runtime can emit is registered in SPAN_NAMES
+(enforced by tests/test_span_names.py: undocumented or dead names fail
+conformance).  Names with a trailing `*` are prefix families — the
+suffix is dynamic (operator class names).
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -21,14 +40,133 @@ from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 _enabled = False
+_conf_probed = False  # lazy one-shot auron.tpu.trace.enable probe
 _lock = threading.Lock()
 _spans: List[dict] = []
 _MAX_SPANS = 100_000
 _sink = None  # open JSONL file, when exporting
 _tls = threading.local()
+_ids = itertools.count(1)
+
+# Worker-child mode: spans are buffered locally and shipped back to the
+# parent in heartbeat/result frames instead of accumulating here.
+_child_mode = False
+_child_buf: List[dict] = []
+_CHILD_BUF_CAP = 10_000
+
+#: Registry of every span/instant name the runtime emits, with the
+#: one-line doc rendered into docs/observability.md.  A trailing `*`
+#: marks a prefix family (dynamic suffix).
+SPAN_NAMES: Dict[str, str] = {
+    # -- spans (dur_ns > 0) -------------------------------------------
+    "task": "per-partition runtime stream covering one task's operator "
+            "chain (bridge/runtime.py; mode=sync|producer)",
+    "task_attempt": "one scheduled attempt of a task in the wave loop, "
+                    "local or routed to a pool worker (bridge/tasks.py; "
+                    "attrs task/attempt/what/speculative/remote)",
+    "backoff_wait": "retry backoff sleep between task attempts "
+                    "(bridge/tasks.py; interruptible by cancel/deadline)",
+    "admission_wait": "queue wait from QueryService.submit() to the "
+                      "worker pop that starts running the query "
+                      "(serving/service.py; attrs query/tenant)",
+    "worker_task": "child-process execution of a remote task inside a "
+                   "pool worker (parallel/workers.py child_main)",
+    "device_exchange": "on-device collective shuffle dispatch for one "
+                       "stage (plan/stages.py -> DeviceExchange)",
+    "rss_exchange": "remote-shuffle-service exchange tier for one stage "
+                    "(plan/stages.py)",
+    "shuffle_exchange": "file-tier shuffle exchange for one stage "
+                        "(plan/stages.py)",
+    "stage_recovery": "lineage re-run of a poisoned producer map task "
+                      "after FetchFailedError (plan/stages.py)",
+    "stage_loop_chunk": "one fused device-loop chunk dispatch folding a "
+                        "window of batches in a single XLA call "
+                        "(runtime/loop.py; overlap vs device_exchange "
+                        "is the ROADMAP item-4 signal)",
+    "stream_epoch": "one streaming micro-batch epoch: poll -> plan -> "
+                    "window/watermark -> sink attempt -> checkpoint "
+                    "commit (streaming/executor.py; attrs epoch/rows)",
+    "explain_analyze": "whole-query profiled execution (plan/explain.py)",
+    "operator:*": "per-operator stream total accumulated across next() "
+                  "calls; suffix is the ExecutionPlan class name "
+                  "(ops/base.py stream meter)",
+    # -- instants (dur_ns == 0) ---------------------------------------
+    "task_retry": "a failed attempt was classified retryable and will "
+                  "back off and retry (bridge/tasks.py)",
+    "fault_injected": "a seeded chaos fault fired at a registered site "
+                      "(faults.py)",
+    "xla_compile": "an XLA kernel compiled (cache miss) with wall ns "
+                   "(bridge/xla_stats.py meter_jit)",
+    "device_shuffle_fallback": "device collective exchange declined or "
+                               "failed; stage fell back a tier "
+                               "(plan/stages.py)",
+    "rss_shuffle_fallback": "RSS exchange tier failed; stage fell back "
+                            "to the file tier (plan/stages.py)",
+    "stage_loop_fallback": "fused device loop bailed; stage re-ran "
+                           "staged per-batch (plan/stages.py)",
+    "quota_breach": "per-query memory quota breach climbed one degrade "
+                    "rung (memory/manager.py; attrs query/used/quota/"
+                    "rung)",
+    "mem_spill": "a memory consumer spilled under pressure or quota "
+                 "shed (memory/manager.py; attrs consumer/bytes/query)",
+    "worker_heartbeat": "pool-worker child liveness beat observed while "
+                        "a task runs (parallel/workers.py)",
+    "worker_cancel_escalation": "cancel/abandon escalated on a worker "
+                                "slot: cancel msg, SIGTERM or SIGKILL "
+                                "(parallel/workers.py; attrs action)",
+    "speculation_attempt": "a duplicate attempt was hedged against a "
+                           "straggler (bridge/tasks.py; attrs task/"
+                           "attempt)",
+    "speculation_win": "an attempt committed first; links the "
+                       "winner/loser attempt pair (bridge/tasks.py; "
+                       "attrs task/winner_attempt/loser_attempts)",
+    "speculation_loser": "a losing attempt was cancelled or abandoned "
+                         "after the sibling committed (bridge/tasks.py)",
+    "stream_recovery": "streaming epoch restored from the latest "
+                       "checkpoint manifest after a retryable failure "
+                       "(streaming/executor.py)",
+    "flight_dump": "the flight recorder wrote a post-mortem artifact "
+                   "for a fatally-classified query (bridge/context.py)",
+}
+
+
+def register_span(name: str, doc: str) -> None:
+    """Escape hatch for out-of-tree emitters; mirrors
+    faults.register_site so conformance keeps covering them."""
+    SPAN_NAMES[name] = doc
+
+
+def _check_name(name: str) -> None:
+    """Emitting an unregistered span name is a bug, not telemetry: the
+    registry is the conformance contract (tests/test_span_names.py).
+    Only reached when tracing is ON — the disabled path never gets here."""
+    if name in SPAN_NAMES:
+        return
+    i = name.find(":")
+    if i > 0 and name[:i + 1] + "*" in SPAN_NAMES:
+        return
+    raise ValueError(
+        f"unregistered span name {name!r}: add it to tracing.SPAN_NAMES "
+        "(or register_span) and document it in docs/observability.md")
+
+
+def _probe_conf() -> None:
+    global _conf_probed, _enabled
+    with _lock:
+        if _conf_probed:
+            return
+        _conf_probed = True
+    try:
+        from blaze_tpu import config
+        if config.TRACE_ENABLE.get():
+            _enabled = True
+    except Exception:
+        pass
 
 
 def enabled() -> bool:
+    if not _conf_probed:
+        _probe_conf()
     return _enabled
 
 
@@ -36,6 +174,13 @@ def _ctx_stack() -> List[Dict[str, Any]]:
     stack = getattr(_tls, "ctx", None)
     if stack is None:
         stack = _tls.ctx = []
+    return stack
+
+
+def _span_stack() -> List[int]:
+    stack = getattr(_tls, "span_stack", None)
+    if stack is None:
+        stack = _tls.span_stack = []
     return stack
 
 
@@ -63,16 +208,25 @@ def execution_context(**fields):
 def span(name: str, **attrs):
     """Emit one span covering the `with` body.  No-op when disabled."""
     if not _enabled:
-        yield
-        return
+        if _conf_probed or not enabled():
+            yield
+            return
+    _check_name(name)
+    sid = next(_ids)
+    stack = _span_stack()
+    parent = stack[-1] if stack else None
+    stack.append(sid)
     t0 = time.perf_counter_ns()
     try:
         yield
     finally:
         t1 = time.perf_counter_ns()
+        stack.pop()
         record = {"name": name, "t0_ns": t0, "t1_ns": t1,
-                  "dur_ns": t1 - t0,
+                  "dur_ns": t1 - t0, "sid": sid,
                   "thread": threading.current_thread().name}
+        if parent is not None:
+            record["parent"] = parent
         ctx = current_context()
         if ctx:
             record["ctx"] = ctx
@@ -85,11 +239,16 @@ def emit_span(name: str, dur_ns: int, **attrs) -> None:
     """Record a span whose duration was measured externally (the operator
     stream meter accumulates time across many next() calls)."""
     if not _enabled:
-        return
+        if _conf_probed or not enabled():
+            return
+    _check_name(name)
     t1 = time.perf_counter_ns()
     record = {"name": name, "t0_ns": t1 - int(dur_ns), "t1_ns": t1,
-              "dur_ns": int(dur_ns),
+              "dur_ns": int(dur_ns), "sid": next(_ids),
               "thread": threading.current_thread().name}
+    stack = _span_stack()
+    if stack:
+        record["parent"] = stack[-1]
     ctx = current_context()
     if ctx:
         record["ctx"] = ctx
@@ -101,10 +260,16 @@ def emit_span(name: str, dur_ns: int, **attrs) -> None:
 def instant(name: str, **attrs) -> None:
     """Zero-duration event (e.g. an XLA compile)."""
     if not _enabled:
-        return
+        if _conf_probed or not enabled():
+            return
+    _check_name(name)
     t = time.perf_counter_ns()
     record = {"name": name, "t0_ns": t, "t1_ns": t, "dur_ns": 0,
+              "sid": next(_ids),
               "thread": threading.current_thread().name}
+    stack = _span_stack()
+    if stack:
+        record["parent"] = stack[-1]
     ctx = current_context()
     if ctx:
         record["ctx"] = ctx
@@ -115,6 +280,10 @@ def instant(name: str, **attrs) -> None:
 
 def _emit(record: dict) -> None:
     with _lock:
+        if _child_mode:
+            _child_buf.append(record)
+            del _child_buf[:-_CHILD_BUF_CAP]
+            return
         _spans.append(record)
         del _spans[:-_MAX_SPANS]
         if _sink is not None:
@@ -122,9 +291,115 @@ def _emit(record: dict) -> None:
             _sink.flush()
 
 
+# -- cross-process propagation ---------------------------------------------
+
+_WIRE_KEYS = ("query", "stage", "task", "attempt", "what", "partition")
+
+
+def wire_context(**extra) -> Optional[dict]:
+    """Compact trace context to ride the worker wire protocol: the
+    current (query, stage, task, attempt) plus the enclosing span id as
+    `parent`.  Returns None when tracing is off, so the task message
+    grows by nothing on the disabled path."""
+    if not enabled():
+        return None
+    ctx = current_context()
+    out = {k: ctx[k] for k in _WIRE_KEYS if k in ctx}
+    stack = getattr(_tls, "span_stack", None)
+    if stack:
+        out["parent"] = stack[-1]
+    for k, v in extra.items():
+        if v is not None:
+            out[k] = v
+    return out
+
+
+@contextmanager
+def remote_task_scope(wire_ctx: Optional[dict]):
+    """Child-process side: adopt a parent trace context for the duration
+    of one task.  Enables span collection in child-buffer mode (spans go
+    to a local buffer drained by take_buffered() into heartbeat/result
+    frames) and parents every child span under the dispatching span."""
+    if not wire_ctx:
+        yield
+        return
+    global _enabled, _conf_probed, _child_mode
+    with _lock:
+        saved = (_enabled, _conf_probed, _child_mode)
+        _enabled = True
+        _conf_probed = True
+        _child_mode = True
+    parent = wire_ctx.get("parent")
+    fields = {k: v for k, v in wire_ctx.items() if k != "parent"}
+    stack = _span_stack()
+    if parent is not None:
+        stack.append(parent)
+    try:
+        with execution_context(**fields):
+            yield
+    finally:
+        if parent is not None:
+            stack.pop()
+        with _lock:
+            _enabled, _conf_probed, _child_mode = saved
+
+
+def take_buffered() -> List[dict]:
+    """Drain the child-mode span buffer (heartbeat/result frame payload)."""
+    with _lock:
+        out = list(_child_buf)
+        del _child_buf[:]
+    return out
+
+
+def ingest(records: Optional[List[dict]], worker=None,
+           clock_ns: Optional[int] = None) -> int:
+    """Parent side: stitch spans shipped back from a worker child into
+    the process trace.  `worker` tags the originating slot; `clock_ns`
+    is the child's perf_counter_ns at frame-send time, used to rebase
+    the child's clock origin onto ours (transit latency is absorbed
+    into the offset — fine at heartbeat granularity)."""
+    if not records or not _enabled:
+        return 0
+    offset = 0
+    if clock_ns is not None:
+        offset = time.perf_counter_ns() - int(clock_ns)
+    with _lock:
+        for r in records:
+            if not isinstance(r, dict):
+                continue
+            if worker is not None:
+                r.setdefault("worker", worker)
+            if offset:
+                r["t0_ns"] = r.get("t0_ns", 0) + offset
+                r["t1_ns"] = r.get("t1_ns", 0) + offset
+            _spans.append(r)
+            if _sink is not None:
+                _sink.write(json.dumps(r, default=str) + "\n")
+        del _spans[:-_MAX_SPANS]
+        if _sink is not None:
+            _sink.flush()
+    try:
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_obs(spans_ingested=len(records))
+    except Exception:
+        pass
+    return len(records)
+
+
+def spans_for_query(query_id) -> List[dict]:
+    """All buffered spans whose context names this query (the timeline
+    endpoint and the flight recorder read this)."""
+    with _lock:
+        return [r for r in _spans
+                if r.get("ctx", {}).get("query") == query_id]
+
+
+# -- lifecycle --------------------------------------------------------------
+
 def start_tracing(path: Optional[str] = None) -> None:
     """Enable span collection; `path` additionally streams JSONL there."""
-    global _enabled, _sink
+    global _enabled, _sink, _conf_probed
     with _lock:
         _spans.clear()
         if _sink is not None:
@@ -132,6 +407,7 @@ def start_tracing(path: Optional[str] = None) -> None:
             _sink = None
         if path:
             _sink = open(path, "w")
+        _conf_probed = True
     _enabled = True
 
 
@@ -144,6 +420,16 @@ def stop_tracing() -> List[dict]:
             _sink.close()
             _sink = None
         return list(_spans)
+
+
+def reset_conf_probe() -> None:
+    """Forget the lazy auron.tpu.trace.enable probe (tests/bench)."""
+    global _conf_probed, _enabled, _child_mode
+    with _lock:
+        _conf_probed = False
+        _enabled = False
+        _child_mode = False
+        del _child_buf[:]
 
 
 def spans() -> List[dict]:

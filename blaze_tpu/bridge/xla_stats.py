@@ -141,6 +141,12 @@ _speculation = {"speculation_waves": 0, "speculation_attempts": 0,
                 "speculation_loser_commits_rejected": 0,
                 "speculation_duplicate_commits": 0}
 
+# Observability-plane accounting (PR 13): spans stitched in from worker
+# children, flight-recorder dumps written, and query-profile LRU
+# evictions (bridge/profiling.py store bound).
+_obs = {"obs_spans_ingested": 0, "obs_flight_dumps": 0,
+        "obs_profile_evictions": 0}
+
 # Bounded raw-sample reservoirs feeding tail-latency percentiles
 # (bench.py --workers / --speculate): successful task-attempt durations
 # and run_tasks wave walls, in ns.  Lists, so NOT folded into
@@ -148,6 +154,12 @@ _speculation = {"speculation_waves": 0, "speculation_attempts": 0,
 _task_duration_ns: List[int] = []
 _wave_wall_ns: List[int] = []
 _SAMPLE_CAP = 8192
+
+# Prometheus histogram bucket upper bounds (seconds) for the task-
+# latency and wave-wall exposition (bridge/profiling.py renders these
+# as real `# TYPE ... histogram` families, not gauges).
+HISTOGRAM_BUCKETS_S = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                       1.0, 2.5, 5.0, 10.0, 30.0)
 
 # Distinct signatures beyond this on one kernel = shape churn (the
 # recompilation-storm smell: unpadded dynamic shapes hitting jit).
@@ -404,6 +416,45 @@ def duration_samples() -> Dict[str, List[int]]:
     with _lock:
         return {"task_ns": list(_task_duration_ns),
                 "wave_ns": list(_wave_wall_ns)}
+
+
+def note_obs(spans_ingested: int = 0, flight_dumps: int = 0,
+             profile_evictions: int = 0) -> None:
+    with _lock:
+        _obs["obs_spans_ingested"] += spans_ingested
+        _obs["obs_flight_dumps"] += flight_dumps
+        _obs["obs_profile_evictions"] += profile_evictions
+
+
+def obs_stats() -> dict:
+    with _lock:
+        return dict(_obs)
+
+
+def _histogram(samples_ns: List[int]) -> Dict[str, Any]:
+    """Cumulative-bucket Prometheus histogram over an ns reservoir:
+    {"buckets": [(le_seconds, cumulative_count), ...], "sum": seconds,
+    "count": n}.  Buckets are HISTOGRAM_BUCKETS_S plus +Inf."""
+    counts = [0] * len(HISTOGRAM_BUCKETS_S)
+    total = 0.0
+    for ns in samples_ns:
+        s = ns / 1e9
+        total += s
+        for bi, le in enumerate(HISTOGRAM_BUCKETS_S):
+            if s <= le:
+                counts[bi] += 1  # every bucket with s <= le: cumulative
+    return {"buckets": list(zip(HISTOGRAM_BUCKETS_S, counts)),
+            "sum": total, "count": len(samples_ns)}
+
+
+def latency_histograms() -> Dict[str, Dict[str, Any]]:
+    """Histogram views of the duration reservoirs for /metrics.prom:
+    task-attempt latency and run_tasks wave wall, in seconds."""
+    with _lock:
+        task = list(_task_duration_ns)
+        wave = list(_wave_wall_ns)
+    return {"task_duration_seconds": _histogram(task),
+            "wave_wall_seconds": _histogram(wave)}
 
 
 def note_device_exchange(rows: int, nbytes: int,
@@ -664,6 +715,7 @@ def snapshot() -> dict:
     flat.update(stream_stats())
     flat.update(worker_stats())
     flat.update(speculation_stats())
+    flat.update(obs_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -699,6 +751,8 @@ def reset() -> None:
             _workers[k] = 0
         for k in _speculation:
             _speculation[k] = 0
+        for k in _obs:
+            _obs[k] = 0
         _task_duration_ns.clear()
         _wave_wall_ns.clear()
         _bucket_caps.clear()

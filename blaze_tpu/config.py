@@ -734,6 +734,31 @@ INPUT_BATCH_STATISTICS = bool_conf(
     "auron.enableInputBatchStatistics", False,
     "Record per-batch row/byte statistics in the runtime metric tree.",
     category="observability")
+TRACE_ENABLE = bool_conf(
+    "auron.tpu.trace.enable", False,
+    "Collect execution spans process-wide without an explicit "
+    "start_tracing() call (bridge/tracing.py).  Probed once lazily; "
+    "disabled tracing stays a near-free boolean check at every span site.",
+    category="observability")
+FLIGHT_RECORDER_ENABLE = bool_conf(
+    "auron.tpu.flightRecorder.enable", True,
+    "Dump a post-mortem JSON artifact (recent spans, counter deltas, "
+    "config snapshot) when a query dies with a fatal classification — "
+    "quota kill, deadline, pool-unavailable, stream recovery exhaustion "
+    "(bridge/context.py flight recorder).", category="observability")
+FLIGHT_RECORDER_DIR = str_conf(
+    "auron.tpu.flightRecorder.dir", "",
+    "Directory for flight-recorder dumps; empty uses "
+    "<system tempdir>/blaze_flight.", category="observability")
+FLIGHT_RECORDER_SPANS = int_conf(
+    "auron.tpu.flightRecorder.maxSpans", 256,
+    "Most-recent span count retained in each flight-recorder dump.",
+    category="observability")
+PROFILE_STORE_MAX = int_conf(
+    "auron.tpu.profile.maxEntries", 64,
+    "LRU capacity of the in-memory query-profile store served at "
+    "/profile/<qid>; evictions are counted in obs_profile_evictions.",
+    category="observability")
 UDAF_FALLBACK_ENABLE = bool_conf(
     "auron.udafFallback.enable", True,
     "Allow typed-imperative UDAFs to run through the host round-trip "

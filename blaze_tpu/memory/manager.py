@@ -27,6 +27,20 @@ from blaze_tpu.memory.spill import SpillMetrics
 MEM_SPILL_FACTOR = 0.8  # consumer must shrink below cap*factor after spill
 
 
+def _trace_spill(consumer, released: int, cause: str) -> None:
+    """mem_spill trace instant: which consumer shed how much, why, and
+    for which query — the attribution surface's spill-bytes source."""
+    try:
+        from blaze_tpu.bridge import tracing
+        tracing.instant(
+            "mem_spill", consumer=consumer.name, bytes=released,
+            cause=cause,
+            query=getattr(getattr(consumer, "query", None),
+                          "query_id", None))
+    except Exception:
+        pass
+
+
 class MemConsumer:
     """Spillable operator state (ref MemConsumer trait, lib.rs:202).
 
@@ -170,6 +184,7 @@ class MemManager:
                     released = updated.spill()
                     self.total_spill_count += 1
                     self.total_spilled_bytes += released
+                    _trace_spill(updated, released, "cross-query-release")
                 self._attribute_shed(updated, released,
                                      global_pressure=True)
             used = self.mem_used
@@ -186,6 +201,7 @@ class MemManager:
                 released = updated.spill()
                 self.total_spill_count += 1
                 self.total_spilled_bytes += released
+                _trace_spill(updated, released, "injected-pressure")
             # per-query quota first: a query over ITS budget sheds its
             # own state (and climbs the degradation ladder) before its
             # pressure is socialized across the pool
@@ -225,6 +241,7 @@ class MemManager:
                 released = c.spill()
                 self.total_spill_count += 1
                 self.total_spilled_bytes += released
+                _trace_spill(c, released, "pool-pressure")
                 self._attribute_shed(c, released, global_pressure=True)
 
     def _attribute_shed(self, c: MemConsumer, released: int,
@@ -293,6 +310,7 @@ class MemManager:
             released = c.spill()
             self.total_spill_count += 1
             self.total_spilled_bytes += released
+            _trace_spill(c, released, "query-quota")
             self._attribute_shed(c, released)
 
     # -- diagnostics (ref lib.rs:143 dump_status) -------------------------

@@ -51,10 +51,11 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Set
 
 from blaze_tpu import faults
+from blaze_tpu.bridge import tracing
 from blaze_tpu.faults import FetchFailedError, WorkerCrashed, \
     classify_exception
-from blaze_tpu.shuffle.ipc import CODEC_RAW, FLAG_CRC, _check_frame_byte, \
-    _CRC, _crc32c, _HEADER, _verify_crc
+from blaze_tpu.shuffle.ipc import FLAG_CRC, _check_frame_byte, \
+    _CRC, _HEADER, _verify_crc, pack_control_frame
 
 log = logging.getLogger("blaze_tpu.workers")
 
@@ -84,8 +85,7 @@ class RemoteTaskError(RuntimeError):
 
 def _send_msg(fp, obj: Any, lock: Optional[threading.Lock] = None) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    frame = (_HEADER.pack(CODEC_RAW | FLAG_CRC, len(payload))
-             + _CRC.pack(_crc32c(payload)) + payload)
+    frame = pack_control_frame(payload)
     if lock is not None:
         with lock:
             fp.write(frame)
@@ -241,6 +241,11 @@ class WorkerPool:
                             self._cond.notify_all()
                 elif kind == "heartbeat":
                     slot.last_heartbeat = time.monotonic()
+                    if msg.get("spans"):
+                        # mid-task child spans stream back in heartbeat
+                        # frames; rebase the child clock onto ours
+                        tracing.ingest(msg["spans"], worker=slot.id,
+                                       clock_ns=msg.get("mono_ns"))
                 else:
                     slot.last_heartbeat = time.monotonic()
                     inbox.put(msg)
@@ -459,6 +464,11 @@ class WorkerPool:
                "conf": config.conf.snapshot(),
                "directive": self._directive(what),
                "heartbeat_ms": self.heartbeat_ms}
+        trace = tracing.wire_context(worker=slot.id)
+        if trace is not None:
+            # trace context rides the framed wire protocol; absent
+            # entirely when tracing is off (zero disabled-path bytes)
+            msg["trace"] = trace
         try:
             _send_msg(proc.stdin, msg, slot.write_lock)
         except (OSError, ValueError) as e:
@@ -526,6 +536,8 @@ class WorkerPool:
         the normal crash path (with budget charge — it really died)."""
         from blaze_tpu.bridge import xla_stats
         xla_stats.note_worker_cancel()
+        tracing.instant("worker_cancel_escalation", worker=slot.id,
+                        action="abandon")
         liveness_s = self.liveness_ms / 1e3
 
         def drain() -> None:
@@ -570,6 +582,8 @@ class WorkerPool:
         with self._lock:
             slot.cancel_kill = True
         xla_stats.note_worker_cancel()
+        tracing.instant("worker_cancel_escalation", worker=slot.id,
+                        action="cancel")
         self._escalate_stop(slot, task_id)
         with self._cond:
             proc = slot.proc
@@ -601,6 +615,11 @@ class WorkerPool:
         raise WorkerCrashed(worker_id=slot.id, exit_code=rc, reason=reason)
 
     def _finish(self, slot: _Slot, res: Dict[str, Any]) -> Any:
+        if res.get("spans"):
+            # final child spans ride the result frame — including an
+            # abandoned speculation loser's (the drainer lands here too)
+            tracing.ingest(res["spans"], worker=slot.id,
+                           clock_ns=res.get("mono_ns"))
         with self._cond:
             slot.tasks_done += 1
             if slot.state == _BUSY:
@@ -848,11 +867,17 @@ def _run_child_task(msg: Dict[str, Any], out, out_lock) -> Dict[str, Any]:
         # deadline — not this sleep expiring — is what ends us
         time.sleep(hang_ms / 1e3)
     stop_beat = threading.Event()
+    trace = msg.get("trace")
 
     def _beat() -> None:
         while not stop_beat.wait(hb_s):
+            beat: Dict[str, Any] = {"kind": "heartbeat"}
+            if trace:
+                tracing.instant("worker_heartbeat", pid=os.getpid())
+                beat["spans"] = tracing.take_buffered()
+                beat["mono_ns"] = time.perf_counter_ns()
             try:
-                _send_msg(out, {"kind": "heartbeat"}, out_lock)
+                _send_msg(out, beat, out_lock)
             except Exception:
                 return
 
@@ -867,7 +892,16 @@ def _run_child_task(msg: Dict[str, Any], out, out_lock) -> Dict[str, Any]:
             # be mistaken for dead
             time.sleep(directive["delay_ms"] / 1e3)
         fn = _resolve_fn(msg["fn"])
-        value = fn(*msg.get("args", ()))
+        if trace:
+            # adopt the parent trace context: spans emitted while the
+            # task runs buffer locally and ship home in heartbeat
+            # frames (above) and in this result frame
+            with tracing.remote_task_scope(trace), \
+                    tracing.span("worker_task", pid=os.getpid(),
+                                 fn=msg["fn"]):
+                value = fn(*msg.get("args", ()))
+        else:
+            value = fn(*msg.get("args", ()))
         if kill_timer is not None:
             # the task won the race with the kill timer: worker-crash
             # means this process DIES.  Committed output files may
@@ -875,17 +909,25 @@ def _run_child_task(msg: Dict[str, Any], out, out_lock) -> Dict[str, Any]:
             # lost-executor shape the parent's map-output re-validation
             # and retry-on-another-worker handle
             os.kill(os.getpid(), signal.SIGKILL)
-        return {"kind": "result", "task_id": msg["task_id"], "ok": True,
-                "value": value}
+        reply = {"kind": "result", "task_id": msg["task_id"], "ok": True,
+                 "value": value}
+        if trace:
+            reply["spans"] = tracing.take_buffered()
+            reply["mono_ns"] = time.perf_counter_ns()
+        return reply
     except BaseException as e:
         if kill_timer is not None:
             os.kill(os.getpid(), signal.SIGKILL)
         fetch = None
         if isinstance(e, FetchFailedError):
             fetch = (e.stage_id, e.map_id)
-        return {"kind": "result", "task_id": msg["task_id"], "ok": False,
-                "error_type": type(e).__name__, "error_msg": str(e),
-                "classify": classify_exception(e), "fetch": fetch}
+        reply = {"kind": "result", "task_id": msg["task_id"], "ok": False,
+                 "error_type": type(e).__name__, "error_msg": str(e),
+                 "classify": classify_exception(e), "fetch": fetch}
+        if trace:
+            reply["spans"] = tracing.take_buffered()
+            reply["mono_ns"] = time.perf_counter_ns()
+        return reply
     finally:
         stop_beat.set()
         if beater is not None:

@@ -1078,6 +1078,14 @@ class DagScheduler:
 
     def run_collect(self, plan: Dict[str, Any]) -> pa.Table:
         """Execute the whole DAG; returns the result stage's output."""
+        from blaze_tpu.bridge import tracing
+        # every span the scheduler (and anything below it) emits carries
+        # the owning query id, so one query stitches into one trace
+        with tracing.execution_context(
+                query=getattr(self._query, "query_id", None)):
+            return self._run_collect(plan)
+
+    def _run_collect(self, plan: Dict[str, Any]) -> pa.Table:
         from blaze_tpu.bridge.runtime import NativeExecutionRuntime
         from blaze_tpu.plan.proto_serde import task_definition_to_bytes
         from blaze_tpu.plan.types import schema_from_dict
@@ -1235,6 +1243,15 @@ class DagScheduler:
             if leftovers:
                 report["dirs"].append(self._dir)
                 report["files"].extend(leftovers)
+        # not a leak: the flight recorder's post-mortem artifact for this
+        # query, referenced here so failure triage starts from the leak
+        # report.  Key present only when a dump exists.
+        qid = getattr(self._query, "query_id", None)
+        if qid is not None:
+            from blaze_tpu.bridge import context as _bctx
+            dump = _bctx.flight_dump(qid)
+            if dump is not None and dump.get("path"):
+                report["flight_dump"] = [dump["path"]]
         return report
 
     def __enter__(self) -> "DagScheduler":

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from blaze_tpu import config, faults
-from blaze_tpu.bridge import xla_stats
+from blaze_tpu.bridge import tracing, xla_stats
 from blaze_tpu.bridge.context import current_task
 from blaze_tpu.bridge.xla_stats import meter_jit
 from blaze_tpu.parallel.stage import hash_agg_step, init_hash_carry
@@ -161,33 +161,38 @@ def run_partition(program, partition: int, ctx: str = "",
             # cooperative cancel, fault site, overflow scalar
             task.check_running()
             faults.maybe_fail("device-loop", stage=ctx, chunk=ci)
-            rows += int(np.asarray(jnp.sum(masks)))
-            cols_stacked, masks = _pad_chunk(cols_stacked, masks, chunk)
-            start = 0
-            while True:
-                carry, ovf_seen, first_ovf = fold(
-                    carry, cols_stacked, masks,
-                    jnp.asarray(start, jnp.int32))
-                fold_calls += 1
-                if not bool(ovf_seen):
-                    break
-                if not program.grow:
-                    # PARTIAL mode: skip semantics (batch-local dedup
-                    # pass-through) belong to the staged path; growing
-                    # here would diverge from its bit pattern
-                    raise StageLoopFallback(
-                        "hash table overflow in partial mode")
-                if slots * 2 > _MAX_SLOTS:
-                    raise StageLoopFallback(
-                        f"table would exceed {_MAX_SLOTS} slots")
-                slots *= 2
-                bigger, re_ovf, _ = _rehash_jit(program.kinds,
-                                                slots, lane)(carry)
-                if int(re_ovf) > 0:
-                    continue  # rare probe clustering: double again
-                carry = bigger
-                regrows += 1
-                start = int(first_ovf)
+            with tracing.span("stage_loop_chunk", stage=ctx,
+                              partition=partition, chunk=ci,
+                              batches=count):
+                rows += int(np.asarray(jnp.sum(masks)))
+                cols_stacked, masks = _pad_chunk(cols_stacked, masks,
+                                                 chunk)
+                start = 0
+                while True:
+                    carry, ovf_seen, first_ovf = fold(
+                        carry, cols_stacked, masks,
+                        jnp.asarray(start, jnp.int32))
+                    fold_calls += 1
+                    if not bool(ovf_seen):
+                        break
+                    if not program.grow:
+                        # PARTIAL mode: skip semantics (batch-local
+                        # dedup pass-through) belong to the staged path;
+                        # growing here would diverge from its bit
+                        # pattern
+                        raise StageLoopFallback(
+                            "hash table overflow in partial mode")
+                    if slots * 2 > _MAX_SLOTS:
+                        raise StageLoopFallback(
+                            f"table would exceed {_MAX_SLOTS} slots")
+                    slots *= 2
+                    bigger, re_ovf, _ = _rehash_jit(program.kinds,
+                                                    slots, lane)(carry)
+                    if int(re_ovf) > 0:
+                        continue  # rare probe clustering: double again
+                    carry = bigger
+                    regrows += 1
+                    start = int(first_ovf)
             ci += 1
             batches += count
             task.loop_chunks = ci

@@ -31,6 +31,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional
 
 from blaze_tpu import config, faults
+from blaze_tpu.bridge import context as bridge_context
+from blaze_tpu.bridge import tracing
 from blaze_tpu.bridge.context import query_scope
 from blaze_tpu.serving.context import QueryCancelled, QueryContext
 
@@ -207,19 +209,31 @@ class QueryService:
     # -- execution ------------------------------------------------------
     def _run(self, handle: QueryHandle, plan: Dict[str, Any]) -> None:
         ctx = handle.ctx
+        queued_s = time.monotonic() - handle.submitted_at
         with self._lock:
             self._queued -= 1
-            if ctx.cancelled:
+            shed = ctx._cancel_exception() if ctx.cancelled else None
+            if shed is None:
+                self._running += 1
+                handle.status = "running"
+            else:
                 # cancelled while queued (explicit cancel or deadline
                 # passed in the queue): shed at pop, zero work done
-                self._finish_locked(handle, error=ctx._cancel_exception())
-                return
-            self._running += 1
-            handle.status = "running"
+                self._finish_locked(handle, error=shed)
+        if shed is not None:
+            self._maybe_flight_dump(handle)
+            return
+        bridge_context.note_query_start(ctx.query_id)
         error: Optional[BaseException] = None
         result: Any = None
         try:
-            with query_scope(ctx):
+            with query_scope(ctx), \
+                    tracing.execution_context(query=ctx.query_id):
+                # the queue wait is a real part of the query's latency:
+                # surface it as a span on the query's own trace, measured
+                # from submit to pool-slot pop
+                tracing.emit_span("admission_wait", int(queued_s * 1e9),
+                                  query=ctx.query_id, tenant=ctx.tenant)
                 ctx.check()  # deadline may have expired in the queue
                 result = self._executor(plan, ctx, handle)
         except BaseException as e:  # noqa: BLE001 - outcome taxonomy below
@@ -227,6 +241,32 @@ class QueryService:
         with self._lock:
             self._running -= 1
             self._finish_locked(handle, error=error, result=result)
+        self._maybe_flight_dump(handle)
+
+    def _maybe_flight_dump(self, handle: QueryHandle) -> None:
+        """Post-mortem: fatally-classified outcomes (deadline, memory
+        quota kill, worker pool unavailable) dump the flight recorder.
+        Runs outside the service lock — the dump does file I/O."""
+        error = handle._error
+        if error is None:
+            return
+        classification = None
+        if isinstance(error, QueryCancelled):
+            kind = handle.ctx._cancel_kind
+            if kind == "deadline":
+                classification = "deadline"
+            elif kind == "mem":
+                classification = "quota-kill"
+        else:
+            try:
+                from blaze_tpu.parallel.workers import WorkerPoolUnavailable
+                if isinstance(error, WorkerPoolUnavailable):
+                    classification = "pool-unavailable"
+            except Exception:
+                pass
+        if classification is not None:
+            bridge_context.record_fatal(handle.query_id, str(error),
+                                        classification)
 
     def _finish_locked(self, handle: QueryHandle,
                        error: Optional[BaseException] = None,
@@ -322,3 +362,14 @@ def cancel_query(query_id: str) -> bool:
     endpoint); True if some service had the query live."""
     return any(svc.cancel(query_id, reason="cancelled via HTTP")
                for svc in list(_services))
+
+
+def tenant_wall_samples() -> Dict[str, List[float]]:
+    """tenant -> completed-query wall seconds, merged across every live
+    service.  Feeds the per-tenant latency histogram in /metrics.prom."""
+    merged: Dict[str, List[float]] = {}
+    for svc in list(_services):
+        with svc._lock:
+            for tenant, walls in svc._tenant_wall_s.items():
+                merged.setdefault(tenant, []).extend(walls)
+    return merged

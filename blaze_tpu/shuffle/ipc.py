@@ -80,6 +80,18 @@ def _verify_crc(expected: int, payload) -> None:
             f"(corrupted block)")
 
 
+def pack_control_frame(payload: bytes) -> bytes:
+    """One raw CRC32C-protected frame around an opaque payload — the
+    worker wire protocol's message framing (parallel/workers.py rides
+    these for pickled task/heartbeat/result messages, trace context
+    included).  Layout matches the shuffle block frames exactly:
+    [CODEC_RAW|FLAG_CRC][u32 len][u32 crc32c][payload], so a torn or
+    bit-rotted control frame surfaces as the same EOFError /
+    ShuffleChecksumError taxonomy the retry machinery classifies."""
+    return (_HEADER.pack(CODEC_RAW | FLAG_CRC, len(payload))
+            + _CRC.pack(_crc32c(payload)) + payload)
+
+
 def _lz4():
     try:
         return pa.Codec("lz4") if pa.Codec.is_available("lz4") else None

@@ -249,9 +249,20 @@ class StreamExecutor:
         self._epoch = resume
         self.recovery_walls_ns.append(time.perf_counter_ns() - t0)
         xla_stats.note_stream_recovery(replayed_epochs=replayed)
+        from blaze_tpu.bridge import tracing
+        tracing.instant("stream_recovery", resume_epoch=resume,
+                        replayed_epochs=replayed,
+                        query=getattr(self._ctx, "query_id", None))
 
     def _run_epoch(self) -> bool:
         """Execute + commit one epoch; returns True at end-of-stream."""
+        from blaze_tpu.bridge import tracing, xla_stats
+        qid = getattr(self._ctx, "query_id", None)
+        with tracing.execution_context(query=qid), \
+                tracing.span("stream_epoch", epoch=self._epoch, query=qid):
+            return self._run_epoch_traced()
+
+    def _run_epoch_traced(self) -> bool:
         from blaze_tpu.bridge import xla_stats
 
         t0 = time.perf_counter_ns()
@@ -359,6 +370,15 @@ class StreamExecutor:
                 except _RETRYABLE as exc:
                     recoveries += 1
                     if recoveries > max_recoveries:
+                        # recovery budget exhausted: this failure is
+                        # fatal to the stream — dump the black box
+                        from blaze_tpu.bridge import context as bctx
+                        bctx.record_fatal(
+                            getattr(self._ctx, "query_id", None)
+                            or f"stream-{id(self):x}",
+                            f"stream recovery exhausted after "
+                            f"{recoveries - 1} recoveries: {exc}",
+                            "stream-recovery-exhausted")
                         raise
                     self._recover()
                     continue

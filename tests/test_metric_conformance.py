@@ -95,3 +95,51 @@ def test_metric_nodes_preseed_baseline_set():
     rows = sum(b.num_rows for b in plan.execute(0))
     assert rows == 50
     check(plan, must_be_live=True)
+
+
+def test_prometheus_exposition_covers_runtime_families():
+    """/metrics.prom conformance: every runtime counter family added
+    since the streaming/worker/speculation/observability PRs must render
+    — a renamed xla_stats key cannot silently drop off the scrape."""
+    from blaze_tpu.bridge import profiling, xla_stats
+
+    MemManager.init(4 << 30)
+    # touch each plane so at least one sample exists per family
+    xla_stats.note_task_duration(25_000_000)
+    xla_stats.note_wave_wall(50_000_000)
+    text = profiling.prometheus_text()
+
+    for family in ("blaze_stream_", "blaze_worker_", "blaze_speculation_",
+                   "blaze_obs_"):
+        assert any(line.startswith(family) and "_total" in line
+                   for line in text.splitlines()), f"missing {family}*"
+    # every key xla_stats exposes for these planes is present by name
+    for k in xla_stats.worker_stats():
+        assert f"blaze_{k}_total" in text, k
+    for k in xla_stats.speculation_stats():
+        assert f"blaze_{k}_total" in text, k
+    for k in xla_stats.obs_stats():
+        assert f"blaze_{k}_total" in text, k
+    for k in xla_stats.stream_stats():
+        want = (f"blaze_{k[:-5]}" if k.endswith("_last")
+                else f"blaze_{k}_total")
+        assert want in text, k
+
+
+def test_prometheus_histograms_render_cumulative_buckets():
+    from blaze_tpu.bridge import profiling, xla_stats
+
+    MemManager.init(4 << 30)
+    xla_stats.note_task_duration(25_000_000)   # 25ms sample
+    xla_stats.note_wave_wall(2_000_000_000)    # 2s sample
+    text = profiling.prometheus_text()
+    for name in ("blaze_task_duration_seconds", "blaze_wave_wall_seconds"):
+        assert f"# TYPE {name} histogram" in text
+        lines = [ln for ln in text.splitlines() if ln.startswith(name)]
+        buckets = [ln for ln in lines if "_bucket{" in ln]
+        assert buckets and any('le="+Inf"' in ln for ln in buckets)
+        assert any(ln.startswith(f"{name}_sum ") for ln in lines)
+        assert any(ln.startswith(f"{name}_count ") for ln in lines)
+        # cumulative: counts never decrease as le grows
+        counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+        assert counts == sorted(counts)

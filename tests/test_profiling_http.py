@@ -110,6 +110,32 @@ def test_profile_ring_evicts_oldest(service):
     assert f"ring-{profiling._MAX_PROFILES + 2}" in known
 
 
+def test_query_timeline_endpoint(service):
+    from blaze_tpu.bridge import tracing
+    tracing.start_tracing()
+    try:
+        with tracing.execution_context(query="q-http-tl"):
+            with tracing.span("task_attempt", task=0, attempt=1,
+                              what="http-tl"):
+                pass
+        code, _ctype, body = _get(service, "/query/q-http-tl/timeline")
+        assert code == 200
+        tl = json.loads(body)
+        assert tl["query_id"] == "q-http-tl"
+        assert any(e.get("name") == "task_attempt" and e["ph"] == "X"
+                   for e in tl["traceEvents"])
+        assert tl["attribution"]["span_count"] >= 1
+
+        code, err = _get_error(service, "/query/never-traced/timeline")
+        assert code == 404
+        assert "never-traced" in err["error"]
+    finally:
+        tracing.stop_tracing()
+        with tracing._lock:   # stop keeps the buffer; don't leak spans
+            tracing._spans.clear()
+        tracing.reset_conf_probe()
+
+
 def test_auron_endpoint(service):
     qid = ui.next_query_id()
     ui.record_conversion(qid, ["FilterExec"], [])
