@@ -141,6 +141,15 @@ def _error_line(msg: str, **extras) -> None:
     sys.stdout.flush()
 
 
+def _write_bench(path: str, rec: dict) -> dict:
+    """Every BENCH_*.json artifact lands through the unified
+    schema-versioned writer (blaze_tpu.tools.bench_schema), so the
+    regression sentinel can parse any leg's output uniformly.  Lazy
+    import: the supervisor side must stay free of blaze_tpu (jax)."""
+    from blaze_tpu.tools.bench_schema import write_bench_artifact
+    return write_bench_artifact(path, rec)
+
+
 _PROBE_CODE = r"""
 import os
 import jax
@@ -335,8 +344,7 @@ def _persist_profile() -> None:
            "transfers": xla_stats.transfer_stats(),
            "pipeline": xla_stats.pipeline_stats(),
            "metric_trees": profiling.recent_metrics()}
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1, default=str)
+    _write_bench(path, rec)
 
 
 # ---- process-pool execution for host-placed stages ------------------------
@@ -1414,8 +1422,7 @@ def expr_bench_main() -> int:
         "BLAZE_BENCH_EXPR_PATH",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_EXPR.json"))
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1, default=str)
+    _write_bench(path, rec)
     print(json.dumps(rec))
     sys.stdout.flush()
     return 0
@@ -1550,8 +1557,7 @@ def chaos_bench_main() -> int:
         "BLAZE_BENCH_CHAOS_PATH",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_CHAOS.json"))
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1, default=str)
+    _write_bench(path, rec)
     print(json.dumps(rec))
     sys.stdout.flush()
     return 0 if diverged == 0 else 1
@@ -1847,8 +1853,7 @@ def workers_bench_main() -> int:
         "BLAZE_BENCH_WORKERS_PATH",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_WORKERS.json"))
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1, default=str)
+    _write_bench(path, rec)
     print(json.dumps(rec))
     sys.stdout.flush()
     ok = (diverged == 0 and leaked == 0 and total_crashes >= 1
@@ -2173,8 +2178,7 @@ def speculate_bench_main() -> int:
         "BLAZE_BENCH_SPECULATE_PATH",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_SPECULATE.json"))
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1, default=str)
+    _write_bench(path, rec)
     print(json.dumps(rec))
     sys.stdout.flush()
     ok = (diverged == 0 and leaked == 0 and dup_blocks == 0
@@ -2406,8 +2410,7 @@ def deviceloop_bench_main() -> int:
         "BLAZE_BENCH_DEVLOOP_PATH",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_DEVLOOP.json"))
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1, default=str)
+    _write_bench(path, rec)
     print(json.dumps(rec))
     sys.stdout.flush()
     ok = (rec["bit_identical"] and divergent == 0
@@ -2609,8 +2612,7 @@ def aggskip_bench_main() -> int:
         "BLAZE_BENCH_AGGSKIP_PATH",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_AGGSKIP.json"))
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1, default=str)
+    _write_bench(path, rec)
     print(json.dumps(rec))
     sys.stdout.flush()
     bad = (diverged or
@@ -2885,8 +2887,7 @@ def multichip_bench_main() -> int:
     if widest_entry:
         rec["n_devices"] = max(int(rec.get("n_devices", 1) or 1),
                                widest_entry["n_devices"])
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1, default=str)
+    _write_bench(path, rec)
     print(json.dumps(mc))
     sys.stdout.flush()
     ok = (not errors and mc["divergent_queries"] == 0 and
@@ -3070,8 +3071,7 @@ def serve_bench_main() -> int:
         "BLAZE_BENCH_SERVE_PATH",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_SERVE.json"))
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1, default=str)
+    _write_bench(path, rec)
     print(json.dumps(rec))
     sys.stdout.flush()
     return 0 if divergent == 0 and leaks == 0 else 1
@@ -3318,8 +3318,7 @@ def scatterlane_bench_main() -> int:
         "BLAZE_BENCH_SCATTER_PATH",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_SCATTER.json"))
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1, default=str)
+    _write_bench(path, rec)
     print(json.dumps(rec))
     sys.stdout.flush()
     ok = (rec["bit_identical"] and rec["divergent_queries"] == 0
@@ -3491,8 +3490,7 @@ def stream_bench_main() -> int:
         "BLAZE_BENCH_STREAM_PATH",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_STREAM.json"))
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1, default=str)
+    _write_bench(path, rec)
     print(json.dumps(rec, default=str))
     sys.stdout.flush()
     ok = (identical and lost == 0 and duplicated == 0
@@ -3641,13 +3639,80 @@ def obs_bench_main() -> int:
         "BLAZE_BENCH_OBS_PATH",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_OBS.json"))
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1, default=str)
+    _write_bench(path, rec)
     print(json.dumps(rec, default=str))
     sys.stdout.flush()
     ok = (diverged == 0 and overhead <= budget
           and all(q["spans"] > 0 for q in queries)
           and sum(q["spans_ingested"] for q in queries) > 0)
+    return 0 if ok else 1
+
+
+def sentinel_bench_main() -> int:
+    """--sentinel: self-check of the regression sentinel CI contract.
+
+    Writes a baseline artifact through the unified writer, then runs the
+    sentinel twice: identical candidate must exit 0, and a candidate
+    with one metric regressed past threshold must exit 2 naming it.
+    """
+    import tempfile
+    from blaze_tpu.tools import sentinel
+    from blaze_tpu.tools.bench_schema import write_bench_artifact
+
+    threshold = float(os.environ.get("BLAZE_BENCH_SENTINEL_THRESHOLD",
+                                     "0.10"))
+    base_rec = {
+        "metric": "sentinel_selfcheck",
+        "q01_wall_s": 1.25,
+        "q01_rows_per_sec": 48_000.0,
+        "shuffle": {"device_bytes": 1 << 20, "spill_bytes": 0},
+        "expr_cache_hit_rate": 0.92,
+    }
+    checks = []
+    with tempfile.TemporaryDirectory(prefix="blaze_sentinel_") as td:
+        base_path = os.path.join(td, "BENCH_BASE.json")
+        same_path = os.path.join(td, "BENCH_SAME.json")
+        regr_path = os.path.join(td, "BENCH_REGR.json")
+        write_bench_artifact(base_path, base_rec)
+        write_bench_artifact(same_path, dict(base_rec))
+        regressed = dict(base_rec)
+        regressed["q01_wall_s"] = base_rec["q01_wall_s"] * 1.5
+        write_bench_artifact(regr_path, regressed)
+
+        rc_same = sentinel.main(["--baseline", base_path,
+                                 "--candidate", same_path,
+                                 "--threshold", str(threshold), "--ci"])
+        checks.append({"name": "identical_exits_zero",
+                       "exit_code": rc_same, "ok": rc_same == 0})
+
+        rc_regr = sentinel.main(["--baseline", base_path,
+                                 "--candidate", regr_path,
+                                 "--threshold", str(threshold), "--ci"])
+        findings = sentinel.compare(
+            sentinel.load(base_path), sentinel.load(regr_path),
+            threshold=threshold, ci=True)
+        named = [f["metric"] for f in findings
+                 if f["kind"] == "regression"]
+        checks.append({"name": "regression_exits_two_and_names_metric",
+                       "exit_code": rc_regr,
+                       "regressions_named": named,
+                       "ok": rc_regr == 2 and named == ["q01_wall_s"]})
+
+    ok = all(c["ok"] for c in checks)
+    rec = {
+        "metric": "sentinel_selfcheck_pass",
+        "value": int(ok),
+        "unit": "bool",
+        "threshold": threshold,
+        "checks": checks,
+    }
+    path = os.environ.get(
+        "BLAZE_BENCH_SENTINEL_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_SENTINEL.json"))
+    _write_bench(path, rec)
+    print(json.dumps(rec, default=str))
+    sys.stdout.flush()
     return 0 if ok else 1
 
 
@@ -3672,6 +3737,8 @@ def main():
         sys.exit(stream_bench_main())
     if "--obs" in sys.argv:
         sys.exit(obs_bench_main())
+    if "--sentinel" in sys.argv:
+        sys.exit(sentinel_bench_main())
     if "--multichip-child" in sys.argv:
         sys.exit(multichip_child_main())
     if "--multichip" in sys.argv:

@@ -20,6 +20,11 @@ TPU-native equivalents served over a stdlib HTTP endpoint:
   /trace/start?dir=<path>, /trace/stop — JAX profiler trace (XLA's own
                     profiler is the pprof analog: device + host timelines
                     viewable in TensorBoard/Perfetto)
+  /history        — replayed per-query summaries from the persistent
+                    event log (bridge/history.py); /history/<qid> is one
+                    query's full summary (final status, metric tree,
+                    attribution, device ledger), /history/rollup the
+                    fleet aggregate keyed by tenant and stage type
 
 The query-profile store is a bounded LRU (auron.tpu.profile.maxEntries;
 get_profile touches) so long-lived serving processes don't grow it
@@ -111,12 +116,19 @@ def prometheus_text() -> str:
     from blaze_tpu.bridge import xla_stats
     from blaze_tpu.memory import MemManager
     lines: List[str] = []
+    # per-SCRAPE header dedup — a default-arg set here persisted across
+    # calls, so every scrape after the first silently dropped all
+    # HELP/TYPE headers (tests/test_metric_conformance.py pins this)
+    seen: set = set()
 
-    def emit(name, value, help_=None, labels=None, seen=set()):
+    def emit(name, value, help_=None, labels=None):
         if help_ and name not in seen:
             seen.add(name)
+            # *_total families are monotone counters, everything else a
+            # point-in-time gauge — Prometheus rate() needs the former
+            kind = "counter" if name.endswith("_total") else "gauge"
             lines.append(f"# HELP {name} {help_}")
-            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"# TYPE {name} {kind}")
         lab = ""
         if labels:
             lab = "{" + ",".join(
@@ -135,38 +147,34 @@ def prometheus_text() -> str:
              "nanoseconds spent compiling", lab)
         emit("blaze_xla_distinct_signatures", e["distinct_signatures"],
              "distinct arg signatures seen (churn when high)", lab)
-    t = xla_stats.transfer_stats()
-    emit("blaze_h2d_bytes_total", t["h2d_bytes"],
-         "host-to-device bytes at batch placement")
-    emit("blaze_d2h_bytes_total", t["d2h_bytes"],
-         "device-to-host bytes (Arrow export, host fetches)")
-    for k, v in xla_stats.stage_loop_stats().items():
-        # device-resident stage loop (runtime/loop.py): engagement,
-        # amortized dispatches, wholesale fallbacks
-        emit(f"blaze_{k}_total", v,
-             "device-resident stage loop counter")
-    for k, v in xla_stats.stream_stats().items():
-        # streaming runtime (streaming/executor.py): epochs, watermark
-        # delay, window-state bytes, checkpoint/recovery/sink outcomes;
-        # *_last keys are point-in-time gauges, the rest are totals
-        if k.endswith("_last"):
-            emit(f"blaze_{k[:-5]}", v, "streaming runtime gauge")
-        else:
-            emit(f"blaze_{k}_total", v, "streaming runtime counter")
-    for k, v in xla_stats.worker_stats().items():
-        # process-isolated worker pool (parallel/workers.py): spawns,
-        # shipped tasks, crash/hang/blacklist/cancel supervision events
-        emit(f"blaze_{k}_total", v, "worker pool counter")
-    for k, v in xla_stats.speculation_stats().items():
-        # speculative execution (bridge/tasks.py): hedged waves/attempts,
-        # first-wins outcomes, rejected loser commits, forced races
-        emit(f"blaze_{k}_total", v, "speculative execution counter")
-    for k, v in xla_stats.obs_stats().items():
-        # observability plane (PR 13): stitched-in child spans, flight
-        # dumps, profile-store LRU evictions
-        emit(f"blaze_{k}_total", v, "observability counter")
+    # every flat counter plane, from the one shared family registry (the
+    # history rollup iterates the same source, so the two surfaces
+    # cannot drift apart); *_last keys are point-in-time gauges
+    fam_help = {
+        "transfers": "host<->device transfer",
+        "pipeline": "batch-shaping / IO-pipeline",
+        "exprs": "whole-stage expression program",
+        "faults": "fault-tolerance (retries, lineage recovery)",
+        "shuffle": "exchange transport",
+        "stage_loop": "device-resident stage loop",
+        "agg": "adaptive partial aggregation",
+        "scatter_lane": "pallas kernel-lane resolution",
+        "stream": "streaming runtime",
+        "workers": "worker pool supervision",
+        "speculation": "speculative execution",
+        "obs": "observability plane",
+    }
+    families = xla_stats.counter_families()
+    for fam in sorted(families):
+        label = fam_help.get(fam, fam)
+        for k in sorted(families[fam]):
+            v = families[fam][k]
+            if k.endswith("_last"):
+                emit(f"blaze_{k[:-5]}", v, f"{label} gauge")
+            else:
+                emit(f"blaze_{k}_total", v, f"{label} counter")
 
-    def emit_histogram(name, hist, help_, labels=None, seen=set()):
+    def emit_histogram(name, hist, help_, labels=None):
         # real Prometheus histogram exposition (cumulative le buckets +
         # _sum/_count), not the gauge families above
         lab_items = sorted((labels or {}).items())
@@ -451,6 +459,26 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps({"tracing": False}))
             except Exception as e:
                 self._send(500, json.dumps({"error": str(e)}))
+        elif route == "/history":
+            from blaze_tpu.bridge.history import HistoryStore
+            self._send(200, json.dumps(HistoryStore().summaries(),
+                                       sort_keys=True))
+        elif route == "/history/rollup":
+            from blaze_tpu.bridge.history import HistoryStore
+            self._send(200, json.dumps(HistoryStore().rollup(),
+                                       sort_keys=True))
+        elif route.startswith("/history/"):
+            from blaze_tpu.bridge.history import HistoryStore
+            qid = urllib.parse.unquote(route[len("/history/"):])
+            store = HistoryStore()
+            summary = store.summary(qid)
+            if summary is None:
+                self._send(404, json.dumps(
+                    {"error": f"no history for query {qid!r} "
+                              f"(is auron.tpu.history.enable on?)",
+                     "known": store.query_ids()}))
+            else:
+                self._send(200, json.dumps(summary, sort_keys=True))
         elif route == "/serving":
             from blaze_tpu.parallel.workers import pool_health
             from blaze_tpu.serving import serving_stats
@@ -477,6 +505,9 @@ class _Handler(BaseHTTPRequestHandler):
                                                   "/auron", "/auron.html",
                                                   "/trace/start",
                                                   "/trace/stop",
+                                                  "/history",
+                                                  "/history/<qid>",
+                                                  "/history/rollup",
                                                   "/serving",
                                                   "/serving/cancel"]}))
 

@@ -695,6 +695,30 @@ def transfer_stats() -> dict:
         return dict(_transfers)
 
 
+def counter_families() -> Dict[str, Dict[str, int]]:
+    """Every flat counter key, grouped by plane.  The single source the
+    Prometheus exposition (bridge/profiling.py) and the history rollup
+    (bridge/history.py) both iterate, so a new family cannot land in one
+    surface and silently miss the other
+    (tests/test_history_conformance.py).  Keys ending in `_last` are
+    point-in-time gauges, everything else is a monotone counter."""
+    with _lock:
+        return {
+            "transfers": dict(_transfers),
+            "pipeline": dict(_pipeline),
+            "exprs": dict(_exprs),
+            "faults": dict(_faults),
+            "shuffle": dict(_shuffle),
+            "stage_loop": dict(_stage_loop),
+            "agg": dict(_agg),
+            "scatter_lane": dict(_scatter_lane),
+            "stream": dict(_stream),
+            "workers": dict(_workers),
+            "speculation": dict(_speculation),
+            "obs": dict(_obs),
+        }
+
+
 def snapshot() -> dict:
     """Flat counter snapshot for before/after deltas (explain_analyze)."""
     rep = compile_report()

@@ -759,6 +759,31 @@ PROFILE_STORE_MAX = int_conf(
     "LRU capacity of the in-memory query-profile store served at "
     "/profile/<qid>; evictions are counted in obs_profile_evictions.",
     category="observability")
+HISTORY_ENABLE = bool_conf(
+    "auron.tpu.history.enable", False,
+    "Write the persistent per-query JSONL event log (admission, stage "
+    "completion, recovery, final metric tree + attribution) replayed by "
+    "the /history endpoints (bridge/history.py).  Probed once lazily; "
+    "disabled history stays a near-free boolean check at every emit "
+    "site — zero hot-path writes.", category="observability")
+HISTORY_DIR = str_conf(
+    "auron.tpu.history.dir", "",
+    "Directory for query event logs; empty uses "
+    "<system tempdir>/blaze_history.", category="observability")
+HISTORY_MAX_EVENTS = int_conf(
+    "auron.tpu.history.maxEventsPerQuery", 512,
+    "Event-log bound per query; events beyond it are dropped (the "
+    "terminal event always lands and carries the drop count).",
+    category="observability")
+HISTORY_MAX_QUERIES = int_conf(
+    "auron.tpu.history.maxQueries", 256,
+    "Retention: most-recent query logs kept on disk; admission prunes "
+    "the oldest beyond this.", category="observability")
+SENTINEL_THRESHOLD = float_conf(
+    "auron.tpu.sentinel.threshold", 0.10,
+    "Default relative noise floor for the regression sentinel "
+    "(blaze_tpu/tools/sentinel.py): metric drift below this fraction "
+    "of baseline is not a regression.", category="observability")
 UDAF_FALLBACK_ENABLE = bool_conf(
     "auron.udafFallback.enable", True,
     "Allow typed-imperative UDAFs to run through the host round-trip "

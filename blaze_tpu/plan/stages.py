@@ -369,6 +369,32 @@ class DagScheduler:
         self.stage_placement[sid] = {
             "compute": "device-loop" if after > loop_before else "staged",
             "exchange": exchange}
+        self._note_history_stage(sid)
+
+    def _note_history_stage(self, sid: int) -> None:
+        """Persist one stage_complete event: observed placement plus the
+        merged metric summary of the stage's tasks (bridge/history.py;
+        no-op unless auron.tpu.history.enable and a serving query owns
+        the run)."""
+        from blaze_tpu.bridge import history
+        if not history.enabled():
+            return
+        qid = getattr(self._query, "query_id", None)
+        if qid is None:
+            return
+        placement = self.stage_placement.get(sid, {})
+        with self._metrics_lock:
+            node = self.stage_metrics.get(sid)
+            values = dict(node.values) if node is not None else {}
+        metrics = {k: int(values[k]) for k in
+                   ("output_rows", "output_batches", "elapsed_compute_ns",
+                    "spilled_bytes", "io_bytes") if k in values}
+        tasks = next((s.num_tasks for s in self.stages if s.sid == sid),
+                     None)
+        history.note_stage(qid, sid=sid,
+                           exchange=placement.get("exchange", "unknown"),
+                           compute=placement.get("compute", "unknown"),
+                           tasks=tasks, metrics=metrics)
 
     @staticmethod
     def _part_of(stage: Stage) -> Dict[str, Any]:
@@ -804,6 +830,7 @@ class DagScheduler:
             "compute": ("device-loop" if loop_tasks == stage.num_tasks
                         else "mixed" if loop_tasks else "staged"),
             "exchange": "device"}
+        self._note_history_stage(stage.sid)
 
         sid = stage.sid
         self._stage_outputs[sid] = {}
@@ -995,6 +1022,11 @@ class DagScheduler:
                 self._read_map_output(stage, ff.map_id,
                                       int(part.get("num_partitions", 1)))
         xla_stats.note_stage_recovery(1)
+        from blaze_tpu.bridge import history
+        if history.enabled():
+            history.note_stage_recovery(
+                getattr(self._query, "query_id", None),
+                sid=ff.stage_id, map_task=ff.map_id)
 
     def invalidate_worker_outputs(self, worker_id) -> None:
         """WorkerPool crash listener: re-validate every committed map

@@ -32,7 +32,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from blaze_tpu import config, faults
 from blaze_tpu.bridge import context as bridge_context
-from blaze_tpu.bridge import tracing
+from blaze_tpu.bridge import history, tracing
 from blaze_tpu.bridge.context import query_scope
 from blaze_tpu.serving.context import QueryCancelled, QueryContext
 
@@ -73,6 +73,9 @@ class QueryHandle:
         self.finished_at: Optional[float] = None
         #: DagScheduler.leak_report() of the run, for post-mortem checks
         self.leak_report: Optional[Dict[str, List[str]]] = None
+        #: final merged metric tree (dict), populated when the history
+        #: plane is on — the event log's terminal payload
+        self.metrics_tree: Optional[dict] = None
 
     @property
     def wall_s(self) -> Optional[float]:
@@ -112,6 +115,10 @@ def _default_executor(plan: Dict[str, Any], ctx: QueryContext,
         sched.cleanup()
         if handle is not None:
             handle.leak_report = sched.leak_report()
+            if history.enabled():
+                tree = sched.collect_metrics()
+                handle.metrics_tree = (tree.to_dict()
+                                       if tree is not None else None)
 
 
 class QueryService:
@@ -203,6 +210,10 @@ class QueryService:
             self._queued += 1
             self._tenant_inflight[tenant] = inflight + 1
             self.counters["admitted"] += 1
+        # outside the admission lock: the event append does file I/O
+        history.note_admitted(ctx.query_id, tenant=tenant,
+                              deadline_ms=deadline_ms or 0,
+                              mem_quota=mem_quota or 0)
         self._pool.submit(self._run, handle, plan)
         return handle
 
@@ -222,7 +233,9 @@ class QueryService:
                 self._finish_locked(handle, error=shed)
         if shed is not None:
             self._maybe_flight_dump(handle)
+            self._note_history_finish(handle)
             return
+        history.note_started(ctx.query_id, queued_s=queued_s)
         bridge_context.note_query_start(ctx.query_id)
         error: Optional[BaseException] = None
         result: Any = None
@@ -242,6 +255,19 @@ class QueryService:
             self._running -= 1
             self._finish_locked(handle, error=error, result=result)
         self._maybe_flight_dump(handle)
+        self._note_history_finish(handle)
+
+    def _note_history_finish(self, handle: QueryHandle) -> None:
+        """Terminal history event (status + metric tree + attribution);
+        outside the service lock — the append does file I/O."""
+        if not history.enabled():
+            return
+        err = handle._error
+        history.note_finished(
+            handle.query_id, status=handle.status, tenant=handle.tenant,
+            wall_s=handle.wall_s,
+            error=f"{type(err).__name__}: {err}" if err else None,
+            metric_tree=handle.metrics_tree)
 
     def _maybe_flight_dump(self, handle: QueryHandle) -> None:
         """Post-mortem: fatally-classified outcomes (deadline, memory

@@ -249,10 +249,13 @@ class StreamExecutor:
         self._epoch = resume
         self.recovery_walls_ns.append(time.perf_counter_ns() - t0)
         xla_stats.note_stream_recovery(replayed_epochs=replayed)
-        from blaze_tpu.bridge import tracing
+        from blaze_tpu.bridge import history, tracing
         tracing.instant("stream_recovery", resume_epoch=resume,
                         replayed_epochs=replayed,
                         query=getattr(self._ctx, "query_id", None))
+        history.note_stream_recovery(
+            getattr(self._ctx, "query_id", None),
+            resume_epoch=resume, replayed=replayed)
 
     def _run_epoch(self) -> bool:
         """Execute + commit one epoch; returns True at end-of-stream."""
@@ -314,7 +317,8 @@ class StreamExecutor:
             "sink": {"attempt": attempt, "rows": emitted.num_rows},
             "final": final,
         }
-        if self._ckpt.commit(self._epoch, manifest):
+        committed = self._ckpt.commit(self._epoch, manifest)
+        if committed:
             self.sink.promote(self._epoch, attempt)
             self._offsets = new_offsets
             self.rows_emitted += emitted.num_rows
@@ -336,6 +340,11 @@ class StreamExecutor:
         self.epochs_committed += 1
         xla_stats.note_stream_epoch(wall, rows=emitted.num_rows,
                                     records=nrecs)
+        from blaze_tpu.bridge import history
+        history.note_stream_epoch(
+            getattr(self._ctx, "query_id", None), epoch=self._epoch,
+            rows=emitted.num_rows, records=nrecs, wall_ns=wall,
+            committed=committed)
         max_seen = max((t for t in
                         self._tracker.snapshot()["max_ts"].values()),
                        default=None)

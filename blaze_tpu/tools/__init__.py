@@ -1,0 +1,9 @@
+"""Operator-facing CLI tools (``python -m blaze_tpu.tools.<name>``) and
+the shared bench-artifact schema.
+
+* ``sentinel``     — regression sentinel: diff unified BENCH_*.json
+                     artifacts / history rollups against a baseline
+                     with noise-floor thresholds (CI exit codes).
+* ``bench_schema`` — the unified schema-versioned envelope every
+                     BENCH_*.json artifact is written through.
+"""

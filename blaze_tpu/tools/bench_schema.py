@@ -1,0 +1,69 @@
+"""Unified schema for BENCH_*.json artifacts.
+
+Every bench leg writes through `write_bench_artifact`, which wraps the
+leg's record in one shared envelope — `schema_version`, UTC run
+timestamp, git sha and host info — so the regression sentinel
+(blaze_tpu/tools/sentinel.py) and the bench trajectory can parse every
+artifact uniformly instead of guessing at a dozen ad-hoc shapes.
+
+Leg keys win over envelope keys on collision, so a leg can legitimately
+override (e.g. carry its own `git_sha` from a replayed run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import subprocess
+import time
+from datetime import datetime, timezone
+from typing import Any, Dict
+
+#: bump when the envelope shape changes; the sentinel refuses to compare
+#: artifacts across schema versions in --ci mode
+BENCH_SCHEMA_VERSION = 1
+
+#: envelope keys the sentinel must NOT diff as metrics
+ENVELOPE_KEYS = ("schema_version", "generated_at_utc", "unix_ts",
+                 "git_sha", "host")
+
+
+def _git_sha() -> str:
+    try:
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        out = subprocess.run(["git", "rev-parse", "HEAD"], cwd=repo,
+                             capture_output=True, text=True, timeout=10)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip()
+    except Exception:
+        pass
+    return os.environ.get("GIT_SHA", "unknown")
+
+
+def bench_envelope() -> Dict[str, Any]:
+    """The shared metadata every artifact carries."""
+    return {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "generated_at_utc": datetime.now(timezone.utc).isoformat(
+            timespec="seconds"),
+        "unix_ts": round(time.time(), 3),
+        "git_sha": _git_sha(),
+        "host": {
+            "platform": platform.platform(),
+            "machine": platform.machine(),
+            "python": platform.python_version(),
+            "cpu_count": os.cpu_count(),
+        },
+    }
+
+
+def write_bench_artifact(path: str, rec: Dict[str, Any]
+                         ) -> Dict[str, Any]:
+    """Write `rec` under the unified envelope to `path`; returns the
+    merged record (what actually landed on disk)."""
+    out = {**bench_envelope(), **rec}
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, default=str)
+    return out

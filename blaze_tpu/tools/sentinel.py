@@ -1,0 +1,209 @@
+"""Regression sentinel: diff bench artifacts / history rollups against
+a stored baseline.
+
+    python -m blaze_tpu.tools.sentinel \
+        --baseline BENCH_BASE.json --candidate BENCH_NEW.json \
+        [--threshold 0.10] [--abs-floor 1e-6] [--metrics 'q01.*'] \
+        [--ci] [--json]
+
+`--baseline` / `--candidate` each name either one JSON file (a unified
+BENCH_*.json artifact or a saved /history/rollup payload) or a
+directory, in which case every `BENCH_*.json` inside is merged under
+its filename stem.  Numeric leaves are flattened to dotted metric keys
+and compared pairwise.
+
+A metric regresses when its relative change exceeds `--threshold` in
+the WORSE direction — metric names carry the direction (`wall`, `_ms`,
+`p99`, `retries`, ... are lower-is-better; `rows_per_sec`, `qps`,
+`hit_rate`, ... higher-is-better; unknown names fail on drift in either
+direction, the conservative CI posture).  Two noise floors cut flapping
+on tiny values: absolute change below `--abs-floor` never fires, and
+the relative change is computed against max(|baseline|, 1e-9).
+
+Exit codes (the CI contract):
+
+* ``0`` — no regression (identical runs always exit 0);
+* ``1`` — usage / IO / schema error;
+* ``2`` — regression: every offending metric is named on stdout.
+
+``--ci`` additionally fails (exit 2) on metrics present in the baseline
+but missing from the candidate, and on bench schema_version mismatches.
+Default thresholds come from `auron.tpu.sentinel.threshold`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+from blaze_tpu.tools.bench_schema import ENVELOPE_KEYS
+
+_LOWER_IS_BETTER = re.compile(
+    r"(wall|latency|_ms\b|_ns\b|_s\b|seconds|p50|p95|p99|overhead|"
+    r"spill|wait|gap|idle|retries|failures|crashes|fallbacks|declines|"
+    r"evictions|recoveries|lag|delay|queued|dropped|misses)",
+    re.IGNORECASE)
+_HIGHER_IS_BETTER = re.compile(
+    r"(rows_per_sec|per_sec|qps|throughput|speedup|hit_rate|hits\b|"
+    r"fraction|utilization|rows\b|completed)", re.IGNORECASE)
+
+
+def metric_direction(key: str) -> str:
+    """'lower' | 'higher' | 'unknown' — which way is better."""
+    if _LOWER_IS_BETTER.search(key):
+        return "lower"
+    if _HIGHER_IS_BETTER.search(key):
+        return "higher"
+    return "unknown"
+
+
+def flatten(obj: Any, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves as dotted keys; envelope metadata is skipped."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if not prefix and k in ENVELOPE_KEYS:
+                continue
+            out.update(flatten(v, f"{prefix}{k}."))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            out.update(flatten(v, f"{prefix}{i}."))
+    elif isinstance(obj, bool):
+        pass  # ok/flags are not metrics
+    elif isinstance(obj, (int, float)):
+        out[prefix[:-1]] = float(obj)
+    return out
+
+
+def load(path: str) -> Dict[str, Any]:
+    """One JSON file, or a directory of BENCH_*.json merged by stem."""
+    if os.path.isdir(path):
+        merged: Dict[str, Any] = {}
+        for name in sorted(os.listdir(path)):
+            if name.startswith("BENCH_") and name.endswith(".json"):
+                with open(os.path.join(path, name)) as f:
+                    merged[name[len("BENCH_"):-len(".json")]] = \
+                        json.load(f)
+        if not merged:
+            raise FileNotFoundError(f"no BENCH_*.json under {path}")
+        return merged
+    with open(path) as f:
+        return json.load(f)
+
+
+def compare(baseline: Dict[str, Any], candidate: Dict[str, Any], *,
+            threshold: float, abs_floor: float = 1e-6,
+            metrics: Optional[str] = None,
+            ci: bool = False) -> List[Dict[str, Any]]:
+    """Findings list, worst first; a finding with kind='regression'
+    drives the nonzero exit."""
+    base = flatten(baseline)
+    cand = flatten(candidate)
+    findings: List[Dict[str, Any]] = []
+    for key in sorted(base):
+        if metrics and not fnmatch.fnmatch(key, metrics):
+            continue
+        if key not in cand:
+            findings.append({
+                "metric": key, "kind": "regression" if ci else "missing",
+                "direction": "missing", "baseline": base[key],
+                "candidate": None, "change": None,
+                "detail": "present in baseline, missing from candidate"})
+            continue
+        b, c = base[key], cand[key]
+        if abs(c - b) < abs_floor:
+            continue
+        rel = (c - b) / max(abs(b), 1e-9)
+        if abs(rel) <= threshold:
+            continue
+        direction = metric_direction(key)
+        worse = (direction == "lower" and rel > 0) or \
+                (direction == "higher" and rel < 0) or \
+                direction == "unknown"
+        findings.append({
+            "metric": key,
+            "kind": "regression" if worse else "improvement",
+            "direction": direction, "baseline": b, "candidate": c,
+            "change": round(rel, 4),
+            "detail": f"{rel:+.1%} vs baseline "
+                      f"(threshold {threshold:.0%})"})
+    findings.sort(key=lambda f: (f["kind"] != "regression",
+                                 -abs(f.get("change") or 1.0)))
+    return findings
+
+
+def _default_threshold() -> float:
+    try:
+        from blaze_tpu import config
+        return float(config.SENTINEL_THRESHOLD.get())
+    except Exception:
+        return 0.10
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m blaze_tpu.tools.sentinel",
+        description="diff bench artifacts / history rollups against a "
+                    "baseline; exit 2 on regression")
+    ap.add_argument("--baseline", required=True,
+                    help="baseline JSON file or directory of "
+                         "BENCH_*.json")
+    ap.add_argument("--candidate", required=True,
+                    help="candidate JSON file or directory")
+    ap.add_argument("--threshold", type=float,
+                    default=_default_threshold(),
+                    help="relative noise floor (default "
+                         "auron.tpu.sentinel.threshold)")
+    ap.add_argument("--abs-floor", type=float, default=1e-6,
+                    help="absolute change below this never fires")
+    ap.add_argument("--metrics", default=None,
+                    help="fnmatch filter on dotted metric keys")
+    ap.add_argument("--ci", action="store_true",
+                    help="strict mode: missing metrics and schema "
+                         "mismatches also regress")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    try:
+        baseline = load(args.baseline)
+        candidate = load(args.candidate)
+    except (OSError, ValueError) as e:
+        print(f"sentinel: cannot load inputs: {e}", file=sys.stderr)
+        return 1
+
+    if args.ci:
+        bv = baseline.get("schema_version")
+        cv = candidate.get("schema_version")
+        if bv is not None and cv is not None and bv != cv:
+            print(f"sentinel: schema_version mismatch "
+                  f"(baseline={bv}, candidate={cv})", file=sys.stderr)
+            return 2
+
+    findings = compare(baseline, candidate, threshold=args.threshold,
+                       abs_floor=args.abs_floor, metrics=args.metrics,
+                       ci=args.ci)
+    regressions = [f for f in findings if f["kind"] == "regression"]
+    if args.as_json:
+        print(json.dumps({"threshold": args.threshold,
+                          "findings": findings,
+                          "regressions": len(regressions)},
+                         indent=1, sort_keys=True))
+    else:
+        for f in findings:
+            print(f"{f['kind'].upper()} {f['metric']}: "
+                  f"baseline={f['baseline']} candidate={f['candidate']} "
+                  f"({f['detail']})")
+        print(f"sentinel: {len(regressions)} regression(s), "
+              f"{len(findings) - len(regressions)} other finding(s) "
+              f"at threshold {args.threshold:.0%}")
+    return 2 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,364 @@
+"""Query history server (bridge/history.py): persistent event log,
+deterministic replay that survives process restart, fleet rollups, the
+device-utilization ledger, retention/compaction, and the HTTP surface.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from blaze_tpu import config
+from blaze_tpu.bridge import history, profiling, tracing
+from blaze_tpu.memory import MemManager
+from blaze_tpu.serving import QueryService
+
+from tests.test_serving import _two_stage_plan
+
+
+@pytest.fixture(autouse=True)
+def clean_slate():
+    MemManager.init(4 << 30)
+    history.reset_conf_probe()
+    tracing.reset_conf_probe()
+    try:
+        yield
+    finally:
+        for opt in (config.HISTORY_ENABLE, config.HISTORY_DIR,
+                    config.HISTORY_MAX_EVENTS, config.HISTORY_MAX_QUERIES,
+                    config.TRACE_ENABLE, config.DAG_SINGLE_TASK_BYTES):
+            config.conf.unset(opt.key)
+        history.reset_conf_probe()
+        tracing.stop_tracing()
+        tracing.reset_conf_probe()
+        MemManager.init(4 << 30)
+
+
+@pytest.fixture
+def hist_dir(tmp_path):
+    d = str(tmp_path / "hist")
+    config.conf.set(config.HISTORY_ENABLE.key, "true")
+    config.conf.set(config.HISTORY_DIR.key, d)
+    history.reset_conf_probe()
+    return d
+
+
+def _emit_full_query(qid, tenant="acme"):
+    """Drive every emitter once, as the engine would."""
+    history.note_admitted(qid, tenant=tenant, deadline_ms=0, mem_quota=0)
+    history.note_started(qid, queued_s=0.001)
+    history.note_stage(qid, sid=0, exchange="file", compute="staged",
+                       tasks=2, metrics={"output_rows": 400})
+    history.note_stage(qid, sid=1, exchange="result", compute="staged",
+                       tasks=1, metrics={"output_rows": 200})
+    history.note_stage_recovery(qid, sid=0, map_task=1)
+    history.note_finished(qid, status="done", tenant=tenant, wall_s=0.25)
+
+
+# -- off by default ----------------------------------------------------------
+
+def test_disabled_by_default_writes_nothing(tmp_path):
+    d = str(tmp_path / "hist")
+    config.conf.set(config.HISTORY_DIR.key, d)  # dir set, enable NOT set
+    history.reset_conf_probe()
+    assert history.enabled() is False
+    _emit_full_query("q-off")
+    assert not os.path.exists(d)  # not even the directory is created
+
+
+# -- event log ---------------------------------------------------------------
+
+def test_event_log_lines_are_schema_versioned(hist_dir):
+    assert history.enabled() is True
+    _emit_full_query("q1")
+    path = os.path.join(hist_dir, "query-q1.jsonl")
+    assert os.path.exists(path)
+    with open(path) as f:
+        events = [json.loads(line) for line in f]
+    assert [e["event"] for e in events] == [
+        "admitted", "started", "stage_complete", "stage_complete",
+        "stage_recovery", "finished"]
+    for e in events:
+        assert e["v"] == history.HISTORY_SCHEMA_VERSION
+        assert e["query"] == "q1"
+        assert e["event"] in history.EVENT_TYPES
+        assert isinstance(e["ts"], float)
+
+
+def test_qid_is_sanitized_into_filename(hist_dir):
+    history.note_admitted("../../etc/passwd", tenant="t")
+    names = os.listdir(hist_dir)
+    assert names == ["query-.._.._etc_passwd.jsonl"]
+
+
+def test_max_events_cap_drops_but_terminal_always_lands(hist_dir):
+    config.conf.set(config.HISTORY_MAX_EVENTS.key, 4)
+    history.note_admitted("qcap", tenant="t")
+    for i in range(10):
+        history.note_stage(qid := "qcap", sid=i, exchange="file",
+                           compute="staged")
+    history.note_finished(qid, status="done", tenant="t", wall_s=0.1)
+    store = history.HistoryStore(hist_dir)
+    events = store.events("qcap")
+    assert len(events) == 5  # 4 capped + the terminal event
+    assert events[-1]["event"] == "finished"
+    assert events[-1]["events_dropped"] == 7
+    s = store.summary("qcap")
+    assert s["status"] == "done"
+    assert s["events_dropped"] == 7
+
+
+# -- replay / restart survival ----------------------------------------------
+
+def test_summary_replay_is_bit_stable(hist_dir):
+    _emit_full_query("q2", tenant="acme")
+    a = history.HistoryStore(hist_dir)
+    b = history.HistoryStore(hist_dir)
+    assert json.dumps(a.summary("q2"), sort_keys=True) == \
+        json.dumps(b.summary("q2"), sort_keys=True)
+    assert json.dumps(a.rollup(), sort_keys=True) == \
+        json.dumps(b.rollup(), sort_keys=True)
+    s = a.summary("q2")
+    assert s["schema_version"] == history.ROLLUP_SCHEMA_VERSION
+    assert s["tenant"] == "acme"
+    assert s["status"] == "done"
+    assert s["stage_recoveries"] == 1
+    assert [st["stage"] for st in s["stages"]] == [0, 1]
+    assert s["attribution"]["approximate"] is True
+    assert s["wall_s"] == 0.25
+
+
+def test_fresh_process_replays_identical_summary(hist_dir):
+    """The restart-survival acceptance: a brand-new process, sharing
+    nothing but the log directory, replays byte-identical /history/<qid>
+    and /history/rollup payloads."""
+    _emit_full_query("q3", tenant="acme")
+    here = history.HistoryStore(hist_dir)
+    want_summary = json.dumps(here.summary("q3"), sort_keys=True)
+    want_rollup = json.dumps(here.rollup(), sort_keys=True)
+    code = (
+        "import json, sys\n"
+        "from blaze_tpu.bridge.history import HistoryStore\n"
+        "store = HistoryStore(sys.argv[1])\n"
+        "print(json.dumps(store.summary('q3'), sort_keys=True))\n"
+        "print(json.dumps(store.rollup(), sort_keys=True))\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code, hist_dir],
+                         capture_output=True, text=True, timeout=240,
+                         env=env,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    got_summary, got_rollup = out.stdout.strip().splitlines()[-2:]
+    assert got_summary == want_summary
+    assert got_rollup == want_rollup
+
+
+# -- rollup ------------------------------------------------------------------
+
+def test_rollup_aggregates_by_tenant_and_stage_type(hist_dir):
+    _emit_full_query("qa", tenant="acme")
+    _emit_full_query("qb", tenant="acme")
+    _emit_full_query("qc", tenant="beta")
+    r = history.HistoryStore(hist_dir).rollup()
+    assert r["schema_version"] == history.ROLLUP_SCHEMA_VERSION
+    assert r["queries"] == 3
+    assert r["tenants"]["acme"]["queries"] == 2
+    assert r["tenants"]["acme"]["completed"] == 2
+    assert r["tenants"]["beta"]["queries"] == 1
+    acme = r["tenants"]["acme"]
+    assert acme["wall_ms_p50"] == 250.0
+    assert acme["wall_ms_p99"] == 250.0
+    assert set(acme["shuffle_bytes_by_tier"]) == {"device", "rss", "file"}
+    # stage-type keying: 2 stages per query, split file/result exchange
+    assert r["stages_by_exchange"]["file"]["stages"] == 3
+    assert r["stages_by_exchange"]["result"]["stages"] == 3
+    assert r["stages_by_exchange"]["file"]["output_rows"] == 3 * 400
+    assert r["stages_by_compute"]["staged"]["stages"] == 6
+    # every flat counter key is present, even at zero
+    for k in history.rollup_counter_keys():
+        assert k in r["counters"], k
+
+
+def test_rollup_qps_and_failed_counts(hist_dir):
+    history.note_admitted("qf", tenant="t")
+    history.note_finished("qf", status="failed", tenant="t", wall_s=0.1,
+                          error="ValueError: boom")
+    r = history.HistoryStore(hist_dir).rollup()
+    assert r["tenants"]["t"]["failed"] == 1
+    assert r["tenants"]["t"]["completed"] == 0
+    s = history.HistoryStore(hist_dir).summary("qf")
+    assert s["error"] == "ValueError: boom"
+
+
+# -- retention / compaction --------------------------------------------------
+
+def test_prune_keeps_newest_max_queries(hist_dir):
+    config.conf.set(config.HISTORY_MAX_QUERIES.key, 3)
+    os.makedirs(hist_dir, exist_ok=True)
+    now = time.time()
+    for i in range(6):
+        p = os.path.join(hist_dir, f"query-q{i}.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps({"v": 1, "event": "admitted",
+                                "query": f"q{i}", "ts": now}) + "\n")
+        os.utime(p, (now - 60 + i, now - 60 + i))
+    removed = history.prune(hist_dir)
+    assert removed == 3
+    assert sorted(os.listdir(hist_dir)) == [
+        "query-q3.jsonl", "query-q4.jsonl", "query-q5.jsonl"]
+
+
+def test_admission_triggers_retention(hist_dir):
+    config.conf.set(config.HISTORY_MAX_QUERIES.key, 2)
+    for i in range(4):
+        history.note_admitted(f"qr{i}", tenant="t")
+        time.sleep(0.01)  # distinct mtimes
+    assert len(os.listdir(hist_dir)) <= 2
+    assert "query-qr3.jsonl" in os.listdir(hist_dir)  # newest survives
+
+
+def test_compact_preserves_summary_drops_epochs(hist_dir):
+    qid = "qstream"
+    history.note_admitted(qid, tenant="t")
+    for epoch in range(20):
+        history.note_stream_epoch(qid, epoch=epoch, rows=10, records=10,
+                                  wall_ns=1000, committed=True)
+    history.note_finished(qid, status="done", tenant="t", wall_s=1.0)
+    store = history.HistoryStore(hist_dir)
+    before = store.summary(qid)
+    removed = store.compact()
+    assert removed == 20
+    after = store.summary(qid)
+    for k in ("status", "tenant", "wall_s", "attribution"):
+        assert after[k] == before[k]
+    assert after["events"] == before["events"] - 20
+    # a second compaction is a no-op
+    assert store.compact() == 0
+
+
+def test_compact_leaves_live_queries_alone(hist_dir):
+    history.note_admitted("qlive", tenant="t")
+    history.note_stream_epoch("qlive", epoch=0, rows=1, records=1,
+                              wall_ns=1, committed=True)
+    store = history.HistoryStore(hist_dir)
+    assert store.compact() == 0  # no `finished` event yet
+    assert len(store.events("qlive")) == 2
+
+
+def test_torn_trailing_line_is_skipped(hist_dir):
+    history.note_admitted("qtorn", tenant="t")
+    with open(os.path.join(hist_dir, "query-qtorn.jsonl"), "a") as f:
+        f.write('{"v": 1, "event": "fini')  # crash mid-append
+    store = history.HistoryStore(hist_dir)
+    assert len(store.events("qtorn")) == 1
+    assert store.summary("qtorn")["status"] == "queued"
+
+
+# -- device-utilization ledger ----------------------------------------------
+
+_MS = 1_000_000  # ns per ms; keep synthetic times above the 1µs rounding
+
+
+def _span(name, t0_ms, dur_ms, stage=None, **attrs):
+    t0, dur = t0_ms * _MS, dur_ms * _MS
+    r = {"name": name, "t0_ns": t0, "t1_ns": t0 + dur, "dur_ns": dur,
+         "ctx": {}, "attrs": dict(attrs)}
+    if stage is not None:
+        r["ctx"]["stage"] = stage
+    return r
+
+
+def test_device_ledger_busy_gap_and_barrier():
+    spans = [
+        # stage 0: two device dispatches with a 100ms gap, then the
+        # exchange barrier 100ms after the last device completion
+        _span("stage_loop_chunk", 0, 100, stage=0),
+        _span("stage_loop_chunk", 200, 100, stage=0),
+        _span("rss_exchange", 400, 50, stage=0, nbytes=1024),
+        # stage 1: overlapping dispatches must not double-count
+        _span("device_exchange", 1000, 100, stage=1),
+        _span("device_exchange", 1050, 100, stage=1),
+    ]
+    led = history.device_ledger(spans)
+    s0 = led["stages"]["0"]
+    assert s0["device_busy_s"] == pytest.approx(0.200)
+    assert s0["dispatch_gap_s"] == pytest.approx(0.100)
+    assert s0["barrier_idle_s"] == pytest.approx(0.100)
+    assert s0["wall_s"] == pytest.approx(0.450)
+    s1 = led["stages"]["1"]
+    assert s1["device_busy_s"] == pytest.approx(0.150)  # union
+    assert s1["dispatch_gap_s"] == 0.0
+    assert led["device_busy_s"] == pytest.approx(0.350)
+    assert 0.0 < led["device_utilization"] <= 1.0
+
+
+def test_device_ledger_stageless_spans_are_overhead():
+    led = history.device_ledger([_span("plan_compile", 0, 500)])
+    assert set(led["stages"]) == {"-1"}
+    assert led["stages"]["-1"]["device_spans"] == 0
+    assert led["device_utilization"] == 0.0  # nothing dispatched
+
+
+def test_xla_compile_instant_counts_ns_attr():
+    spans = [{"name": "xla_compile", "t0_ns": 100 * _MS,
+              "t1_ns": 100 * _MS, "dur_ns": 0, "ctx": {"stage": 0},
+              "attrs": {"ns": 400 * _MS}}]
+    led = history.device_ledger(spans)
+    assert led["stages"]["0"]["device_busy_s"] == pytest.approx(0.400)
+
+
+# -- end-to-end: QueryService + HTTP surface ---------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_service_query_lands_in_history_and_http(hist_dir, tmp_path):
+    config.conf.set(config.TRACE_ENABLE.key, "on")
+    tracing.reset_conf_probe()
+    # force staged execution so stage_complete events exist on this
+    # small input (the single-task fast path never assigns placements)
+    config.conf.set(config.DAG_SINGLE_TASK_BYTES.key, 0)
+    svc = QueryService()
+    try:
+        h = svc.submit(_two_stage_plan(tmp_path, n=2_000),
+                       tenant="acme", query_id="qe2e")
+        h.result(60)
+    finally:
+        svc.shutdown()
+
+    port = profiling.start_http_service()
+    try:
+        code, listing = _get(port, "/history")
+        assert code == 200
+        assert any(s["query_id"] == "qe2e" and s["status"] == "done"
+                   for s in listing)
+        code, s = _get(port, "/history/qe2e")
+        assert code == 200
+        assert s["status"] == "done"
+        assert s["tenant"] == "acme"
+        assert s["stages"], "no stage_complete events replayed"
+        assert s["metric_tree"] is not None
+        assert s["attribution"]["counters"]
+        assert s["device_ledger"] is not None  # tracing was on
+        code, r = _get(port, "/history/rollup")
+        assert code == 200
+        assert r["tenants"]["acme"]["completed"] == 1
+        assert r["stages_by_exchange"]
+        # unknown qid 404s with a hint
+        try:
+            _get(port, "/history/nope")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        else:
+            raise AssertionError("/history/nope unexpectedly succeeded")
+    finally:
+        profiling.stop_http_service()

@@ -1,0 +1,90 @@
+"""History-surface conformance: `xla_stats.counter_families()` is the
+single source of truth for the runtime counter plane, and both export
+surfaces — the Prometheus exposition (`profiling.prometheus_text()`)
+and the history rollup (`HistoryStore.rollup()['counters']`) — must
+represent every family it declares.  A counter added to xla_stats
+cannot silently ship on one surface but not the other, and every
+history event type must stay documented.  Mirrors
+tests/test_span_names.py / tests/test_fault_sites.py."""
+
+import os
+
+from blaze_tpu.bridge import history, profiling, xla_stats
+from blaze_tpu.memory import MemManager
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+
+def _flat_counter_keys():
+    keys = {}
+    for fam, counters in xla_stats.counter_families().items():
+        for k in counters:
+            keys[k] = fam
+    return keys
+
+
+def test_counter_families_cover_the_known_planes():
+    fams = set(xla_stats.counter_families())
+    # the sweep below is vacuous if families stop registering
+    assert len(fams) >= 12, sorted(fams)
+    for expected in ("transfers", "pipeline", "exprs", "faults",
+                     "shuffle", "stage_loop", "stream", "workers",
+                     "speculation", "obs"):
+        assert expected in fams
+
+
+def test_every_counter_family_renders_in_prometheus_text():
+    MemManager.init(4 << 30)
+    text = profiling.prometheus_text()
+    missing = []
+    for k in _flat_counter_keys():
+        want = (f"blaze_{k[:-len('_last')]}" if k.endswith("_last")
+                else f"blaze_{k}_total")
+        if want not in text:
+            missing.append((k, want))
+    assert not missing, f"counters absent from /metrics.prom: {missing}"
+
+
+def test_every_counter_key_is_in_the_rollup_schema(tmp_path):
+    rollup_keys = set(history.rollup_counter_keys())
+    for k, fam in _flat_counter_keys().items():
+        if k.endswith("_last"):
+            assert k not in rollup_keys, (
+                f"{k} is a point-in-time gauge; summing it across "
+                f"queries is meaningless")
+        else:
+            assert k in rollup_keys, f"{fam}.{k} missing from rollup"
+    # and an actual (empty) rollup pre-seeds every key at zero
+    r = history.HistoryStore(str(tmp_path)).rollup()
+    assert set(r["counters"]) == rollup_keys
+    assert all(v == 0 for v in r["counters"].values())
+
+
+def test_rollup_and_prometheus_agree_on_the_counter_plane():
+    """The two export surfaces are the same set: every summable counter
+    the scrape exposes is aggregable from history, and vice versa."""
+    MemManager.init(4 << 30)
+    text = profiling.prometheus_text()
+    for k in history.rollup_counter_keys():
+        assert f"blaze_{k}_total" in text, (
+            f"rollup key {k} has no Prometheus family")
+
+
+def test_event_types_are_documented():
+    with open(os.path.join(_REPO, "docs", "observability.md")) as f:
+        docs = f.read()
+    for event in sorted(history.EVENT_TYPES):
+        assert f"`{event}`" in docs, (
+            f"history event type {event!r} missing from "
+            f"docs/observability.md")
+
+
+def test_history_knobs_are_documented():
+    from blaze_tpu import config
+    with open(os.path.join(_REPO, "docs", "configuration.md")) as f:
+        docs = f.read()
+    for opt in (config.HISTORY_ENABLE, config.HISTORY_DIR,
+                config.HISTORY_MAX_EVENTS, config.HISTORY_MAX_QUERIES,
+                config.SENTINEL_THRESHOLD):
+        assert opt.key in docs, opt.key

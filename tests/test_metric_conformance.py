@@ -126,6 +126,50 @@ def test_prometheus_exposition_covers_runtime_families():
         assert want in text, k
 
 
+def test_prometheus_scrape_is_deterministic_and_self_describing():
+    """Two back-to-back scrapes must be byte-identical (the exposition
+    carries no per-scrape state), and every sample family must be
+    preceded by its # HELP and # TYPE metadata exactly once — the
+    mutable-default `seen` set used to leak across scrapes and drop
+    metadata from the second one."""
+    from blaze_tpu.bridge import profiling, xla_stats
+
+    MemManager.init(4 << 30)
+    xla_stats.note_task_duration(25_000_000)
+    xla_stats.note_wave_wall(50_000_000)
+    first = profiling.prometheus_text()
+    second = profiling.prometheus_text()
+    assert first == second
+
+    for text in (first, second):
+        lines = text.splitlines()
+        helps = {ln.split()[2] for ln in lines if ln.startswith("# HELP")}
+        types = {ln.split()[2] for ln in lines if ln.startswith("# TYPE")}
+        families = set()
+        for ln in lines:
+            if not ln or ln.startswith("#"):
+                continue
+            name = ln.split("{", 1)[0].split(" ", 1)[0]
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and any(
+                        t + suffix == name for t in types):
+                    name = name[:-len(suffix)]
+                    break
+            families.add(name)
+        missing_help = families - helps
+        missing_type = families - types
+        assert not missing_help, f"families without HELP: {missing_help}"
+        assert not missing_type, f"families without TYPE: {missing_type}"
+        # metadata emitted exactly once per family
+        type_lines = [ln.split()[2] for ln in lines
+                      if ln.startswith("# TYPE")]
+        assert len(type_lines) == len(set(type_lines))
+        # monotonically-accumulated families declare themselves counters
+        for ln in lines:
+            if ln.startswith("# TYPE") and ln.split()[2].endswith("_total"):
+                assert ln.split()[3] == "counter", ln
+
+
 def test_prometheus_histograms_render_cumulative_buckets():
     from blaze_tpu.bridge import profiling, xla_stats
 

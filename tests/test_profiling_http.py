@@ -76,8 +76,9 @@ def test_metrics_prom_exposition(service):
     assert "blaze_mem_peak_used_bytes" in body
     assert 'blaze_operator_output_rows_total{operator="ScanExec"} 7' in body
     assert 'blaze_operator_io_bytes_total{operator="ScanExec"} 123' in body
-    # HELP/TYPE emitted once per metric family
-    assert body.count("# TYPE blaze_h2d_bytes_total gauge") == 1
+    # HELP/TYPE emitted once per metric family; accumulated *_total
+    # families declare themselves counters (they used to claim gauge)
+    assert body.count("# TYPE blaze_h2d_bytes_total counter") == 1
 
 
 def test_profile_endpoints(service):
