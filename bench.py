@@ -2908,7 +2908,13 @@ def serve_bench_main() -> int:
     (every completed result bit-identical to its fault-free solo run)
     and ZERO leaks (scheduler leak reports empty, no registered
     MemConsumers, no service threads left).  Records p50/p99 wall
-    latency plus shed/cancel counts per level into BENCH_SERVE.json."""
+    latency plus shed/cancel counts per level into BENCH_SERVE.json.
+
+    A second, chaos-free "dashboard" leg (ISSUE 15) replays a
+    zipf-skewed repeat-heavy mix with the work-sharing rings on
+    (result/subplan cache, single-flight, scan share) and records
+    per-level hit/coalesce/share counters next to qps/p50/p99; every
+    completed result must be Table.equals-identical to its solo run."""
     if os.environ.get("BLAZE_BENCH_PLATFORM"):
         import jax
         jax.config.update("jax_platforms",
@@ -2954,10 +2960,12 @@ def serve_bench_main() -> int:
     rec_levels = []
     divergent = 0
     leaks = 0
+    dash_levels = []
+    dash_divergent = dash_nonbit = dash_leaks = 0
     try:
         with tempfile.TemporaryDirectory(prefix="serve-") as d:
             # corpus + fault-free solo baselines, shared across levels
-            plans, bases = [], []
+            plans, bases, arrow_bases = [], [], []
             for qname in names:
                 qname = qname.strip()
                 builder, table_names = QUERIES[qname]
@@ -2966,8 +2974,9 @@ def serve_bench_main() -> int:
                     tables, os.path.join(d, qname), 2)
                 plan_dict, _oracle = builder(paths, tables, 2)
                 plans.append((qname, plan_dict))
-                bases.append(frame(
-                    DagScheduler().run_collect(plan_dict)))
+                base_tbl = DagScheduler().run_collect(plan_dict)
+                arrow_bases.append(base_tbl)
+                bases.append(frame(base_tbl))
 
             for conc in levels:
                 n_queries = int(os.environ.get(
@@ -3051,6 +3060,137 @@ def serve_bench_main() -> int:
                     "qps": round(len(submitted) / wall_level, 2)
                     if wall_level > 0 else None,
                 })
+
+            # ---- dashboard leg (ISSUE 15): repeat-heavy zipf replay
+            # with the work-sharing rings ON and chaos OFF.  Sharing
+            # must be BIT-identical, not merely equivalent: every
+            # completed result is compared with Table.equals against
+            # its fault-free solo run.  The cache is process-wide and
+            # deliberately NOT reset between levels — the first level
+            # pays the cold cost, later levels ride the warm rings,
+            # which is exactly the repeat-heavy dashboard shape.
+            from blaze_tpu.bridge import xla_stats as _xs
+            from blaze_tpu.cache import reset_cache
+            faults.clear()
+            pool = [(qname, p, t)
+                    for (qname, p), t in zip(plans, arrow_bases)]
+            # limit-wrapped variants share every producer subtree with
+            # their base plan but differ at the result fingerprint, so
+            # they exercise the subplan ring when the result ring misses
+            for qname, plan_dict in plans:
+                variant = {"kind": "limit", "limit": 10 ** 9,
+                           "input": plan_dict}
+                pool.append((qname + "+limit", variant,
+                             DagScheduler().run_collect(variant)))
+            weights = _np.array([1.0 / (r + 1) ** 1.1
+                                 for r in range(len(pool))])
+            weights /= weights.sum()
+            cache_knobs = {config.CACHE_ENABLE.key: True,
+                           config.SERVING_SINGLE_FLIGHT.key: True,
+                           config.CACHE_SCAN_SHARE.key: True}
+            for k, v in cache_knobs.items():
+                config.conf.set(k, v)
+            reset_cache()
+            try:
+                for conc in levels:
+                    n_sub = 4 * conc
+                    rng = _np.random.default_rng(seed * 7 + conc)
+                    picks = rng.choice(len(pool), size=n_sub,
+                                       p=weights)
+                    threads_before = {t.name
+                                      for t in _threading.enumerate()}
+                    svc = QueryService(max_concurrent=conc,
+                                       max_queue=n_sub,
+                                       tenant_max_inflight=n_sub)
+                    before = _xs.cache_stats()
+                    t_level = time.perf_counter()
+                    handles = []
+                    walls = []
+                    completed = 0
+                    try:
+                        for i, j in enumerate(picks):
+                            try:
+                                h = svc.submit(pool[j][1],
+                                               tenant=f"t{i % 4}")
+                            except QueryRejected:
+                                continue
+                            handles.append((h, int(j)))
+                        for h, j in handles:
+                            h.exception(timeout=600)
+                            if h.status == "done":
+                                completed += 1
+                                walls.append(h.wall_s or 0.0)
+                                if not h.result().equals(pool[j][2]):
+                                    dash_nonbit += 1
+                            else:
+                                # clean leg: every query must land
+                                dash_divergent += 1
+                            if h.leak_report is not None and any(
+                                    h.leak_report.values()):
+                                dash_leaks += 1
+                    finally:
+                        svc.shutdown(wait=True, cancel_running=True)
+                    wall_level = time.perf_counter() - t_level
+                    # the result cache itself stays registered between
+                    # levels by design; anything else is a leak
+                    if any(getattr(c, "name", "") != "result_cache"
+                           for c in MemManager.get()._consumers):
+                        dash_leaks += 1
+                    for _ in range(50):
+                        lingering = [
+                            t.name for t in _threading.enumerate()
+                            if t.name.startswith("blaze-serve")
+                            and t.name not in threads_before]
+                        if not lingering:
+                            break
+                        time.sleep(0.1)
+                    dash_leaks += len(lingering)
+                    walls.sort()
+                    cs = _xs.cache_stats()
+                    dd = {k2: cs[k2] - before.get(k2, 0) for k2 in cs}
+                    rh = dd.get("result_cache_hits", 0)
+                    rm = dd.get("result_cache_misses", 0)
+                    sph = dd.get("subplan_cache_hits", 0)
+                    spm = dd.get("subplan_cache_misses", 0)
+                    ssh = dd.get("scan_share_hits", 0)
+                    ssm = dd.get("scan_share_misses", 0)
+                    dash_levels.append({
+                        "concurrency": conc,
+                        "submitted": len(handles),
+                        "completed": completed,
+                        "p50_ms": round(
+                            _percentile(walls, 0.50) * 1e3, 2),
+                        "p99_ms": round(
+                            _percentile(walls, 0.99) * 1e3, 2),
+                        "wall_s": round(wall_level, 3),
+                        "qps": round(len(handles) / wall_level, 2)
+                        if wall_level > 0 else None,
+                        "result_cache_hits": rh,
+                        "result_cache_misses": rm,
+                        "result_cache_hit_rate": round(
+                            rh / (rh + rm), 4) if rh + rm else None,
+                        "subplan_cache_hits": sph,
+                        "subplan_cache_misses": spm,
+                        "coalesced": dd.get(
+                            "single_flight_coalesces", 0),
+                        "promoted": dd.get(
+                            "single_flight_promotions", 0),
+                        "scan_share_hits": ssh,
+                        "scan_share_misses": ssm,
+                        "scan_share_ratio": round(
+                            ssh / (ssh + ssm), 4)
+                        if ssh + ssm else None,
+                        "scan_share_bytes_saved": dd.get(
+                            "scan_share_bytes_saved", 0),
+                        "cache_used_bytes": cs.get(
+                            "cache_used_bytes_last", 0),
+                    })
+            finally:
+                for k in cache_knobs:
+                    config.conf.unset(k)
+                reset_cache()
+            if MemManager.get()._consumers:
+                dash_leaks += 1
     finally:
         faults.clear()
         for k in knobs:
@@ -3066,6 +3206,16 @@ def serve_bench_main() -> int:
         "queries": [q.strip() for q in names],
         "levels": rec_levels,
         "leaks": leaks,
+        "dashboard": {
+            "levels": dash_levels,
+            "qps_growth_low_to_high": round(
+                dash_levels[-1]["qps"] / dash_levels[0]["qps"], 2)
+            if len(dash_levels) > 1 and dash_levels[0]["qps"]
+            else None,
+            "divergent_queries": dash_divergent,
+            "non_bit_identical": dash_nonbit,
+            "leaks": dash_leaks,
+        },
     }
     path = os.environ.get(
         "BLAZE_BENCH_SERVE_PATH",
@@ -3074,7 +3224,8 @@ def serve_bench_main() -> int:
     _write_bench(path, rec)
     print(json.dumps(rec))
     sys.stdout.flush()
-    return 0 if divergent == 0 and leaks == 0 else 1
+    return 0 if (divergent == 0 and leaks == 0 and dash_divergent == 0
+                 and dash_nonbit == 0 and dash_leaks == 0) else 1
 
 
 # ===========================================================================

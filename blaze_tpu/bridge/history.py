@@ -603,11 +603,16 @@ class HistoryStore:
                 "device_lane_fraction": 0.0, "host_lane_fraction": 0.0,
                 "expr_cache_hit_rate": 0.0,
                 "stage_program_cache_hit_rate": 0.0,
+                "result_cache_hit_rate": 0.0,
+                "subplan_cache_hit_rate": 0.0,
+                "scan_share_hit_rate": 0.0,
                 "spill_bytes": 0,
                 "shuffle_bytes_by_tier": {"device": 0, "rss": 0,
                                           "file": 0},
                 "_fused": 0, "_eager": 0, "_expr_hits": 0,
                 "_expr_built": 0, "_sp_hits": 0, "_sp_built": 0,
+                "_rc_hits": 0, "_rc_miss": 0, "_spl_hits": 0,
+                "_spl_miss": 0, "_ss_hits": 0, "_ss_miss": 0,
             })
             t["queries"] += 1
             status = s["status"]
@@ -635,6 +640,12 @@ class HistoryStore:
             t["_sp_hits"] += int(
                 delta.get("stage_loop_program_cache_hits", 0))
             t["_sp_built"] += int(delta.get("stage_loop_programs_built", 0))
+            t["_rc_hits"] += int(delta.get("result_cache_hits", 0))
+            t["_rc_miss"] += int(delta.get("result_cache_misses", 0))
+            t["_spl_hits"] += int(delta.get("subplan_cache_hits", 0))
+            t["_spl_miss"] += int(delta.get("subplan_cache_misses", 0))
+            t["_ss_hits"] += int(delta.get("scan_share_hits", 0))
+            t["_ss_miss"] += int(delta.get("scan_share_misses", 0))
             attrib = s.get("attribution") or {}
             t["spill_bytes"] += int(attrib.get("spill_bytes", 0) or 0)
             tiers = t["shuffle_bytes_by_tier"]
@@ -680,6 +691,13 @@ class HistoryStore:
             if sh + sb:
                 t["stage_program_cache_hit_rate"] = round(
                     sh / (sh + sb), 4)
+            for rate_key, hk, mk in (
+                    ("result_cache_hit_rate", "_rc_hits", "_rc_miss"),
+                    ("subplan_cache_hit_rate", "_spl_hits", "_spl_miss"),
+                    ("scan_share_hit_rate", "_ss_hits", "_ss_miss")):
+                h, m = t.pop(hk), t.pop(mk)
+                if h + m:
+                    t[rate_key] = round(h / (h + m), 4)
         return {
             "schema_version": ROLLUP_SCHEMA_VERSION,
             "queries": n_queries,

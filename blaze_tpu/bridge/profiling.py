@@ -163,6 +163,7 @@ def prometheus_text() -> str:
         "workers": "worker pool supervision",
         "speculation": "speculative execution",
         "obs": "observability plane",
+        "cache": "cross-query work sharing",
     }
     families = xla_stats.counter_families()
     for fam in sorted(families):

@@ -230,7 +230,8 @@ def _run_remote(spec, exclude: set, deadline, query, what: str,
     _POOL_MISS when the pool can't take it (disabled / spawn failed /
     fully blacklisted) so the caller falls back to in-process."""
     from blaze_tpu import config
-    if not config.WORKERS_ENABLE.get():
+    if not config.WORKERS_ENABLE.get() and not (
+            query is not None and config.SERVING_USE_WORKERS.get()):
         return _POOL_MISS
     from blaze_tpu.parallel import workers
     pool = workers.get_pool()
@@ -272,7 +273,10 @@ def run_tasks(fn: Callable[[int], Any], n: int, timeout_s: float,
         # process-isolated tasks don't contend on the GIL: give every
         # map task its own slot-waiter thread and let the worker pool's
         # slot count be the real concurrency limit
-        if config.WORKERS_ENABLE.get() and max_workers is None:
+        if max_workers is None and (
+                config.WORKERS_ENABLE.get()
+                or (query is not None
+                    and config.SERVING_USE_WORKERS.get())):
             max_workers = max(1, n)
     spec_conf = None
     if n >= 2 and config.SPECULATION_ENABLE.get():

@@ -127,6 +127,14 @@ SPAN_NAMES: Dict[str, str] = {
                        "(streaming/executor.py)",
     "flight_dump": "the flight recorder wrote a post-mortem artifact "
                    "for a fatally-classified query (bridge/context.py)",
+    "result_cache_hit": "a whole-query result was served from the "
+                        "work-sharing cache, skipping execution "
+                        "(serving/service.py; attrs query/fingerprint/"
+                        "nbytes)",
+    "subplan_cache_hit": "a leaf map stage replayed cached "
+                         "exchange-boundary blocks instead of running "
+                         "its tasks (plan/stages.py; attrs stage/"
+                         "fingerprint)",
 }
 
 

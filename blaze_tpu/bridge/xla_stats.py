@@ -147,6 +147,20 @@ _speculation = {"speculation_waves": 0, "speculation_attempts": 0,
 _obs = {"obs_spans_ingested": 0, "obs_flight_dumps": 0,
         "obs_profile_evictions": 0}
 
+# Cross-query work sharing (blaze_tpu/cache/, serving single-flight,
+# shared scan decode).  scan_share_hits = follower rides a leader's
+# decode; scan_share_misses = leader decoded itself.
+# cache_used_bytes_last is the result/subplan cache's live footprint.
+_cache = {"result_cache_hits": 0, "result_cache_misses": 0,
+          "result_cache_puts": 0, "result_cache_evictions": 0,
+          "result_cache_invalidations": 0,
+          "subplan_cache_hits": 0, "subplan_cache_misses": 0,
+          "subplan_cache_puts": 0,
+          "single_flight_coalesces": 0, "single_flight_promotions": 0,
+          "scan_share_hits": 0, "scan_share_misses": 0,
+          "scan_share_bytes_saved": 0,
+          "cache_used_bytes_last": 0}
+
 # Bounded raw-sample reservoirs feeding tail-latency percentiles
 # (bench.py --workers / --speculate): successful task-attempt durations
 # and run_tasks wave walls, in ns.  Lists, so NOT folded into
@@ -429,6 +443,24 @@ def note_obs(spans_ingested: int = 0, flight_dumps: int = 0,
 def obs_stats() -> dict:
     with _lock:
         return dict(_obs)
+
+
+def note_cache(**deltas: int) -> None:
+    """Work-sharing plane mutator: kwargs name `_cache` keys; gauges
+    (`*_last`) are set absolutely, counters are incremented."""
+    with _lock:
+        for k, v in deltas.items():
+            if k not in _cache:
+                continue
+            if k.endswith("_last"):
+                _cache[k] = int(v)
+            else:
+                _cache[k] += int(v)
+
+
+def cache_stats() -> dict:
+    with _lock:
+        return dict(_cache)
 
 
 def _histogram(samples_ns: List[int]) -> Dict[str, Any]:
@@ -716,6 +748,7 @@ def counter_families() -> Dict[str, Dict[str, int]]:
             "workers": dict(_workers),
             "speculation": dict(_speculation),
             "obs": dict(_obs),
+            "cache": dict(_cache),
         }
 
 
@@ -740,6 +773,7 @@ def snapshot() -> dict:
     flat.update(worker_stats())
     flat.update(speculation_stats())
     flat.update(obs_stats())
+    flat.update(cache_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -777,6 +811,8 @@ def reset() -> None:
             _speculation[k] = 0
         for k in _obs:
             _obs[k] = 0
+        for k in _cache:
+            _cache[k] = 0
         _task_duration_ns.clear()
         _wave_wall_ns.clear()
         _bucket_caps.clear()

@@ -1,0 +1,9 @@
+"""Cross-query work sharing: semantic result/subplan cache and the
+shared scan-decode broker.  Everything here is gated by
+`auron.tpu.cache.enable` — with the knob off (the default) no module
+state is created and the execution path is byte-identical to a build
+without this package."""
+
+from blaze_tpu.cache.results import ResultCache, get_cache, reset_cache
+
+__all__ = ["ResultCache", "get_cache", "reset_cache"]

@@ -1,0 +1,125 @@
+"""Shared scan decode (the work-sharing tentpole's ring (c)).
+
+N admitted queries over one table each open the same parquet file and
+re-decode the same row groups.  The broker deduplicates CONCURRENT
+decodes at (file, row-groups, batch-rows, column-superset) granularity:
+the first arrival leads and decodes once, publishing the raw record
+batches; followers that arrive while the entry is refcounted wait on
+the publish event and ride the same batches.  Refcounted release drops
+the entry when the last reader detaches — nothing is retained beyond
+the overlap window, so this is a decode broker, not a data cache (the
+result/subplan cache in results.py covers reuse over time).
+
+Bit-identity: the key pins the exact row-group list and batch size, and
+followers receive the leader's batches BEFORE per-consumer alignment
+(`_align_schema` / partition-column assembly run per consumer), so a
+follower's output is byte-for-byte what its own decode would have
+produced.  Column supersets are safe because alignment projects by
+name.  A leader that fails publishes the error; followers fall back to
+decoding themselves rather than surfacing a foreign failure.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from blaze_tpu.bridge import xla_stats
+
+_Key = Tuple[str, Tuple[int, ...], int]
+
+
+class ShareEntry:
+    __slots__ = ("key", "columns", "event", "batches", "nbytes",
+                 "error", "refs")
+
+    def __init__(self, key: _Key, columns: Optional[Sequence[str]]):
+        self.key = key
+        #: leader's column list; None = all columns (superset of any)
+        self.columns = list(columns) if columns is not None else None
+        self.event = threading.Event()
+        self.batches: Optional[List[Any]] = None
+        self.nbytes = 0
+        self.error: Optional[BaseException] = None
+        self.refs = 1
+
+
+def _covers(have: Optional[Sequence[str]],
+            want: Optional[Sequence[str]]) -> bool:
+    if have is None:
+        return True
+    if want is None:
+        return False
+    return set(want) <= set(have)
+
+
+class ScanBroker:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[_Key, List[ShareEntry]] = {}
+
+    def lease(self, path: str, row_groups: Sequence[int],
+              columns: Optional[Sequence[str]], batch_rows: int
+              ) -> Tuple[str, ShareEntry]:
+        """("lead", entry) — caller decodes and must publish();
+        ("follow", entry) — caller waits on entry.event and rides the
+        published batches.  Either way the caller must release()."""
+        key = (path, tuple(row_groups), int(batch_rows))
+        with self._lock:
+            for e in self._entries.get(key, []):
+                if e.error is None and _covers(e.columns, columns):
+                    e.refs += 1
+                    return "follow", e
+            e = ShareEntry(key, columns)
+            self._entries.setdefault(key, []).append(e)
+            return "lead", e
+
+    def publish(self, entry: ShareEntry, batches: Optional[List[Any]],
+                error: Optional[BaseException] = None) -> None:
+        entry.batches = batches
+        entry.error = error
+        if batches is not None:
+            entry.nbytes = sum(
+                getattr(b, "nbytes", 0) for b in batches)
+        entry.event.set()
+
+    def release(self, entry: ShareEntry) -> None:
+        with self._lock:
+            entry.refs -= 1
+            if entry.refs <= 0:
+                group = self._entries.get(entry.key, [])
+                if entry in group:
+                    group.remove(entry)
+                if not group:
+                    self._entries.pop(entry.key, None)
+
+    def live_entries(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._entries.values())
+
+
+#: process-wide broker; harmless when idle (two empty containers)
+_broker = ScanBroker()
+
+
+def get_broker() -> ScanBroker:
+    return _broker
+
+
+def follow_batches(entry: ShareEntry, check=None,
+                   timeout_s: float = 600.0) -> Optional[List[Any]]:
+    """Wait for the leader's publish; returns the shared batches, or
+    None when the leader failed / the wait timed out (caller decodes
+    itself).  `check` is the caller's cancellation hook (raises)."""
+    waited = 0.0
+    while not entry.event.wait(0.2):
+        if check is not None:
+            check()
+        waited += 0.2
+        if waited >= timeout_s:
+            return None
+    if entry.error is not None or entry.batches is None:
+        return None
+    xla_stats.note_cache(scan_share_hits=1,
+                         scan_share_bytes_saved=entry.nbytes)
+    return entry.batches

@@ -699,6 +699,54 @@ QUERY_MEM_QUOTA = int_conf(
     "batch-capacity shrink) and is killed (QueryMemoryExceeded) only "
     "when degradation cannot bring it under.  0 = no quota.",
     category="serving")
+SERVING_SINGLE_FLIGHT = bool_conf(
+    "auron.tpu.serving.singleFlight", False,
+    "Coalesce identical in-flight queries in the QueryService: when a "
+    "submitted plan's fingerprint+snapshot matches one already queued "
+    "or running, the new query becomes a waiter on the leader's result "
+    "(one execution, N answers).  A cancelled leader promotes the first "
+    "live waiter to executor; deadline/quota kills stay per-query.",
+    category="serving")
+SERVING_USE_WORKERS = bool_conf(
+    "auron.tpu.serving.useWorkerPool", False,
+    "Route serving-mode map tasks (queries carrying a QueryContext) "
+    "onto the process-isolated worker pool even when "
+    "auron.tpu.workers.enable is off, so concurrent admitted queries "
+    "get true parallelism instead of time-slicing one interpreter.  "
+    "Off by default: solo/batch runs keep the in-process path.",
+    category="serving")
+CACHE_ENABLE = bool_conf(
+    "auron.tpu.cache.enable", False,
+    "Master switch for the cross-query work-sharing cache "
+    "(blaze_tpu/cache/): semantic result + subplan reuse keyed by "
+    "canonical plan fingerprint and source snapshot version.  Off "
+    "(default) keeps execution byte-identical to the uncached path "
+    "with zero steady-state overhead.", category="cache")
+CACHE_MAX_BYTES = int_conf(
+    "auron.tpu.cache.maxBytes", 256 << 20,
+    "Byte budget for the shared result/subplan cache.  The cache is a "
+    "MemConsumer under the unified MemManager, so global memory "
+    "pressure evicts cached entries (LRU) before live queries spill.",
+    category="cache")
+CACHE_SUBPLAN = bool_conf(
+    "auron.tpu.cache.subplan", True,
+    "Also cache exchange-boundary subplan outputs (leaf map-stage "
+    "shuffle blocks): a later query whose producing subtree matches "
+    "skips the whole map stage and replays the cached partition "
+    "blocks.  Only read when auron.tpu.cache.enable is on.",
+    category="cache")
+CACHE_SCAN_SHARE = bool_conf(
+    "auron.tpu.cache.scanShare", False,
+    "Deduplicate CONCURRENT ParquetScan decode at (file, row-groups, "
+    "column-superset) granularity: one leader decodes, followers ride "
+    "the published batches (refcounted, dropped when the last reader "
+    "releases — no retained memory).  Only read when "
+    "auron.tpu.cache.enable is on.", category="cache")
+CACHE_SCAN_SHARE_MAX_BYTES = int_conf(
+    "auron.tpu.cache.scanShare.maxBytes", 64 << 20,
+    "Per-file ceiling for shared scan decode: files larger than this "
+    "stream through the normal per-consumer path instead of being "
+    "buffered for followers.", category="cache")
 CASE_SENSITIVE = bool_conf("spark.sql.caseSensitive", False, "Column name matching.")
 ANSI_ENABLED = bool_conf(
     "spark.sql.ansi.enabled", False,

@@ -290,6 +290,9 @@ class ParquetScanExec(ExecutionPlan):
                     self.metrics.add("io_bytes", rb.nbytes)
                     yield rb
                 return
+        share_max = (config.CACHE_SCAN_SHARE_MAX_BYTES.get()
+                     if config.CACHE_ENABLE.get()
+                     and config.CACHE_SCAN_SHARE.get() else 0)
         for fidx, path in enumerate(self._file_groups[partition]):
             try:
                 f = pq.ParquetFile(open_source(path))
@@ -301,6 +304,12 @@ class ParquetScanExec(ExecutionPlan):
             self.metrics.add("pruned_row_groups",
                              f.metadata.num_row_groups - len(row_groups))
             if not row_groups:
+                continue
+            if (share_max and isinstance(path, str)
+                    and os.path.exists(path)
+                    and os.path.getsize(path) <= share_max):
+                yield from self._share_file(f, path, row_groups, columns,
+                                            partition, fidx)
                 continue
             if (isinstance(path, str) and os.path.exists(path)
                     and os.path.getsize(path) <= eager_limit):
@@ -317,6 +326,47 @@ class ParquetScanExec(ExecutionPlan):
                 rb = _align_schema(rb, self._file_part)
                 self.metrics.add("io_bytes", rb.nbytes)
                 yield self._assemble_output(rb, partition, fidx)
+
+    def _share_file(self, f, path, row_groups, columns, partition, fidx):
+        """Decode one file through the scan broker: concurrent scans of
+        the same (file, row-groups, batch-rows) with a covered column
+        set ride one decode pass.  The leader publishes RAW batches —
+        alignment and partition-constant assembly stay per consumer, so
+        a follower's output is bit-identical to its own decode."""
+        from blaze_tpu.bridge import xla_stats
+        from blaze_tpu.bridge.context import active_query
+        from blaze_tpu.cache import scanshare
+        broker = scanshare.get_broker()
+        mode, entry = broker.lease(path, row_groups, columns,
+                                   self._batch_rows)
+        try:
+            raw = None
+            if mode == "follow":
+                q = active_query()
+                raw = scanshare.follow_batches(
+                    entry, check=q.check if q is not None else None)
+            if raw is None:
+                # leader — or a follower decoding itself after the
+                # leader failed (its error is the leader's to surface)
+                tbl = f.read_row_groups(row_groups, columns=columns,
+                                        use_threads=True)
+                raw = tbl.to_batches(max_chunksize=self._batch_rows)
+                if mode == "lead":
+                    broker.publish(entry, list(raw))
+                    raw = entry.batches
+                    xla_stats.note_cache(scan_share_misses=1)
+            for rb in raw:
+                if rb.num_rows == 0:
+                    continue
+                rb = _align_schema(rb, self._file_part)
+                self.metrics.add("io_bytes", rb.nbytes)
+                yield self._assemble_output(rb, partition, fidx)
+        except BaseException as e:  # noqa: BLE001 - unblock followers
+            if mode == "lead" and not entry.event.is_set():
+                broker.publish(entry, None, error=e)
+            raise
+        finally:
+            broker.release(entry)
 
     def _assemble_output(self, rb: pa.RecordBatch, partition: int,
                          fidx: int) -> pa.RecordBatch:

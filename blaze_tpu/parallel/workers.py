@@ -714,7 +714,8 @@ def get_pool() -> Optional[WorkerPool]:
     unspawnable (callers then take the in-process thread path)."""
     global _pool, _pool_failed
     from blaze_tpu import config
-    if not config.WORKERS_ENABLE.get():
+    if not (config.WORKERS_ENABLE.get()
+            or config.SERVING_USE_WORKERS.get()):
         return None
     with _pool_lock:
         if _pool is not None and not _pool.closed:

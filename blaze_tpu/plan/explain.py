@@ -61,6 +61,40 @@ def format_speculation_footer(x) -> Optional[str]:
         f"{x.get('speculation_duplicate_commits', 0)}")
 
 
+def format_work_sharing_footer(x) -> Optional[str]:
+    """The explain-analyze "work sharing:" footer (result/subplan cache,
+    single-flight, shared scan decode), or None when the run touched
+    none of it — the cache is off by default and the profile must stay
+    byte-identical then."""
+    if not any(x.get(k) for k in (
+            "result_cache_hits", "result_cache_misses",
+            "result_cache_puts", "subplan_cache_hits",
+            "subplan_cache_misses", "single_flight_coalesces",
+            "scan_share_hits", "scan_share_misses")):
+        return None
+
+    def rate(hits: int, misses: int) -> str:
+        total = hits + misses
+        return f"{hits / total:.0%}" if total else "n/a"
+
+    rc_h = x.get("result_cache_hits", 0)
+    rc_m = x.get("result_cache_misses", 0)
+    sp_h = x.get("subplan_cache_hits", 0)
+    sp_m = x.get("subplan_cache_misses", 0)
+    ss_h = x.get("scan_share_hits", 0)
+    ss_m = x.get("scan_share_misses", 0)
+    return (
+        f"work sharing: result={rc_h}/{rc_h + rc_m} "
+        f"({rate(rc_h, rc_m)}) "
+        f"subplan={sp_h}/{sp_h + sp_m} ({rate(sp_h, sp_m)}) "
+        f"coalesced={x.get('single_flight_coalesces', 0)} "
+        f"promoted={x.get('single_flight_promotions', 0)} "
+        f"scan_share={ss_h}/{ss_h + ss_m} ({rate(ss_h, ss_m)}) "
+        f"saved={_fmt_bytes(x.get('scan_share_bytes_saved', 0))} "
+        f"evictions={x.get('result_cache_evictions', 0)} "
+        f"invalidations={x.get('result_cache_invalidations', 0)}")
+
+
 def _node_line(node: MetricNode) -> str:
     v = node.values
     total = v.get("elapsed_compute_ns", 0)
@@ -208,6 +242,9 @@ class QueryProfile:
         spec_line = format_speculation_footer(x)
         if spec_line is not None:
             lines.append(spec_line)
+        ws_line = format_work_sharing_footer(x)
+        if ws_line is not None:
+            lines.append(ws_line)
         if any(x.get(k) for k in ("shuffle_device_bytes",
                                   "shuffle_host_bytes",
                                   "shuffle_device_fallbacks")):

@@ -1,0 +1,120 @@
+"""Canonical plan fingerprints for cross-query work sharing.
+
+A fingerprint is a blake2s digest over the canonical JSON form of an
+engine-IR subtree (sorted keys, compact separators), so two submissions
+of the same logical plan — regardless of dict insertion order — hash
+identically.  The IR dicts ARE the canonical form: every PhysicalExpr
+`cache_key` (exprs/base.py) is derived 1:1 from its IR dict, and stage
+identity (StageProgram fingerprints, PR 8) is derived from the same
+subtree, so hashing the IR subsumes both.
+
+The digest deliberately EXCLUDES the source data version.  That lives
+in a separate `source_snapshot` — the stat-derived (mtime_ns, size) of
+every scanned file plus any `snapshot_id` a connector stamps on its IR
+node (the Iceberg snapshot analog) — which the cache stores alongside
+each entry and re-validates on every lookup.  A snapshot mismatch is an
+invalidation, not a different key: the stale entry is actively evicted.
+
+Uncacheable plans return `None` from `source_snapshot`:
+
+* any non-file source (`memory_scan`, `kafka_scan`) — no version signal;
+* run-scoped readers (`ipc_reader`, `ffi_reader`) — their resource ids
+  are minted per run and never collide across queries anyway;
+* sinks (`*_sink`, `*shuffle_writer`) — side effects must re-execute;
+* un-stat-able files (remote FS, deleted) — no invalidation evidence;
+* plans with no versioned source at all — nothing to validate against.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+#: file-backed scan kinds whose `file_groups` feed the snapshot
+_FILE_SCAN_KINDS = ("parquet_scan", "orc_scan")
+
+#: kinds that make the containing plan uncacheable outright
+_UNCACHEABLE_KINDS = ("memory_scan", "kafka_scan", "ipc_reader",
+                      "ffi_reader", "parquet_sink", "orc_sink",
+                      "shuffle_writer", "rss_shuffle_writer",
+                      "ipc_writer")
+
+
+def _canonical(obj: Any) -> bytes:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"),
+                      default=str).encode()
+
+
+def _digest(obj: Any) -> str:
+    return hashlib.blake2s(_canonical(obj)).hexdigest()
+
+
+def _walk(plan: Dict[str, Any]):
+    """Yield every dict node of an IR tree (children live in arbitrary
+    keys: input/left/right/children/file_groups/...)."""
+    stack: List[Any] = [plan]
+    while stack:
+        d = stack.pop()
+        if isinstance(d, dict):
+            yield d
+            stack.extend(d.values())
+        elif isinstance(d, (list, tuple)):
+            stack.extend(d)
+
+
+def plan_fingerprint(plan: Dict[str, Any]) -> str:
+    """Content digest of a whole IR (sub)tree; data-version agnostic."""
+    return _digest(plan)
+
+
+def subplan_fingerprint(stage_plan: Dict[str, Any],
+                        partitioning: Optional[Dict[str, Any]],
+                        num_tasks: int) -> str:
+    """Identity of one exchange-producing map stage: the stage subtree
+    plus the partitioning that shaped its shuffle output — two stages
+    agreeing on both produce byte-identical partition blocks."""
+    return _digest({"plan": stage_plan, "partitioning": partitioning,
+                    "num_tasks": int(num_tasks)})
+
+
+def source_snapshot(plan: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Version stamp of every data source under `plan`, or None when the
+    plan is uncacheable (see module docstring)."""
+    files: Dict[str, List[int]] = {}
+    snapshots: List[str] = []
+    for node in _walk(plan):
+        kind = node.get("kind")
+        if kind in _UNCACHEABLE_KINDS:
+            return None
+        if kind in _FILE_SCAN_KINDS:
+            for group in node.get("file_groups") or []:
+                for path in group:
+                    if not isinstance(path, str):
+                        return None
+                    try:
+                        st = os.stat(path)
+                    except OSError:
+                        return None
+                    files[path] = [st.st_mtime_ns, st.st_size]
+        snap_id = node.get("snapshot_id")
+        if snap_id is not None:
+            snapshots.append(str(snap_id))
+    if not files and not snapshots:
+        return None
+    return {"files": files, "snapshots": sorted(snapshots)}
+
+
+def snapshot_digest(snapshot: Dict[str, Any]) -> str:
+    return _digest(snapshot)
+
+
+def result_cache_key(plan: Dict[str, Any]
+                     ) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """(fingerprint, snapshot) for a whole query, or None when the plan
+    cannot be cached or deduplicated."""
+    snap = source_snapshot(plan)
+    if snap is None:
+        return None
+    return plan_fingerprint(plan), snap

@@ -206,6 +206,11 @@ class DagScheduler:
         # compute_placement from this instead of the session-level
         # default, which reported "cpu" even when device lanes ran)
         self.stage_placement: Dict[int, Dict[str, str]] = {}
+        # work-sharing (auron.tpu.cache.subplan): sid -> (fp, snapshot)
+        # of stages served FROM the cross-query cache this run, and of
+        # stages whose fresh output should be stored after the map wave
+        self._cached_stages: Dict[int, tuple] = {}
+        self._pending_subplan: Dict[int, tuple] = {}
 
     def _record_task_metrics(self, sid: int, tree: MetricNode) -> None:
         from blaze_tpu.bridge import profiling
@@ -530,7 +535,11 @@ class DagScheduler:
         falls that one task back in-process."""
         from blaze_tpu import config
         if not config.WORKERS_ENABLE.get():
-            return None
+            # serving-mode queries may opt map tasks onto the pool even
+            # when the global switch is off, so N admitted queries get
+            # process parallelism instead of time-slicing one interpreter
+            if self._query is None or not config.SERVING_USE_WORKERS.get():
+                return None
 
         def spec(m: int) -> Optional[Dict[str, Any]]:
             td = self._map_task_def(stage, part, m)
@@ -629,6 +638,109 @@ class DagScheduler:
         from blaze_tpu.serving.context import is_cancellation
         return is_cancellation(e)
 
+    # -- cross-query subplan cache (auron.tpu.cache.subplan) ---------------
+
+    def _subplan_cache_key(self, stage: Stage):
+        """(fingerprint, snapshot) when this producer stage is shareable
+        across queries, else None.  Only LEAF stages qualify: a stage
+        reading upstream exchanges carries run-scoped stage:// resource
+        ids, so its identity can never match another run's anyway."""
+        from blaze_tpu import config
+        if not (config.CACHE_ENABLE.get() and config.CACHE_SUBPLAN.get()):
+            return None
+        if stage.partitioning is None or self._reader_rids(stage.plan):
+            return None
+        from blaze_tpu.plan import fingerprint as fp_mod
+        snap = fp_mod.source_snapshot(stage.plan)
+        if snap is None:
+            return None
+        part = self._part_of(stage)
+        fp = fp_mod.subplan_fingerprint(stage.plan, part, stage.num_tasks)
+        return fp, snap
+
+    def _try_cached_producer(self, stage: Stage) -> bool:
+        """Serve one map stage from the cross-query cache: publish the
+        cached partition blocks under the stage's resource id (the raw-
+        bytes block shape the device tier already publishes) and skip
+        the whole map wave.  Misses remember the key so the fresh output
+        is stored after the file-tier wave commits."""
+        key = self._subplan_cache_key(stage)
+        if key is None:
+            return False
+        from blaze_tpu.cache import results as result_cache
+        cache = result_cache.get_cache()
+        if cache is None:
+            return False
+        fp, snap = key
+        blocks = cache.get_subplan(fp, snap)
+        if blocks is None:
+            self._pending_subplan[stage.sid] = key
+            return False
+        sid = stage.sid
+        self._cached_stages[sid] = key
+        # empty map-output table: _shuffle_inputs finds no file-backed
+        # entries, so consumer tasks stay in-process (same contract as
+        # the device tier)
+        self._stage_outputs[sid] = {}
+
+        def blocks_for(reduce_id: int, _blocks=blocks):
+            for blk in _blocks.get(reduce_id, ()):
+                yield blk
+
+        put_resource(stage.resource_id, blocks_for)
+        if stage.resource_id not in self._resources:
+            self._resources.append(stage.resource_id)
+        self.stage_placement[sid] = {"compute": "cached",
+                                     "exchange": "cached"}
+        self._note_history_stage(sid)
+        from blaze_tpu.bridge import tracing
+        tracing.instant("subplan_cache_hit", stage=sid, fingerprint=fp)
+        return True
+
+    def _maybe_store_subplan(self, stage: Stage) -> None:
+        """After a file-tier map wave commits, store the per-reduce
+        partition bytes (the exact committed .data segments, still in
+        their on-disk IPC frame form) so a later query with the same
+        producing subtree replays them instead of re-running the wave."""
+        key = self._pending_subplan.pop(stage.sid, None)
+        if key is None:
+            return
+        from blaze_tpu.cache import results as result_cache
+        cache = result_cache.get_cache()
+        if cache is None:
+            return
+        outputs = self._stage_outputs.get(stage.sid) or {}
+        n_out = int(self._part_of(stage).get("num_partitions", 1))
+        blocks: Dict[int, list] = {}
+        try:
+            for map_id in sorted(outputs):
+                entry = outputs[map_id]
+                if entry is None:
+                    return  # invalidated mid-wave: nothing safe to store
+                data, offsets = entry
+                with open(data, "rb") as f:
+                    for r in range(n_out):
+                        length = int(offsets[r + 1] - offsets[r])
+                        if not length:
+                            continue
+                        f.seek(int(offsets[r]))
+                        blocks.setdefault(r, []).append(f.read(length))
+        except OSError:
+            return  # torn output: cache nothing, the files stay truth
+        cache.put_subplan(key[0], key[1], blocks)
+
+    def _invalidate_cached_stage(self, sid: int) -> None:
+        """A cached stage's replay went bad: drop the entry and re-run
+        the producer with the cache bypassed — fresh execution is the
+        recovery path, never a second replay of suspect bytes."""
+        key = self._cached_stages.pop(sid, None)
+        if key is None:
+            return
+        from blaze_tpu.cache import results as result_cache
+        cache = result_cache.get_cache()
+        if cache is not None:
+            cache.invalidate(key[0])
+
     def _run_producer(self, stage: Stage) -> None:
         """One exchange boundary: device-resident collective when the
         planner marked it eligible; else the elastic shuffle service
@@ -637,6 +749,8 @@ class DagScheduler:
         otherwise — and the file path is ALSO the fallback for any
         device- or service-tier failure.  The higher tiers are
         optimizations, never a new failure mode."""
+        if self._try_cached_producer(stage):
+            return
         if stage.device_spec is not None:
             try:
                 self._run_producer_device(stage)
@@ -670,6 +784,7 @@ class DagScheduler:
                 tracing.instant("rss_shuffle_fallback", stage=stage.sid,
                                 error=type(e).__name__)
         self._run_producer_file(stage)
+        self._maybe_store_subplan(stage)
 
     @staticmethod
     def _rss_root() -> Optional[str]:
@@ -993,6 +1108,13 @@ class DagScheduler:
         if stage is None or stage.partitioning is None \
                 or not 0 <= ff.map_id < stage.num_tasks:
             raise ff  # no lineage to recover from
+        if ff.stage_id in self._cached_stages:
+            # the poisoned blocks were a cross-query cache replay:
+            # invalidate the entry and re-produce the stage for real
+            # (cache bypassed — the run owns fresh files from here on)
+            self._invalidate_cached_stage(ff.stage_id)
+            self._run_producer_file(stage)
+            return
         from blaze_tpu.bridge import tracing, xla_stats
         part = self._part_of(stage)
         with tracing.span("stage_recovery", stage=ff.stage_id,
@@ -1147,7 +1269,9 @@ class DagScheduler:
         # are re-validated immediately (invalidate_worker_outputs) so a
         # torn commit surfaces as lineage recovery, not a bad read
         crash_pool = None
-        if config.WORKERS_ENABLE.get():
+        if config.WORKERS_ENABLE.get() or (
+                self._query is not None
+                and config.SERVING_USE_WORKERS.get()):
             from blaze_tpu.parallel import workers as _workers
             crash_pool = _workers.get_pool()
             if crash_pool is not None:

@@ -76,6 +76,10 @@ class QueryHandle:
         #: final merged metric tree (dict), populated when the history
         #: plane is on — the event log's terminal payload
         self.metrics_tree: Optional[dict] = None
+        #: work-sharing identity: (fingerprint, snapshot) when the plan
+        #: is cacheable, and the single-flight key this handle leads
+        self._cache_key = None
+        self._flight_key: Optional[str] = None
 
     @property
     def wall_s(self) -> Optional[float]:
@@ -100,6 +104,20 @@ class QueryHandle:
         if not self._done.wait(timeout):
             raise TimeoutError(f"query {self.query_id} not finished")
         return self._error
+
+
+class _Flight:
+    """One single-flight group: the leader executes, waiters share its
+    outcome.  Lives in QueryService._flights under the service lock."""
+
+    __slots__ = ("key", "plan", "leader", "waiters")
+
+    def __init__(self, key: str, plan: Dict[str, Any],
+                 leader: QueryHandle):
+        self.key = key
+        self.plan = plan
+        self.leader = leader
+        self.waiters: List[QueryHandle] = []
 
 
 def _default_executor(plan: Dict[str, Any], ctx: QueryContext,
@@ -159,7 +177,11 @@ class QueryService:
         self.counters = {"admitted": 0, "completed": 0, "failed": 0,
                          "cancelled": 0, "deadline": 0,
                          "shed_queue_full": 0, "shed_tenant_quota": 0,
-                         "shed_memory": 0, "shed_injected": 0}
+                         "shed_memory": 0, "shed_injected": 0,
+                         "coalesced": 0, "cache_hits": 0}
+        #: single-flight groups keyed by fingerprint:snapshot digest
+        self.single_flight = config.SERVING_SINGLE_FLIGHT.get()
+        self._flights: Dict[str, _Flight] = {}
         _services.add(self)
 
     # -- admission ------------------------------------------------------
@@ -171,6 +193,21 @@ class QueryService:
             deadline_ms = config.QUERY_DEADLINE_MS.get()
         if mem_quota is None:
             mem_quota = config.QUERY_MEM_QUOTA.get()
+        # work-sharing identity, computed OUTSIDE the admission lock:
+        # the snapshot stats every source file
+        cache_key = flight_key = cached_nbytes = None
+        if config.CACHE_ENABLE.get() or self.single_flight:
+            from blaze_tpu.plan import fingerprint as fp_mod
+            cache_key = fp_mod.result_cache_key(plan)
+            if cache_key is not None:
+                flight_key = (f"{cache_key[0]}:"
+                              f"{fp_mod.snapshot_digest(cache_key[1])}")
+                if config.CACHE_ENABLE.get():
+                    from blaze_tpu.cache import results as result_cache
+                    cache = result_cache.get_cache()
+                    if cache is not None:
+                        cached_nbytes = cache.peek_result_nbytes(
+                            cache_key[0], cache_key[1])
         with self._lock:
             if self._closed:
                 raise QueryRejected("shutdown", "service is shut down")
@@ -194,6 +231,10 @@ class QueryService:
             if self.admit_mem_bytes > 0:
                 from blaze_tpu.plan.stages import DagScheduler
                 est = DagScheduler._scan_input_bytes(plan)
+                # a cache hit will serve already-materialized bytes, so
+                # the cached footprint supersedes a stale scan estimate
+                if cached_nbytes is not None:
+                    est = min(est, cached_nbytes)
                 # the sentinel (un-stat-able input) always admits:
                 # shedding needs evidence, not absence of it
                 if est < (1 << 62) and est > self.admit_mem_bytes:
@@ -206,15 +247,32 @@ class QueryService:
                                deadline_ms=deadline_ms or 0,
                                mem_quota=mem_quota or 0)
             handle = QueryHandle(ctx, self)
+            handle._cache_key = cache_key
             self._handles[ctx.query_id] = handle
             self._queued += 1
             self._tenant_inflight[tenant] = inflight + 1
             self.counters["admitted"] += 1
+            run_now = True
+            if self.single_flight and flight_key is not None:
+                flight = self._flights.get(flight_key)
+                if flight is not None:
+                    # identical query already in flight: ride it
+                    flight.waiters.append(handle)
+                    self.counters["coalesced"] += 1
+                    run_now = False
+                else:
+                    self._flights[flight_key] = _Flight(
+                        flight_key, plan, handle)
+                    handle._flight_key = flight_key
         # outside the admission lock: the event append does file I/O
         history.note_admitted(ctx.query_id, tenant=tenant,
                               deadline_ms=deadline_ms or 0,
                               mem_quota=mem_quota or 0)
-        self._pool.submit(self._run, handle, plan)
+        if run_now:
+            self._pool.submit(self._run, handle, plan)
+        else:
+            from blaze_tpu.bridge import xla_stats
+            xla_stats.note_cache(single_flight_coalesces=1)
         return handle
 
     # -- execution ------------------------------------------------------
@@ -231,14 +289,19 @@ class QueryService:
                 # cancelled while queued (explicit cancel or deadline
                 # passed in the queue): shed at pop, zero work done
                 self._finish_locked(handle, error=shed)
+                settled = self._settle_flight_locked(handle, shed, None)
         if shed is not None:
             self._maybe_flight_dump(handle)
             self._note_history_finish(handle)
+            for w in settled:
+                self._maybe_flight_dump(w)
+                self._note_history_finish(w)
             return
         history.note_started(ctx.query_id, queued_s=queued_s)
         bridge_context.note_query_start(ctx.query_id)
         error: Optional[BaseException] = None
         result: Any = None
+        cache_hit = False
         try:
             with query_scope(ctx), \
                     tracing.execution_context(query=ctx.query_id):
@@ -248,14 +311,115 @@ class QueryService:
                 tracing.emit_span("admission_wait", int(queued_s * 1e9),
                                   query=ctx.query_id, tenant=ctx.tenant)
                 ctx.check()  # deadline may have expired in the queue
-                result = self._executor(plan, ctx, handle)
+                result, cache_hit = self._cached_result(handle)
+                if not cache_hit:
+                    result = self._executor(plan, ctx, handle)
+                    self._store_result(handle, result)
         except BaseException as e:  # noqa: BLE001 - outcome taxonomy below
             error = e
         with self._lock:
             self._running -= 1
+            if cache_hit:
+                self.counters["cache_hits"] += 1
             self._finish_locked(handle, error=error, result=result)
+            settled = self._settle_flight_locked(handle, error, result)
         self._maybe_flight_dump(handle)
         self._note_history_finish(handle)
+        for w in settled:
+            self._maybe_flight_dump(w)
+            self._note_history_finish(w)
+
+    def _cached_result(self, handle: QueryHandle):
+        """(result, True) on a semantic result-cache hit — validated
+        against the CURRENT source snapshot, so a hit is bit-identical
+        to fresh execution; (None, False) otherwise."""
+        key = handle._cache_key
+        if key is None or not config.CACHE_ENABLE.get():
+            return None, False
+        from blaze_tpu.cache import results as result_cache
+        cache = result_cache.get_cache()
+        if cache is None:
+            return None, False
+        value = cache.get_result(key[0], key[1])
+        if value is None:
+            return None, False
+        tracing.instant("result_cache_hit", query=handle.query_id,
+                        fingerprint=key[0])
+        return value, True
+
+    def _store_result(self, handle: QueryHandle, result: Any) -> None:
+        if (handle._cache_key is None or result is None
+                or not config.CACHE_ENABLE.get()):
+            return
+        from blaze_tpu.cache import results as result_cache
+        cache = result_cache.get_cache()
+        if cache is not None:
+            cache.put_result(handle._cache_key[0],
+                             handle._cache_key[1], result)
+
+    def _settle_flight_locked(self, handle: QueryHandle,
+                              error: Optional[BaseException],
+                              result: Any) -> List[QueryHandle]:
+        """Resolve the single-flight group this handle led (no-op for
+        non-leaders).  Success and hard failures propagate to every
+        waiter; a CANCELLED leader instead promotes the first live
+        waiter to executor — its cancellation is its own, not the
+        group's, and the cache was never touched by the aborted run.
+        Returns the waiters finished here (their history events are the
+        caller's, outside the lock)."""
+        key = handle._flight_key
+        if key is None:
+            return []
+        flight = self._flights.get(key)
+        if flight is None or flight.leader is not handle:
+            return []
+        settled: List[QueryHandle] = []
+        promote = (isinstance(error, QueryCancelled)
+                   and not self._closed)
+        while promote and flight.waiters:
+            w = flight.waiters.pop(0)
+            werr = self._waiter_error(w)
+            if werr is not None:
+                self._queued -= 1
+                self._finish_locked(w, error=werr)
+                settled.append(w)
+                continue
+            flight.leader = w
+            w._flight_key = key
+            try:
+                self._pool.submit(self._run, w, flight.plan)
+            except RuntimeError:  # pool already shut down
+                self._queued -= 1
+                self._finish_locked(w, error=QueryCancelled(
+                    w.query_id, "service shutdown"))
+                settled.append(w)
+                continue
+            from blaze_tpu.bridge import xla_stats
+            xla_stats.note_cache(single_flight_promotions=1)
+            return settled
+        del self._flights[key]
+        for w in flight.waiters:
+            self._queued -= 1
+            werr = self._waiter_error(w)
+            if werr is not None:
+                self._finish_locked(w, error=werr)
+            elif error is not None:
+                self._finish_locked(w, error=error)
+            else:
+                self._finish_locked(w, result=result)
+            settled.append(w)
+        return settled
+
+    @staticmethod
+    def _waiter_error(w: QueryHandle) -> Optional[BaseException]:
+        """A waiter's OWN terminal error (cancel/deadline/quota), if its
+        context tripped while it rode the flight — kills stay
+        per-query even though execution was shared."""
+        try:
+            w.ctx.check()
+        except BaseException as e:  # noqa: BLE001 - classified by ctx
+            return e
+        return None
 
     def _note_history_finish(self, handle: QueryHandle) -> None:
         """Terminal history event (status + metric tree + attribution);

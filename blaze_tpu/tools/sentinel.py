@@ -50,7 +50,8 @@ _LOWER_IS_BETTER = re.compile(
     re.IGNORECASE)
 _HIGHER_IS_BETTER = re.compile(
     r"(rows_per_sec|per_sec|qps|throughput|speedup|hit_rate|hits\b|"
-    r"fraction|utilization|rows\b|completed)", re.IGNORECASE)
+    r"fraction|utilization|rows\b|completed|coalesces|bytes_saved|"
+    r"share_ratio)", re.IGNORECASE)
 
 
 def metric_direction(key: str) -> str:

@@ -1,0 +1,509 @@
+"""Cross-query work sharing: plan fingerprints + source snapshots, the
+bounded result/subplan cache (invalidation on source mutation, snapshot
+advance), single-flight dedup (coalesce, winner-cancelled promotion),
+the shared scan-decode broker, admission accounting of cached bytes,
+and the off-by-default zero-overhead contract."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu import config, faults
+from blaze_tpu.bridge import xla_stats
+from blaze_tpu.cache import get_cache, reset_cache
+from blaze_tpu.cache.scanshare import (ScanBroker, follow_batches,
+                                       get_broker)
+from blaze_tpu.memory import MemManager
+from blaze_tpu.plan import fingerprint as fp
+from blaze_tpu.plan.explain import format_work_sharing_footer
+from blaze_tpu.plan.stages import DagScheduler
+from blaze_tpu.serving import QueryCancelled, QueryRejected, QueryService
+
+
+@pytest.fixture(autouse=True)
+def clean_slate():
+    faults.clear()
+    MemManager.init(4 << 30)
+    reset_cache()
+    try:
+        yield
+    finally:
+        reset_cache()
+        faults.clear()
+        MemManager.init(4 << 30)
+
+
+@pytest.fixture
+def cache_on():
+    config.conf.set(config.CACHE_ENABLE.key, True)
+    try:
+        yield
+    finally:
+        config.conf.unset(config.CACHE_ENABLE.key)
+
+
+@pytest.fixture
+def single_flight_on():
+    config.conf.set(config.SERVING_SINGLE_FLIGHT.key, True)
+    try:
+        yield
+    finally:
+        config.conf.unset(config.SERVING_SINGLE_FLIGHT.key)
+
+
+@pytest.fixture
+def staged_path():
+    config.conf.set(config.DAG_SINGLE_TASK_BYTES.key, 0)
+    try:
+        yield
+    finally:
+        config.conf.unset(config.DAG_SINGLE_TASK_BYTES.key)
+
+
+def _delta(before):
+    after = xla_stats.cache_stats()
+    return {k: after[k] - before.get(k, 0) for k in after
+            if after[k] != before.get(k, 0)}
+
+
+def _write_table(path, n=2_000, seed=7, n_keys=50):
+    rng = np.random.default_rng(seed)
+    t = pa.table({"k": pa.array(rng.integers(0, n_keys, n),
+                                type=pa.int64()),
+                  "v": pa.array(rng.random(n))})
+    pq.write_table(t, path)
+    return t
+
+
+def _scan_plan(paths):
+    schema = {"fields": [
+        {"name": "k", "type": {"id": "int64"}, "nullable": True},
+        {"name": "v", "type": {"id": "float64"}, "nullable": True}]}
+    return {"kind": "parquet_scan", "schema": schema,
+            "file_groups": [[p] for p in paths]}
+
+
+def _agg_plan(paths, n_reduce=3):
+    return {
+        "kind": "hash_agg",
+        "groupings": [{"expr": {"kind": "column", "index": 0},
+                       "name": "k"}],
+        "aggs": [{"fn": "sum", "mode": "final", "name": "s",
+                  "args": [{"kind": "column", "index": 1}]}],
+        "input": {
+            "kind": "local_exchange",
+            "partitioning": {"kind": "hash",
+                             "exprs": [{"kind": "column", "index": 0}],
+                             "num_partitions": n_reduce},
+            "input": {
+                "kind": "hash_agg",
+                "groupings": [{"expr": {"kind": "column", "name": "k"},
+                               "name": "k"}],
+                "aggs": [{"fn": "sum", "mode": "partial", "name": "s",
+                          "args": [{"kind": "column", "name": "v"}]}],
+                "input": _scan_plan(paths)}}}
+
+
+def _sorted(tbl):
+    return tbl.sort_by([("k", "ascending")])
+
+
+# -- fingerprints & snapshots ------------------------------------------------
+
+def test_fingerprint_stable_under_key_order(tmp_path):
+    p = str(tmp_path / "a.parquet")
+    _write_table(p)
+    plan = _scan_plan([p])
+    # same logical plan, different dict insertion order
+    reordered = {k: plan[k] for k in reversed(list(plan))}
+    assert fp.plan_fingerprint(plan) == fp.plan_fingerprint(reordered)
+    other = dict(plan, extra_knob=1)
+    assert fp.plan_fingerprint(plan) != fp.plan_fingerprint(other)
+
+
+def test_source_snapshot_uncacheable_plans(tmp_path):
+    # no version signal: memory scans cannot be validated
+    assert fp.source_snapshot({"kind": "memory_scan", "rid": "r1"}) \
+        is None
+    # run-scoped readers never collide across queries
+    assert fp.result_cache_key(
+        {"kind": "hash_agg", "input": {"kind": "ipc_reader",
+                                       "rid": "stage://1/0"}}) is None
+    # un-stat-able file: no invalidation evidence, never cached
+    gone = _scan_plan([str(tmp_path / "missing.parquet")])
+    assert fp.source_snapshot(gone) is None
+    # no versioned source at all
+    assert fp.source_snapshot({"kind": "empty"}) is None
+
+
+def test_source_snapshot_tracks_mtime_and_snapshot_id(tmp_path):
+    p = str(tmp_path / "a.parquet")
+    _write_table(p)
+    plan = _scan_plan([p])
+    snap1 = fp.source_snapshot(plan)
+    assert p in snap1["files"]
+    # rewrite + explicit mtime bump (filesystems can be coarse)
+    _write_table(p, seed=8)
+    st = os.stat(p)
+    os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    snap2 = fp.source_snapshot(plan)
+    assert fp.snapshot_digest(snap1) != fp.snapshot_digest(snap2)
+    # a connector-stamped snapshot_id (Iceberg analog) versions too
+    tagged = dict(plan, snapshot_id=41)
+    advanced = dict(plan, snapshot_id=42)
+    assert fp.source_snapshot(tagged)["snapshots"] == ["41"]
+    assert (fp.snapshot_digest(fp.source_snapshot(tagged))
+            != fp.snapshot_digest(fp.source_snapshot(advanced)))
+
+
+# -- result cache ------------------------------------------------------------
+
+def test_result_cache_invalidates_on_snapshot_mismatch(cache_on):
+    cache = get_cache()
+    t = pa.table({"x": pa.array([1, 2, 3], type=pa.int64())})
+    snap_a = {"files": {"f": [1, 10]}, "snapshots": []}
+    snap_b = {"files": {"f": [2, 10]}, "snapshots": []}  # mtime advanced
+    assert cache.put_result("fp1", snap_a, t)
+    assert cache.get_result("fp1", snap_a).equals(t)
+    before = xla_stats.cache_stats()
+    assert cache.get_result("fp1", snap_b) is None  # stale: evicted
+    d = _delta(before)
+    assert d.get("result_cache_invalidations") == 1
+    assert cache.stats()["entries"] == 0
+    # the stale entry is gone even for the original snapshot
+    assert cache.peek_result_nbytes("fp1", snap_a) is None
+
+
+def test_result_cache_byte_budget_evicts_lru(cache_on):
+    reset_cache()
+    config.conf.set(config.CACHE_MAX_BYTES.key, 1 << 14)
+    try:
+        cache = get_cache()
+        snap = {"files": {"f": [1, 1]}, "snapshots": []}
+        big = pa.table({"x": pa.array(np.arange(500), type=pa.int64())})
+        for i in range(8):
+            assert cache.put_result(f"fp{i}", snap, big)
+        s = cache.stats()
+        assert s["used_bytes"] <= s["max_bytes"]
+        assert s["entries"] < 8  # LRU shed the oldest
+        assert cache.peek_result_nbytes("fp7", snap) is not None
+    finally:
+        config.conf.unset(config.CACHE_MAX_BYTES.key)
+        reset_cache()
+
+
+def test_mem_pressure_spill_halves_footprint(cache_on):
+    cache = get_cache()
+    snap = {"files": {"f": [1, 1]}, "snapshots": []}
+    big = pa.table({"x": pa.array(np.arange(4096), type=pa.int64())})
+    for i in range(4):
+        cache.put_result(f"fp{i}", snap, big)
+    used = cache.stats()["used_bytes"]
+    released = cache.spill()
+    assert released >= used // 2
+    assert cache.stats()["used_bytes"] <= used // 2
+    assert cache.mem_used == cache.stats()["used_bytes"]
+
+
+def test_service_invalidates_on_source_mutation(tmp_path, cache_on):
+    p = str(tmp_path / "a.parquet")
+    _write_table(p, seed=7)
+    plan = _agg_plan([p])
+    svc = QueryService(max_concurrent=2, max_queue=8)
+    try:
+        r1 = svc.submit(plan).result(30)
+        r2 = svc.submit(plan).result(30)
+        assert r1.equals(r2)  # bit-identical hit
+        assert svc.counters["cache_hits"] == 1
+        # mutate the source: rewrite + guaranteed mtime advance
+        _write_table(p, seed=99)
+        st = os.stat(p)
+        os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        before = xla_stats.cache_stats()
+        r3 = svc.submit(plan).result(30)
+        d = _delta(before)
+        assert d.get("result_cache_invalidations", 0) >= 1
+        assert not _sorted(r3).equals(_sorted(r1))  # fresh data served
+        assert svc.counters["cache_hits"] == 1  # no stale hit
+    finally:
+        svc.shutdown()
+
+
+# -- single-flight dedup -----------------------------------------------------
+
+def test_single_flight_coalesces_identical_queries(tmp_path,
+                                                   single_flight_on):
+    p = str(tmp_path / "a.parquet")
+    _write_table(p)
+    plan = _scan_plan([p])
+    gate = threading.Event()
+    runs = []
+
+    def slow(plan, ctx, handle):
+        runs.append(handle.query_id)
+        gate.wait(10)
+        ctx.check()
+        return pa.table({"n": pa.array([len(runs)], type=pa.int64())})
+
+    svc = QueryService(max_concurrent=4, max_queue=16, executor=slow)
+    try:
+        before = xla_stats.cache_stats()
+        handles = [svc.submit(plan) for _ in range(5)]
+        time.sleep(0.2)
+        gate.set()
+        results = [h.result(10) for h in handles]
+        assert len(runs) == 1  # one execution, five answers
+        assert svc.counters["coalesced"] == 4
+        assert all(r.equals(results[0]) for r in results)
+        assert _delta(before).get("single_flight_coalesces") == 4
+        assert all(h.status == "done" for h in handles)
+    finally:
+        svc.shutdown()
+
+
+def test_winner_cancelled_promotes_waiter(tmp_path, single_flight_on,
+                                          cache_on):
+    p = str(tmp_path / "a.parquet")
+    _write_table(p)
+    plan = _scan_plan([p])
+    done = threading.Event()
+    started = []
+
+    def slow(plan, ctx, handle):
+        started.append(handle.query_id)
+        while not done.wait(0.02):
+            ctx.check()
+        ctx.check()
+        return pa.table({"n": pa.array([7], type=pa.int64())})
+
+    svc = QueryService(max_concurrent=2, max_queue=16, executor=slow)
+    try:
+        leader = svc.submit(plan)
+        time.sleep(0.1)
+        w1 = svc.submit(plan)
+        w2 = svc.submit(plan)
+        time.sleep(0.1)
+        before = xla_stats.cache_stats()
+        leader.cancel("caller went away")
+        time.sleep(0.3)  # leader notices, promotion runs
+        done.set()
+        # the leader's cancellation stays its own
+        with pytest.raises(QueryCancelled, match="caller went away"):
+            leader.result(10)
+        # a promoted waiter re-ran the work; both waiters got the answer
+        assert w1.result(10).num_rows == 1
+        assert w2.result(10).num_rows == 1
+        assert len(started) == 2  # leader + exactly one promoted waiter
+        assert _delta(before).get("single_flight_promotions") == 1
+        # the cancelled winner never poisoned the cache: a fresh submit
+        # hits the PROMOTED run's stored result
+        r = svc.submit(plan).result(10)
+        assert r.num_rows == 1
+        assert svc.counters["cache_hits"] == 1
+    finally:
+        svc.shutdown()
+
+
+# -- subplan cache -----------------------------------------------------------
+
+def test_subplan_cache_hit_bit_identical(tmp_path, cache_on,
+                                         staged_path):
+    p0, p1 = str(tmp_path / "a0.parquet"), str(tmp_path / "a1.parquet")
+    _write_table(p0, seed=1)
+    _write_table(p1, seed=2)
+    plan = _agg_plan([p0, p1])
+    before = xla_stats.cache_stats()
+    r1 = DagScheduler().run_collect(plan)
+    d1 = _delta(before)
+    assert d1.get("subplan_cache_puts", 0) >= 1
+    before = xla_stats.cache_stats()
+    r2 = DagScheduler().run_collect(plan)
+    d2 = _delta(before)
+    assert d2.get("subplan_cache_hits", 0) >= 1
+    assert _sorted(r2).equals(_sorted(r1))
+
+
+def test_subplan_cache_replay_is_chaos_immune(tmp_path, cache_on,
+                                              staged_path):
+    p = str(tmp_path / "a.parquet")
+    _write_table(p)
+    plan = _agg_plan([p])
+    r1 = DagScheduler().run_collect(plan)  # populates the cache
+    # every shuffle read would now fail — but cached replays hand the
+    # reducers raw bytes blocks, which never touch the fetch path
+    faults.install("shuffle-read", p=1.0)
+    r2 = DagScheduler().run_collect(plan)
+    assert _sorted(r2).equals(_sorted(r1))
+    assert faults.stats().get("shuffle-read",
+                              {"fires": 0})["fires"] == 0
+
+
+# -- scan-decode broker ------------------------------------------------------
+
+def test_scan_broker_lease_follow_release():
+    b = ScanBroker()
+    role, lead = b.lease("/f.parquet", [0, 1], ["k", "v"], 8192)
+    assert role == "lead"
+    # subset columns ride the leader's superset; exact key must match
+    role2, e2 = b.lease("/f.parquet", [0, 1], ["k"], 8192)
+    assert role2 == "follow" and e2 is lead
+    # different row groups never share
+    role3, e3 = b.lease("/f.parquet", [0], ["k"], 8192)
+    assert role3 == "lead" and e3 is not lead
+    # wider columns than the leader cannot follow it
+    role4, e4 = b.lease("/f.parquet", [0, 1], None, 8192)
+    assert role4 == "lead" and e4 is not lead
+    batches = [pa.record_batch([pa.array([1, 2])], names=["k"])]
+    before = xla_stats.cache_stats()
+    b.publish(lead, batches)
+    got = follow_batches(e2)
+    assert got is batches
+    d = _delta(before)
+    assert d.get("scan_share_hits") == 1
+    assert d.get("scan_share_bytes_saved", 0) > 0
+    for e in (lead, e2, e3, e4):
+        b.release(e)
+    assert b.live_entries() == 0
+
+
+def test_scan_broker_leader_error_falls_back():
+    b = ScanBroker()
+    _, lead = b.lease("/f.parquet", [0], ["k"], 8192)
+    _, follower = b.lease("/f.parquet", [0], ["k"], 8192)
+    b.publish(lead, None, error=RuntimeError("decode blew up"))
+    # the follower decodes itself instead of surfacing a foreign error
+    assert follow_batches(follower) is None
+    # errored entries are never joined by later arrivals
+    role, fresh = b.lease("/f.parquet", [0], ["k"], 8192)
+    assert role == "lead" and fresh is not lead
+    for e in (lead, follower, fresh):
+        b.release(e)
+    assert b.live_entries() == 0
+
+
+def test_scan_share_concurrent_runs_bit_identical(tmp_path, cache_on):
+    config.conf.set(config.CACHE_SCAN_SHARE.key, True)
+    try:
+        p = str(tmp_path / "a.parquet")
+        _write_table(p, n=5_000)
+        plan = _scan_plan([p])
+        fresh = DagScheduler().run_collect(plan)
+        results, errors = [None] * 6, []
+        barrier = threading.Barrier(6)
+
+        def run(i):
+            try:
+                barrier.wait(10)
+                results[i] = DagScheduler().run_collect(plan)
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(6)]
+        before = xla_stats.cache_stats()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errors
+        assert all(r.equals(fresh) for r in results)
+        d = _delta(before)
+        assert d.get("scan_share_misses", 0) >= 1  # someone led
+        assert get_broker().live_entries() == 0  # nothing retained
+    finally:
+        config.conf.unset(config.CACHE_SCAN_SHARE.key)
+
+
+# -- admission gate ----------------------------------------------------------
+
+def test_admission_gate_accounts_cached_result_bytes(tmp_path,
+                                                     cache_on):
+    p = str(tmp_path / "big.parquet")
+    _write_table(p, n=60_000)
+    assert os.path.getsize(p) > 64 << 10
+    plan = _agg_plan([p])
+    # prime the cache through a permissive service
+    warm = QueryService(max_concurrent=2, max_queue=8,
+                        admit_mem_bytes=1 << 30)
+    try:
+        cached = warm.submit(plan).result(30)
+    finally:
+        warm.shutdown()
+    # a strict gate sheds the cold scan estimate...
+    svc = QueryService(max_concurrent=2, max_queue=8,
+                       admit_mem_bytes=64 << 10)
+    try:
+        cold = dict(plan, extra_knob=1)  # same bytes, no cache entry
+        with pytest.raises(QueryRejected, match="memory"):
+            svc.submit(cold)
+        # ...but the cached plan admits on its materialized footprint
+        h = svc.submit(plan)
+        assert h.result(30).equals(cached)
+        assert svc.counters["cache_hits"] == 1
+        assert svc.counters["shed_memory"] == 1
+    finally:
+        svc.shutdown()
+
+
+# -- off-by-default contract -------------------------------------------------
+
+def test_cache_disabled_by_default_zero_overhead(tmp_path):
+    assert config.CACHE_ENABLE.get() is False
+    assert get_cache() is None  # disabled path allocates nothing
+    p = str(tmp_path / "a.parquet")
+    _write_table(p)
+    plan = _agg_plan([p])
+    before = xla_stats.cache_stats()
+    svc = QueryService(max_concurrent=2, max_queue=8)
+    try:
+        r1 = svc.submit(plan).result(30)
+        r2 = svc.submit(plan).result(30)
+    finally:
+        svc.shutdown()
+    assert r1.equals(r2)  # both executions ran fresh, byte-identical
+    assert _delta(before) == {}  # not a single cache counter moved
+    assert svc.counters["cache_hits"] == 0
+    assert svc.counters["coalesced"] == 0
+    # the explain footer stays silent when nothing was shared
+    assert format_work_sharing_footer(
+        {k: 0 for k in xla_stats.cache_stats()}) is None
+
+
+def test_cache_hits_emit_trace_instants(tmp_path, cache_on,
+                                        staged_path):
+    from blaze_tpu.bridge import tracing
+    p = str(tmp_path / "a.parquet")
+    _write_table(p)
+    plan = _agg_plan([p])
+    tracing.start_tracing()
+    try:
+        DagScheduler().run_collect(plan)  # populate
+        DagScheduler().run_collect(plan)  # subplan_cache_hit instant
+        svc = QueryService(max_concurrent=2, max_queue=8)
+        try:
+            svc.submit(plan).result(30)  # populate the result ring
+            svc.submit(plan).result(30)  # result_cache_hit instant
+        finally:
+            svc.shutdown()
+        names = [s["name"] for s in tracing.spans()]
+        assert "subplan_cache_hit" in names
+        assert "result_cache_hit" in names
+    finally:
+        tracing.stop_tracing()
+        with tracing._lock:
+            tracing._spans.clear()
+        tracing.reset_conf_probe()
+
+
+def test_work_sharing_footer_renders_only_when_active():
+    zeros = {k: 0 for k in xla_stats.cache_stats()}
+    assert format_work_sharing_footer(zeros) is None
+    active = dict(zeros, result_cache_hits=3, result_cache_misses=1)
+    line = format_work_sharing_footer(active)
+    assert line is not None and "work sharing" in line
+    assert "3/4" in line
