@@ -3663,8 +3663,17 @@ def obs_bench_main() -> int:
     must also really trace: per-query span counts and child spans
     stitched in over the worker wire (`obs_spans_ingested`) are
     recorded and must be non-zero, and traced results must match the
-    untraced runs bit for bit.  Writes BENCH_OBS.json and prints it
-    as one JSON line."""
+    untraced runs bit for bit.
+
+    A second section exercises the statistics feedback plane
+    (`auron.tpu.stats.enable`): the same queries run with the statstore
+    OFF then ON, the stats legs must stay within the same overhead
+    budget and match bit for bit, and the per-fingerprint priors must
+    really merge (run_count grows across runs).  ETA accuracy is
+    recorded cold (prior from one run) vs warm (prior from all earlier
+    runs) against the actual walls — recorded, not gated.
+
+    Writes BENCH_OBS.json and prints it as one JSON line."""
     if os.environ.get("BLAZE_BENCH_PLATFORM"):
         import jax
         jax.config.update("jax_platforms",
@@ -3679,6 +3688,7 @@ def obs_bench_main() -> int:
     from blaze_tpu.itest.tpcds_data import write_parquet_splits
     from blaze_tpu.memory import MemManager
     from blaze_tpu.parallel import workers
+    from blaze_tpu.plan import statstore
     from blaze_tpu.plan.stages import DagScheduler
 
     names = os.environ.get("BLAZE_BENCH_OBS_QUERIES",
@@ -3704,6 +3714,8 @@ def obs_bench_main() -> int:
 
     queries = []
     diverged = 0
+    stats_queries = []
+    stats_diverged = 0
     try:
         with tempfile.TemporaryDirectory(prefix="obs-") as d:
             plans = []
@@ -3762,17 +3774,75 @@ def obs_bench_main() -> int:
                         int(ds.get("obs_spans_ingested", 0)),
                     "divergence": err,
                 })
+
+            # --- statstore feedback plane: overhead + ETA accuracy ---
+            stats_dir = os.path.join(d, "statstore")
+            for qname, plan_dict in plans:
+                config.conf.unset(config.STATS_ENABLE.key)
+                statstore.reset_conf_probe()
+                off_wall, off_res = run(qname, plan_dict, "soff", iters)
+
+                config.conf.set(config.STATS_ENABLE.key, "on")
+                config.conf.set(config.STATS_DIR.key, stats_dir)
+                statstore.reset_conf_probe()
+                walls, preds, fp, got = [], [], None, None
+                for it in range(iters):
+                    prior = statstore.prior(fp) if fp else None
+                    preds.append((prior or {}).get(
+                        "derived", {}).get("wall_p50_s"))
+                    sched = DagScheduler(work_dir=os.path.join(
+                        d, qname, f"son{it}"))
+                    t0 = time.perf_counter()
+                    got = sched.run_collect(plan_dict)
+                    walls.append(time.perf_counter() - t0)
+                    fp = sched.stats_fingerprint or fp
+                prior = statstore.prior(fp) if fp else None
+                config.conf.unset(config.STATS_ENABLE.key)
+                config.conf.unset(config.STATS_DIR.key)
+                statstore.reset_conf_probe()
+
+                err = compare_frames(frame(got), frame(off_res))
+                if err is not None:
+                    stats_diverged += 1
+
+                def eta_err(i):
+                    # |prior p50 - actual wall| as a % of the actual;
+                    # None when no prior existed yet for that run
+                    if not (1 <= i < len(walls)) or preds[i] is None \
+                            or walls[i] <= 0:
+                        return None
+                    return round(abs(preds[i] - walls[i])
+                                 / walls[i] * 100, 2)
+
+                stats_queries.append({
+                    "query": qname,
+                    "base_wall_s": round(off_wall, 4),
+                    "stats_wall_s": round(min(walls), 4),
+                    "overhead_pct": round(
+                        (min(walls) / off_wall - 1.0) * 100, 2),
+                    "runs_merged": int((prior or {}).get(
+                        "run_count", 0)),
+                    "eta_cold_error_pct": eta_err(1),
+                    "eta_warm_error_pct": eta_err(len(walls) - 1),
+                    "divergence": err,
+                })
     finally:
         workers.shutdown_pool(wait=False)
         for k in knobs:
             config.conf.unset(k)
         config.conf.unset(config.TRACE_ENABLE.key)
+        config.conf.unset(config.STATS_ENABLE.key)
+        config.conf.unset(config.STATS_DIR.key)
         tracing.stop_tracing()
         tracing.reset_conf_probe()
+        statstore.reset_conf_probe()
 
     total_base = sum(q["base_wall_s"] for q in queries)
     total_traced = sum(q["traced_wall_s"] for q in queries)
     overhead = (total_traced / total_base - 1.0) if total_base else 0.0
+    s_base = sum(q["base_wall_s"] for q in stats_queries)
+    s_on = sum(q["stats_wall_s"] for q in stats_queries)
+    stats_overhead = (s_on / s_base - 1.0) if s_base else 0.0
     rec = {
         "metric": "tracing_overhead_pct",
         "value": round(overhead * 100, 2),
@@ -3785,6 +3855,13 @@ def obs_bench_main() -> int:
         "total_spans_ingested":
             sum(q["spans_ingested"] for q in queries),
         "divergent_queries": diverged,
+        "statstore": {
+            "overhead_pct": round(stats_overhead * 100, 2),
+            "budget_pct": budget * 100,
+            "divergent_queries": stats_diverged,
+            "runs_merged": sum(q["runs_merged"] for q in stats_queries),
+            "queries": stats_queries,
+        },
     }
     path = os.environ.get(
         "BLAZE_BENCH_OBS_PATH",
@@ -3795,7 +3872,9 @@ def obs_bench_main() -> int:
     sys.stdout.flush()
     ok = (diverged == 0 and overhead <= budget
           and all(q["spans"] > 0 for q in queries)
-          and sum(q["spans_ingested"] for q in queries) > 0)
+          and sum(q["spans_ingested"] for q in queries) > 0
+          and stats_diverged == 0 and stats_overhead <= budget
+          and all(q["runs_merged"] >= 2 for q in stats_queries))
     return 0 if ok else 1
 
 

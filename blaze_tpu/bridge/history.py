@@ -282,9 +282,12 @@ def note_stream_recovery(query_id: Any, *, resume_epoch: int,
 def note_finished(query_id: Any, *, status: str, tenant: str,
                   wall_s: Optional[float] = None,
                   error: Optional[str] = None,
-                  metric_tree: Optional[dict] = None) -> None:
+                  metric_tree: Optional[dict] = None,
+                  fingerprint: Optional[str] = None) -> None:
     """Terminal event: final status, metric tree, counter-delta
-    attribution and (when tracing ran) the device-utilization ledger."""
+    attribution, (when tracing ran) the device-utilization ledger plus
+    the critical-path bottleneck report, and (when the stats plane is
+    on) the plan fingerprint and advisor findings."""
     if not enabled():
         return
     from blaze_tpu.bridge import xla_stats
@@ -322,6 +325,26 @@ def note_finished(query_id: Any, *, status: str, tenant: str,
         fields["error"] = str(error)[:512]
     if spans:
         fields["device_ledger"] = device_ledger(spans)
+        try:
+            from blaze_tpu.bridge import critical_path
+            report = critical_path.bottleneck_report(spans, wall_s)
+            if report is not None:
+                fields["bottleneck"] = report
+        except Exception:
+            pass
+    if fingerprint:
+        fields["fingerprint"] = str(fingerprint)
+    try:
+        from blaze_tpu.plan import statstore
+        if statstore.enabled():
+            from blaze_tpu.plan import advisor as advisor_mod
+            findings = advisor_mod.findings(
+                statstore.prior(fingerprint), fields.get("bottleneck"))
+            fields["advisor"] = findings
+            if findings:
+                xla_stats.note_stats(advisor_findings=len(findings))
+    except Exception:
+        pass
     _append(query_id, "finished", fields, terminal=True)
 
 
@@ -366,11 +389,26 @@ def device_ledger(spans: List[dict]) -> Dict[str, Any]:
       ROADMAP item 4 wants overlapped away.
 
     Totals aggregate the per-stage rows; ``device_utilization`` is
-    busy/wall over stages that dispatched to the device at all."""
+    busy/wall over stages that dispatched to the device at all.
+
+    Edge contract: an empty or all-malformed trace yields an empty
+    ledger; a stage with zero exchange-tier spans (single-stage plans,
+    streaming epoch traces) reports ``barrier_idle_s`` of 0 — never a
+    crash, never negative.  Malformed records (non-dict, non-numeric
+    timestamps) are skipped, matching HistoryStore.events()."""
+
+    def _ns(v: Any) -> Optional[int]:
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return None
+
     by_stage: Dict[int, List[dict]] = {}
     for r in spans:
-        ctx = r.get("ctx") or {}
-        attrs = r.get("attrs") or {}
+        if not isinstance(r, dict) or _ns(r.get("t0_ns", 0)) is None:
+            continue
+        ctx = r.get("ctx") if isinstance(r.get("ctx"), dict) else {}
+        attrs = r.get("attrs") if isinstance(r.get("attrs"), dict) else {}
         stage = ctx.get("stage", attrs.get("stage"))
         try:
             stage = int(stage)
@@ -378,21 +416,30 @@ def device_ledger(spans: List[dict]) -> Dict[str, Any]:
             stage = -1
         by_stage.setdefault(stage, []).append(r)
 
+    def _t0(r: dict) -> int:
+        return _ns(r.get("t0_ns", 0)) or 0
+
+    def _t1(r: dict) -> int:
+        v = _ns(r.get("t1_ns"))
+        return v if v is not None else _t0(r)
+
     stages: Dict[str, Dict[str, Any]] = {}
     tot_busy = tot_wall = tot_gap = tot_barrier = 0
     for stage in sorted(by_stage):
         rs = by_stage[stage]
-        t0 = min(r.get("t0_ns", 0) for r in rs)
-        t1 = max(r.get("t1_ns", r.get("t0_ns", 0)) for r in rs)
+        t0 = min(_t0(r) for r in rs)
+        t1 = max(_t1(r) for r in rs)
         device: List[tuple] = []
         for r in rs:
             name = r.get("name")
             if name not in _DEVICE_SPANS:
                 continue
-            s0 = r.get("t0_ns", 0)
-            dur = r.get("dur_ns", 0)
+            s0 = _t0(r)
+            dur = _ns(r.get("dur_ns", 0)) or 0
             if name == "xla_compile":  # instant carrying its wall in ns
-                dur = int((r.get("attrs") or {}).get("ns", 0) or 0)
+                attrs = (r.get("attrs")
+                         if isinstance(r.get("attrs"), dict) else {})
+                dur = _ns(attrs.get("ns", 0)) or 0
             device.append((s0, s0 + max(0, dur)))
         busy = _merged_busy_ns(device)
         gap = 0
@@ -403,13 +450,13 @@ def device_ledger(spans: List[dict]) -> Dict[str, Any]:
         barrier = 0
         exchanges = [r for r in rs if r.get("name") in _EXCHANGE_SPANS]
         if exchanges:
-            ex0 = min(r.get("t0_ns", 0) for r in exchanges)
-            pre = [r.get("t1_ns", r.get("t0_ns", 0)) for r in rs
+            ex0 = min(_t0(r) for r in exchanges)
+            pre = [_t1(r) for r in rs
                    if r.get("name") not in _EXCHANGE_SPANS
-                   and r.get("t1_ns", r.get("t0_ns", 0)) <= ex0]
+                   and _t1(r) <= ex0]
             if pre:
                 barrier = max(0, ex0 - max(pre))
-        wall = t1 - t0
+        wall = max(0, t1 - t0)
         stages[str(stage)] = {
             "wall_s": round(wall / 1e9, 6),
             "device_busy_s": round(busy / 1e9, 6),
@@ -512,7 +559,8 @@ class HistoryStore:
                        "replays": 0, "recoveries": 0,
                        "replayed_epochs": 0},
             "metric_tree": None, "attribution": None,
-            "device_ledger": None, "error": None,
+            "device_ledger": None, "bottleneck": None,
+            "advisor": None, "fingerprint": None, "error": None,
             "events": len(events), "events_dropped": 0,
         }
         for e in events:
@@ -554,6 +602,9 @@ class HistoryStore:
                 s["metric_tree"] = e.get("metric_tree")
                 s["attribution"] = e.get("attribution")
                 s["device_ledger"] = e.get("device_ledger")
+                s["bottleneck"] = e.get("bottleneck")
+                s["advisor"] = e.get("advisor")
+                s["fingerprint"] = e.get("fingerprint")
                 s["error"] = e.get("error")
                 s["events_dropped"] = int(e.get("events_dropped", 0))
         return s

@@ -164,6 +164,7 @@ def prometheus_text() -> str:
         "speculation": "speculative execution",
         "obs": "observability plane",
         "cache": "cross-query work sharing",
+        "stats": "statistics feedback plane",
     }
     families = xla_stats.counter_families()
     for fam in sorted(families):
@@ -370,6 +371,24 @@ def engine_status() -> dict:
     return status
 
 
+#: every GET route the service answers, placeholders included; the 404
+#: payload and the HTTP conformance sweep
+#: (tests/test_http_conformance.py) both read this — a handler branch
+#: without a row here, or vice versa, fails the sweep.
+ROUTES = (
+    "/status", "/metrics", "/metrics.prom",
+    "/profile", "/profile/<qid>",
+    "/query/<qid>/timeline", "/query/<qid>/bottleneck",
+    "/query/<qid>/progress",
+    "/auron", "/auron.html",
+    "/trace/start", "/trace/stop",
+    "/history", "/history/<qid>", "/history/rollup",
+    "/stats", "/stats/<fingerprint>",
+    "/progress",
+    "/serving", "/serving/cancel",
+)
+
+
 class _Handler(BaseHTTPRequestHandler):
     _tracing = False
 
@@ -425,6 +444,58 @@ class _Handler(BaseHTTPRequestHandler):
                               f"(is tracing enabled?)"}))
             else:
                 self._send(200, json.dumps(timeline, default=str))
+        elif route.startswith("/query/") and route.endswith("/bottleneck"):
+            from blaze_tpu.bridge import critical_path, tracing
+            qid = urllib.parse.unquote(
+                route[len("/query/"):-len("/bottleneck")])
+            report = None
+            spans = tracing.spans_for_query(qid)
+            if spans:
+                report = critical_path.bottleneck_report(spans)
+            if report is None:
+                # the live buffer may have rotated; the history finished
+                # event keeps the report alongside the device ledger
+                from blaze_tpu.bridge.history import HistoryStore
+                summary = HistoryStore().summary(qid)
+                if summary:
+                    report = summary.get("bottleneck")
+            if report is None:
+                self._send(404, json.dumps(
+                    {"error": f"no bottleneck report for query {qid!r} "
+                              f"(is tracing or history enabled?)"}))
+            else:
+                self._send(200, json.dumps(report, sort_keys=True))
+        elif route.startswith("/query/") and route.endswith("/progress"):
+            from blaze_tpu.serving import progress as progress_mod
+            qid = urllib.parse.unquote(
+                route[len("/query/"):-len("/progress")])
+            p = progress_mod.progress(qid)
+            if p is None:
+                self._send(404, json.dumps(
+                    {"error": f"no progress for query {qid!r} "
+                              f"(is auron.tpu.stats.enable on?)",
+                     "live": progress_mod.live()}))
+            else:
+                self._send(200, json.dumps(p, sort_keys=True))
+        elif route == "/progress":
+            from blaze_tpu.serving import progress as progress_mod
+            self._send(200, json.dumps(progress_mod.snapshot_all(),
+                                       sort_keys=True))
+        elif route == "/stats":
+            from blaze_tpu.plan.statstore import StatStore
+            self._send(200, json.dumps(StatStore().summary(),
+                                       sort_keys=True))
+        elif route.startswith("/stats/"):
+            from blaze_tpu.plan.statstore import StatStore
+            fp = urllib.parse.unquote(route[len("/stats/"):])
+            store = StatStore()
+            rec = store.record(fp)
+            if rec is None:
+                self._send(404, json.dumps(
+                    {"error": f"no statistics for fingerprint {fp!r}",
+                     "known": store.fingerprints()}))
+            else:
+                self._send(200, json.dumps(rec, sort_keys=True))
         elif route == "/trace/start":
             import jax
             # the trace dir arrives as ?dir=<path> (query STRING, not the
@@ -498,19 +569,7 @@ class _Handler(BaseHTTPRequestHandler):
                                         "cancelled": cancel_query(qid)}))
         else:
             self._send(404, json.dumps({"error": "unknown path",
-                                        "paths": ["/status", "/metrics",
-                                                  "/metrics.prom",
-                                                  "/profile",
-                                                  "/profile/<qid>",
-                                                  "/query/<qid>/timeline",
-                                                  "/auron", "/auron.html",
-                                                  "/trace/start",
-                                                  "/trace/stop",
-                                                  "/history",
-                                                  "/history/<qid>",
-                                                  "/history/rollup",
-                                                  "/serving",
-                                                  "/serving/cancel"]}))
+                                        "paths": list(ROUTES)}))
 
 
 _server: Optional[ThreadingHTTPServer] = None

@@ -161,6 +161,15 @@ _cache = {"result_cache_hits": 0, "result_cache_misses": 0,
           "scan_share_bytes_saved": 0,
           "cache_used_bytes_last": 0}
 
+# Statistics feedback plane (plan/statstore.py, plan/advisor.py):
+# observations ingested, ingests that merged onto an existing record
+# (run 2+ of a fingerprint), advisor findings emitted into history,
+# progress ETAs seeded from a statstore prior, and the store's current
+# on-disk fingerprint count (gauge).
+_stats = {"stats_ingests": 0, "stats_runs_merged": 0,
+          "stats_advisor_findings": 0, "stats_eta_seeded": 0,
+          "stats_fingerprints_last": 0}
+
 # Bounded raw-sample reservoirs feeding tail-latency percentiles
 # (bench.py --workers / --speculate): successful task-attempt durations
 # and run_tasks wave walls, in ns.  Lists, so NOT folded into
@@ -463,6 +472,26 @@ def cache_stats() -> dict:
         return dict(_cache)
 
 
+def note_stats(**deltas: int) -> None:
+    """Stats-plane mutator: kwargs name `_stats` keys with or without
+    the `stats_` prefix; gauges (`*_last`) are set absolutely, counters
+    are incremented (the note_cache contract)."""
+    with _lock:
+        for k, v in deltas.items():
+            key = k if k.startswith("stats_") else f"stats_{k}"
+            if key not in _stats:
+                continue
+            if key.endswith("_last"):
+                _stats[key] = int(v)
+            else:
+                _stats[key] += int(v)
+
+
+def statstore_stats() -> dict:
+    with _lock:
+        return dict(_stats)
+
+
 def _histogram(samples_ns: List[int]) -> Dict[str, Any]:
     """Cumulative-bucket Prometheus histogram over an ns reservoir:
     {"buckets": [(le_seconds, cumulative_count), ...], "sum": seconds,
@@ -749,6 +778,7 @@ def counter_families() -> Dict[str, Dict[str, int]]:
             "speculation": dict(_speculation),
             "obs": dict(_obs),
             "cache": dict(_cache),
+            "stats": dict(_stats),
         }
 
 
@@ -774,6 +804,7 @@ def snapshot() -> dict:
     flat.update(speculation_stats())
     flat.update(obs_stats())
     flat.update(cache_stats())
+    flat.update(statstore_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -813,6 +844,8 @@ def reset() -> None:
             _obs[k] = 0
         for k in _cache:
             _cache[k] = 0
+        for k in _stats:
+            _stats[k] = 0
         _task_duration_ns.clear()
         _wave_wall_ns.clear()
         _bucket_caps.clear()

@@ -832,6 +832,39 @@ SENTINEL_THRESHOLD = float_conf(
     "Default relative noise floor for the regression sentinel "
     "(blaze_tpu/tools/sentinel.py): metric drift below this fraction "
     "of baseline is not a regression.", category="observability")
+STATS_ENABLE = bool_conf(
+    "auron.tpu.stats.enable", False,
+    "Enable the statistics feedback plane: the per-fingerprint "
+    "observed-stats store (plan/statstore.py), the advisor findings "
+    "derived from it, and the live /query/<qid>/progress registry.  "
+    "Probed once lazily; disabled it stays a near-free boolean check — "
+    "zero writes, zero allocation on the query path.",
+    category="observability")
+STATS_DIR = str_conf(
+    "auron.tpu.stats.dir", "",
+    "Directory for the per-fingerprint statistics store; empty uses "
+    "<history dir>/stats.", category="observability")
+STATS_MAX_FINGERPRINTS = int_conf(
+    "auron.tpu.stats.maxFingerprints", 256,
+    "Retention bound for the statistics store: most-recently-updated "
+    "fingerprint records kept on disk; ingest prunes the oldest beyond "
+    "this.", category="observability")
+STATS_SKETCH_CENTROIDS = int_conf(
+    "auron.tpu.stats.sketchCentroids", 64,
+    "Centroid budget per quantile sketch in the statistics store.  "
+    "Larger is sharper (lower quantile error) and bigger on disk; "
+    "merges collapse the closest adjacent centroids past this bound.",
+    category="observability")
+STATS_ADVISOR_BROADCAST_BYTES = int_conf(
+    "auron.tpu.stats.advisor.broadcastBytes", 8 << 20,
+    "Advisor threshold: a shuffle boundary whose p50 total bytes fits "
+    "under this is flagged as a broadcast candidate.",
+    category="observability")
+STATS_ADVISOR_SKEW_FACTOR = float_conf(
+    "auron.tpu.stats.advisor.skewFactor", 4.0,
+    "Advisor threshold: a partition whose bytes exceed this multiple "
+    "of the boundary's median partition bytes is flagged as a "
+    "skew-split candidate.", category="observability")
 UDAF_FALLBACK_ENABLE = bool_conf(
     "auron.udafFallback.enable", True,
     "Allow typed-imperative UDAFs to run through the host round-trip "

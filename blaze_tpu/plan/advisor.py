@@ -1,0 +1,183 @@
+"""Structured findings derived from the statistics feedback plane:
+the statstore's merged per-fingerprint record (plan/statstore.py) plus
+the finished query's bottleneck report (bridge/critical_path.py).
+
+Each finding is a small JSON object — ``{"kind", "stage", "summary",
+"evidence"}`` — embedded in the history ``finished`` event and counted
+in the ``stats_advisor_findings`` Prometheus counter.  Findings are
+*advice for the next run* (and PR 17's adaptive pass reads the same
+record directly); they never change execution here.
+
+Kinds (docs/observability.md keeps the table):
+
+- ``broadcast_candidate``   a shuffle boundary small enough to broadcast
+- ``skew_partition``        one partition >> median: skew-split candidate
+- ``host_eviction``         stage-loop/scatter work evicted to the host
+- ``low_cache_hit_rate``    expr/stage-loop program cache churns
+- ``high_cardinality_agg``  partial-agg probe says grouping won't reduce
+- ``dominant_bottleneck``   one wall-clock category owns most of the run
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from blaze_tpu.plan import statstore
+
+__all__ = ["FINDING_KINDS", "findings"]
+
+FINDING_KINDS = ("broadcast_candidate", "skew_partition", "host_eviction",
+                 "low_cache_hit_rate", "high_cardinality_agg",
+                 "dominant_bottleneck")
+
+#: category -> what to try, for dominant_bottleneck summaries
+_BOTTLENECK_HINTS = {
+    "scan_decode": "consider narrower projection or scan-share cache",
+    "device_compute": "device-bound; check stage-loop chunk sizing",
+    "host_compute": "host-bound; check host-lane evictions",
+    "exchange_wire": "exchange-bound; broadcast or fewer partitions",
+    "barrier_idle": "map->exchange barrier; rebalance producer tasks",
+    "dispatch_gap": "scheduler idle; raise task parallelism",
+    "admission_wait": "queue-bound; raise admission concurrency",
+    "retry_backoff": "retries dominate; investigate task failures",
+}
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024.0 or unit == "GB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GB"
+
+
+def _broadcast_bytes() -> int:
+    try:
+        from blaze_tpu import config
+        return int(config.STATS_ADVISOR_BROADCAST_BYTES.get())
+    except Exception:
+        return 8 << 20
+
+
+def _skew_factor() -> float:
+    try:
+        from blaze_tpu import config
+        return float(config.STATS_ADVISOR_SKEW_FACTOR.get())
+    except Exception:
+        return 4.0
+
+
+def _median(values: List[float]) -> float:
+    vs = sorted(values)
+    n = len(vs)
+    if not n:
+        return 0.0
+    mid = n // 2
+    return vs[mid] if n % 2 else (vs[mid - 1] + vs[mid]) / 2.0
+
+
+def _stage_findings(sfp: str, st: Dict[str, Any]) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    sid = st.get("sid")
+    total_p50 = statstore.sketch_quantile(st.get("total_bytes") or {}, 0.5)
+    partitions = int(st.get("partitions") or 0)
+    thr = _broadcast_bytes()
+    if total_p50 is not None and 0 < total_p50 <= thr and partitions > 1:
+        out.append({
+            "kind": "broadcast_candidate", "stage": sid,
+            "summary": (f"stage {sid} shuffle writes "
+                        f"{_fmt_bytes(total_p50)} (p50) across "
+                        f"{partitions} partitions: fits broadcast "
+                        f"threshold {_fmt_bytes(thr)}"),
+            "evidence": {"fingerprint": sfp,
+                         "total_bytes_p50": round(total_p50, 1),
+                         "threshold_bytes": thr,
+                         "partitions": partitions},
+        })
+    last = [float(b) for b in (st.get("last_partition_bytes") or [])]
+    med = _median(last)
+    factor = _skew_factor()
+    if last and med > 0:
+        worst = max(range(len(last)), key=lambda i: (last[i], -i))
+        ratio = last[worst] / med
+        if ratio >= factor:
+            out.append({
+                "kind": "skew_partition", "stage": sid,
+                "summary": (f"stage {sid} partition {worst} is "
+                            f"{ratio:.1f}x median "
+                            f"({_fmt_bytes(last[worst])} vs "
+                            f"{_fmt_bytes(med)}): skew-split candidate"),
+                "evidence": {"fingerprint": sfp, "partition": worst,
+                             "partition_bytes": int(last[worst]),
+                             "median_bytes": round(med, 1),
+                             "ratio": round(ratio, 2),
+                             "factor": factor},
+            })
+    return out
+
+
+def findings(record: Optional[Dict[str, Any]],
+             bottleneck: Optional[Dict[str, Any]] = None
+             ) -> List[Dict[str, Any]]:
+    """Derive advisor findings; deterministic given (record,
+    bottleneck), sorted by (kind, stage)."""
+    out: List[Dict[str, Any]] = []
+    rec = record or {}
+    for sfp in sorted(rec.get("stages") or {}):
+        out.extend(_stage_findings(sfp, rec["stages"][sfp]))
+    for reason, n in sorted((rec.get("fallback_reasons") or {}).items()):
+        if int(n) > 0:
+            out.append({
+                "kind": "host_eviction", "stage": None,
+                "summary": f"host-evicted: {reason} x{int(n)}",
+                "evidence": {"reason": reason, "count": int(n)},
+            })
+    derived = rec.get("derived") or {}
+    counters = rec.get("counters") or {}
+    for rate_key, built_key, hits_key, what in (
+            ("expr_cache_hit_rate", "expr_programs_built",
+             "expr_program_cache_hits", "expr-program"),
+            ("stage_loop_cache_hit_rate", "stage_loop_programs_built",
+             "stage_loop_program_cache_hits", "stage-loop")):
+        rate = derived.get(rate_key)
+        lookups = (int(counters.get(built_key, 0)) +
+                   int(counters.get(hits_key, 0)))
+        if rate is not None and lookups >= 8 and rate < 0.5:
+            out.append({
+                "kind": "low_cache_hit_rate", "stage": None,
+                "summary": (f"{what} cache hit rate {rate:.0%} over "
+                            f"{lookups} lookups: compile churn"),
+                "evidence": {"plane": what, "hit_rate": rate,
+                             "lookups": lookups},
+            })
+    ratio = derived.get("agg_probe_ratio")
+    if ratio is not None and ratio >= 0.8:
+        out.append({
+            "kind": "high_cardinality_agg", "stage": None,
+            "summary": (f"partial-agg probe ratio {ratio:.2f} "
+                        f"(groups/rows): partial agg barely reduces — "
+                        f"skip candidate"),
+            "evidence": {
+                "agg_probe_ratio": ratio,
+                "probe_rows": int(counters.get(
+                    "partial_agg_probe_rows", 0)),
+                "probe_groups": int(counters.get(
+                    "partial_agg_probe_groups", 0))},
+        })
+    if bottleneck:
+        dom = bottleneck.get("dominant")
+        frac = float(bottleneck.get("dominant_fraction") or 0.0)
+        if dom and frac >= 0.5:
+            hint = _BOTTLENECK_HINTS.get(dom, "")
+            out.append({
+                "kind": "dominant_bottleneck", "stage": None,
+                "summary": (f"{dom} owns {frac:.0%} of wall"
+                            + (f": {hint}" if hint else "")),
+                "evidence": {"category": dom, "fraction": frac,
+                             "wall_s": bottleneck.get("wall_s")},
+            })
+    out.sort(key=lambda f: (f["kind"],
+                            -1 if f["stage"] is None else int(f["stage"]),
+                            f["summary"]))
+    return out

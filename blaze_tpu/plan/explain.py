@@ -95,6 +95,23 @@ def format_work_sharing_footer(x) -> Optional[str]:
         f"invalidations={x.get('result_cache_invalidations', 0)}")
 
 
+def format_bottleneck_footer(report) -> Optional[str]:
+    """The explain-analyze "bottleneck:" footer from a
+    bridge/critical_path.bottleneck_report dict, or None when no spans
+    were traced — tracing is off by default and the profile must stay
+    byte-identical then."""
+    if not report or not report.get("span_count"):
+        return None
+    cats = report.get("categories") or {}
+    parts = [f"{k}={cats[k]:.3f}s" for k in sorted(cats) if cats.get(k)]
+    head = f"bottleneck: wall={report.get('wall_s', 0):.3f}s"
+    dom = report.get("dominant")
+    if dom:
+        head += (f" dominant={dom} "
+                 f"({report.get('dominant_fraction', 0):.0%})")
+    return head + ((" " + " ".join(parts)) if parts else "")
+
+
 def _node_line(node: MetricNode) -> str:
     v = node.values
     total = v.get("elapsed_compute_ns", 0)
@@ -145,11 +162,14 @@ class QueryProfile:
     kernels: Dict[str, dict] = field(default_factory=dict)
     placement: str = ""
     output_rows: int = 0
+    # critical-path category attribution (bridge/critical_path.py
+    # bottleneck_report over the run's spans); None when tracing was off
+    bottleneck: Optional[dict] = None
     # result table, only populated under keep_result=True; NOT serialized
     result: Optional[Any] = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "query_id": self.query_id,
             "wall_ns": self.wall_ns,
             "tree": self.tree.to_dict(),
@@ -160,6 +180,9 @@ class QueryProfile:
             "placement": self.placement,
             "output_rows": self.output_rows,
         }
+        if self.bottleneck is not None:
+            d["bottleneck"] = self.bottleneck
+        return d
 
     def render_text(self) -> str:
         lines = [f"== query profile {self.query_id} "
@@ -301,6 +324,9 @@ class QueryProfile:
                 f"declines={x.get('scatter_lane_declines', 0)} "
                 f"fault_fallbacks="
                 f"{x.get('scatter_lane_fault_fallbacks', 0)}")
+        bn_line = format_bottleneck_footer(self.bottleneck)
+        if bn_line is not None:
+            lines.append(bn_line)
         return "\n".join(lines)
 
     def __str__(self) -> str:
@@ -417,12 +443,19 @@ def explain_analyze(plan: Union[Dict[str, Any], Any], *,
                 plan, work_dir)
     wall_ns = time.perf_counter_ns() - t0
 
+    bottleneck = None
+    spans = tracing.spans_for_query(qid)
+    if spans:
+        from blaze_tpu.bridge import critical_path
+        bottleneck = critical_path.bottleneck_report(spans, wall_ns / 1e9)
+
     profile = QueryProfile(
         query_id=qid, wall_ns=wall_ns, tree=tree, partitions=partitions,
         exec_mode=mode, xla=xla_stats.delta(xla_before),
         kernels=xla_stats.compile_report()["kernels"],
         placement="host" if host_resident() else "device",
-        output_rows=rows, result=table if keep_result else None)
+        output_rows=rows, bottleneck=bottleneck,
+        result=table if keep_result else None)
     if record:
         profiling.record_profile(qid, profile.to_dict())
         ui.record_completion(qid, wall_ns / 1e9, metrics=tree.to_dict())

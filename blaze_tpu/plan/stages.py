@@ -211,6 +211,16 @@ class DagScheduler:
         # stages whose fresh output should be stored after the map wave
         self._cached_stages: Dict[int, tuple] = {}
         self._pending_subplan: Dict[int, tuple] = {}
+        # statistics feedback plane (plan/statstore.py; armed per run by
+        # _stats_begin only when auron.tpu.stats.enable): the run's plan
+        # fingerprint, per-shuffle-boundary observations captured at
+        # producer completion (the map-output table is gone by cleanup),
+        # and the counter/reservoir baselines the final ingest deltas
+        self.stats_fingerprint: Optional[str] = None
+        self.stage_boundaries: Dict[int, Dict[str, Any]] = {}
+        self._stats_base: Optional[dict] = None
+        self._stats_dur0: Dict[str, int] = {}
+        self._stats_t0: float = 0.0
 
     def _record_task_metrics(self, sid: int, tree: MetricNode) -> None:
         from blaze_tpu.bridge import profiling
@@ -219,6 +229,16 @@ class DagScheduler:
                 sid, MetricNode(name=tree.name))
             merged.merge_from(tree)
         profiling.record_metrics(tree.to_dict())
+        from blaze_tpu.plan import statstore
+        if statstore.enabled():
+            qid = getattr(self._query, "query_id", None)
+            if qid is not None:
+                from blaze_tpu.serving import progress
+                values = tree.values or {}
+                progress.note_rows(
+                    qid, sid,
+                    rows=int(values.get("output_rows", 0) or 0),
+                    bytes_=int(values.get("io_bytes", 0) or 0))
 
     def collect_metrics(self) -> Optional[MetricNode]:
         """Merged metric tree of the result stage (the operator tree the
@@ -354,12 +374,26 @@ class DagScheduler:
 
     # -- execution ---------------------------------------------------------
 
-    def _run_tasks(self, fn, n: int, what: str, remote=None) -> List[Any]:
+    def _run_tasks(self, fn, n: int, what: str, remote=None,
+                   sid: Optional[int] = None) -> List[Any]:
         from blaze_tpu.bridge.tasks import default_task_parallelism, run_tasks
         # host placement caps slots harder than the executor-size knob:
         # serial tasks around intra-op-parallel C++ kernels beat
         # GIL-contended task concurrency (see default_task_parallelism)
         workers = min(self._par, default_task_parallelism(n))
+        if sid is not None:
+            from blaze_tpu.plan import statstore
+            if statstore.enabled():
+                qid = getattr(self._query, "query_id", None)
+                if qid is not None:
+                    from blaze_tpu.serving import progress
+                    progress.note_stage_start(qid, sid, n)
+                    inner = fn
+
+                    def fn(i, _inner=inner, _qid=qid, _sid=sid):
+                        out = _inner(i)
+                        progress.note_task_done(_qid, _sid)
+                        return out
         return run_tasks(fn, n, self._timeout, what, max_workers=workers,
                          query=self._query, remote=remote)
 
@@ -917,7 +951,7 @@ class DagScheduler:
                           tasks=stage.num_tasks, partitions=n_out):
             per_task = self._run_tasks(
                 one_map, stage.num_tasks,
-                f"stage {stage.sid} (device shuffle)")
+                f"stage {stage.sid} (device shuffle)", sid=stage.sid)
             batches = [b for kind, out in per_task if kind == "batches"
                        for b in out if b.num_rows]
             col_tasks = [out for kind, out in per_task
@@ -946,6 +980,10 @@ class DagScheduler:
                         else "mixed" if loop_tasks else "staged"),
             "exchange": "device"}
         self._note_history_stage(stage.sid)
+        from blaze_tpu.plan import statstore
+        if statstore.enabled():
+            self._note_boundary(stage, [len(blocks.get(r, b""))
+                                        for r in range(n_out)], "device")
 
         sid = stage.sid
         self._stage_outputs[sid] = {}
@@ -1017,7 +1055,8 @@ class DagScheduler:
         with tracing.span("rss_exchange", stage=stage.sid,
                           tasks=stage.num_tasks, partitions=n_out):
             self._run_tasks(run_map, stage.num_tasks,
-                            f"stage {stage.sid} (rss push)")
+                            f"stage {stage.sid} (rss push)",
+                            sid=stage.sid)
         self._note_placement(stage.sid, "rss", loop_before)
 
         self._stage_outputs[stage.sid] = {}
@@ -1053,7 +1092,8 @@ class DagScheduler:
                 results = self._run_tasks(
                     lambda m: self._run_map_task(stage, part, m),
                     stage.num_tasks, f"stage {stage.sid} (shuffle write)",
-                    remote=self._map_remote(stage, part))
+                    remote=self._map_remote(stage, part),
+                    sid=stage.sid)
             finally:
                 # attempt-suffixed outputs, claim files and a late
                 # loser's leftovers all join the cleanup list even when
@@ -1069,6 +1109,12 @@ class DagScheduler:
         xla_stats.note_host_exchange(sum(
             int(off[-1])
             for _, off in self._stage_outputs[stage.sid].values()))
+        from blaze_tpu.plan import statstore
+        if statstore.enabled():
+            self._note_boundary(stage, [
+                sum(int(off[r + 1] - off[r])
+                    for _, off in self._stage_outputs[stage.sid].values())
+                for r in range(n_out)], "file")
 
         sid = stage.sid
 
@@ -1230,14 +1276,129 @@ class DagScheduler:
             return pa.Table.from_batches([out])
         return out
 
+    def _note_boundary(self, stage: Stage, part_bytes: List[int],
+                       exchange: str) -> None:
+        """Capture one shuffle boundary's per-partition bytes for the
+        statistics store, keyed by the producer's subtree fingerprint.
+        Must run at producer completion — cleanup() clears the
+        map-output table before run_collect returns.  (The rss tier
+        holds no local sizes; its boundaries are not captured.)"""
+        try:
+            from blaze_tpu.plan import fingerprint as fp_mod
+            part = (self._part_of(stage) if stage.partitioning is not None
+                    else None)
+            fp = fp_mod.subplan_fingerprint(stage.plan, part,
+                                            stage.num_tasks)
+            with self._metrics_lock:
+                node = self.stage_metrics.get(stage.sid)
+                rows = (int(node.values.get("output_rows", 0) or 0)
+                        if node is not None else 0)
+            self.stage_boundaries[stage.sid] = {
+                "fingerprint": fp, "sid": stage.sid,
+                "tasks": stage.num_tasks,
+                "partitions": len(part_bytes),
+                "partition_bytes": [int(b) for b in part_bytes],
+                "exchange": exchange, "output_rows": rows}
+        except Exception:
+            pass
+
+    def _stats_begin(self, plan: Dict[str, Any]) -> None:
+        """Arm the statistics feedback plane for this run: fingerprint
+        the plan, baseline the counter plane + duration reservoirs, and
+        register live progress.  No-op (one boolean) when
+        auron.tpu.stats.enable is off."""
+        from blaze_tpu.plan import statstore
+        self.stats_fingerprint = None
+        self.stage_boundaries = {}
+        self._stats_base = None
+        if not statstore.enabled():
+            return
+        try:
+            import time
+            from blaze_tpu.bridge import xla_stats
+            from blaze_tpu.plan import fingerprint as fp_mod
+            self.stats_fingerprint = fp_mod.plan_fingerprint(plan)
+            self._stats_base = xla_stats.snapshot()
+            self._stats_dur0 = {k: len(v) for k, v in
+                                xla_stats.duration_samples().items()}
+            self._stats_t0 = time.perf_counter()
+            qid = getattr(self._query, "query_id", None)
+            if qid is not None:
+                prior = statstore.prior(self.stats_fingerprint)
+                prior_wall = None
+                if prior is not None:
+                    prior_wall = (prior.get("derived") or {}).get(
+                        "wall_p50_s")
+                    if prior_wall:
+                        xla_stats.note_stats(eta_seeded=1)
+                from blaze_tpu.serving import progress
+                progress.note_query_start(qid, self.stats_fingerprint,
+                                          prior_wall)
+        except Exception:
+            self.stats_fingerprint = None
+            self._stats_base = None
+
+    def _stats_end(self, ok: bool) -> None:
+        """Close the feedback loop: settle live progress and, on
+        success, ingest this run's observation into the statstore
+        (failed runs would poison the priors).  Never raises."""
+        base, self._stats_base = self._stats_base, None
+        if base is None:
+            return
+        try:
+            import time
+            from blaze_tpu.bridge import xla_stats
+            from blaze_tpu.plan import statstore
+            wall_s = time.perf_counter() - self._stats_t0
+            qid = getattr(self._query, "query_id", None)
+            if qid is not None:
+                from blaze_tpu.serving import progress
+                progress.note_query_done(
+                    qid, "finished" if ok else "failed", wall_s=wall_s)
+            if not ok:
+                return
+            delta = xla_stats.delta(base)
+            samples = xla_stats.duration_samples()
+            task_ns = samples.get("task_ns", [])[
+                self._stats_dur0.get("task_ns", 0):]
+            # host-lane eviction evidence, as counter deltas (per-query
+            # slice of the process plane; approximate under concurrency,
+            # same caveat as the history attribution)
+            reasons = {}
+            for key, reason in (("stage_loop_fallbacks", "stage_loop"),
+                                ("scatter_lane_declines", "scatter_lane"),
+                                ("expr_eager_batches", "expr_eager")):
+                n = int(delta.get(key, 0))
+                if n > 0:
+                    reasons[reason] = n
+            statstore.ingest({
+                "fingerprint": self.stats_fingerprint,
+                "wall_s": wall_s,
+                "task_ns": task_ns,
+                "counters": {k: int(delta.get(k, 0))
+                             for k in statstore.INGEST_COUNTERS},
+                "fallback_reasons": reasons,
+                "stages": sorted(self.stage_boundaries.values(),
+                                 key=lambda b: b["sid"]),
+            })
+        except Exception:
+            pass
+
     def run_collect(self, plan: Dict[str, Any]) -> pa.Table:
         """Execute the whole DAG; returns the result stage's output."""
         from blaze_tpu.bridge import tracing
+        self._stats_begin(plan)
+        ok = False
         # every span the scheduler (and anything below it) emits carries
         # the owning query id, so one query stitches into one trace
         with tracing.execution_context(
                 query=getattr(self._query, "query_id", None)):
-            return self._run_collect(plan)
+            try:
+                out = self._run_collect(plan)
+                ok = True
+                return out
+            finally:
+                self._stats_end(ok)
 
     def _run_collect(self, plan: Dict[str, Any]) -> pa.Table:
         from blaze_tpu.bridge.runtime import NativeExecutionRuntime
@@ -1312,7 +1473,7 @@ class DagScheduler:
                         "stage_loop_tasks"]
                     parts = self._run_tasks(
                         run_result, result.num_tasks,
-                        f"stage {result.sid} (result)")
+                        f"stage {result.sid} (result)", sid=result.sid)
                     self._note_placement(result.sid, "result",
                                          loop_before)
                     break

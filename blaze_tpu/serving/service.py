@@ -76,6 +76,10 @@ class QueryHandle:
         #: final merged metric tree (dict), populated when the history
         #: plane is on — the event log's terminal payload
         self.metrics_tree: Optional[dict] = None
+        #: plan fingerprint of the run, populated when the stats plane
+        #: (auron.tpu.stats.enable) is on — keys the statstore record
+        #: and the advisor findings in the history finished event
+        self.stats_fingerprint: Optional[str] = None
         #: work-sharing identity: (fingerprint, snapshot) when the plan
         #: is cacheable, and the single-flight key this handle leads
         self._cache_key = None
@@ -133,6 +137,7 @@ def _default_executor(plan: Dict[str, Any], ctx: QueryContext,
         sched.cleanup()
         if handle is not None:
             handle.leak_report = sched.leak_report()
+            handle.stats_fingerprint = sched.stats_fingerprint
             if history.enabled():
                 tree = sched.collect_metrics()
                 handle.metrics_tree = (tree.to_dict()
@@ -431,7 +436,8 @@ class QueryService:
             handle.query_id, status=handle.status, tenant=handle.tenant,
             wall_s=handle.wall_s,
             error=f"{type(err).__name__}: {err}" if err else None,
-            metric_tree=handle.metrics_tree)
+            metric_tree=handle.metrics_tree,
+            fingerprint=handle.stats_fingerprint)
 
     def _maybe_flight_dump(self, handle: QueryHandle) -> None:
         """Post-mortem: fatally-classified outcomes (deadline, memory

@@ -46,7 +46,7 @@ from blaze_tpu.tools.bench_schema import ENVELOPE_KEYS
 _LOWER_IS_BETTER = re.compile(
     r"(wall|latency|_ms\b|_ns\b|_s\b|seconds|p50|p95|p99|overhead|"
     r"spill|wait|gap|idle|retries|failures|crashes|fallbacks|declines|"
-    r"evictions|recoveries|lag|delay|queued|dropped|misses)",
+    r"evictions|recoveries|lag|delay|queued|dropped|misses|error)",
     re.IGNORECASE)
 _HIGHER_IS_BETTER = re.compile(
     r"(rows_per_sec|per_sec|qps|throughput|speedup|hit_rate|hits\b|"

@@ -1,0 +1,132 @@
+"""`python -m blaze_tpu.tools.top` — live query progress against a
+running engine's profiling HTTP service (the `top` of the serving
+plane).
+
+Polls /progress (per-query stage/task progress, row/byte rates, ETA —
+populated when `auron.tpu.stats.enable` is on) and /serving (admission
+queue + worker-pool health) and renders one table per tick.  Stdlib
+urllib only, so it runs from any box that can reach the port.
+
+    python -m blaze_tpu.tools.top --port 8042
+    python -m blaze_tpu.tools.top --port 8042 --once --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+
+def _get(host: str, port: int, path: str,
+         timeout: float = 5.0) -> Optional[Any]:
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}{path}", timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def _fmt_rate(v: float) -> str:
+    for unit in ("", "K", "M", "G"):
+        if abs(v) < 1000.0 or unit == "G":
+            return f"{v:.1f}{unit}"
+        v /= 1000.0
+    return f"{v:.1f}G"
+
+
+def _fmt_eta(p: Dict[str, Any]) -> str:
+    eta = p.get("eta_s")
+    if eta is None:
+        return "-"
+    src = (p.get("eta_source") or "?")[0]  # p(rior) / f(raction)
+    return f"{eta:.1f}s({src})"
+
+
+def _row(p: Dict[str, Any]) -> List[str]:
+    stages = p.get("stages") or {}
+    done_stages = sum(1 for st in stages.values()
+                      if st["tasks_total"] and
+                      st["tasks_done"] >= st["tasks_total"])
+    return [
+        str(p.get("query_id", "?"))[:24],
+        str(p.get("state", "?")),
+        f"{done_stages}/{len(stages)}",
+        f"{p.get('tasks_done', 0)}/{p.get('tasks_total', 0)}",
+        _fmt_rate(float(p.get("rows_per_s", 0.0))),
+        _fmt_rate(float(p.get("bytes_per_s", 0.0))),
+        f"{p.get('elapsed_s', 0.0):.1f}s",
+        _fmt_eta(p),
+    ]
+
+
+_HEADER = ["QUERY", "STATE", "STAGES", "TASKS", "ROWS/S", "BYTES/S",
+           "ELAPSED", "ETA"]
+
+
+def render(progress: Dict[str, Any],
+           serving: Optional[Dict[str, Any]]) -> str:
+    rows = [_HEADER]
+    for p in progress.get("running") or []:
+        rows.append(_row(p))
+    for p in progress.get("recent") or []:
+        rows.append(_row(p))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(_HEADER))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    if serving:
+        services = serving.get("services") or []
+        running = sum(int(s.get("running", 0)) for s in services)
+        queued = sum(int(s.get("queue_depth", 0)) for s in services)
+        completed = sum(int(t.get("completed", 0)) for s in services
+                        for t in (s.get("tenants") or {}).values())
+        lines.append("")
+        lines.append(f"serving: running={running} queued={queued} "
+                     f"completed={completed} services={len(services)}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m blaze_tpu.tools.top",
+        description="live query progress from the profiling service")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True,
+                    help="profiling HTTP service port")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between polls")
+    ap.add_argument("--once", action="store_true",
+                    help="poll once and exit (scripts/tests)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw /progress JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    while True:
+        progress = _get(args.host, args.port, "/progress")
+        if progress is None:
+            print(f"top: no response from "
+                  f"http://{args.host}:{args.port}/progress",
+                  file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps(progress, sort_keys=True))
+        else:
+            serving = _get(args.host, args.port, "/serving")
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear screen
+            print(render(progress, serving))
+        if args.once:
+            return 0
+        try:
+            time.sleep(max(0.1, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

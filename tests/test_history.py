@@ -313,6 +313,80 @@ def test_xla_compile_instant_counts_ns_attr():
     assert led["stages"]["0"]["device_busy_s"] == pytest.approx(0.400)
 
 
+def test_finished_event_embeds_bottleneck_and_advisor(hist_dir, tmp_path):
+    from blaze_tpu.plan import statstore
+    config.conf.set(config.TRACE_ENABLE.key, "on")
+    tracing.reset_conf_probe()
+    config.conf.set(config.STATS_ENABLE.key, "on")
+    config.conf.set(config.STATS_DIR.key, str(tmp_path / "stats"))
+    statstore.reset_conf_probe()
+    try:
+        with tracing.execution_context(query="qbn"):
+            with tracing.span("task", stage=0):
+                time.sleep(0.002)
+        statstore.ingest({"fingerprint": "fp-bn", "wall_s": 0.01,
+                          "task_ns": [], "counters": {},
+                          "fallback_reasons": {"stage_loop": 2},
+                          "stages": []})
+        history.note_admitted("qbn", tenant="t")
+        history.note_finished("qbn", status="done", tenant="t",
+                              wall_s=0.01, fingerprint="fp-bn")
+        s = history.HistoryStore(hist_dir).summary("qbn")
+        assert s["fingerprint"] == "fp-bn"
+        bn = s["bottleneck"]
+        assert bn is not None and bn["v"] == 1
+        assert sum(bn["categories"].values()) == pytest.approx(
+            bn["wall_s"], rel=0.01)
+        assert any(f["kind"] == "host_eviction" for f in s["advisor"])
+    finally:
+        for opt in (config.STATS_ENABLE, config.STATS_DIR):
+            config.conf.unset(opt.key)
+        statstore.reset_conf_probe()
+
+
+def test_device_ledger_zero_exchange_stage_has_no_barrier():
+    # single-stage plans never emit exchange-tier spans: the barrier
+    # must report 0, never negative, never raise
+    spans = [_span("stage_loop_chunk", 0, 100, stage=0),
+             _span("task", 0, 150, stage=0)]
+    led = history.device_ledger(spans)
+    s0 = led["stages"]["0"]
+    assert s0["barrier_idle_s"] == 0.0
+    assert s0["device_busy_s"] == pytest.approx(0.100)
+    assert led["barrier_idle_s"] == 0.0
+
+
+def test_device_ledger_streaming_epoch_only_trace():
+    # a streaming query's trace is stream_epoch spans with no device
+    # dispatch and no exchange at all
+    spans = [_span("stream_epoch", i * 100, 80, stage=0, epoch=i)
+             for i in range(3)]
+    led = history.device_ledger(spans)
+    s0 = led["stages"]["0"]
+    assert s0["device_spans"] == 0
+    assert s0["barrier_idle_s"] == 0.0
+    assert s0["dispatch_gap_s"] == 0.0
+    assert s0["wall_s"] == pytest.approx(0.280)
+    assert led["device_utilization"] == 0.0
+
+
+def test_device_ledger_empty_and_malformed_traces():
+    assert history.device_ledger([])["stages"] == {}
+    led = history.device_ledger([
+        None, "span", 7,
+        {"name": "task", "t0_ns": "NaNish", "ctx": {"stage": 0}},
+        {"name": "device_exchange", "t0_ns": 0, "t1_ns": None,
+         "dur_ns": None, "ctx": "not-a-dict", "attrs": ["nope"]},
+        _span("device_exchange", 0, 50, stage=1),
+    ])
+    # the one well-formed span survives; nothing negative anywhere
+    assert led["stages"]["1"]["device_busy_s"] == pytest.approx(0.050)
+    for row in led["stages"].values():
+        for k in ("wall_s", "device_busy_s", "dispatch_gap_s",
+                  "barrier_idle_s"):
+            assert row[k] >= 0.0
+
+
 # -- end-to-end: QueryService + HTTP surface ---------------------------------
 
 def _get(port, path):

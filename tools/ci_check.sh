@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# CI gate: tier-1 tests, then the perf-regression sentinel against the
+# committed BENCH_*.json baselines.  A perf regression fails the build
+# instead of only being reportable.
+#
+# Usage:
+#   tools/ci_check.sh                    # tier-1 + sentinel over --sentinel
+#   CI_BENCH_LEGS="--sentinel --obs" tools/ci_check.sh
+#   CI_SKIP_TESTS=1 tools/ci_check.sh   # sentinel only (tests ran already)
+#
+# Each leg in CI_BENCH_LEGS is re-run into a scratch dir (via the
+# BLAZE_BENCH_<LEG>_PATH override every leg honors) and compared
+# per-artifact against the committed baseline of the same name — the
+# whole committed directory is NOT used as one baseline, because a
+# candidate that regenerates only some legs would fail --ci's
+# missing-metric check for the rest.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export BLAZE_BENCH_PLATFORM="${BLAZE_BENCH_PLATFORM:-cpu}"
+
+if [ "${CI_SKIP_TESTS:-0}" != "1" ]; then
+    echo "== ci_check: tier-1 tests =="
+    python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider
+fi
+
+LEGS="${CI_BENCH_LEGS:---sentinel}"
+WORK="$(mktemp -d /tmp/blaze-ci-check.XXXXXX)"
+trap 'rm -rf "$WORK"' EXIT
+
+fail=0
+for leg in $LEGS; do
+    name="$(echo "${leg#--}" | tr '[:lower:]' '[:upper:]')"
+    art="BENCH_${name}.json"
+    if [ ! -f "$art" ]; then
+        echo "ci_check: no committed baseline $art for $leg" >&2
+        fail=1
+        continue
+    fi
+    echo "== ci_check: bench $leg (candidate -> $WORK/$art) =="
+    env "BLAZE_BENCH_${name}_PATH=$WORK/$art" python bench.py "$leg"
+    echo "== ci_check: sentinel --ci ($art) =="
+    if ! python -m blaze_tpu.tools.sentinel --ci \
+            --baseline "$art" --candidate "$WORK/$art"; then
+        fail=1
+    fi
+done
+
+if [ "$fail" != "0" ]; then
+    echo "ci_check: FAILED" >&2
+    exit 1
+fi
+echo "ci_check: OK"
