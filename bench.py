@@ -3878,6 +3878,259 @@ def obs_bench_main() -> int:
     return 0 if ok else 1
 
 
+def aqe_bench_main() -> int:
+    """Adaptive-query-execution gate (`--aqe`): run synthetic join/agg
+    workloads static-vs-adaptive and assert the three runtime rules pay
+    for themselves with bit-identical results.
+
+    Legs (each compares the adaptive result against the static run via
+    compare_frames; any divergence fails the gate):
+
+    * ``broadcast``  small-dim shuffle join: the runtime switch must
+      elide the probe exchange (walls recorded, not gated);
+    * ``skew``       skewed fact join at high static partition count:
+      the composed skew-split + coalesce rewrite must beat the static
+      wall by >2x (per-task dispatch tax is the win);
+    * ``coalesce``   tiny-partition agg: the standalone coalesce rule;
+    * ``history``    statstore-warmed planning: the second (cache-miss)
+      run plans straight to the adaptive shape at BIND time and must
+      beat the first run's wall.
+
+    ``--fast`` is the CI smoke: 1 rep, skew leg only, same >2x and
+    zero-divergence gates.  Writes BENCH_AQE.json (env override
+    BLAZE_BENCH_AQE_PATH) and prints it as one JSON line."""
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+    import copy
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu import config
+    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.plan import adaptive, statstore
+    from blaze_tpu.plan.stages import DagScheduler
+
+    fast = "--fast" in sys.argv
+    iters = int(os.environ.get("BLAZE_BENCH_AQE_ITERS",
+                               "1" if fast else "3"))
+
+    MemManager.init(4 << 30)
+    config.conf.set(config.DAG_SINGLE_TASK_BYTES.key, 0)
+
+    schema2 = lambda a, b: {"fields": [  # noqa: E731
+        {"name": a, "type": {"id": "int64"}, "nullable": True},
+        {"name": b, "type": {"id": "float64"}, "nullable": True}]}
+
+    def write_splits(d, name, t, nsplit):
+        paths = []
+        step = -(-t.num_rows // nsplit)
+        for i in range(nsplit):
+            p = os.path.join(d, f"{name}-{i}.parquet")
+            pq.write_table(t.slice(i * step, step), p)
+            paths.append([p])
+        return paths
+
+    def exchange(inp, nparts):
+        return {"kind": "local_exchange",
+                "partitioning": {
+                    "kind": "hash",
+                    "exprs": [{"kind": "column", "index": 0}],
+                    "num_partitions": nparts},
+                "input": inp}
+
+    def join_plan(d, tag, nparts, n, hot_frac, nfact):
+        rng = np.random.default_rng(17)
+        if hot_frac > 0:
+            keys = np.where(rng.random(n) < hot_frac, 0,
+                            rng.integers(1, 200, n)).astype(np.int64)
+        else:
+            keys = rng.integers(0, 200, n).astype(np.int64)
+        fact = pa.table({"k": pa.array(keys),
+                         "v": pa.array(rng.random(n))})
+        dim = pa.table({"k": pa.array(np.arange(200, dtype=np.int64)),
+                        "w": pa.array(rng.random(200))})
+        return {"kind": "hash_join", "join_type": "inner",
+                "left": exchange(
+                    {"kind": "parquet_scan", "schema": schema2("k", "w"),
+                     "file_groups": write_splits(d, f"dim-{tag}", dim,
+                                                 2)}, nparts),
+                "right": exchange(
+                    {"kind": "parquet_scan", "schema": schema2("k", "v"),
+                     "file_groups": write_splits(d, f"fact-{tag}", fact,
+                                                 nfact)}, nparts),
+                "left_keys": [{"kind": "column", "index": 0}],
+                "right_keys": [{"kind": "column", "index": 0}],
+                "build_side": "left"}
+
+    def agg_plan(d, nparts):
+        rng = np.random.default_rng(23)
+        n = 40_000
+        t = pa.table({"k": pa.array(rng.integers(0, 500, n),
+                                    type=pa.int64()),
+                      "v": pa.array(rng.random(n))})
+        return {"kind": "hash_agg",
+                "groupings": [{"expr": {"kind": "column", "index": 0},
+                               "name": "k"}],
+                "aggs": [{"fn": "sum", "mode": "final", "name": "s",
+                          "args": [{"kind": "column", "index": 1}]}],
+                "input": exchange({
+                    "kind": "hash_agg",
+                    "groupings": [{"expr": {"kind": "column",
+                                            "name": "k"}, "name": "k"}],
+                    "aggs": [{"fn": "sum", "mode": "partial",
+                              "name": "s",
+                              "args": [{"kind": "column",
+                                        "name": "v"}]}],
+                    "input": {"kind": "parquet_scan",
+                              "schema": schema2("k", "v"),
+                              "file_groups": write_splits(d, "agg", t,
+                                                          2)}}, nparts)}
+
+    def frame(tbl):
+        import pandas as pd
+        df = (tbl.to_pandas() if tbl.num_rows else pd.DataFrame(
+            {n: [] for n in tbl.schema.names}))
+        return df.set_axis(range(df.shape[1]), axis=1)
+
+    def run(plan, d, tag, conf, reps):
+        """min-wall over `reps` runs of `plan` under `conf`; returns
+        (wall, table, aqe counter delta)."""
+        for k, v in conf.items():
+            config.conf.set(k, v)
+        adaptive.reset_conf_probe()
+        before = xla_stats.aqe_stats()
+        walls, got = [], None
+        try:
+            for it in range(reps):
+                sched = DagScheduler(
+                    work_dir=os.path.join(d, f"{tag}{it}"))
+                t0 = time.perf_counter()
+                got = sched.run_collect(copy.deepcopy(plan))
+                walls.append(time.perf_counter() - t0)
+        finally:
+            for k in conf:
+                config.conf.unset(k)
+            adaptive.reset_conf_probe()
+        after = xla_stats.aqe_stats()
+        delta = {k: after[k] - before[k]
+                 for k in after if after[k] != before[k]}
+        return min(walls), got, delta
+
+    aqe_on = {config.AQE_ENABLE.key: True}
+    legs = {}
+    rules = {"broadcast": 0, "skew_split": 0, "coalesce": 0,
+             "history_seeds": 0}
+    diverged = 0
+
+    def leg(name, plan, d, conf, gate_rule=None):
+        nonlocal diverged
+        # warm XLA/compile caches outside both timed runs
+        run(plan, d, f"{name}-warm", {}, 1)
+        s_wall, s_got, _ = run(plan, d, f"{name}-s", {}, iters)
+        a_wall, a_got, delta = run(plan, d, f"{name}-a", conf, iters)
+        err = compare_frames(frame(a_got), frame(s_got))
+        if err is not None:
+            diverged += 1
+        rules["broadcast"] += delta.get("aqe_broadcast_switches", 0)
+        rules["skew_split"] += delta.get("aqe_skew_splits", 0)
+        rules["coalesce"] += delta.get("aqe_partitions_coalesced", 0)
+        legs[name] = {
+            "static_wall_s": round(s_wall, 4),
+            "aqe_wall_s": round(a_wall, 4),
+            "speedup": round(s_wall / max(a_wall, 1e-9), 3),
+            "counters": delta,
+            "divergence": err,
+        }
+        return legs[name]
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="aqe-") as d:
+            skew_conf = dict(aqe_on)
+            skew_conf[config.AQE_BROADCAST_THRESHOLD.key] = 0
+            skew_conf[config.AQE_SKEW_FACTOR.key] = 2.0
+            skew = leg("skew",
+                       join_plan(d, "skew", nparts=160, n=50_000,
+                                 hot_frac=0.75, nfact=8),
+                       d, skew_conf)
+
+            if not fast:
+                leg("broadcast",
+                    join_plan(d, "bc", nparts=32, n=40_000,
+                              hot_frac=0.0, nfact=4),
+                    d, aqe_on)
+                leg("coalesce", agg_plan(d, nparts=32), d, aqe_on)
+
+                # history leg: cold run observes and records, warm run
+                # plans straight to the adaptive shape from the prior.
+                # coalesceTarget=1 disables the runtime coalesce rule
+                # and partition seeding, isolating the seeded broadcast.
+                hplan = join_plan(d, "hist", nparts=48, n=40_000,
+                                  hot_frac=0.0, nfact=4)
+                run(hplan, d, "hist-warmup", {}, 1)
+                hconf = dict(aqe_on)
+                hconf[config.AQE_HISTORY_SEED.key] = True
+                hconf[config.AQE_COALESCE_TARGET.key] = 1
+                hconf[config.STATS_ENABLE.key] = True
+                hconf[config.STATS_DIR.key] = os.path.join(d, "stats")
+                statstore.reset_conf_probe()
+                try:
+                    cold_wall, cold_got, cold_delta = run(
+                        hplan, d, "hist-cold", hconf, 1)
+                    warm_wall, warm_got, warm_delta = run(
+                        hplan, d, "hist-warm", hconf, iters)
+                finally:
+                    statstore.reset_conf_probe()
+                err = compare_frames(frame(warm_got), frame(cold_got))
+                if err is not None:
+                    diverged += 1
+                rules["history_seeds"] += warm_delta.get(
+                    "aqe_history_seeds", 0)
+                legs["history"] = {
+                    "cold_wall_s": round(cold_wall, 4),
+                    "warm_wall_s": round(warm_wall, 4),
+                    "speedup": round(cold_wall / max(warm_wall, 1e-9),
+                                     3),
+                    "cold_counters": cold_delta,
+                    "warm_counters": warm_delta,
+                    "divergence": err,
+                }
+    finally:
+        config.conf.unset(config.DAG_SINGLE_TASK_BYTES.key)
+
+    rec = {
+        "metric": "aqe_skew_join_speedup",
+        "value": skew["speedup"],
+        "unit": "x",
+        "iters": iters,
+        "fast": fast,
+        "divergent_queries": diverged,
+        "rules_fired": rules,
+        "legs": legs,
+    }
+    path = os.environ.get(
+        "BLAZE_BENCH_AQE_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_AQE.json"))
+    _write_bench(path, rec)
+    print(json.dumps(rec, default=str))
+    sys.stdout.flush()
+    ok = (diverged == 0 and skew["speedup"] > 2.0
+          and rules["skew_split"] > 0 and rules["coalesce"] > 0)
+    if not fast:
+        ok = (ok and rules["broadcast"] > 0
+              and rules["history_seeds"] > 0
+              and legs["history"]["warm_wall_s"]
+              < legs["history"]["cold_wall_s"])
+    return 0 if ok else 1
+
+
 def sentinel_bench_main() -> int:
     """--sentinel: self-check of the regression sentinel CI contract.
 
@@ -3967,6 +4220,8 @@ def main():
         sys.exit(stream_bench_main())
     if "--obs" in sys.argv:
         sys.exit(obs_bench_main())
+    if "--aqe" in sys.argv:
+        sys.exit(aqe_bench_main())
     if "--sentinel" in sys.argv:
         sys.exit(sentinel_bench_main())
     if "--multichip-child" in sys.argv:

@@ -122,6 +122,12 @@ SPAN_NAMES: Dict[str, str] = {
                        "attrs task/winner_attempt/loser_attempts)",
     "speculation_loser": "a losing attempt was cancelled or abandoned "
                          "after the sibling committed (bridge/tasks.py)",
+    "aqe_rewrite": "an adaptive-execution rule rewrote a not-yet-"
+                   "dispatched consumer stage at the boundary "
+                   "(plan/adaptive.py; attrs stage/rule)",
+    "aqe_history_seed": "bind-time planning applied statstore-derived "
+                        "seeds to the plan (plan/adaptive.py; attrs "
+                        "seeds)",
     "stream_recovery": "streaming epoch restored from the latest "
                        "checkpoint manifest after a retryable failure "
                        "(streaming/executor.py)",

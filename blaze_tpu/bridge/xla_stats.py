@@ -170,6 +170,15 @@ _stats = {"stats_ingests": 0, "stats_runs_merged": 0,
           "stats_advisor_findings": 0, "stats_eta_seeded": 0,
           "stats_fingerprints_last": 0}
 
+# Adaptive query execution (plan/adaptive.py): runtime rewrites applied
+# at stage boundaries, split by rule; plans seeded from statstore
+# history at bind time; producer stages elided outright (their exchange
+# never ran); and the estimated shuffle bytes those rewrites avoided.
+_aqe = {"aqe_rewrites": 0, "aqe_broadcast_switches": 0,
+        "aqe_partitions_coalesced": 0, "aqe_skew_splits": 0,
+        "aqe_history_seeds": 0, "aqe_bytes_saved": 0,
+        "aqe_stages_elided": 0}
+
 # Bounded raw-sample reservoirs feeding tail-latency percentiles
 # (bench.py --workers / --speculate): successful task-attempt durations
 # and run_tasks wave walls, in ns.  Lists, so NOT folded into
@@ -492,6 +501,26 @@ def statstore_stats() -> dict:
         return dict(_stats)
 
 
+def note_aqe(**deltas: int) -> None:
+    """AQE-plane mutator: kwargs name `_aqe` keys with or without the
+    `aqe_` prefix; gauges (`*_last`) are set absolutely, counters are
+    incremented (the note_stats contract)."""
+    with _lock:
+        for k, v in deltas.items():
+            key = k if k.startswith("aqe_") else f"aqe_{k}"
+            if key not in _aqe:
+                continue
+            if key.endswith("_last"):
+                _aqe[key] = int(v)
+            else:
+                _aqe[key] += int(v)
+
+
+def aqe_stats() -> dict:
+    with _lock:
+        return dict(_aqe)
+
+
 def _histogram(samples_ns: List[int]) -> Dict[str, Any]:
     """Cumulative-bucket Prometheus histogram over an ns reservoir:
     {"buckets": [(le_seconds, cumulative_count), ...], "sum": seconds,
@@ -779,6 +808,7 @@ def counter_families() -> Dict[str, Dict[str, int]]:
             "obs": dict(_obs),
             "cache": dict(_cache),
             "stats": dict(_stats),
+            "aqe": dict(_aqe),
         }
 
 
@@ -805,6 +835,7 @@ def snapshot() -> dict:
     flat.update(obs_stats())
     flat.update(cache_stats())
     flat.update(statstore_stats())
+    flat.update(aqe_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -846,6 +877,8 @@ def reset() -> None:
             _cache[k] = 0
         for k in _stats:
             _stats[k] = 0
+        for k in _aqe:
+            _aqe[k] = 0
         _task_duration_ns.clear()
         _wave_wall_ns.clear()
         _bucket_caps.clear()

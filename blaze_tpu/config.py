@@ -865,6 +865,46 @@ STATS_ADVISOR_SKEW_FACTOR = float_conf(
     "Advisor threshold: a partition whose bytes exceed this multiple "
     "of the boundary's median partition bytes is flagged as a "
     "skew-split candidate.", category="observability")
+AQE_ENABLE = bool_conf(
+    "auron.tpu.aqe.enable", False,
+    "Enable adaptive query execution (plan/adaptive.py): the "
+    "DagScheduler re-plans not-yet-dispatched consumer stages from the "
+    "exact map-output bytes of committed producers — broadcast-join "
+    "switch, reduce-partition coalescing, and skew-split.  Probed once "
+    "lazily; disabled AQE stays a near-free boolean check at the stage "
+    "boundary and the executed plan is byte-identical to the static "
+    "plan.", category="observability")
+AQE_BROADCAST_THRESHOLD = int_conf(
+    "auron.tpu.aqe.broadcastThreshold", -1,
+    "Observed build-side map-output bytes under this rewrite a "
+    "shuffle-hash join to a broadcast build at runtime; -1 inherits "
+    "auron.tpu.stats.advisor.broadcastBytes so the advisor and the AQE "
+    "pass can never disagree.", category="observability")
+AQE_COALESCE_TARGET = int_conf(
+    "auron.tpu.aqe.coalesceTargetBytes", 16 << 20,
+    "Target bytes per reduce partition after coalescing: adjacent "
+    "partitions are merged greedily until the next would push a group "
+    "past this.  Also the history-seeded partition-count target at "
+    "plan bind time.", category="observability")
+AQE_SKEW_FACTOR = float_conf(
+    "auron.tpu.aqe.skewFactor", -1.0,
+    "A reduce partition whose bytes exceed this multiple of the "
+    "boundary median is split across replicated-build sub-tasks; "
+    "<= 0 inherits auron.tpu.stats.advisor.skewFactor.",
+    category="observability")
+AQE_SKEW_MAX_SPLITS = int_conf(
+    "auron.tpu.aqe.skewMaxSplits", 8,
+    "Upper bound on the sub-tasks a single skewed partition is split "
+    "into (each replicates the build side once).",
+    category="observability")
+AQE_HISTORY_SEED = bool_conf(
+    "auron.tpu.aqe.historySeed", False,
+    "Seed the plan at bind time from the statistics store's "
+    "per-fingerprint quantiles (requires auron.tpu.stats.enable): "
+    "pre-broadcast historically-small build sides, shrink partition "
+    "counts toward coalesceTargetBytes, and pre-select the partial-agg "
+    "skip strategy when history shows high group cardinality.",
+    category="observability")
 UDAF_FALLBACK_ENABLE = bool_conf(
     "auron.udafFallback.enable", True,
     "Allow typed-imperative UDAFs to run through the host round-trip "

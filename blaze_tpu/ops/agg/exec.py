@@ -58,11 +58,18 @@ class AggExec(ExecutionPlan):
     def __init__(self, child: ExecutionPlan,
                  group_exprs: Sequence[Tuple[PhysicalExpr, str]],
                  aggs: Sequence[Tuple[AggFunction, AggMode, str]],
-                 exec_mode: AggExecMode = AggExecMode.HASH_AGG):
+                 exec_mode: AggExecMode = AggExecMode.HASH_AGG,
+                 skip_partial_hint: bool = False):
         super().__init__([child])
         self._group_exprs = list(group_exprs)
         self._aggs = list(aggs)
         self._exec_mode = exec_mode
+        # history-seeded hint (AQE seed_agg_skip via the IR's
+        # supports_partial_skipping flag): prior runs measured a probe
+        # ratio high enough that partial aggregation won't reduce —
+        # skip the probe window and go straight to pass-through.
+        # Safety still rests on _skip_eligible().
+        self.skip_partial_hint = bool(skip_partial_hint)
         in_schema = child.schema
         for fn, _, _ in self._aggs:
             fn.bind(in_schema)
@@ -243,6 +250,9 @@ class _AggState(MemConsumer):
         from blaze_tpu.bridge.context import active_query
         q = getattr(self, "query", None) or active_query()
         if q is not None and getattr(q, "force_agg_passthrough", False):
+            self._probe_done = True
+            return True
+        if getattr(self.op, "skip_partial_hint", False):
             self._probe_done = True
             return True
         if not config.PARTIAL_AGG_SKIPPING_ENABLE.get():

@@ -24,7 +24,8 @@ from typing import Any, Dict, List, Optional
 
 from blaze_tpu.plan import statstore
 
-__all__ = ["FINDING_KINDS", "findings"]
+__all__ = ["FINDING_KINDS", "findings", "recommendations",
+           "broadcast_threshold", "skew_factor"]
 
 FINDING_KINDS = ("broadcast_candidate", "skew_partition", "host_eviction",
                  "low_cache_hit_rate", "high_cardinality_agg",
@@ -52,17 +53,29 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.1f} GB"
 
 
-def _broadcast_bytes() -> int:
+def broadcast_threshold() -> int:
+    """The single broadcast-bytes threshold shared by advisor findings
+    and the AQE pass: `auron.tpu.aqe.broadcastThreshold` when set
+    (>= 0), else the advisor's `stats.advisor.broadcastBytes`."""
     try:
         from blaze_tpu import config
+        v = int(config.AQE_BROADCAST_THRESHOLD.get())
+        if v >= 0:
+            return v
         return int(config.STATS_ADVISOR_BROADCAST_BYTES.get())
     except Exception:
         return 8 << 20
 
 
-def _skew_factor() -> float:
+def skew_factor() -> float:
+    """The single skew ratio shared by advisor findings and the AQE
+    pass: `auron.tpu.aqe.skewFactor` when set (> 0), else the
+    advisor's `stats.advisor.skewFactor`."""
     try:
         from blaze_tpu import config
+        v = float(config.AQE_SKEW_FACTOR.get())
+        if v > 0:
+            return v
         return float(config.STATS_ADVISOR_SKEW_FACTOR.get())
     except Exception:
         return 4.0
@@ -77,19 +90,17 @@ def _median(values: List[float]) -> float:
     return vs[mid] if n % 2 else (vs[mid - 1] + vs[mid]) / 2.0
 
 
-def _stage_findings(sfp: str, st: Dict[str, Any]) -> List[Dict[str, Any]]:
+def _stage_recommendations(sfp: str, st: Dict[str, Any]
+                           ) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     sid = st.get("sid")
     total_p50 = statstore.sketch_quantile(st.get("total_bytes") or {}, 0.5)
     partitions = int(st.get("partitions") or 0)
-    thr = _broadcast_bytes()
+    thr = broadcast_threshold()
     if total_p50 is not None and 0 < total_p50 <= thr and partitions > 1:
         out.append({
-            "kind": "broadcast_candidate", "stage": sid,
-            "summary": (f"stage {sid} shuffle writes "
-                        f"{_fmt_bytes(total_p50)} (p50) across "
-                        f"{partitions} partitions: fits broadcast "
-                        f"threshold {_fmt_bytes(thr)}"),
+            "rule": "broadcast", "stage": sid, "fingerprint": sfp,
+            "threshold": thr,
             "evidence": {"fingerprint": sfp,
                          "total_bytes_p50": round(total_p50, 1),
                          "threshold_bytes": thr,
@@ -97,22 +108,64 @@ def _stage_findings(sfp: str, st: Dict[str, Any]) -> List[Dict[str, Any]]:
         })
     last = [float(b) for b in (st.get("last_partition_bytes") or [])]
     med = _median(last)
-    factor = _skew_factor()
+    factor = skew_factor()
     if last and med > 0:
         worst = max(range(len(last)), key=lambda i: (last[i], -i))
         ratio = last[worst] / med
         if ratio >= factor:
             out.append({
-                "kind": "skew_partition", "stage": sid,
-                "summary": (f"stage {sid} partition {worst} is "
-                            f"{ratio:.1f}x median "
-                            f"({_fmt_bytes(last[worst])} vs "
-                            f"{_fmt_bytes(med)}): skew-split candidate"),
+                "rule": "skew_split", "stage": sid, "fingerprint": sfp,
+                "threshold": factor,
                 "evidence": {"fingerprint": sfp, "partition": worst,
                              "partition_bytes": int(last[worst]),
                              "median_bytes": round(med, 1),
                              "ratio": round(ratio, 2),
                              "factor": factor},
+            })
+    return out
+
+
+def recommendations(record: Optional[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+    """Structured `(rule, threshold, evidence)` records the AQE pass
+    (plan/adaptive.py) consumes directly.  The broadcast/skew findings
+    below are rendered FROM these records, so the advisor's report and
+    the rewrites the engine actually applies share one threshold
+    source and can never disagree."""
+    out: List[Dict[str, Any]] = []
+    rec = record or {}
+    for sfp in sorted(rec.get("stages") or {}):
+        out.extend(_stage_recommendations(sfp, rec["stages"][sfp]))
+    out.sort(key=lambda r: (r["rule"],
+                            -1 if r["stage"] is None else int(r["stage"]),
+                            r["fingerprint"]))
+    return out
+
+
+def _stage_findings(sfp: str, st: Dict[str, Any]) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for r in _stage_recommendations(sfp, st):
+        ev = r["evidence"]
+        sid = r["stage"]
+        if r["rule"] == "broadcast":
+            out.append({
+                "kind": "broadcast_candidate", "stage": sid,
+                "summary": (f"stage {sid} shuffle writes "
+                            f"{_fmt_bytes(ev['total_bytes_p50'])} (p50) "
+                            f"across {ev['partitions']} partitions: fits "
+                            f"broadcast threshold "
+                            f"{_fmt_bytes(ev['threshold_bytes'])}"),
+                "evidence": dict(ev),
+            })
+        elif r["rule"] == "skew_split":
+            out.append({
+                "kind": "skew_partition", "stage": sid,
+                "summary": (f"stage {sid} partition {ev['partition']} is "
+                            f"{ev['ratio']:.1f}x median "
+                            f"({_fmt_bytes(ev['partition_bytes'])} vs "
+                            f"{_fmt_bytes(ev['median_bytes'])}): "
+                            f"skew-split candidate"),
+                "evidence": dict(ev),
             })
     return out
 

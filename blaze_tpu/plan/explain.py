@@ -95,6 +95,23 @@ def format_work_sharing_footer(x) -> Optional[str]:
         f"invalidations={x.get('result_cache_invalidations', 0)}")
 
 
+def format_aqe_footer(x) -> Optional[str]:
+    """The explain-analyze "aqe:" footer (runtime rewrites and
+    history-seeded planning), or None when adaptive execution never
+    fired — AQE is off by default and the profile must stay
+    byte-identical then."""
+    if not (x.get("aqe_rewrites") or x.get("aqe_history_seeds")):
+        return None
+    return (
+        f"aqe: rewrites={x.get('aqe_rewrites', 0)} "
+        f"broadcast={x.get('aqe_broadcast_switches', 0)} "
+        f"coalesced={x.get('aqe_partitions_coalesced', 0)} "
+        f"skew_splits={x.get('aqe_skew_splits', 0)} "
+        f"history_seeds={x.get('aqe_history_seeds', 0)} "
+        f"stages_elided={x.get('aqe_stages_elided', 0)} "
+        f"saved={_fmt_bytes(x.get('aqe_bytes_saved', 0))}")
+
+
 def format_bottleneck_footer(report) -> Optional[str]:
     """The explain-analyze "bottleneck:" footer from a
     bridge/critical_path.bottleneck_report dict, or None when no spans
@@ -268,6 +285,9 @@ class QueryProfile:
         ws_line = format_work_sharing_footer(x)
         if ws_line is not None:
             lines.append(ws_line)
+        aqe_line = format_aqe_footer(x)
+        if aqe_line is not None:
+            lines.append(aqe_line)
         if any(x.get(k) for k in ("shuffle_device_bytes",
                                   "shuffle_host_bytes",
                                   "shuffle_device_fallbacks")):

@@ -79,6 +79,18 @@ def subplan_fingerprint(stage_plan: Dict[str, Any],
                     "num_tasks": int(num_tasks)})
 
 
+def derived_fingerprint(base_fp: str, rule: str,
+                        params: Dict[str, Any]) -> str:
+    """Fingerprint of an AQE-rewritten subtree: a digest over the
+    ORIGINAL fingerprint plus the rewrite rule and its parameters.
+    Derivation (rather than re-hashing the mutated IR, which embeds
+    run-scoped resource ids) keeps the identity deterministic across
+    runs while guaranteeing it can never collide with the static
+    shape — so the subplan cache and statstore treat a rewritten stage
+    as a distinct shape, never a stale hit."""
+    return _digest({"base": base_fp, "rule": rule, "params": params})
+
+
 def source_snapshot(plan: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """Version stamp of every data source under `plan`, or None when the
     plan is uncacheable (see module docstring)."""

@@ -145,7 +145,9 @@ def create_plan(d: Dict[str, Any]) -> ExecutionPlan:
             aggs.append((fn, AggMode(a.get("mode", "partial")), a["name"]))
         mode = (AggExecMode.HASH_AGG if k == "hash_agg"
                 else AggExecMode.SORT_AGG)
-        return AggExec(child, groups, aggs, mode)
+        return AggExec(child, groups, aggs, mode,
+                       skip_partial_hint=bool(
+                           d.get("supports_partial_skipping")))
 
     if k == "broadcast_nested_loop_join":
         from blaze_tpu.ops.joins.bnlj import BroadcastNestedLoopJoinExec

@@ -139,6 +139,11 @@ class Stage:
     # planner verdict for the device-resident exchange (plan/planner.py
     # exchange_device_spec); None = this boundary stays on file shuffle
     device_spec: Optional[Dict[str, Any]] = None
+    # adaptive execution (plan/adaptive.py): set when a runtime rule
+    # rewrote this stage — carries the rule name and the DERIVED
+    # fingerprint that replaces the static subtree identity everywhere
+    # downstream (statstore, subplan cache)
+    aqe: Optional[Dict[str, Any]] = None
 
 
 class DagScheduler:
@@ -218,6 +223,9 @@ class DagScheduler:
         # and the counter/reservoir baselines the final ingest deltas
         self.stats_fingerprint: Optional[str] = None
         self.stage_boundaries: Dict[int, Dict[str, Any]] = {}
+        # adaptive execution (plan/adaptive.py): the run's rewrite/seed
+        # event log, copied onto the serving QueryHandle at finish
+        self.aqe_events: List[Dict[str, Any]] = []
         self._stats_base: Optional[dict] = None
         self._stats_dur0: Dict[str, int] = {}
         self._stats_t0: float = 0.0
@@ -684,6 +692,12 @@ class DagScheduler:
             return None
         if stage.partitioning is None or self._reader_rids(stage.plan):
             return None
+        if stage.aqe is not None:
+            # an AQE-rewritten stage carries run-scoped derived
+            # resources; its static fingerprint no longer describes its
+            # shape (belt and braces: rewritten stages always hold
+            # readers, which the check above already declines)
+            return None
         from blaze_tpu.plan import fingerprint as fp_mod
         snap = fp_mod.source_snapshot(stage.plan)
         if snap is None:
@@ -980,8 +994,8 @@ class DagScheduler:
                         else "mixed" if loop_tasks else "staged"),
             "exchange": "device"}
         self._note_history_stage(stage.sid)
-        from blaze_tpu.plan import statstore
-        if statstore.enabled():
+        from blaze_tpu.plan import adaptive, statstore
+        if statstore.enabled() or adaptive.enabled():
             self._note_boundary(stage, [len(blocks.get(r, b""))
                                         for r in range(n_out)], "device")
 
@@ -1109,8 +1123,8 @@ class DagScheduler:
         xla_stats.note_host_exchange(sum(
             int(off[-1])
             for _, off in self._stage_outputs[stage.sid].values()))
-        from blaze_tpu.plan import statstore
-        if statstore.enabled():
+        from blaze_tpu.plan import adaptive, statstore
+        if statstore.enabled() or adaptive.enabled():
             self._note_boundary(stage, [
                 sum(int(off[r + 1] - off[r])
                     for _, off in self._stage_outputs[stage.sid].values())
@@ -1287,8 +1301,13 @@ class DagScheduler:
             from blaze_tpu.plan import fingerprint as fp_mod
             part = (self._part_of(stage) if stage.partitioning is not None
                     else None)
-            fp = fp_mod.subplan_fingerprint(stage.plan, part,
-                                            stage.num_tasks)
+            # an AQE-rewritten stage records under its DERIVED
+            # fingerprint: its plan embeds run-scoped derived resource
+            # ids, and the static identity must never accrete stats
+            # from a rewritten shape
+            fp = (stage.aqe or {}).get("fingerprint") or \
+                fp_mod.subplan_fingerprint(stage.plan, part,
+                                           stage.num_tasks)
             with self._metrics_lock:
                 node = self.stage_metrics.get(stage.sid)
                 rows = (int(node.values.get("output_rows", 0) or 0)
@@ -1423,6 +1442,14 @@ class DagScheduler:
         # scheduler across micro-batch epochs and cleanup() removed it
         # at the end of the previous epoch
         os.makedirs(self._dir, exist_ok=True)
+        # history-driven planning (plan/adaptive.py): seed broadcast
+        # choices, partition counts and the agg strategy from statstore
+        # priors BEFORE the split — _stats_begin already fingerprinted
+        # the ORIGINAL plan, so priors stay keyed consistently across
+        # cold and warm runs.  Returns the plan unchanged when off.
+        from blaze_tpu.plan import adaptive
+        self.aqe_events = []
+        plan = adaptive.seed_plan(plan, self)
         stages = self.split(plan)
         stages_by_id = {st.sid: st for st in stages}
         max_recoveries = max(0, config.STAGE_MAX_RECOVERIES.get())
@@ -1462,12 +1489,19 @@ class DagScheduler:
             # still terminates)
             completed: set = set()
             recoveries = 0
+            # adaptive re-planning hook (plan/adaptive.py): fires
+            # between a producer's map-output commit and the next
+            # dispatch; None when auron.tpu.aqe.enable is off
+            aqe_rt = adaptive.runtime_for(self)
             while True:
                 try:
                     for st in stages[:-1]:
                         if st.sid not in completed:
                             self._run_producer(st)
                             completed.add(st.sid)
+                            if aqe_rt is not None:
+                                aqe_rt.on_producer_commit(
+                                    st, completed, stages_by_id)
                     from blaze_tpu.bridge import xla_stats
                     loop_before = xla_stats.stage_loop_stats()[
                         "stage_loop_tasks"]

@@ -57,6 +57,7 @@ INGEST_COUNTERS = (
     "stage_loop_programs_built", "stage_loop_program_cache_hits",
     "stage_loop_fallbacks", "scatter_lane_declines",
     "shuffle_device_bytes", "shuffle_host_bytes",
+    "aqe_rewrites", "aqe_bytes_saved", "aqe_history_seeds",
 )
 
 #: appended lines per fingerprint file before it is compacted down to

@@ -20,9 +20,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-__all__ = ["note_query_start", "note_stage_start", "note_task_done",
-           "note_rows", "note_query_done", "progress", "live",
-           "snapshot_all", "reset"]
+__all__ = ["note_query_start", "note_stage_start", "note_stage_replan",
+           "note_task_done", "note_rows", "note_query_done", "progress",
+           "live", "snapshot_all", "reset"]
 
 _lock = threading.Lock()
 _live: Dict[str, Dict[str, Any]] = {}
@@ -45,6 +45,7 @@ def note_query_start(query_id: str, fingerprint: Optional[str] = None,
             "prior_wall_s": prior_wall_s,
             "t0": time.monotonic(),
             "stages": {},
+            "replans": 0,
         }
 
 
@@ -57,6 +58,23 @@ def note_stage_start(query_id: str, sid: int, tasks: int) -> None:
             "tasks_total": 0, "tasks_done": 0, "rows": 0, "bytes": 0})
         # recovery re-runs re-enter a stage; total counts all attempts
         st["tasks_total"] += max(0, int(tasks))
+
+
+def note_stage_replan(query_id: str, sid: int, tasks: int) -> None:
+    """An AQE rewrite replaced stage `sid`'s plan mid-run (new task
+    count `tasks`).  Statstore priors describe the *static* plan's
+    wall, so the ETA must stop trusting them and re-estimate from the
+    observed completion fraction."""
+    with _lock:
+        q = _live.get(query_id)
+        if q is None:
+            return
+        q["replans"] = int(q.get("replans", 0)) + 1
+        st = q["stages"].get(int(sid))
+        if st is not None:
+            # the rewrite supersedes the stage's pre-planned tasks:
+            # re-baseline total on the not-yet-run portion
+            st["tasks_total"] = st["tasks_done"] + max(0, int(tasks))
 
 
 def note_task_done(query_id: str, sid: int) -> None:
@@ -91,11 +109,19 @@ def _render(q: Dict[str, Any], state: str,
     total = sum(st["tasks_total"] for st in q["stages"].values())
     rows = sum(st["rows"] for st in q["stages"].values())
     nbytes = sum(st["bytes"] for st in q["stages"].values())
+    replans = int(q.get("replans", 0))
     eta_s: Optional[float] = None
     eta_source: Optional[str] = None
     if state == "running":
         prior = q.get("prior_wall_s")
-        if prior is not None and prior > 0:
+        if replans > 0:
+            # an AQE rewrite changed the task/partition shape mid-run;
+            # the prior described the static plan, so re-estimate from
+            # the observed fraction instead
+            if total > 0 and 0 < done < total and elapsed > 0:
+                eta_s = elapsed * (total - done) / done
+                eta_source = "fraction-replanned"
+        elif prior is not None and prior > 0:
             eta_s = max(0.0, float(prior) - elapsed)
             eta_source = "prior"
         elif total > 0 and 0 < done < total and elapsed > 0:
@@ -115,6 +141,7 @@ def _render(q: Dict[str, Any], state: str,
         "bytes_per_s": round(nbytes / elapsed, 3) if elapsed > 0 else 0.0,
         "eta_s": round(eta_s, 6) if eta_s is not None else None,
         "eta_source": eta_source,
+        "replans": replans,
     }
     return out
 

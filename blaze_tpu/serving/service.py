@@ -80,6 +80,9 @@ class QueryHandle:
         #: (auron.tpu.stats.enable) is on — keys the statstore record
         #: and the advisor findings in the history finished event
         self.stats_fingerprint: Optional[str] = None
+        #: adaptive-execution audit trail: the run's AQE rewrite/seed
+        #: events (DagScheduler.aqe_events), [] when AQE never fired
+        self.aqe_events: Optional[List[dict]] = None
         #: work-sharing identity: (fingerprint, snapshot) when the plan
         #: is cacheable, and the single-flight key this handle leads
         self._cache_key = None
@@ -138,6 +141,7 @@ def _default_executor(plan: Dict[str, Any], ctx: QueryContext,
         if handle is not None:
             handle.leak_report = sched.leak_report()
             handle.stats_fingerprint = sched.stats_fingerprint
+            handle.aqe_events = list(getattr(sched, "aqe_events", []))
             if history.enabled():
                 tree = sched.collect_metrics()
                 handle.metrics_tree = (tree.to_dict()

@@ -51,7 +51,8 @@ _LOWER_IS_BETTER = re.compile(
 _HIGHER_IS_BETTER = re.compile(
     r"(rows_per_sec|per_sec|qps|throughput|speedup|hit_rate|hits\b|"
     r"fraction|utilization|rows\b|completed|coalesces|bytes_saved|"
-    r"share_ratio)", re.IGNORECASE)
+    r"share_ratio|aqe_(rewrites|broadcast_switches|partitions_coalesced|"
+    r"skew_splits|history_seeds|stages_elided))", re.IGNORECASE)
 
 
 def metric_direction(key: str) -> str:
@@ -185,6 +186,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"sentinel: schema_version mismatch "
                   f"(baseline={bv}, candidate={cv})", file=sys.stderr)
             return 2
+        # directory mode: every committed baseline artifact must have a
+        # candidate counterpart — a bench leg silently not running is a
+        # regression (this is what makes BENCH_AQE.json mandatory once
+        # it exists in the baseline)
+        if os.path.isdir(args.baseline) and os.path.isdir(args.candidate):
+            missing = sorted(set(baseline) - set(candidate))
+            if missing:
+                for stem in missing:
+                    print(f"sentinel: baseline artifact "
+                          f"BENCH_{stem}.json missing from candidate",
+                          file=sys.stderr)
+                return 2
 
     findings = compare(baseline, candidate, threshold=args.threshold,
                        abs_floor=args.abs_floor, metrics=args.metrics,

@@ -1,0 +1,518 @@
+"""Adaptive query execution (plan/adaptive.py): the three runtime
+rewrite rules at the stage boundary, history-seeded planning from
+statstore priors, and the contracts every rewrite must keep — derived
+fingerprints, bit-identical results vs the static plan, lineage
+recovery, cancellation, and a byte-identical disabled path."""
+
+import copy
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu import config, faults
+from blaze_tpu.bridge import xla_stats
+from blaze_tpu.memory import MemManager
+from blaze_tpu.plan import adaptive, advisor, statstore
+from blaze_tpu.plan import fingerprint as fp_mod
+from blaze_tpu.plan.stages import DagScheduler
+
+
+@pytest.fixture(autouse=True)
+def clean_slate():
+    faults.clear()
+    MemManager.init(4 << 30)
+    config.conf.set(config.DAG_SINGLE_TASK_BYTES.key, 0)
+    adaptive.reset_conf_probe()
+    statstore.reset_conf_probe()
+    try:
+        yield
+    finally:
+        faults.clear()
+        config.conf.unset(config.DAG_SINGLE_TASK_BYTES.key)
+        for opt in (config.AQE_ENABLE, config.AQE_BROADCAST_THRESHOLD,
+                    config.AQE_COALESCE_TARGET, config.AQE_SKEW_FACTOR,
+                    config.AQE_SKEW_MAX_SPLITS, config.AQE_HISTORY_SEED,
+                    config.STATS_ENABLE, config.STATS_DIR):
+            config.conf.unset(opt.key)
+        adaptive.reset_conf_probe()
+        statstore.reset_conf_probe()
+
+
+@pytest.fixture
+def fast_retries():
+    config.conf.set(config.TASK_RETRY_BACKOFF_MS.key, 1)
+    try:
+        yield
+    finally:
+        config.conf.unset(config.TASK_RETRY_BACKOFF_MS.key)
+
+
+def _aqe_on(**extra):
+    config.conf.set(config.AQE_ENABLE.key, True)
+    for k, v in extra.items():
+        config.conf.set(k, v)
+    adaptive.reset_conf_probe()
+
+
+_SCHEMA = lambda a, b: {"fields": [
+    {"name": a, "type": {"id": "int64"}, "nullable": True},
+    {"name": b, "type": {"id": "float64"}, "nullable": True}]}
+
+
+def _write_splits(tmp_path, name, t, nsplit):
+    paths = []
+    step = -(-t.num_rows // nsplit)
+    for i in range(nsplit):
+        p = str(tmp_path / f"{name}-{i}.parquet")
+        pq.write_table(t.slice(i * step, step), p)
+        paths.append([p])
+    return paths
+
+
+def _exchange(inp, nparts):
+    return {"kind": "local_exchange",
+            "partitioning": {"kind": "hash",
+                             "exprs": [{"kind": "column", "index": 0}],
+                             "num_partitions": nparts},
+            "input": inp}
+
+
+def _scan(schema, groups):
+    return {"kind": "parquet_scan", "schema": schema,
+            "file_groups": groups}
+
+
+def _join_plan(tmp_path, nparts=8, skewed=False, seed=3):
+    """dim (small, BUILD side, left) shuffle-joined with fact; with
+    `skewed`, ~70% of fact rows share one key."""
+    rng = np.random.default_rng(seed)
+    n = 40_000
+    if skewed:
+        keys = np.where(rng.random(n) < 0.7, 0,
+                        rng.integers(1, 200, n)).astype(np.int64)
+    else:
+        keys = rng.integers(0, 200, n).astype(np.int64)
+    fact = pa.table({"k": pa.array(keys), "v": pa.array(rng.random(n))})
+    dim = pa.table({"k": pa.array(np.arange(200, dtype=np.int64)),
+                    "w": pa.array(rng.random(200))})
+    return {"kind": "hash_join", "join_type": "inner",
+            "left": _exchange(_scan(_SCHEMA("k", "w"),
+                                    _write_splits(tmp_path, "dim", dim,
+                                                  2)), nparts),
+            "right": _exchange(_scan(_SCHEMA("k", "v"),
+                                     _write_splits(tmp_path, "fact",
+                                                   fact, 4)), nparts),
+            "left_keys": [{"kind": "column", "index": 0}],
+            "right_keys": [{"kind": "column", "index": 0}],
+            "build_side": "left"}
+
+
+def _agg_plan(tmp_path, nparts=16, seed=5):
+    rng = np.random.default_rng(seed)
+    n = 30_000
+    t = pa.table({"k": pa.array(rng.integers(0, 500, n), type=pa.int64()),
+                  "v": pa.array(rng.random(n))})
+    return {"kind": "hash_agg",
+            "groupings": [{"expr": {"kind": "column", "index": 0},
+                           "name": "k"}],
+            "aggs": [{"fn": "sum", "mode": "final", "name": "s",
+                      "args": [{"kind": "column", "index": 1}]}],
+            "input": _exchange({
+                "kind": "hash_agg",
+                "groupings": [{"expr": {"kind": "column", "name": "k"},
+                               "name": "k"}],
+                "aggs": [{"fn": "sum", "mode": "partial", "name": "s",
+                          "args": [{"kind": "column", "name": "v"}]}],
+                "input": _scan(_SCHEMA("k", "v"),
+                               _write_splits(tmp_path, "in", t, 2))},
+                nparts)}
+
+
+def _canon(t):
+    """Canonical frame: a rewrite may change task count and thus row
+    order, so equality is order-insensitive."""
+    df = t.to_pandas().set_axis(range(t.num_columns), axis=1)
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+def _run(plan, tmp_path, tag):
+    sched = DagScheduler(work_dir=str(tmp_path / f"dag-{tag}"))
+    return sched.run_collect(copy.deepcopy(plan)), sched
+
+
+def _aqe_delta(fn):
+    before = xla_stats.aqe_stats()
+    out = fn()
+    after = xla_stats.aqe_stats()
+    return out, {k: after[k] - before[k]
+                 for k in after if after[k] != before[k]}
+
+
+# -- defaults & disabled path ------------------------------------------------
+
+def test_aqe_knobs_default_off():
+    assert config.AQE_ENABLE.get() is False
+    assert config.AQE_HISTORY_SEED.get() is False
+    assert config.AQE_BROADCAST_THRESHOLD.get() == -1   # inherit advisor
+    assert config.AQE_SKEW_FACTOR.get() <= 0            # inherit advisor
+    assert not adaptive.enabled()
+    assert not adaptive.history_seed_enabled()
+    assert adaptive.runtime_for(object()) is None
+
+
+def test_disabled_path_untouched(tmp_path):
+    plan = _join_plan(tmp_path)
+    # seed_plan must return the SAME object (not a copy) when off
+    assert adaptive.seed_plan(plan) is plan
+    (got, sched), delta = _aqe_delta(lambda: _run(plan, tmp_path, "off"))
+    assert delta == {}
+    assert sched.aqe_events == []
+    assert all(st.aqe is None for st in sched.stages)
+    assert len(sched.stages) == 3  # static shape: 2 producers + result
+
+
+def test_aqe_footer_silent_at_zero():
+    from blaze_tpu.plan.explain import format_aqe_footer
+    assert format_aqe_footer({}) is None
+    assert format_aqe_footer({"aqe_rewrites": 0,
+                              "aqe_history_seeds": 0}) is None
+    line = format_aqe_footer({"aqe_rewrites": 2, "aqe_skew_splits": 1,
+                              "aqe_bytes_saved": 2048})
+    assert line.startswith("aqe: rewrites=2")
+    assert "skew_splits=1" in line and "saved=2.0KiB" in line
+
+
+# -- the three runtime rules -------------------------------------------------
+
+def test_broadcast_switch_bit_identical(tmp_path):
+    plan = _join_plan(tmp_path)
+    static, _ = _run(plan, tmp_path, "static")
+    _aqe_on()
+    (got, sched), delta = _aqe_delta(lambda: _run(plan, tmp_path, "aqe"))
+    assert delta.get("aqe_broadcast_switches") == 1
+    assert delta.get("aqe_stages_elided") == 1
+    assert delta.get("aqe_bytes_saved", 0) > 0
+    ev = [e for e in sched.aqe_events if e["rule"] == "broadcast"]
+    assert len(ev) == 1
+    elided = ev[0]["elided_stage"]
+    assert sched.stage_placement[elided] == {"compute": "elided",
+                                             "exchange": "elided"}
+    assert _canon(got).equals(_canon(static))
+    # no scheduler leaks even with the derived registrations
+    assert all(not v for v in sched.leak_report().values())
+
+
+def test_skew_split_bit_identical(tmp_path):
+    plan = _join_plan(tmp_path, skewed=True)
+    static, s0 = _run(plan, tmp_path, "static")
+    _aqe_on(**{config.AQE_BROADCAST_THRESHOLD.key: 0,   # force past rule 1
+               config.AQE_SKEW_FACTOR.key: 2.0})
+    (got, sched), delta = _aqe_delta(lambda: _run(plan, tmp_path, "aqe"))
+    assert delta.get("aqe_skew_splits") == 1
+    ev = [e for e in sched.aqe_events if e["rule"] == "skew_split"]
+    assert len(ev) == 1 and ev[0]["splits"] >= 2
+    # the composed rewrite both splits the hot partition and coalesces
+    # the tiny remainder (Spark's OptimizeSkewedJoin + coalesce pair)
+    assert delta.get("aqe_partitions_coalesced", 0) > 0
+    assert sched.stages[-1].num_tasks != s0.stages[-1].num_tasks
+    assert _canon(got).equals(_canon(static))
+
+
+def test_coalesce_bit_identical(tmp_path):
+    plan = _agg_plan(tmp_path, nparts=16)
+    static, _ = _run(plan, tmp_path, "static")
+    _aqe_on()
+    (got, sched), delta = _aqe_delta(lambda: _run(plan, tmp_path, "aqe"))
+    assert delta.get("aqe_partitions_coalesced") == 15
+    assert sched.stages[-1].num_tasks == 1  # tiny data: one task
+    assert sched.stages[-1].aqe["rule"] == "coalesce"
+    assert _canon(got).equals(_canon(static))
+
+
+# -- rewrite contracts -------------------------------------------------------
+
+def test_derived_fingerprints_deterministic_and_distinct():
+    base = fp_mod.plan_fingerprint({"kind": "debug"})
+    a = fp_mod.derived_fingerprint(base, "coalesce", {"groups": [[0, 1]]})
+    b = fp_mod.derived_fingerprint(base, "coalesce", {"groups": [[0, 1]]})
+    c = fp_mod.derived_fingerprint(base, "coalesce", {"groups": [[0], [1]]})
+    d = fp_mod.derived_fingerprint(base, "skew_split", {"groups": [[0, 1]]})
+    assert a == b
+    assert len({a, c, d, base}) == 4
+
+
+def test_rewritten_stage_skips_subplan_cache(tmp_path):
+    """A rewritten stage must never publish under the static shape's
+    identity — the subplan cache key declines when stage.aqe is set."""
+    plan = _agg_plan(tmp_path)
+    _aqe_on()
+    _, sched = _run(plan, tmp_path, "aqe")
+    st = sched.stages[-1]
+    assert st.aqe is not None
+    assert sched._subplan_cache_key(st) is None
+
+
+def test_rewrite_survives_lineage_recovery(tmp_path, fast_retries):
+    plan = _join_plan(tmp_path, skewed=True)
+    static, _ = _run(plan, tmp_path, "static")
+    _aqe_on(**{config.AQE_BROADCAST_THRESHOLD.key: 0,
+               config.AQE_SKEW_FACTOR.key: 2.0})
+    xla_stats.reset()
+    # corrupt the first frame flushed (stage 0 / map 0): the rewritten
+    # consumer's derived readers must surface it as a FetchFailedError
+    # naming the original producer map task, and recovery must re-run
+    # exactly that task
+    with faults.scoped(("shuffle-write", dict(at=(1,), action="corrupt"))):
+        got, sched = _run(plan, tmp_path, "aqe")
+    assert any(e["rule"] == "skew_split" for e in sched.aqe_events)
+    fs = xla_stats.fault_stats()
+    assert fs["stage_recoveries"] >= 1
+    assert fs["recovered_map_tasks"] >= 1
+    assert _canon(got).equals(_canon(static))
+    assert all(not v for v in sched.leak_report().values())
+
+
+def test_rewrite_cancellation_clean(tmp_path):
+    from blaze_tpu.serving import QueryCancelled, QueryContext
+    plan = _join_plan(tmp_path, skewed=True)
+    _aqe_on(**{config.AQE_BROADCAST_THRESHOLD.key: 0,
+               config.AQE_SKEW_FACTOR.key: 2.0})
+    ctx = QueryContext("q-aqe-cancel")
+    sched = DagScheduler(work_dir=str(tmp_path / "dag"),
+                         query_ctx=ctx)
+
+    done = threading.Event()
+
+    def cancel_after_rewrite():
+        # fire the cancel as soon as the skew rewrite lands, so the
+        # rewritten consumer's tasks are what get cancelled
+        while not done.wait(0.001):
+            if sched.aqe_events:
+                ctx.cancel("test cancel after rewrite")
+                return
+
+    t = threading.Thread(target=cancel_after_rewrite, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(QueryCancelled):
+            sched.run_collect(copy.deepcopy(plan))
+            ctx.check()  # raced past the read path: surface it here
+    finally:
+        done.set()
+        t.join(5)
+        sched.cleanup()
+    assert all(not v for v in sched.leak_report().values())
+
+
+# -- history-seeded planning -------------------------------------------------
+
+def _stats_on(tmp_path):
+    config.conf.set(config.STATS_ENABLE.key, True)
+    config.conf.set(config.STATS_DIR.key, str(tmp_path / "stats"))
+    statstore.reset_conf_probe()
+
+
+def test_history_seed_cold_vs_warm(tmp_path):
+    plan = _join_plan(tmp_path)
+    static, _ = _run(plan, tmp_path, "static")
+    _stats_on(tmp_path)
+    _aqe_on(**{config.AQE_HISTORY_SEED.key: True})
+    # cold: no prior -> no seeding; the runtime broadcast rule still
+    # fires from observed bytes, and the boundary lands in the store
+    cold, s1 = _run(plan, tmp_path, "cold")
+    assert not any(str(e.get("rule", "")).startswith("seed_")
+                   for e in s1.aqe_events)
+    assert len(s1.stages) == 3
+    # warm: the prior pre-broadcasts the historically-small build at
+    # BIND time -> both exchanges spliced out, single-stage plan
+    (warm, s2), delta = _aqe_delta(lambda: _run(plan, tmp_path, "warm"))
+    seeds = [e for e in s2.aqe_events if e["rule"] == "seed_broadcast"]
+    assert len(seeds) == 1 and seeds[0]["stage"] is None
+    assert delta.get("aqe_history_seeds") == 1
+    assert len(s2.stages) < len(s1.stages)
+    assert _canon(warm).equals(_canon(static))
+    assert _canon(cold).equals(_canon(static))
+
+
+def test_empty_and_corrupted_statstore_fall_back(tmp_path):
+    plan = _join_plan(tmp_path)
+    _stats_on(tmp_path)
+    _aqe_on(**{config.AQE_HISTORY_SEED.key: True})
+    # empty store: static plan, zero errors
+    got1, s1 = _run(plan, tmp_path, "empty")
+    assert len(s1.stages) == 3
+
+    # corrupt every store file in place: seeding must silently fall
+    # back to the static plan (prior() returns None on corruption)
+    sdir = str(tmp_path / "stats")
+    assert os.path.isdir(sdir) and os.listdir(sdir)
+    for name in os.listdir(sdir):
+        with open(os.path.join(sdir, name), "w") as f:
+            f.write("{not json")
+    got2, s2 = _run(plan, tmp_path, "corrupt")
+    assert not any(str(e.get("rule", "")).startswith("seed_")
+                   for e in s2.aqe_events)
+    assert len(s2.stages) == 3
+    assert _canon(got2).equals(_canon(got1))
+
+
+def test_seed_plan_exception_falls_back(tmp_path, monkeypatch):
+    _stats_on(tmp_path)
+    _aqe_on(**{config.AQE_HISTORY_SEED.key: True})
+    monkeypatch.setattr(statstore, "prior",
+                        lambda fp: (_ for _ in ()).throw(RuntimeError()))
+    plan = {"kind": "debug"}
+    assert adaptive.seed_plan(plan) is plan
+
+
+def test_seed_partitions_unified_across_join(tmp_path, monkeypatch):
+    """History says both join inputs are tiny: the seeded plan shrinks
+    BOTH exchanges to one unified count (co-partitioning preserved)."""
+    plan = _join_plan(tmp_path, nparts=8)
+    _stats_on(tmp_path)
+    _aqe_on(**{config.AQE_HISTORY_SEED.key: True,
+               config.AQE_BROADCAST_THRESHOLD.key: 0})  # no broadcast seed
+    sfps = [adaptive._exchange_sfp(plan[s]) for s in ("left", "right")]
+    assert all(sfps)
+    sk = statstore.sketch_add(statstore.sketch_new(), [1 << 20])  # 1MiB p50
+    prior = {"stages": {sfp: {"sid": i, "partitions": 8,
+                              "total_bytes": copy.deepcopy(sk)}
+                        for i, sfp in enumerate(sfps)}}
+    monkeypatch.setattr(statstore, "prior", lambda fp: prior)
+    seeded = adaptive.seed_plan(copy.deepcopy(plan))
+    ln = seeded["left"]["partitioning"]["num_partitions"]
+    rn = seeded["right"]["partitioning"]["num_partitions"]
+    assert ln == rn == 1  # 1MiB / 16MiB target -> 1 partition, unified
+
+
+def test_seed_agg_skip_threads_hint_to_exec(tmp_path, monkeypatch):
+    """A high historical probe ratio seeds supports_partial_skipping on
+    the partial hash_agg, and the planner threads it to AggExec."""
+    plan = _agg_plan(tmp_path)
+    _stats_on(tmp_path)
+    _aqe_on(**{config.AQE_HISTORY_SEED.key: True})
+    monkeypatch.setattr(statstore, "prior",
+                        lambda fp: {"derived": {"agg_probe_ratio": 0.97}})
+    seeded = adaptive.seed_plan(copy.deepcopy(plan))
+    partial = seeded["input"]["input"]
+    assert partial["kind"] == "hash_agg"
+    assert partial["supports_partial_skipping"] is True
+    # the final (top) agg must NOT carry the hint: modes are not partial
+    assert not plan["input"]["input"].get("supports_partial_skipping")
+    assert not seeded.get("supports_partial_skipping")
+    from blaze_tpu.plan import create_plan
+    ex = create_plan(partial)
+    assert ex.skip_partial_hint is True
+    assert create_plan(plan["input"]["input"]).skip_partial_hint is False
+
+
+# -- advisor & progress integration ------------------------------------------
+
+def test_advisor_recommendations_match_findings():
+    record = {"stages": {
+        "fp-small": {"sid": 1, "partitions": 8,
+                     "total_bytes": statstore.sketch_add(
+                         statstore.sketch_new(), [1024.0]),
+                     "last_partition_bytes": [10, 10, 10, 10]},
+        "fp-skew": {"sid": 2, "partitions": 4,
+                    "total_bytes": statstore.sketch_add(
+                        statstore.sketch_new(), [1 << 30]),
+                    "last_partition_bytes": [100, 100, 10_000, 100]},
+    }}
+    recs = advisor.recommendations(record)
+    assert [(r["rule"], r["stage"]) for r in recs] == \
+        [("broadcast", 1), ("skew_split", 2)]
+    for r in recs:
+        assert set(r) == {"rule", "stage", "fingerprint", "threshold",
+                          "evidence"}
+        assert r["evidence"]["fingerprint"] == r["fingerprint"]
+    # findings are rendered FROM the same records: same stages flagged
+    kinds = [(f["kind"], f["stage"]) for f in advisor.findings(record)]
+    assert ("broadcast_candidate", 1) in kinds
+    assert ("skew_partition", 2) in kinds
+    assert recs[0]["threshold"] == advisor.broadcast_threshold()
+    assert recs[1]["threshold"] == advisor.skew_factor()
+
+
+def test_progress_eta_reestimates_after_replan():
+    from blaze_tpu.serving import progress
+    progress.reset()
+    try:
+        progress.note_query_start("q-replan", fingerprint="fp",
+                                  prior_wall_s=100.0)
+        progress.note_stage_start("q-replan", 0, 8)
+        for _ in range(4):
+            progress.note_task_done("q-replan", 0)
+        snap = progress.progress("q-replan")
+        assert snap["eta_source"] == "prior"       # trusts history...
+        assert snap["replans"] == 0
+        progress.note_stage_replan("q-replan", 0, 2)
+        snap = progress.progress("q-replan")
+        # ...until a rewrite invalidates the static-plan prior
+        assert snap["replans"] == 1
+        assert snap["eta_source"] == "fraction-replanned"
+        assert snap["stages"]["0"]["tasks_total"] == 6  # 4 done + 2 new
+        assert snap["eta_s"] is not None
+    finally:
+        progress.reset()
+
+
+def test_aqe_counters_in_families_and_snapshot():
+    fams = xla_stats.counter_families()
+    assert "aqe" in fams
+    assert set(fams["aqe"]) == {
+        "aqe_rewrites", "aqe_broadcast_switches",
+        "aqe_partitions_coalesced", "aqe_skew_splits",
+        "aqe_history_seeds", "aqe_bytes_saved", "aqe_stages_elided"}
+    xla_stats.note_aqe(rewrites=2, bytes_saved=10)
+    try:
+        snap = xla_stats.snapshot()
+        assert snap["aqe_rewrites"] >= 2
+        assert snap["aqe_bytes_saved"] >= 10
+    finally:
+        xla_stats.reset()
+
+
+def test_aqe_spans_emitted_when_tracing_enabled(tmp_path):
+    """A rewrite emits an `aqe_rewrite` instant and a seeded bind an
+    `aqe_history_seed` instant (registered names; conformance-checked
+    by tests/test_span_names.py)."""
+    from blaze_tpu.bridge import tracing
+
+    def drain():
+        tracing.stop_tracing()
+        with tracing._lock:
+            tracing._spans.clear()
+
+    config.conf.set(config.TRACE_ENABLE.key, "on")
+    tracing.reset_conf_probe()
+    drain()
+    try:
+        _aqe_on()
+        _run(_join_plan(tmp_path), tmp_path, "span-bc")
+        names = [s["name"] for s in tracing.spans()]
+        rewrites = [s for s in tracing.spans()
+                    if s["name"] == "aqe_rewrite"]
+        assert rewrites and rewrites[0]["attrs"]["rule"] == "broadcast"
+
+        # warm a statstore prior, then a seeded bind
+        config.conf.set(config.STATS_ENABLE.key, True)
+        config.conf.set(config.STATS_DIR.key, str(tmp_path / "stats"))
+        config.conf.set(config.AQE_HISTORY_SEED.key, True)
+        statstore.reset_conf_probe()
+        adaptive.reset_conf_probe()
+        _run(_join_plan(tmp_path), tmp_path, "span-cold")
+        drain()
+        tracing.reset_conf_probe()
+        _run(_join_plan(tmp_path), tmp_path, "span-warm")
+        seeds = [s for s in tracing.spans()
+                 if s["name"] == "aqe_history_seed"]
+        assert seeds and seeds[0]["attrs"]["seeds"] >= 1
+    finally:
+        config.conf.unset(config.TRACE_ENABLE.key)
+        tracing.reset_conf_probe()
+        drain()

@@ -465,6 +465,7 @@ def test_span_registry_pin():
         "worker_heartbeat", "worker_cancel_escalation",
         "speculation_attempt", "speculation_win", "speculation_loser",
         "stream_recovery", "flight_dump",
+        "aqe_rewrite", "aqe_history_seed",
         "result_cache_hit", "subplan_cache_hit",
     }
     assert all(doc.strip() for doc in tracing.SPAN_NAMES.values())

@@ -32,6 +32,19 @@ LEGS="${CI_BENCH_LEGS:---sentinel}"
 WORK="$(mktemp -d /tmp/blaze-ci-check.XXXXXX)"
 trap 'rm -rf "$WORK"' EXIT
 
+# Fast AQE smoke (CI_AQE_FAST=0 to skip): the adaptive-execution test
+# module plus a 1-rep skew-leg-only bench run.  --fast emits a reduced
+# artifact into scratch and self-gates on its own exit code (skew
+# speedup + zero divergence); it is NOT sentinel-compared because the
+# reduced artifact carries fewer metrics than the committed baseline.
+if [ "${CI_AQE_FAST:-1}" = "1" ]; then
+    echo "== ci_check: AQE tests =="
+    python -m pytest tests/test_adaptive.py -q -p no:cacheprovider
+    echo "== ci_check: bench --aqe --fast (smoke) =="
+    env "BLAZE_BENCH_AQE_PATH=$WORK/BENCH_AQE_FAST.json" \
+        python bench.py --aqe --fast
+fi
+
 fail=0
 for leg in $LEGS; do
     name="$(echo "${leg#--}" | tr '[:lower:]' '[:upper:]')"
