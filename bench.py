@@ -2685,7 +2685,7 @@ def multichip_child_main() -> int:
     jax.block_until_ready(out.accs[0])
     assert int(np.asarray(out.slot_valid).sum()) == n_groups
     walls = []
-    for _ in range(int(os.environ.get("BLAZE_BENCH_MULTICHIP_REPS", "5"))):
+    for _ in range(int(os.environ.get("BLAZE_BENCH_MULTICHIP_REPS", "20"))):
         t0 = time.perf_counter()
         out = step(*args)
         jax.block_until_ready(out.accs[0])
@@ -2699,7 +2699,9 @@ def multichip_child_main() -> int:
         "host_cpu_cores": cores,
         # virtual CPU devices past the physical core count timeshare one
         # host: scaling flattens for HARDWARE reasons, not engine ones —
-        # flag the leg so the curve reader discounts it
+        # flag the leg so the curve reader discounts it (refined below
+        # from ACTUAL worker-process CPU accounting when the
+        # process-per-device wave runs)
         "host_core_limited": (jax.default_backend() == "cpu"
                               and n_req > cores),
         # staged query execution in this leg runs through the
@@ -2712,12 +2714,183 @@ def multichip_child_main() -> int:
                       "wall_s": round(wall, 6),
                       "rows_per_sec": int(rows / wall)},
     }
+    if os.environ.get("BLAZE_BENCH_MULTICHIP_PROC", "1") != "0":
+        # process-per-device harness: N pinned worker processes x 1
+        # emulated device each, instead of N virtual devices
+        # timesharing THIS process — the scaling curve free of
+        # single-interpreter collective-sync overhead
+        ps = _multichip_proc_stage(n_req)
+        rec["proc_stage"] = ps
+        if not ps.get("errors"):
+            rec["host_core_limited"] = (
+                jax.default_backend() == "cpu"
+                and ps["cpu_parallelism"] < 0.75 * n_req)
+    if os.environ.get("BLAZE_BENCH_MULTICHIP_LEDGER", "1") != "0":
+        # per-leg device ledger: barrier_idle_s / dispatch_gap_s from a
+        # traced device-shuffle run (bridge/history.device_ledger)
+        rec["exchange_ledger"] = _multichip_exchange_probe(False)[0]
     if "--queries" in sys.argv:
         rec["itest"] = _multichip_queries(chaos=False)
         rec["chaos"] = _multichip_queries(chaos=True)
+        rec["overlap"] = _multichip_overlap_probe()
     print(json.dumps(rec))
     sys.stdout.flush()
     return 0
+
+
+def _multichip_proc_stage(n_req: int) -> dict:
+    """Process-per-device scaling wave: a pinned WorkerPool
+    (`auron.tpu.workers.pinDevices`) spawns `n_req` children, each
+    seeing exactly ONE emulated device, and every child runs a
+    fixed-size `_task_device_shard` workload concurrently (weak
+    scaling: rows PER WORKER are constant, so the leg's aggregate
+    throughput is the scaling signal — on real multi-device hardware
+    it grows ~linearly, on a core-limited host it stays flat instead
+    of regressing the way N virtual devices timesharing one
+    interpreter did).  Wall is the min over timed waves (first wave
+    warms jax import + compile per child); `cpu_parallelism` is the
+    sum of child CPU seconds over wall — the honest host_core_limited
+    signal (a 1-core host cannot exceed ~1.0 however many devices are
+    requested)."""
+    import threading as _threading
+
+    from blaze_tpu import config
+    from blaze_tpu.parallel.workers import WorkerPool
+
+    rows = int(os.environ.get("BLAZE_BENCH_MULTICHIP_ROWS", str(1 << 20)))
+    reps = int(os.environ.get("BLAZE_BENCH_MULTICHIP_REPS", "20"))
+    waves = int(os.environ.get("BLAZE_BENCH_MULTICHIP_WAVES", "3"))
+    shard = max(1, rows)  # per worker: weak scaling across legs
+    config.conf.set(config.WORKERS_PIN_DEVICES.key, True)
+    pool = None
+    try:
+        pool = WorkerPool(count=n_req, liveness_ms=60000).start()
+        spec = "blaze_tpu.parallel.workers:_task_device_shard"
+        results: list = [None] * n_req
+
+        def wave():
+            errs: list = []
+
+            def one(i):
+                try:
+                    results[i] = pool.run(
+                        {"fn": spec, "args": (shard, 4096, reps, 42 + i)},
+                        timeout_s=MULTICHIP_TIMEOUT_S)
+                except Exception as e:
+                    errs.append(f"worker {i}: {e}")
+            ts = [_threading.Thread(target=one, args=(i,))
+                  for i in range(n_req)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return time.perf_counter() - t0, errs
+
+        _warm, errs = wave()  # jax import + compile inside each child
+        runs = []
+        for _ in range(max(1, waves)):
+            w, werrs = wave()
+            errs += werrs
+            runs.append((w, sum(float(r.get("cpu_s") or 0)
+                                for r in results if r)))
+        wall, cpu = min(runs)
+        shards = [r for r in results if r]
+        rec = {
+            "workers": n_req, "rows": shard * n_req, "reps": reps,
+            "wall_s": round(wall, 6),
+            "rows_per_sec": int(shard * n_req * max(1, reps) / wall)
+            if wall else 0,
+            "cpu_s": round(cpu, 6),
+            "cpu_parallelism": round(cpu / wall, 3) if wall else 0.0,
+            "devices_per_worker": sorted({int(r.get("devices") or 0)
+                                          for r in shards}),
+            "pinned": [s.get("device_spec") for s in pool.health()],
+        }
+        if errs:
+            rec["errors"] = errs[:3]
+        return rec
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=False)
+        config.conf.unset(config.WORKERS_PIN_DEVICES.key)
+
+
+def _multichip_exchange_probe(overlap: bool, collect: bool = False):
+    """One traced staged run with the device shuffle on: returns the
+    device ledger's barrier/gap seconds plus the xla_stats
+    shuffle_barrier_idle_ns / overlap-exchange deltas for this run (and
+    the result Table when `collect`, for the sync-vs-overlap divergence
+    check)."""
+    import tempfile
+
+    from blaze_tpu import config
+    from blaze_tpu.bridge import tracing, xla_stats
+    from blaze_tpu.bridge.history import device_ledger
+    from blaze_tpu.itest import generate
+    from blaze_tpu.itest.queries import QUERIES
+    from blaze_tpu.itest.tpcds_data import write_parquet_splits
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.plan.stages import DagScheduler
+
+    qname = os.environ.get("BLAZE_BENCH_MULTICHIP_PROBE_QUERY", "q06")
+    scale = float(os.environ.get("BLAZE_BENCH_MULTICHIP_PROBE_SCALE",
+                                 "0.1"))
+    MemManager.init(4 << 30)
+    builder, table_names = QUERIES[qname]
+    tables = generate(table_names, scale=scale)
+    knobs = {config.DAG_SINGLE_TASK_BYTES.key: 0,
+             config.SHUFFLE_DEVICE.key: "on",
+             config.EXCHANGE_OVERLAP_ENABLE.key: overlap}
+    with tempfile.TemporaryDirectory(prefix="mc-probe-") as d:
+        paths = write_parquet_splits(tables, d, 2)
+        plan_dict, _oracle = builder(paths, tables, 2)
+        for k, v in knobs.items():
+            config.conf.set(k, v)
+        tracing.start_tracing()
+        before = xla_stats.snapshot()
+        try:
+            t0 = time.perf_counter()
+            got = DagScheduler(work_dir=os.path.join(d, "dag")) \
+                .run_collect(plan_dict)
+            wall = time.perf_counter() - t0
+            ds = xla_stats.delta(before)
+            spans = tracing.spans()
+        finally:
+            tracing.stop_tracing()
+            for k in knobs:
+                config.conf.unset(k)
+        led = device_ledger(spans)
+        rec = {"query": qname, "scale": scale, "overlap": bool(overlap),
+               "wall_s": round(wall, 4),
+               "barrier_idle_s": led["barrier_idle_s"],
+               "dispatch_gap_s": led["dispatch_gap_s"],
+               "device_busy_s": led["device_busy_s"],
+               "barrier_idle_ns":
+                   int(ds.get("shuffle_barrier_idle_ns", 0)),
+               "overlap_exchanges":
+                   int(ds.get("shuffle_device_overlap_exchanges", 0)),
+               "device_exchanges":
+                   int(ds.get("shuffle_device_exchanges", 0)),
+               "fallbacks": int(ds.get("shuffle_device_fallbacks", 0))}
+        return rec, (got if collect else None)
+
+
+def _multichip_overlap_probe() -> dict:
+    """Overlapped vs synchronous exchange on the SAME workload: the
+    overlap leg must cut the barrier-idle counter (sync pays
+    first-finisher-to-last-straggler wait before its one merged
+    exchange; overlap pays only per-task dispatch-slot waits) by >= 30%
+    and produce an identical result."""
+    from blaze_tpu.itest.runner import compare_frames
+
+    sync, base = _multichip_exchange_probe(False, collect=True)
+    over, got = _multichip_exchange_probe(True, collect=True)
+    err = compare_frames(got.to_pandas(), base.to_pandas())
+    si, oi = sync["barrier_idle_ns"], over["barrier_idle_ns"]
+    red = (1.0 - oi / si) if si else 0.0
+    return {"sync": sync, "overlap": over, "divergence": err,
+            "barrier_idle_reduction": round(red, 4)}
 
 
 def _multichip_queries(chaos: bool) -> dict:
@@ -2846,31 +3019,78 @@ def multichip_bench_main() -> int:
     mc = {"metric": "multichip_map_stage_scaling", "unit": "x",
           "legs": []}
     base_wall = None
+    base_proc = None
     for leg in legs:
         ms = leg["map_stage"]
+        ps = leg.get("proc_stage") or {}
         if leg["n_devices"] == 1:
             base_wall = ms["wall_s"]
+            if ps.get("rows_per_sec") and not ps.get("errors"):
+                base_proc = ps["rows_per_sec"]
         entry = {"n_devices": leg["n_devices"],
                  "n_devices_requested": leg["n_devices_requested"],
                  "host_cpu_cores": leg.get("host_cpu_cores"),
                  "host_core_limited": leg.get("host_core_limited", False),
                  "worker_isolated": leg.get("worker_isolated", False),
                  "platform": leg["platform"], **ms}
+        if ps:
+            entry["proc_wall_s"] = ps.get("wall_s")
+            entry["proc_rows_per_sec"] = ps.get("rows_per_sec")
+            entry["cpu_parallelism"] = ps.get("cpu_parallelism")
+            entry["proc_workers"] = ps.get("workers")
+            if ps.get("errors"):
+                entry["proc_errors"] = ps["errors"]
+        if "exchange_ledger" in leg:
+            # per-leg device ledger: the barrier the overlap work targets
+            entry["barrier_idle_s"] = \
+                leg["exchange_ledger"]["barrier_idle_s"]
+            entry["dispatch_gap_s"] = \
+                leg["exchange_ledger"]["dispatch_gap_s"]
+            entry["barrier_idle_ns"] = \
+                leg["exchange_ledger"]["barrier_idle_ns"]
         mc["legs"].append(entry)
         if "itest" in leg:
             mc["itest"] = leg["itest"]
         if "chaos" in leg:
             mc["chaos"] = leg["chaos"]
+        if "overlap" in leg:
+            mc["overlap"] = leg["overlap"]
     for entry in mc["legs"]:
-        entry["speedup_vs_1"] = (
-            round(base_wall / entry["wall_s"], 3) if base_wall else None)
+        pr = entry.get("proc_rows_per_sec")
+        if base_proc and pr and not entry.get("proc_errors"):
+            # the process-per-device wave is the scaling curve: one
+            # pinned child per device running a fixed per-device
+            # workload, so speedup is the leg's aggregate throughput
+            # over the 1-worker leg's — the 8-wide leg no longer pays
+            # 8 virtual devices' collective sync inside ONE interpreter
+            # (the old flat-to-regressing curve)
+            entry["speedup_vs_1"] = round(pr / base_proc, 3)
+            entry["speedup_basis"] = "process-per-device"
+        else:
+            entry["speedup_vs_1"] = (
+                round(base_wall / entry["wall_s"], 3) if base_wall
+                else None)
+            entry["speedup_basis"] = "in-process-mesh"
     widest_entry = max(mc["legs"], key=lambda e: e["n_devices"],
                        default=None)
     mc["value"] = (widest_entry or {}).get("speedup_vs_1") or 0
+    # monotone over the multi-device tail (8 >= 4): the 1-device leg is
+    # 1.0 by construction and a 1-core host legitimately sits below it.
+    # A small relative noise floor (same posture as the sentinel's
+    # threshold) keeps wave jitter on a flat curve from flapping the ok
+    # bit; a real regression like the old 0.777@8 is far outside it.
+    tol = float(os.environ.get("BLAZE_BENCH_MULTICHIP_MONO_TOL", "0.03"))
+    tail = sorted((e["n_devices"], e.get("speedup_vs_1") or 0)
+                  for e in mc["legs"] if e["n_devices"] > 1)
+    mc["monotone"] = all(b[1] >= a[1] * (1.0 - tol)
+                         for a, b in zip(tail, tail[1:]))
     it = mc.get("itest", {}).get("divergent_queries")
     ch = mc.get("chaos", {}).get("divergent_queries")
     mc["divergent_queries"] = (
         it + ch if it is not None and ch is not None else -1)
+    ov = mc.get("overlap")
+    if ov is not None and ov.get("divergence") is not None:
+        mc["divergent_queries"] = (mc["divergent_queries"] or 0) + 1
     if errors:
         mc["errors"] = errors
 
@@ -2890,8 +3110,13 @@ def multichip_bench_main() -> int:
     _write_bench(path, rec)
     print(json.dumps(mc))
     sys.stdout.flush()
+    ov = mc.get("overlap")
+    overlap_ok = (ov is None or
+                  (ov.get("divergence") is None and
+                   ov.get("barrier_idle_reduction", 0) >= 0.30))
     ok = (not errors and mc["divergent_queries"] == 0 and
-          len(mc["legs"]) == len(legs_req))
+          len(mc["legs"]) == len(legs_req) and mc["monotone"] and
+          overlap_ok)
     return 0 if ok else 1
 
 

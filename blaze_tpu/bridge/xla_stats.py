@@ -66,7 +66,20 @@ _faults = {"task_attempts": 0, "task_retries": 0, "task_retry_wait_ns": 0,
 _shuffle = {"shuffle_device_bytes": 0, "shuffle_host_bytes": 0,
             "shuffle_device_rows": 0, "shuffle_device_exchanges": 0,
             "shuffle_device_collectives": 0,
-            "shuffle_device_fallbacks": 0}
+            "shuffle_device_fallbacks": 0,
+            # overlapped exchange (PR 18): per-task tickets drained in
+            # the background, and the host-side barrier — time from the
+            # last fold completing to the first collective dispatch —
+            # the overlap exists to eliminate (sync pays it per stage;
+            # the overlapped path records 0)
+            "shuffle_device_overlap_exchanges": 0,
+            "shuffle_barrier_idle_ns": 0,
+            # io.compression.codec coverage beyond shuffle frames:
+            # worker-pool control frames and RSS partition puts
+            # (raw size - wire size, summed; 0 when the codec is raw
+            # or compression grew the payload and was skipped)
+            "worker_frame_compressed_bytes_saved": 0,
+            "rss_put_compressed_bytes_saved": 0}
 
 # Device-resident stage-loop accounting (runtime/loop.py,
 # plan/stage_compiler.py): stage programs built vs served from the
@@ -126,7 +139,12 @@ _stream = {"stream_epochs": 0, "stream_epoch_wall_ns": 0,
 # slots blacklisted by the crash budget, and cancel escalations.
 _workers = {"worker_spawns": 0, "worker_tasks": 0, "worker_crashes": 0,
             "worker_hangs": 0, "worker_restarts": 0,
-            "worker_blacklisted": 0, "worker_cancels": 0}
+            "worker_blacklisted": 0, "worker_cancels": 0,
+            # child-process CPU actually burned running tasks (user+sys
+            # os.times() delta shipped in each result frame) — what
+            # bench.py --multichip derives host_core_limited from,
+            # instead of a host-core-count heuristic
+            "worker_cpu_ns": 0}
 
 # Speculative-execution accounting (bridge/tasks.py wave loop,
 # shuffle/writer.py + shuffle/rss.py commit arbitration): waves that
@@ -572,6 +590,37 @@ def note_device_shuffle_fallback() -> None:
     and the stage re-ran through the file shuffle."""
     with _lock:
         _shuffle["shuffle_device_fallbacks"] += 1
+
+
+def note_exchange_overlap() -> None:
+    """One overlapped exchange ticket drained: its collective and
+    partition split ran concurrently with a later task's fold."""
+    with _lock:
+        _shuffle["shuffle_device_overlap_exchanges"] += 1
+
+
+def note_barrier_idle(ns: int) -> None:
+    """Host-side fold-end -> first-collective-dispatch gap for one
+    producer stage's device exchange (the barrier the overlapped
+    exchange eliminates; clamped >= 0 by callers)."""
+    with _lock:
+        _shuffle["shuffle_barrier_idle_ns"] += int(ns)
+
+
+def note_frame_compression(kind: str, saved: int) -> None:
+    """io.compression.codec saved `saved` bytes on one frame: kind
+    'worker' = a worker-pool control frame (task/result/heartbeat),
+    'rss' = an RSS partition put."""
+    key = ("worker_frame_compressed_bytes_saved" if kind == "worker"
+           else "rss_put_compressed_bytes_saved")
+    with _lock:
+        _shuffle[key] += int(saved)
+
+
+def note_worker_cpu(ns: int) -> None:
+    """Child-process CPU (user+sys) reported in one result frame."""
+    with _lock:
+        _workers["worker_cpu_ns"] += int(ns)
 
 
 def shuffle_stats() -> dict:

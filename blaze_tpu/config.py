@@ -562,6 +562,17 @@ WORKERS_DRAIN_MS = int_conf(
     "Graceful-drain budget at pool shutdown: workers get a shutdown "
     "message and this long to exit cleanly before SIGTERM, then "
     "SIGKILL.", category="fault-tolerance")
+WORKERS_PIN_DEVICES = bool_conf(
+    "auron.tpu.workers.pinDevices", False,
+    "Pin ONE emulated XLA device per worker child at spawn "
+    "(JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count=1 in "
+    "the child's env, replacing any inherited device-count flag).  N "
+    "pinned workers model N independent single-device hosts — the "
+    "process-per-device harness bench.py --multichip uses so the "
+    "scaling curve measures real per-process work instead of N "
+    "virtual devices serializing collectives on one core.  Each child "
+    "echoes its device_spec (platform, device count) in the hello "
+    "frame; pool.health() surfaces it.", category="fault-tolerance")
 SPECULATION_ENABLE = bool_conf(
     "auron.tpu.speculation.enable", False,
     "Speculative execution (the spark.speculation analog): once the "
@@ -625,6 +636,26 @@ MESH_EXCHANGE_SKEW = float_conf(
     "the collective exchange (capacity ladder rung >= skew * "
     "rows/destination).  Skewed key distributions that still overflow "
     "re-dispatch at the next ladder rung.", category="scale-out")
+EXCHANGE_OVERLAP_ENABLE = bool_conf(
+    "auron.tpu.exchange.overlap.enable", False,
+    "Double-buffer the device exchange: each map task's all-to-all is "
+    "DISPATCHED (unawaited device futures) as soon as its fold "
+    "finishes and DRAINED on a background thread, so task k's "
+    "collective + partition re-encode overlap task k+1's stage-loop "
+    "fold (ROADMAP item 4 — the ledger's barrier_idle category is the "
+    "target).  Overlap is fenced at hash-table regrow boundaries "
+    "(runtime/loop.py exchange_fence) to keep the atomic "
+    "overflow/rehash contract, and any dispatch/drain failure falls "
+    "back wholesale to the file shuffle exactly like the synchronous "
+    "lane.  Off (default) keeps the byte-identical synchronous "
+    "exchange.", category="scale-out")
+EXCHANGE_OVERLAP_DEPTH = int_conf(
+    "auron.tpu.exchange.overlap.depth", 2,
+    "In-flight exchange tickets allowed before the next dispatch "
+    "blocks (double-buffering = 2).  Bounds device send/receive "
+    "buffers held live concurrently; <= 1 degrades to dispatch-then-"
+    "drain per task with the drain still off the fold thread.",
+    category="scale-out")
 STAGE_DEVICE_LOOP_ENABLE = str_conf(
     "auron.tpu.stage.deviceLoop.enable", "auto",
     "Device-resident stage loop (runtime/loop.py): compile an eligible "
@@ -967,6 +998,17 @@ IO_COMPRESSION_CODEC = str_conf(
 IO_COMPRESSION_ZSTD_LEVEL = int_conf(
     "io.compression.zstd.level", 1,
     "zstd level for shuffle/spill frames.", category="shuffle")
+IO_COMPRESSION_WORKER_FRAMES = bool_conf(
+    "auron.tpu.io.compression.workerFrames", False,
+    "Compress worker-pool control frames (task/result/heartbeat "
+    "pickles riding the CRC32C pipe protocol) with io.compression."
+    "codec.  The codec byte has always been in the frame header, so "
+    "either end decodes any mix — a parent with this on talks to an "
+    "old child and vice versa.  Savings are counted in "
+    "worker_frame_compressed_bytes_saved; RSS partition puts "
+    "already carry IPC-compressed payloads and are accounted "
+    "separately (rss_put_compressed_bytes_saved).",
+    category="shuffle")
 FORCE_SHUFFLED_HASH_JOIN = bool_conf(
     "auron.forceShuffledHashJoin", False,
     "Convert every sort-merge join into a shuffled hash join.",

@@ -167,6 +167,22 @@ def all_to_all_rows(columns: Sequence[jax.Array], valid: jax.Array,
     return out_cols, out_valid, overflow
 
 
+def exchange_wire_cost(n_dev: int, capacity: int,
+                       dtypes: Sequence[str]) -> Tuple[int, int]:
+    """Accounting for ONE all_to_all_rows dispatch at `capacity`: every
+    device stages (n_dev dests x capacity) send buffers per exchanged
+    column — the data columns + their bool validity columns + the int32
+    pid rider + the bool row mask — and the program issues one
+    collective per buffer.  Returns (moved_bytes, collectives);
+    DeviceExchange sums these per ladder rung for
+    xla_stats.note_device_exchange, identically for the synchronous
+    exchange and the overlapped dispatch/drain split."""
+    import numpy as np
+    ncols = len(dtypes)
+    per_slot = sum(np.dtype(d).itemsize for d in dtypes) + ncols + 4 + 1
+    return n_dev * n_dev * capacity * per_slot, 2 * ncols + 2
+
+
 def psum_table_accs(table: AggTable, axis_name: str) -> AggTable:
     """Global (ungrouped) aggregate merge: one psum over acc columns."""
     accs = tuple(jax.lax.psum(jnp.where(v, a, jnp.zeros_like(a)), axis_name)

@@ -570,6 +570,26 @@ def _pad_rows(a, total: int, dtype=None):
     return out.astype(dtype) if dtype is not None else out
 
 
+class ExchangeTicket:
+    """One in-flight device exchange: the UNAWAITED outputs of the
+    first-rung dispatch plus everything `DeviceExchange.drain` needs to
+    finish the job — the remaining capacity-ladder rungs (with the
+    padded send buffers kept alive for an overflow re-dispatch), the
+    per-rung accounting accumulated so far, and the host-split
+    metadata.  Produced by `dispatch`, consumed exactly once by
+    `drain`; between the two the collective and the D2D partition
+    routing are free to run while the host folds the next chunk."""
+
+    __slots__ = ("out", "rungs", "row_valid", "datas", "vbufs",
+                 "key_idx", "dtypes", "lane", "n", "ncols", "n_out",
+                 "n_dev", "rows_per_dev", "ctx", "moved_bytes",
+                 "collectives", "dispatch_ns", "parts")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+
 class DeviceExchange:
     """Host-side driver for the on-device repartition.
 
@@ -580,6 +600,14 @@ class DeviceExchange:
     final rung = per-device row count can never overflow), and splits
     the received rows back into per-reduce-partition columns in a
     deterministic (destination, source, slot) order.
+
+    The driver is split into `dispatch` (everything through issuing the
+    first rung's shard_map call — returns an ExchangeTicket holding the
+    unawaited device futures) and `drain` (the overflow host sync, the
+    rung climb, accounting, and the host split).  `exchange` composes
+    the two back-to-back, which IS the synchronous path byte-for-byte;
+    the overlapped scheduler (plan/stages.py) instead drains ticket k
+    on a background thread while task k+1 is still folding.
     """
 
     def __init__(self, mesh=None):
@@ -597,9 +625,23 @@ class DeviceExchange:
         loop.py), which stay on device through padding and sharding
         (D2D, no host round trip).  Returns `parts`: n_out entries of
         ([data...], [valid...]) holding that reduce partition's rows."""
+        return self.drain(self.dispatch(columns, valids, key_indices,
+                                        n_out, ctx=ctx))
+
+    def dispatch(self, columns: Sequence[np.ndarray],
+                 valids: Sequence[np.ndarray],
+                 key_indices: Sequence[int], n_out: int,
+                 ctx: str = "") -> ExchangeTicket:
+        """Issue the all-to-all WITHOUT awaiting it: pad, pick the
+        ladder rungs, fire the per-shard fault sites, and dispatch the
+        first rung's cached program.  Returns immediately — jax
+        dispatch is async, so the returned ticket's `out` arrays are
+        device futures the collective is still filling."""
+        import time as _time
+
         from blaze_tpu import config, faults
         from blaze_tpu.batch import bucket_capacity, bucket_ladder
-        from blaze_tpu.bridge import xla_stats
+        from blaze_tpu.parallel.collective import exchange_wire_cost
         from blaze_tpu.parallel.mesh import DP_AXIS, shard_rows
 
         ncols = len(columns)
@@ -608,9 +650,14 @@ class DeviceExchange:
         n = int(len(columns[0]))
         n_dev = int(self.mesh.shape[DP_AXIS])
         if n == 0:
-            return [([np.zeros(0, c.dtype) for c in columns],
-                     [np.zeros(0, dtype=bool) for _ in columns])
-                    for _ in range(n_out)]
+            parts = [([np.zeros(0, c.dtype) for c in columns],
+                      [np.zeros(0, dtype=bool) for _ in columns])
+                     for _ in range(n_out)]
+            return ExchangeTicket(parts=parts, n=0, ncols=ncols,
+                                  n_out=int(n_out), n_dev=n_dev,
+                                  ctx=ctx, rungs=[], moved_bytes=0,
+                                  collectives=0,
+                                  dispatch_ns=_time.perf_counter_ns())
 
         # pad to n_dev * rows_per_dev so NamedSharding splits evenly;
         # padding rows carry row_valid=False and are never sent
@@ -637,32 +684,65 @@ class DeviceExchange:
         dtypes = tuple(np.dtype(c.dtype).name for c in columns)
         from blaze_tpu.kernels import lane as lane_mod
         lane = lane_mod.resolve("partition")
-        itemsizes = [np.dtype(d).itemsize for d in dtypes]
-        moved_bytes = 0
-        collectives = 0
+
+        cap = rungs[0]
+        # the scripted mid-collective kill: one decision per shard
+        # per dispatch, so `device-collective@k` targets shard k-1
+        for d in range(n_dev):
+            faults.maybe_fail("device-collective", shard=d, stage=ctx)
+        fn = _exchange_program(self.mesh, int(n_out), int(cap),
+                               key_idx, dtypes, lane)
+        out = fn(*shard_rows(self.mesh, row_valid, *datas, *vbufs))
+        moved_bytes, collectives = exchange_wire_cost(n_dev, cap, dtypes)
+        return ExchangeTicket(
+            out=out, rungs=list(rungs[1:]), row_valid=row_valid,
+            datas=datas, vbufs=vbufs, key_idx=key_idx, dtypes=dtypes,
+            lane=lane, n=n, ncols=ncols, n_out=int(n_out), n_dev=n_dev,
+            rows_per_dev=rows_per_dev, ctx=ctx, moved_bytes=moved_bytes,
+            collectives=collectives,
+            dispatch_ns=_time.perf_counter_ns())
+
+    def drain(self, ticket: ExchangeTicket):
+        """Await a dispatched exchange: block on the overflow scalar
+        (the one host sync), climb the remaining ladder rungs when a
+        destination bucket overflowed (re-firing the per-shard fault
+        sites per re-dispatch, exactly like the synchronous loop), then
+        split the received rows into per-partition numpy columns."""
+        from blaze_tpu import faults
+        from blaze_tpu.bridge import xla_stats
+        from blaze_tpu.parallel.collective import exchange_wire_cost
+        from blaze_tpu.parallel.mesh import shard_rows
+
+        if ticket.parts is not None:
+            return ticket.parts
+        ncols, n_out = ticket.ncols, ticket.n_out
+        out = ticket.out
         result = None
-        for cap in rungs:
-            # the scripted mid-collective kill: one decision per shard
-            # per dispatch, so `device-collective@k` targets shard k-1
-            for d in range(n_dev):
-                faults.maybe_fail("device-collective", shard=d, stage=ctx)
-            fn = _exchange_program(self.mesh, int(n_out), int(cap),
-                                   key_idx, dtypes, lane)
-            out = fn(*shard_rows(self.mesh, row_valid, *datas, *vbufs))
-            # send buffers are (n_dev dests x cap) per device per column:
-            # data cols + bool validity cols + int32 pid + bool row mask
-            per_slot = sum(itemsizes) + ncols + 4 + 1
-            moved_bytes += n_dev * n_dev * cap * per_slot
-            collectives += 2 * ncols + 2
+        while True:
             overflow = int(np.sum(np.asarray(out[-1])))
             if overflow == 0:
                 result = out
                 break
+            if not ticket.rungs:
+                break
+            cap = ticket.rungs.pop(0)
+            for d in range(ticket.n_dev):
+                faults.maybe_fail("device-collective", shard=d,
+                                  stage=ticket.ctx)
+            fn = _exchange_program(self.mesh, n_out, int(cap),
+                                   ticket.key_idx, ticket.dtypes,
+                                   ticket.lane)
+            out = fn(*shard_rows(self.mesh, ticket.row_valid,
+                                 *ticket.datas, *ticket.vbufs))
+            mb, cc = exchange_wire_cost(ticket.n_dev, cap, ticket.dtypes)
+            ticket.moved_bytes += mb
+            ticket.collectives += cc
         if result is None:
             raise DeviceExchangeError(
-                f"destination bucket overflow persisted through rung "
-                f"{rungs[-1]} (rows_per_dev={rows_per_dev})")
-        xla_stats.note_device_exchange(n, moved_bytes, collectives)
+                f"destination bucket overflow persisted through the "
+                f"ladder (rows_per_dev={ticket.rows_per_dev})")
+        xla_stats.note_device_exchange(ticket.n, ticket.moved_bytes,
+                                       ticket.collectives)
 
         out_cols = [np.asarray(a) for a in result[:ncols]]
         out_vals = [np.asarray(a).astype(bool)
@@ -682,4 +762,6 @@ class DeviceExchange:
             lo, hi = int(bounds[r]), int(bounds[r + 1])
             parts.append(([d[lo:hi] for d in datas_live],
                           [v[lo:hi] for v in vals_live]))
+        ticket.parts = parts
+        ticket.out = ticket.datas = ticket.vbufs = None  # free buffers
         return parts

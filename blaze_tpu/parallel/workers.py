@@ -43,6 +43,7 @@ import logging
 import os
 import pickle
 import queue
+import re
 import signal
 import subprocess
 import sys
@@ -54,8 +55,8 @@ from blaze_tpu import faults
 from blaze_tpu.bridge import tracing
 from blaze_tpu.faults import FetchFailedError, WorkerCrashed, \
     classify_exception
-from blaze_tpu.shuffle.ipc import FLAG_CRC, _check_frame_byte, \
-    _CRC, _HEADER, _verify_crc, pack_control_frame
+from blaze_tpu.shuffle.ipc import CODEC_RAW, FLAG_CRC, _check_frame_byte, \
+    _CRC, _decompress, _HEADER, _verify_crc, pack_control_frame
 
 log = logging.getLogger("blaze_tpu.workers")
 
@@ -83,9 +84,28 @@ class RemoteTaskError(RuntimeError):
 # truncated or corrupted frame surfaces as a checksum/EOF error the
 # retry machinery already classifies, never as a bad unpickle.
 
+def _frame_codec() -> int:
+    """The wire codec for OUTGOING control frames: io.compression.codec
+    when io.compression.workerFrames opts the worker protocol in, raw
+    otherwise.  Each frame self-describes its codec in the header byte,
+    so mixed parent/child settings (the conf snapshot lands only with
+    the first task) interoperate frame-by-frame."""
+    from blaze_tpu import config
+    if not config.IO_COMPRESSION_WORKER_FRAMES.get():
+        return CODEC_RAW
+    from blaze_tpu.shuffle.ipc import _get_codec
+    return _get_codec()
+
+
 def _send_msg(fp, obj: Any, lock: Optional[threading.Lock] = None) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    frame = pack_control_frame(payload)
+    codec = _frame_codec()
+    frame = pack_control_frame(payload, codec)
+    if codec != CODEC_RAW:
+        saved = (_HEADER.size + _CRC.size + len(payload)) - len(frame)
+        if saved > 0:
+            from blaze_tpu.bridge import xla_stats
+            xla_stats.note_frame_compression("worker", saved)
     if lock is not None:
         with lock:
             fp.write(frame)
@@ -116,7 +136,7 @@ def _recv_msg(fp) -> Optional[Any]:
     if header == b"":
         raise EOFError("truncated worker-pipe frame header")
     raw_codec, length = _HEADER.unpack(header)
-    _check_frame_byte(raw_codec)
+    codec = _check_frame_byte(raw_codec)
     crc = None
     if raw_codec & FLAG_CRC:
         crc_bytes = _read_exact(fp, _CRC.size)
@@ -128,6 +148,10 @@ def _recv_msg(fp) -> Optional[Any]:
         raise EOFError("truncated worker-pipe frame payload")
     if crc is not None:
         _verify_crc(crc, payload)
+    if codec != CODEC_RAW:
+        # CRC covers the wire bytes (corruption detection happens before
+        # any codec touches them); the codec byte keys the decode
+        payload = _decompress(codec, payload)
     return pickle.loads(payload)
 
 
@@ -159,6 +183,8 @@ class _Slot:
         self.cancel_kill = False   # cancel/deadline kill: not a crash
         self.inbox: "queue.Queue" = queue.Queue()
         self.write_lock = threading.Lock()
+        self.device_spec: Optional[Dict[str, Any]] = None  # hello frame
+        self.cpu_ns = 0            # child CPU (user+sys) across tasks
 
     def pid(self) -> Optional[int]:
         return self.proc.pid if self.proc is not None else None
@@ -207,7 +233,7 @@ class WorkerPool:
                 [sys.executable, "-m", "blaze_tpu.parallel.workers",
                  "--child"],
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-                bufsize=0)
+                bufsize=0, env=self._child_env(slot))
         except OSError as e:
             slot.proc = None
             slot.state = _DEAD
@@ -221,6 +247,30 @@ class WorkerPool:
             target=self._reader, args=(slot, slot.proc, slot.inbox),
             name=f"blaze-worker-reader-{slot.id}", daemon=True)
         t.start()
+
+    @staticmethod
+    def _child_env(slot: _Slot) -> Optional[Dict[str, str]]:
+        """Spawn env for one child; None inherits the parent env as-is.
+        With workers.pinDevices each child is pinned to exactly ONE
+        emulated device (`JAX_PLATFORMS=cpu`,
+        `--xla_force_host_platform_device_count=1`) — the
+        process-per-device scaling harness: N workers x 1 device instead
+        of 1 process x N virtual devices, so the multichip bench's
+        collective overhead is cross-PROCESS, not cross-thread.  Any
+        device-count flag inherited from a multichip parent is stripped
+        first (the parent emulates N devices; its children must not)."""
+        from blaze_tpu import config
+        if not config.WORKERS_PIN_DEVICES.get():
+            return None
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=1"
+                            ).strip()
+        env["BLAZE_WORKER_DEVICE_SLOT"] = str(slot.id)
+        return env
 
     def _reader(self, slot: _Slot, proc: subprocess.Popen,
                 inbox: "queue.Queue") -> None:
@@ -237,6 +287,7 @@ class WorkerPool:
                     with self._cond:
                         if slot.proc is proc and slot.state == _STARTING:
                             slot.state = _IDLE
+                            slot.device_spec = msg.get("device_spec")
                             slot.last_heartbeat = time.monotonic()
                             self._cond.notify_all()
                 elif kind == "heartbeat":
@@ -620,8 +671,17 @@ class WorkerPool:
             # abandoned speculation loser's (the drainer lands here too)
             tracing.ingest(res["spans"], worker=slot.id,
                            clock_ns=res.get("mono_ns"))
+        cpu_ns = res.get("cpu_ns")
+        if cpu_ns:
+            # actual worker-process CPU (user+sys from os.times in the
+            # child) — the multichip bench derives host_core_limited
+            # from the SUM of these vs wall, not from a host heuristic
+            from blaze_tpu.bridge import xla_stats
+            xla_stats.note_worker_cpu(int(cpu_ns))
         with self._cond:
             slot.tasks_done += 1
+            if cpu_ns:
+                slot.cpu_ns += int(cpu_ns)
             if slot.state == _BUSY:
                 slot.state = _IDLE
             self._cond.notify_all()
@@ -692,6 +752,8 @@ class WorkerPool:
             return [{"worker": s.id, "pid": s.pid(), "state": s.state,
                      "crashes": s.crashes, "tasks_done": s.tasks_done,
                      "incarnation": s.incarnation,
+                     "device_spec": s.device_spec,
+                     "cpu_s": s.cpu_ns / 1e9,
                      "heartbeat_age_ms": int((now - s.last_heartbeat) * 1e3)
                      if s.state == _BUSY else None}
                     for s in self._slots]
@@ -838,8 +900,61 @@ def _task_raise(kind: str = "runtime") -> None:
     raise RuntimeError("injected fatal failure")
 
 
+def _task_device_shard(rows: int, groups: int, reps: int = 1,
+                       seed: int = 0) -> dict:
+    """Bench helper (bench.py --multichip): one process-per-device shard
+    of the grouped-agg microbench.  jax initializes INSIDE this pinned
+    child, seeing exactly the one emulated device the spawn env granted,
+    so the N-shard wave measures real cross-process scaling rather than
+    N virtual devices time-slicing one interpreter.  Reports wall AND
+    process CPU (user+sys) so the supervisor can compute
+    cpu_parallelism = sum(cpu_s) / wall across the wave — the honest
+    host_core_limited signal."""
+    t_wall = time.perf_counter()
+    cpu0 = os.times()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    rows, groups = int(rows), int(groups)
+    rng = np.random.default_rng(int(seed))
+    keys = jnp.asarray(rng.integers(0, groups, size=rows, dtype=np.int64))
+    vals = jnp.asarray(rng.random(rows))
+
+    @jax.jit
+    def agg(k, v):
+        return jax.ops.segment_sum(v, k, num_segments=groups)
+
+    out = None
+    for _ in range(max(1, int(reps))):
+        out = agg(keys, vals)
+    out.block_until_ready()
+    cpu1 = os.times()
+    return {"wall_s": time.perf_counter() - t_wall,
+            "cpu_s": ((cpu1.user - cpu0.user) +
+                      (cpu1.system - cpu0.system)),
+            "checksum": float(jnp.sum(out)),
+            "devices": jax.device_count(),
+            "platform": jax.default_backend(),
+            "pid": os.getpid()}
+
+
 # ---------------------------------------------------------------------------
 # Child side
+
+def _child_device_spec() -> Optional[Dict[str, Any]]:
+    """Describe the device this child was pinned to, from the spawn env
+    ALONE — importing jax in the frame loop would initialize a backend
+    the first task's conf snapshot has not configured yet.  None when
+    the pool spawned without pinning (the default)."""
+    slot = os.environ.get("BLAZE_WORKER_DEVICE_SLOT")
+    if slot is None:
+        return None
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                  os.environ.get("XLA_FLAGS", ""))
+    return {"slot": int(slot),
+            "platform": os.environ.get("JAX_PLATFORMS") or "default",
+            "local_devices": int(m.group(1)) if m else None}
+
 
 def _resolve_fn(spec: str) -> Callable:
     mod_name, _, qual = spec.partition(":")
@@ -887,6 +1002,13 @@ def _run_child_task(msg: Dict[str, Any], out, out_lock) -> Dict[str, Any]:
         beater = threading.Thread(target=_beat, name="blaze-worker-beat",
                                   daemon=True)
         beater.start()
+    cpu0 = os.times()
+
+    def _cpu_ns() -> int:
+        t = os.times()
+        return int(((t.user - cpu0.user) +
+                    (t.system - cpu0.system)) * 1e9)
+
     try:
         if directive.get("delay_ms"):
             # worker-slow: stall but KEEP heartbeating — slow must never
@@ -911,7 +1033,7 @@ def _run_child_task(msg: Dict[str, Any], out, out_lock) -> Dict[str, Any]:
             # and retry-on-another-worker handle
             os.kill(os.getpid(), signal.SIGKILL)
         reply = {"kind": "result", "task_id": msg["task_id"], "ok": True,
-                 "value": value}
+                 "value": value, "cpu_ns": _cpu_ns()}
         if trace:
             reply["spans"] = tracing.take_buffered()
             reply["mono_ns"] = time.perf_counter_ns()
@@ -924,7 +1046,8 @@ def _run_child_task(msg: Dict[str, Any], out, out_lock) -> Dict[str, Any]:
             fetch = (e.stage_id, e.map_id)
         reply = {"kind": "result", "task_id": msg["task_id"], "ok": False,
                  "error_type": type(e).__name__, "error_msg": str(e),
-                 "classify": classify_exception(e), "fetch": fetch}
+                 "classify": classify_exception(e), "fetch": fetch,
+                 "cpu_ns": _cpu_ns()}
         if trace:
             reply["spans"] = tracing.take_buffered()
             reply["mono_ns"] = time.perf_counter_ns()
@@ -944,7 +1067,11 @@ def child_main() -> int:
     sys.stdout = sys.stderr
     out_lock = threading.Lock()
     signal.signal(signal.SIGTERM, lambda *_: os._exit(143))
-    _send_msg(out, {"kind": "hello", "pid": os.getpid()}, out_lock)
+    hello: Dict[str, Any] = {"kind": "hello", "pid": os.getpid()}
+    spec = _child_device_spec()
+    if spec is not None:
+        hello["device_spec"] = spec
+    _send_msg(out, hello, out_lock)
     while True:
         try:
             msg = _recv_msg(inp)

@@ -298,6 +298,19 @@ class QueryProfile:
                 f"{x.get('shuffle_device_rows', 0)} rows) "
                 f"host={_fmt_bytes(x.get('shuffle_host_bytes', 0))} "
                 f"fallbacks={x.get('shuffle_device_fallbacks', 0)}")
+            if x.get("shuffle_device_overlap_exchanges") \
+                    or x.get("shuffle_barrier_idle_ns"):
+                lines.append(
+                    f"  overlap: exchanges="
+                    f"{x.get('shuffle_device_overlap_exchanges', 0)} "
+                    f"barrier_idle="
+                    f"{_fmt_ns(x.get('shuffle_barrier_idle_ns', 0))}")
+        saved_w = x.get("worker_frame_compressed_bytes_saved", 0)
+        saved_r = x.get("rss_put_compressed_bytes_saved", 0)
+        if saved_w or saved_r:
+            lines.append(
+                f"frame compression: worker={_fmt_bytes(saved_w)} saved "
+                f"rss_put={_fmt_bytes(saved_r)} saved")
         if any(x.get(k) for k in ("stage_loop_tasks",
                                   "stage_loop_fallbacks")):
             lines.append(

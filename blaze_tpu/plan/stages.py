@@ -33,7 +33,7 @@ import tempfile
 import threading
 import uuid
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import pyarrow as pa
 
@@ -935,45 +935,47 @@ class DagScheduler:
                     [valids[i], np.asarray(v).astype(bool)])
         return cols, valids
 
-    def _run_producer_device(self, stage: Stage) -> None:
-        """Tentpole path: run the producer's map tasks — through the
-        device-resident stage loop when the stage compiles, the staged
-        per-batch executor otherwise — repartition their output through
-        the mesh collective (parallel/stage.py DeviceExchange) and
-        publish per-reduce-partition rows as in-memory IPC bytes blocks
-        (shuffle/reader.py read_block consumes raw bytes directly).
-        Any failure raises out to _run_producer, which falls back to
-        the file path."""
+    def _exchange_sync(self, stage: Stage, spec, n_out: int, schema):
+        """Synchronous device exchange: run the whole map wave, merge
+        every task's columns into one set, then ONE exchange + encode.
+        The `device_exchange` span covers merge+exchange+encode only —
+        NOT the map wave — so the device ledger's barrier_idle category
+        sees the real fold-end -> exchange-start gap this path pays.
+        shuffle_barrier_idle_ns counts the FIRST-finisher's wait: the
+        earliest-completed task's output sits at the barrier until the
+        last straggler lands and the merged exchange can start — the
+        exact idle the overlapped path dispatches away."""
+        import time as _time
+
         from blaze_tpu import config
-        from blaze_tpu.bridge import tracing
+        from blaze_tpu.bridge import tracing, xla_stats
         from blaze_tpu.parallel.stage import (DeviceExchange,
                                               DeviceExchangeError)
-        from blaze_tpu.plan.types import schema_from_dict
         from blaze_tpu.shuffle.ipc import write_batches_to_bytes
 
-        spec = stage.device_spec
-        n_out = int(spec["num_partitions"])
-        schema = schema_from_dict(stage.out_schema)
+        done_ns: List[int] = []
 
         def one_map(m: int):
             out = self._run_map_task_loop(stage, m)
             if out is not None:
+                done_ns.append(_time.perf_counter_ns())
                 return ("cols", out)
-            return ("batches", self._run_map_task_collect(stage, m))
+            res = ("batches", self._run_map_task_collect(stage, m))
+            done_ns.append(_time.perf_counter_ns())
+            return res
 
-        with tracing.span("device_exchange", stage=stage.sid,
-                          tasks=stage.num_tasks, partitions=n_out):
-            per_task = self._run_tasks(
-                one_map, stage.num_tasks,
-                f"stage {stage.sid} (device shuffle)", sid=stage.sid)
-            batches = [b for kind, out in per_task if kind == "batches"
-                       for b in out if b.num_rows]
-            col_tasks = [out for kind, out in per_task
-                         if kind == "cols" and out[2] > 0]
-            loop_tasks = sum(1 for kind, _o in per_task
-                             if kind == "cols")
-            blocks: Dict[int, bytes] = {}
-            if batches or col_tasks:
+        per_task = self._run_tasks(
+            one_map, stage.num_tasks,
+            f"stage {stage.sid} (device shuffle)", sid=stage.sid)
+        batches = [b for kind, out in per_task if kind == "batches"
+                   for b in out if b.num_rows]
+        col_tasks = [out for kind, out in per_task
+                     if kind == "cols" and out[2] > 0]
+        loop_tasks = sum(1 for kind, _o in per_task if kind == "cols")
+        blocks: Dict[int, bytes] = {}
+        if batches or col_tasks:
+            with tracing.span("device_exchange", stage=stage.sid,
+                              tasks=stage.num_tasks, partitions=n_out):
                 cols, valids = self._merge_map_outputs(batches,
                                                        col_tasks, schema)
                 est = sum(int(c.nbytes) for c in cols)
@@ -981,6 +983,9 @@ class DagScheduler:
                     raise DeviceExchangeError(
                         f"map output {est}B exceeds "
                         f"auron.tpu.shuffle.device.maxBytes")
+                if done_ns:
+                    xla_stats.note_barrier_idle(
+                        max(0, _time.perf_counter_ns() - min(done_ns)))
                 parts = DeviceExchange().exchange(
                     cols, valids, spec["key_indices"], n_out,
                     ctx=str(stage.sid))
@@ -989,6 +994,195 @@ class DagScheduler:
                     if datas and len(datas[0]):
                         rb = _columns_to_batch(datas, vls, arrow_schema)
                         blocks[r] = write_batches_to_bytes([rb])
+        return blocks, loop_tasks
+
+    def _exchange_overlapped(self, stage: Stage, spec, n_out: int,
+                             schema):
+        """Overlap scheduler (auron.tpu.exchange.overlap.enable): each
+        map task's columns are DISPATCHED into the mesh collective the
+        moment its fold finishes (parallel/stage.py ExchangeTicket) and
+        DRAINED on one background thread, so task k's all-to-all and
+        partition split run while task k+1 is still folding.  Contracts
+        kept vs the synchronous path:
+
+          * dispatch/drain failures — injected `device-collective`
+            faults included — are recorded and re-raised only AFTER the
+            wave, so task-retry machinery never sees them and the
+            wholesale file fallback stays the one failure path;
+          * overlap is fenced at hash-table regrow boundaries
+            (runtime/loop.py exchange_fence) to keep the atomic
+            overflow/rehash contract;
+          * cancellation propagates from the wave within one chunk, and
+            the drainer thread is always joined (leak_report clean);
+          * assembly concatenates per-partition rows in the synchronous
+            merge order (staged-batch tasks by task index, then
+            device-col tasks) and encodes ONE RecordBatch per
+            partition, so published blocks are byte-identical.
+        """
+        import queue as _queue
+        import time as _time
+
+        import numpy as np
+
+        from blaze_tpu import config
+        from blaze_tpu.bridge import tracing, xla_stats
+        from blaze_tpu.parallel.stage import (DeviceExchange,
+                                              DeviceExchangeError)
+        from blaze_tpu.runtime import loop as device_loop
+        from blaze_tpu.shuffle.ipc import write_batches_to_bytes
+
+        exchange = DeviceExchange()
+        depth = max(1, int(config.EXCHANGE_OVERLAP_DEPTH.get()))
+        max_bytes = config.SHUFFLE_DEVICE_MAX_BYTES.get()
+        slots = threading.Semaphore(depth)
+        lock = threading.Lock()
+        idle = threading.Condition(lock)
+        state = {"inflight": 0, "est": 0, "first_dispatch": None}
+        errors: List[BaseException] = []
+        parts_by_task: Dict[Tuple[int, int], list] = {}
+        q: "_queue.Queue" = _queue.Queue()
+
+        def drainer():
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                key, ticket = item
+                try:
+                    parts = exchange.drain(ticket)
+                    parts = [([np.asarray(d) for d in ds],
+                              [np.asarray(v) for v in vs])
+                             for ds, vs in parts]
+                    tracing.emit_span(
+                        "device_exchange",
+                        _time.perf_counter_ns() - ticket.dispatch_ns,
+                        stage=stage.sid, task=key[1], partitions=n_out,
+                        overlapped=True)
+                    xla_stats.note_exchange_overlap()
+                    with lock:
+                        parts_by_task[key] = parts
+                except BaseException as e:  # re-raised after the wave
+                    with lock:
+                        errors.append(e)
+                finally:
+                    with idle:
+                        state["inflight"] -= 1
+                        idle.notify_all()
+                    slots.release()
+
+        def fence():
+            # regrow boundary: drain every in-flight ticket before the
+            # carry doubles (runtime/loop.py calls this pre-rehash)
+            with idle:
+                while state["inflight"]:
+                    idle.wait(0.05)
+
+        def one_map(m: int):
+            out = self._run_map_task_loop(stage, m)
+            if out is not None:
+                kind, rank = "cols", 1
+                cols, valids, nrows = out
+            else:
+                kind, rank = "batches", 0
+                bs = [b for b in self._run_map_task_collect(stage, m)
+                      if b.num_rows]
+                cols, valids = _batches_to_columns(bs, schema)
+                nrows = len(cols[0]) if cols else 0
+            with lock:
+                doomed = bool(errors)
+            if doomed or nrows == 0:
+                return (kind, None)
+            fold_end = _time.perf_counter_ns()
+            slots.acquire()  # backpressure: at most `depth` in flight
+            try:
+                with lock:
+                    state["est"] += sum(int(c.nbytes) for c in cols)
+                    est = state["est"]
+                if est > max_bytes:
+                    raise DeviceExchangeError(
+                        f"map output {est}B exceeds "
+                        f"auron.tpu.shuffle.device.maxBytes")
+                ticket = exchange.dispatch(cols, valids,
+                                           spec["key_indices"], n_out,
+                                           ctx=str(stage.sid))
+                with idle:
+                    if state["first_dispatch"] is None:
+                        state["first_dispatch"] = ticket.dispatch_ns
+                    state["inflight"] += 1
+                # barrier idle here is only the backpressure wait for a
+                # dispatch slot — vs the sync path's first-finisher wait
+                # for the LAST straggler before its one merged exchange
+                xla_stats.note_barrier_idle(
+                    max(0, ticket.dispatch_ns - fold_end))
+                q.put(((rank, m), ticket))
+            except BaseException as e:
+                slots.release()
+                with lock:
+                    errors.append(e)
+            return (kind, True)
+
+        drain_thread = threading.Thread(
+            target=drainer, name=f"exchange-drain-{stage.sid}",
+            daemon=True)
+        drain_thread.start()
+        try:
+            with device_loop.exchange_fence(fence):
+                per_task = self._run_tasks(
+                    one_map, stage.num_tasks,
+                    f"stage {stage.sid} (device shuffle)",
+                    sid=stage.sid)
+        finally:
+            q.put(None)
+            drain_thread.join()
+        if errors:
+            raise errors[0]
+        loop_tasks = sum(1 for kind, _o in per_task if kind == "cols")
+
+        blocks: Dict[int, bytes] = {}
+        keys = sorted(parts_by_task)  # sync merge order
+        if keys:
+            arrow_schema = schema.to_arrow()
+            base = parts_by_task[keys[0]]
+            for r in range(n_out):
+                part_list = [parts_by_task[k][r] for k in keys]
+                ncols = len(base[r][0])
+                datas = [np.concatenate(
+                    [np.asarray(p[0][i]).astype(base[r][0][i].dtype)
+                     for p in part_list]) for i in range(ncols)]
+                vls = [np.concatenate(
+                    [np.asarray(p[1][i]).astype(bool)
+                     for p in part_list]) for i in range(ncols)]
+                if datas and len(datas[0]):
+                    rb = _columns_to_batch(datas, vls, arrow_schema)
+                    blocks[r] = write_batches_to_bytes([rb])
+        return blocks, loop_tasks
+
+    def _run_producer_device(self, stage: Stage) -> None:
+        """Tentpole path: run the producer's map tasks — through the
+        device-resident stage loop when the stage compiles, the staged
+        per-batch executor otherwise — repartition their output through
+        the mesh collective (parallel/stage.py DeviceExchange) and
+        publish per-reduce-partition rows as in-memory IPC bytes blocks
+        (shuffle/reader.py read_block consumes raw bytes directly).
+        With auron.tpu.exchange.overlap.enable the exchange is
+        dispatched per map task and drained in the background
+        (_exchange_overlapped); otherwise one synchronous exchange runs
+        after the wave (_exchange_sync) — both publish byte-identical
+        blocks.  Any failure raises out to _run_producer, which falls
+        back to the file path."""
+        from blaze_tpu import config
+        from blaze_tpu.plan.types import schema_from_dict
+
+        spec = stage.device_spec
+        n_out = int(spec["num_partitions"])
+        schema = schema_from_dict(stage.out_schema)
+
+        if config.EXCHANGE_OVERLAP_ENABLE.get():
+            blocks, loop_tasks = self._exchange_overlapped(
+                stage, spec, n_out, schema)
+        else:
+            blocks, loop_tasks = self._exchange_sync(
+                stage, spec, n_out, schema)
         self.stage_placement[stage.sid] = {
             "compute": ("device-loop" if loop_tasks == stage.num_tasks
                         else "mixed" if loop_tasks else "staged"),

@@ -57,6 +57,7 @@ INGEST_COUNTERS = (
     "stage_loop_programs_built", "stage_loop_program_cache_hits",
     "stage_loop_fallbacks", "scatter_lane_declines",
     "shuffle_device_bytes", "shuffle_host_bytes",
+    "shuffle_barrier_idle_ns", "shuffle_device_overlap_exchanges",
     "aqe_rewrites", "aqe_bytes_saved", "aqe_history_seeds",
 )
 

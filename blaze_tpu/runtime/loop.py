@@ -28,6 +28,9 @@ Discipline inherited from the staged path, kept intact:
 
 from __future__ import annotations
 
+import threading
+from contextlib import contextmanager
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -53,6 +56,40 @@ class StageLoopFallback(RuntimeError):
 # fingerprint -> jit'd chunk fold; bounded FIFO like fused's step caches
 _FOLD_CACHE: dict = {}
 _FOLD_LIMIT = 128
+
+# -- regrow fences (overlapped exchange) ------------------------------------
+# The overlapped exchange (plan/stages.py) keeps previous chunks'
+# all-to-all collectives in flight while this loop folds the next chunk.
+# A hash-table regrow is the one point where that is unsafe: the rehash
+# doubles the live table while in-flight tickets still pin their
+# send/receive buffers, and the overflow/rehash contract is atomic —
+# so the overlap scheduler registers a fence that drains every in-flight
+# ticket, and the loop runs all fences RIGHT BEFORE each regrow.
+
+_FENCE_LOCK = threading.Lock()
+_FENCES: list = []
+
+
+@contextmanager
+def exchange_fence(fn):
+    """Register `fn` to run before every hash-table regrow for the
+    duration of the `with` body.  Fences are global (not per-query):
+    an extra drain of another query's tickets only adds waiting, never
+    changes results."""
+    with _FENCE_LOCK:
+        _FENCES.append(fn)
+    try:
+        yield
+    finally:
+        with _FENCE_LOCK:
+            _FENCES.remove(fn)
+
+
+def _run_fences() -> None:
+    with _FENCE_LOCK:
+        fences = list(_FENCES)
+    for fn in fences:
+        fn()
 
 
 def _fold_factory(program, donate: bool, lane: str = "scatter"):
@@ -185,6 +222,7 @@ def run_partition(program, partition: int, ctx: str = "",
                     if slots * 2 > _MAX_SLOTS:
                         raise StageLoopFallback(
                             f"table would exceed {_MAX_SLOTS} slots")
+                    _run_fences()  # drain in-flight overlapped exchanges
                     slots *= 2
                     bigger, re_ovf, _ = _rehash_jit(program.kinds,
                                                     slots, lane)(carry)

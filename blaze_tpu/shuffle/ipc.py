@@ -80,14 +80,25 @@ def _verify_crc(expected: int, payload) -> None:
             f"(corrupted block)")
 
 
-def pack_control_frame(payload: bytes) -> bytes:
-    """One raw CRC32C-protected frame around an opaque payload — the
+def pack_control_frame(payload: bytes, codec: int = CODEC_RAW) -> bytes:
+    """One CRC32C-protected frame around an opaque payload — the
     worker wire protocol's message framing (parallel/workers.py rides
     these for pickled task/heartbeat/result messages, trace context
     included).  Layout matches the shuffle block frames exactly:
-    [CODEC_RAW|FLAG_CRC][u32 len][u32 crc32c][payload], so a torn or
+    [codec|FLAG_CRC][u32 len][u32 crc32c][payload], so a torn or
     bit-rotted control frame surfaces as the same EOFError /
-    ShuffleChecksumError taxonomy the retry machinery classifies."""
+    ShuffleChecksumError taxonomy the retry machinery classifies.
+
+    `codec` (io.compression.workerFrames) compresses the payload with
+    the shuffle block codec; the frame byte self-describes the choice,
+    so a reader built for CODEC_RAW-only peers still interoperates —
+    compression is skipped whenever it would grow the frame, keeping
+    tiny control messages (heartbeats, acks) raw."""
+    if codec != CODEC_RAW:
+        body = _compress(codec, payload)
+        if len(body) < len(payload):
+            return (_HEADER.pack(codec | FLAG_CRC, len(body))
+                    + _CRC.pack(_crc32c(body)) + body)
     return (_HEADER.pack(CODEC_RAW | FLAG_CRC, len(payload))
             + _CRC.pack(_crc32c(payload)) + payload)
 

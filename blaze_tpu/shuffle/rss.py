@@ -33,9 +33,49 @@ import json
 import os
 import re
 import time
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Tuple
 
-_FRAME = re.compile(r"^m(\d+)-a(\d+)-s(\d+)\.push$")
+_FRAME = re.compile(r"^m(\d+)-a(\d+)-s(\d+)\.push(z?)$")
+
+
+def _pack_put(payload: bytes) -> Tuple[bytes, str]:
+    """Wire form of one partition put.  With io.compression.workerFrames
+    on, the put is wrapped in ONE compressed control frame (same
+    self-describing [codec|FLAG_CRC][len][crc] layout as the shuffle
+    block frames) and lands as `.pushz`; the suffix keys the read-side
+    unwrap, so mixed-codec pushes from differently-configured writers
+    coexist in one shuffle.  Compression that would grow the put (the
+    inner IPC frames are often already codec-compressed) falls back to
+    the raw `.push` form — accounting only counts real savings."""
+    from blaze_tpu import config
+    if config.IO_COMPRESSION_WORKER_FRAMES.get():
+        from blaze_tpu.shuffle.ipc import (
+            CODEC_RAW, _CRC, _get_codec, _HEADER, pack_control_frame)
+        codec = _get_codec()
+        if codec != CODEC_RAW:
+            frame = pack_control_frame(payload, codec)
+            saved = (_HEADER.size + _CRC.size + len(payload)) - len(frame)
+            if saved > 0:
+                from blaze_tpu.bridge import xla_stats
+                xla_stats.note_frame_compression("rss", saved)
+                return frame, "pushz"
+    return payload, "push"
+
+
+def _unpack_put(data: bytes) -> bytes:
+    """Invert `_pack_put`'s compressed form: CRC-verify the wire bytes,
+    then decode by the frame's own codec byte."""
+    from blaze_tpu.shuffle.ipc import (
+        _check_frame_byte, _CRC, _decompress, FLAG_CRC, _HEADER,
+        _verify_crc)
+    raw_codec, length = _HEADER.unpack_from(data)
+    codec = _check_frame_byte(raw_codec)
+    pos = _HEADER.size
+    if raw_codec & FLAG_CRC:
+        (crc,) = _CRC.unpack_from(data, pos)
+        pos += _CRC.size
+        _verify_crc(crc, data[pos:pos + length])
+    return _decompress(codec, data[pos:pos + length])
 
 
 class RssPushClient:
@@ -64,12 +104,13 @@ class RssPushClient:
     def _push(self, map_id: int, attempt: int, partition: int,
               seq: int, payload: bytes) -> None:
         d = os.path.join(self.root, f"part-{partition}")
-        final = os.path.join(d, f"m{map_id}-a{attempt}-s{seq}.push")
+        wire, suffix = _pack_put(payload)
+        final = os.path.join(d, f"m{map_id}-a{attempt}-s{seq}.{suffix}")
         if os.path.exists(final):
             return  # idempotent retry of an already-landed frame
         tmp = final + f".tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
-            f.write(payload)
+            f.write(wire)
         os.replace(tmp, final)  # atomic publish
 
     def _committed_attempt(self, map_id: int):
@@ -191,7 +232,10 @@ class RssPushClient:
                     f"{sorted(committed)} (lost pushes)")
             for seq in sorted(committed):
                 with open(committed[seq], "rb") as f:
-                    blocks.append(f.read())
+                    data = f.read()
+                if committed[seq].endswith("z"):
+                    data = _unpack_put(data)
+                blocks.append(data)
         return blocks
 
     def cleanup(self) -> None:

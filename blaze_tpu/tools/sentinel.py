@@ -51,6 +51,7 @@ _LOWER_IS_BETTER = re.compile(
 _HIGHER_IS_BETTER = re.compile(
     r"(rows_per_sec|per_sec|qps|throughput|speedup|hit_rate|hits\b|"
     r"fraction|utilization|rows\b|completed|coalesces|bytes_saved|"
+    r"overlap(?:ped)?|cpu_parallelism|"
     r"share_ratio|aqe_(rewrites|broadcast_switches|partitions_coalesced|"
     r"skew_splits|history_seeds|stages_elided))", re.IGNORECASE)
 

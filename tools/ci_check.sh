@@ -45,6 +45,28 @@ if [ "${CI_AQE_FAST:-1}" = "1" ]; then
         python bench.py --aqe --fast
 fi
 
+# Fast multichip/overlap smoke (CI_MULTICHIP_FAST=0 to skip): the
+# overlapped-exchange test module plus a reduced --multichip run —
+# 1- and 2-device legs, small per-worker shards, one probe query.
+# Self-gating: bench --multichip exits nonzero on a non-monotone
+# curve, any sync-vs-overlap divergence, or a barrier-idle reduction
+# below the 30% floor.  Not sentinel-compared (reduced legs carry
+# fewer metrics than the committed BENCH_SF100 baseline).
+if [ "${CI_MULTICHIP_FAST:-1}" = "1" ]; then
+    echo "== ci_check: overlapped-exchange tests =="
+    python -m pytest tests/test_exchange_overlap.py -q -p no:cacheprovider
+    echo "== ci_check: bench --multichip (overlap smoke) =="
+    env "BLAZE_BENCH_SF100_PATH=$WORK/BENCH_SF100_FAST.json" \
+        BLAZE_BENCH_MULTICHIP_DEVICES=1,2 \
+        BLAZE_BENCH_MULTICHIP_ROWS=65536 \
+        BLAZE_BENCH_MULTICHIP_REPS=2 \
+        BLAZE_BENCH_MULTICHIP_WAVES=2 \
+        BLAZE_BENCH_MULTICHIP_QUERIES=q06 \
+        BLAZE_BENCH_MULTICHIP_SCALE=0.05 \
+        BLAZE_BENCH_MULTICHIP_PROBE_SCALE=0.05 \
+        python bench.py --multichip
+fi
+
 fail=0
 for leg in $LEGS; do
     name="$(echo "${leg#--}" | tr '[:lower:]' '[:upper:]')"
