@@ -4356,6 +4356,274 @@ def aqe_bench_main() -> int:
     return 0 if ok else 1
 
 
+# ===========================================================================
+# --fleet: replicated-serving kill-replica soak (ISSUE 19)
+# ===========================================================================
+
+def fleet_bench_main() -> int:
+    """Fleet soak (`--fleet`): an N-replica loopback serving fleet —
+    real replica PROCESSES behind the fingerprint-affine router, a
+    shared socket RSS shuffle service, and a shared history dir — runs
+    the q01/q06/q95 mix; mid-run one replica is SIGKILLed while holding
+    queries.  Invariants, each compared against fault-free in-process
+    baselines:
+
+      * 0 lost queries — every submitted query returns a result;
+      * 0 divergent results — re-routed/retried queries match the
+        baseline bit for bit;
+      * 0 duplicate committed blocks — first-wins commit held on the
+        shared RSS tier despite the crossfire of retried map attempts;
+      * affinity preserved — 100% hit-rate before the kill, and the
+        surviving replicas keep their own fingerprints after it;
+      * per-replica history rollups account for every completed query.
+
+    Writes BENCH_FLEET.json and prints it as one JSON line."""
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+    import glob
+    import tempfile
+
+    from blaze_tpu import config
+    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.bridge.history import HistoryStore
+    from blaze_tpu.fleet import FleetQueryLost, FleetRouter, spawn_replica
+    from blaze_tpu.itest import generate
+    from blaze_tpu.itest.queries import QUERIES
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.itest.tpcds_data import write_parquet_splits
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.plan.stages import DagScheduler
+    from blaze_tpu.shuffle.rss import RssSocketServer
+
+    fast = "--fast" in sys.argv
+    n_replicas = int(os.environ.get(
+        "BLAZE_BENCH_FLEET_REPLICAS", "2" if fast else "3"))
+    names = os.environ.get(
+        "BLAZE_BENCH_FLEET_QUERIES",
+        "q01,q06" if fast else "q01,q06,q95").split(",")
+    scale = float(os.environ.get(
+        "BLAZE_BENCH_FLEET_SCALE", "0.02" if fast else "0.05"))
+    rounds = int(os.environ.get(
+        "BLAZE_BENCH_FLEET_ROUNDS", "2" if fast else "4"))
+
+    MemManager.init(4 << 30)
+    # router supervision at bench cadence: a SIGKILLed replica must be
+    # classified down in ~1s, not the production 2s default
+    for k, v in ((config.FLEET_HEARTBEAT_MS.key, 100),
+                 (config.FLEET_LIVENESS_MS.key, 1000),
+                 (config.FLEET_PROBE_BACKOFF_MS.key, 100),
+                 (config.FLEET_RETRIES.key, 3)):
+        config.conf.set(k, v)
+
+    def frame(tbl):
+        import pandas as pd
+        return tbl.to_pandas() if tbl.num_rows else pd.DataFrame(
+            {n: [] for n in tbl.schema.names})
+
+    lost = 0
+    divergent = 0
+    duplicates = 0
+    successes = 0
+    procs = {}
+    rss_srv = None
+    router = None
+    per_query = []
+    try:
+        with tempfile.TemporaryDirectory(prefix="fleet-") as d:
+            # corpus + fault-free in-process baselines
+            plans, bases = [], []
+            for qname in names:
+                qname = qname.strip()
+                builder, table_names = QUERIES[qname]
+                tables = generate(table_names, scale=scale)
+                paths = write_parquet_splits(
+                    tables, os.path.join(d, qname), 2)
+                plan_dict, _oracle = builder(paths, tables, 2)
+                plans.append((qname, plan_dict))
+                bases.append(frame(DagScheduler(
+                    work_dir=os.path.join(d, qname, "base"))
+                    .run_collect(plan_dict)))
+
+            rss_root = os.path.join(d, "rss-store")
+            os.makedirs(rss_root)
+            rss_srv = RssSocketServer(rss_root).start()
+            hist_dir = os.path.join(d, "hist")
+            replica_conf = {
+                config.HISTORY_ENABLE.key: "true",
+                config.HISTORY_DIR.key: hist_dir,
+                # staged wire path so exchanges actually traverse the
+                # shared RSS service (single-task fusion would bypass it)
+                config.DAG_SINGLE_TASK_BYTES.key: 0,
+                config.SHUFFLE_SERVICE.key: rss_srv.url,
+                config.TASK_RETRY_BACKOFF_MS.key: 5,
+            }
+            endpoints = []
+            for i in range(n_replicas):
+                rid = f"replica-{i}"
+                proc, addr = spawn_replica(rid, conf=replica_conf)
+                procs[rid] = proc
+                endpoints.append((rid, addr))
+            router = FleetRouter(endpoints)
+
+            def run_one(qname, plan_dict, base, tag):
+                nonlocal lost, divergent, successes
+                t0 = time.perf_counter()
+                try:
+                    got = router.execute(plan_dict, timeout_s=300.0)
+                except FleetQueryLost as e:
+                    lost += 1
+                    per_query.append({"query": qname, "leg": tag,
+                                      "lost": True, "error": str(e)})
+                    return
+                wall = time.perf_counter() - t0
+                successes += 1
+                err = compare_frames(frame(got), base)
+                if err is not None:
+                    divergent += 1
+                per_query.append({"query": qname, "leg": tag,
+                                  "wall_s": round(wall, 4),
+                                  "divergent": err})
+
+            # warm-up: establish affinity (and each replica's caches)
+            for (qname, plan_dict), base in zip(plans, bases):
+                run_one(qname, plan_dict, base, "warmup")
+            pre_kill = router.health()
+            affinity_pre = pre_kill["affinity_hit_rate"]
+
+            kill_round = max(0, rounds // 2)
+            killed = None
+            for rnd in range(rounds):
+                if rnd == kill_round:
+                    # SIGKILL the busiest replica WHILE it holds the
+                    # round's queries: submit async, then pull the rug
+                    victim = max(
+                        (r for r in router.health()["replicas"]
+                         if r["state"] == "up"),
+                        key=lambda r: r["queries_routed"])["replica"]
+                    futs = [(qname, router.submit(
+                                plan_dict, timeout_s=300.0), base)
+                            for (qname, plan_dict), base
+                            in zip(plans, bases)]
+                    time.sleep(0.05)
+                    procs[victim].kill()  # SIGKILL, no drain
+                    killed = victim
+                    for qname, fut, base in futs:
+                        try:
+                            got = fut.result(timeout=600.0)
+                        except FleetQueryLost as e:
+                            lost += 1
+                            per_query.append(
+                                {"query": qname, "leg": "kill",
+                                 "lost": True, "error": str(e)})
+                            continue
+                        successes += 1
+                        err = compare_frames(frame(got), base)
+                        if err is not None:
+                            divergent += 1
+                        per_query.append({"query": qname, "leg": "kill",
+                                          "divergent": err})
+                else:
+                    for (qname, plan_dict), base in zip(plans, bases):
+                        run_one(qname, plan_dict, base, f"round-{rnd}")
+
+            health = router.health()
+            fleet_counters = xla_stats.fleet_stats()
+
+            # first-wins held on the shared RSS tier: exactly one
+            # committed manifest per (shuffle, map) — and with the
+            # O_EXCL/hardlink arbitration a second one cannot exist,
+            # so any extra commit file IS a duplicate committed block
+            seen = set()
+            for manifest in glob.glob(os.path.join(
+                    rss_root, "rss-*", "commit-m*")):
+                if manifest.endswith(".owner"):
+                    continue
+                key = (os.path.basename(os.path.dirname(manifest)),
+                       os.path.basename(manifest))
+                if key in seen:
+                    duplicates += 1
+                seen.add(key)
+
+            # per-replica history rollup over the SHARED dir: completed
+            # counts must account for every query the fleet answered
+            rollup = HistoryStore(hist_dir).rollup()
+            replica_counts = {k: v["completed"]
+                              for k, v in rollup["replicas"].items()}
+            rollup_total = sum(replica_counts.values())
+
+            # graceful teardown: drain survivors via SIGTERM
+            router.drain_all()
+            for rid, proc in procs.items():
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc in procs.values():
+                try:
+                    proc.wait(timeout=30)
+                except Exception:
+                    proc.kill()
+    finally:
+        if router is not None:
+            router.close()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        if rss_srv is not None:
+            rss_srv.stop()
+        for k in (config.FLEET_HEARTBEAT_MS.key,
+                  config.FLEET_LIVENESS_MS.key,
+                  config.FLEET_PROBE_BACKOFF_MS.key,
+                  config.FLEET_RETRIES.key):
+            config.conf.unset(k)
+
+    submitted = len(per_query)
+    affinity_post = health["affinity_hit_rate"]
+    rec = {
+        "metric": "fleet_soak_lost_queries",
+        "value": lost,
+        "unit": "queries",
+        "fast": fast,
+        "replicas": n_replicas,
+        "rounds": rounds,
+        "scale": scale,
+        "submitted": submitted,
+        "completed": successes,
+        "lost_queries": lost,
+        "divergent_results": divergent,
+        "duplicate_committed_blocks": duplicates,
+        "killed_replica": killed,
+        "affinity_hit_rate_pre_kill": affinity_pre,
+        "affinity_hit_rate_final": affinity_post,
+        "replicas_up_final": health["replicas_up"],
+        "fleet_reroutes": fleet_counters["fleet_reroutes"],
+        "fleet_replica_down_events":
+            fleet_counters["fleet_replica_down_events"],
+        "history_completed_by_replica": replica_counts,
+        "history_completed_total": rollup_total,
+        "queries": per_query,
+    }
+    path = os.environ.get(
+        "BLAZE_BENCH_FLEET_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_FLEET.json"))
+    _write_bench(path, rec)
+    print(json.dumps(rec, default=str))
+    sys.stdout.flush()
+    ok = (lost == 0 and divergent == 0 and duplicates == 0
+          and killed is not None
+          and successes == submitted
+          # every query the fleet completed is attributed to exactly
+          # one replica in the shared history rollup
+          and rollup_total == successes
+          # affinity: perfect while the fleet was whole, and the kill
+          # only moves the victim's fingerprints
+          and affinity_pre == 1.0
+          and (affinity_post or 0) >= 0.5
+          and health["replicas_up"] == n_replicas - 1)
+    return 0 if ok else 1
+
+
 def sentinel_bench_main() -> int:
     """--sentinel: self-check of the regression sentinel CI contract.
 
@@ -4447,6 +4715,8 @@ def main():
         sys.exit(obs_bench_main())
     if "--aqe" in sys.argv:
         sys.exit(aqe_bench_main())
+    if "--fleet" in sys.argv:
+        sys.exit(fleet_bench_main())
     if "--sentinel" in sys.argv:
         sys.exit(sentinel_bench_main())
     if "--multichip-child" in sys.argv:

@@ -57,7 +57,7 @@ from typing import Any, Dict, List, Optional
 HISTORY_SCHEMA_VERSION = 1
 
 #: bump when the summary/rollup shape changes; both payloads carry it
-ROLLUP_SCHEMA_VERSION = 1
+ROLLUP_SCHEMA_VERSION = 2
 
 #: every event type the emitters may write (docs/observability.md keeps
 #: a row per entry; tests/test_history_conformance.py enforces it)
@@ -334,6 +334,16 @@ def note_finished(query_id: Any, *, status: str, tenant: str,
             pass
     if fingerprint:
         fields["fingerprint"] = str(fingerprint)
+    # fleet: stamp which replica served the query, so per-replica
+    # rollups across a shared history dir account for every submitted
+    # query (the kill-replica soak sums these against the total)
+    try:
+        from blaze_tpu import config
+        replica = config.FLEET_REPLICA_ID.get()
+        if replica:
+            fields["replica"] = str(replica)
+    except Exception:
+        pass
     try:
         from blaze_tpu.plan import statstore
         if statstore.enabled():
@@ -561,6 +571,7 @@ class HistoryStore:
             "metric_tree": None, "attribution": None,
             "device_ledger": None, "bottleneck": None,
             "advisor": None, "fingerprint": None, "error": None,
+            "replica": None,
             "events": len(events), "events_dropped": 0,
         }
         for e in events:
@@ -605,6 +616,7 @@ class HistoryStore:
                 s["bottleneck"] = e.get("bottleneck")
                 s["advisor"] = e.get("advisor")
                 s["fingerprint"] = e.get("fingerprint")
+                s["replica"] = e.get("replica")
                 s["error"] = e.get("error")
                 s["events_dropped"] = int(e.get("events_dropped", 0))
         return s
@@ -634,6 +646,7 @@ class HistoryStore:
         per-query attribution deltas over every flat xla_stats counter
         key, so each family the engine exposes is represented here."""
         tenants: Dict[str, Dict[str, Any]] = {}
+        replicas: Dict[str, Dict[str, Any]] = {}
         by_exchange: Dict[str, Dict[str, int]] = {}
         by_compute: Dict[str, Dict[str, int]] = {}
         counters: Dict[str, float] = {k: 0 for k in rollup_counter_keys()}
@@ -675,6 +688,23 @@ class HistoryStore:
                 t["cancelled"] += 1
             if s["wall_s"] is not None:
                 walls.setdefault(tenant, []).append(float(s["wall_s"]))
+            # fleet: per-replica attribution from the stamped terminal
+            # events — across a shared history dir these counts sum to
+            # the fleet's total submitted queries (the soak's invariant)
+            if s.get("replica"):
+                r = replicas.setdefault(str(s["replica"]), {
+                    "queries": 0, "completed": 0, "failed": 0,
+                    "cancelled": 0, "wall_s_total": 0.0})
+                r["queries"] += 1
+                if status == "done":
+                    r["completed"] += 1
+                elif status == "failed":
+                    r["failed"] += 1
+                elif status == "cancelled":
+                    r["cancelled"] += 1
+                if s["wall_s"] is not None:
+                    r["wall_s_total"] = round(
+                        r["wall_s_total"] + float(s["wall_s"]), 6)
             for ts_key in ("submitted_ts", "finished_ts"):
                 ts = s.get(ts_key)
                 if ts is not None:
@@ -753,6 +783,7 @@ class HistoryStore:
             "schema_version": ROLLUP_SCHEMA_VERSION,
             "queries": n_queries,
             "tenants": tenants,
+            "replicas": replicas,
             "stages_by_exchange": by_exchange,
             "stages_by_compute": by_compute,
             "counters": counters,

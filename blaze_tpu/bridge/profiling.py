@@ -165,6 +165,7 @@ def prometheus_text() -> str:
         "obs": "observability plane",
         "cache": "cross-query work sharing",
         "stats": "statistics feedback plane",
+        "fleet": "replicated serving fleet",
     }
     families = xla_stats.counter_families()
     for fam in sorted(families):
@@ -386,6 +387,7 @@ ROUTES = (
     "/stats", "/stats/<fingerprint>",
     "/progress",
     "/serving", "/serving/cancel",
+    "/fleet",
 )
 
 
@@ -556,6 +558,14 @@ class _Handler(BaseHTTPRequestHandler):
             from blaze_tpu.serving import serving_stats
             self._send(200, json.dumps({"services": serving_stats(),
                                         "workers": pool_health()}))
+        elif route == "/fleet":
+            # fleet health: every live router's replica table (state,
+            # heartbeat age, affinity hit-rate) + the fleet counter
+            # family.  Empty-but-200 when no fleet is running, so the
+            # conformance sweep and dashboards can always scrape it.
+            from blaze_tpu.fleet.router import fleet_health
+            self._send(200, json.dumps(fleet_health(), sort_keys=True,
+                                       default=str))
         elif route == "/serving/cancel":
             from blaze_tpu.serving import cancel_query
             params = urllib.parse.parse_qs(parsed.query,

@@ -141,6 +141,13 @@ SPAN_NAMES: Dict[str, str] = {
                          "exchange-boundary blocks instead of running "
                          "its tasks (plan/stages.py; attrs stage/"
                          "fingerprint)",
+    "fleet_replica_down": "the fleet router marked a replica down "
+                          "after a transport error, a missed liveness "
+                          "deadline, or drain (fleet/router.py; attrs "
+                          "replica/reason)",
+    "fleet_replica_up": "a down replica answered a backoff probe and "
+                        "rejoined the routable set (fleet/router.py; "
+                        "attrs replica)",
 }
 
 

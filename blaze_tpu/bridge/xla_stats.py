@@ -197,6 +197,22 @@ _aqe = {"aqe_rewrites": 0, "aqe_broadcast_switches": 0,
         "aqe_history_seeds": 0, "aqe_bytes_saved": 0,
         "aqe_stages_elided": 0}
 
+# Fleet-scope serving (blaze_tpu/fleet/): queries routed by the
+# fingerprint-affine router, affinity hits (query landed on its
+# rendezvous first choice — the replica whose result/subplan cache is
+# warm), re-routes after replica death, end-to-end query retries,
+# replica up/down transitions and heartbeat misses, torn socket frames
+# survived, cross-replica hedges, and queries lost for good (must stay
+# 0 — the kill-replica soak's core invariant).
+# fleet_replicas_up_last is the router's current live-replica gauge.
+_fleet = {"fleet_queries_routed": 0, "fleet_queries_completed": 0,
+          "fleet_queries_lost": 0, "fleet_affinity_hits": 0,
+          "fleet_affinity_misses": 0, "fleet_reroutes": 0,
+          "fleet_retries": 0, "fleet_replica_down_events": 0,
+          "fleet_replica_up_events": 0, "fleet_heartbeat_misses": 0,
+          "fleet_torn_frames": 0, "fleet_hedges": 0,
+          "fleet_hedge_wins": 0, "fleet_replicas_up_last": 0}
+
 # Bounded raw-sample reservoirs feeding tail-latency percentiles
 # (bench.py --workers / --speculate): successful task-attempt durations
 # and run_tasks wave walls, in ns.  Lists, so NOT folded into
@@ -539,6 +555,26 @@ def aqe_stats() -> dict:
         return dict(_aqe)
 
 
+def note_fleet(**deltas: int) -> None:
+    """Fleet-plane mutator: kwargs name `_fleet` keys with or without
+    the `fleet_` prefix; gauges (`*_last`) are set absolutely, counters
+    are incremented (the note_stats contract)."""
+    with _lock:
+        for k, v in deltas.items():
+            key = k if k.startswith("fleet_") else f"fleet_{k}"
+            if key not in _fleet:
+                continue
+            if key.endswith("_last"):
+                _fleet[key] = int(v)
+            else:
+                _fleet[key] += int(v)
+
+
+def fleet_stats() -> dict:
+    with _lock:
+        return dict(_fleet)
+
+
 def _histogram(samples_ns: List[int]) -> Dict[str, Any]:
     """Cumulative-bucket Prometheus histogram over an ns reservoir:
     {"buckets": [(le_seconds, cumulative_count), ...], "sum": seconds,
@@ -858,6 +894,7 @@ def counter_families() -> Dict[str, Dict[str, int]]:
             "cache": dict(_cache),
             "stats": dict(_stats),
             "aqe": dict(_aqe),
+            "fleet": dict(_fleet),
         }
 
 
@@ -885,6 +922,7 @@ def snapshot() -> dict:
     flat.update(cache_stats())
     flat.update(statstore_stats())
     flat.update(aqe_stats())
+    flat.update(fleet_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
 
@@ -928,6 +966,8 @@ def reset() -> None:
             _stats[k] = 0
         for k in _aqe:
             _aqe[k] = 0
+        for k in _fleet:
+            _fleet[k] = 0
         _task_duration_ns.clear()
         _wave_wall_ns.clear()
         _bucket_caps.clear()

@@ -688,12 +688,72 @@ STAGE_DEVICE_LOOP_DONATE = bool_conf(
     "donation (harmless: XLA warns and copies).", category="scale-out")
 SHUFFLE_SERVICE = str_conf(
     "auron.tpu.shuffle.service", "",
-    "Shared-storage root of the elastic shuffle tier (shuffle/rss.py, "
-    "the Celeborn/Uniffle analog): map tasks push partition frames "
-    "there instead of writing local .data/.index files, so concurrent "
-    "queries don't contend on local disk.  Empty (default) keeps the "
-    "local file shuffle; any service-tier failure falls back to files "
-    "for that stage.", category="scale-out")
+    "Elastic shuffle tier endpoint (shuffle/rss.py, the "
+    "Celeborn/Uniffle analog): a shared-storage directory root, or "
+    "`socket://host:port` for the socket backend — map tasks push "
+    "partition frames to an RSS server over CRC32C control frames, so "
+    "map outputs survive their producing replica and reducers on ANY "
+    "replica can fetch them.  Empty (default) keeps the local file "
+    "shuffle; any service-tier failure falls back to files for that "
+    "stage.", category="scale-out")
+FLEET_REPLICA_ID = str_conf(
+    "auron.tpu.fleet.replicaId", "",
+    "Identity of THIS process within a serving fleet (fleet/replica.py)."
+    "  Stamped on every history event the replica's queries emit, so the"
+    " history rollup can aggregate per-replica query counts.  Empty "
+    "(default) = not a fleet replica; nothing is stamped and the "
+    "disabled path is byte-identical.", category="fleet")
+FLEET_HEARTBEAT_MS = int_conf(
+    "auron.tpu.fleet.heartbeatMs", 250,
+    "Router→replica ping cadence (fleet/router.py).  Only read once a "
+    "FleetRouter is constructed; no fleet, no effect.", category="fleet")
+FLEET_LIVENESS_MS = int_conf(
+    "auron.tpu.fleet.livenessMs", 2000,
+    "A replica whose last successful heartbeat is older than this is "
+    "marked DOWN (the worker-pool liveness deadline at fleet scope): "
+    "queries stop routing to it and its in-flight queries are retried "
+    "end-to-end on the next replica in rendezvous order.",
+    category="fleet")
+FLEET_PROBE_BACKOFF_MS = int_conf(
+    "auron.tpu.fleet.probeBackoffMs", 200,
+    "Base of the exponential backoff between liveness probes of a DOWN "
+    "replica (200ms, 400ms, 800ms, ... like the worker-pool respawn "
+    "backoff).  A probe that answers marks the replica UP again.",
+    category="fleet")
+FLEET_PROBE_BACKOFF_MAX_MS = int_conf(
+    "auron.tpu.fleet.probeBackoffMaxMs", 10_000,
+    "Ceiling on the down-replica probe backoff.", category="fleet")
+FLEET_RETRIES = int_conf(
+    "auron.tpu.fleet.retries", 2,
+    "End-to-end re-routes per query after a replica dies mid-flight "
+    "(connection reset or liveness miss).  Safe at every count because "
+    "attempt commit is first-wins on every shuffle tier — a retried "
+    "query can never double-commit blocks.", category="fleet")
+FLEET_DRAIN_MS = int_conf(
+    "auron.tpu.fleet.drainMs", 2000,
+    "Graceful-drain window on replica SIGTERM: stop accepting new "
+    "connections, let in-flight queries finish up to this long, then "
+    "exit 0.  SIGKILL (crash) skips the drain — that is what the "
+    "router's retry path is for.", category="fleet")
+FLEET_HEDGE_ENABLE = bool_conf(
+    "auron.tpu.fleet.hedge.enable", False,
+    "Hedge straggling queries across replicas (speculative execution "
+    "at fleet scope): a routed query running past hedge.multiplier x "
+    "the router's observed median wall is re-submitted to the next "
+    "replica in rendezvous order; first result wins, the loser is "
+    "cancelled.  Duplicate-safe for the same reason router retry is — "
+    "first-wins attempt commit on every tier.  Off by default.",
+    category="fleet")
+FLEET_HEDGE_MULTIPLIER = float_conf(
+    "auron.tpu.fleet.hedge.multiplier", 3.0,
+    "Straggler threshold for cross-replica hedging, as a multiple of "
+    "the router's median completed-query wall (the speculation "
+    "multiplier at fleet scope).", category="fleet")
+FLEET_HEDGE_MIN_MS = int_conf(
+    "auron.tpu.fleet.hedge.minMs", 50,
+    "Floor on the hedge trigger: a query younger than this is never "
+    "hedged, whatever the median says (guards against hedging every "
+    "query when the mix is uniformly fast).", category="fleet")
 SERVING_MAX_CONCURRENT = int_conf(
     "auron.tpu.serving.maxConcurrent", 4,
     "Queries executing simultaneously in the QueryService "

@@ -53,6 +53,20 @@ Sites (the code points that call in here):
                    (suppresses the cancel so BOTH attempts race the
                    commit; every shuffle tier must reject the late
                    loser)
+    replica-crash  fleet/replica.py, per query request (the replica
+                   process really SIGKILLs itself mid-query — the host
+                   death the router must survive: connection reset →
+                   mark the replica down, re-route the query to the
+                   next replica in rendezvous order, retry end-to-end)
+    replica-hang   fleet/replica.py, per heartbeat (the replica wedges —
+                   stops answering pings while its socket stays open;
+                   the router's liveness deadline must classify the
+                   miss as down and stop routing to it)
+    socket-torn-frame  shuffle/ipc.py sock_send_frame, per frame (the
+                   sender dies mid-send: the peer sees a length prefix
+                   it can never satisfy; readers must classify the tear
+                   as retryable FrameTransportClosed loss, never as a
+                   ShuffleChecksumError)
 
 Determinism: every decision is a pure function of (seed, site,
 occurrence-index) — the k-th evaluation of a site fires or not
@@ -84,7 +98,8 @@ SITES = ("task-start", "shuffle-write", "shuffle-read", "ipc-decode",
          "mem-pressure", "device-collective", "device-loop", "admit",
          "cancel-race", "quota-breach", "pallas-kernel", "stream-epoch",
          "checkpoint-commit", "worker-crash", "worker-hang", "worker-slow",
-         "speculation-loser-commit-race")
+         "speculation-loser-commit-race", "replica-crash", "replica-hang",
+         "socket-torn-frame")
 
 #: dynamically registered sites (register_site): rule validation accepts
 #: them alongside the static SITES tuple

@@ -1,0 +1,37 @@
+"""N-replica serving fleet: replicated QueryServices over sockets.
+
+The PR 11 worker protocol promoted from pipes to TCP and from one
+process to N crash domains (PAPER.md's disaggregated-service pillar;
+the lineage-recovery assumption of "Resilient Distributed Datasets"
+made explicit at host scope):
+
+  * `fleet/replica.py` — one replica process: a QueryService wrapped in
+    a socket server speaking the length-prefixed CRC32C pickle frames
+    from shuffle/ipc.py, hardened for short reads and torn frames on
+    TCP, with a hello handshake, heartbeats, graceful SIGTERM drain and
+    the worker pool's crash-classification semantics;
+  * `fleet/router.py` — a fingerprint-affine router: rendezvous-hash
+    each query's content-addressed plan fingerprint over the live
+    replicas, so repeats land on the replica whose result/subplan cache
+    is warm.  On replica death (heartbeat miss or connection reset) the
+    replica is marked DOWN with exponential-backoff probing, the query
+    re-routes to the next replica in rendezvous order, and in-flight
+    queries retry end-to-end — safe because attempt commit is
+    first-wins on every shuffle tier, so a retried query can never
+    double-commit blocks.
+
+Shuffle data outlives replicas via the RSS socket backend
+(shuffle/rss.py `socket://` scheme): map outputs live with the RSS
+server, and reducers on any replica fetch them over the same frames.
+
+Everything here is opt-in: no router, no replica, no fleet — the
+`auron.tpu.fleet.*` knobs are only read once one is constructed, and
+the disabled path is byte-identical to a solo QueryService.
+"""
+
+from blaze_tpu.fleet.replica import ReplicaServer, spawn_replica
+from blaze_tpu.fleet.router import (FleetQueryLost, FleetRouter,
+                                    fleet_health)
+
+__all__ = ["ReplicaServer", "spawn_replica", "FleetRouter",
+           "FleetQueryLost", "fleet_health"]

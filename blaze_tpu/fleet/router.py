@@ -1,0 +1,433 @@
+"""Fingerprint-affine query router over an N-replica fleet.
+
+Routing policy (rendezvous / highest-random-weight hashing): each query
+hashes its content-addressed plan fingerprint (plan/fingerprint.py —
+process-independent, so every router instance agrees) against every
+replica id and routes to the highest score.  Repeats of the same plan
+land on the SAME replica — the one whose PR 15 result/subplan cache is
+warm — and when a replica dies, only the fingerprints that hashed to it
+move (to their next-ranked replica); everyone else's affinity is
+undisturbed.  That is the whole point of rendezvous over mod-N: replica
+death does not reshuffle the cache-warm mapping of the survivors.
+
+Failure handling reuses the worker-pool supervision semantics at fleet
+scope:
+
+  * connection reset / torn frame mid-query → the replica is marked
+    DOWN (WorkerCrashed analog), the query re-routes to the next
+    replica in ITS OWN rendezvous order and retries end-to-end — safe
+    because attempt commit is first-wins on every shuffle tier, so the
+    retry can never double-commit blocks;
+  * heartbeat miss past `auron.tpu.fleet.livenessMs` → DOWN (the hung
+    replica: socket open, nobody home);
+  * DOWN replicas are probed with exponential backoff
+    (`probeBackoffMs`, doubling to `probeBackoffMaxMs`); a probe that
+    answers hello marks the replica UP and it re-enters every
+    rendezvous ranking at its old positions — affinity restores itself.
+
+Speculation (PR 12) at fleet scope: with `auron.tpu.fleet.hedge.enable`
+a query running past hedge.multiplier x the router's median completed
+wall is hedged on the next replica in rendezvous order; first result
+wins.  First-wins commit makes the duplicate harmless, exactly as for
+speculative task attempts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from blaze_tpu.fleet import wire
+from blaze_tpu.shuffle.ipc import FrameTransportClosed
+
+#: transport-level failures that mean "the replica is gone", not "the
+#: query is bad" — the WorkerCrashed taxonomy at socket scope
+_TRANSPORT_ERRORS = (FrameTransportClosed, ConnectionError, EOFError,
+                     OSError)
+
+_routers: "weakref.WeakSet[FleetRouter]" = weakref.WeakSet()
+
+
+class FleetQueryLost(RuntimeError):
+    """Every routing attempt a query was allowed exhausted without a
+    result — the counter the kill-replica soak must hold at zero."""
+
+
+class FleetQueryFailed(RuntimeError):
+    """A replica executed the query and reported a non-retryable
+    failure (plan/logic error): re-routing would just fail again."""
+
+
+class _Replica:
+    def __init__(self, replica_id: str, addr: Tuple[str, int]):
+        self.replica_id = replica_id
+        self.addr = (addr[0], int(addr[1]))
+        self.state = "up"
+        self.pid: Optional[int] = None
+        self.last_ok = time.monotonic()
+        self.misses = 0
+        self.probe_backoff_ms = 0.0
+        self.next_probe_at = 0.0
+        self.crashes = 0
+        self.queries_routed = 0
+        self.affinity_hits = 0
+        self.queries_done = 0
+        self.queries_failed = 0
+
+    def health_row(self, now: float) -> Dict[str, Any]:
+        """The router's pool_health()-shaped view of this replica."""
+        routed = self.queries_routed
+        return {
+            "replica": self.replica_id,
+            "pid": self.pid,
+            "addr": f"{self.addr[0]}:{self.addr[1]}",
+            "state": self.state,
+            "crashes": self.crashes,
+            "heartbeat_age_ms": round((now - self.last_ok) * 1e3, 1),
+            "heartbeat_misses": self.misses,
+            "queries_routed": routed,
+            "queries_done": self.queries_done,
+            "queries_failed": self.queries_failed,
+            "affinity_hits": self.affinity_hits,
+            "affinity_hit_rate": (round(self.affinity_hits / routed, 4)
+                                  if routed else None),
+            "probe_backoff_ms": round(self.probe_backoff_ms, 1),
+        }
+
+
+class FleetRouter:
+    """Routes queries over `endpoints` = [(replica_id, (host, port))]."""
+
+    def __init__(self, endpoints, *, heartbeat: bool = True,
+                 request_timeout_s: float = 600.0):
+        from blaze_tpu import config
+        self._heartbeat_s = config.FLEET_HEARTBEAT_MS.get() / 1000.0
+        self._liveness_s = config.FLEET_LIVENESS_MS.get() / 1000.0
+        self._probe_base_ms = float(config.FLEET_PROBE_BACKOFF_MS.get())
+        self._probe_max_ms = float(
+            config.FLEET_PROBE_BACKOFF_MAX_MS.get())
+        self._retries = max(0, config.FLEET_RETRIES.get())
+        self._hedge = config.FLEET_HEDGE_ENABLE.get()
+        self._hedge_mult = config.FLEET_HEDGE_MULTIPLIER.get()
+        self._hedge_min_s = config.FLEET_HEDGE_MIN_MS.get() / 1000.0
+        self._request_timeout_s = request_timeout_s
+        self._lock = threading.Lock()
+        self._replicas: List[_Replica] = []
+        for item in endpoints:
+            rid, addr = (item["replica_id"], item["addr"]) \
+                if isinstance(item, dict) else item
+            self._replicas.append(_Replica(str(rid), addr))
+        self._walls: deque = deque(maxlen=128)
+        self._closed = threading.Event()
+        self._pool = None
+        for r in self._replicas:
+            self._try_hello(r)
+        self._note_gauge()
+        self._hb_thread: Optional[threading.Thread] = None
+        if heartbeat and self._heartbeat_s > 0:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, name="blaze-fleet-router",
+                daemon=True)
+            self._hb_thread.start()
+        _routers.add(self)
+
+    # -- supervision -------------------------------------------------------
+
+    def _ping_timeout_s(self) -> float:
+        return max(0.05, min(2.0, self._liveness_s / 2))
+
+    def _try_hello(self, r: _Replica) -> bool:
+        try:
+            reply = wire.request(r.addr, {"kind": "hello"},
+                                 timeout_s=self._ping_timeout_s())
+            r.pid = reply.get("pid")
+            self._mark_up(r)
+            return True
+        except _TRANSPORT_ERRORS:
+            self._mark_down(r, "hello-failed")
+            return False
+
+    def _mark_down(self, r: _Replica, reason: str) -> None:
+        from blaze_tpu.bridge import tracing, xla_stats
+        with self._lock:
+            was_up = r.state == "up"
+            r.state = "down"
+            if was_up:
+                r.crashes += 1
+                r.probe_backoff_ms = self._probe_base_ms
+            else:
+                r.probe_backoff_ms = min(
+                    self._probe_max_ms,
+                    max(self._probe_base_ms, r.probe_backoff_ms * 2))
+            r.next_probe_at = (time.monotonic()
+                               + r.probe_backoff_ms / 1000.0)
+        if was_up:
+            xla_stats.note_fleet(replica_down_events=1)
+            tracing.instant("fleet_replica_down",
+                            replica=r.replica_id, reason=reason)
+            self._note_gauge()
+
+    def _mark_up(self, r: _Replica) -> None:
+        from blaze_tpu.bridge import tracing, xla_stats
+        with self._lock:
+            was_down = r.state != "up"
+            r.state = "up"
+            r.last_ok = time.monotonic()
+            r.misses = 0
+            r.probe_backoff_ms = 0.0
+        if was_down:
+            xla_stats.note_fleet(replica_up_events=1)
+            tracing.instant("fleet_replica_up", replica=r.replica_id)
+            self._note_gauge()
+
+    def _note_gauge(self) -> None:
+        from blaze_tpu.bridge import xla_stats
+        with self._lock:
+            up = sum(1 for r in self._replicas if r.state == "up")
+        xla_stats.note_fleet(replicas_up_last=up)
+
+    def _heartbeat_loop(self) -> None:
+        from blaze_tpu.bridge import xla_stats
+        while not self._closed.wait(self._heartbeat_s):
+            now = time.monotonic()
+            for r in list(self._replicas):
+                if r.state == "up":
+                    try:
+                        wire.request(r.addr, {"kind": "ping"},
+                                     timeout_s=self._ping_timeout_s())
+                        with self._lock:
+                            r.last_ok = time.monotonic()
+                            r.misses = 0
+                    except _TRANSPORT_ERRORS:
+                        with self._lock:
+                            r.misses += 1
+                        xla_stats.note_fleet(heartbeat_misses=1)
+                        if (time.monotonic() - r.last_ok
+                                > self._liveness_s):
+                            self._mark_down(r, "liveness-miss")
+                elif now >= r.next_probe_at:
+                    self._try_hello(r)
+                    if r.state != "up":
+                        # _try_hello's mark_down doubled the backoff
+                        pass
+
+    # -- routing -----------------------------------------------------------
+
+    @staticmethod
+    def fingerprint(plan: Dict[str, Any]) -> str:
+        from blaze_tpu.plan import fingerprint as fp_mod
+        return fp_mod.plan_fingerprint(plan)
+
+    def _rank(self, fp: str) -> List[_Replica]:
+        def score(r: _Replica) -> bytes:
+            return hashlib.blake2s(
+                f"{fp}|{r.replica_id}".encode()).digest()
+        return sorted(self._replicas, key=score, reverse=True)
+
+    def _revive_if_all_down(self) -> None:
+        if any(r.state == "up" for r in self._replicas):
+            return
+        for r in self._replicas:
+            self._try_hello(r)
+
+    def execute(self, plan: Dict[str, Any], *,
+                tenant: str = "default", deadline_ms: float = 0.0,
+                timeout_s: Optional[float] = None,
+                query_id: Optional[str] = None) -> Any:
+        """Route, execute, retry; returns the result table.  Raises
+        FleetQueryFailed on a non-retryable replica-side failure and
+        FleetQueryLost only when every allowed attempt found no replica
+        able to answer."""
+        fp = self.fingerprint(plan)
+        ranked = self._rank(fp)
+        if query_id is None:
+            # replica-local query ids ("q<N>") collide across processes
+            # in a shared history dir; fleet queries get a global one.
+            # A retry reuses it, so one query = one history log and the
+            # finishing replica's stamp wins.
+            import uuid
+            query_id = f"fq-{uuid.uuid4().hex[:12]}"
+        if self._hedge:
+            return self._execute_hedged(plan, fp, ranked, tenant,
+                                        deadline_ms, timeout_s,
+                                        query_id)
+        return self._execute_routed(plan, fp, ranked, 0, tenant,
+                                    deadline_ms, timeout_s, query_id)
+
+    def submit(self, plan: Dict[str, Any], **kw):
+        """Async variant: a concurrent.futures.Future of execute()."""
+        from concurrent.futures import ThreadPoolExecutor
+        with self._lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=32, thread_name_prefix="blaze-fleet-sub")
+            pool = self._pool
+        return pool.submit(self.execute, plan, **kw)
+
+    def _execute_routed(self, plan, fp, ranked, offset, tenant,
+                        deadline_ms, timeout_s, query_id) -> Any:
+        from blaze_tpu.bridge import xla_stats
+        timeout_s = timeout_s or self._request_timeout_s
+        first_choice = ranked[0]
+        last_error: Optional[str] = None
+        tried = 0
+        i = offset
+        while tried <= self._retries:
+            self._revive_if_all_down()
+            candidates = [ranked[(i + j) % len(ranked)]
+                          for j in range(len(ranked))]
+            replica = next((c for c in candidates if c.state == "up"),
+                           None)
+            if replica is None:
+                break
+            i = candidates.index(replica) + i + 1
+            tried += 1
+            with self._lock:
+                replica.queries_routed += 1
+                affine = replica is first_choice
+                if affine:
+                    replica.affinity_hits += 1
+            xla_stats.note_fleet(
+                queries_routed=1,
+                affinity_hits=1 if affine else 0,
+                affinity_misses=0 if affine else 1,
+                reroutes=1 if tried > 1 else 0,
+                retries=1 if tried > 1 else 0)
+            t0 = time.monotonic()
+            try:
+                reply = wire.request(
+                    replica.addr,
+                    {"kind": "query", "plan": plan, "tenant": tenant,
+                     "deadline_ms": deadline_ms, "query_id": query_id,
+                     "timeout_s": timeout_s},
+                    timeout_s=timeout_s + 10.0)
+            except _TRANSPORT_ERRORS as e:
+                if isinstance(e, FrameTransportClosed):
+                    xla_stats.note_fleet(torn_frames=1)
+                last_error = f"{type(e).__name__}: {e}"
+                self._mark_down(replica, "query-transport-error")
+                continue
+            if reply.get("ok"):
+                wall = time.monotonic() - t0
+                with self._lock:
+                    replica.queries_done += 1
+                    self._walls.append(wall)
+                xla_stats.note_fleet(queries_completed=1)
+                return reply["table"]
+            with self._lock:
+                replica.queries_failed += 1
+            last_error = str(reply.get("error"))
+            if reply.get("classify") == "retryable":
+                continue  # replica is healthy; the attempt is what died
+            raise FleetQueryFailed(
+                f"replica {replica.replica_id} failed query "
+                f"(status={reply.get('status')}): {last_error}")
+        xla_stats.note_fleet(queries_lost=1)
+        raise FleetQueryLost(
+            f"query lost after {tried} routing attempt(s)"
+            + (f"; last error: {last_error}" if last_error else ""))
+
+    def _execute_hedged(self, plan, fp, ranked, tenant, deadline_ms,
+                        timeout_s, query_id) -> Any:
+        """Cross-replica speculation: primary on the affine replica; if
+        it straggles past multiplier x median (min hedge.minMs), a
+        duplicate races from the next rendezvous position."""
+        from concurrent.futures import FIRST_COMPLETED, wait
+        from concurrent.futures import TimeoutError as FuturesTimeout
+        from blaze_tpu.bridge import xla_stats
+        with self._lock:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(
+                    max_workers=32, thread_name_prefix="blaze-fleet-sub")
+            pool = self._pool
+            walls = sorted(self._walls)
+        primary = pool.submit(self._execute_routed, plan, fp, ranked,
+                              0, tenant, deadline_ms, timeout_s,
+                              query_id)
+        hedge_after = None
+        if walls:
+            median = walls[len(walls) // 2]
+            hedge_after = max(self._hedge_min_s,
+                              median * self._hedge_mult)
+        if hedge_after is None or len(ranked) < 2:
+            return primary.result()
+        try:
+            return primary.result(timeout=hedge_after)
+        except (FuturesTimeout, TimeoutError):
+            pass  # straggling: race a duplicate from rank offset 1
+        xla_stats.note_fleet(hedges=1)
+        hedge = pool.submit(self._execute_routed, plan, fp, ranked,
+                            1, tenant, deadline_ms, timeout_s,
+                            query_id)
+        futures = {primary, hedge}
+        while futures:
+            done, futures = wait(futures, return_when=FIRST_COMPLETED)
+            for f in done:
+                if f.exception() is None:
+                    if f is hedge:
+                        xla_stats.note_fleet(hedge_wins=1)
+                    return f.result()
+            # a failed leg: fall through to whoever is still running
+        # both legs raised: surface the primary's error
+        return primary.result()
+
+    # -- health ------------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """Router up/down state + per-replica pool_health()-shaped rows
+        + affinity hit-rate (the /fleet endpoint payload)."""
+        now = time.monotonic()
+        with self._lock:
+            rows = [r.health_row(now) for r in self._replicas]
+        routed = sum(r["queries_routed"] for r in rows)
+        hits = sum(r["affinity_hits"] for r in rows)
+        return {
+            "replicas": rows,
+            "replicas_up": sum(1 for r in rows if r["state"] == "up"),
+            "replicas_down": sum(1 for r in rows
+                                 if r["state"] == "down"),
+            "queries_routed": routed,
+            "affinity_hit_rate": (round(hits / routed, 4)
+                                  if routed else None),
+            "hedge_enabled": bool(self._hedge),
+        }
+
+    def drain_all(self) -> None:
+        """Politely ask every live replica to drain (rolling shutdown)."""
+        for r in self._replicas:
+            if r.state == "up":
+                try:
+                    wire.request(r.addr, {"kind": "drain"},
+                                 timeout_s=self._ping_timeout_s())
+                except _TRANSPORT_ERRORS:
+                    pass
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+        _routers.discard(self)
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def fleet_health() -> Dict[str, Any]:
+    """Process-wide fleet view for the /fleet HTTP endpoint: every live
+    router's replica table plus the fleet counter family."""
+    from blaze_tpu.bridge import xla_stats
+    return {"routers": [r.health() for r in list(_routers)],
+            "counters": xla_stats.fleet_stats()}

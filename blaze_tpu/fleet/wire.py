@@ -1,0 +1,71 @@
+"""Fleet wire protocol: pickled control messages over CRC32C frames.
+
+Exactly the worker-pool message framing (parallel/workers.py) moved
+from pipes to TCP: each message is one `shuffle/ipc.py` control frame —
+[codec|FLAG_CRC][u32 len][u32 crc32c][pickled payload] — so a torn or
+bit-rotted message surfaces through the same taxonomy the retry
+machinery already classifies (FrameTransportClosed = retryable peer
+loss, ShuffleChecksumError = corruption).  Short recvs are looped until
+the length prefix is satisfied; a clean close between frames reads as
+None.
+
+Message kinds (dicts, forward-compatible — unknown keys ignored):
+
+    hello      {kind, replica_id?}          -> {kind, replica_id, pid,
+                                                proto}
+    ping       {kind}                       -> {kind: pong, health}
+    query      {kind, query_id, plan,       -> {kind: result, ok,
+                tenant, deadline_ms}            table?|error?, wall_s,
+                                                classify?, replica_id}
+    stats      {kind}                       -> {kind: stats, ...}
+    drain      {kind}                       -> {kind: draining}
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+from typing import Any, Optional, Tuple
+
+from blaze_tpu.shuffle.ipc import (FrameTransportClosed,
+                                   sock_recv_frame, sock_send_frame)
+
+#: bumped when a message shape changes incompatibly; hello carries it
+PROTO_VERSION = 1
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    sock_send_frame(sock, pickle.dumps(obj, protocol=4))
+
+
+def recv_msg(sock: socket.socket) -> Optional[Any]:
+    payload = sock_recv_frame(sock)
+    return None if payload is None else pickle.loads(payload)
+
+
+def connect(addr: Tuple[str, int],
+            timeout_s: float = 10.0) -> socket.socket:
+    sock = socket.create_connection(addr, timeout=timeout_s)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def request(addr: Tuple[str, int], msg: Any,
+            timeout_s: float = 10.0) -> Any:
+    """One connect → send → recv → close round trip.  Raises
+    FrameTransportClosed when the peer closes without answering (the
+    crash-mid-request shape the router must classify as replica loss)."""
+    sock = connect(addr, timeout_s)
+    try:
+        sock.settimeout(timeout_s)
+        send_msg(sock, msg)
+        reply = recv_msg(sock)
+        if reply is None:
+            raise FrameTransportClosed(
+                f"peer {addr[0]}:{addr[1]} closed before replying")
+        return reply
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass

@@ -1215,12 +1215,12 @@ class DagScheduler:
         from blaze_tpu.bridge import tracing
         from blaze_tpu.bridge.runtime import NativeExecutionRuntime
         from blaze_tpu.plan.proto_serde import task_definition_to_bytes
-        from blaze_tpu.shuffle.rss import RssPushClient
+        from blaze_tpu.shuffle.rss import rss_client_for
 
         part = self._part_of(stage)
         n_out = int(part.get("num_partitions", 1))
-        client = RssPushClient(root, f"{self._run_id}-{stage.sid}",
-                               stage.num_tasks, n_out)
+        client = rss_client_for(root, f"{self._run_id}-{stage.sid}",
+                                stage.num_tasks, n_out)
         self._rss_clients.append(client)
         attempts: Dict[int, int] = {}
         attempts_lock = threading.Lock()

@@ -25,6 +25,13 @@ FetchFailedError so the DAG scheduler can re-run exactly the map task
 that wrote the block.  Codec bytes with unknown flag/codec bits are
 rejected with a clear error instead of decoding garbage — a reader older
 than the frame format fails loudly, never silently.
+
+Stream transports (fleet sockets, RSS socket backend): the same frames
+ride TCP via `sock_send_frame` / `recv_control_frame`, which loop on
+short recv until the length prefix is satisfied and classify a mid-frame
+EOF as FrameTransportClosed — retryable peer loss in the WorkerCrashed /
+ConnectionError taxonomy — keeping ShuffleChecksumError reserved for a
+COMPLETE frame whose CRC32C genuinely mismatches.
 """
 
 from __future__ import annotations
@@ -37,6 +44,16 @@ import pyarrow as pa
 
 from blaze_tpu import config, faults
 from blaze_tpu.faults import ShuffleChecksumError
+
+class FrameTransportClosed(ConnectionError):
+    """A stream transport (TCP socket) ended mid-frame: the peer died or
+    the connection was reset between the length prefix and the payload.
+    This is LOSS, not corruption — the bytes that did arrive were never
+    CRC-mismatched — so it must classify retryable (the WorkerCrashed /
+    ConnectionError taxonomy: re-route, re-connect, re-send), never as a
+    ShuffleChecksumError that would trigger lineage recovery for a block
+    that was simply cut off in flight."""
+
 
 _HEADER = struct.Struct("<BI")
 _CRC = struct.Struct("<I")
@@ -101,6 +118,75 @@ def pack_control_frame(payload: bytes, codec: int = CODEC_RAW) -> bytes:
                     + _CRC.pack(_crc32c(body)) + body)
     return (_HEADER.pack(CODEC_RAW | FLAG_CRC, len(payload))
             + _CRC.pack(_crc32c(payload)) + payload)
+
+
+def recv_exact(read, n: int, *, mid_frame: bool = False):
+    """Read exactly `n` bytes from a stream transport, looping on short
+    reads (TCP `recv` returns whatever the kernel has buffered, not the
+    requested length — the length prefix is only satisfied once the loop
+    accumulates it).  Returns None on a clean EOF at a frame boundary
+    (`mid_frame=False`, the peer closed between frames); raises
+    FrameTransportClosed when the stream ends with a frame partially
+    delivered — retryable loss, not a checksum failure."""
+    data = read(n)
+    if not data:
+        if mid_frame:
+            raise FrameTransportClosed(
+                f"stream closed mid-frame ({n} byte(s) short)")
+        return None
+    data = bytes(data)
+    while len(data) < n:
+        more = read(n - len(data))
+        if not more:
+            raise FrameTransportClosed(
+                f"stream closed mid-frame (got {len(data)}/{n} bytes)")
+        data += bytes(more)
+    return data
+
+
+def recv_control_frame(read):
+    """Read one control frame from a stream transport and return its
+    verified, decompressed payload — the socket-side dual of
+    `pack_control_frame`.  `read(n)` is any short-read-prone callable
+    (socket.recv, file.read).  Returns None on clean EOF before a new
+    frame; raises FrameTransportClosed on a torn frame (peer death
+    mid-send — retryable) and ShuffleChecksumError only on genuine
+    payload corruption (CRC mismatch on a COMPLETE frame)."""
+    header = recv_exact(read, _HEADER.size)
+    if header is None:
+        return None
+    raw_codec, length = _HEADER.unpack(header)
+    codec = _check_frame_byte(raw_codec)
+    crc = None
+    if raw_codec & FLAG_CRC:
+        (crc,) = _CRC.unpack(recv_exact(read, _CRC.size, mid_frame=True))
+    payload = (recv_exact(read, length, mid_frame=True)
+               if length else b"")
+    if crc is not None:
+        _verify_crc(crc, payload)
+    return _decompress(codec, payload)
+
+
+def sock_send_frame(sock, payload: bytes, codec: int = CODEC_RAW) -> None:
+    """Send one control frame over a socket.  The `socket-torn-frame`
+    fault site models the producing host dying mid-send: the peer
+    receives a prefix of the frame and then EOF, which its
+    `recv_control_frame` must surface as retryable FrameTransportClosed
+    loss — never as corruption, and never as a silent short message."""
+    frame = pack_control_frame(payload, codec)
+    if faults.fires("socket-torn-frame"):
+        try:
+            sock.sendall(frame[:max(1, len(frame) // 2)])
+        finally:
+            sock.close()
+        raise FrameTransportClosed("injected torn frame (sender died)")
+    sock.sendall(frame)
+
+
+def sock_recv_frame(sock):
+    """Receive one control frame's payload from a socket (None on clean
+    EOF); short recvs are looped until the length prefix is satisfied."""
+    return recv_control_frame(sock.recv)
 
 
 def _lz4():

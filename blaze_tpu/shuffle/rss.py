@@ -32,10 +32,15 @@ from __future__ import annotations
 import json
 import os
 import re
+import socket
+import threading
 import time
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 _FRAME = re.compile(r"^m(\d+)-a(\d+)-s(\d+)\.push(z?)$")
+
+#: scheme prefix selecting the socket backend in auron.tpu.shuffle.service
+SOCKET_SCHEME = "socket://"
 
 
 def _pack_put(payload: bytes) -> Tuple[bytes, str]:
@@ -241,6 +246,304 @@ class RssPushClient:
     def cleanup(self) -> None:
         import shutil
         shutil.rmtree(self.root, ignore_errors=True)
+
+
+# -- socket backend ---------------------------------------------------------
+#
+# The directory backend above needs a shared mount; the socket backend
+# needs only a reachable address — map outputs live with the RSS server
+# process, not with the replica that produced them, so a replica dying
+# mid-query loses NOTHING already pushed (VERDICT item 7, the
+# Celeborn-server deployment shape).  Same manifest protocol, same
+# first-wins attempt arbitration (the server arbitrates with the
+# directory backend's own commit path), carried over the length-prefixed
+# CRC32C control frames from shuffle/ipc.py.
+
+
+def _send_msg(sock, obj) -> None:
+    import pickle
+    from blaze_tpu.shuffle.ipc import sock_send_frame
+    sock_send_frame(sock, pickle.dumps(obj, protocol=4))
+
+
+def _recv_msg(sock):
+    import pickle
+    from blaze_tpu.shuffle.ipc import sock_recv_frame
+    payload = sock_recv_frame(sock)
+    return None if payload is None else pickle.loads(payload)
+
+
+class RssSocketServer:
+    """One RSS endpoint: accepts framed manifest-protocol requests and
+    serves them against a private storage directory via the directory
+    backend (so both backends share one commit-arbitration code path —
+    a race the directory tier rejects is rejected here too)."""
+
+    def __init__(self, root: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._clients: Dict[str, RssPushClient] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        """The `auron.tpu.shuffle.service` value selecting this server."""
+        return f"{SOCKET_SCHEME}{self.host}:{self.port}"
+
+    def start(self) -> "RssSocketServer":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="blaze-rss-accept",
+            daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _peer = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="blaze-rss-conn", daemon=True).start()
+
+    def _dir_client(self, msg) -> RssPushClient:
+        sid = str(msg["shuffle_id"])
+        with self._lock:
+            client = self._clients.get(sid)
+            if client is None:
+                client = RssPushClient(
+                    self.root, sid, int(msg["num_maps"]),
+                    int(msg["num_reduces"]),
+                    use_hardlinks=bool(msg.get("use_hardlinks", True)))
+                self._clients[sid] = client
+        return client
+
+    def _serve_conn(self, conn) -> None:
+        from blaze_tpu.shuffle.ipc import FrameTransportClosed
+        try:
+            while True:
+                try:
+                    msg = _recv_msg(conn)
+                except (FrameTransportClosed, ConnectionError, OSError):
+                    return  # peer died mid-frame: nothing to answer
+                if msg is None:
+                    return  # clean close between frames
+                try:
+                    reply = self._handle(msg)
+                except TimeoutError as e:
+                    reply = {"ok": False, "kind": "timeout",
+                             "error": str(e)}
+                except (IOError, OSError) as e:
+                    reply = {"ok": False, "kind": "io", "error": str(e)}
+                except Exception as e:  # protocol-level failure
+                    reply = {"ok": False, "kind": "error",
+                             "error": f"{type(e).__name__}: {e}"}
+                try:
+                    _send_msg(conn, reply)
+                except (FrameTransportClosed, ConnectionError, OSError):
+                    return  # reply torn: client re-requests idempotently
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, msg: dict) -> dict:
+        kind = msg.get("kind")
+        if kind == "hello":
+            return {"ok": True, "root": self.root, "pid": os.getpid()}
+        client = self._dir_client(msg)
+        if kind == "push":
+            client._push(int(msg["map"]), int(msg["attempt"]),
+                         int(msg["partition"]), int(msg["seq"]),
+                         msg["payload"])
+            return {"ok": True}
+        if kind == "commit":
+            won = client._commit(
+                int(msg["map"]), int(msg["attempt"]),
+                {int(k): int(v) for k, v in msg["counts"].items()})
+            return {"ok": True, "won": won}
+        if kind == "committed":
+            return {"ok": True,
+                    "attempt": client._committed_attempt(int(msg["map"]))}
+        if kind == "wait":
+            return {"ok": True, "manifests": client.wait_for_maps(
+                timeout_s=float(msg.get("timeout_s", 60.0)))}
+        if kind == "blocks":
+            return {"ok": True, "blocks": client.reader_blocks(
+                int(msg["partition"]),
+                timeout_s=float(msg.get("timeout_s", 60.0)))}
+        if kind == "cleanup":
+            with self._lock:
+                self._clients.pop(str(msg["shuffle_id"]), None)
+            client.cleanup()
+            return {"ok": True}
+        raise ValueError(f"unknown rss request kind {kind!r}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class RssSocketClient:
+    """Drop-in for RssPushClient speaking the manifest protocol over a
+    socket.  Every request is idempotent or server-arbitrated
+    (push = rename-idempotent, commit = first-wins), so a torn frame or
+    dead connection is survived by reconnect + re-send — the retry can
+    never corrupt or double-commit.  `self.root` mirrors the server's
+    storage path for this shuffle (loopback white-box introspection;
+    the wire protocol itself never touches it)."""
+
+    #: reconnect+resend budget per request (each retry is a fresh
+    #: connection; beyond this the transport error propagates retryable)
+    _MAX_SENDS = 3
+
+    def __init__(self, addr, shuffle_id: str, num_maps: int,
+                 num_reduces: int, use_hardlinks: bool = True,
+                 timeout_s: float = 30.0):
+        if isinstance(addr, str):
+            if addr.startswith(SOCKET_SCHEME):
+                addr = addr[len(SOCKET_SCHEME):]
+            host, _, port = addr.rpartition(":")
+            addr = (host or "127.0.0.1", int(port))
+        self._addr = (addr[0], int(addr[1]))
+        self.shuffle_id = shuffle_id
+        self.num_maps = num_maps
+        self.num_reduces = num_reduces
+        self.use_hardlinks = use_hardlinks
+        self._timeout_s = timeout_s
+        self._sock = None
+        self._lock = threading.RLock()
+        hello = self._request({"kind": "hello"})
+        self.root = os.path.join(hello["root"], f"rss-{shuffle_id}")
+
+    # -- transport ---------------------------------------------------------
+
+    def _connect(self, timeout_s: float):
+        sock = socket.create_connection(self._addr, timeout=timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _request(self, msg: dict, timeout_s: Optional[float] = None
+                 ) -> dict:
+        from blaze_tpu.shuffle.ipc import FrameTransportClosed
+        msg.setdefault("shuffle_id", self.shuffle_id)
+        msg.setdefault("num_maps", self.num_maps)
+        msg.setdefault("num_reduces", self.num_reduces)
+        msg.setdefault("use_hardlinks", self.use_hardlinks)
+        budget = (timeout_s or 0.0) + self._timeout_s
+        last: Optional[BaseException] = None
+        with self._lock:
+            for _attempt in range(self._MAX_SENDS):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect(budget)
+                    self._sock.settimeout(budget)
+                    _send_msg(self._sock, msg)
+                    reply = _recv_msg(self._sock)
+                    if reply is None:
+                        raise FrameTransportClosed(
+                            "rss server closed before replying")
+                    break
+                except (FrameTransportClosed, ConnectionError,
+                        OSError, EOFError) as e:
+                    last = e
+                    self._drop()
+            else:
+                raise FrameTransportClosed(
+                    f"rss server {self._addr[0]}:{self._addr[1]} "
+                    f"unreachable after {self._MAX_SENDS} attempts"
+                ) from last
+        if reply.get("ok"):
+            return reply
+        err = reply.get("error", "rss request failed")
+        if reply.get("kind") == "timeout":
+            raise TimeoutError(err)
+        if reply.get("kind") == "io":
+            raise IOError(err)
+        raise RuntimeError(err)
+
+    # -- the RssPushClient surface ----------------------------------------
+
+    def partition_writer(self, map_id: int, attempt: int = 0
+                         ) -> "RssPartitionWriter":
+        return RssPartitionWriter(self, map_id, attempt)
+
+    def _push(self, map_id: int, attempt: int, partition: int,
+              seq: int, payload: bytes) -> None:
+        self._request({"kind": "push", "map": map_id,
+                       "attempt": attempt, "partition": partition,
+                       "seq": seq, "payload": payload})
+
+    def _commit(self, map_id: int, attempt: int,
+                counts: Dict[int, int]) -> bool:
+        return bool(self._request(
+            {"kind": "commit", "map": map_id, "attempt": attempt,
+             "counts": {int(k): int(v) for k, v in counts.items()}}
+        )["won"])
+
+    def _committed_attempt(self, map_id: int):
+        return self._request({"kind": "committed",
+                              "map": map_id})["attempt"]
+
+    def wait_for_maps(self, timeout_s: float = 60.0,
+                      poll_s: float = 0.02) -> List[dict]:
+        # transport deadline > server-side wait deadline, so the
+        # server's TimeoutError reply wins over a raw socket timeout
+        return self._request({"kind": "wait", "timeout_s": timeout_s},
+                             timeout_s=timeout_s + 10.0)["manifests"]
+
+    def reader_blocks(self, partition: int,
+                      timeout_s: float = 60.0) -> List[bytes]:
+        return self._request(
+            {"kind": "blocks", "partition": partition,
+             "timeout_s": timeout_s}, timeout_s=timeout_s + 10.0)["blocks"]
+
+    def cleanup(self) -> None:
+        try:
+            self._request({"kind": "cleanup"})
+        except Exception:
+            pass  # cleanup is best-effort on both backends
+        with self._lock:
+            self._drop()
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+
+def rss_client_for(root: str, shuffle_id: str, num_maps: int,
+                   num_reduces: int, use_hardlinks: bool = True):
+    """Backend selection off the `auron.tpu.shuffle.service` value: a
+    `socket://host:port` address speaks the socket protocol, anything
+    else is a shared-storage directory root.  Both return the same
+    client surface, so the scheduler's RSS path is backend-blind."""
+    if root.startswith(SOCKET_SCHEME):
+        return RssSocketClient(root, shuffle_id, num_maps, num_reduces,
+                               use_hardlinks=use_hardlinks)
+    return RssPushClient(root, shuffle_id, num_maps, num_reduces,
+                         use_hardlinks=use_hardlinks)
 
 
 class RssPartitionWriter:

@@ -46,13 +46,15 @@ from blaze_tpu.tools.bench_schema import ENVELOPE_KEYS
 _LOWER_IS_BETTER = re.compile(
     r"(wall|latency|_ms\b|_ns\b|_s\b|seconds|p50|p95|p99|overhead|"
     r"spill|wait|gap|idle|retries|failures|crashes|fallbacks|declines|"
-    r"evictions|recoveries|lag|delay|queued|dropped|misses|error)",
+    r"evictions|recoveries|lag|delay|queued|dropped|misses|error|"
+    r"lost|reroutes|torn_frames|down_events)",
     re.IGNORECASE)
 _HIGHER_IS_BETTER = re.compile(
     r"(rows_per_sec|per_sec|qps|throughput|speedup|hit_rate|hits\b|"
     r"fraction|utilization|rows\b|completed|coalesces|bytes_saved|"
     r"overlap(?:ped)?|cpu_parallelism|"
-    r"share_ratio|aqe_(rewrites|broadcast_switches|partitions_coalesced|"
+    r"share_ratio|replicas_up|hedge_wins|"
+    r"aqe_(rewrites|broadcast_switches|partitions_coalesced|"
     r"skew_splits|history_seeds|stages_elided))", re.IGNORECASE)
 
 

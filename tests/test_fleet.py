@@ -1,0 +1,386 @@
+"""Replicated serving fleet (fleet/): hardened stream framing,
+fingerprint-affine rendezvous routing, replica-death survival
+(`replica-crash`, `replica-hang`, `socket-torn-frame` chaos sites),
+graceful drain, the /fleet health surface, and per-replica history
+rollups."""
+
+import io
+import json
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from blaze_tpu import config, faults
+from blaze_tpu.bridge import history, tracing, xla_stats
+from blaze_tpu.fleet import (FleetQueryLost, FleetRouter, ReplicaServer,
+                             fleet_health)
+from blaze_tpu.fleet.router import FleetQueryFailed
+from blaze_tpu.memory import MemManager
+from blaze_tpu.shuffle.ipc import (CODEC_RAW, FrameTransportClosed,
+                                   pack_control_frame, recv_control_frame,
+                                   recv_exact, sock_recv_frame,
+                                   sock_send_frame)
+
+from tests.test_serving import _two_stage_plan
+
+_FLEET_KNOBS = (config.FLEET_HEARTBEAT_MS, config.FLEET_LIVENESS_MS,
+                config.FLEET_PROBE_BACKOFF_MS, config.FLEET_RETRIES,
+                config.FLEET_HEDGE_ENABLE, config.FLEET_REPLICA_ID)
+
+
+@pytest.fixture(autouse=True)
+def clean_slate():
+    faults.clear()
+    MemManager.init(4 << 30)
+    try:
+        yield
+    finally:
+        faults.clear()
+        for opt in _FLEET_KNOBS:
+            config.conf.unset(opt.key)
+        tracing.stop_tracing()
+        with tracing._lock:
+            tracing._spans.clear()
+        tracing.reset_conf_probe()
+        MemManager.init(4 << 30)
+
+
+@pytest.fixture
+def fleet(request):
+    """Three in-process replicas + a router with test-speed heartbeats.
+    Yields (router, replicas)."""
+    config.conf.set(config.FLEET_HEARTBEAT_MS.key, 50)
+    config.conf.set(config.FLEET_LIVENESS_MS.key, 400)
+    config.conf.set(config.FLEET_PROBE_BACKOFF_MS.key, 50)
+    config.conf.set(config.FLEET_RETRIES.key, 3)
+    replicas = [ReplicaServer(f"r{i}").start() for i in range(3)]
+    router = FleetRouter([(r.replica_id, r.addr) for r in replicas])
+    try:
+        yield router, replicas
+    finally:
+        router.close()
+        for r in replicas:
+            r.kill()
+
+
+def _frame(t):
+    import pandas as pd
+    return t.to_pandas() if t.num_rows else pd.DataFrame(
+        {n: [] for n in t.schema.names})
+
+
+# -- stream framing (shuffle/ipc.py hardening) -------------------------------
+
+def test_recv_exact_loops_on_short_reads():
+    chunks = [b"ab", b"c", b"de"]
+    assert recv_exact(lambda n: chunks.pop(0), 5) == b"abcde"
+
+
+def test_recv_exact_clean_eof_at_boundary_is_none():
+    assert recv_exact(lambda n: b"", 4) is None
+
+
+def test_recv_exact_mid_frame_eof_is_retryable_transport_loss():
+    """EOF with bytes already consumed is a dead peer, not corruption:
+    FrameTransportClosed (a ConnectionError ⇒ retryable), never a
+    checksum error."""
+    chunks = [b"ab"]
+    with pytest.raises(FrameTransportClosed):
+        recv_exact(lambda n: chunks.pop(0) if chunks else b"", 4)
+    assert faults.classify_exception(FrameTransportClosed()) == "retryable"
+
+
+def test_recv_control_frame_roundtrip_one_byte_reads():
+    frame = pack_control_frame(b"payload-bytes", CODEC_RAW)
+    buf = io.BytesIO(frame)
+    assert recv_control_frame(lambda n: buf.read(1)) == b"payload-bytes"
+    assert recv_control_frame(lambda n: buf.read(1)) is None  # clean EOF
+
+
+def test_recv_control_frame_truncated_is_transport_loss():
+    frame = pack_control_frame(b"payload-bytes", CODEC_RAW)
+    buf = io.BytesIO(frame[:len(frame) // 2])
+    with pytest.raises(FrameTransportClosed):
+        recv_control_frame(buf.read)
+
+
+def test_socket_torn_frame_fault_site():
+    """The `socket-torn-frame` chaos site: the sender dies mid-frame and
+    the receiver classifies the loss as retryable peer death."""
+    a, b = socket.socketpair()
+    try:
+        with faults.scoped(("socket-torn-frame", dict(at=(1,)))):
+            with pytest.raises(FrameTransportClosed):
+                sock_send_frame(a, b"x" * 1024)
+        b.settimeout(5.0)
+        with pytest.raises(FrameTransportClosed):
+            sock_recv_frame(b)
+    finally:
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+# -- rendezvous routing ------------------------------------------------------
+
+def test_rendezvous_ranking_is_deterministic_and_spreads(fleet):
+    router, _ = fleet
+    fps = [f"fingerprint-{i}" for i in range(16)]
+    first = {fp: router._rank(fp)[0].replica_id for fp in fps}
+    # deterministic: re-ranking agrees with itself
+    assert first == {fp: router._rank(fp)[0].replica_id for fp in fps}
+    # and different fingerprints spread over the fleet
+    assert len(set(first.values())) >= 2
+
+
+def test_repeat_queries_are_affine(fleet, tmp_path):
+    router, replicas = fleet
+    plan = _two_stage_plan(tmp_path, n=2000)
+    a = router.execute(plan)
+    b = router.execute(plan)
+    assert _frame(a).equals(_frame(b))
+    h = router.health()
+    assert h["affinity_hit_rate"] == 1.0
+    served = [r for r in h["replicas"] if r["queries_routed"]]
+    assert len(served) == 1  # both landed on the cache-warm replica
+    assert served[0]["queries_done"] == 2
+
+
+def test_two_routers_agree_on_affinity(fleet, tmp_path):
+    """Any router instance computes the same fingerprint→replica map —
+    affinity needs no shared state between routers."""
+    router, replicas = fleet
+    other = FleetRouter([(r.replica_id, r.addr) for r in replicas],
+                        heartbeat=False)
+    try:
+        plan = _two_stage_plan(tmp_path, n=2000, tag="-b")
+        fp = router.fingerprint(plan)
+        assert (router._rank(fp)[0].replica_id
+                == other._rank(fp)[0].replica_id)
+    finally:
+        other.close()
+
+
+# -- replica death -----------------------------------------------------------
+
+def test_replica_crash_reroutes_and_retries(fleet, tmp_path):
+    """The `replica-crash` site: the affine replica dies holding the
+    query; the router marks it down and the query retries end-to-end on
+    a sibling — same bytes out, zero lost queries."""
+    router, replicas = fleet
+    plan = _two_stage_plan(tmp_path, n=2000, tag="-c")
+    base = _frame(router.execute(plan))
+    before = xla_stats.fleet_stats()
+    with faults.scoped(("replica-crash", dict(at=(1,)))):
+        got = _frame(router.execute(plan))
+    assert got.equals(base)
+    h = router.health()
+    assert h["replicas_down"] == 1
+    after = xla_stats.fleet_stats()
+    assert after["fleet_reroutes"] > before["fleet_reroutes"]
+    assert after["fleet_queries_lost"] == before["fleet_queries_lost"]
+
+
+def test_killed_replica_is_probed_back_up(fleet, tmp_path):
+    router, replicas = fleet
+    tracing.start_tracing()
+    plan = _two_stage_plan(tmp_path, n=2000, tag="-k")
+    router.execute(plan)
+    victim = next(r for r in router.health()["replicas"]
+                  if r["queries_routed"])
+    dead = next(r for r in replicas if r.replica_id == victim["replica"])
+    dead.kill()
+    assert _frame(router.execute(plan)).equals(
+        _frame(router.execute(plan)))
+    # resurrect at the SAME address; backoff probing must bring it back
+    revived = ReplicaServer(dead.replica_id, host=dead.host,
+                            port=dead.port).start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if router.health()["replicas_down"] == 0:
+                break
+            time.sleep(0.05)
+        assert router.health()["replicas_down"] == 0
+        # the down/up transitions are trace instants (fleet_replica_*)
+        names = [s["name"] for s in tracing.spans()]
+        assert "fleet_replica_down" in names
+        assert "fleet_replica_up" in names
+    finally:
+        revived.kill()
+
+
+def test_replica_hang_is_downed_by_liveness_deadline(fleet, tmp_path):
+    """The `replica-hang` site: socket open, pings unanswered — only
+    the liveness deadline can classify it, and queries route around."""
+    router, replicas = fleet
+    with faults.scoped(("replica-hang", dict(at=(1,)))):
+        # Under load a HEALTHY replica can transiently miss pings and
+        # flap down before probing revives it; only the wedged replica
+        # stays down.  Wait for the down set to settle to exactly it.
+        deadline = time.monotonic() + 10.0
+        down = []
+        while time.monotonic() < deadline:
+            down = [r["replica"] for r in router.health()["replicas"]
+                    if r["state"] == "down"]
+            hung_ids = [r.replica_id for r in replicas if r._hung]
+            if hung_ids and down == hung_ids:
+                break
+            time.sleep(0.05)
+    hung = next(r.replica_id for r in replicas if r._hung)
+    assert down == [hung]
+    plan = _two_stage_plan(tmp_path, n=2000, tag="-h")
+    assert _frame(router.execute(plan)) is not None
+    assert all(r["queries_routed"] == 0 for r in
+               router.health()["replicas"] if r["replica"] == hung)
+
+
+def test_drained_replica_sheds_to_siblings(fleet, tmp_path):
+    router, replicas = fleet
+    plan = _two_stage_plan(tmp_path, n=2000, tag="-d")
+    router.execute(plan)
+    affine = next(r for r in router.health()["replicas"]
+                  if r["queries_routed"])
+    next(r for r in replicas
+         if r.replica_id == affine["replica"]).drain(timeout_s=2.0)
+    got = router.execute(plan)  # rerouted, not lost
+    assert got.num_rows > 0
+
+
+def test_all_replicas_dead_is_query_lost(tmp_path):
+    config.conf.set(config.FLEET_RETRIES.key, 1)
+    config.conf.set(config.FLEET_PROBE_BACKOFF_MS.key, 10)
+    r = ReplicaServer("solo").start()
+    router = FleetRouter([(r.replica_id, r.addr)], heartbeat=False)
+    try:
+        r.kill()
+        before = xla_stats.fleet_stats()["fleet_queries_lost"]
+        with pytest.raises(FleetQueryLost):
+            router.execute(_two_stage_plan(tmp_path, n=500, tag="-l"))
+        assert xla_stats.fleet_stats()["fleet_queries_lost"] == before + 1
+    finally:
+        router.close()
+
+
+def test_plan_error_is_fatal_not_rerouted(fleet):
+    """A broken plan fails the same way on every replica — the router
+    must surface it once, not burn retries across the fleet."""
+    router, _ = fleet
+    with pytest.raises(FleetQueryFailed):
+        router.execute({"kind": "no_such_operator"})
+    assert router.health()["replicas_down"] == 0
+
+
+class _SlowService:
+    """QueryService stand-in that straggles for a fixed wall."""
+
+    def __init__(self, inner, delay_s):
+        self._inner = inner
+        self._delay_s = delay_s
+
+    def submit(self, plan, **kw):
+        time.sleep(self._delay_s)
+        return self._inner.submit(plan, **kw)
+
+    def shutdown(self, **kw):
+        self._inner.shutdown(**kw)
+
+
+def test_hedge_races_straggler_across_replicas(fleet, tmp_path):
+    """Cross-replica speculation: the affine replica straggles past
+    multiplier x median, a hedge races from the next rendezvous
+    position and wins — first-wins commit makes the duplicate safe."""
+    from blaze_tpu.serving import QueryService
+    config.conf.set(config.FLEET_HEDGE_ENABLE.key, "true")
+    router, replicas = fleet
+    router._hedge = True
+    router._hedge_mult = 2.0
+    router._hedge_min_s = 0.05
+    plan = _two_stage_plan(tmp_path, n=2000, tag="-g")
+    base = _frame(router.execute(plan))  # warm + seeds the median wall
+    # wedge the affine replica's service so its next query straggles
+    affine = router._rank(router.fingerprint(plan))[0].replica_id
+    victim = next(r for r in replicas if r.replica_id == affine)
+    victim._service = _SlowService(victim.service(), delay_s=2.0)
+    before = xla_stats.fleet_stats()
+    got = _frame(router.execute(plan))
+    assert got.equals(base)
+    after = xla_stats.fleet_stats()
+    assert after["fleet_hedges"] == before["fleet_hedges"] + 1
+    assert after["fleet_hedge_wins"] == before["fleet_hedge_wins"] + 1
+    assert router.health()["replicas_down"] == 0  # slow is not dead
+
+
+# -- health surfaces ---------------------------------------------------------
+
+def test_fleet_health_module_surface(fleet, tmp_path):
+    router, _ = fleet
+    router.execute(_two_stage_plan(tmp_path, n=500, tag="-s"))
+    payload = fleet_health()
+    assert any(h["queries_routed"] >= 1 for h in payload["routers"])
+    assert payload["counters"]["fleet_queries_completed"] >= 1
+    json.dumps(payload, default=str)  # must be JSON-serializable
+
+
+def test_fleet_http_endpoint(fleet, tmp_path):
+    from blaze_tpu.bridge import profiling
+    router, _ = fleet
+    router.execute(_two_stage_plan(tmp_path, n=500, tag="-e"))
+    port = profiling.start_http_service(0)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fleet", timeout=10) as resp:
+        assert resp.status == 200
+        body = json.loads(resp.read())
+    assert "routers" in body and "counters" in body
+    assert any(r["queries_routed"] >= 1 for r in body["routers"])
+
+
+def test_history_rollup_attributes_queries_to_replicas(tmp_path):
+    d = str(tmp_path / "hist")
+    config.conf.set(config.HISTORY_ENABLE.key, "true")
+    config.conf.set(config.HISTORY_DIR.key, d)
+    history.reset_conf_probe()
+    try:
+        for qid, replica in (("q-a", "r0"), ("q-b", "r0"),
+                             ("q-c", "r1")):
+            config.conf.set(config.FLEET_REPLICA_ID.key, replica)
+            history.note_admitted(qid, tenant="t", deadline_ms=0,
+                                  mem_quota=0)
+            history.note_finished(qid, status="done", tenant="t",
+                                  wall_s=0.1)
+        store = history.HistoryStore(d)
+        assert store.summary("q-a")["replica"] == "r0"
+        roll = store.rollup()
+        by_replica = {k: v["queries"] for k, v in
+                      roll["replicas"].items()}
+        assert by_replica == {"r0": 2, "r1": 1}
+        # the soak's invariant: per-replica counts sum to the total
+        assert sum(by_replica.values()) == roll["queries"]
+    finally:
+        for opt in (config.HISTORY_ENABLE, config.HISTORY_DIR):
+            config.conf.unset(opt.key)
+        history.reset_conf_probe()
+
+
+# -- process-mode replica ----------------------------------------------------
+
+@pytest.mark.slow
+def test_spawned_replica_process_drains_on_sigterm():
+    import signal
+
+    from blaze_tpu.fleet import spawn_replica, wire
+    proc, addr = spawn_replica("proc-r0")
+    try:
+        hello = wire.request(addr, {"kind": "hello"}, timeout_s=10.0)
+        assert hello["replica_id"] == "proc-r0"
+        assert hello["pid"] == proc.pid
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)

@@ -18,6 +18,38 @@ def budget():
     MemManager.init(1 << 30)
 
 
+@pytest.fixture(params=["dir", "socket"])
+def make_client(request, tmp_path):
+    """RSS client factory parametrized over both backends: the
+    directory backend direct, and the same first-wins arbitration
+    behind the socket service (shuffle data outliving its producing
+    replica).  The socket client's `root` points into the server's
+    storage, so the white-box filesystem assertions below hold for
+    both."""
+    servers, clients = [], []
+
+    def factory(tag, num_maps, num_reduces, use_hardlinks=True):
+        if request.param == "dir":
+            return RssPushClient(str(tmp_path), tag, num_maps=num_maps,
+                                 num_reduces=num_reduces,
+                                 use_hardlinks=use_hardlinks)
+        from blaze_tpu.shuffle.rss import (RssSocketClient,
+                                           RssSocketServer)
+        srv = RssSocketServer(str(tmp_path)).start()
+        servers.append(srv)
+        c = RssSocketClient(srv.url, tag, num_maps=num_maps,
+                            num_reduces=num_reduces,
+                            use_hardlinks=use_hardlinks)
+        clients.append(c)
+        return c
+
+    yield factory
+    for c in clients:
+        c.close()
+    for srv in servers:
+        srv.stop()
+
+
 def _map_td(t, tmp_path, map_id, n_maps, n_reduces, rid):
     import os
 
@@ -100,9 +132,9 @@ def _table(n=5000, seed=0):
                      "v": pa.array(np.round(rng.random(n) * 10, 3))})
 
 
-def test_push_commit_read_roundtrip(tmp_path):
+def test_push_commit_read_roundtrip(tmp_path, make_client):
     t = _table()
-    client = RssPushClient(str(tmp_path), "s1", num_maps=3, num_reduces=4)
+    client = make_client("s1", num_maps=3, num_reduces=4)
     for m in range(3):
         _run_map(t, tmp_path, client, m, 3, 4)
     got = _reduce_all(t, client, 4)
@@ -113,12 +145,12 @@ def test_push_commit_read_roundtrip(tmp_path):
     assert all(len(client.reader_blocks(p, 1.0)) > 0 for p in range(4))
 
 
-def test_failed_attempt_is_ignored(tmp_path):
+def test_failed_attempt_is_ignored(tmp_path, make_client):
     """Failure injection: attempt 0 of map 1 pushes frames but dies
     before MapperEnd; the retry (attempt 1) commits.  Readers must see
     exactly one attempt's data — no loss, no duplication."""
     t = _table()
-    client = RssPushClient(str(tmp_path), "s2", num_maps=2, num_reduces=3)
+    client = make_client("s2", num_maps=2, num_reduces=3)
     _run_map(t, tmp_path, client, 0, 2, 3)
     _run_map(t, tmp_path, client, 1, 2, 3, attempt=0, die_after_push=True)  # dies
     _run_map(t, tmp_path, client, 1, 2, 3, attempt=1)                       # retry
@@ -128,31 +160,31 @@ def test_failed_attempt_is_ignored(tmp_path):
                - pa.compute.sum(t["v"]).as_py()) < 1e-9
 
 
-def test_idempotent_repush(tmp_path):
+def test_idempotent_repush(tmp_path, make_client):
     """A task retried WITH THE SAME attempt id (speculative duplicate)
     re-pushes identical frames; rename-idempotence collapses them."""
     t = _table(n=2000)
-    client = RssPushClient(str(tmp_path), "s3", num_maps=1, num_reduces=2)
+    client = make_client("s3", num_maps=1, num_reduces=2)
     _run_map(t, tmp_path, client, 0, 1, 2, attempt=0, die_after_push=True)
     _run_map(t, tmp_path, client, 0, 1, 2, attempt=0)  # same attempt, full rerun
     got = _reduce_all(t, client, 2)
     assert got.num_rows == t.num_rows
 
 
-def test_missing_map_times_out(tmp_path):
+def test_missing_map_times_out(tmp_path, make_client):
     t = _table(n=100)
-    client = RssPushClient(str(tmp_path), "s4", num_maps=2, num_reduces=1)
+    client = make_client("s4", num_maps=2, num_reduces=1)
     _run_map(t, tmp_path, client, 0, 2, 1)
     with pytest.raises(TimeoutError, match="never committed"):
         client.wait_for_maps(timeout_s=0.3)
 
 
-def test_lost_push_detected(tmp_path):
+def test_lost_push_detected(tmp_path, make_client):
     """A committed manifest whose frames vanished (worker data loss)
     must fail loudly, not return partial data."""
     import glob, os
     t = _table(n=3000)
-    client = RssPushClient(str(tmp_path), "s5", num_maps=1, num_reduces=2)
+    client = make_client("s5", num_maps=1, num_reduces=2)
     _run_map(t, tmp_path, client, 0, 1, 2)
     victims = glob.glob(os.path.join(client.root, "part-0", "*.push"))
     assert victims
@@ -161,11 +193,11 @@ def test_lost_push_detected(tmp_path):
         client.reader_blocks(0, timeout_s=1.0)
 
 
-def test_crashed_run_leftover_frames_tolerated(tmp_path):
+def test_crashed_run_leftover_frames_tolerated(tmp_path, make_client):
     """A crashed run of the SAME attempt left higher-seq frames the
     committed retry never re-pushed; those are garbage, not lost pushes
     — the committed prefix must read cleanly."""
-    client = RssPushClient(str(tmp_path), "s6", num_maps=1, num_reduces=1)
+    client = make_client("s6", num_maps=1, num_reduces=1)
     # crashed run pushed 3 frames, no commit
     for seq in range(3):
         client._push(0, 0, 0, seq, b"frame%d" % seq)
@@ -175,13 +207,13 @@ def test_crashed_run_leftover_frames_tolerated(tmp_path):
     assert blocks == [b"frame0", b"frame1"]
 
 
-def _race_two_attempts(tmp_path, tag, use_hardlinks):
+def _race_two_attempts(make_client, tag, use_hardlinks):
     """Two DISTINCT attempts of map 0 push different payloads and both
     reach the commit point (the forced loser-commit-race shape).  The
     first committer must win, the second must be rejected, and readers
     must see exactly the winner's frames."""
-    client = RssPushClient(str(tmp_path), tag, num_maps=1, num_reduces=1,
-                           use_hardlinks=use_hardlinks)
+    client = make_client(tag, num_maps=1, num_reduces=1,
+                         use_hardlinks=use_hardlinks)
     client._push(0, 0, 0, 0, b"attempt0-frame")
     client._push(0, 1, 0, 0, b"attempt1-frame")
     assert client._commit(0, 0, {0: 1}) is True
@@ -194,14 +226,14 @@ def _race_two_attempts(tmp_path, tag, use_hardlinks):
     assert blocks == [b"attempt0-frame"]  # loser frames ignored
 
 
-def test_distinct_attempt_first_wins_hardlink(tmp_path):
-    _race_two_attempts(tmp_path, "race-hl", use_hardlinks=True)
+def test_distinct_attempt_first_wins_hardlink(make_client):
+    _race_two_attempts(make_client, "race-hl", use_hardlinks=True)
 
 
-def test_distinct_attempt_first_wins_no_hardlink(tmp_path):
+def test_distinct_attempt_first_wins_no_hardlink(tmp_path, make_client):
     """The FUSE/object-store fallback must arbitrate via the O_EXCL
     claim file, not last-wins os.replace."""
-    _race_two_attempts(tmp_path, "race-claim", use_hardlinks=False)
+    _race_two_attempts(make_client, "race-claim", use_hardlinks=False)
     # the claim file names the winner
     import os
     claim = os.path.join(str(tmp_path), "rss-race-claim",

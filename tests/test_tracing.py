@@ -467,5 +467,6 @@ def test_span_registry_pin():
         "stream_recovery", "flight_dump",
         "aqe_rewrite", "aqe_history_seed",
         "result_cache_hit", "subplan_cache_hit",
+        "fleet_replica_down", "fleet_replica_up",
     }
     assert all(doc.strip() for doc in tracing.SPAN_NAMES.values())

@@ -67,6 +67,22 @@ if [ "${CI_MULTICHIP_FAST:-1}" = "1" ]; then
         python bench.py --multichip
 fi
 
+# Fast fleet smoke (CI_FLEET_FAST=0 to skip): the fleet test module
+# plus a reduced --fleet run — a 2-replica loopback fleet over the
+# shared socket RSS tier with one seeded mid-run SIGKILL.  Self-gating:
+# bench --fleet exits nonzero on any lost query, divergent result,
+# duplicate committed block, or a per-replica history rollup that does
+# not sum to the completed total.  Not sentinel-compared (the reduced
+# artifact carries fewer queries than the committed BENCH_FLEET
+# baseline).
+if [ "${CI_FLEET_FAST:-1}" = "1" ]; then
+    echo "== ci_check: fleet tests =="
+    python -m pytest tests/test_fleet.py -q -p no:cacheprovider
+    echo "== ci_check: bench --fleet --fast (kill-replica smoke) =="
+    env "BLAZE_BENCH_FLEET_PATH=$WORK/BENCH_FLEET_FAST.json" \
+        python bench.py --fleet --fast
+fi
+
 fail=0
 for leg in $LEGS; do
     name="$(echo "${leg#--}" | tr '[:lower:]' '[:upper:]')"
