@@ -4357,6 +4357,254 @@ def aqe_bench_main() -> int:
 
 
 # ===========================================================================
+# --encodings: strings/decimals on the device lanes (ISSUE 20)
+# ===========================================================================
+
+def encodings_bench_main() -> int:
+    """Encoding-lane gate (`--encodings`): the two workloads the old
+    type gates evicted to the host — a string-keyed group-by and a
+    decimal aggregation — run with the ISSUE 20 encoding lanes OFF
+    (seed behaviour: utf8 keys reject the stage loop, decimal columns
+    reject the device exchange) and ON (dictionary codes fold on the
+    int lanes, decimals ride the mesh as their unscaled int64s).
+
+    Asserts and records per leg:
+      * bit-identical frames between the legs (the encodings are
+        representational, never semantic);
+      * placement flips from host to device-loop / device-exchange
+        (`stage_loop_tasks` / `shuffle_device_exchanges` engagement
+        with zero fallbacks);
+      * the host-lane eviction fraction before/after — the per-column
+        `host_evictions_*` counters over total placement decisions.
+
+    ``--fast`` is the CI smoke: smaller corpus, 1 iteration, same
+    gates.  Writes BENCH_ENCODINGS.json (env override
+    BLAZE_BENCH_ENCODINGS_PATH) and prints it as one JSON line."""
+    if os.environ.get("BLAZE_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["BLAZE_BENCH_PLATFORM"])
+    import shutil
+    import tempfile
+    from decimal import Decimal
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu import config
+    from blaze_tpu.bridge import xla_stats
+    from blaze_tpu.itest.runner import compare_frames
+    from blaze_tpu.memory import MemManager
+    from blaze_tpu.plan.stages import DagScheduler
+
+    fast = "--fast" in sys.argv
+    n_rows = int(os.environ.get("BLAZE_BENCH_ENCODINGS_ROWS",
+                                "20000" if fast else "120000"))
+    iters = int(os.environ.get("BLAZE_BENCH_ENCODINGS_ITERS",
+                               "1" if fast else "3"))
+    n_maps, n_reduces = 2, 3
+
+    MemManager.init(4 << 30)
+    knobs = {config.DAG_SINGLE_TASK_BYTES.key: 0,
+             config.STAGE_DEVICE_LOOP_ENABLE.key: "on",
+             config.SHUFFLE_DEVICE.key: "on"}
+    for k, v in knobs.items():
+        config.conf.set(k, v)
+
+    enc_on = {config.ENCODING_DICT_ENABLE.key: True,
+              config.ENCODING_DECIMAL_ENABLE.key: True}
+
+    def write_splits(root, name, t):
+        paths = []
+        per = -(-t.num_rows // n_maps)
+        for i in range(n_maps):
+            p = os.path.join(root, f"{name}-{i}.parquet")
+            pq.write_table(t.slice(i * per, per), p)
+            paths.append([p])
+        return paths
+
+    def two_stage(groups, schema, fn="sum"):
+        return {
+            "kind": "hash_agg",
+            "groupings": [{"expr": {"kind": "column", "index": 0},
+                           "name": "k"}],
+            "aggs": [{"fn": fn, "mode": "final", "name": "s",
+                      "args": [{"kind": "column", "index": 1}]}],
+            "input": {
+                "kind": "local_exchange",
+                "partitioning": {"kind": "hash",
+                                 "exprs": [{"kind": "column",
+                                            "index": 0}],
+                                 "num_partitions": n_reduces},
+                "input": {
+                    "kind": "hash_agg",
+                    "groupings": [{"expr": {"kind": "column",
+                                            "name": "k"}, "name": "k"}],
+                    "aggs": [{"fn": fn, "mode": "partial", "name": "s",
+                              "args": [{"kind": "column",
+                                        "name": "v"}]}],
+                    "input": groups}}}
+
+    def string_plan(root):
+        rng = np.random.default_rng(29)
+        # multi-byte utf8 + empty string + NULLs in the key domain
+        domain = ([f"sku-{i:04d}" for i in range(200)]
+                  + ["", "véhicule", "北京市", "zäh-🚀"])
+        idx = rng.integers(0, len(domain), n_rows)
+        keys = [domain[i] if rng.random() > 0.05 else None
+                for i in idx]
+        t = pa.table({"k": pa.array(keys, type=pa.string()),
+                      "v": pa.array(rng.random(n_rows))})
+        schema = {"fields": [
+            {"name": "k", "type": {"id": "utf8"}, "nullable": True},
+            {"name": "v", "type": {"id": "float64"},
+             "nullable": True}]}
+        scan = {"kind": "parquet_scan", "schema": schema,
+                "file_groups": write_splits(root, "str", t)}
+        return two_stage(scan, schema)
+
+    def decimal_plan(root):
+        rng = np.random.default_rng(31)
+        keys = rng.integers(0, 300, n_rows)
+        vals = [Decimal(int(rng.integers(-10**7, 10**7))).scaleb(-2)
+                if rng.random() > 0.08 else None
+                for _ in range(n_rows)]
+        t = pa.table({"k": pa.array(keys, type=pa.int64()),
+                      "v": pa.array(vals, type=pa.decimal128(12, 2))})
+        schema = {"fields": [
+            {"name": "k", "type": {"id": "int64"}, "nullable": True},
+            {"name": "v", "type": {"id": "decimal", "precision": 12,
+                                   "scale": 2}, "nullable": True}]}
+        scan = {"kind": "parquet_scan", "schema": schema,
+                "file_groups": write_splits(root, "dec", t)}
+        return two_stage(scan, schema)
+
+    def frame(tbl):
+        import pandas as pd
+        df = (tbl.to_pandas() if tbl.num_rows else pd.DataFrame(
+            {n: [] for n in tbl.schema.names}))
+        if len(df):
+            df = df.sort_values(df.columns[0], na_position="first")
+        return df.reset_index(drop=True)
+
+    def eviction_fraction(d):
+        """Host-lane evictions over total placement decisions in the
+        counter delta: what fraction of device-lane opportunities the
+        type gates turned away."""
+        ev = (int(d.get("host_evictions_string", 0))
+              + int(d.get("host_evictions_decimal", 0))
+              + int(d.get("host_evictions_other", 0)))
+        kept = (int(d.get("stage_loop_tasks", 0))
+                + int(d.get("shuffle_device_exchanges", 0)))
+        total = ev + kept
+        return round(ev / total, 4) if total else None
+
+    def run_leg(root, tag, plan, conf):
+        for k, v in conf.items():
+            config.conf.set(k, v)
+        try:
+            # warm outside the clock (compiles, parquet page cache)
+            DagScheduler(work_dir=os.path.join(
+                root, f"{tag}-warm")).run_collect(plan)
+            xla_stats.reset()
+            walls, tbl = [], None
+            before = xla_stats.snapshot()
+            for it in range(iters):
+                t0 = time.perf_counter()
+                tbl = DagScheduler(work_dir=os.path.join(
+                    root, f"{tag}-{it}")).run_collect(plan)
+                walls.append(time.perf_counter() - t0)
+            d = xla_stats.delta(before)
+        finally:
+            for k in conf:
+                config.conf.unset(k)
+        loop_tasks = int(d.get("stage_loop_tasks", 0))
+        exchanges = int(d.get("shuffle_device_exchanges", 0))
+        fallbacks = (int(d.get("stage_loop_fallbacks", 0))
+                     + int(d.get("shuffle_device_fallbacks", 0)))
+        if loop_tasks and exchanges:
+            placement = "device-loop"
+        elif loop_tasks or exchanges:
+            placement = "mixed"
+        else:
+            placement = "host"
+        return tbl, {
+            "wall_s": round(float(np.min(walls)), 4),
+            "placement": placement,
+            "stage_loop_tasks": loop_tasks,
+            "device_exchanges": exchanges,
+            "fallbacks": fallbacks,
+            "eviction_fraction": eviction_fraction(d),
+            "counters": {k: int(d[k]) for k in (
+                "dict_encoded_columns", "dict_exchange_remaps",
+                "decimal_scaled_int32_dispatches",
+                "decimal_scaled_int64_dispatches",
+                "decimal_limb_dispatches", "host_evictions_string",
+                "host_evictions_decimal", "host_evictions_other")
+                if d.get(k)},
+        }
+
+    legs = {}
+    diverged = 0
+    root = tempfile.mkdtemp(prefix="encodings-")
+    try:
+        for name, plan in (("string_group_by", string_plan(root)),
+                           ("decimal_agg", decimal_plan(root))):
+            base_tbl, off = run_leg(root, f"{name}-off", plan, {})
+            got_tbl, on = run_leg(root, f"{name}-on", plan, enc_on)
+            err = compare_frames(frame(got_tbl), frame(base_tbl))
+            if err is not None:
+                diverged += 1
+            legs[name] = {
+                "off": off, "on": on, "divergence": err,
+                "speedup": round(off["wall_s"]
+                                 / max(on["wall_s"], 1e-9), 3),
+            }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+        for k in knobs:
+            config.conf.unset(k)
+
+    s, dml = legs["string_group_by"], legs["decimal_agg"]
+    rec = {
+        "metric": "encodings_device_placement_legs",
+        "value": sum(1 for leg in legs.values()
+                     if leg["on"]["placement"] != "host"
+                     and leg["on"]["fallbacks"] == 0),
+        "unit": "legs device-resident (of 2)",
+        "rows": n_rows, "iters": iters, "fast": fast,
+        "divergent_queries": diverged,
+        "eviction_fraction_before": {
+            n: legs[n]["off"]["eviction_fraction"] for n in legs},
+        "eviction_fraction_after": {
+            n: legs[n]["on"]["eviction_fraction"] for n in legs},
+        "legs": legs,
+    }
+    path = os.environ.get(
+        "BLAZE_BENCH_ENCODINGS_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_ENCODINGS.json"))
+    _write_bench(path, rec)
+    print(json.dumps(rec, default=str))
+    sys.stdout.flush()
+
+    def _frac_drops(name):
+        b = legs[name]["off"]["eviction_fraction"]
+        a = legs[name]["on"]["eviction_fraction"]
+        return b is not None and (a is None or a < b)
+
+    ok = (diverged == 0 and rec["value"] == 2
+          and s["on"]["stage_loop_tasks"] > 0
+          and s["off"]["stage_loop_tasks"] == 0
+          and dml["on"]["device_exchanges"] > 0
+          and dml["off"]["device_exchanges"] == 0
+          and _frac_drops("string_group_by")
+          and _frac_drops("decimal_agg"))
+    return 0 if ok else 1
+
+
+# ===========================================================================
 # --fleet: replicated-serving kill-replica soak (ISSUE 19)
 # ===========================================================================
 
@@ -4715,6 +4963,8 @@ def main():
         sys.exit(obs_bench_main())
     if "--aqe" in sys.argv:
         sys.exit(aqe_bench_main())
+    if "--encodings" in sys.argv:
+        sys.exit(encodings_bench_main())
     if "--fleet" in sys.argv:
         sys.exit(fleet_bench_main())
     if "--sentinel" in sys.argv:

@@ -135,6 +135,28 @@ def _arrow_fixed_values(arr: pa.Array, dtype: DataType) -> np.ndarray:
     return vals[arr.offset:arr.offset + len(arr)]
 
 
+def decimal_from_unscaled(values: np.ndarray, valid: Optional[np.ndarray],
+                          t: pa.DataType) -> pa.Array:
+    """Unscaled int64/int32 values -> decimal128 arrow array WITHOUT an
+    arrow cast (a cast would rescale; the ints already ARE the scaled
+    representation).  Builds the 16-byte little-endian limbs directly:
+    vectorized, unlike a per-value python-Decimal loop."""
+    v = np.ascontiguousarray(values).astype(np.int64, copy=False)
+    limbs = np.empty((len(v), 2), dtype=np.int64)
+    limbs[:, 0] = v        # low limb (little-endian int128)
+    limbs[:, 1] = v >> 63  # arithmetic shift: sign extension
+    data_buf = pa.py_buffer(limbs.tobytes())
+    if valid is None or bool(np.asarray(valid).all()):
+        validity_buf, null_count = None, 0
+    else:
+        valid = np.asarray(valid, dtype=bool)
+        bits = np.packbits(valid.astype(np.uint8), bitorder="little")
+        validity_buf = pa.py_buffer(bits.tobytes())
+        null_count = int((~valid).sum())
+    return pa.Array.from_buffers(t, len(v), [validity_buf, data_buf],
+                                 null_count=null_count)
+
+
 @dataclass
 class DeviceColumn:
     """Fixed-width column resident on device: padded data + validity."""
@@ -158,6 +180,8 @@ class DeviceColumn:
         n = len(values)
         assert capacity >= n
         np_dtype = dtype.np_dtype()
+        if dtype.id == TypeId.DECIMAL and values.dtype == np.int32:
+            np_dtype = np.int32  # scaled-int32 tier (encoding.decimal.int32)
         data = np.zeros(capacity, dtype=np_dtype)
         data[:n] = values
         v = np.zeros(capacity, dtype=bool)
@@ -174,14 +198,26 @@ class DeviceColumn:
         arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
         values = _arrow_fixed_values(arr, dtype)
         valid = _unpack_validity(arr)
+        store = dtype.np_dtype()
+        if dtype.id == TypeId.DECIMAL and config.ENCODING_DECIMAL_ENABLE.get():
+            from blaze_tpu.bridge import xla_stats
+            if dtype.precision <= 9 and config.ENCODING_DECIMAL_INT32.get():
+                # the narrow scaled-int tier: p<=9 unscaled values fit
+                # int32, and the single add/sub the device lanes apply
+                # before widening cannot overflow it
+                store = np.int32
+                xla_stats.note_encoding(decimal_scaled_int32_dispatches=1)
+            else:
+                xla_stats.note_encoding(decimal_scaled_int64_dispatches=1)
         if capacity == len(arr) and _host_resident():
             # zero-copy: numpy views over the Arrow buffers (host-resident
             # batches are unpadded, and nothing mutates column data in
             # place)
             return DeviceColumn(dtype,
-                                values.astype(dtype.np_dtype(), copy=False),
+                                values.astype(store, copy=False),
                                 valid)
-        return DeviceColumn.from_numpy(values, valid, dtype, capacity,
+        return DeviceColumn.from_numpy(values.astype(store, copy=False),
+                                       valid, dtype, capacity,
                                        stage_host=stage_host)
 
     def to_arrow(self, num_rows: int, selection: Optional[np.ndarray] = None,
@@ -202,15 +238,7 @@ class DeviceColumn:
         mask = None if valid.all() else ~valid  # no nulls -> zero-copy
         at = self.dtype.to_arrow()
         if self.dtype.id == TypeId.DECIMAL:
-            # unscaled int64 -> decimal128 via arrow cast of the raw integers,
-            # then reinterpret scale (arrow cast would rescale, so build
-            # decimal from pieces instead)
-            import decimal as pydec
-            scale = self.dtype.scale
-            null = np.zeros(len(values), bool) if mask is None else mask
-            py = [None if m else pydec.Decimal(int(v)).scaleb(-scale)
-                  for v, m in zip(values.tolist(), null.tolist())]
-            return pa.array(py, type=at)
+            return decimal_from_unscaled(values, valid, at)
         if self.dtype.id == TypeId.BOOL:
             return pa.array(values.astype(bool), type=at, mask=mask)
         return pa.array(values, type=at, mask=mask)
@@ -221,6 +249,81 @@ class DeviceColumn:
         valid = asnp(self.validity)[indices]
         return DeviceColumn.from_numpy(values, valid, self.dtype,
                                        bucket_capacity(len(indices)))
+
+
+@dataclass
+class DictColumn(DeviceColumn):
+    """utf8 column dictionary-encoded for the device lanes: `data` holds
+    int32 codes into `dictionary` (a host pa.Array of utf8 values, no
+    null entries), `validity` marks nulls (code 0 at null positions).
+    The LOGICAL dtype stays UTF8 and `to_arrow`/`take_host` decode back
+    to plain strings, so every generic consumer (sort, joins, shuffle,
+    materialization) stays correct without knowing about the encoding —
+    only the opt-in fast paths (expr programs, stage loop, hash kernels)
+    look at the codes."""
+
+    dictionary: pa.Array = None
+
+    @staticmethod
+    def from_codes(codes: np.ndarray, valid: Optional[np.ndarray],
+                   dtype: DataType, capacity: int, dictionary: pa.Array,
+                   stage_host: bool = False) -> "DictColumn":
+        n = len(codes)
+        assert capacity >= n
+        data = np.zeros(capacity, dtype=np.int32)
+        data[:n] = codes
+        v = np.zeros(capacity, dtype=bool)
+        v[:n] = True if valid is None else valid
+        if stage_host or _host_resident():
+            return DictColumn(dtype, data, v, dictionary=dictionary)
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_h2d(data.nbytes + v.nbytes)
+        return DictColumn(dtype, jnp.asarray(data), jnp.asarray(v),
+                          dictionary=dictionary)
+
+    @staticmethod
+    def from_arrow_dict(arr: pa.DictionaryArray, dtype: DataType,
+                        capacity: int,
+                        stage_host: bool = False) -> "DictColumn":
+        arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+        valid = _unpack_validity(arr)
+        codes = np.asarray(arr.indices.cast(pa.int32()).fill_null(0))
+        d = arr.dictionary
+        if isinstance(d, pa.ChunkedArray):
+            d = d.combine_chunks()
+        if not pa.types.is_string(d.type):
+            d = d.cast(pa.string())
+        if d.null_count:
+            # codes pointing at a null dictionary entry are logically
+            # null rows (the scan encoder never emits null entries, but
+            # external dictionary arrays may)
+            valid = valid & _unpack_validity(d)[codes]
+        return DictColumn.from_codes(codes, valid, dtype, capacity, d,
+                                     stage_host=stage_host)
+
+    def to_arrow(self, num_rows: int, selection: Optional[np.ndarray] = None,
+                 prefetched: Optional[tuple] = None) -> pa.Array:
+        """Decode codes back to plain utf8 (host materialization)."""
+        if prefetched is not None:
+            codes, valid = prefetched
+            codes = codes[:num_rows]
+            valid = valid[:num_rows]
+        else:
+            codes = asnp(self.data)[:num_rows]
+            valid = asnp(self.validity)[:num_rows]
+        if selection is not None:
+            codes = codes[selection[:num_rows]]
+            valid = valid[selection[:num_rows]]
+        idx = pa.array(codes.astype(np.int64),
+                       mask=None if valid.all() else ~valid)
+        return self.dictionary.take(idx).cast(self.dtype.to_arrow())
+
+    def take_host(self, indices: np.ndarray) -> "DictColumn":
+        codes = asnp(self.data)[indices]
+        valid = asnp(self.validity)[indices]
+        return DictColumn.from_codes(codes, valid, self.dtype,
+                                     bucket_capacity(len(indices)),
+                                     self.dictionary)
 
 
 @dataclass
@@ -282,7 +385,11 @@ class ColumnBatch:
             cap = bucket_capacity(n)
         cols: List[Column] = []
         for arr, f in zip(arrays, schema):
-            if f.data_type.is_fixed_width:
+            if pa.types.is_dictionary(arr.type) \
+                    and f.data_type.id == TypeId.UTF8:
+                cols.append(DictColumn.from_arrow_dict(
+                    arr, f.data_type, cap, stage_host=True))
+            elif f.data_type.is_fixed_width:
                 cols.append(DeviceColumn.from_arrow(arr, f.data_type, cap,
                                                     stage_host=True))
             else:
@@ -390,8 +497,10 @@ class ColumnBatch:
         xla_stats.note_h2d(sum(b.nbytes for b in bufs))
         cols = list(self.columns)
         for j, i in enumerate(idx):
-            c = cols[i]
-            cols[i] = DeviceColumn(c.dtype, placed[2 * j], placed[2 * j + 1])
+            # replace() preserves the column subclass (DictColumn keeps
+            # its dictionary across placement)
+            cols[i] = replace(cols[i], data=placed[2 * j],
+                              validity=placed[2 * j + 1])
         return replace(self, columns=cols)
 
     # -- transformations ----------------------------------------------------
@@ -422,8 +531,8 @@ class ColumnBatch:
             return ColumnBatch(self.schema, cols, len(indices), None)
         mask = self.row_mask()
         perm = jnp.argsort(~mask, stable=True)  # selected first, in order
-        cols = [DeviceColumn(c.dtype, jnp.take(c.data, perm),
-                             jnp.take(c.validity, perm))
+        cols = [replace(c, data=jnp.take(c.data, perm),
+                        validity=jnp.take(c.validity, perm))
                 for c in self.columns]
         return ColumnBatch(self.schema, cols, count, None)
 
@@ -495,6 +604,17 @@ class ColumnBatch:
                     vals = xp.pad(vals, (0, pad))
                     valid = xp.pad(valid, (0, pad))
                 cols.append(DeviceColumn(f.data_type, vals, valid))
+            elif all(isinstance(b.columns[i], DictColumn) for b in batches):
+                cols.append(_concat_dict_columns(
+                    [(b.columns[i], b.num_rows) for b in batches],
+                    f.data_type, cap))
+            elif any(isinstance(b.columns[i], DictColumn) for b in batches):
+                # mixed encoded/plain (encoder hit its cardinality cap
+                # mid-stream): decode losslessly to a host column
+                arrs = [b.columns[i].to_arrow(b.num_rows) for b in batches]
+                combined = pa.concat_arrays(
+                    [a.cast(f.data_type.to_arrow()) for a in arrs])
+                cols.append(HostColumn(f.data_type, combined))
             else:
                 arrs = [b.columns[i].array for b in batches]
                 combined = pa.concat_arrays([a.cast(f.data_type.to_arrow()) for a in arrs])
@@ -511,3 +631,45 @@ class ColumnBatch:
     def __repr__(self):
         return (f"ColumnBatch(rows={self.num_rows}, cap={self.capacity}, "
                 f"cols={[f.name for f in self.schema]})")
+
+
+def _concat_dict_columns(parts, dtype: DataType, cap: int) -> DictColumn:
+    """Concatenate dict-encoded columns by unifying their dictionaries:
+    codes remap onto a merged first-seen dictionary (merge order = batch
+    order, so cross-partition unification is deterministic).  The common
+    case — one stream's incremental encoder, where each batch's
+    dictionary is a prefix of the next — costs zero remaps."""
+    import pyarrow.compute as pc
+    merged = None
+    datas, valids = [], []
+    remaps = 0
+    for c, n in parts:
+        codes = asnp(c.data)[:n].astype(np.int64)
+        valid = asnp(c.validity)[:n]
+        d = c.dictionary
+        if merged is None or d is merged or merged.equals(d):
+            merged = d
+        elif len(d) >= len(merged) and d.slice(0, len(merged)).equals(merged):
+            # incremental-encoder prefix growth: old codes stay valid
+            merged = d
+        else:
+            pos = pc.index_in(d, value_set=merged)
+            missing = np.asarray(pc.is_null(pos))
+            remap = np.asarray(pos.fill_null(0)).astype(np.int64)
+            if missing.any():
+                base = len(merged)
+                merged = pa.concat_arrays(
+                    [merged, d.filter(pa.array(missing))])
+                remap[missing] = base + np.cumsum(missing)[missing] - 1
+            codes = remap[codes]
+            remaps += 1
+        datas.append(codes)
+        valids.append(valid)
+    if remaps:
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_encoding(dict_exchange_remaps=remaps)
+    return DictColumn.from_codes(
+        np.concatenate(datas) if datas else np.zeros(0, np.int64),
+        np.concatenate(valids) if valids else np.zeros(0, bool),
+        dtype, cap, merged if merged is not None
+        else pa.array([], type=pa.string()))

@@ -197,6 +197,20 @@ _aqe = {"aqe_rewrites": 0, "aqe_broadcast_switches": 0,
         "aqe_history_seeds": 0, "aqe_bytes_saved": 0,
         "aqe_stages_elided": 0}
 
+# Encoding lanes (config.ENCODING_*): utf8 columns dictionary-encoded
+# at scan decode, cross-batch dictionary-unify remaps at concat/exchange
+# boundaries, decimal dispatches split by storage tier (scaled int32 /
+# scaled int64 / two-limb int128), and host-lane evictions split by the
+# column dtype that caused them — the per-column accounting the advisor
+# and BENCH_* compute_placement read instead of the old whole-stage
+# "string somewhere -> host" verdict.
+_encoding = {"dict_encoded_columns": 0, "dict_exchange_remaps": 0,
+             "decimal_scaled_int32_dispatches": 0,
+             "decimal_scaled_int64_dispatches": 0,
+             "decimal_limb_dispatches": 0,
+             "host_evictions_string": 0, "host_evictions_decimal": 0,
+             "host_evictions_other": 0}
+
 # Fleet-scope serving (blaze_tpu/fleet/): queries routed by the
 # fingerprint-affine router, affinity hits (query landed on its
 # rendezvous first choice — the replica whose result/subplan cache is
@@ -555,6 +569,25 @@ def aqe_stats() -> dict:
         return dict(_aqe)
 
 
+def note_encoding(**deltas: int) -> None:
+    """Encoding-plane mutator (dict/decimal device lanes): kwargs name
+    `_encoding` keys exactly; gauges (`*_last`) are set absolutely,
+    counters are incremented (the note_stats contract)."""
+    with _lock:
+        for key, v in deltas.items():
+            if key not in _encoding:
+                continue
+            if key.endswith("_last"):
+                _encoding[key] = int(v)
+            else:
+                _encoding[key] += int(v)
+
+
+def encoding_stats() -> dict:
+    with _lock:
+        return dict(_encoding)
+
+
 def note_fleet(**deltas: int) -> None:
     """Fleet-plane mutator: kwargs name `_fleet` keys with or without
     the `fleet_` prefix; gauges (`*_last`) are set absolutely, counters
@@ -894,6 +927,7 @@ def counter_families() -> Dict[str, Dict[str, int]]:
             "cache": dict(_cache),
             "stats": dict(_stats),
             "aqe": dict(_aqe),
+            "encoding": dict(_encoding),
             "fleet": dict(_fleet),
         }
 
@@ -922,6 +956,7 @@ def snapshot() -> dict:
     flat.update(cache_stats())
     flat.update(statstore_stats())
     flat.update(aqe_stats())
+    flat.update(encoding_stats())
     flat.update(fleet_stats())
     flat.update({f"total_{k}": v for k, v in rep["totals"].items()})
     return flat
@@ -966,6 +1001,8 @@ def reset() -> None:
             _stats[k] = 0
         for k in _aqe:
             _aqe[k] = 0
+        for k in _encoding:
+            _encoding[k] = 0
         for k in _fleet:
             _fleet[k] = 0
         _task_duration_ns.clear()

@@ -383,6 +383,40 @@ FUSED_DICT_DEVICE_MAX_SLOTS = int_conf(
     "auron.tpu.fused.dictDevice.maxSlots", 1 << 22,
     "Dense code-table ceiling for the dict-device strategy; growth "
     "past it falls back to the host-vectorized aggregation.")
+ENCODING_DICT_ENABLE = bool_conf(
+    "auron.tpu.encoding.dict.enable", False,
+    "Dictionary-encode utf8 columns at scan decode: the device lanes "
+    "see only the int32 code column, so group-by/join keys, equality "
+    "filters and IN-list predicates ride the existing int lanes "
+    "(expr programs, device stage loop, hash kernels); strings decode "
+    "back to utf8 only at host materialization.  Operations the codes "
+    "cannot answer (substring, LIKE, concat) fall back eager per "
+    "EXPRESSION, not per stage.  Off by default; the disabled path is "
+    "byte-identical to pre-encoding behavior.", category="encoding")
+ENCODING_DICT_MAX_ENTRIES = int_conf(
+    "auron.tpu.encoding.dict.maxEntries", 1 << 16,
+    "Per-column dictionary cardinality ceiling for scan-side string "
+    "encoding.  A column whose running per-stream dictionary would "
+    "exceed it stops encoding for the remainder of that stream (later "
+    "batches stay plain utf8; downstream consumers decode losslessly).",
+    category="encoding")
+ENCODING_DECIMAL_ENABLE = bool_conf(
+    "auron.tpu.encoding.decimal.enable", False,
+    "Lower decimal128 columns as scaled-integer arithmetic on the "
+    "device lanes: precisions <= 18 run as scaled int64 (or int32, see "
+    "encoding.decimal.int32) through expr programs, the stage loop and "
+    "DeviceExchange; unequal-scale comparisons rescale through the "
+    "two-limb int128 kernels (kernels/decimal128.py).  Overflow "
+    "promotes to the eager host path — never silently wraps.  Results "
+    "are bit-identical to host Arrow decimal arithmetic, ANSI and "
+    "non-ANSI.  Off by default.", category="encoding")
+ENCODING_DECIMAL_INT32 = bool_conf(
+    "auron.tpu.encoding.decimal.int32", True,
+    "With encoding.decimal.enable, store decimals of precision <= 9 as "
+    "scaled int32 on device (TPU v5e emulates 64-bit integer ops ~10x "
+    "slower, so the narrowest exact width wins).  A single add/sub of "
+    "two p<=9 operands cannot exceed int32 range; results widen to the "
+    "declared int64 output dtype.", category="encoding")
 COMPILE_CACHE_DIR = str_conf(
     "auron.tpu.compile.cache.dir", "~/.cache/blaze_tpu/xla",
     "Persistent XLA compilation cache directory (jax_compilation_cache_"

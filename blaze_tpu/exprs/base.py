@@ -33,6 +33,10 @@ class ColVal:
     validity: Optional[jax.Array] = None  # (capacity,) bool when device-form
     array: Optional[pa.Array] = None      # num_rows-long when host-form
     literal: bool = False                 # evaluated from a Literal expr
+    # dictionary-encoded utf8 (batch.DictColumn): `data` holds int32
+    # codes into this host value array; to_host decodes, so eager host
+    # expressions stay correct per-expression without knowing about it
+    dictionary: Optional[pa.Array] = None
 
     @property
     def is_device(self) -> bool:
@@ -52,6 +56,10 @@ class ColVal:
 
     @staticmethod
     def from_column(col, capacity: int) -> "ColVal":
+        from blaze_tpu.batch import DictColumn
+        if isinstance(col, DictColumn):
+            return ColVal(col.dtype, data=col.data, validity=col.validity,
+                          dictionary=col.dictionary)
         if isinstance(col, DeviceColumn):
             return ColVal(col.dtype, data=col.data, validity=col.validity)
         return ColVal(col.dtype, array=col.array)
@@ -61,6 +69,10 @@ class ColVal:
         """Materialize as an Arrow array of num_rows (device sync)."""
         if self.array is not None:
             return self.array.slice(0, num_rows)
+        if self.dictionary is not None:
+            from blaze_tpu.batch import DictColumn
+            return DictColumn(self.dtype, self.data, self.validity,
+                              dictionary=self.dictionary).to_arrow(num_rows)
         return DeviceColumn(self.dtype, self.data, self.validity).to_arrow(num_rows)
 
     def to_device(self, capacity: int) -> "ColVal":
@@ -71,6 +83,10 @@ class ColVal:
         return ColVal(self.dtype, data=dc.data, validity=dc.validity)
 
     def to_column(self, capacity: int):
+        if self.dictionary is not None and self.is_device:
+            from blaze_tpu.batch import DictColumn
+            return DictColumn(self.dtype, self.data, self.validity,
+                              dictionary=self.dictionary)
         if self.is_device:
             return DeviceColumn(self.dtype, self.data, self.validity)
         if self.dtype.is_fixed_width:

@@ -110,6 +110,16 @@ class BinaryExpr(PhysicalExpr):
         b = self.right.evaluate(batch)
         lt, rt = self._child_types(batch.schema)
         dec = self._decimal_types(lt, rt)
+        if dec is not None and self.op in _CMP \
+                and a.is_device and b.is_device \
+                and not self._decimal_device_ok(*dec) \
+                and self._decimal_limb_ok(*dec):
+            # unequal-scale comparison within p<=18: rescale through the
+            # two-limb int128 kernels — exact (no rounding, no overflow
+            # semantics needed for compares), and traceable, so these
+            # predicates keep their stage device-resident
+            from blaze_tpu.kernels import decimal128 as d128
+            return d128.compare_colvals(self.op, a, b, dec[0], dec[1])
         if dec is not None and not (self._decimal_device_ok(*dec)
                                     and a.is_device and b.is_device):
             # exact Spark decimal semantics (scale alignment, result
@@ -119,6 +129,15 @@ class BinaryExpr(PhysicalExpr):
             # into _evaluate_host, which has no arithmetic
             from blaze_tpu.exprs import decimal_arith as D
             return D.evaluate(self.op, a, b, dec[0], dec[1], batch)
+        if a.dictionary is not None or b.dictionary is not None:
+            # dict-encoded utf8 operands: the generic device paths below
+            # would compare raw CODES (meaningless across dictionaries) —
+            # equality answers on codes when dictionaries line up,
+            # everything else decodes per-expression
+            dv = self._evaluate_dict(batch, a, b)
+            if dv is not None:
+                return dv
+            return self._evaluate_host(batch, a, b)
         if not a.is_device or not b.is_device:
             return self._evaluate_host(batch, a, b)
         if self.op in _BOOLEAN:
@@ -197,6 +216,66 @@ class BinaryExpr(PhysicalExpr):
             return True
         return self.op in ("+", "-") and \
             max(ldt.precision, rdt.precision) + 1 <= 18
+
+    def _decimal_limb_ok(self, ldt: DataType, rdt: DataType) -> bool:
+        """Unequal-scale comparisons stay on device through the two-limb
+        int128 rescale when both operands fit the int64 unscaled form and
+        the rescale multiplier keeps products inside int128 (10^18 *
+        10^20 < 2^127)."""
+        from blaze_tpu import config
+        if not config.ENCODING_DECIMAL_ENABLE.get():
+            return False
+        if max(ldt.precision, rdt.precision) > 18:
+            return False
+        return abs(ldt.scale - rdt.scale) <= 20
+
+    def _evaluate_dict(self, batch: ColumnBatch, a: ColVal,
+                       b: ColVal) -> Optional[ColVal]:
+        """Equality family over dict-encoded codes, or None to decode.
+        Codes are first-seen order, so ONLY (in)equality is answerable
+        on them; ordering comparisons decode."""
+        if self.op not in ("==", "!=", "<=>"):
+            return None
+        import pyarrow as pa
+        from blaze_tpu.xputil import asnp
+        if a.dictionary is not None and b.dictionary is not None:
+            xp = xp_of(a.data, b.data)
+            if a.dictionary is b.dictionary or \
+                    a.dictionary.equals(b.dictionary):
+                bcodes = b.data
+            else:
+                pos = pc.index_in(b.dictionary, value_set=a.dictionary)
+                remap = np.asarray(pos.fill_null(-1)).astype(np.int64)
+                bcodes = remap[asnp(b.data)] if xp is np \
+                    else jnp.asarray(remap)[b.data]
+            return self._dict_eq(a.data, a.validity, bcodes, b.validity)
+        # dict vs utf8 literal: look the literal up in the dictionary
+        # once — absent literals compare against code -1 (never matches)
+        d_side, o_side = (a, b) if a.dictionary is not None else (b, a)
+        if not (o_side.literal and o_side.array is not None):
+            return None
+        val = o_side.array[0].as_py() if len(o_side.array) else None
+        if val is None:
+            return None  # null literal: host path has the semantics
+        pos = pc.index_in(pa.array([val]), value_set=d_side.dictionary)[0]
+        code = -1 if not pos.is_valid else pos.as_py()
+        xp = xp_of(d_side.data)
+        lit_codes = xp.full(d_side.data.shape[0], code,
+                            dtype=d_side.data.dtype)
+        lit_valid = xp.ones(d_side.data.shape[0], dtype=bool)
+        if d_side is a:
+            return self._dict_eq(a.data, a.validity, lit_codes, lit_valid)
+        return self._dict_eq(lit_codes, lit_valid, b.data, b.validity)
+
+    def _dict_eq(self, ac, av, bc, bv) -> ColVal:
+        xp = xp_of(ac, bc)
+        eq = ac.astype(xp.int64) == bc.astype(xp.int64)
+        if self.op == "<=>":
+            data = (eq & av & bv) | (~av & ~bv)
+            return ColVal.device(BOOL, data)
+        valid = av & bv
+        data = (eq if self.op == "==" else ~eq) & valid
+        return ColVal(BOOL, data=data, validity=valid)
 
     def _evaluate_host(self, batch: ColumnBatch, a: ColVal, b: ColVal) -> ColVal:
         """String/binary comparisons, Kleene and/or over mixed host/device

@@ -204,6 +204,23 @@ class InList(PhysicalExpr):
         v = self.child.evaluate(batch)
         has_null_member = any(x is None for x in self.values)
         members = [x for x in self.values if x is not None]
+        if v.is_device and v.dictionary is not None \
+                and all(isinstance(m, str) for m in members):
+            # dict-encoded utf8 probe: map members to codes through the
+            # dictionary once (absent members can never match) and ride
+            # the int lane below
+            pos = pc.index_in(pa.array(members, type=pa.string()),
+                              value_set=v.dictionary)
+            codes = [p.as_py() for p in pos if p.is_valid]
+            xp = xp_of(v.data)
+            hit = xp.zeros(v.data.shape[0], dtype=bool)
+            for m in codes:
+                hit = hit | (v.data == xp.asarray(m, dtype=v.data.dtype))
+            valid = (v.validity & hit) if has_null_member else v.validity
+            data = hit if not self.negated else ~hit
+            return ColVal(BOOL, data=data & valid, validity=valid)
+        if v.is_device and v.dictionary is not None:
+            v = ColVal.host(v.dtype, v.to_host(batch.num_rows))
         if v.is_device:
             xp = xp_of(v.data)
             hit = xp.zeros(v.data.shape[0], dtype=bool)

@@ -63,6 +63,16 @@ def _dtype_ok(dt: DataType) -> bool:
     return dt.is_fixed_width and dt.id != TypeId.DECIMAL
 
 
+def _ref_dtype_ok(dt: DataType) -> bool:
+    """Operand gate for BoundReference/BinaryExpr positions: with the
+    decimal-encoding knob on, narrow decimals ride the int lanes as
+    scaled integers (the op-level checks below still require exactness
+    — equal-scale device math or the limb rescale for compares)."""
+    if dt.id == TypeId.DECIMAL:
+        return config.ENCODING_DECIMAL_ENABLE.get() and dt.is_fixed_width
+    return _dtype_ok(dt)
+
+
 def is_traceable(expr: PhysicalExpr, schema: Schema) -> bool:
     """True when `expr` evaluates as pure device array math over
     fixed-width columns — i.e. `evaluate` can run under a jit trace."""
@@ -74,15 +84,26 @@ def is_traceable(expr: PhysicalExpr, schema: Schema) -> bool:
 
 def _traceable(e: PhysicalExpr, schema: Schema) -> bool:
     if isinstance(e, BoundReference):
-        return _dtype_ok(schema[e.index].data_type)
+        return _ref_dtype_ok(schema[e.index].data_type)
     if isinstance(e, Literal):
         return _dtype_ok(e.dtype)
     if isinstance(e, BinaryExpr):
         if e.op not in _ARITH and e.op not in _CMP and e.op not in _BOOLEAN:
             return False
         lt, rt = e._child_types(schema)
-        if not (_dtype_ok(lt) and _dtype_ok(rt)):
+        if not (_ref_dtype_ok(lt) and _ref_dtype_ok(rt)):
             return False
+        if TypeId.DECIMAL in (lt.id, rt.id):
+            # only the ops whose device math is exact may trace: equal-
+            # scale compares/+- on the unscaled ints, or unequal-scale
+            # compares through the limb rescale.  Everything else routes
+            # decimal_arith's host path, which cannot trace.
+            dec = e._decimal_types(lt, rt)
+            if dec is None:
+                return False
+            if not (e._decimal_device_ok(*dec)
+                    or (e.op in _CMP and e._decimal_limb_ok(*dec))):
+                return False
         return _traceable(e.left, schema) and _traceable(e.right, schema)
     if isinstance(e, (Not, IsNull, IsNotNull)):
         return _traceable(e.child, schema)
@@ -98,6 +119,29 @@ def _traceable(e: PhysicalExpr, schema: Schema) -> bool:
         return _dtype_ok(src) and _dtype_ok(e.to) and \
             _device_supported(src, e.to) and _traceable(e.child, schema)
     return False
+
+
+def eviction_reason(exprs: Sequence[PhysicalExpr],
+                    schema: Schema) -> str:
+    """Classify WHY a chain left the device lanes, by the first
+    referenced column dtype the gates reject: 'string' / 'decimal' /
+    'other'.  The per-column accounting behind host_evictions_* — a
+    string column merely present in the schema no longer brands the
+    whole stage, only chains that actually reference one."""
+    for i in _collect_refs(list(exprs)):
+        dt = schema[i].data_type
+        if dt.id in (TypeId.UTF8, TypeId.BINARY):
+            return "string"
+        if dt.id == TypeId.DECIMAL:
+            return "decimal"
+    return "other"
+
+
+def _note_host_eviction(exprs: Sequence[PhysicalExpr],
+                        schema: Schema) -> None:
+    from blaze_tpu.bridge import xla_stats
+    reason = eviction_reason(exprs, schema)
+    xla_stats.note_encoding(**{f"host_evictions_{reason}": 1})
 
 
 def _collect_refs(exprs: Sequence[PhysicalExpr]) -> List[int]:
@@ -133,7 +177,12 @@ def program_fingerprint(mode: str, filters: Sequence[PhysicalExpr],
             tuple(f.cache_key() for f in filters),
             tuple(p.cache_key() for p in projections),
             _schema_sig(in_schema),
-            bool(config.EXPR_DONATE.get()))
+            bool(config.EXPR_DONATE.get()),
+            # encoding knobs change what the trace computes (limb
+            # compares, scaled-int decimal operands): new setting ->
+            # new program, zero steady-state recompiles within one
+            bool(config.ENCODING_DECIMAL_ENABLE.get()),
+            bool(config.ENCODING_DICT_ENABLE.get()))
 
 
 # ---------------------------------------------------------------------------
@@ -341,6 +390,9 @@ class FusedExprsEvaluator:
         projections_ok = bool(self.projections) and all(
             is_traceable(p, in_schema) for p in self.projections) and \
             bool(_collect_refs(self.projections))
+        if (self.filters and not filters_ok) or \
+                (self.projections and not projections_ok):
+            _note_host_eviction(self.filters + self.projections, in_schema)
         # resolve only the program the operator shape will dispatch:
         # Filter -> filter, Project -> project, FilterProject -> the
         # combined program (or the filter half when projections are

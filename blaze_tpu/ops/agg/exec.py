@@ -292,9 +292,11 @@ class _AggState(MemConsumer):
         sink = _ArrowSink()
         for e, _name in op._group_exprs:
             cv = e.evaluate(cb)
-            if cv.is_device:
+            if cv.is_device and cv.dictionary is None:
                 sink.add_device(cv.data, cv.validity, n)
             else:
+                # host (or dict-encoded utf8: emit decoded strings — raw
+                # codes must never leave as key "values")
                 sink.add_host(cv.to_host(n))
         gids = xp.arange(cap)
         from blaze_tpu.ops.agg.functions import CountAgg
@@ -304,6 +306,10 @@ class _AggState(MemConsumer):
                 if not c.dtype.is_fixed_width and isinstance(fn, CountAgg):
                     # count(utf8_col): only validity feeds the kernel
                     # (same contract as _aggregate_input_batch)
+                    if c.array is None:  # dict-encoded: validity is
+                        av = xp.asarray(c.validity)  # already cap-sized
+                        args.append((av.astype(xp.int8), av))
+                        continue
                     av = np.zeros(cap, dtype=bool)
                     av[:len(c.array)] = np.asarray(c.array.is_valid())
                     av = av if xp is np else jnp.asarray(av)
@@ -402,9 +408,13 @@ class _AggState(MemConsumer):
                         # try a device materialization.  Other var-width
                         # aggs (max(utf8)) stay on the loud-failure path
                         # rather than reducing over a validity mask.
-                        av = np.zeros(cap, dtype=bool)
-                        av[:len(c.array)] = np.asarray(c.array.is_valid())
-                        av = av if xp is np else jnp.asarray(av)
+                        if c.array is None:  # dict-encoded utf8
+                            av = xp.asarray(c.validity)
+                        else:
+                            av = np.zeros(cap, dtype=bool)
+                            av[:len(c.array)] = np.asarray(
+                                c.array.is_valid())
+                            av = av if xp is np else jnp.asarray(av)
                         tv = xp.take(av, perm)
                         args.append((tv.astype(xp.int8),
                                      tv & sorted_valid))

@@ -112,6 +112,8 @@ class MemoryScanExec(ExecutionPlan):
                    batch_rows: Optional[int] = None) -> "MemoryScanExec":
         if isinstance(table, pa.RecordBatch):
             table = pa.Table.from_batches([table])
+        if config.ENCODING_DICT_ENABLE.get():
+            table = _dict_encode_table(table)
         schema = Schema.from_arrow(table.schema)
         batch_rows = batch_rows or config.BATCH_SIZE.get()
         batches = table.to_batches(max_chunksize=batch_rows)
@@ -201,9 +203,18 @@ class ParquetScanExec(ExecutionPlan):
         from blaze_tpu.ops.base import prefetch
         transform = ColumnBatch.from_arrow
         post = self._post_decode_filter()
-        if post is not None:
-            def transform(rb, _post=post):
-                return _post(ColumnBatch.from_arrow(rb))
+        # per-stream incremental dictionary encoder: each execute() call
+        # owns one (the running dictionary is stream state — codes are
+        # only comparable within a stream, and each batch's dictionary
+        # extends the previous batch's, so the LAST dictionary seen
+        # decodes every earlier batch of the stream)
+        enc = _stream_dict_encoder(self._schema)
+        if post is not None or enc is not None:
+            def transform(rb, _post=post, _enc=enc):
+                if _enc is not None:
+                    rb = _enc(rb)
+                cb = ColumnBatch.from_arrow(rb)
+                return _post(cb) if _post is not None else cb
         return prefetch(self._decode_batches(partition),
                         depth=self._prefetch_depth(),
                         transform=transform,
@@ -418,6 +429,112 @@ def assemble_partition_constants(rb: pa.RecordBatch, out_schema: Schema,
                       else pa.array([v] * rb.num_rows, type=at))
     return pa.RecordBatch.from_arrays(
         arrays, schema=out_schema.to_arrow())
+
+
+def _stream_dict_encoder(schema: Schema):
+    """A fresh per-stream encoder when dictionary encoding is on and the
+    scan emits utf8 columns; None otherwise (the disabled path never
+    touches the batch — byte-identical to pre-encoding behavior)."""
+    from blaze_tpu.schema import TypeId
+    if not config.ENCODING_DICT_ENABLE.get():
+        return None
+    if not any(f.data_type.id == TypeId.UTF8 for f in schema):
+        return None
+    return _StreamDictEncoder(schema, config.ENCODING_DICT_MAX_ENTRIES.get())
+
+
+class _StreamDictEncoder:
+    """Incremental per-stream dictionary encoding of utf8 scan columns.
+
+    Each utf8 column keeps a running stream-global dictionary in
+    first-seen order; every emitted batch's DictionaryArray indexes into
+    the CURRENT global, so dictionaries grow by appending only (prefix
+    property).  Downstream, a batch's codes therefore remain valid
+    against any LATER dictionary of the same stream — the stage loop
+    exploits this by decoding final group keys with the last dictionary
+    snapshot it saw.
+
+    Overflow past `auron.tpu.encoding.dict.maxEntries` retires the
+    column for the rest of the stream: later batches carry plain utf8
+    and downstream code (ColumnBatch.concat mixed branch, the stage-loop
+    stream guard) degrades losslessly to host strings.
+    """
+
+    def __init__(self, schema: Schema, max_entries: int):
+        from blaze_tpu.schema import TypeId
+        # col index -> running dictionary (None = not started,
+        # False = retired by overflow)
+        self._cols = {i: None for i, f in enumerate(schema)
+                      if f.data_type.id == TypeId.UTF8}
+        self._noted: set = set()
+        self._max = max(1, max_entries)
+
+    def __call__(self, rb: pa.RecordBatch) -> pa.RecordBatch:
+        import pyarrow.compute as pc
+        arrays = list(rb.columns)
+        changed = False
+        for i, vals in list(self._cols.items()):
+            if vals is False or i >= rb.num_columns:
+                continue
+            arr = rb.column(i)
+            if pa.types.is_dictionary(arr.type):
+                continue  # already encoded upstream
+            if not pa.types.is_string(arr.type):
+                arr = arr.cast(pa.string())
+            if vals is None:
+                vals = pa.array([], type=pa.string())
+            pos = pc.index_in(arr, value_set=vals)
+            missing = pc.and_(pc.is_valid(arr), pc.is_null(pos))
+            if len(arr) and pc.any(missing).as_py():
+                new_vals = pc.unique(arr.filter(missing)).cast(pa.string())
+                if len(vals) + len(new_vals) > self._max:
+                    # overflow: stop encoding this column for the stream
+                    self._cols[i] = False
+                    continue
+                vals = pa.concat_arrays([vals, new_vals])
+                pos = pc.index_in(arr, value_set=vals)
+            self._cols[i] = vals
+            if i not in self._noted:
+                self._noted.add(i)
+                from blaze_tpu.bridge import xla_stats
+                xla_stats.note_encoding(dict_encoded_columns=1)
+            arrays[i] = pa.DictionaryArray.from_arrays(
+                pos.cast(pa.int32()), vals)
+            changed = True
+        if not changed:
+            return rb
+        return pa.RecordBatch.from_arrays(arrays, names=list(rb.schema.names))
+
+
+def _dict_encode_table(table: pa.Table) -> pa.Table:
+    """Whole-table dictionary encoding for memory scans: one unified
+    dictionary per utf8 column (to_batches then slices it zero-copy, so
+    every batch of the scan shares one dictionary — the concat fast
+    path).  Columns whose cardinality exceeds maxEntries stay plain."""
+    import pyarrow.compute as pc
+    cap = max(1, config.ENCODING_DICT_MAX_ENTRIES.get())
+    arrays, changed = [], False
+    for i, f in enumerate(table.schema):
+        col = table.column(i)
+        if not pa.types.is_string(f.type):
+            arrays.append(col)
+            continue
+        arr = (col.combine_chunks() if col.num_chunks != 1
+               else col.chunk(0))
+        if isinstance(arr, pa.ChunkedArray):
+            arr = (arr.chunk(0) if arr.num_chunks
+                   else pa.array([], type=pa.string()))
+        enc = pc.dictionary_encode(arr)
+        if len(enc.dictionary) > cap:
+            arrays.append(col)
+            continue
+        from blaze_tpu.bridge import xla_stats
+        xla_stats.note_encoding(dict_encoded_columns=1)
+        arrays.append(enc)
+        changed = True
+    if not changed:
+        return table
+    return pa.Table.from_arrays(arrays, names=list(table.schema.names))
 
 
 def _align_schema(rb: pa.RecordBatch, schema: Schema) -> pa.RecordBatch:

@@ -112,6 +112,30 @@ def format_aqe_footer(x) -> Optional[str]:
         f"saved={_fmt_bytes(x.get('aqe_bytes_saved', 0))}")
 
 
+def format_encodings_footer(x) -> Optional[str]:
+    """The explain-analyze "encodings:" footer (dictionary-encoded
+    strings and scaled-int/limb decimals on the device lanes), or None
+    when no encoding lane fired — the encoding knobs are off by default
+    and the profile must stay byte-identical then."""
+    ev = (x.get("host_evictions_string", 0)
+          + x.get("host_evictions_decimal", 0)
+          + x.get("host_evictions_other", 0))
+    if not (x.get("dict_encoded_columns")
+            or x.get("decimal_scaled_int32_dispatches")
+            or x.get("decimal_scaled_int64_dispatches")
+            or x.get("decimal_limb_dispatches") or ev):
+        return None
+    return (
+        f"encodings: dict_cols={x.get('dict_encoded_columns', 0)} "
+        f"remaps={x.get('dict_exchange_remaps', 0)} "
+        f"dec_i32={x.get('decimal_scaled_int32_dispatches', 0)} "
+        f"dec_i64={x.get('decimal_scaled_int64_dispatches', 0)} "
+        f"dec_limb={x.get('decimal_limb_dispatches', 0)} "
+        f"evictions=string:{x.get('host_evictions_string', 0)}"
+        f"/decimal:{x.get('host_evictions_decimal', 0)}"
+        f"/other:{x.get('host_evictions_other', 0)}")
+
+
 def format_bottleneck_footer(report) -> Optional[str]:
     """The explain-analyze "bottleneck:" footer from a
     bridge/critical_path.bottleneck_report dict, or None when no spans
@@ -288,6 +312,9 @@ class QueryProfile:
         aqe_line = format_aqe_footer(x)
         if aqe_line is not None:
             lines.append(aqe_line)
+        enc_line = format_encodings_footer(x)
+        if enc_line is not None:
+            lines.append(enc_line)
         if any(x.get(k) for k in ("shuffle_device_bytes",
                                   "shuffle_host_bytes",
                                   "shuffle_device_fallbacks")):

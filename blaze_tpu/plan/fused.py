@@ -237,7 +237,12 @@ def _chain_cache_key(source_schema: Schema, chain, group_exprs, specs):
             tuple(chain_k),
             tuple(e.cache_key() for e, _ in group_exprs),
             tuple((rk, ok, a.cache_key() if a is not None else None)
-                  for rk, ok, a in specs))
+                  for rk, ok, a in specs),
+            # encoding knobs change what the chain traces (int32 code
+            # slots for utf8; limb compares for unequal-scale decimals):
+            # key them so toggling never reuses a stale prepare
+            bool(config.ENCODING_DICT_ENABLE.get()),
+            bool(config.ENCODING_DECIMAL_ENABLE.get()))
 
 
 def _source_row_count(child: ExecutionPlan):
@@ -1855,7 +1860,7 @@ class FusedPartialAggExec(ExecutionPlan):
                 return out
             slots *= 2
 
-    def _emit_hash(self, carry) -> BatchIterator:
+    def _emit_hash(self, carry, key_dicts=None) -> BatchIterator:
         count = int(jnp.sum(carry.used))
         if count == 0:
             return
@@ -1870,7 +1875,8 @@ class FusedPartialAggExec(ExecutionPlan):
                 for kd, kv in zip(keys_h, kvalid_h)]
         accs = [a[:count] for a in accs_h]
         avalid = [v[:count] for v in avalid_h]
-        yield from self._emit_rows(keys, accs, avalid)
+        yield from self._emit_rows(keys, accs, avalid,
+                                   key_dicts=key_dicts)
 
     # -- shared emission ----------------------------------------------------
     def _device_inputs(self, batch: ColumnBatch):
@@ -1892,13 +1898,23 @@ class FusedPartialAggExec(ExecutionPlan):
         return (tuple(kd), tuple(kv), tuple(ad), tuple(av),
                 _pad_lane(batch.row_mask()))
 
-    def _emit_rows(self, keys, accs, avalid) -> BatchIterator:
+    def _emit_rows(self, keys, accs, avalid,
+                   key_dicts=None) -> BatchIterator:
         n = len(accs[0]) if accs else len(keys[0][0])
         arrays: List[pa.Array] = []
         out_arrow = self._out_schema.to_arrow()
         i = 0
-        for (kd, kv), f in zip(keys, out_arrow):
-            arrays.append(_to_arrow(kd, kv, f.type))
+        for j, ((kd, kv), f) in enumerate(zip(keys, out_arrow)):
+            d = key_dicts[j] if key_dicts is not None else None
+            if d is not None:
+                # dict-encoded key: the table folded int32 codes; decode
+                # through the stream's final dictionary snapshot (its
+                # prefix covers every code of every earlier batch)
+                idx = pa.array(np.where(kv, kd.astype(np.int64), 0),
+                               pa.int64(), mask=~kv)
+                arrays.append(d.take(idx).cast(f.type))
+            else:
+                arrays.append(_to_arrow(kd, kv, f.type))
             i += 1
         for (_rk, out_kind, _arg), a, v in zip(self._specs, accs, avalid):
             f = out_arrow.field(i)
@@ -1994,18 +2010,98 @@ def _evict_if_full(cache: Dict) -> None:
         cache.pop(next(iter(cache)))  # FIFO: oldest compiled entry
 
 
+def _utf8_ref_free(expr, schema: Schema) -> bool:
+    """True when no BoundReference in the tree resolves to utf8 — inside
+    a traced chain such a reference would see raw dictionary codes,
+    whose comparison/order semantics are NOT string semantics."""
+    if isinstance(expr, BoundReference):
+        return schema[expr.index].data_type.id != TypeId.UTF8
+    return all(_utf8_ref_free(c, schema) for c in expr.children())
+
+
+def _dict_chain_safe(source_schema: Schema, chain, group_exprs,
+                     specs) -> bool:
+    """Static admission for tracing utf8 columns as int32 dictionary
+    codes: codes may only PASS THROUGH (identity projections, bare group
+    references) — never be computed on.  A filter, computed projection,
+    or agg argument touching utf8 would trace successfully on codes but
+    compute code-order semantics, so any such use rejects the chain and
+    it keeps the eager/staged path."""
+    sch = source_schema
+    for kind, preds, exprs, out_schema in chain:
+        if kind == "filter":
+            if not all(_utf8_ref_free(p, sch) for p in preds):
+                return False
+        else:
+            for e in exprs:
+                if isinstance(e, BoundReference):
+                    continue  # identity: codes flow through unchanged
+                if not _utf8_ref_free(e, sch):
+                    return False
+            sch = out_schema
+    for e, _n in group_exprs:
+        if (e.data_type(sch).id == TypeId.UTF8
+                and not isinstance(e, BoundReference)):
+            return False
+    for _rk, _ok, arg in specs:
+        if arg is not None and not _utf8_ref_free(arg, sch):
+            return False
+    return True
+
+
+def _dict_key_sources(agg):
+    """Per-group-key SOURCE column indices for dict-encoded utf8 keys
+    (None entries = plain fixed-width key), or None when the stage's
+    var-width keys are not admissible as dictionary codes.  Each utf8
+    key must be a bare reference whose chain lineage is identity
+    projections all the way down — the source index is what the runtime
+    loop watches for dictionaries."""
+    if not config.ENCODING_DICT_ENABLE.get():
+        return None
+    out = []
+    for e, _n in agg._group_exprs:
+        dt = e.data_type(agg._in_schema)
+        if dt.is_fixed_width:
+            out.append(None)
+            continue
+        if dt.id != TypeId.UTF8 or not isinstance(e, BoundReference):
+            return None
+        idx = e.index
+        for kind, _preds, exprs, _schema in reversed(agg._chain):
+            if kind != "project":
+                continue
+            pe = exprs[idx]
+            if not isinstance(pe, BoundReference):
+                return None
+            idx = pe.index
+        out.append(idx)
+    return tuple(out)
+
+
 def _prepare_factory(key, source_schema: Schema, chain, group_exprs,
                      specs):
     if key in _PREPARE_CACHE:
         return _PREPARE_CACHE[key]
     _evict_if_full(_PREPARE_CACHE)
     prepare = _make_prepare(source_schema, chain, group_exprs, specs)
+    dict_ok = (config.ENCODING_DICT_ENABLE.get()
+               and _dict_chain_safe(source_schema, chain, group_exprs,
+                                    specs))
+
+    def _slot(f):
+        if f.data_type.is_fixed_width:
+            return (jax.ShapeDtypeStruct((128,), f.data_type.jnp_dtype()),
+                    jax.ShapeDtypeStruct((128,), jnp.bool_))
+        if dict_ok and f.data_type.id == TypeId.UTF8:
+            # dict-encoded utf8: the program only ever sees int32 codes
+            # (the runtime loop guards that every utf8 source column
+            # actually arrives as a DictColumn, falling back otherwise)
+            return (jax.ShapeDtypeStruct((128,), jnp.int32),
+                    jax.ShapeDtypeStruct((128,), jnp.bool_))
+        return None
+
     try:
-        fake_cols = tuple(
-            (jax.ShapeDtypeStruct((128,), f.data_type.jnp_dtype()),
-             jax.ShapeDtypeStruct((128,), jnp.bool_))
-            if f.data_type.is_fixed_width else None
-            for f in source_schema)
+        fake_cols = tuple(_slot(f) for f in source_schema)
         jax.eval_shape(prepare, fake_cols,
                        jax.ShapeDtypeStruct((128,), jnp.bool_))
         result = prepare  # consumers inline it into their own jit step

@@ -413,6 +413,19 @@ _DEVICE_EXCHANGE_TIDS = frozenset((
     "date32", "timestamp_us"))
 
 
+def _note_exchange_type_eviction(tid) -> None:
+    """An exchange boundary just stayed on the host file shuffle because
+    of a column TYPE (not mode/keys): account the reason so the advisor
+    and bench placement reports show what actually evicted it."""
+    from blaze_tpu.bridge import xla_stats
+    if tid in ("utf8", "binary"):
+        xla_stats.note_encoding(host_evictions_string=1)
+    elif tid == "decimal":
+        xla_stats.note_encoding(host_evictions_decimal=1)
+    else:
+        xla_stats.note_encoding(host_evictions_other=1)
+
+
 def exchange_device_spec(partitioning: Optional[Dict[str, Any]],
                          out_schema: Optional[Dict[str, Any]]
                          ) -> Optional[Dict[str, Any]]:
@@ -451,8 +464,19 @@ def exchange_device_spec(partitioning: Optional[Dict[str, Any]],
     if not fields:
         return None
     for f in fields:
-        if f.get("type", {}).get("id") not in _DEVICE_EXCHANGE_TIDS:
-            return None
+        t = f.get("type", {})
+        tid = t.get("id")
+        if tid in _DEVICE_EXCHANGE_TIDS:
+            continue
+        if (tid == "decimal" and int(t.get("precision", 99)) <= 18
+                and config.ENCODING_DECIMAL_ENABLE.get()):
+            # p<=18 decimals already travel as unscaled int64 on device
+            # (batch._arrow_fixed_values), hash as longs (kernels/
+            # hashing "decimal" tid), and rebuild losslessly on the
+            # reduce side (batch.decimal_from_unscaled) — mesh-shardable
+            continue
+        _note_exchange_type_eviction(tid)
+        return None
     names = [f.get("name") for f in fields]
     key_indices = []
     for e in partitioning.get("exprs", []):

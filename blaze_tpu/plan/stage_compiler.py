@@ -79,6 +79,10 @@ class StageProgram:
     acc_dtypes: Tuple[Any, ...]    # jnp dtypes of the accumulators
     grow: bool                     # exact modes grow the table on overflow
     fingerprint: Tuple             # process-wide program identity
+    # per-group-key SOURCE column index when the key is dict-encoded
+    # utf8 (codes fold as int32; the loop captures each stream's last
+    # dictionary to decode the drain); None entries are plain keys
+    dict_keys: Tuple[Any, ...] = ()
 
     @property
     def source(self):
@@ -123,8 +127,15 @@ def compile_fused_agg(agg) -> StageProgram:
                                   "not a fused partial agg")
     if agg._ranges is not None:
         raise StageLoopIneligible("dense lane has its own windowed fold")
+    dict_keys: Tuple[Any, ...] = ()
     if agg._has_var_keys:
-        raise StageLoopIneligible("variable-width group keys")
+        from blaze_tpu.plan.fused import _dict_key_sources
+        admitted = _dict_key_sources(agg)
+        if admitted is None:
+            # a string key just evicted this stage from the device loop
+            xla_stats.note_encoding(host_evictions_string=1)
+            raise StageLoopIneligible("variable-width group keys")
+        dict_keys = admitted
     if agg._prepare is None:
         raise StageLoopIneligible("filter/project chain did not trace")
     if not agg._group_exprs:
@@ -132,13 +143,17 @@ def compile_fused_agg(agg) -> StageProgram:
     if not getattr(agg._source, "reexecutable", True):
         raise StageLoopIneligible("source is not re-executable: wholesale "
                                   "fallback could not re-run the partition")
+    import jax.numpy as jnp
     kinds = tuple(rk for rk, _ok, _a in agg._specs)
-    key_dtypes = tuple(e.data_type(agg._in_schema).jnp_dtype()
-                       for e, _n in agg._group_exprs)
+    key_dtypes = tuple(
+        jnp.int32 if dict_keys and dict_keys[i] is not None
+        else e.data_type(agg._in_schema).jnp_dtype()
+        for i, (e, _n) in enumerate(agg._group_exprs))
     acc_dtypes = tuple(agg._acc_dtypes())
     fingerprint = (agg._prepare_key, kinds,
                    tuple(str(d) for d in key_dtypes),
-                   tuple(str(d) for d in acc_dtypes), bool(agg._grow))
+                   tuple(str(d) for d in acc_dtypes), bool(agg._grow),
+                   dict_keys)
     hit = fingerprint in _SEEN_FINGERPRINTS
     xla_stats.note_stage_program(cache_hit=hit)
     if not hit:
@@ -148,7 +163,8 @@ def compile_fused_agg(agg) -> StageProgram:
     return StageProgram(agg=agg, prepare=agg._prepare,
                         prepare_key=agg._prepare_key, kinds=kinds,
                         key_dtypes=key_dtypes, acc_dtypes=acc_dtypes,
-                        grow=bool(agg._grow), fingerprint=fingerprint)
+                        grow=bool(agg._grow), fingerprint=fingerprint,
+                        dict_keys=dict_keys)
 
 
 def try_compile(agg) -> Optional[StageProgram]:

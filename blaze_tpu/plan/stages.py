@@ -108,6 +108,12 @@ def _columns_to_batch(datas, valids, arrow_schema: pa.Schema
             arrays.append(pa.array(data, mask=mask).cast(t))
         elif pa.types.is_boolean(t):
             arrays.append(pa.array(np.asarray(data, dtype=bool), mask=mask))
+        elif pa.types.is_decimal(t):
+            # the mesh carried the unscaled ints; a pa.array(..., type=t)
+            # would read them as whole decimal values and rescale
+            from blaze_tpu.batch import decimal_from_unscaled
+            arrays.append(decimal_from_unscaled(
+                np.asarray(data, dtype=np.int64), valid, t))
         else:
             arrays.append(pa.array(data, type=t, mask=mask))
     return pa.RecordBatch.from_arrays(arrays, schema=arrow_schema)
@@ -1580,7 +1586,14 @@ class DagScheduler:
             reasons = {}
             for key, reason in (("stage_loop_fallbacks", "stage_loop"),
                                 ("scatter_lane_declines", "scatter_lane"),
-                                ("expr_eager_batches", "expr_eager")):
+                                ("expr_eager_batches", "expr_eager"),
+                                # per-column causes (ISSUE 20): WHY the
+                                # stage left the device lane, not just
+                                # that it did
+                                ("host_evictions_string", "string_column"),
+                                ("host_evictions_decimal",
+                                 "decimal_column"),
+                                ("host_evictions_other", "other_column")):
                 n = int(delta.get(key, 0))
                 if n > 0:
                     reasons[reason] = n

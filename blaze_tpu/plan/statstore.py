@@ -59,6 +59,11 @@ INGEST_COUNTERS = (
     "shuffle_device_bytes", "shuffle_host_bytes",
     "shuffle_barrier_idle_ns", "shuffle_device_overlap_exchanges",
     "aqe_rewrites", "aqe_bytes_saved", "aqe_history_seeds",
+    "dict_encoded_columns", "dict_exchange_remaps",
+    "decimal_scaled_int32_dispatches", "decimal_scaled_int64_dispatches",
+    "decimal_limb_dispatches",
+    "host_evictions_string", "host_evictions_decimal",
+    "host_evictions_other",
 )
 
 #: appended lines per fingerprint file before it is compacted down to

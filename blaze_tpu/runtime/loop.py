@@ -244,10 +244,47 @@ def run_partition(program, partition: int, ctx: str = "",
     return carry
 
 
+def _dict_stream_guard(stream, utf8_cols, key_srcs, captured):
+    """Wrap a dict-key stage's source stream: every utf8 source column
+    must arrive dictionary-encoded (the prepare traced int32 code slots
+    for them — a plain utf8 batch, e.g. after encoder overflow, has no
+    device form and must fall back BEFORE the fold sees it), and the
+    latest dictionary per key source is captured as it passes.  The
+    encoder's prefix property makes the LAST dictionary of the stream
+    decode every earlier batch's codes, so capture is just
+    last-writer-wins."""
+    from blaze_tpu.batch import DictColumn
+    for batch in stream:
+        for ci in utf8_cols:
+            c = batch.columns[ci]
+            if not isinstance(c, DictColumn) or c.dictionary is None:
+                raise StageLoopFallback(
+                    "utf8 source column arrived without dictionary "
+                    "encoding (encoder overflow or unencoded source)")
+            if ci in key_srcs:
+                captured[ci] = c.dictionary
+        yield batch
+
+
 def execute_loop(program, partition: int, ctx: str = ""):
     """Generator form for FusedPartialAggExec.execute: fold, then drain
     through the shared emission path (ColumnBatch chunks).  Guaranteed
     to raise StageLoopFallback only BEFORE the first yield."""
+    dict_keys = getattr(program, "dict_keys", ())
+    if any(s is not None for s in dict_keys):
+        from blaze_tpu.schema import TypeId
+        utf8_cols = {i for i, f in enumerate(program.source.schema)
+                     if f.data_type.id == TypeId.UTF8}
+        key_srcs = {s for s in dict_keys if s is not None}
+        captured: dict = {}
+        stream = _dict_stream_guard(program.source.execute(partition),
+                                    utf8_cols, key_srcs, captured)
+        carry = run_partition(program, partition, ctx=ctx,
+                              source_stream=stream)
+        key_dicts = [captured.get(s) if s is not None else None
+                     for s in dict_keys]
+        yield from program.agg._emit_hash(carry, key_dicts=key_dicts)
+        return
     carry = run_partition(program, partition, ctx=ctx)
     yield from program.agg._emit_hash(carry)
 
@@ -258,6 +295,11 @@ def drain_device(program, carry):
     DeviceExchange without a host round trip.  Returns (datas, valids,
     n) — lists of length-n device arrays in output column order."""
     from blaze_tpu.plan.fused import _bucket
+    if any(s is not None for s in getattr(program, "dict_keys", ())):
+        # dict-key stages never reach here (utf8 output columns exclude
+        # the boundary from DeviceExchange), but raw codes must not leak
+        # into an exchange if that ever changes
+        raise StageLoopFallback("dict-encoded keys cannot drain D2D")
     used = carry.used
     count = int(jax.device_get(jnp.sum(used)))
     if count == 0:

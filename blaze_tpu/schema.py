@@ -151,6 +151,11 @@ class DataType:
             return BINARY
         if pa.types.is_null(t):
             return NULL
+        if pa.types.is_dictionary(t):
+            # dictionary encoding is a physical layout, not a logical
+            # type: the schema keeps the value type (batch.DictColumn
+            # carries the codes)
+            return DataType.from_arrow(t.value_type)
         if pa.types.is_list(t):
             return DataType(TypeId.LIST, children=(
                 Field("item", DataType.from_arrow(t.value_type), True),))

@@ -47,7 +47,11 @@ _LOWER_IS_BETTER = re.compile(
     r"(wall|latency|_ms\b|_ns\b|_s\b|seconds|p50|p95|p99|overhead|"
     r"spill|wait|gap|idle|retries|failures|crashes|fallbacks|declines|"
     r"evictions|recoveries|lag|delay|queued|dropped|misses|error|"
-    r"lost|reroutes|torn_frames|down_events)",
+    r"lost|reroutes|torn_frames|down_events|"
+    # encoding lanes (ISSUE 20): checked before the generic "fraction"
+    # higher-is-better rule below, so eviction_fraction scores the
+    # right way; remaps are dictionary-merge work at exchange edges
+    r"eviction_fraction|dict_exchange_remaps)",
     re.IGNORECASE)
 _HIGHER_IS_BETTER = re.compile(
     r"(rows_per_sec|per_sec|qps|throughput|speedup|hit_rate|hits\b|"
@@ -55,7 +59,13 @@ _HIGHER_IS_BETTER = re.compile(
     r"overlap(?:ped)?|cpu_parallelism|"
     r"share_ratio|replicas_up|hedge_wins|"
     r"aqe_(rewrites|broadcast_switches|partitions_coalesced|"
-    r"skew_splits|history_seeds|stages_elided))", re.IGNORECASE)
+    r"skew_splits|history_seeds|stages_elided)|"
+    # encoding lanes (ISSUE 20): more columns riding int codes / more
+    # decimal work dispatched on the scaled-int tiers = more of the
+    # workload device-resident
+    r"dict_encoded_columns|decimal_scaled_int\d+_dispatches|"
+    r"decimal_limb_dispatches|stage_loop_tasks|device_exchanges)",
+    re.IGNORECASE)
 
 
 def metric_direction(key: str) -> str:

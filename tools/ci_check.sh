@@ -83,6 +83,23 @@ if [ "${CI_FLEET_FAST:-1}" = "1" ]; then
         python bench.py --fleet --fast
 fi
 
+# Fast encodings smoke (CI_ENCODINGS_FAST=0 to skip): the dictionary-
+# string and decimal-lane test modules plus a reduced --encodings run —
+# string-group-by and decimal-agg legs, encodings off vs on.  Self-
+# gating: bench --encodings exits nonzero on any divergent frame, a
+# leg that stays host-placed with the encodings on, any device-lane
+# fallback, or an eviction fraction that fails to drop.  Not
+# sentinel-compared (the reduced corpus carries different walls than
+# the committed BENCH_ENCODINGS baseline).
+if [ "${CI_ENCODINGS_FAST:-1}" = "1" ]; then
+    echo "== ci_check: encoding-lane tests =="
+    python -m pytest tests/test_dict_strings.py tests/test_decimal_lanes.py \
+        -q -p no:cacheprovider
+    echo "== ci_check: bench --encodings --fast (smoke) =="
+    env "BLAZE_BENCH_ENCODINGS_PATH=$WORK/BENCH_ENCODINGS_FAST.json" \
+        python bench.py --encodings --fast
+fi
+
 fail=0
 for leg in $LEGS; do
     name="$(echo "${leg#--}" | tr '[:lower:]' '[:upper:]')"
